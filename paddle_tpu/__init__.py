@@ -1,0 +1,65 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference: pkuzyc/Paddle, surveyed in /root/repo/
+SURVEY.md), built on JAX/XLA/Pallas.
+
+Architecture (vs the reference's layer map, SURVEY.md §1):
+- layers 0-5 (tensor core, kernels, dispatch) -> `core/` + `ops/` over XLA
+- layer 6 (eager autograd)                    -> `core/autograd.py` tape of
+  jax.vjp pullbacks
+- layers 7-9 (IR, executor, CINN compiler)    -> `jit/` traces the eager tape
+  under jax.jit into single XLA programs; Pallas kernels in `kernels/`
+- layers 10+ (distributed)                    -> `distributed/` over
+  jax.sharding Mesh + GSPMD/shard_map collectives
+"""
+
+from __future__ import annotations
+
+import warnings as _warnings
+
+# x64 stays disabled (TPU-first: int32/float32 are the wide types); silence
+# jnp's per-call truncation notice for paddle-parity int64 requests.
+_warnings.filterwarnings(
+    "ignore", message=".*truncated to dtype int32.*", category=UserWarning)
+
+from . import core
+from .core import (  # noqa: F401
+    Generator, Parameter, Place, Tensor, bfloat16, complex64, complex128,
+    device_count, enable_grad, float8_e4m3fn, float8_e5m2, float16, float32,
+    float64, get_default_dtype, get_device, grad, int8, int16, int32, int64,
+    is_compiled_with_tpu, is_grad_enabled, is_tensor, no_grad, seed,
+    set_default_dtype, set_device, uint8,
+)
+from .core.dtype import bool_ as bool  # noqa: F401
+from .ops import *  # noqa: F401,F403
+from . import ops
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import autograd  # noqa: F401
+from . import framework  # noqa: F401
+from . import device  # noqa: F401
+from .framework.io import load, save  # noqa: F401
+from . import metric  # noqa: F401
+from . import incubate  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def synchronize():
+    core.place.synchronize()
+
+
+def disable_static(*args, **kwargs):  # always-eager front end
+    pass
+
+
+def enable_static(*args, **kwargs):
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static graph mode; use paddle_tpu.jit "
+        "(to_static / compile_train_step) for the compiled path")
+
+
+def in_dynamic_mode():
+    return True

@@ -1,0 +1,13 @@
+from . import autograd, dispatch, dtype, place, random  # noqa: F401
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad  # noqa: F401
+from .dtype import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, convert_dtype, float8_e4m3fn,
+    float8_e5m2, float16, float32, float64, get_default_dtype, int8, int16,
+    int32, int64, set_default_dtype, uint8,
+)
+from .place import (  # noqa: F401
+    Place, device_count, get_device, is_compiled_with_tpu, set_device,
+    synchronize,
+)
+from .random import Generator, default_generator, seed  # noqa: F401
+from .tensor import Parameter, Tensor, is_tensor  # noqa: F401

@@ -1,0 +1,312 @@
+"""Tape-based eager autograd engine.
+
+Capability parity with the reference's eager autograd
+(`paddle/fluid/eager/grad_node_info.h:197` GradNodeBase, `backward.cc:439`
+egr::Backward), designed TPU-first: every recorded op stores the `jax.vjp`
+pullback of its traced forward, so the backward pass is itself a chain of
+XLA-compiled pullbacks (and the whole tape is re-traceable under `jax.jit`,
+which is how the compiled train step fuses forward+backward+update into one
+XLA program).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+from . import dtype as dtype_mod
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+class no_grad:
+    """Context manager / decorator disabling gradient recording.
+
+    Mirrors `paddle.no_grad` (reference: python/paddle/base/dygraph/base.py).
+    """
+
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+
+class Node:
+    """One recorded op on the tape (analogue of a generated GradNode).
+
+    ``vjp_fn`` maps a tuple of output cotangents (one per op output, in
+    op-output order) to a tuple of input cotangents (one per entry of
+    ``inputs``).
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "out_meta", "name", "__weakref__")
+
+    def __init__(
+        self,
+        vjp_fn: Callable,
+        inputs: Sequence[Any],
+        out_meta: Sequence[tuple],
+        name: str = "",
+    ):
+        self.vjp_fn = vjp_fn
+        self.inputs = tuple(inputs)  # Tensors, vjp arg order
+        self.out_meta = tuple(out_meta)  # (shape, dtype) per op output
+        self.name = name
+
+    def __repr__(self):
+        return f"<Node {self.name} n_in={len(self.inputs)} n_out={len(self.out_meta)}>"
+
+
+def _zero_cotangent(shape, dt):
+    if dtype_mod.is_floating_point(dt) or dtype_mod.is_complex(dt):
+        import jax.numpy as jnp
+
+        return jnp.zeros(shape, dt)
+    # Non-differentiable output: jax.vjp expects float0 cotangents.
+    return np.zeros(shape, dtype=jax.dtypes.float0)
+
+
+def _topo_order(root_nodes):
+    """Reverse-topological order of reachable nodes (outputs before inputs)."""
+    order = []
+    state = {}  # node -> 0 visiting, 1 done
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            state[id(node)] = 1
+            order.append(node)
+            continue
+        if id(node) in state:
+            continue
+        state[id(node)] = 0
+        stack.append((node, True))
+        for t in node.inputs:
+            prod = t._node
+            if prod is not None and id(prod) not in state:
+                stack.append((prod, False))
+    order.reverse()  # produce consumers-first order
+    return order
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, _into=None):
+    """Run the tape backward from ``tensors``, accumulating into leaf ``.grad``.
+
+    Mirrors `egr::Backward` (reference paddle/fluid/eager/backward.cc:439):
+    seeds cotangents (ones for scalar roots), walks grad nodes in dependency
+    order, accumulates gradients on leaf tensors. When ``_into`` is a dict,
+    leaf gradients are collected there (id(tensor) -> array) instead of
+    touching ``.grad`` — the functional `grad()` path.
+    """
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    import jax.numpy as jnp
+
+    # node id -> list of accumulated output cotangents (or None)
+    pending: dict[int, list] = {}
+    node_by_id: dict[int, Node] = {}
+    leaf_grads: dict[int, Any] = {}
+    leaf_by_id: dict[int, Tensor] = {}
+    root_nodes = []
+
+    def _seed(t, g):
+        if t.stop_gradient:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True"
+            )
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}"
+                )
+            g = jnp.ones(t._data.shape, t._data.dtype)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        _route(t, g)
+
+    def _route(t, g):
+        node = t._node
+        if node is None:
+            if not t.stop_gradient:
+                key = id(t)
+                leaf_by_id[key] = t
+                leaf_grads[key] = g if key not in leaf_grads else leaf_grads[key] + g
+            return
+        nid = id(node)
+        if nid not in pending:
+            pending[nid] = [None] * len(node.out_meta)
+            node_by_id[nid] = node
+            root_nodes.append(node)
+        slot = pending[nid]
+        idx = t._out_idx
+        slot[idx] = g if slot[idx] is None else slot[idx] + g
+
+    for t, g in zip(tensors, grad_tensors):
+        _seed(t, g)
+
+    order = _topo_order(root_nodes)
+
+    for node in order:
+        nid = id(node)
+        cts = pending.get(nid)
+        if cts is None:
+            # Reachable from roots topologically but received no cotangent
+            # (all consumers were grad-pruned); its inputs get zeros — skip.
+            continue
+        full = tuple(
+            ct if ct is not None else _zero_cotangent(shape, dt)
+            for ct, (shape, dt) in zip(cts, node.out_meta)
+        )
+        in_grads = node.vjp_fn(full)
+        for t, g in zip(node.inputs, in_grads):
+            if t.stop_gradient:
+                continue
+            prod = t._node
+            if prod is None:
+                key = id(t)
+                leaf_by_id[key] = t
+                leaf_grads[key] = (
+                    g if key not in leaf_grads else leaf_grads[key] + g
+                )
+            else:
+                pid = id(prod)
+                if pid not in pending:
+                    pending[pid] = [None] * len(prod.out_meta)
+                    node_by_id[pid] = prod
+                slot = pending[pid]
+                idx = t._out_idx
+                slot[idx] = g if slot[idx] is None else slot[idx] + g
+        pending[nid] = None  # free cotangents early
+
+    # Accumulate into .grad (GradNodeAccumulation analogue), or into the
+    # caller's store for the functional grad() path.
+    if _into is not None:
+        for key, g in leaf_grads.items():
+            _into[key] = g if key not in _into else _into[key] + g
+    else:
+        for key, g in leaf_grads.items():
+            t = leaf_by_id[key]
+            if t.grad is None:
+                t.grad = Tensor(g, stop_gradient=True)
+            else:
+                t.grad = Tensor(t.grad._data + g, stop_gradient=True)
+
+    if not retain_graph:
+        for t in tensors:
+            _release_graph(t)
+
+
+def _release_graph(root):
+    """Drop tape references so intermediate activations can be freed."""
+    node = root._node
+    if node is None:
+        return
+    seen = set()
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        for t in n.inputs:
+            if t._node is not None:
+                stack.append(t._node)
+        n.vjp_fn = _dead_vjp
+        n.inputs = ()
+
+
+def _dead_vjp(*_):
+    raise RuntimeError(
+        "trying to backward through a graph a second time; "
+        "pass retain_graph=True to backward()"
+    )
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    allow_unused=False,
+):
+    """Functional gradient: d(outputs)/d(inputs) without touching ``.grad``.
+
+    Mirrors `paddle.grad` (reference python/paddle/autograd/__init__.py).
+    ``create_graph`` is not supported on the eager tape; use the functional
+    `paddle_tpu.jit` path (jax.grad) for higher-order derivatives.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; "
+            "use paddle_tpu.incubate.autograd / jax.grad on a pure function"
+        )
+    single = isinstance(inputs, Tensor)
+    inputs = [inputs] if single else list(inputs)
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+
+    store: dict[int, Any] = {}
+    backward(outputs, grad_tensors=grad_outputs, retain_graph=True,
+             _into=store)
+    results = []
+    for t in inputs:
+        g = store.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient; "
+                    "pass allow_unused=True to return None for it"
+                )
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    if retain_graph is False or retain_graph is None:
+        for t in outputs:
+            _release_graph(t)
+    return results[0] if single else results

@@ -1,0 +1,91 @@
+"""Op dispatch: the bridge from eager Tensor calls to XLA.
+
+Capability parity with the reference's generated dispatch chain
+(`paddle/phi/api/generator/api_base.py:1300` kernel selection +
+`eager_gen.py:321` ad_func node creation), collapsed into one function:
+``apply`` runs the jnp/lax forward, and — when any floating input requires
+grad — records a tape Node holding the `jax.vjp` pullback. There is no
+kernel registry to search: XLA owns kernel selection per backend.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from . import dtype as dtype_mod
+from .autograd import Node, is_grad_enabled
+from .tensor import Tensor
+
+
+def _differentiable(dt) -> bool:
+    return dtype_mod.is_floating_point(dt) or dtype_mod.is_complex(dt)
+
+
+def apply(fn: Callable, *args, name: str = None, **kwargs):
+    """Run ``fn`` over the payloads of ``args`` and wrap outputs as Tensors.
+
+    - Tensor args are unwrapped to jax arrays; non-Tensor args pass through.
+    - If recording, differentiable Tensor args become jax.vjp arguments and a
+      Node is attached to every differentiable output.
+    - ``fn`` may return one array or a tuple/list of arrays; ``apply``
+      returns a single Tensor or a list of Tensors accordingly.
+    """
+    name = name or getattr(fn, "__name__", "op")
+    diff_idx = []
+    payloads = []
+    recording = is_grad_enabled()
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            payloads.append(a._data)
+            if recording and not a.stop_gradient and \
+                    _differentiable(a._data.dtype):
+                diff_idx.append(i)
+        else:
+            payloads.append(a)
+
+    if not diff_idx:
+        out = fn(*payloads, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return [Tensor(o) for o in out]
+        return Tensor(out)
+
+    diff_args = [payloads[i] for i in diff_idx]
+    was_tuple = [False]
+
+    def pure(*diff_vals):
+        full = list(payloads)
+        for pos, v in zip(diff_idx, diff_vals):
+            full[pos] = v
+        out = fn(*full, **kwargs)
+        if isinstance(out, (tuple, list)):
+            was_tuple[0] = True
+            return tuple(out)
+        return (out,)
+
+    out_tuple, vjp_fn = jax.vjp(pure, *diff_args)
+    out_meta = [(o.shape, o.dtype) for o in out_tuple]
+    node = Node(vjp_fn, [args[i] for i in diff_idx], out_meta, name=name)
+
+    outs = []
+    any_diff_out = False
+    for idx, o in enumerate(out_tuple):
+        t = Tensor(o)
+        if _differentiable(o.dtype):
+            t.stop_gradient = False
+            t._node = node
+            t._out_idx = idx
+            any_diff_out = True
+        outs.append(t)
+    if not any_diff_out:
+        for t in outs:
+            t._node = None
+
+    if was_tuple[0]:
+        return outs
+    return outs[0]
+
+
+def unwrap(x):
+    return x._data if isinstance(x, Tensor) else x

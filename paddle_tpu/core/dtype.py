@@ -1,0 +1,107 @@
+"""Dtype registry.
+
+Capability parity with the reference's scalar-type layer
+(`paddle/phi/common/data_type.h`, `bfloat16.h`, `float8_e4m3fn.h`): a set of
+canonical dtype objects, name lookup, and promotion helpers. TPU-first: the
+canonical training dtype is bfloat16; float32 is the accumulation/master dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (numpy dtype instances, same objects jnp uses).
+bool_ = jnp.dtype(jnp.bool_)
+uint8 = jnp.dtype(jnp.uint8)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int64)
+float16 = jnp.dtype(jnp.float16)
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float64)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex128)
+float8_e4m3fn = jnp.dtype(jnp.float8_e4m3fn)
+float8_e5m2 = jnp.dtype(jnp.float8_e5m2)
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "fp16": float16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+_INTEGER = {uint8, int8, int16, int32, int64}
+_COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalize a dtype-like (string, numpy dtype, python type) to a dtype."""
+    if dtype is None:
+        raise TypeError("dtype must not be None")
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise TypeError(f"unsupported dtype name: {dtype!r}") from None
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    return d.name
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in _INTEGER
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in _COMPLEX
+
+
+_DEFAULT_DTYPE = [float32]
+
+
+def get_default_dtype() -> jnp.dtype:
+    return _DEFAULT_DTYPE[0]
+
+
+def set_default_dtype(dtype) -> None:
+    d = convert_dtype(dtype)
+    if d not in _FLOATING:
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def promote_types(a, b) -> jnp.dtype:
+    return jnp.promote_types(convert_dtype(a), convert_dtype(b))
+
+
+def to_numpy_dtype(dtype) -> np.dtype:
+    d = convert_dtype(dtype)
+    if d == bfloat16:
+        # numpy has no native bfloat16; ml_dtypes provides it via jnp.
+        return d
+    return np.dtype(d)

@@ -1,0 +1,75 @@
+"""Stateful RNG with a functional core.
+
+Capability parity with the reference's `Generator` (`paddle/phi/core/generator.h`)
+and `paddle.seed`. TPU-first: the state is a JAX PRNG key that is split per
+draw. Under `jax.jit` tracing, the compiled-step driver swaps in a traced key
+via ``scoped_key`` so randomness is an input to the XLA program (deterministic
+replay, new randomness per step) instead of a baked constant.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.PRNGKey(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = seed
+        self._key = jax.random.PRNGKey(seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def split(self):
+        """Return a fresh subkey, advancing the state."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.generator = Generator(0)
+
+
+_state = _RngState()
+
+
+def default_generator() -> Generator:
+    return _state.generator
+
+
+def seed(value: int) -> Generator:
+    """Global seed (mirrors `paddle.seed`)."""
+    return _state.generator.manual_seed(int(value))
+
+
+def next_key():
+    return _state.generator.split()
+
+
+@contextlib.contextmanager
+def scoped_key(key):
+    """Temporarily replace the global RNG state with ``key`` (used by the
+    compiled train step to thread a per-step traced key through stateful
+    dropout/random ops)."""
+    gen = _state.generator
+    saved = gen.get_state()
+    gen.set_state(key)
+    try:
+        yield
+    finally:
+        gen.set_state(saved)

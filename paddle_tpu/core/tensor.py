@@ -1,0 +1,263 @@
+"""The eager Tensor.
+
+Capability parity with the reference's eager Tensor
+(`paddle/phi/api/include/tensor.h:82` C++ Tensor, `paddle/fluid/pybind/eager.cc:68`
+Python binding): data + autograd metadata (stop_gradient, grad), device
+placement, numpy interop. TPU-first: the payload is a `jax.Array`, so every
+tensor is an asynchronously-dispatched XLA buffer and the same Tensor code
+runs under `jax.jit` tracing (payload becomes a tracer) — this is what lets
+the "dygraph" front end compile into single XLA programs.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+from . import place as place_mod
+from .autograd import backward as _backward
+
+
+class Tensor:
+    __slots__ = ("_data", "grad", "stop_gradient", "_node", "_out_idx", "name",
+                 "persistable", "__weakref__")
+
+    def __init__(self, data, dtype=None, place=None, stop_gradient=True,
+                 name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if dtype is not None:
+            dtype = dtype_mod.convert_dtype(dtype)
+        if isinstance(data, (jax.Array, jax.core.Tracer)):
+            arr = data if dtype is None else data.astype(dtype)
+        else:
+            if isinstance(data, (float, int)) and dtype is None:
+                dtype = (dtype_mod.get_default_dtype()
+                         if isinstance(data, float) else dtype_mod.int64)
+            arr = jnp.asarray(data, dtype=dtype)
+            if arr.dtype == jnp.float64 and dtype is None:
+                arr = arr.astype(dtype_mod.get_default_dtype())
+        if place is not None:
+            arr = jax.device_put(arr, place_mod.Place.parse(place).jax_device())
+        self._data = arr
+        self.grad = None
+        self.stop_gradient = stop_gradient
+        self._node = None
+        self._out_idx = 0
+        self.name = name
+        self.persistable = False
+
+    # -- metadata ---------------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        devs = getattr(self._data, "devices", None)
+        if devs is None or isinstance(self._data, jax.core.Tracer):
+            return place_mod._default_place()
+        d = next(iter(self._data.devices()))
+        return place_mod.Place(d.platform, d.id)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return self._data.dtype.itemsize
+
+    # -- host interop -----------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *idx):
+        arr = self._data
+        if idx:
+            arr = arr[idx]
+        return arr.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- autograd ---------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        _backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data))
+        else:
+            self.grad = None
+
+    clear_grad = clear_gradient
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self._out_idx = 0
+        self.stop_gradient = True
+        return self
+
+    # -- mutation (in-place surface) --------------------------------------
+    def _rebind(self, array):
+        """Replace the payload in place. Previously recorded tape nodes hold
+        immutable residual arrays, so this cannot corrupt earlier history."""
+        self._data = array
+        return self
+
+    def set_value(self, value):
+        value = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(value.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._data.shape}")
+        return self._rebind(value.astype(self._data.dtype))
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def zero_(self):
+        return self._rebind(jnp.zeros_like(self._data))
+
+    def fill_(self, value):
+        return self._rebind(jnp.full_like(self._data, value))
+
+    # -- misc -------------------------------------------------------------
+    def to(self, *args, **kwargs):
+        """to(place), to(dtype) or to(place, dtype)."""
+        place = kwargs.pop("place", None)
+        dtype = kwargs.pop("dtype", None)
+        for a in args:
+            if isinstance(a, str) and a in dtype_mod._NAME_TO_DTYPE:
+                dtype = a
+            elif isinstance(a, (str, place_mod.Place, jax.Device)):
+                place = a
+            else:
+                dtype = a
+        if dtype is None and place is None:
+            return self
+        dt = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+        dev = place_mod.Place.parse(place).jax_device() if place is not None \
+            else None
+
+        def _to(a):
+            if dt is not None:
+                a = a.astype(dt)
+            if dev is not None:
+                a = jax.device_put(a, dev)
+            return a
+        from .dispatch import apply
+        return apply(_to, self, name="to")
+
+    def cuda(self, *a, **k):  # tolerated alias; maps to the accelerator
+        return self.to("tpu")
+
+    def cpu(self):
+        return self.to("cpu")
+
+    def pin_memory(self):
+        return self
+
+    def block_until_ready(self):
+        if hasattr(self._data, "block_until_ready"):
+            self._data.block_until_ready()
+        return self
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_part = "" if self.stop_gradient else ", stop_gradient=False"
+        if isinstance(self._data, jax.core.Tracer):
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                    f"traced{grad_part})")
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"place={self.place}{grad_part},\n{np.asarray(self._data)})")
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __format__(self, spec):
+        if self.size == 1:
+            return format(self._data.item(), spec)
+        return format(str(self), spec)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # Arithmetic/indexing dunders are bound by paddle_tpu.ops.bind_tensor_methods
+    # at package import time (mirrors the generated eager_method.cc binding).
+
+    def __hash__(self):
+        return id(self)
+
+
+class Parameter(Tensor):
+    """A trainable leaf tensor (reference: python/paddle/base/framework.py
+    EagerParamBase). stop_gradient defaults to False; ``trainable`` mirrors
+    paddle's attribute."""
+
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, value):
+        self.stop_gradient = not value
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def is_tensor(obj: Any) -> bool:
+    return isinstance(obj, Tensor)

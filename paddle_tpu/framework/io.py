@@ -1,0 +1,6 @@
+def save(obj, path, **kwargs):
+    raise NotImplementedError
+
+
+def load(path, **kwargs):
+    raise NotImplementedError

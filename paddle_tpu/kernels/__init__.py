@@ -1,0 +1,5 @@
+"""Pallas TPU kernels + XLA reference paths for the fused ops the reference
+implements as CUDA kernels (`paddle/phi/kernels/fusion/gpu/`,
+`paddle/fluid/operators/fused/`)."""
+
+from . import flash_attention  # noqa: F401

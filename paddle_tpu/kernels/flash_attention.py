@@ -1,0 +1,100 @@
+"""Scaled-dot-product / flash attention.
+
+Capability parity with the reference's `flash_attn_kernel.cu:128` (FA2
+dynload) and `python/paddle/nn/functional/flash_attention.py`. Two paths:
+
+- `sdpa_xla`: straight jnp attention — XLA fuses well and serves as the
+  numeric oracle and CPU/interpret fallback.
+- Pallas TPU kernel (`paddle_tpu/kernels/pallas/flash_attention.py`), used
+  automatically on TPU for supported shapes/dtypes.
+
+Layout is paddle's: [batch, seq, num_heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.random import next_key
+
+
+def _use_pallas(q) -> bool:
+    try:
+        if jax.default_backend() == "cpu":
+            return False
+    except RuntimeError:
+        return False
+    # MXU-friendly: head_dim multiple of 128 handled by kernel padding; seq
+    # must be tile-divisible. The pallas kernel pads internally; gate only on
+    # dtype support.
+    return q.dtype in (jnp.float32, jnp.bfloat16)
+
+
+def sdpa_xla(q, k, v, bias=None, causal=False, scale=None):
+    """Reference attention on [B, S, H, D] arrays (not Tensors)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # fp32 logits for stability (matches FA2 semantics)
+    logits = jnp.einsum("bsnd,btnd->bnst", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), bool), k=t - s)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    if bias is not None:
+        logits = logits + bias.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", probs, v)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                       dropout_p=dropout, is_causal=causal,
+                                       training=training)
+    return out, None
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """SDPA on Tensors of shape [batch, seq, heads, head_dim] (paddle
+    layout). GQA supported: key/value may have fewer heads (must divide)."""
+    mask_arr = unwrap(attn_mask)
+    use_dropout = training and dropout_p > 0.0
+    key_rng = next_key() if use_dropout else None
+
+    def _sdpa(q, k, v):
+        qh, kh = q.shape[2], k.shape[2]
+        if kh != qh:  # GQA: repeat kv heads
+            rep = qh // kh
+            k2 = jnp.repeat(k, rep, axis=2)
+            v2 = jnp.repeat(v, rep, axis=2)
+        else:
+            k2, v2 = k, v
+        if _use_pallas(q) and mask_arr is None and not use_dropout:
+            try:
+                from .pallas.flash_attention import flash_attention_fwd
+            except ImportError:
+                flash_attention_fwd = None
+            if flash_attention_fwd is not None:
+                return flash_attention_fwd(q, k2, v2, causal=is_causal)
+        bias = None
+        if mask_arr is not None:
+            m = mask_arr
+            if m.dtype == jnp.bool_:
+                bias = jnp.where(m, 0.0, -jnp.inf)
+            else:
+                bias = m
+        out = sdpa_xla(q, k2, v2, bias=bias, causal=is_causal)
+        if use_dropout:
+            keep = jax.random.bernoulli(key_rng, 1.0 - dropout_p, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout_p), 0.0)
+        return out.astype(q.dtype)
+    return apply(_sdpa, query, key, value, name="flash_attention")

@@ -1,0 +1,208 @@
+"""Activation functionals (parity: reference
+`python/paddle/nn/functional/activation.py`)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+
+__all__ = [
+    "relu", "relu6", "gelu", "silu", "swish", "sigmoid", "log_sigmoid",
+    "tanh", "softmax", "log_softmax", "leaky_relu", "elu", "selu", "celu",
+    "prelu", "rrelu", "hardshrink", "hardsigmoid", "hardswish", "hardtanh",
+    "softplus", "softshrink", "softsign", "tanhshrink", "thresholded_relu",
+    "maxout", "glu", "swiglu", "mish", "gumbel_softmax",
+]
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, x, name="relu")
+
+
+def relu6(x, name=None):
+    return apply(jax.nn.relu6, x, name="relu6")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), x,
+                 name="gelu")
+
+
+def silu(x, name=None):
+    return apply(jax.nn.silu, x, name="silu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x, name="sigmoid")
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x, name="log_sigmoid")
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x, name="tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def _softmax(a):
+        if dtype is not None:
+            from ...core.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply(_softmax, x, name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def _log_softmax(a):
+        if dtype is not None:
+            from ...core.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply(_log_softmax, x, name="log_softmax")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), x,
+                 name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha), x, name="elu")
+
+
+def selu(x,
+         scale=1.0507009873554804934193349852946,
+         alpha=1.6732632423543772848170429916717, name=None):
+    return apply(lambda a: scale * jnp.where(a > 0, a,
+                                             alpha * jnp.expm1(a)),
+                 x, name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha), x, name="celu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _prelu(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply(_prelu, x, weight, name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    if training:
+        import jax.random as jrandom
+
+        from ...core.random import next_key
+        def _rrelu(a):
+            slope = jrandom.uniform(next_key(), a.shape, jnp.float32,
+                                    lower, upper).astype(a.dtype)
+            return jnp.where(a >= 0, a, slope * a)
+        return apply(_rrelu, x, name="rrelu")
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x,
+                 name="hardshrink")
+
+
+def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
+    return apply(lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x,
+                 name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return apply(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x,
+                 name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), x, name="hardtanh")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        lambda a: jnp.where(a * beta > threshold, a,
+                            jnp.log1p(jnp.exp(jnp.minimum(
+                                beta * a, threshold))) / beta),
+        x, name="softplus")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a - threshold,
+                                     jnp.where(a < -threshold,
+                                               a + threshold, 0.0)),
+                 x, name="softshrink")
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, x, name="softsign")
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda a: a - jnp.tanh(a), x, name="tanhshrink")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a, value), x,
+                 name="thresholded_relu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _maxout(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = (a.shape[:ax] + (c // groups, groups) +
+                     a.shape[ax + 1:])
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply(_maxout, x, name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda a: jax.nn.glu(a, axis=axis), x, name="glu")
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU; fused kernel analogue of reference
+    `python/paddle/incubate/nn/functional/swiglu.py` — XLA fuses this chain
+    on TPU."""
+    if y is not None:
+        return apply(lambda a, b: jax.nn.silu(a) * b, x, y, name="swiglu")
+
+    def _swiglu(a):
+        u, v = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(u) * v
+    return apply(_swiglu, x, name="swiglu")
+
+
+def mish(x, name=None):
+    return apply(jax.nn.mish, x, name="mish")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core.random import next_key
+    key = next_key()
+
+    def _gumbel(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            # straight-through: one_hot(argmax) + y - stop_grad(y)
+            idx = jnp.argmax(y, axis=axis)
+            oh = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+            return oh + y - jax.lax.stop_gradient(y)
+        return y
+    return apply(_gumbel, x, name="gumbel_softmax")

@@ -1,0 +1,439 @@
+"""Common functionals: linear, embedding, dropout, normalization, attention.
+
+Parity targets: reference `python/paddle/nn/functional/common.py`,
+`input.py` (embedding), `norm.py`, and the fused attention surface
+(`scaled_dot_product_attention`, flash attention — here routed to the Pallas
+kernel on TPU, XLA fallback elsewhere).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply, unwrap
+from ...core.random import next_key
+from ...ops.math import mm_precision
+
+__all__ = [
+    "linear", "embedding", "dropout", "dropout2d", "dropout3d",
+    "alpha_dropout", "layer_norm", "rms_norm", "batch_norm", "group_norm",
+    "instance_norm", "local_response_norm", "normalize",
+    "scaled_dot_product_attention", "cosine_similarity", "pairwise_distance",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "unfold", "fold",
+    "interpolate", "upsample", "label_smooth", "bilinear",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W shaped [in, out] (paddle convention,
+    reference python/paddle/nn/functional/common.py linear)."""
+    if bias is None:
+        return apply(lambda a, w: jnp.matmul(
+            a, w, precision=mm_precision(a.dtype, w.dtype)), x, weight,
+            name="linear")
+    return apply(lambda a, w, b: jnp.matmul(
+        a, w, precision=mm_precision(a.dtype, w.dtype)) + b, x, weight,
+        bias, name="linear")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    idx = unwrap(x)
+
+    def _embedding(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply(_embedding, weight, name="embedding")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if mode == "upscale_in_train" else \
+            apply(lambda a: a * (1.0 - p), x, name="dropout_scale")
+    key = next_key()
+
+    def _dropout(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1
+                     for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply(_dropout, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    alpha_p = -alpha * scale
+
+    def _alpha_dropout(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+    return apply(_alpha_dropout, x, name="alpha_dropout")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(tuple(normalized_shape))
+
+    def _ln(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        # fp32 statistics regardless of input dtype (matches the reference's
+        # fused_layernorm which accumulates in fp32)
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(a.dtype)
+    args = [t for t in (weight, bias) if t is not None]
+    return apply(_ln, x, *args, name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference: `python/paddle/incubate/nn/functional/
+    fused_rms_norm.py`); fp32 accumulate, optionally Pallas-fused."""
+    def _rms(a, *w):
+        af = a.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(af), axis=-1, keepdims=True)
+        out = af * jax.lax.rsqrt(ms + epsilon)
+        if w:
+            out = out * w[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+    args = [weight] if weight is not None else []
+    return apply(_rms, x, *args, name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    use_batch_stats = training and not use_global_stats
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    if use_batch_stats:
+        # update running stats in place (paddle semantics); detached from
+        # the tape — the normalization below recomputes stats inside the
+        # recorded op so gradients flow through mean/var.
+        xf = unwrap(x).astype(jnp.float32)
+        batch_mean = jnp.mean(xf, axis=reduce_axes)
+        batch_var = jnp.var(xf, axis=reduce_axes)
+        running_mean._rebind(
+            (momentum * running_mean._data +
+             (1 - momentum) * batch_mean.astype(running_mean.dtype)))
+        running_var._rebind(
+            (momentum * running_var._data +
+             (1 - momentum) * batch_var.astype(running_var.dtype)))
+        frozen_mean = frozen_var = None
+    else:
+        frozen_mean = unwrap(running_mean).astype(jnp.float32)
+        frozen_var = unwrap(running_var).astype(jnp.float32)
+
+    def _bn(a, *wb):
+        af = a.astype(jnp.float32)
+        if use_batch_stats:
+            mean_arr = jnp.mean(af, axis=reduce_axes)
+            var_arr = jnp.var(af, axis=reduce_axes)
+        else:
+            mean_arr, var_arr = frozen_mean, frozen_var
+        out = (af - mean_arr.reshape(shape)) * \
+            jax.lax.rsqrt(var_arr.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+    args = [t for t in (weight, bias) if t is not None]
+    return apply(_bn, x, *args, name="batch_norm")
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+
+    def _gn(a, *wb):
+        af = a.astype(jnp.float32)
+        if ch_axis != 1:
+            af = jnp.moveaxis(af, ch_axis, 1)
+        n, c = af.shape[0], af.shape[1]
+        rest = af.shape[2:]
+        g = af.reshape((n, num_groups, c // num_groups) + rest)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(af.shape)
+        shape = [1] * out.ndim
+        shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        if ch_axis != 1:
+            out = jnp.moveaxis(out, 1, ch_axis)
+        return out.astype(a.dtype)
+    args = [t for t in (weight, bias) if t is not None]
+    return apply(_gn, x, *args, name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def _in(a, *wb):
+        af = a.astype(jnp.float32)
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+    args = [t for t in (weight, bias) if t is not None]
+    return apply(_in, x, *args, name="instance_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def _lrn(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        moved = jnp.moveaxis(sq, ch_axis, -1)
+        pad = [(0, 0)] * (moved.ndim - 1) + [(size // 2, (size - 1) // 2)]
+        padded = jnp.pad(moved, pad)
+        win = jnp.stack([padded[..., i:i + moved.shape[-1]]
+                         for i in range(size)], axis=-1).sum(-1)
+        win = jnp.moveaxis(win, -1, ch_axis)
+        return a / jnp.power(k + alpha * win, beta)
+    return apply(_lrn, x, name="local_response_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _normalize(a):
+        n = jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True)
+        return a / jnp.maximum(n, epsilon)
+    return apply(_normalize, x, name="normalize")
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """SDPA on [batch, seq, heads, head_dim] (paddle layout; reference
+    `python/paddle/nn/functional/flash_attention.py`). Routes to the Pallas
+    flash kernel on TPU when shapes allow; XLA path otherwise."""
+    from ...kernels import flash_attention as fa
+    return fa.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def _cos(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return apply(_cos, x1, x2, name="cosine_similarity")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def _pd(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+    return apply(_pd, x, y, name="pairwise_distance")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def _ps(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c // (r * r), r, r, h, w)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, r, r, c // (r * r))
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h * r, w * r, c // (r * r))
+    return apply(_ps, x, name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def _pu(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c, h // r, r, w // r, r)
+            out = out.transpose(0, 1, 3, 5, 2, 4)
+            return out.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h // r, r, w // r, r, c)
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h // r, w // r, c * r * r)
+    return apply(_pu, x, name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def _cs(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, groups, c // groups, h, w)
+            return out.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, groups, c // groups)
+        return out.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply(_cs, x, name="channel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _pair(paddings) if not (isinstance(paddings, (list, tuple)) and
+                                 len(paddings) == 4) else paddings
+    dl = _pair(dilations)
+
+    def _unfold(a):
+        n, c, h, w = a.shape
+        if len(pd) == 2:
+            pads = (pd[0], pd[0], pd[1], pd[1])
+        else:
+            pads = tuple(pd)
+        ap = jnp.pad(a, ((0, 0), (0, 0), (pads[0], pads[1]),
+                         (pads[2], pads[3])))
+        oh = (ap.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (ap.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = ap[:, :, i * dl[0]:i * dl[0] + oh * st[0]:st[0],
+                        j * dl[1]:j * dl[1] + ow * st[1]:st[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return apply(_unfold, x, name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    os_ = _pair(output_sizes)
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _pair(paddings)
+    dl = _pair(dilations)
+
+    def _fold(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        oh = (os_[0] + 2 * pd[0] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (os_[1] + 2 * pd[1] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        a2 = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]),
+                        a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0]:i * dl[0] + oh * st[0]:st[0],
+                             j * dl[1]:j * dl[1] + ow * st[1]:st[1]].add(
+                    a2[:, :, i, j])
+        return out[:, :, pd[0]:os_[0] + pd[0], pd[1]:os_[1] + pd[1]]
+    return apply(_fold, x, name="fold")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    def _interp(a):
+        nchw = data_format.startswith("NC")
+        spatial = a.shape[2:] if nchw else a.shape[1:-1]
+        if size is not None:
+            out_spatial = tuple(int(unwrap(s)) for s in (
+                size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(spatial)
+            out_spatial = tuple(int(s * f) for s, f in zip(spatial, sf))
+        if nchw:
+            target = a.shape[:2] + out_spatial
+        else:
+            target = (a.shape[0],) + out_spatial + (a.shape[-1],)
+        jmode = {"nearest": "nearest", "bilinear": "linear",
+                 "trilinear": "linear", "linear": "linear",
+                 "bicubic": "cubic", "area": "linear"}[mode]
+        return jax.image.resize(a, target, method=jmode).astype(a.dtype)
+    return apply(_interp, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    pd_arr = unwrap(prior_dist)
+
+    def _ls(l):
+        k = l.shape[-1]
+        if pd_arr is not None:
+            return (1 - epsilon) * l + epsilon * pd_arr
+        return (1 - epsilon) * l + epsilon / k
+    return apply(_ls, label, name="label_smooth")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _bilinear(a, b, w, *bias_arg):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b,
+                         precision=mm_precision(a.dtype))
+        if bias_arg:
+            out = out + bias_arg[0]
+        return out
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return apply(_bilinear, *args, name="bilinear")
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))

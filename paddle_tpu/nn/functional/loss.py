@@ -1,0 +1,358 @@
+"""Loss functionals (parity: reference `python/paddle/nn/functional/loss.py`).
+cross_entropy follows paddle's signature: logits + integer labels (or soft
+labels), ignore_index, reduction, label smoothing via label_smooth().
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply, unwrap
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "smooth_l1_loss", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
+    "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
+    "ctc_loss", "sigmoid_focal_loss", "square_error_cost", "log_loss",
+    "poisson_nll_loss", "gaussian_nll_loss", "multi_label_soft_margin_loss",
+    "soft_margin_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    if reduction == "none":
+        return out
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    lbl = unwrap(label)
+    w_arr = unwrap(weight)
+
+    def _ce(logits, *maybe_soft):
+        lf = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lf, axis=axis) if use_softmax else \
+            jnp.log(jnp.maximum(lf, 1e-30))
+        if soft_label or maybe_soft:
+            soft = maybe_soft[0].astype(jnp.float32) if maybe_soft else \
+                lbl.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                k = logits.shape[axis]
+                soft = (1 - label_smoothing) * soft + label_smoothing / k
+            loss = -jnp.sum(soft * logp, axis=axis)
+            if w_arr is not None:
+                cls_w = jnp.sum(soft * w_arr, axis=axis)
+                loss = loss * cls_w
+            return _reduce(loss, reduction)
+        # hard labels
+        li = lbl
+        if li.ndim == logp.ndim:  # trailing 1 dim paddle-style
+            li = jnp.squeeze(li, axis=axis)
+        k = logits.shape[axis]
+        valid = li != ignore_index
+        safe = jnp.where(valid, li, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis)
+        nll = -jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0.0:
+            smooth_term = -jnp.mean(logp, axis=axis)
+            nll = (1 - label_smoothing) * nll + label_smoothing * smooth_term
+        if w_arr is not None:
+            sample_w = jnp.where(valid, w_arr[safe], 0.0)
+            nll = nll * sample_w
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(sample_w), 1e-12)
+                return jnp.sum(jnp.where(valid, nll, 0.0)) / denom
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(nll) / denom
+        return _reduce(nll, reduction)
+
+    if soft_label and hasattr(label, "_data"):
+        return apply(_ce, input, label, name="cross_entropy")
+    return apply(_ce, input, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as softmax_fn
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    return _nll(input, label, weight, ignore_index, reduction)
+
+
+def _nll(input, label, weight, ignore_index, reduction):
+    lbl = unwrap(label)
+    w_arr = unwrap(weight)
+
+    def _loss(logp):
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, 1), axis=1)
+        nll = -jnp.squeeze(picked, axis=1)
+        if w_arr is not None:
+            sw = jnp.where(valid, w_arr[safe], 0.0)
+            nll = nll * sw
+            if reduction == "mean":
+                return jnp.sum(jnp.where(valid, nll, 0.0)) / \
+                    jnp.maximum(jnp.sum(sw), 1e-12)
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(nll, reduction)
+    return apply(_loss, input, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 input, label, name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 input, label, name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _sl1(a, b):
+        d = a - b
+        abs_d = jnp.abs(d)
+        loss = jnp.where(abs_d < delta, 0.5 * d * d / delta,
+                         abs_d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply(_sl1, input, label, name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    w_arr = unwrap(weight)
+
+    def _bce(p, t):
+        pf = jnp.clip(p.astype(jnp.float32), 1e-12, 1.0 - 1e-7)
+        loss = -(t * jnp.log(pf) + (1 - t) * jnp.log1p(-pf))
+        if w_arr is not None:
+            loss = loss * w_arr
+        return _reduce(loss, reduction)
+    return apply(_bce, input, label, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    w_arr = unwrap(weight)
+    pw = unwrap(pos_weight)
+
+    def _bce(z, t):
+        zf = z.astype(jnp.float32)
+        tf = t.astype(jnp.float32)
+        # stable: max(z,0) - z*t + log(1+exp(-|z|)), with pos_weight applied
+        # to the positive term
+        log_sig = jax.nn.log_sigmoid(zf)
+        log_sig_neg = jax.nn.log_sigmoid(-zf)
+        if pw is not None:
+            loss = -(pw * tf * log_sig + (1 - tf) * log_sig_neg)
+        else:
+            loss = -(tf * log_sig + (1 - tf) * log_sig_neg)
+        if w_arr is not None:
+            loss = loss * w_arr
+        return _reduce(loss, reduction)
+    return apply(_bce, logit, label, name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def _kl(logp, t):
+        tf = t.astype(jnp.float32)
+        if log_target:
+            loss = jnp.exp(tf) * (tf - logp)
+        else:
+            loss = tf * (jnp.log(jnp.maximum(tf, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply(_kl, input, label, name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply(lambda a, b, t: _reduce(
+        jnp.maximum(0.0, -t * (a - b) + margin), reduction),
+        input, other, label, name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    return apply(lambda a, t: _reduce(
+        jnp.where(t == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        input, label, name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def _cel(a, b, t):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(t == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply(_cel, input1, input2, label, name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def _tml(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return apply(_tml, input, positive, negative, name="triplet_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC (reference: `paddle/phi/kernels/impl/warpctc_kernel_impl.h` via
+    warpctc; here a pure-XLA forward-algorithm implementation).
+    log_probs: [T, B, C] logits (paddle convention), labels: [B, L] padded.
+    """
+    lbl = unwrap(labels)
+    in_len = unwrap(input_lengths)
+    lb_len = unwrap(label_lengths)
+
+    def _ctc(logits):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        L = lbl.shape[1]
+        S = 2 * L + 1
+        # extended label sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, dtype=lbl.dtype)
+        ext = ext.at[:, 1::2].set(lbl)
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+
+        ext_lp = jnp.take_along_axis(
+            jnp.transpose(lp, (1, 0, 2)),  # [B, T, C]
+            ext[:, None, :].astype(jnp.int32), axis=2)  # [B, T, S]
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.zeros((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(ext_lp[:, 0, 0])
+        alpha0 = alpha0.at[:, 1].set(ext_lp[:, 0, 1])
+
+        def step(alpha, t):
+            a_prev1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_prev2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_prev2 = jnp.where(same_as_prev2, neg_inf, a_prev2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_prev1), a_prev2)
+            new_alpha = merged + ext_lp[:, t, :]
+            # freeze past input length
+            new_alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        s_last = 2 * lb_len  # final blank index
+        final_blank = jnp.take_along_axis(alpha, s_last[:, None],
+                                          axis=1)[:, 0]
+        final_label = jnp.take_along_axis(
+            alpha, jnp.maximum(s_last - 1, 0)[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(final_blank, final_label)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lb_len, 1))
+        return _reduce(loss, reduction)
+    return apply(_ctc, log_probs, name="ctc_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    norm = unwrap(normalizer)
+
+    def _focal(z, t):
+        zf = z.astype(jnp.float32)
+        p = jax.nn.sigmoid(zf)
+        ce = -(t * jax.nn.log_sigmoid(zf) + (1 - t) * jax.nn.log_sigmoid(-zf))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if norm is not None:
+            loss = loss / norm
+        return _reduce(loss, reduction)
+    return apply(_focal, logit, label, name="sigmoid_focal_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), input, label,
+                 name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(lambda p, t: -(t * jnp.log(p + epsilon) +
+                                (1 - t) * jnp.log(1 - p + epsilon)),
+                 input, label, name="log_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def _pnll(x, t):
+        if log_input:
+            loss = jnp.exp(x) - t * x
+        else:
+            loss = x - t * jnp.log(x + epsilon)
+        if full:
+            stirling = t * jnp.log(t + epsilon) - t + \
+                0.5 * jnp.log(2 * jnp.pi * (t + epsilon))
+            loss = loss + jnp.where(t > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply(_pnll, input, label, name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def _gnll(mu, t, var):
+        v = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(v) + jnp.square(mu - t) / v)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi))
+        return _reduce(loss, reduction)
+    return apply(_gnll, input, label, variance, name="gaussian_nll_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    w_arr = unwrap(weight)
+
+    def _ml(z, t):
+        loss = -(t * jax.nn.log_sigmoid(z) +
+                 (1 - t) * jax.nn.log_sigmoid(-z))
+        if w_arr is not None:
+            loss = loss * w_arr
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+    return apply(_ml, input, label, name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply(lambda z, t: _reduce(jnp.log1p(jnp.exp(-t * z)), reduction),
+                 input, label, name="soft_margin_loss")

@@ -1,0 +1,231 @@
+"""Op library: the `paddle.*` tensor-op surface.
+
+Parity target: reference `python/paddle/tensor/` (~700 wrappers over
+`_C_ops`). Here every op is a thin jnp/lax closure dispatched through
+`core.dispatch.apply`, which handles autograd recording; there is no
+per-op kernel registry because XLA performs backend kernel selection.
+
+`bind_tensor_methods` attaches the method/dunder surface to Tensor —
+the analogue of the generated `paddle/fluid/pybind/eager_method.cc`.
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .einsum import *  # noqa: F401,F403
+
+from . import creation, math, reduction, manipulation, logic, search
+from . import random, linalg, einsum as einsum_mod
+
+
+def _inplace_from(t: Tensor, out: Tensor) -> Tensor:
+    """Give ``t`` the value (and tape position) of ``out`` — the functional
+    realization of the reference's inplace ops (`x.add_(y)` etc.)."""
+    if t.is_leaf and not t.stop_gradient and t._node is None and \
+            out._node is not None:
+        raise RuntimeError(
+            "in-place operation on a leaf tensor that requires grad")
+    t._data = out._data
+    t._node = out._node
+    t._out_idx = out._out_idx
+    t.stop_gradient = out.stop_gradient and t.stop_gradient
+    return t
+
+
+def _getitem(self, idx):
+    idx_u = _unwrap_index(idx)
+    return apply(lambda a: a[idx_u], self, name="getitem")
+
+
+def _setitem(self, idx, value):
+    idx_u = _unwrap_index(idx)
+    if isinstance(value, Tensor):
+        out = apply(lambda a, v: a.at[idx_u].set(v.astype(a.dtype)), self,
+                    value, name="setitem")
+    else:
+        out = apply(lambda a: a.at[idx_u].set(value), self, name="setitem")
+    _inplace_from(self, out)
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    if isinstance(idx, builtins.slice):
+        return builtins.slice(unwrap(idx.start), unwrap(idx.stop),
+                              unwrap(idx.step))
+    return idx
+
+
+_BINARY_DUNDERS = {
+    "__add__": add, "__sub__": subtract, "__mul__": multiply,
+    "__truediv__": divide, "__floordiv__": floor_divide, "__mod__": mod,
+    "__pow__": math.pow, "__matmul__": matmul,
+    "__eq__": equal, "__ne__": not_equal, "__lt__": less_than,
+    "__le__": less_equal, "__gt__": greater_than, "__ge__": greater_equal,
+    "__and__": bitwise_and, "__or__": bitwise_or, "__xor__": bitwise_xor,
+    "__lshift__": bitwise_left_shift, "__rshift__": bitwise_right_shift,
+}
+
+_REFLECTED = {
+    "__radd__": add, "__rmul__": multiply,
+    "__rsub__": lambda x, y: subtract(y, x),
+    "__rtruediv__": lambda x, y: divide(y, x),
+    "__rfloordiv__": lambda x, y: floor_divide(y, x),
+    "__rmod__": lambda x, y: mod(y, x),
+    "__rpow__": lambda x, y: math.pow(y, x),
+    "__rmatmul__": lambda x, y: matmul(y, x),
+}
+
+_METHODS = {
+    # math
+    "add": add, "subtract": subtract, "multiply": multiply, "divide": divide,
+    "floor_divide": floor_divide, "mod": mod, "remainder": mod,
+    "pow": math.pow, "matmul": matmul, "sqrt": sqrt, "rsqrt": rsqrt,
+    "exp": exp, "expm1": expm1, "log": log, "log2": log2, "log10": log10,
+    "log1p": log1p, "abs": math.abs, "neg": neg, "sign": sign,
+    "floor": floor, "ceil": ceil, "round": math.round, "trunc": trunc,
+    "frac": frac, "sin": sin, "cos": cos, "tan": tan, "asin": asin,
+    "acos": acos, "atan": atan, "atan2": atan2, "sinh": sinh, "cosh": cosh,
+    "tanh": tanh, "asinh": asinh, "acosh": acosh, "atanh": atanh,
+    "reciprocal": reciprocal, "square": square, "maximum": maximum,
+    "minimum": minimum, "fmax": fmax, "fmin": fmin, "clip": clip,
+    "scale": scale, "lerp": lerp, "erf": erf, "erfinv": erfinv,
+    "isnan": isnan, "isinf": isinf, "isfinite": isfinite,
+    "nan_to_num": nan_to_num, "cumsum": cumsum, "cumprod": cumprod,
+    "logsumexp": logsumexp, "logcumsumexp": logcumsumexp, "logit": logit,
+    "digamma": digamma, "lgamma": lgamma, "sigmoid": sigmoid,
+    "heaviside": heaviside, "hypot": hypot, "diff": diff, "sgn": sgn,
+    "inner": inner, "outer": outer, "kron": kron, "conj": conj,
+    "deg2rad": deg2rad, "rad2deg": rad2deg, "angle": angle,
+    "cummax": cummax, "cummin": cummin, "gcd": gcd, "lcm": lcm,
+    # reduction
+    "sum": reduction.sum, "mean": mean, "max": reduction.max,
+    "min": reduction.min, "amax": amax, "amin": amin, "prod": prod,
+    "all": reduction.all, "any": reduction.any,
+    "count_nonzero": count_nonzero, "median": median, "nanmedian": nanmedian,
+    "nansum": nansum, "nanmean": nanmean, "var": var, "std": std,
+    "quantile": quantile, "nanquantile": nanquantile,
+    # manipulation
+    "reshape": reshape, "transpose": manipulation.transpose, "cast": cast,
+    "astype": cast, "split": split, "chunk": chunk, "squeeze": squeeze,
+    "unsqueeze": unsqueeze, "flatten": manipulation.flatten, "tile": tile,
+    "expand": expand, "expand_as": expand_as, "broadcast_to": broadcast_to,
+    "flip": flip, "rot90": rot90, "roll": roll, "gather": gather,
+    "gather_nd": gather_nd, "scatter": scatter,
+    "scatter_nd_add": scatter_nd_add, "index_select": index_select,
+    "index_add": index_add, "index_put": index_put,
+    "masked_select": manipulation.masked_select, "masked_fill": masked_fill,
+    "where": manipulation.where, "pad": pad, "unbind": unbind,
+    "unstack": unstack, "repeat_interleave": repeat_interleave,
+    "take_along_axis": take_along_axis, "put_along_axis": put_along_axis,
+    "moveaxis": moveaxis, "swapaxes": swapaxes, "tensordot": tensordot,
+    "unflatten": unflatten, "view": view, "view_as": view_as,
+    "diagonal": diagonal, "diag_embed": diag_embed, "numel_t": numel,
+    "tensor_split": tensor_split, "as_real": as_real, "as_complex": as_complex,
+    # logic
+    "equal": equal, "not_equal": not_equal, "less_than": less_than,
+    "less_equal": less_equal, "greater_than": greater_than,
+    "greater_equal": greater_equal, "logical_and": logical_and,
+    "logical_or": logical_or, "logical_not": logical_not,
+    "logical_xor": logical_xor, "bitwise_and": bitwise_and,
+    "bitwise_or": bitwise_or, "bitwise_not": bitwise_not,
+    "bitwise_xor": bitwise_xor, "allclose": allclose, "isclose": isclose,
+    "equal_all": equal_all,
+    # search
+    "argmax": argmax, "argmin": argmin, "argsort": argsort, "sort": sort,
+    "topk": topk, "nonzero": nonzero, "kthvalue": kthvalue, "mode": mode,
+    "index_sample": index_sample, "bucketize": bucketize, "unique": unique,
+    "unique_consecutive": unique_consecutive,
+    # linalg
+    "dot": dot, "bmm": bmm, "mm": mm, "mv": mv, "norm": linalg.norm,
+    "dist": dist, "cross": cross, "cholesky": cholesky, "qr": qr,
+    "svd": svd, "inv": inv, "pinv": pinv, "solve": solve,
+    "matrix_power": matrix_power, "det": det, "slogdet": slogdet,
+    "trace": linalg.trace, "eigvals": eigvals, "cov": cov,
+    "corrcoef": corrcoef, "histogram": histogram, "lu": lu,
+    # creation-ish
+    "clone": clone, "tril": tril, "triu": triu, "diag": diag,
+    "diagflat": diagflat,
+    # random inplace
+    "exponential_": random.exponential_, "uniform_": random.uniform_,
+    "normal_": random.normal_,
+}
+
+# ops whose first arg is the tensor and have natural inplace variants
+_INPLACE_BASES = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "sqrt", "rsqrt", "exp", "log", "abs", "neg",
+    "floor", "ceil", "round", "trunc", "sin", "cos", "tan", "tanh",
+    "sigmoid", "reciprocal", "square", "clip", "scale", "lerp", "erf",
+    "erfinv", "nan_to_num", "logit", "cumsum", "cast", "reshape",
+    "squeeze", "unsqueeze", "flatten", "flip", "scatter", "masked_fill",
+    "index_put", "put_along_axis", "tril", "triu", "digamma", "lgamma",
+    "frac", "asin", "acos", "atan", "sinh", "cosh", "asinh", "acosh",
+    "atanh", "expm1", "log2", "log10", "log1p", "sign",
+]
+
+
+def _make_method(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+    method.__name__ = fn.__name__
+    method.__doc__ = fn.__doc__
+    return method
+
+
+def _make_inplace(fn):
+    def method(self, *args, **kwargs):
+        return _inplace_from(self, fn(self, *args, **kwargs))
+    method.__name__ = fn.__name__ + "_"
+    return method
+
+
+def bind_tensor_methods(cls=Tensor):
+    for dunder, fn in {**_BINARY_DUNDERS, **_REFLECTED}.items():
+        setattr(cls, dunder, _make_method(fn))
+    cls.__neg__ = _make_method(neg)
+    cls.__abs__ = _make_method(math.abs)
+    cls.__invert__ = _make_method(logical_not)
+    cls.__getitem__ = _getitem
+    cls.__setitem__ = _setitem
+    for name, fn in _METHODS.items():
+        if not hasattr(cls, name):
+            setattr(cls, name, _make_method(fn))
+    for base in _INPLACE_BASES:
+        fn = _METHODS.get(base)
+        if fn is not None and not hasattr(cls, base + "_"):
+            setattr(cls, base + "_", _make_inplace(fn))
+
+    def _t_property(self):
+        # numpy-style full reverse (paddle Tensor.T semantics)
+        return manipulation.transpose(self, list(range(self.ndim))[::-1])
+    cls.T = property(_t_property)
+
+    def _mT(self):
+        return swapaxes(self, -1, -2)
+    cls.mT = property(_mT)
+
+
+bind_tensor_methods()
+
+
+def inplace_from(t, out):
+    return _inplace_from(t, out)

@@ -1,0 +1,186 @@
+"""Tensor creation ops.
+
+Parity target: `python/paddle/tensor/creation.py` in the reference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, unwrap
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
+    "tril_indices", "triu_indices", "complex", "polar", "one_hot",
+]
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default
+    return convert_dtype(dtype)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype, place=place,
+                   stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None):
+    shape = _norm_shape(shape)
+    return Tensor(jnp.zeros(shape, _dt(dtype, get_default_dtype())))
+
+
+def ones(shape, dtype=None):
+    shape = _norm_shape(shape)
+    return Tensor(jnp.ones(shape, _dt(dtype, get_default_dtype())))
+
+
+def full(shape, fill_value, dtype=None):
+    shape = _norm_shape(shape)
+    fill_value = unwrap(fill_value)
+    if dtype is None:
+        arr = jnp.full(shape, fill_value)
+        if arr.dtype == jnp.float64:
+            arr = arr.astype(get_default_dtype())
+    else:
+        arr = jnp.full(shape, fill_value, convert_dtype(dtype))
+    return Tensor(arr)
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None):
+    return apply(jnp.zeros_like, x, dtype=_dt(dtype), name="zeros_like")
+
+
+def ones_like(x, dtype=None):
+    return apply(jnp.ones_like, x, dtype=_dt(dtype), name="ones_like")
+
+
+def full_like(x, fill_value, dtype=None):
+    return Tensor(jnp.full_like(unwrap(x), unwrap(fill_value),
+                                dtype=_dt(dtype)))
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) or (hasattr(v, "dtype") and
+               jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating))
+               for v in (start, end, step)):
+            dtype = get_default_dtype()
+        else:
+            dtype = jnp.int64
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               dtype=_dt(dtype, get_default_dtype())))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               base=base,
+                               dtype=_dt(dtype, get_default_dtype())))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor(jnp.eye(num_rows, num_columns,
+                          dtype=_dt(dtype, get_default_dtype())))
+
+
+def diag(x, offset=0, padding_value=0):
+    def _diag(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a, dtype=bool), k=offset)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return apply(_diag, x, name="diag")
+
+
+def diagflat(x, offset=0):
+    return apply(lambda a: jnp.diagflat(a, k=offset), x, name="diagflat")
+
+
+def tril(x, diagonal=0):
+    return apply(lambda a: jnp.tril(a, k=diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0):
+    return apply(lambda a: jnp.triu(a, k=diagonal), x, name="triu")
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def meshgrid(*args):
+    arrays = [unwrap(a) for a in (args[0] if len(args) == 1 and
+              isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    src = unwrap(x)
+    if output is None:
+        return apply(lambda a: a, x, name="assign") if isinstance(x, Tensor) \
+            else Tensor(jnp.asarray(src))
+    output.set_value(src)
+    return output
+
+
+def clone(x):
+    return apply(lambda a: a + jnp.zeros((), a.dtype), x, name="clone")
+
+
+def complex(real, imag):
+    return apply(jax.lax.complex, real, imag, name="complex")
+
+
+def polar(abs_, angle):
+    return apply(lambda a, t: a * jnp.exp(1j * t.astype(jnp.complex64)),
+                 abs_, angle, name="polar")
+
+
+def one_hot(x, num_classes):
+    return apply(
+        lambda a: jax.nn.one_hot(a, num_classes, dtype=get_default_dtype()),
+        x, name="one_hot")
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if not isinstance(s, int) else s for s in shape)

@@ -1,0 +1,21 @@
+"""einsum (parity: reference `python/paddle/tensor/einsum.py`, 1.3k lines of
+manual planning — on TPU we defer to jnp.einsum, which XLA lowers to fused
+MXU contractions)."""
+
+from __future__ import annotations
+
+from ..core.dispatch import apply
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    import jax.numpy as jnp
+
+    from .math import mm_precision
+
+    ops = operands[0] if len(operands) == 1 and isinstance(
+        operands[0], (list, tuple)) else operands
+    return apply(lambda *arrs: jnp.einsum(
+        equation, *arrs, precision=mm_precision(*[a.dtype for a in arrs])),
+        *ops, name="einsum")

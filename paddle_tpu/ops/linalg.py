@@ -1,0 +1,294 @@
+"""Linear algebra ops (parity: reference `python/paddle/tensor/linalg.py`).
+Decompositions lower to jax.numpy.linalg / lax.linalg (XLA custom calls on
+TPU)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from .math import matmul, mm_precision  # re-export home is linalg in paddle
+
+__all__ = [
+    "matmul", "dot", "bmm", "mm", "mv", "norm", "vector_norm", "matrix_norm",
+    "dist", "cross", "cholesky", "cholesky_solve", "qr", "svd", "svdvals",
+    "eig", "eigh", "eigvals", "eigvalsh", "inv", "pinv", "solve",
+    "triangular_solve", "lstsq", "matrix_power", "det", "slogdet",
+    "multi_dot", "matrix_rank", "cov", "corrcoef", "histogram",
+    "histogramdd", "lu", "lu_unpack", "trace", "cond",
+]
+
+
+def dot(x, y, name=None):
+    def _dot(a, b):
+        if a.ndim == 1:
+            return jnp.dot(a, b)
+        return jnp.sum(a * b, axis=-1)
+    return apply(_dot, x, y, name="dot")
+
+
+def bmm(x, y, name=None):
+    return apply(lambda a, b: jnp.matmul(
+        a, b, precision=mm_precision(a.dtype, b.dtype)), x, y, name="bmm")
+
+
+def mm(x, y, name=None):
+    return bmm(x, y)
+
+
+def mv(x, y, name=None):
+    return bmm(x, y)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def _norm(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis),
+                                   keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=_ax(axis),
+                                   keepdims=keepdim)
+        if p == float("inf") or p == float("-inf") or isinstance(p, (int,
+                                                                     float)):
+            if axis is None:
+                flat = a.reshape(-1)
+                return jnp.linalg.norm(flat, ord=p, keepdims=False)
+            return jnp.linalg.norm(a, ord=p, axis=_ax(axis),
+                                   keepdims=keepdim)
+        raise ValueError(f"unsupported norm order {p}")
+    return apply(_norm, x, name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def _vn(a):
+        return jnp.linalg.vector_norm(a, ord=p, axis=_ax(axis),
+                                      keepdims=keepdim)
+    return apply(_vn, x, name="vector_norm")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def _mn(a):
+        return jnp.linalg.matrix_norm(a, ord=p, keepdims=keepdim)
+    return apply(_mn, x, name="matrix_norm")
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def dist(x, y, p=2, name=None):
+    return apply(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p),
+                 x, y, name="dist")
+
+
+def cross(x, y, axis=9, name=None):
+    def _cross(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis of size 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply(_cross, x, y, name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    def _chol(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply(_chol, x, name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def _chs(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+    return apply(_chs, x, y, name="cholesky_solve")
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        return apply(lambda a: jnp.linalg.qr(a, mode="r"), x, name="qr")
+    out = apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, name="qr")
+    return out[0], out[1]
+
+
+def svd(x, full_matrices=False, name=None):
+    out = apply(lambda a: tuple(jnp.linalg.svd(
+        a, full_matrices=full_matrices)), x, name="svd")
+    return out[0], out[1], out[2]
+
+
+def svdvals(x, name=None):
+    return apply(lambda a: jnp.linalg.svd(a, compute_uv=False), x,
+                 name="svdvals")
+
+
+def eig(x, name=None):
+    # jnp.linalg.eig is CPU-only; run on host (reference uses LAPACK too).
+    import numpy as np
+    a = np.asarray(unwrap(x))
+    w, v = np.linalg.eig(a)
+    from ..core.tensor import Tensor
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    a = np.asarray(unwrap(x))
+    from ..core.tensor import Tensor
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigh(x, UPLO="L", name=None):
+    out = apply(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x,
+                name="eigh")
+    return out[0], out[1]
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x,
+                 name="eigvalsh")
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x, name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                           hermitian=hermitian),
+                 x, name="pinv")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def _ts(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(_ts, x, y, name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def _lstsq(a, b):
+        sol, res, rank_, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank_.astype(jnp.int64), sv
+    out = apply(_lstsq, x, y, name="lstsq")
+    return tuple(out)
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), x,
+                 name="matrix_power")
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, name="det")
+
+
+def slogdet(x, name=None):
+    out = apply(lambda a: tuple(jnp.linalg.slogdet(a)), x, name="slogdet")
+    return out[0], out[1]
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *arrs: jnp.linalg.multi_dot(arrs), *list(x),
+                 name="multi_dot")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.matrix_rank(a, rtol=tol)
+                 .astype(jnp.int64), x, name="matrix_rank")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = unwrap(fweights)
+    aw = unwrap(aweights)
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar,
+                                   ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), x, name="cov")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x,
+                 name="corrcoef")
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    a = unwrap(input)
+    w = unwrap(weight)
+    lo, hi = float(unwrap(min)), float(unwrap(max))
+    if lo == 0 and hi == 0:
+        lo, hi = float(jnp.min(a)), float(jnp.max(a))
+        if lo == hi:
+            lo, hi = lo - 0.5, hi + 0.5
+    hist, _ = jnp.histogram(a.reshape(-1), bins=bins, range=(lo, hi),
+                            weights=w, density=density)
+    from ..core.tensor import Tensor
+    return Tensor(hist if density or w is not None else
+                  hist.astype(jnp.int64))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    import numpy as np
+    a = np.asarray(unwrap(x))
+    w = np.asarray(unwrap(weights)) if weights is not None else None
+    hist, edges = np.histogramdd(a, bins=bins, range=ranges,
+                                 density=density, weights=w)
+    from ..core.tensor import Tensor
+    return (Tensor(jnp.asarray(hist)),
+            [Tensor(jnp.asarray(e)) for e in edges])
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def _lu(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, (piv + 1).astype(jnp.int32)
+    out = apply(_lu, x, name="lu")
+    from ..core.tensor import Tensor
+    if get_infos:
+        info = Tensor(jnp.zeros((), jnp.int32))
+        return out[0], out[1], info
+    return out[0], out[1]
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    def _unpack(lu_mat):
+        m, n = lu_mat.shape[-2:]
+        k = min(m, n)
+        L = jnp.tril(lu_mat[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_mat.dtype)
+        U = jnp.triu(lu_mat[..., :k, :])
+        return L, U
+    piv = unwrap(lu_pivots)
+    out = apply(_unpack, lu_data, name="lu_unpack")
+    import numpy as np
+    p = np.asarray(piv) - 1
+    m = unwrap(lu_data).shape[-2]
+    perm = np.arange(m)
+    for i, pv in enumerate(p.reshape(-1)):
+        perm[[i, pv]] = perm[[pv, i]]
+    P = np.zeros((m, m), dtype=np.float32)
+    P[perm, np.arange(m)] = 1.0
+    from ..core.tensor import Tensor
+    return Tensor(jnp.asarray(P)), out[0], out[1]
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                     axis2=axis2), x, name="trace")
+
+
+def cond(x, p=None, name=None):
+    return apply(lambda a: jnp.linalg.cond(a, p=p), x, name="cond")

@@ -1,0 +1,73 @@
+"""Comparison / logical / bitwise ops (parity: reference
+`python/paddle/tensor/logic.py`)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_not",
+    "logical_xor", "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+    "bitwise_left_shift", "bitwise_right_shift", "allclose", "isclose",
+    "equal_all", "is_empty",
+]
+
+
+def _cmp(fn, name):
+    def op(x, y, name_=None):
+        return apply(fn, x, y, name=name)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+bitwise_left_shift = _cmp(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = _cmp(jnp.right_shift, "bitwise_right_shift")
+
+
+def logical_not(x, name=None):
+    return apply(jnp.logical_not, x, name="logical_not")
+
+
+def bitwise_not(x, name=None):
+    return apply(jnp.bitwise_not, x, name="bitwise_not")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan),
+                 x, y, name="allclose")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan),
+                 x, y, name="isclose")
+
+
+def equal_all(x, y, name=None):
+    def _equal_all(a, b):
+        if a.shape != b.shape:
+            return jnp.asarray(False)
+        return jnp.all(a == b)
+    return apply(_equal_all, x, y, name="equal_all")
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) == 0))

@@ -1,0 +1,559 @@
+"""Shape/layout manipulation ops.
+
+Parity target: reference `python/paddle/tensor/manipulation.py` plus the
+strided-view kernels (`paddle/phi/kernels/stride/`). On TPU there are no
+strided views — XLA owns layout — so view-like ops are functional; the
+`_inplace_from` rebinding in `ops/__init__.py` provides the in-place API
+surface.
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, unwrap
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "reshape", "transpose", "cast", "concat", "stack", "split", "chunk",
+    "squeeze", "unsqueeze", "flatten", "tile", "expand", "expand_as",
+    "broadcast_to", "broadcast_tensors", "flip", "rot90", "roll", "gather",
+    "gather_nd", "scatter", "scatter_nd_add", "index_select", "index_add",
+    "index_put", "masked_select", "masked_fill", "where", "slice",
+    "strided_slice", "pad", "unbind", "unstack", "repeat_interleave",
+    "take_along_axis", "put_along_axis", "moveaxis", "swapaxes", "as_real",
+    "as_complex", "tensordot", "atleast_1d", "atleast_2d", "atleast_3d",
+    "unflatten", "view", "view_as", "diagonal", "diag_embed", "crop",
+    "shard_index", "tensor_split", "hsplit", "vsplit", "dsplit", "hstack",
+    "vstack", "dstack", "column_stack", "row_stack", "numel", "rank",
+    "shape", "t",
+]
+
+
+def reshape(x, shape, name=None):
+    shape = _shape_arg(shape)
+    return apply(lambda a: jnp.reshape(a, shape), x, name="reshape")
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm=None, name=None):
+    perm = _shape_arg(perm) if perm is not None else None
+    return apply(lambda a: jnp.transpose(a, perm), x, name="transpose")
+
+
+def t(x, name=None):
+    def _t(a):
+        if a.ndim < 2:
+            return a
+        return a.T
+    return apply(_t, x, name="t")
+
+
+def cast(x, dtype):
+    dt = convert_dtype(dtype)
+    return apply(lambda a: a.astype(dt), x, name="cast")
+
+
+def concat(x, axis=0, name=None):
+    axis = int(unwrap(axis))
+    tensors = list(x)
+    return apply(lambda *arrs: jnp.concatenate(arrs, axis=axis), *tensors,
+                 name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply(lambda *arrs: jnp.stack(arrs, axis=axis), *tensors,
+                 name="stack")
+
+
+def hstack(x, name=None):
+    return apply(lambda *arrs: jnp.hstack(arrs), *list(x), name="hstack")
+
+
+def vstack(x, name=None):
+    return apply(lambda *arrs: jnp.vstack(arrs), *list(x), name="vstack")
+
+
+def dstack(x, name=None):
+    return apply(lambda *arrs: jnp.dstack(arrs), *list(x), name="dstack")
+
+
+def column_stack(x, name=None):
+    return apply(lambda *arrs: jnp.column_stack(arrs), *list(x),
+                 name="column_stack")
+
+
+row_stack = vstack
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis))
+    dim = x.shape[axis] if isinstance(x, Tensor) else x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: axis {axis} length {dim} is not divisible by "
+                f"{num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(unwrap(s)) for s in num_or_sections]
+        if -1 in sizes:
+            known = builtins_sum(s for s in sizes if s != -1)
+            sizes[sizes.index(-1)] = dim - known
+    offsets = np.cumsum([0] + sizes[:-1])
+
+    def _split(a):
+        return tuple(
+            jax.lax.slice_in_dim(a, int(o), int(o) + s, axis=axis)
+            for o, s in zip(offsets, sizes))
+    return apply(_split, x, name="split")
+
+
+def builtins_sum(it):
+    total = 0
+    for v in it:
+        total += v
+    return total
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    axis = int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, rem = divmod(dim, n)
+        sizes = [base + (1 if i < rem else 0) for i in range(n)]
+        return split(x, sizes, axis)
+    indices = [0] + [int(unwrap(i)) for i in num_or_indices] + [dim]
+    sizes = [b - a for a, b in zip(indices[:-1], indices[1:])]
+    return split(x, sizes, axis)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is not None:
+        axis = _shape_arg(axis) if isinstance(axis, (list, tuple)) else \
+            (int(unwrap(axis)),)
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+    return apply(lambda a: jnp.squeeze(a, axis=axis), x, name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(unwrap(a)) for a in axis)
+    else:
+        axis = (int(unwrap(axis)),)
+    return apply(lambda a: jnp.expand_dims(a, axis=axis), x, name="unsqueeze")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+
+    def _flatten(a):
+        if a.ndim == 0:
+            return a.reshape(1)
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return a.reshape(new_shape)
+    return apply(_flatten, x, name="flatten")
+
+
+def unflatten(x, axis, shape, name=None):
+    axis = axis % x.ndim
+    shape = _shape_arg(shape)
+
+    def _unflatten(a):
+        new_shape = a.shape[:axis] + tuple(shape) + a.shape[axis + 1:]
+        return a.reshape(new_shape)
+    return apply(_unflatten, x, name="unflatten")
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_arg(repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), x, name="tile")
+
+
+def expand(x, shape, name=None):
+    shape = _shape_arg(shape)
+    def _expand(a):
+        target = list(shape)
+        # paddle semantics: -1 keeps the original dim
+        offset = len(target) - a.ndim
+        for i in range(len(target)):
+            if target[i] == -1:
+                target[i] = a.shape[i - offset] if i >= offset else 1
+        return jnp.broadcast_to(a, tuple(target))
+    return apply(_expand, x, name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    shape = _shape_arg(shape)
+    return apply(lambda a: jnp.broadcast_to(a, tuple(shape)), x,
+                 name="broadcast_to")
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = [unwrap(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [broadcast_to(t, shape) for t in inputs]
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    return apply(lambda a: jnp.flip(a, axis=axis), x, name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x,
+                 name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    shifts = _shape_arg(shifts) if isinstance(shifts, (list, tuple)) else \
+        int(unwrap(shifts))
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    return apply(lambda a: jnp.roll(a, shifts, axis=axis), x, name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(unwrap(axis))
+    idx = unwrap(index)
+    return apply(lambda a: jnp.take(a, idx.reshape(-1) if idx.ndim > 0
+                                    else idx, axis=axis), x, name="gather")
+
+
+def gather_nd(x, index, name=None):
+    idx = unwrap(index)
+
+    def _gather_nd(a):
+        k = idx.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[flat_idx] if k == a.ndim else a[flat_idx + (Ellipsis,)]
+    return apply(_gather_nd, x, name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = unwrap(index)
+
+    def _scatter(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        zeroed = a.at[idx].set(jnp.zeros_like(u))
+        return zeroed.at[idx].add(u)
+    return apply(_scatter, x, updates, name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = unwrap(index)
+
+    def _scatter_nd_add(a, u):
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[flat_idx].add(u)
+    return apply(_scatter_nd_add, x, updates, name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = unwrap(index)
+    return apply(lambda a: jnp.take(a, idx, axis=axis), x,
+                 name="index_select")
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = unwrap(index)
+    axis = axis % x.ndim
+
+    def _index_add(a, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        vmoved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[idx].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+    return apply(_index_add, x, value, name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(unwrap(i) for i in indices)
+
+    def _index_put(a, v):
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+    return apply(_index_put, x, value, name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output shape: eager-only (documented; same restriction the
+    # reference has under CINN/static shape inference).
+    a = unwrap(x)
+    m = np.asarray(unwrap(mask))
+    return Tensor(jnp.asarray(np.asarray(a)[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    m = unwrap(mask)
+    return apply(lambda a, v: jnp.where(m, v.astype(a.dtype) if
+                                        hasattr(v, "astype") else v, a),
+                 x, value if isinstance(value, Tensor) else unwrap(value),
+                 name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = unwrap(condition)
+    if x is None and y is None:
+        nz = np.nonzero(np.asarray(cond))
+        return Tensor(jnp.asarray(np.stack(nz, axis=-1).astype(np.int64)))
+    return apply(lambda a, b: jnp.where(cond, a, b), x, y, name="where")
+
+
+def slice(x, axes, starts, ends, name=None):
+    axes = [int(a) for a in axes]
+    starts = [int(unwrap(s)) for s in starts]
+    ends = [int(unwrap(e)) for e in ends]
+
+    def _slice(a):
+        out = a
+        for ax, s, e in zip(axes, starts, ends):
+            dim = out.shape[ax]
+            s_, e_ = _norm_range(s, e, dim)
+            out = jax.lax.slice_in_dim(out, s_, e_, axis=ax)
+        return out
+    return apply(_slice, x, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def _ss(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(int(unwrap(s)), int(unwrap(e)),
+                                     int(unwrap(st)))
+        return a[tuple(idx)]
+    return apply(_ss, x, name="strided_slice")
+
+
+def _norm_range(s, e, dim):
+    if s < 0:
+        s += dim
+    if e < 0:
+        e += dim
+    return max(0, min(s, dim)), max(0, min(e, dim))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad = [int(unwrap(p)) for p in pad] if not isinstance(pad, int) else \
+        [int(pad)] * (2 * x.ndim)
+
+    def _pad(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            # full-rank paddle order: (before_0, after_0, before_1, ...)
+            # paddle actually uses per-dim pairs in *reverse* only for the
+            # NCHW conv helper; plain paddle.nn.functional.pad with len==2*nd
+            # applies to all dims in order.
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial spec applies to trailing spatial dims (torch-style,
+            # which paddle follows for NCHW/NCL/NCDHW): last dim first.
+            n = len(pad) // 2
+            widths = [(0, 0)] * nd
+            for i in range(n):
+                dim = nd - 1 - i
+                widths[dim] = (pad[2 * i], pad[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode=jmode, constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return apply(_pad, x, name="pad")
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+
+    def _unbind(a):
+        return tuple(jnp.squeeze(s, axis=axis) for s in
+                     jnp.split(a, n, axis=axis))
+    return apply(_unbind, x, name="unbind")
+
+
+unstack = unbind
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    reps = unwrap(repeats)
+    return apply(lambda a: jnp.repeat(a, reps, axis=axis), x,
+                 name="repeat_interleave")
+
+
+def take_along_axis(x, indices, axis, broadcast=True, name=None):
+    idx = unwrap(indices)
+    return apply(lambda a: jnp.take_along_axis(a, idx, axis=axis), x,
+                 name="take_along_axis")
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None):
+    idx = unwrap(indices)
+
+    def _put(a, v):
+        v = jnp.broadcast_to(v, idx.shape) if jnp.ndim(v) else \
+            jnp.full(idx.shape, v, a.dtype)
+        dims = list(range(a.ndim))
+        dims.remove(axis % a.ndim)
+        full_idx = []
+        for d in range(a.ndim):
+            if d == axis % a.ndim:
+                full_idx.append(idx)
+            else:
+                shape = [1] * a.ndim
+                shape[d] = a.shape[d]
+                full_idx.append(jnp.broadcast_to(
+                    jnp.arange(a.shape[d]).reshape(shape), idx.shape))
+        full_idx = tuple(full_idx)
+        if reduce == "assign":
+            return a.at[full_idx].set(v)
+        if reduce == "add":
+            return a.at[full_idx].add(v)
+        if reduce == "multiply" or reduce == "mul":
+            return a.at[full_idx].multiply(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    return apply(_put, x, values, name="put_along_axis")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x,
+                 name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis0, axis1), x, name="swapaxes")
+
+
+def as_real(x, name=None):
+    def _as_real(a):
+        return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
+    return apply(_as_real, x, name="as_real")
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x,
+                 name="as_complex")
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(axes, (list, tuple)):
+        ax = tuple(tuple(int(i) for i in (a if isinstance(a, (list, tuple))
+                                          else [a])) for a in axes)
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y,
+                 name="tensordot")
+
+
+def atleast_1d(*inputs):
+    outs = [apply(jnp.atleast_1d, t, name="atleast_1d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs):
+    outs = [apply(jnp.atleast_2d, t, name="atleast_2d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs):
+    outs = [apply(jnp.atleast_3d, t, name="atleast_3d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                        axis2=axis2), x, name="diagonal")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def _diag_embed(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        i = jnp.arange(a.shape[-1])
+        rows = i + max(-offset, 0)
+        cols = i + max(offset, 0)
+        out = base.at[..., rows, cols].set(a)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+    return apply(_diag_embed, x, name="diag_embed")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _shape_arg(shape)
+    offsets = [int(unwrap(o)) for o in offsets] if offsets is not None else \
+        [0] * x.ndim
+
+    def _crop(a):
+        target = [a.shape[i] if shape[i] in (-1, None) else shape[i]
+                  for i in range(a.ndim)]
+        return jax.lax.dynamic_slice(a, offsets, target)
+    return apply(_crop, x, name="crop")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+
+    def _shard(a):
+        shard = a // size
+        return jnp.where(shard == shard_id, a % size, ignore_value)
+    return apply(_shard, input, name="shard_index")
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(x.shape)) if x.shape else 1,
+                              dtype=jnp.int64))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(x.ndim, dtype=jnp.int32))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+def _shape_arg(shape):
+    if shape is None:
+        return None
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) for s in shape)

@@ -1,0 +1,335 @@
+"""Elementwise and general math ops.
+
+Parity target: `python/paddle/tensor/math.py` + `ops.yaml` elementwise section
+of the reference. All lower straight to jnp/lax; XLA fuses chains of these
+into single kernels, replacing the reference's hand-fused CUDA elementwise
+machinery (`paddle/phi/kernels/funcs/elementwise_base.h`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "float_power", "matmul", "sqrt", "rsqrt", "exp",
+    "expm1", "log", "log2", "log10", "log1p", "abs", "neg", "sign", "floor",
+    "ceil", "round", "trunc", "frac", "sin", "cos", "tan", "asin", "acos",
+    "atan", "atan2", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "reciprocal", "square", "maximum", "minimum", "fmax", "fmin", "clip",
+    "scale", "add_n", "lerp", "erf", "erfinv", "logit", "isnan", "isinf",
+    "isfinite", "nan_to_num", "cumsum", "cumprod", "cummax", "cummin",
+    "logsumexp", "logcumsumexp", "logaddexp", "deg2rad", "rad2deg", "angle",
+    "conj", "real", "imag", "digamma", "lgamma", "gammaln", "multiply_",
+    "heaviside", "hypot", "ldexp", "copysign", "nextafter", "sgn",
+    "stanh", "softplus_math", "rsqrt_", "sigmoid", "i0", "i1",
+    "diff", "trapezoid", "cumulative_trapezoid", "vander", "gcd", "lcm",
+    "broadcast_shape", "inner", "outer", "kron",
+]
+
+
+def _binop(fn, name):
+    def op(x, y, name_=None):
+        return apply(fn, x, y, name=name)
+    op.__name__ = name
+    return op
+
+
+add = _binop(jnp.add, "add")
+subtract = _binop(jnp.subtract, "subtract")
+multiply = _binop(jnp.multiply, "multiply")
+divide = _binop(jnp.divide, "divide")
+floor_divide = _binop(jnp.floor_divide, "floor_divide")
+mod = _binop(jnp.mod, "mod")
+remainder = mod
+maximum = _binop(jnp.maximum, "maximum")
+minimum = _binop(jnp.minimum, "minimum")
+fmax = _binop(jnp.fmax, "fmax")
+fmin = _binop(jnp.fmin, "fmin")
+atan2 = _binop(jnp.arctan2, "atan2")
+logaddexp = _binop(jnp.logaddexp, "logaddexp")
+hypot = _binop(jnp.hypot, "hypot")
+copysign = _binop(jnp.copysign, "copysign")
+nextafter = _binop(jnp.nextafter, "nextafter")
+gcd = _binop(jnp.gcd, "gcd")
+lcm = _binop(jnp.lcm, "lcm")
+
+
+def pow(x, y, name=None):
+    return apply(jnp.power, x, y, name="pow")
+
+
+float_power = pow
+
+
+def _unop(fn, name):
+    def op(x, name_=None):
+        return apply(fn, x, name=name)
+    op.__name__ = name
+    return op
+
+
+sqrt = _unop(jnp.sqrt, "sqrt")
+rsqrt = _unop(jax.lax.rsqrt, "rsqrt")
+exp = _unop(jnp.exp, "exp")
+expm1 = _unop(jnp.expm1, "expm1")
+log = _unop(jnp.log, "log")
+log2 = _unop(jnp.log2, "log2")
+log10 = _unop(jnp.log10, "log10")
+log1p = _unop(jnp.log1p, "log1p")
+abs = _unop(jnp.abs, "abs")
+neg = _unop(jnp.negative, "neg")
+sign = _unop(jnp.sign, "sign")
+floor = _unop(jnp.floor, "floor")
+ceil = _unop(jnp.ceil, "ceil")
+round = _unop(jnp.round, "round")
+trunc = _unop(jnp.trunc, "trunc")
+sin = _unop(jnp.sin, "sin")
+cos = _unop(jnp.cos, "cos")
+tan = _unop(jnp.tan, "tan")
+asin = _unop(jnp.arcsin, "asin")
+acos = _unop(jnp.arccos, "acos")
+atan = _unop(jnp.arctan, "atan")
+sinh = _unop(jnp.sinh, "sinh")
+cosh = _unop(jnp.cosh, "cosh")
+tanh = _unop(jnp.tanh, "tanh")
+asinh = _unop(jnp.arcsinh, "asinh")
+acosh = _unop(jnp.arccosh, "acosh")
+atanh = _unop(jnp.arctanh, "atanh")
+reciprocal = _unop(jnp.reciprocal, "reciprocal")
+square = _unop(jnp.square, "square")
+erf = _unop(jax.scipy.special.erf, "erf")
+erfinv = _unop(jax.scipy.special.erfinv, "erfinv")
+isnan = _unop(jnp.isnan, "isnan")
+isinf = _unop(jnp.isinf, "isinf")
+isfinite = _unop(jnp.isfinite, "isfinite")
+deg2rad = _unop(jnp.deg2rad, "deg2rad")
+rad2deg = _unop(jnp.rad2deg, "rad2deg")
+angle = _unop(jnp.angle, "angle")
+conj = _unop(jnp.conj, "conj")
+real = _unop(jnp.real, "real")
+imag = _unop(jnp.imag, "imag")
+digamma = _unop(jax.scipy.special.digamma, "digamma")
+lgamma = _unop(jax.scipy.special.gammaln, "lgamma")
+gammaln = lgamma
+sigmoid = _unop(jax.nn.sigmoid, "sigmoid")
+i0 = _unop(jax.scipy.special.i0, "i0")
+i1 = _unop(jax.scipy.special.i1, "i1")
+
+
+def frac(x, name=None):
+    return apply(lambda a: a - jnp.trunc(a), x, name="frac")
+
+
+def sgn(x, name=None):
+    def _sgn(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+    return apply(_sgn, x, name="sgn")
+
+
+def logit(x, eps=None, name=None):
+    def _logit(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+    return apply(_logit, x, name="logit")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x, name="stanh")
+
+
+def softplus_math(x, beta=1.0, threshold=20.0):
+    return apply(
+        lambda a: jnp.where(a * beta > threshold, a,
+                            jnp.log1p(jnp.exp(beta * a)) / beta),
+        x, name="softplus")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = unwrap(min)
+    hi = unwrap(max)
+    return apply(lambda a: jnp.clip(a, lo, hi), x, name="clip")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = unwrap(scale), unwrap(bias)
+
+    def _scale(a):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out
+    return apply(_scale, x, name="scale")
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    def _add_n(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+    return apply(_add_n, *inputs, name="add_n")
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                          neginf=neginf), x, name="nan_to_num")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    return apply(lambda a: jnp.cumsum(a, axis=axis,
+                                      dtype=convert_dtype(dtype) if dtype
+                                      else None),
+                 x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply(lambda a: jnp.cumprod(a, axis=dim,
+                                       dtype=convert_dtype(dtype) if dtype
+                                       else None),
+                 x, name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def _cummax(a):
+        ax = axis if axis is not None else 0
+        arr = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.maximum, arr, axis=ax)
+        n = arr.shape[ax]
+        iota = jax.lax.broadcasted_iota(jnp.int64, arr.shape, ax)
+        is_new = arr == vals
+        idx = jnp.where(is_new, iota, -1)
+        inds = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
+        return vals, inds.astype(convert_dtype(dtype))
+    out = apply(_cummax, x, name="cummax")
+    return out[0], out[1]
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def _cummin(a):
+        ax = axis if axis is not None else 0
+        arr = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.minimum, arr, axis=ax)
+        iota = jax.lax.broadcasted_iota(jnp.int64, arr.shape, ax)
+        is_new = arr == vals
+        idx = jnp.where(is_new, iota, -1)
+        inds = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
+        return vals, inds.astype(convert_dtype(dtype))
+    out = apply(_cummin, x, name="cummin")
+    return out[0], out[1]
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=axis,
+                                                       keepdims=keepdim),
+                 x, name="logsumexp")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def _lcse(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, arr, axis=ax)
+    return apply(_lcse, x, name="logcumsumexp")
+
+
+def heaviside(x, y, name=None):
+    return apply(lambda a, b: jnp.heaviside(a, b), x, y, name="heaviside")
+
+
+def ldexp(x, y, name=None):
+    return apply(lambda a, b: a * jnp.power(2.0, b.astype(jnp.float32)),
+                 x, y, name="ldexp")
+
+
+def mm_precision(*dtypes):
+    """float32 contractions run at full fp32 precision (paddle parity);
+    bf16/fp16 keep the fast MXU path."""
+    if any(jnp.dtype(d) == jnp.float32 for d in dtypes):
+        return jax.lax.Precision.HIGHEST
+    return None
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def _matmul(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=mm_precision(a.dtype, b.dtype))
+    return apply(_matmul, x, y, name="matmul")
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, x, y, name="inner")
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y, name="outer")
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, x, y, name="kron")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = unwrap(prepend)
+    app = unwrap(append)
+    return apply(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre,
+                                    append=app), x, name="diff")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    xa = unwrap(x)
+    def _trap(a):
+        if xa is not None:
+            return jax.scipy.integrate.trapezoid(a, x=xa, axis=axis)
+        return jax.scipy.integrate.trapezoid(a, dx=dx or 1.0, axis=axis)
+    return apply(_trap, y, name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    xa = unwrap(x)
+
+    def _ctrap(a):
+        d = jnp.diff(xa, axis=axis) if xa is not None else (dx or 1.0)
+        left = jax.lax.slice_in_dim(a, 0, a.shape[axis] - 1, axis=axis)
+        right = jax.lax.slice_in_dim(a, 1, a.shape[axis], axis=axis)
+        if xa is not None and jnp.ndim(d) == 1 and a.ndim > 1:
+            shape = [1] * a.ndim
+            shape[axis] = -1
+            d = d.reshape(shape)
+        return jnp.cumsum((left + right) * d / 2.0, axis=axis)
+    return apply(_ctrap, y, name="cumulative_trapezoid")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply(lambda a: jnp.vander(a, N=n, increasing=increasing),
+                 x, name="vander")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def multiply_(x, y):
+    from . import _inplace_from
+    return _inplace_from(x, multiply(x, y))
+
+
+def rsqrt_(x):
+    from . import _inplace_from
+    return _inplace_from(x, rsqrt(x))

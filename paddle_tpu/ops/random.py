@@ -1,0 +1,149 @@
+"""Random ops backed by the global Generator (parity: reference
+`python/paddle/tensor/random.py`). Every draw splits the global PRNG key
+(`paddle_tpu/core/random.py`), so results are deterministic under `seed()`
+and trace-safe under the compiled train step (which scopes a per-step key).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import unwrap
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.random import next_key
+from ..core.tensor import Tensor
+from .creation import _norm_shape
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "normal", "standard_normal", "bernoulli", "multinomial", "poisson",
+    "exponential_", "uniform_", "normal_", "rand_like", "randn_like",
+    "standard_gamma", "binomial", "log_normal",
+]
+
+
+def _dt(dtype, default=None):
+    return convert_dtype(dtype) if dtype is not None else \
+        (default or get_default_dtype())
+
+
+def rand(shape, dtype=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None):
+    shape = _norm_shape(shape)
+    return Tensor(jax.random.normal(next_key(), shape, _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=None):
+    m, s = unwrap(mean), unwrap(std)
+    if shape is None:
+        shape = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+    else:
+        shape = _norm_shape(shape)
+    draw = jax.random.normal(next_key(), shape, _dt(dtype))
+    return Tensor(draw * s + m)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    return normal(mean, std, shape).exp()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    shape = _norm_shape(shape)
+    key = jax.random.PRNGKey(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, shape, _dt(dtype),
+                                     minval=unwrap(min), maxval=unwrap(max)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    shape = _norm_shape(shape)
+    return Tensor(jax.random.randint(next_key(), shape, int(unwrap(low)),
+                                     int(unwrap(high)),
+                                     dtype=convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    dt = convert_dtype(dtype) if dtype else x.dtype
+    out = randint(low, high, x.shape, dtype="int64")
+    return Tensor(out._data.astype(dt))
+
+
+def randperm(n, dtype="int64"):
+    return Tensor(jax.random.permutation(next_key(), int(n))
+                  .astype(convert_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    p = unwrap(x)
+    draw = jax.random.uniform(next_key(), p.shape, p.dtype
+                              if jnp.issubdtype(p.dtype, jnp.floating)
+                              else jnp.float32)
+    return Tensor((draw < p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = unwrap(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits,
+                                     shape=(p.shape[:-1] or ()) +
+                                     (num_samples,) if p.ndim > 1 else
+                                     (num_samples,), axis=-1)
+        return Tensor(out.astype(jnp.int64))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(next_key(), p.shape)
+    scores = logits + g
+    _, idx = jax.lax.top_k(scores, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    lam = unwrap(x)
+    return Tensor(jax.random.poisson(next_key(), lam).astype(lam.dtype))
+
+
+def standard_gamma(x, name=None):
+    alpha = unwrap(x)
+    return Tensor(jax.random.gamma(next_key(), alpha))
+
+
+def binomial(count, prob, name=None):
+    n, p = unwrap(count), unwrap(prob)
+    return Tensor(jax.random.binomial(next_key(), n.astype(jnp.float32),
+                                      p).astype(jnp.int64))
+
+
+def rand_like(x, dtype=None):
+    dt = convert_dtype(dtype) if dtype else x.dtype
+    return Tensor(jax.random.uniform(next_key(), tuple(x.shape), dt))
+
+
+def randn_like(x, dtype=None):
+    dt = convert_dtype(dtype) if dtype else x.dtype
+    return Tensor(jax.random.normal(next_key(), tuple(x.shape), dt))
+
+
+def exponential_(x, lam=1.0, name=None):
+    draw = jax.random.exponential(next_key(), tuple(x.shape),
+                                  x.dtype) / lam
+    return x._rebind(draw)
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    draw = jax.random.uniform(next_key(), tuple(x.shape), x.dtype,
+                              minval=min, maxval=max)
+    return x._rebind(draw)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    draw = jax.random.normal(next_key(), tuple(x.shape), x.dtype) * std + mean
+    return x._rebind(draw)

@@ -1,0 +1,139 @@
+"""Reduction ops (parity: reference `python/paddle/tensor/math.py` reductions +
+`paddle/phi/kernels/funcs/reduce_function.h` machinery — XLA owns the
+tiling/tree-reduction here)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.dtype import convert_dtype
+
+__all__ = [
+    "sum", "mean", "max", "min", "amax", "amin", "prod", "all", "any",
+    "count_nonzero", "median", "nanmedian", "nansum", "nanmean", "var", "std",
+    "quantile", "nanquantile",
+]
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    axis = unwrap(axis)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    dt = convert_dtype(dtype) if dtype else None
+    return apply(lambda a: jnp.sum(a, axis=ax, dtype=dt, keepdims=keepdim),
+                 x, name="sum")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    dt = convert_dtype(dtype) if dtype else None
+    return apply(lambda a: jnp.nansum(a, axis=ax, dtype=dt, keepdims=keepdim),
+                 x, name="nansum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim),
+                 x, name="mean")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim),
+                 x, name="nanmean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x,
+                 name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x,
+                 name="min")
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = _norm_axis(axis)
+    dt = convert_dtype(dtype) if dtype else None
+    return apply(lambda a: jnp.prod(a, axis=ax, dtype=dt, keepdims=keepdim),
+                 x, name="prod")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x,
+                 name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x,
+                 name="any")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim)
+                 .astype(jnp.int64), x, name="count_nonzero")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.median(a, axis=ax, keepdims=keepdim),
+                 x, name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    return apply(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim),
+                 x, name="nanmedian")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply(lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                 x, name="var")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return apply(lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim),
+                 x, name="std")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    ax = _norm_axis(axis)
+    qv = unwrap(q)
+    return apply(lambda a: jnp.quantile(a, jnp.asarray(qv), axis=ax,
+                                        keepdims=keepdim,
+                                        method=interpolation),
+                 x, name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    ax = _norm_axis(axis)
+    qv = unwrap(q)
+    return apply(lambda a: jnp.nanquantile(a, jnp.asarray(qv), axis=ax,
+                                           keepdims=keepdim,
+                                           method=interpolation),
+                 x, name="nanquantile")

@@ -1,0 +1,184 @@
+"""Search / sort / index ops (parity: reference
+`python/paddle/tensor/search.py`). Dynamic-output-shape ops (nonzero, unique)
+run eagerly on host — same restriction the reference's static/CINN path has.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, unwrap
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "nonzero", "kthvalue",
+    "mode", "index_sample", "searchsorted", "bucketize", "unique",
+    "unique_consecutive", "masked_select",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+
+    def _argmax(a):
+        out = jnp.argmax(a, axis=axis, keepdims=keepdim if axis is not None
+                         else False)
+        return out.astype(dt)
+    return apply(_argmax, x, name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+
+    def _argmin(a):
+        out = jnp.argmin(a, axis=axis, keepdims=keepdim if axis is not None
+                         else False)
+        return out.astype(dt)
+    return apply(_argmin, x, name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    def _argsort(a):
+        out = jnp.argsort(a, axis=axis, stable=stable,
+                          descending=descending)
+        return out.astype(jnp.int64)
+    return apply(_argsort, x, name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def _sort(a):
+        out = jnp.sort(a, axis=axis, stable=stable, descending=descending)
+        return out
+    return apply(_sort, x, name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(unwrap(k))
+
+    def _topk(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(moved, k)
+        else:
+            vals, idx = jax.lax.top_k(-moved, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+    out = apply(_topk, x, name="topk")
+    return out[0], out[1]
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    k = int(unwrap(k))
+
+    def _kth(a):
+        ax = axis % a.ndim
+        vals = jnp.sort(a, axis=ax)
+        idx = jnp.argsort(a, axis=ax).astype(jnp.int64)
+        v = jax.lax.index_in_dim(vals, k - 1, axis=ax, keepdims=keepdim)
+        i = jax.lax.index_in_dim(idx, k - 1, axis=ax, keepdims=keepdim)
+        return v, i
+    out = apply(_kth, x, name="kthvalue")
+    return out[0], out[1]
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(unwrap(x))
+    ax = axis % a.ndim
+    moved = np.moveaxis(a, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=a.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    shape = moved.shape[:-1]
+    vals = vals.reshape(shape)
+    idxs = idxs.reshape(shape)
+    if keepdim:
+        vals = np.expand_dims(vals, ax)
+        idxs = np.expand_dims(idxs, ax)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+
+
+def nonzero(x, as_tuple=False, name=None):
+    a = np.asarray(unwrap(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v.astype(np.int64))) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=-1).astype(np.int64)))
+
+
+def index_sample(x, index, name=None):
+    idx = unwrap(index)
+    return apply(lambda a: jnp.take_along_axis(a, idx, axis=1), x,
+                 name="index_sample")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    seq = unwrap(sorted_sequence)
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+
+    def _ss(v):
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, v, side=side).astype(dt)
+        flat_seq = seq.reshape(-1, seq.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        outs = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+            flat_seq, flat_v)
+        return outs.reshape(v.shape).astype(dt)
+    return apply(_ss, values, name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    res = np.unique(a, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    dt = convert_dtype(dtype)
+    outs = [Tensor(jnp.asarray(res[0]))]
+    for extra in res[1:]:
+        outs.append(Tensor(jnp.asarray(extra.astype(np.dtype(dt)))))
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    if axis is None:
+        flat = a.reshape(-1)
+        if flat.size == 0:
+            keep = np.array([], dtype=bool)
+        else:
+            keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+        vals = flat[keep]
+        outs = [Tensor(jnp.asarray(vals))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, flat.size))
+            outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)

@@ -1,0 +1,33 @@
+"""Test harness config.
+
+Runs everything on a virtual 8-device CPU mesh (SURVEY.md §4: the reference
+tests all parallelism single-host; we use XLA's forced host device count the
+way the reference uses its `custom_cpu` fake device plugin).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# The axon PJRT plugin (PJRT_LIBRARY_PATH) would register the real TPU and
+# override JAX_PLATFORMS; drop it for the CPU-mesh test environment.
+os.environ.pop("PJRT_LIBRARY_PATH", None)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu as paddle
+
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,82 @@
+"""OpTest-style numeric harness.
+
+Models the reference's `test/legacy_test/op_test.py:418`: every op is checked
+against a NumPy oracle (`check_output`) and its analytic tape gradient is
+checked against numeric finite-difference gradients (`check_grad`, reference
+`get_numeric_gradient` op_test.py:148).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op_fn, np_fn, inputs, atol=1e-5, rtol=1e-5, kwargs=None):
+    """Run op_fn over paddle tensors and np_fn over raw arrays; compare."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a) for a in inputs]
+    out = op_fn(*tensors, **kwargs)
+    ref = np_fn(*inputs, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    assert len(outs) == len(refs), f"{len(outs)} outputs vs {len(refs)} refs"
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(
+            np.asarray(o.numpy(), dtype=np.float64)
+            if np.issubdtype(np.asarray(r).dtype, np.floating) else o.numpy(),
+            np.asarray(r), atol=atol, rtol=rtol)
+    return outs
+
+
+def numeric_grad(fn, inputs, idx, delta=1e-3):
+    """Central finite differences of sum(fn(inputs)) w.r.t. inputs[idx]."""
+    base = [np.array(a, dtype=np.float64) for a in inputs]
+    grad = np.zeros_like(base[idx])
+    it = np.nditer(base[idx], flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        orig = base[idx][i]
+        base[idx][i] = orig + delta
+        hi = _scalar_sum(fn, base)
+        base[idx][i] = orig - delta
+        lo = _scalar_sum(fn, base)
+        base[idx][i] = orig
+        grad[i] = (hi - lo) / (2 * delta)
+        it.iternext()
+    return grad
+
+
+def _scalar_sum(fn, arrays):
+    tensors = [paddle.to_tensor(a.astype(np.float32)) for a in arrays]
+    with paddle.no_grad():
+        out = fn(*tensors)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    return float(sum(float(o.sum()) for o in outs
+                     if paddle.core.dtype.is_floating_point(o.dtype)))
+
+
+def check_grad(op_fn, inputs, grad_inputs=None, atol=5e-3, rtol=5e-3,
+               delta=1e-3, kwargs=None):
+    """Compare tape gradients of sum(op(*inputs)) against finite differences."""
+    kwargs = kwargs or {}
+    fn = lambda *ts: op_fn(*ts, **kwargs)  # noqa: E731
+    tensors = [paddle.to_tensor(np.asarray(a, np.float32),
+                                stop_gradient=False) for a in inputs]
+    out = fn(*tensors)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    total = None
+    for o in outs:
+        if paddle.core.dtype.is_floating_point(o.dtype):
+            s = o.sum()
+            total = s if total is None else total + s
+    total.backward()
+
+    indices = range(len(inputs)) if grad_inputs is None else grad_inputs
+    for i in indices:
+        assert tensors[i].grad is not None, f"input {i} got no gradient"
+        analytic = tensors[i].grad.numpy().astype(np.float64)
+        numeric = numeric_grad(fn, inputs, i, delta=delta)
+        np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol,
+                                   err_msg=f"grad mismatch on input {i}")

@@ -1,0 +1,155 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x + 2 * x  # dy/dx = 2x + 2 = 8
+        y.backward()
+        assert x.grad.tolist() == [8.0]
+
+    def test_branching_accumulates(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        a = x * 3
+        b = x * 4
+        (a + b).backward()
+        assert x.grad.tolist() == [7.0]
+
+    def test_shared_subexpression(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x           # y = x^2
+        z = y * y           # z = x^4, dz/dx = 4x^3 = 32
+        z.backward()
+        assert x.grad.tolist() == [32.0]
+
+    def test_grad_accumulation_across_backwards(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        assert x.grad.tolist() == [5.0]
+
+    def test_clear_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        x.clear_gradient()
+        assert x.grad is None
+
+    def test_non_scalar_needs_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            (x * 2).backward()
+        y = x * 2
+        y.backward(paddle.to_tensor([1.0, 0.5]))
+        assert x.grad.tolist() == [2.0, 1.0]
+
+    def test_stop_gradient_prunes(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([1.0], stop_gradient=True)
+        (x * y).backward()
+        assert x.grad is not None
+        assert y.grad is None
+
+    def test_detach_cuts_graph(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (x * 3).detach() * 2
+        with pytest.raises(RuntimeError):
+            y.backward()  # no grad path
+
+    def test_double_backward_raises_without_retain(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        y.backward(retain_graph=True)
+        y.backward()
+        assert x.grad.tolist() == [4.0]
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._node is None
+
+    def test_no_grad_decorator(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+
+        @paddle.no_grad()
+        def fn(t):
+            return t * 2
+
+        assert fn(x).stop_gradient
+
+    def test_multi_output_op_grads(self):
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32), stop_gradient=False)
+        a, b = paddle.split(x, 2)
+        (a.sum() * 2 + b.sum() * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2, 2, 3, 3])
+
+    def test_partial_output_use(self):
+        # only one output of a multi-output op participates in the loss
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32), stop_gradient=False)
+        a, b = paddle.split(x, 2)
+        a.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1, 1, 0, 0])
+
+    def test_int_outputs_not_recorded(self):
+        x = paddle.to_tensor([3.0, 1.0], stop_gradient=False)
+        idx = paddle.argmax(x)
+        assert idx.stop_gradient
+
+    def test_topk_grad_through_values(self):
+        x = paddle.to_tensor([1.0, 5.0, 3.0], stop_gradient=False)
+        vals, _ = paddle.topk(x, 2)
+        vals.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0, 1, 1])
+
+    def test_matmul_grad_matches_manual(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        x = paddle.to_tensor(a, stop_gradient=False)
+        w = paddle.to_tensor(b, stop_gradient=False)
+        paddle.matmul(x, w).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.ones((3, 5)) @ b.T, atol=1e-5)
+        np.testing.assert_allclose(w.grad.numpy(),
+                                   a.T @ np.ones((3, 5)), atol=1e-5)
+
+    def test_deep_chain(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x
+        for _ in range(50):
+            y = y * 1.1
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.1 ** 50], rtol=1e-4)
+
+
+class TestFunctionalGrad:
+    def test_grad_basic(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        (g,) = paddle.grad(y, [x])
+        assert g.tolist() == [4.0]
+        assert x.grad is None  # .grad untouched
+
+    def test_grad_allow_unused(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        z = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gx.tolist() == [2.0]
+        assert gz is None
+
+    def test_grad_unused_raises(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        z = paddle.to_tensor([1.0], stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            paddle.grad(x * 2, [z])

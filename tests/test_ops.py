@@ -1,0 +1,393 @@
+"""Op numeric tests against the NumPy oracle + finite-difference grads
+(reference test strategy: SURVEY.md §4, test/legacy_test/op_test.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+def _randn(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+def _randpos(*shape):
+    return (np.random.rand(*shape).astype(np.float32) + 0.1)
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("op,np_op", [
+        (paddle.add, np.add), (paddle.subtract, np.subtract),
+        (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+        (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+        (paddle.atan2, np.arctan2),
+    ])
+    def test_binary(self, op, np_op):
+        check_output(op, np_op, [_randn(3, 4), _randpos(3, 4)])
+
+    def test_binary_broadcast(self):
+        check_output(paddle.add, np.add, [_randn(3, 1, 4), _randn(5, 1)])
+
+    @pytest.mark.parametrize("op,np_op,gen", [
+        (paddle.ops.math.sqrt, np.sqrt, _randpos),
+        (paddle.exp, np.exp, _randn),
+        (paddle.ops.math.log, np.log, _randpos),
+        (paddle.ops.math.abs, np.abs, _randn),
+        (paddle.sin, np.sin, _randn), (paddle.cos, np.cos, _randn),
+        (paddle.tanh, np.tanh, _randn),
+        (paddle.floor, np.floor, _randn), (paddle.ceil, np.ceil, _randn),
+        (paddle.square, np.square, _randn),
+        (paddle.erf, lambda a: np.vectorize(__import__("math").erf)(a),
+         _randn),
+    ])
+    def test_unary(self, op, np_op, gen):
+        check_output(op, np_op, [gen(4, 5)], atol=1e-4, rtol=1e-4)
+
+    def test_grads(self):
+        check_grad(paddle.multiply, [_randn(3, 3), _randn(3, 3)])
+        check_grad(paddle.divide, [_randn(3, 3), _randpos(3, 3)])
+        check_grad(paddle.tanh, [_randn(4)])
+        check_grad(lambda x: paddle.ops.math.sqrt(x), [_randpos(4) + 0.5])
+        check_grad(paddle.ops.math.matmul, [_randn(3, 4), _randn(4, 2)])
+
+    def test_clip(self):
+        check_output(lambda x: paddle.clip(x, -0.5, 0.5),
+                     lambda a: np.clip(a, -0.5, 0.5), [_randn(4, 4)])
+
+    def test_scale(self):
+        check_output(lambda x: paddle.scale(x, 2.0, 1.0),
+                     lambda a: a * 2 + 1, [_randn(3)])
+        check_output(lambda x: paddle.scale(x, 2.0, 1.0,
+                                            bias_after_scale=False),
+                     lambda a: (a + 1) * 2, [_randn(3)])
+
+    def test_add_n(self):
+        xs = [_randn(2, 2) for _ in range(3)]
+        out = paddle.add_n([paddle.to_tensor(a) for a in xs])
+        np.testing.assert_allclose(out.numpy(), sum(xs), atol=1e-6)
+
+    def test_cumsum_cumprod(self):
+        check_output(lambda x: paddle.cumsum(x, axis=1),
+                     lambda a: np.cumsum(a, axis=1), [_randn(3, 4)])
+        check_output(lambda x: paddle.cumprod(x, dim=0),
+                     lambda a: np.cumprod(a, axis=0), [_randn(3, 4)])
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as sls
+        check_output(lambda x: paddle.logsumexp(x, axis=1),
+                     lambda a: sls(a, axis=1), [_randn(3, 4)], atol=1e-4,
+                     rtol=1e-4)
+
+    def test_lerp(self):
+        check_output(paddle.lerp, lambda a, b, w: a + w * (b - a),
+                     [_randn(3), _randn(3), _randpos(3)])
+
+
+class TestReduction:
+    @pytest.mark.parametrize("op,np_op", [
+        (paddle.ops.reduction.sum, np.sum),
+        (paddle.mean, np.mean),
+        (paddle.ops.reduction.max, np.max),
+        (paddle.ops.reduction.min, np.min),
+        (paddle.prod, np.prod),
+    ])
+    @pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False),
+                                              (1, True), ((0, 1), False)])
+    def test_reduce(self, op, np_op, axis, keepdim):
+        check_output(lambda x: op(x, axis=axis, keepdim=keepdim),
+                     lambda a: np_op(a, axis=axis, keepdims=keepdim),
+                     [_randn(3, 4, 2)], atol=1e-4, rtol=1e-4)
+
+    def test_var_std(self):
+        check_output(lambda x: paddle.var(x, axis=1),
+                     lambda a: np.var(a, axis=1, ddof=1), [_randn(5, 6)])
+        check_output(lambda x: paddle.std(x, unbiased=False),
+                     lambda a: np.std(a), [_randn(5, 6)])
+
+    def test_reduce_grads(self):
+        check_grad(lambda x: paddle.ops.reduction.sum(x, axis=1), [_randn(3, 4)])
+        check_grad(lambda x: paddle.mean(x), [_randn(3, 4)])
+        check_grad(lambda x: paddle.ops.reduction.max(x, axis=0), [_randn(3, 4)])
+
+    def test_any_all(self):
+        a = np.array([[True, False], [True, True]])
+        assert paddle.ops.reduction.all(paddle.to_tensor(a)).item() is False
+        assert paddle.ops.reduction.any(paddle.to_tensor(a)).item() is True
+
+    def test_median(self):
+        check_output(paddle.median, np.median, [_randn(9)])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        check_output(lambda x: paddle.reshape(x, [4, 3]),
+                     lambda a: a.reshape(4, 3), [_randn(3, 4)])
+        check_output(lambda x: paddle.transpose(x, [1, 0, 2]),
+                     lambda a: a.transpose(1, 0, 2), [_randn(2, 3, 4)])
+
+    def test_concat_stack(self):
+        a, b = _randn(2, 3), _randn(2, 3)
+        out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 1))
+        out = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(out.numpy(), np.stack([a, b], 0))
+
+    def test_split_sections(self):
+        x = _randn(7, 2)
+        parts = paddle.split(paddle.to_tensor(x), [2, 2, -1], axis=0)
+        assert [p.shape[0] for p in parts] == [2, 2, 3]
+        np.testing.assert_allclose(parts[2].numpy(), x[4:])
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = _randn(1, 3, 1, 2)
+        assert paddle.squeeze(paddle.to_tensor(x)).shape == [3, 2]
+        assert paddle.squeeze(paddle.to_tensor(x), axis=0).shape == [3, 1, 2]
+        assert paddle.unsqueeze(paddle.to_tensor(x), [0, 4]).shape == \
+            [1, 1, 3, 1, 1, 2]
+        assert paddle.ops.manipulation.flatten(
+            paddle.to_tensor(x), 1, 2).shape == [1, 3, 2]
+
+    def test_tile_expand(self):
+        x = _randn(1, 3)
+        assert paddle.tile(paddle.to_tensor(x), [2, 2]).shape == [2, 6]
+        assert paddle.expand(paddle.to_tensor(x), [4, -1]).shape == [4, 3]
+        assert paddle.broadcast_to(paddle.to_tensor(x), [4, 3]).shape == [4, 3]
+
+    def test_gather_scatter(self):
+        x = _randn(5, 3)
+        idx = np.array([0, 2, 4])
+        out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), x[idx])
+        upd = _randn(3, 3)
+        out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        ref = x.copy()
+        ref[idx] = upd
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_gather_nd(self):
+        x = _randn(3, 4, 5)
+        idx = np.array([[0, 1], [2, 3]])
+        out = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), x[[0, 2], [1, 3]])
+
+    def test_where(self):
+        c = np.array([True, False, True])
+        a, b = _randn(3), _randn(3)
+        out = paddle.ops.manipulation.where(paddle.to_tensor(c),
+                                            paddle.to_tensor(a),
+                                            paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), np.where(c, a, b))
+
+    def test_pad(self):
+        x = _randn(2, 3)
+        out = paddle.ops.manipulation.pad(paddle.to_tensor(x), [1, 1],
+                                          value=9.0)
+        assert out.shape == [2, 5]
+        assert out.numpy()[0, 0] == 9.0
+
+    def test_take_along_put_along(self):
+        x = _randn(3, 4)
+        idx = np.argsort(x, axis=1)
+        out = paddle.take_along_axis(paddle.to_tensor(x),
+                                     paddle.to_tensor(idx), 1)
+        np.testing.assert_allclose(out.numpy(),
+                                   np.take_along_axis(x, idx, 1))
+
+    def test_flip_roll(self):
+        x = _randn(3, 4)
+        np.testing.assert_allclose(
+            paddle.flip(paddle.to_tensor(x), [0]).numpy(), np.flip(x, 0))
+        np.testing.assert_allclose(
+            paddle.roll(paddle.to_tensor(x), 1, axis=0).numpy(),
+            np.roll(x, 1, 0))
+
+    def test_grads_through_manip(self):
+        check_grad(lambda x: paddle.reshape(x, [6]), [_randn(2, 3)])
+        check_grad(lambda x: paddle.transpose(x, [1, 0]), [_randn(2, 3)])
+        check_grad(lambda x: paddle.gather(
+            x, paddle.to_tensor(np.array([0, 1]))), [_randn(3, 2)])
+
+    def test_cast_grad(self):
+        x = paddle.to_tensor(_randn(3), stop_gradient=False)
+        y = x.astype("bfloat16").astype("float32")
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(3))
+
+    def test_masked_select(self):
+        x = _randn(4, 4)
+        m = x > 0
+        out = paddle.ops.manipulation.masked_select(
+            paddle.to_tensor(x), paddle.to_tensor(m))
+        np.testing.assert_allclose(out.numpy(), x[m])
+
+
+class TestSearchSort:
+    def test_argmax_argmin(self):
+        x = _randn(3, 4)
+        check_output(lambda t: paddle.argmax(t, axis=1),
+                     lambda a: np.argmax(a, axis=1), [x])
+        check_output(lambda t: paddle.argmin(t, axis=0),
+                     lambda a: np.argmin(a, axis=0), [x])
+
+    def test_sort_argsort(self):
+        x = _randn(3, 5)
+        check_output(lambda t: paddle.sort(t, axis=1),
+                     lambda a: np.sort(a, axis=1), [x])
+        check_output(lambda t: paddle.argsort(t, axis=1),
+                     lambda a: np.argsort(a, axis=1, kind="stable"), [x])
+        check_output(lambda t: paddle.sort(t, axis=1, descending=True),
+                     lambda a: -np.sort(-a, axis=1), [x])
+
+    def test_topk(self):
+        x = _randn(3, 6)
+        vals, idx = paddle.topk(paddle.to_tensor(x), 2, axis=1)
+        ref = np.sort(x, axis=1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(vals.numpy(), ref, atol=1e-6)
+
+    def test_nonzero_unique(self):
+        x = np.array([[1, 0], [0, 2]])
+        nz = paddle.nonzero(paddle.to_tensor(x))
+        np.testing.assert_array_equal(nz.numpy(),
+                                      np.stack(np.nonzero(x), -1))
+        u, inv = paddle.unique(paddle.to_tensor(np.array([3, 1, 1, 2])),
+                               return_inverse=True)
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+
+    def test_searchsorted(self):
+        seq = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+        v = np.array([2.0, 6.0], np.float32)
+        out = paddle.searchsorted(paddle.to_tensor(seq), paddle.to_tensor(v))
+        np.testing.assert_array_equal(out.numpy(), [1, 3])
+
+
+class TestLinalg:
+    def test_matmul_variants(self):
+        a, b = _randn(3, 4), _randn(4, 5)
+        check_output(paddle.ops.math.matmul, np.matmul, [a, b])
+        check_output(lambda x, y: paddle.ops.math.matmul(
+            x, y, transpose_y=True), lambda x, y: x @ y.T,
+            [_randn(3, 4), _randn(5, 4)])
+        check_output(paddle.bmm, np.matmul, [_randn(2, 3, 4), _randn(2, 4, 5)])
+
+    def test_dot(self):
+        check_output(paddle.dot, np.dot, [_randn(5), _randn(5)])
+
+    def test_norm(self):
+        check_output(lambda x: paddle.ops.linalg.norm(x),
+                     lambda a: np.linalg.norm(a), [_randn(3, 4)],
+                     atol=1e-5)
+
+    def test_solve_inv_det(self):
+        a = _randn(4, 4) + 4 * np.eye(4, dtype=np.float32)
+        b = _randn(4, 2)
+        check_output(paddle.solve, np.linalg.solve, [a, b], atol=1e-4)
+        check_output(paddle.inv, np.linalg.inv, [a], atol=1e-4)
+        check_output(paddle.det, np.linalg.det, [a], atol=1e-2, rtol=1e-4)
+
+    def test_cholesky_qr_svd(self):
+        m = _randn(4, 4)
+        spd = m @ m.T + 4 * np.eye(4, dtype=np.float32)
+        L = paddle.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(L.numpy() @ L.numpy().T, spd, atol=1e-4)
+        q, r = paddle.qr(paddle.to_tensor(m))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), m, atol=1e-4)
+        u, s, vt = paddle.svd(paddle.to_tensor(m))
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()) @ vt.numpy(), m, atol=1e-4)
+
+    def test_eigh(self):
+        m = _randn(4, 4)
+        sym = (m + m.T) / 2
+        w, v = paddle.eigh(paddle.to_tensor(sym))
+        ref_w = np.linalg.eigvalsh(sym)
+        np.testing.assert_allclose(w.numpy(), ref_w, atol=1e-4)
+
+    def test_einsum(self):
+        a, b = _randn(3, 4), _randn(4, 5)
+        check_output(lambda x, y: paddle.einsum("ij,jk->ik", x, y),
+                     lambda x, y: np.einsum("ij,jk->ik", x, y), [a, b])
+        check_grad(lambda x, y: paddle.einsum("ij,jk->ik", x, y),
+                   [_randn(2, 3), _randn(3, 2)])
+
+    def test_trace(self):
+        check_output(lambda x: paddle.ops.linalg.trace(x),
+                     lambda a: np.trace(a), [_randn(4, 4)])
+
+
+class TestCreationRandom:
+    def test_arange_linspace(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(),
+                                      np.arange(5))
+        np.testing.assert_allclose(
+            paddle.arange(0, 1, 0.25).numpy(), np.arange(0, 1, 0.25))
+        np.testing.assert_allclose(
+            paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+
+    def test_eye_diag_tri(self):
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3))
+        x = _randn(4, 4)
+        np.testing.assert_array_equal(
+            paddle.tril(paddle.to_tensor(x)).numpy(), np.tril(x))
+        np.testing.assert_array_equal(
+            paddle.triu(paddle.to_tensor(x), 1).numpy(), np.triu(x, 1))
+
+    def test_full_zeros_ones(self):
+        assert paddle.full([2, 2], 7).numpy().sum() == 28
+        assert paddle.zeros([3]).numpy().sum() == 0
+        assert paddle.ones([3], dtype="int32").dtype == paddle.int32
+
+    def test_one_hot(self):
+        out = paddle.ops.creation.one_hot(paddle.to_tensor([0, 2]), 3)
+        np.testing.assert_array_equal(out.numpy(),
+                                      [[1, 0, 0], [0, 0, 1]])
+
+    def test_seed_reproducible(self):
+        paddle.seed(7)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_rand_ranges(self):
+        u = paddle.uniform([1000], min=2.0, max=3.0).numpy()
+        assert u.min() >= 2.0 and u.max() <= 3.0
+        r = paddle.randint(0, 5, [1000]).numpy()
+        assert r.min() >= 0 and r.max() < 5
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+    def test_bernoulli_multinomial(self):
+        p = paddle.full([2000], 0.3)
+        draws = paddle.bernoulli(p).numpy()
+        assert 0.2 < draws.mean() < 0.4
+        m = paddle.multinomial(paddle.to_tensor([0.0, 0.0, 1.0]), 5,
+                               replacement=True)
+        assert (m.numpy() == 2).all()
+
+    def test_meshgrid(self):
+        a, b = paddle.meshgrid(paddle.arange(3), paddle.arange(2))
+        assert a.shape == [3, 2]
+
+
+class TestLogic:
+    def test_compare(self):
+        x, y = _randn(4), _randn(4)
+        check_output(paddle.equal, np.equal, [x, x])
+        check_output(paddle.less_than, np.less, [x, y])
+        check_output(paddle.greater_equal, np.greater_equal, [x, y])
+
+    def test_logical(self):
+        a = np.array([True, False, True])
+        b = np.array([True, True, False])
+        check_output(paddle.logical_and, np.logical_and, [a, b])
+        check_output(paddle.logical_or, np.logical_or, [a, b])
+        check_output(paddle.logical_xor, np.logical_xor, [a, b])
+
+    def test_allclose_equal_all(self):
+        x = _randn(3)
+        assert paddle.ops.logic.allclose(paddle.to_tensor(x),
+                                         paddle.to_tensor(x)).item()
+        assert paddle.equal_all(paddle.to_tensor(x),
+                                paddle.to_tensor(x)).item()

@@ -1,0 +1,130 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestTensorBasics:
+    def test_create_from_list(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert t.shape == [2, 2]
+        assert t.dtype == paddle.float32
+        assert t.stop_gradient
+
+    def test_create_dtypes(self):
+        assert paddle.to_tensor(1).dtype.name in ("int64", "int32")
+        assert paddle.to_tensor(1.0).dtype == paddle.float32
+        assert paddle.to_tensor([True]).dtype.name == "bool"
+        t = paddle.to_tensor([1, 2], dtype="bfloat16")
+        assert t.dtype == paddle.bfloat16
+
+    def test_default_dtype(self):
+        paddle.set_default_dtype("bfloat16")
+        try:
+            assert paddle.ones([2]).dtype == paddle.bfloat16
+        finally:
+            paddle.set_default_dtype("float32")
+
+    def test_numpy_roundtrip(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_array_equal(t.numpy(), a)
+
+    def test_item(self):
+        assert paddle.to_tensor(3.5).item() == 3.5
+        assert paddle.to_tensor([[1, 2], [3, 4]]).item(1, 1) == 4
+
+    def test_repr_and_len(self):
+        t = paddle.ones([2, 3])
+        assert "shape=[2, 3]" in repr(t)
+        assert len(t) == 2
+        with pytest.raises(TypeError):
+            len(paddle.to_tensor(1.0))
+
+    def test_astype_cast(self):
+        t = paddle.ones([2], dtype="float32")
+        assert t.astype("bfloat16").dtype == paddle.bfloat16
+        assert t.cast("int32").dtype == paddle.int32
+
+    def test_detach_shares_value(self):
+        t = paddle.ones([2])
+        t.stop_gradient = False
+        d = t.detach()
+        assert d.stop_gradient
+        np.testing.assert_array_equal(d.numpy(), t.numpy())
+
+    def test_set_value(self):
+        t = paddle.zeros([2, 2])
+        t.set_value(np.ones((2, 2), np.float32))
+        assert t.numpy().sum() == 4
+        with pytest.raises(ValueError):
+            t.set_value(np.ones((3, 3), np.float32))
+
+    def test_parameter(self):
+        p = paddle.core.Parameter(np.zeros((2, 2), np.float32))
+        assert not p.stop_gradient
+        assert p.trainable
+        p.trainable = False
+        assert p.stop_gradient
+
+    def test_arith_dunders(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        y = paddle.to_tensor([3.0, 4.0])
+        assert (x + y).tolist() == [4.0, 6.0]
+        assert (x - y).tolist() == [-2.0, -2.0]
+        assert (x * y).tolist() == [3.0, 8.0]
+        assert (y / x).tolist() == [3.0, 2.0]
+        assert (2.0 * x).tolist() == [2.0, 4.0]
+        assert (1.0 - x).tolist() == [0.0, -1.0]
+        assert (x ** 2).tolist() == [1.0, 4.0]
+        assert (-x).tolist() == [-1.0, -2.0]
+        assert (x == y).tolist() == [False, False]
+        assert (x < y).tolist() == [True, True]
+
+    def test_matmul_dunder(self):
+        a = paddle.ones([2, 3])
+        b = paddle.ones([3, 4])
+        assert (a @ b).shape == [2, 4]
+
+    def test_getitem_setitem(self):
+        t = paddle.arange(12).reshape([3, 4])
+        assert t[0].tolist() == [0, 1, 2, 3]
+        assert t[-1, -1].item() == 11
+        assert t[0:2, 1].tolist() == [1, 5]
+        mask_sel = t[paddle.to_tensor([0, 2])]
+        assert mask_sel.shape == [2, 4]
+        t2 = paddle.zeros([3, 3])
+        t2[1, 1] = 7.0
+        assert t2.numpy()[1, 1] == 7.0
+
+    def test_iter(self):
+        rows = list(paddle.arange(6).reshape([2, 3]))
+        assert len(rows) == 2
+        assert rows[1].tolist() == [3, 4, 5]
+
+    def test_inplace_ops(self):
+        t = paddle.to_tensor([1.0, 2.0])
+        t.add_(1.0)
+        assert t.tolist() == [2.0, 3.0]
+        t.scale_(2.0)
+        assert t.tolist() == [4.0, 6.0]
+        t.zero_()
+        assert t.tolist() == [0.0, 0.0]
+
+    def test_clone_grad_flows(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x.clone() * 3
+        y.backward()
+        assert x.grad.tolist() == [3.0]
+
+    def test_to_dtype(self):
+        t = paddle.ones([2]).to("bfloat16")
+        assert t.dtype == paddle.bfloat16
+
+    def test_place(self):
+        t = paddle.ones([2])
+        assert t.place.device_type in ("cpu", "tpu")
+
+    def test_is_tensor(self):
+        assert paddle.is_tensor(paddle.ones([1]))
+        assert not paddle.is_tensor(np.ones(1))
