@@ -1,1 +1,73 @@
+"""`paddle.nn` surface (reference: python/paddle/nn/__init__.py)."""
 
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .initializer.attr import ParamAttr  # noqa: F401
+from .layer.layers import Layer  # noqa: F401
+from .layer.common import (  # noqa: F401
+    AlphaDropout, Bilinear, ChannelShuffle, CosineSimilarity, Dropout,
+    Dropout2D, Dropout3D, Embedding, Flatten, Fold, Identity, Linear,
+    Pad1D, Pad2D, Pad3D, PairwiseDistance, PixelShuffle, PixelUnshuffle,
+    Unflatten, Unfold, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    ZeroPad2D,
+)
+from .layer.activation import (  # noqa: F401
+    CELU, ELU, GELU, GLU, Hardshrink, Hardsigmoid, Hardswish, Hardtanh,
+    LeakyReLU, LogSigmoid, LogSoftmax, Maxout, Mish, PReLU, ReLU, ReLU6,
+    RReLU, SELU, Sigmoid, SiLU, Softmax, Softplus, Softshrink, Softsign,
+    Swish, Tanh, Tanhshrink, ThresholdedReLU,
+)
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,
+    InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
+    LocalResponseNorm, RMSNorm, SpectralNorm, SyncBatchNorm,
+)
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D,
+    Conv3DTranspose,
+)
+from .layer.pooling import (  # noqa: F401
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
+    AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+)
+from .layer.container import (  # noqa: F401
+    LayerDict, LayerList, ParameterList, Sequential,
+)
+from .layer.loss import (  # noqa: F401
+    BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss, CrossEntropyLoss,
+    CTCLoss, GaussianNLLLoss, HingeEmbeddingLoss, KLDivLoss, L1Loss,
+    MarginRankingLoss, MSELoss, MultiLabelSoftMarginLoss, NLLLoss,
+    PoissonNLLLoss, SmoothL1Loss, SoftMarginLoss, TripletMarginLoss,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerDecoder,
+    TransformerDecoderLayer, TransformerEncoder, TransformerEncoderLayer,
+)
+
+from ..core.tensor import Parameter  # noqa: F401
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip over ``parameters`` (utility
+    parity: python/paddle/nn/utils/clip_grad_norm_.py)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros([], jnp.float32))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p.grad._data)) for p in params]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(p.grad._data.astype(jnp.float32)),
+                                  norm_type)) for p in params),
+            1.0 / norm_type)
+    clip_coef = jnp.clip(max_norm / (total + 1e-6), a_max=1.0)
+    for p in params:
+        p.grad._rebind((p.grad._data.astype(jnp.float32) *
+                        clip_coef).astype(p.grad.dtype))
+    return Tensor(total)

@@ -1,0 +1,188 @@
+"""Convolution functionals.
+
+Parity: reference `python/paddle/nn/functional/conv.py` (conv1d/2d/3d and
+transpose variants over phi conv kernels, `paddle/phi/kernels/gpu/
+conv_kernel.cu` + cuDNN). TPU-first: one `lax.conv_general_dilated` call —
+XLA lowers it onto the MXU directly, picking layouts itself (no cuDNN-style
+algorithm search or layout autotuning needed).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import apply
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 1:
+            return tuple(v) * n
+        assert len(v) == n, f"expected {n} values, got {v}"
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding_pairs(padding, n, kernel, dilation):
+    """Normalize paddle's padding forms to lax pairs.
+
+    Accepts int, per-dim ints, explicit lo/hi pairs, or "SAME"/"VALID".
+    """
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p == "VALID":
+            return [(0, 0)] * n
+        if p == "SAME":
+            pairs = []
+            for k, d in zip(kernel, dilation):
+                eff = d * (k - 1)
+                pairs.append((eff // 2, eff - eff // 2))
+            return pairs
+        raise ValueError(f"unknown padding {padding!r}")
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == n and all(
+            isinstance(p, (list, tuple)) and len(p) == 2 for p in padding):
+        return [tuple(p) for p in padding]
+    if len(padding) == 2 * n:  # flat lo/hi list
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    raise ValueError(f"bad padding {padding!r} for {n} spatial dims")
+
+
+def _conv_nd(n, x, weight, bias, stride, padding, dilation, groups,
+             data_format, name):
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    spatial = {1: "W", 2: "HW", 3: "DHW"}[n]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    out_spec = lhs_spec
+    dn = (lhs_spec, "OI" + spatial, out_spec)
+
+    def fwd(a, w, *rest):
+        kshape = w.shape[2:]
+        pads = _padding_pairs(padding, n, kshape, dilation)
+        out = lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pads,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=dn,
+            preferred_element_type=None)
+        if rest:
+            b = rest[0]
+            bshape = [1] * out.ndim
+            bshape[lhs_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(bshape)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(fwd, *args, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(1, x, weight, bias, stride, padding, dilation, groups,
+                    data_format, name or "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(2, x, weight, bias, stride, padding, dilation, groups,
+                    data_format, name or "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(3, x, weight, bias, stride, padding, dilation, groups,
+                    data_format, name or "conv3d")
+
+
+def _conv_transpose_nd(n, x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, data_format, output_size, name):
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    output_padding = _tuplize(output_padding, n)
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    spatial = {1: "W", 2: "HW", 3: "DHW"}[n]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    dn = (lhs_spec, "OI" + spatial, lhs_spec)
+
+    def fwd(a, w, *rest):
+        # paddle/torch transpose-conv weight layout: [in, out//groups, *k].
+        kshape = w.shape[2:]
+        pads_in = _padding_pairs(padding, n, kshape, dilation)
+        # gradient-of-conv padding: d*(k-1) - p, plus output_padding on hi.
+        pads = [
+            (d * (k - 1) - lo, d * (k - 1) - hi + op)
+            for (lo, hi), k, d, op in zip(
+                pads_in, kshape, dilation, output_padding)
+        ]
+        # Flip spatial dims, then swap to OIHW with O=out_channels.
+        w_f = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups == 1:
+            w_oi = jnp.swapaxes(w_f, 0, 1)  # [out, in, *k]
+            return lax.conv_general_dilated(
+                a, w_oi, window_strides=(1,) * n, padding=pads,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn)
+        # grouped: split input channels & kernel per group, conv, concat.
+        cin = w.shape[0]
+        gsize = cin // groups
+        c_axis = lhs_spec.index("C")
+        outs = []
+        for g in range(groups):
+            a_g = lax.slice_in_dim(a, g * gsize, (g + 1) * gsize, axis=c_axis)
+            w_g = jnp.swapaxes(w_f[g * gsize:(g + 1) * gsize], 0, 1)
+            outs.append(lax.conv_general_dilated(
+                a_g, w_g, window_strides=(1,) * n, padding=pads,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn))
+        return jnp.concatenate(outs, axis=c_axis)
+
+    def with_bias(a, w, b):
+        out = fwd(a, w)
+        bshape = [1] * out.ndim
+        bshape[lhs_spec.index("C")] = b.shape[0]
+        return out + b.reshape(bshape)
+
+    out = apply(with_bias if bias is not None else fwd,
+                *((x, weight, bias) if bias is not None else (x, weight)),
+                name=name)
+    if output_size is not None:
+        sizes = _tuplize(output_size, n)
+        # crop/verify to requested size (paddle semantics)
+        slices = [slice(None)] * out.ndim
+        off = 1 if not channel_last else 1
+        start = 2 if not channel_last else 1
+        for i, s in enumerate(sizes):
+            ax = (start + i) if not channel_last else (1 + i)
+            slices[ax] = slice(0, s)
+        out = out[tuple(slices)]
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose_nd(1, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              output_size, name or "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_nd(2, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              output_size, name or "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(3, x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              output_size, name or "conv3d_transpose")

@@ -1,0 +1,211 @@
+"""Pooling functionals.
+
+Parity: reference `python/paddle/nn/functional/pooling.py` (phi pool
+kernels `paddle/phi/kernels/funcs/pooling.h`). TPU-first: all pooling is
+`lax.reduce_window`, which XLA fuses/vectorizes on the VPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import apply
+from .conv import _padding_pairs, _tuplize
+
+
+def _pool_nd(n, x, kernel_size, stride, padding, reducer, init, data_format,
+             ceil_mode=False, name="pool", count_include_pad=True,
+             average=False):
+    kernel = _tuplize(kernel_size, n)
+    stride = _tuplize(stride if stride is not None else kernel_size, n)
+    channel_last = data_format in ("NLC", "NHWC", "NDHWC")
+    pads = _padding_pairs(padding, n, kernel, (1,) * n)
+    if ceil_mode:
+        # extend hi padding so the last partial window is included
+        pads = [(lo, hi + s - 1) for (lo, hi), s in zip(pads, stride)]
+
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padcfg = [(0, 0)] + pads + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padcfg = [(0, 0), (0, 0)] + pads
+
+    def fwd(a):
+        out = lax.reduce_window(a, jnp.asarray(init, a.dtype), reducer,
+                                window, strides, padcfg)
+        if average:
+            if count_include_pad:
+                denom = np.prod(kernel).astype(np.float32)
+                out = out / jnp.asarray(denom, a.dtype)
+            else:
+                ones = jnp.ones(a.shape, a.dtype)
+                counts = lax.reduce_window(
+                    ones, jnp.asarray(0, a.dtype), lax.add, window, strides,
+                    padcfg)
+                out = out / counts
+        return out
+
+    return apply(fwd, x, name=name)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(1, x, kernel_size, stride, padding, lax.add, 0,
+                    data_format, ceil_mode, name or "avg_pool1d",
+                    count_include_pad=not exclusive, average=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    out = _pool_nd(2, x, kernel_size, stride, padding, lax.add, 0,
+                   data_format, ceil_mode, name or "avg_pool2d",
+                   count_include_pad=not exclusive, average=True)
+    if divisor_override is not None:
+        kernel = _tuplize(kernel_size, 2)
+        out = out * (float(np.prod(kernel)) / divisor_override)
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    out = _pool_nd(3, x, kernel_size, stride, padding, lax.add, 0,
+                   data_format, ceil_mode, name or "avg_pool3d",
+                   count_include_pad=not exclusive, average=True)
+    if divisor_override is not None:
+        kernel = _tuplize(kernel_size, 3)
+        out = out * (float(np.prod(kernel)) / divisor_override)
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool_nd(1, x, kernel_size, stride, padding, lax.max, -np.inf,
+                   data_format, ceil_mode, name or "max_pool1d")
+    if return_mask:
+        return out, _pool_indices(1, x, kernel_size, stride, padding,
+                                  ceil_mode, data_format)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool_nd(2, x, kernel_size, stride, padding, lax.max, -np.inf,
+                   data_format, ceil_mode, name or "max_pool2d")
+    if return_mask:
+        return out, _pool_indices(2, x, kernel_size, stride, padding,
+                                  ceil_mode, data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool_nd(3, x, kernel_size, stride, padding, lax.max, -np.inf,
+                   data_format, ceil_mode, name or "max_pool3d")
+    if return_mask:
+        return out, _pool_indices(3, x, kernel_size, stride, padding,
+                                  ceil_mode, data_format)
+    return out
+
+
+def _pool_indices(n, x, kernel_size, stride, padding, ceil_mode, data_format):
+    """Argmax indices (flattened per spatial plane), paddle's return_mask."""
+    from ...core.tensor import Tensor
+
+    kernel = _tuplize(kernel_size, n)
+    stride = _tuplize(stride if stride is not None else kernel_size, n)
+    pads = _padding_pairs(padding, n, kernel, (1,) * n)
+    if ceil_mode:
+        pads = [(lo, hi + s - 1) for (lo, hi), s in zip(pads, stride)]
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    spatial_shape = a.shape[2:]
+    flat_idx = jnp.arange(int(np.prod(spatial_shape)),
+                          dtype=jnp.int32).reshape(spatial_shape)
+    flat_idx = jnp.broadcast_to(flat_idx, a.shape)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padcfg = [(0, 0), (0, 0)] + pads
+
+    def select(acc, cur):
+        acc_v, acc_i = acc
+        cur_v, cur_i = cur
+        take_cur = cur_v > acc_v
+        return (jnp.where(take_cur, cur_v, acc_v),
+                jnp.where(take_cur, cur_i, acc_i))
+
+    _, idx = lax.reduce_window(
+        (a, flat_idx),
+        (jnp.asarray(-np.inf, a.dtype), jnp.asarray(-1, jnp.int32)),
+        select, window, strides, padcfg)
+    return Tensor(idx)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(1, x, output_size, "avg", name or "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(2, x, output_size, "avg", name or "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(3, x, output_size, "avg", name or "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(1, x, output_size, "max", name or "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(2, x, output_size, "max", name or "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(3, x, output_size, "max", name or "adaptive_max_pool3d")
+
+
+def _adaptive(n, x, output_size, mode, name):
+    """Adaptive pooling via per-output-bin mean/max.
+
+    When input size divides evenly we reduce to plain pooling (the common
+    case, fully static for XLA); otherwise falls back to bin-gather.
+    """
+    out_sizes = _tuplize(output_size, n)
+
+    def fwd(a):
+        spatial = a.shape[2:]
+        res = a
+        if all(o is None or s % o == 0 for s, o in zip(spatial, out_sizes)):
+            kernel = tuple(1 if o is None else s // o
+                           for s, o in zip(spatial, out_sizes))
+            window = (1, 1) + kernel
+            if mode == "avg":
+                out = lax.reduce_window(res, jnp.asarray(0, a.dtype), lax.add,
+                                        window, window,
+                                        [(0, 0)] * (n + 2))
+                return out / jnp.asarray(np.prod(kernel), a.dtype)
+            return lax.reduce_window(res, jnp.asarray(-np.inf, a.dtype),
+                                     lax.max, window, window,
+                                     [(0, 0)] * (n + 2))
+        # uneven bins: gather each bin (static python loop — small outputs)
+        for dim in range(n):
+            s = res.shape[2 + dim]
+            o = out_sizes[dim] if out_sizes[dim] is not None else s
+            starts = [int(np.floor(i * s / o)) for i in range(o)]
+            ends = [int(np.ceil((i + 1) * s / o)) for i in range(o)]
+            pieces = []
+            for st, en in zip(starts, ends):
+                seg = lax.slice_in_dim(res, st, en, axis=2 + dim)
+                red = (jnp.mean if mode == "avg" else jnp.max)(
+                    seg, axis=2 + dim, keepdims=True)
+                pieces.append(red)
+            res = jnp.concatenate(pieces, axis=2 + dim)
+        return res
+
+    return apply(fwd, x, name=name)
