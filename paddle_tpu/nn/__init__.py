@@ -46,6 +46,9 @@ from .layer.transformer import (  # noqa: F401
 )
 
 from ..core.tensor import Parameter  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+)
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,

@@ -1,0 +1,103 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py).
+
+Each clipper exposes ``_apply(params)`` mutating ``.grad`` in place (the
+optimizer calls it before the update) — same hook point as the reference's
+`_create_optimization_pass` grad-clip stage. Under hybrid parallelism the
+distributed optimizer wraps ClipGradByGlobalNorm to take the norm across
+mesh axes (reference hybrid_parallel_optimizer.py:255 semantics — with
+GSPMD-sharded grads jnp.sum already reduces globally).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def _apply(self, params):
+        raise NotImplementedError
+
+    def _clip_arrays(self, grads, need_clip=None):
+        """Pure form over jnp arrays (used by the compiled train step);
+        ``need_clip`` is an optional bool list aligned with ``grads``."""
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        # functional form: list[(param, grad)] -> list[(param, grad)]
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _apply(self, params):
+        for p in params:
+            if p.grad is None or not p.need_clip:
+                continue
+            p.grad._rebind(jnp.clip(p.grad._data, self.min, self.max))
+
+    def _clip_arrays(self, grads, need_clip=None):
+        need_clip = need_clip or [True] * len(grads)
+        return [jnp.clip(g, self.min, self.max) if nc else g
+                for g, nc in zip(grads, need_clip)]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _apply(self, params):
+        for p in params:
+            if p.grad is None or not p.need_clip:
+                continue
+            g = p.grad._data.astype(jnp.float32)
+            norm = jnp.sqrt(jnp.sum(g * g))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            p.grad._rebind((g * scale).astype(p.grad.dtype))
+
+    def _clip_arrays(self, grads, need_clip=None):
+        need_clip = need_clip or [True] * len(grads)
+        out = []
+        for g, nc in zip(grads, need_clip):
+            if not nc:
+                out.append(g)
+                continue
+            g32 = g.astype(jnp.float32)
+            norm = jnp.sqrt(jnp.sum(g32 * g32))
+            scale = jnp.minimum(
+                self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((g32 * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _apply(self, params):
+        grads = [p.grad for p in params
+                 if p.grad is not None and p.need_clip]
+        if not grads:
+            return
+        sq = sum(jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+                 for g in grads)
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        for g in grads:
+            g._rebind((g._data.astype(jnp.float32) * scale).astype(g.dtype))
+
+    def _clip_arrays(self, grads, need_clip=None):
+        need_clip = need_clip or [True] * len(grads)
+        active = [g for g, nc in zip(grads, need_clip) if nc]
+        if not active:
+            return list(grads)
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in active)
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype) if nc else g
+                for g, nc in zip(grads, need_clip)]

@@ -1,1 +1,7 @@
+"""`paddle.optimizer` surface (reference: python/paddle/optimizer/)."""
 
+from . import lr  # noqa: F401
+from .adam import Adam, Adamax, AdamW, Lamb, NAdam, RAdam  # noqa: F401
+from .optimizer import (  # noqa: F401
+    ASGD, Adadelta, Adagrad, Momentum, Optimizer, RMSProp, Rprop, SGD,
+)

@@ -1,0 +1,381 @@
+"""Optimizer base + classic optimizers.
+
+Parity: reference `python/paddle/optimizer/optimizer.py` (base class,
+accumulator management, `_create_optimization_pass`) and the per-optimizer
+files (sgd.py, momentum.py, adagrad.py, ...). TPU-first: every update rule
+is a pure function ``_update(p, g, state, lr) -> (new_p, new_state)`` over
+jax arrays, so the compiled train step (`paddle_tpu.jit`) can trace the
+exact same math into one fused XLA program (the analogue of the reference's
+fused_adam/multi_tensor kernels — XLA does the fusion).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+
+def _as_float_or_none(wd):
+    """Normalize weight_decay (float | L1Decay | L2Decay | None)."""
+    if wd is None:
+        return None, None
+    from ..regularizer import L1Decay, L2Decay
+    if isinstance(wd, L1Decay):
+        return "l1", float(wd.coeff)
+    if isinstance(wd, L2Decay):
+        return "l2", float(wd.coeff)
+    return "l2", float(wd)
+
+
+class Optimizer:
+    """Base optimizer.
+
+    ``parameters`` may be a list of Parameters or a list of dicts
+    (param groups, paddle semantics: each dict has 'params' plus overrides
+    like 'learning_rate' multiplier or 'weight_decay').
+    """
+
+    # names of per-param slot arrays, e.g. ("moment1", "moment2")
+    _slot_names: tuple = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required (eager mode, reference "
+                "python/paddle/optimizer/optimizer.py:262 semantics)")
+        self._lr = learning_rate
+        if isinstance(learning_rate, LRScheduler):
+            self._lr_scheduler = learning_rate
+        else:
+            self._lr_scheduler = None
+        self._groups = self._build_groups(parameters, weight_decay)
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # id(param) -> dict(slot name -> jnp array); master weights too
+        self._state: dict[int, dict] = {}
+        self._global_step = 0
+        # step counter visible to _update: python int eagerly, traced
+        # jnp scalar inside the compiled train step
+        self._t = 0
+
+    def _build_groups(self, parameters, weight_decay):
+        wd_mode, wd = _as_float_or_none(weight_decay)
+        params = list(parameters)
+        if params and isinstance(params[0], dict):
+            groups = []
+            for g in params:
+                gm, gw = _as_float_or_none(g.get("weight_decay"))
+                groups.append({
+                    "params": list(g["params"]),
+                    "lr_mult": float(g.get("learning_rate", 1.0)),
+                    "wd_mode": gm if g.get("weight_decay") is not None
+                    else wd_mode,
+                    "weight_decay": gw if g.get("weight_decay") is not None
+                    else wd,
+                })
+            return groups
+        return [{"params": params, "lr_mult": 1.0, "wd_mode": wd_mode,
+                 "weight_decay": wd}]
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self):
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler.get_lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if self._lr_scheduler is not None:
+            raise RuntimeError(
+                "cannot set_lr when the learning rate is an LRScheduler")
+        self._lr = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr_scheduler = scheduler
+
+    # -- state -------------------------------------------------------------
+    def _slots_for(self, p):
+        key = id(p)
+        if key not in self._state:
+            st = {}
+            pdata = p._data
+            needs_master = (self._multi_precision and
+                            pdata.dtype in (jnp.bfloat16, jnp.float16))
+            master = pdata.astype(jnp.float32) if needs_master else None
+            st["master"] = master
+            for nm in self._slot_names:
+                st[nm] = self._init_slot(nm, pdata)
+            self._state[key] = st
+        return self._state[key]
+
+    def _init_slot(self, name, pdata):
+        return jnp.zeros(pdata.shape, jnp.float32)
+
+    # -- the update rule (override) ---------------------------------------
+    def _update(self, p, g, state, lr):
+        """Pure update: (fp32 param, fp32 grad, slot dict, lr) ->
+        (new fp32 param, new slot dict)."""
+        raise NotImplementedError
+
+    def _decay_grad(self, p, g, group):
+        """Apply regularization-style decay into the gradient (L1/L2 coupled
+        decay, paddle regularizer semantics). Decoupled decay (AdamW)
+        overrides _decoupled_decay instead."""
+        if group["wd_mode"] == "l2" and not self._decoupled:
+            return g + group["weight_decay"] * p
+        if group["wd_mode"] == "l1" and not self._decoupled:
+            return g + group["weight_decay"] * jnp.sign(p)
+        return g
+
+    _decoupled = False
+
+    def _apply_param(self, p32, g, st, lr_p, group, param=None):
+        """Pure single-param update (shared by eager step() and the traced
+        compiled step — `self._t` is a python int eagerly, a traced scalar
+        under jit)."""
+        if group["weight_decay"]:
+            g = self._decay_grad(p32, g, group)
+        new_st = dict(st)
+        new_p, new_st = self._update(p32, g, new_st, lr_p)
+        if group["weight_decay"] and self._decoupled and \
+                self._wants_decay(param):
+            new_p = new_p - lr_p * group["weight_decay"] * p32
+        return new_p, new_st
+
+    def _wants_decay(self, param):
+        return True
+
+    # -- driver ------------------------------------------------------------
+    @property
+    def _parameter_list(self):
+        return [p for g in self._groups for p in g["params"]]
+
+    def _param_groups_flat(self):
+        """[(param, group)] in stable order."""
+        return [(p, g) for g in self._groups for p in g["params"]]
+
+    def step(self):
+        self._global_step += 1
+        self._t = self._global_step
+        if self._grad_clip is not None:
+            self._grad_clip._apply(self._parameter_list)
+        for group in self._groups:
+            lr = self.get_lr() * group["lr_mult"]
+            for p in group["params"]:
+                if p.grad is None or p.stop_gradient:
+                    continue
+                lr_p = lr * p.optimize_attr.get("learning_rate", 1.0)
+                st = self._slots_for(p)
+                p32 = st["master"] if st["master"] is not None \
+                    else p._data.astype(jnp.float32)
+                g = p.grad._data.astype(jnp.float32)
+                new_p, new_st = self._apply_param(p32, g, st, lr_p, group,
+                                                  param=p)
+                if st["master"] is not None:
+                    new_st["master"] = new_p
+                p._rebind(new_p.astype(p._data.dtype))
+                self._state[id(p)] = new_st
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # -- serialization -----------------------------------------------------
+    def state_dict(self):
+        sd = OrderedDict()
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            st = self._state.get(id(p))
+            if st is None:
+                continue
+            for nm, arr in st.items():
+                if arr is not None:
+                    sd[f"{key}.{nm}"] = Tensor(arr)
+        sd["global_step"] = self._global_step
+        if self._lr_scheduler is not None:
+            sd["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        if self._lr_scheduler is not None and "LR_Scheduler" in state_dict:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            key = p.name or f"param_{i}"
+            st = self._slots_for(p)
+            for nm in list(st.keys()):
+                k = f"{key}.{nm}"
+                if k in state_dict:
+                    v = state_dict[k]
+                    st[nm] = v._data if isinstance(v, Tensor) else \
+                        jnp.asarray(v)
+
+
+class SGD(Optimizer):
+    """reference python/paddle/optimizer/sgd.py"""
+
+    def _update(self, p, g, state, lr):
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    """reference python/paddle/optimizer/momentum.py (use_nesterov opt)."""
+
+    _slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update(self, p, g, state, lr):
+        v = self._momentum * state["velocity"] + g
+        state["velocity"] = v
+        if self._nesterov:
+            return p - lr * (g + self._momentum * v), state
+        return p - lr * v, state
+
+
+class Adagrad(Optimizer):
+    """reference python/paddle/optimizer/adagrad.py"""
+
+    _slot_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._epsilon = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_slot(self, name, pdata):
+        return jnp.full(pdata.shape, self._init_val, jnp.float32)
+
+    def _update(self, p, g, state, lr):
+        m = state["moment"] + g * g
+        state["moment"] = m
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon), state
+
+
+class Adadelta(Optimizer):
+    """reference python/paddle/optimizer/adadelta.py"""
+
+    _slot_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _update(self, p, g, state, lr):
+        rho, eps = self._rho, self._epsilon
+        sg = rho * state["avg_squared_grad"] + (1 - rho) * g * g
+        upd = g * jnp.sqrt(state["avg_squared_update"] + eps) / \
+            jnp.sqrt(sg + eps)
+        su = rho * state["avg_squared_update"] + (1 - rho) * upd * upd
+        state["avg_squared_grad"] = sg
+        state["avg_squared_update"] = su
+        return p - lr * upd, state
+
+
+class RMSProp(Optimizer):
+    """reference python/paddle/optimizer/rmsprop.py"""
+
+    _slot_names = ("mean_square", "mean_grad", "momentum_acc")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _update(self, p, g, state, lr):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * state["mean_square"] + (1 - rho) * g * g
+        state["mean_square"] = ms
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            state["mean_grad"] = mg
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            denom = jnp.sqrt(ms + eps)
+        step = lr * g / denom
+        if self._momentum > 0:
+            acc = self._momentum * state["momentum_acc"] + step
+            state["momentum_acc"] = acc
+            step = acc
+        return p - step, state
+
+
+class Rprop(Optimizer):
+    """reference python/paddle/optimizer/rprop.py"""
+
+    _slot_names = ("prev_grad", "lr_per_elem")
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _init_slot(self, name, pdata):
+        if name == "lr_per_elem":
+            return jnp.full(pdata.shape, float(self._lr), jnp.float32)
+        return jnp.zeros(pdata.shape, jnp.float32)
+
+    def _update(self, p, g, state, lr):
+        eta_minus, eta_plus = self._etas
+        lo, hi = self._lr_range
+        sign = jnp.sign(g * state["prev_grad"])
+        factor = jnp.where(sign > 0, eta_plus,
+                           jnp.where(sign < 0, eta_minus, 1.0))
+        lrs = jnp.clip(state["lr_per_elem"] * factor, lo, hi)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        state["lr_per_elem"] = lrs
+        state["prev_grad"] = g_eff
+        return p - lrs * jnp.sign(g_eff), state
+
+
+class ASGD(Optimizer):
+    """reference python/paddle/optimizer/asgd.py (averaged SGD)."""
+
+    _slot_names = ("d", "ys", "avg")
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+
+    def _update(self, p, g, state, lr):
+        new_p = p - lr * g
+        n = float(self._global_step)
+        state["avg"] = state["avg"] + (new_p - state["avg"]) / n
+        return new_p, state
