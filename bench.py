@@ -1,0 +1,104 @@
+"""Driver benchmark: GPT-2 345M LM pretrain step throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md). The agreed
+comparator is the north-star "match or beat A100 MFU" (BASELINE.json): we
+take 40% MFU — a strong published A100 result for Megatron-class GPT-345M
+pretraining — as the baseline MFU, and report vs_baseline = our_MFU / 0.40.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak FLOP/s per chip by device generation
+PEAK_BF16 = {
+    "v5e": 197e12,  # TPU v5e (v5litepod)
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+    "v3": 123e12,
+    "cpu": 1e12,  # nominal, so the script still runs off-TPU
+}
+
+BASELINE_MFU = 0.40  # A100 MFU comparator (see module docstring)
+
+
+def detect_peak():
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "cpu").lower()
+    for k, v in PEAK_BF16.items():
+        if k in kind:
+            return k, v
+    import os
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    if gen in PEAK_BF16:
+        return gen, PEAK_BF16[gen]
+    return kind or "cpu", PEAK_BF16["cpu"]
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.models import GPT, GPTConfig
+
+    on_tpu = jax.default_backend() != "cpu"
+    if on_tpu:
+        batch, seq = 8, 1024
+        config = GPTConfig.gpt2_medium()
+        steps = 10
+    else:  # smoke mode off-TPU
+        batch, seq = 2, 64
+        config = GPTConfig.tiny()
+        steps = 3
+
+    paddle.seed(0)
+    model = GPT(config)
+    if on_tpu:
+        model.to(dtype="bfloat16")  # params bf16; AdamW keeps fp32 masters
+    opt = optimizer.AdamW(learning_rate=3e-4, parameters=model.parameters(),
+                          grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    step = paddle.jit.TrainStep(model, opt,
+                                lambda m, ids: m.loss(ids, ids))
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(
+        rng.integers(0, config.vocab_size, (batch, seq)).astype("int64"))
+
+    # warmup (compile). NB: sync via host fetch — on the axon remote relay
+    # block_until_ready can return before the chain finishes executing.
+    loss = step(ids)
+    loss = step(ids)
+    loss_val = float(np.asarray(loss._data))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids)
+    loss_val = float(np.asarray(loss._data))
+    dt = time.perf_counter() - t0
+
+    tokens_per_s = batch * seq * steps / dt
+    flops_tok = model.flops_per_token(seq)
+    kind, peak = detect_peak()
+    mfu = tokens_per_s * flops_tok / peak
+
+    print(json.dumps({
+        "metric": "gpt2_345m_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / BASELINE_MFU, 4),
+        "mfu": round(mfu, 4),
+        "device": kind,
+        "step_time_ms": round(1000 * dt / steps, 2),
+        "loss": loss_val,
+        "batch": batch, "seq": seq,
+        "params": model.num_params(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

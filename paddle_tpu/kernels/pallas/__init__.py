@@ -1,0 +1,2 @@
+"""Pallas TPU kernels — the analogue of the reference's hand-written CUDA
+fusion library (`paddle/phi/kernels/fusion/`, SURVEY.md §2.1)."""

@@ -1,0 +1,55 @@
+"""Flash attention on TPU via Pallas.
+
+Capability parity with the reference's FA2 integration
+(`paddle/phi/kernels/gpu/flash_attn_kernel.cu:128` dynload to the vendored
+flashattn lib). On TPU the equivalent "vendor kernel" is a Pallas kernel
+tiled for the MXU; we use the canonical Pallas flash-attention kernel that
+ships with JAX (fwd + custom-vjp bwd), adapted to paddle's [B, S, H, D]
+layout. Sequence/context-parallel ring attention builds on top of this in
+paddle_tpu/distributed.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention as _pallas_mha)
+    HAVE_PALLAS_FA = True
+except ImportError:  # pragma: no cover
+    HAVE_PALLAS_FA = False
+
+
+def _block_sizes(seq_q, seq_k, head_dim):
+    blk = 512
+    return BlockSizes(
+        block_q=min(blk, seq_q), block_k_major=min(blk, seq_k),
+        block_k=min(blk, seq_k), block_b=1,
+        block_q_major_dkv=min(blk, seq_q), block_k_major_dkv=min(blk, seq_k),
+        block_k_dkv=min(blk, seq_k), block_q_dkv=min(blk, seq_q),
+        block_k_major_dq=min(blk, seq_k), block_k_dq=min(blk, seq_k),
+        block_q_dq=min(blk, seq_q),
+    )
+
+
+def flash_attention_fwd(q, k, v, causal=False, scale=None):
+    """q/k/v: [batch, seq, heads, head_dim] arrays (post-GQA-expansion).
+    Returns [batch, seq, heads, head_dim]. Differentiable (the underlying
+    kernel carries a custom VJP with dq/dk/dv Pallas kernels)."""
+    if not HAVE_PALLAS_FA:
+        raise ImportError("pallas flash attention unavailable")
+    d = q.shape[-1]
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # [B,S,H,D] -> [B,H,S,D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _pallas_mha(
+        qt, kt, vt, causal=causal, sm_scale=sm_scale,
+        block_sizes=_block_sizes(qt.shape[2], kt.shape[2], d))
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)

@@ -1,0 +1,5 @@
+"""Model zoo: LLM families mirroring the reference's headline workloads
+(BASELINE.json config ladder: GPT-2, Llama, Mixtral/MoE, ViT)."""
+
+from .gpt import GPT, GPTConfig  # noqa: F401
+from .llama import Llama, LlamaConfig  # noqa: F401

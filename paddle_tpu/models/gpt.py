@@ -1,0 +1,174 @@
+"""GPT-2 family.
+
+Capability parity with the reference's GPT workloads (PaddleNLP GPT trained
+through paddle.nn / fleet; in-repo analogues: the transformer layers of
+`python/paddle/nn/layer/transformer.py` and the semi_auto_parallel llama/gpt
+tests under `test/auto_parallel/hybrid_strategy/`). TPU-first choices:
+- pre-LN residual blocks, learned positional embeddings (GPT-2);
+- attention through F.scaled_dot_product_attention → Pallas flash kernel;
+- a single weight-tied [vocab, d] embedding used for both lookup and the
+  LM head matmul (one big MXU matmul, bf16-friendly);
+- no data-dependent python control flow — the whole forward traces into
+  one XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304  # padded to a 128-multiple for the MXU
+    max_position_embeddings: int = 1024
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = None
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @staticmethod
+    def gpt2_small():
+        return GPTConfig(hidden_size=768, num_layers=12, num_heads=12)
+
+    @staticmethod
+    def gpt2_medium():  # the 345M PR1 reference config
+        return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16)
+
+    @staticmethod
+    def gpt2_large():
+        return GPTConfig(hidden_size=1280, num_layers=36, num_heads=20)
+
+    @staticmethod
+    def tiny():  # test-sized
+        return GPTConfig(vocab_size=256, max_position_embeddings=64,
+                         hidden_size=64, num_layers=2, num_heads=4)
+
+
+def _normal_attr(std):
+    return nn.ParamAttr(initializer=nn.initializer.Normal(0.0, std))
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        d, h = config.hidden_size, config.num_heads
+        self.num_heads = h
+        self.head_dim = d // h
+        std = config.initializer_range
+        proj_std = std / math.sqrt(2 * config.num_layers)
+        self.qkv_proj = nn.Linear(d, 3 * d, weight_attr=_normal_attr(std))
+        self.out_proj = nn.Linear(d, d, weight_attr=_normal_attr(proj_std))
+        self.dropout = config.dropout
+
+    def forward(self, x):
+        from .. import ops
+        b, s, d = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = ops.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = ops.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.dropout if self.training else 0.0)
+        out = ops.reshape(out, [b, s, d])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        d = config.hidden_size
+        std = config.initializer_range
+        proj_std = std / math.sqrt(2 * config.num_layers)
+        self.fc_in = nn.Linear(d, config.intermediate_size,
+                               weight_attr=_normal_attr(std))
+        self.fc_out = nn.Linear(config.intermediate_size, d,
+                                weight_attr=_normal_attr(proj_std))
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x), approximate=True))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        return x
+
+
+class GPT(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        std = config.initializer_range
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size,
+                                weight_attr=_normal_attr(std))
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size,
+                                weight_attr=_normal_attr(std))
+        self.drop = nn.Dropout(config.dropout)
+        self.h = nn.LayerList([GPTBlock(config)
+                               for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+        if not config.tie_word_embeddings:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     weight_attr=_normal_attr(std),
+                                     bias_attr=False)
+        else:
+            self.lm_head = None
+
+    def forward(self, input_ids):
+        from .. import ops
+        b, s = input_ids.shape
+        pos = ops.arange(0, s, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x)
+        x = self.ln_f(x)
+        if self.lm_head is not None:
+            return self.lm_head(x)
+        # weight-tied head: [b,s,d] @ [d,vocab]
+        return ops.matmul(x, self.wte.weight, transpose_y=True)
+
+    def loss(self, input_ids, labels):
+        """Next-token cross entropy; labels already shifted or equal to
+        input_ids (we shift internally)."""
+        logits = self(input_ids)
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        return F.cross_entropy(shift_logits, shift_labels)
+
+    def num_params(self, non_embedding=True):
+        n = sum(p.size for p in self.parameters())
+        if non_embedding:
+            n -= self.wpe.weight.size
+        return n
+
+    def flops_per_token(self, seq_len):
+        """Approximate training FLOPs/token (fwd+bwd), PaLM-style 6N + attn."""
+        n = self.num_params()
+        l, d = self.config.num_layers, self.config.hidden_size
+        return 6 * n + 12 * l * d * seq_len

@@ -1,0 +1,201 @@
+"""Llama family (Llama-2/3 style decoder).
+
+Capability parity target: the reference's semi-auto llama workload
+(`test/auto_parallel/hybrid_strategy/semi_auto_llama.py`) and its fused
+kernels (`fused_rope`, `fused_rms_norm`, flash attention — SURVEY.md §2.1).
+TPU-first: RoPE and RMSNorm are plain jnp (XLA fuses them into neighbors),
+attention is SDPA→Pallas flash with GQA, SwiGLU is two MXU matmuls + fused
+elementwise. No KV-cache branching in the training path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import apply
+from ..nn import functional as F
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = None  # GQA; None = MHA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            self.num_kv_heads = self.num_heads
+
+    @staticmethod
+    def llama2_7b():
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_8b():
+        return LlamaConfig(vocab_size=128256, hidden_size=4096,
+                           intermediate_size=14336, num_layers=32,
+                           num_heads=32, num_kv_heads=8,
+                           max_position_embeddings=8192,
+                           rope_theta=500000.0)
+
+    @staticmethod
+    def llama3_70b():
+        return LlamaConfig(vocab_size=128256, hidden_size=8192,
+                           intermediate_size=28672, num_layers=80,
+                           num_heads=64, num_kv_heads=8,
+                           max_position_embeddings=8192,
+                           rope_theta=500000.0)
+
+    @staticmethod
+    def tiny():
+        return LlamaConfig(vocab_size=256, hidden_size=64,
+                           intermediate_size=128, num_layers=2, num_heads=4,
+                           num_kv_heads=2, max_position_embeddings=64)
+
+
+def apply_rope(q, k, theta=10000.0, position_offset=0):
+    """Rotary embedding on [b, s, h, d] Tensors (capability of the
+    reference's fused_rotary_position_embedding, fused_ops.yaml:408)."""
+
+    def _rope(qa, ka):
+        d = qa.shape[-1]
+        s = qa.shape[1]
+        inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, jnp.float32) / d))
+        pos = jnp.arange(position_offset, position_offset + s, dtype=jnp.float32)
+        freqs = jnp.outer(pos, inv_freq)  # [s, d/2]
+        cos = jnp.cos(freqs)[None, :, None, :]
+        sin = jnp.sin(freqs)[None, :, None, :]
+
+        def rot(x):
+            x1 = x[..., 0::2].astype(jnp.float32)
+            x2 = x[..., 1::2].astype(jnp.float32)
+            o1 = x1 * cos - x2 * sin
+            o2 = x2 * cos + x1 * sin
+            out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+            return out.astype(x.dtype)
+
+        return rot(qa), rot(ka)
+
+    return apply(_rope, q, k, name="rope")
+
+
+def _normal_attr(std):
+    return nn.ParamAttr(initializer=nn.initializer.Normal(0.0, std))
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        d = config.hidden_size
+        self.num_heads = config.num_heads
+        self.num_kv_heads = config.num_kv_heads
+        self.head_dim = d // config.num_heads
+        self.rope_theta = config.rope_theta
+        std = config.initializer_range
+        kv_out = self.num_kv_heads * self.head_dim
+        self.q_proj = nn.Linear(d, d, weight_attr=_normal_attr(std),
+                                bias_attr=False)
+        self.k_proj = nn.Linear(d, kv_out, weight_attr=_normal_attr(std),
+                                bias_attr=False)
+        self.v_proj = nn.Linear(d, kv_out, weight_attr=_normal_attr(std),
+                                bias_attr=False)
+        self.o_proj = nn.Linear(d, d, weight_attr=_normal_attr(std),
+                                bias_attr=False)
+
+    def forward(self, x):
+        from .. import ops
+        b, s, d = x.shape
+        q = ops.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
+        k = ops.reshape(self.k_proj(x),
+                        [b, s, self.num_kv_heads, self.head_dim])
+        v = ops.reshape(self.v_proj(x),
+                        [b, s, self.num_kv_heads, self.head_dim])
+        q, k = apply_rope(q, k, theta=self.rope_theta)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = ops.reshape(out, [b, s, d])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        d, i = config.hidden_size, config.intermediate_size
+        std = config.initializer_range
+        self.gate_proj = nn.Linear(d, i, weight_attr=_normal_attr(std),
+                                   bias_attr=False)
+        self.up_proj = nn.Linear(d, i, weight_attr=_normal_attr(std),
+                                 bias_attr=False)
+        self.down_proj = nn.Linear(i, d, weight_attr=_normal_attr(std),
+                                   bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaBlock(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class Llama(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        std = config.initializer_range
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size,
+                                         weight_attr=_normal_attr(std))
+        self.layers = nn.LayerList([LlamaBlock(config)
+                                    for _ in range(config.num_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size,
+                               epsilon=config.rms_norm_eps)
+        if not config.tie_word_embeddings:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     weight_attr=_normal_attr(std),
+                                     bias_attr=False)
+        else:
+            self.lm_head = None
+
+    def forward(self, input_ids):
+        from .. import ops
+        x = self.embed_tokens(input_ids)
+        for block in self.layers:
+            x = block(x)
+        x = self.norm(x)
+        if self.lm_head is not None:
+            return self.lm_head(x)
+        return ops.matmul(x, self.embed_tokens.weight, transpose_y=True)
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(logits[:, :-1, :], labels[:, 1:])
+
+    def num_params(self):
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len):
+        n = self.num_params()
+        l, d = self.config.num_layers, self.config.hidden_size
+        return 6 * n + 12 * l * d * seq_len
