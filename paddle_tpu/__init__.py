@@ -44,6 +44,7 @@ from .framework.io import load, save  # noqa: F401
 from . import metric  # noqa: F401
 from . import incubate  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import distributed  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -24,7 +24,7 @@ from .autograd import backward as _backward
 
 class Tensor:
     __slots__ = ("_data", "grad", "stop_gradient", "_node", "_out_idx", "name",
-                 "persistable", "__weakref__")
+                 "persistable", "_dist_attr", "__weakref__")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True,
                  name=None):
@@ -50,6 +50,7 @@ class Tensor:
         self._out_idx = 0
         self.name = name
         self.persistable = False
+        self._dist_attr = None  # (ProcessMesh, [Placement]) when sharded
 
     # -- metadata ---------------------------------------------------------
     @property

@@ -1,0 +1,22 @@
+"""`paddle.distributed` surface over jax.sharding / XLA collectives
+(reference: python/paddle/distributed/; SURVEY.md §2.3, §5.8)."""
+
+from .api import (  # noqa: F401
+    apply_placement_rules, dtensor_from_fn, reshard, shard_layer,
+    shard_tensor,
+)
+from .collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_gather_object, all_reduce, all_to_all,
+    alltoall, barrier, broadcast, gather, new_group, ppermute, recv, reduce,
+    reduce_scatter, scatter, send,
+)
+from .env import (  # noqa: F401
+    device_count, get_rank, get_world_size, init_parallel_env,
+    is_initialized, local_device_count,
+)
+from .mesh import ProcessMesh, get_mesh, init_mesh, set_mesh  # noqa: F401
+from .placement import (  # noqa: F401
+    Partial, Placement, Replicate, Shard, named_sharding,
+    placements_to_spec, spec_to_placements,
+)
+from .sharded_step import ShardedTrainStep  # noqa: F401

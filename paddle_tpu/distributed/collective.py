@@ -1,0 +1,228 @@
+"""Functional collective API.
+
+Parity: reference `python/paddle/distributed/communication/` (all_reduce /
+all_gather / reduce_scatter / all_to_all / broadcast / send / recv +
+stream variants) over ProcessGroupNCCL (process_group_nccl.cc:819).
+
+TPU-first semantics: a Group is a mesh axis. Inside `shard_map`-traced code
+these lower to XLA ICI collectives (`lax.psum`, `lax.all_gather`,
+`lax.psum_scatter`, `lax.all_to_all`, `lax.ppermute`) — asynchronously
+scheduled by XLA, no comm streams or watchdog to manage. Called eagerly on
+global (sharded) arrays, they are resolved through sharding: e.g. eager
+all_reduce of a Partial tensor = reshard to Replicate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .mesh import get_mesh
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = one mesh axis (or all axes)."""
+
+    def __init__(self, axis_name=None, mesh=None, ranks=None):
+        self.axis_name = axis_name
+        self.mesh = mesh or get_mesh()
+        self._ranks = ranks
+
+    @property
+    def nranks(self):
+        if self.mesh is None:
+            return jax.device_count()
+        if self.axis_name is None:
+            return int(jnp.prod(jnp.asarray(self.mesh.shape)))
+        return self.mesh.get_dim_size(self.axis_name)
+
+    world_size = nranks
+
+    @property
+    def rank(self):
+        return 0  # single-controller: per-device rank only exists in-trace
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name}, nranks={self.nranks})"
+
+
+_world_group = None
+
+
+def _group(group):
+    global _world_group
+    if group is not None:
+        return group
+    if _world_group is None:
+        _world_group = Group(axis_name=None)
+    return _world_group
+
+
+def new_group(ranks=None, backend=None, axis_name=None, mesh=None):
+    return Group(axis_name=axis_name, mesh=mesh, ranks=ranks)
+
+
+def _in_shard_map(axis_name):
+    """True when tracing inside shard_map with this named axis bound."""
+    if axis_name is None:
+        return False
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except Exception:
+        return False
+
+
+def _axis(group):
+    g = _group(group)
+    return g.axis_name
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lax.psum,
+    ReduceOp.MAX: lax.pmax,
+    ReduceOp.MIN: lax.pmin,
+}
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis(group)
+    if _in_shard_map(axis):
+        def fn(a):
+            if op == ReduceOp.AVG:
+                return lax.pmean(a, axis)
+            if op == ReduceOp.PROD:
+                return jnp.exp(lax.psum(jnp.log(a), axis))
+            return _REDUCERS[op](a, axis)
+        out = apply(fn, tensor, name="all_reduce")
+        from ..ops import _inplace_from
+        return _inplace_from(tensor, out)
+    # eager global view: values are already global; reduce is identity
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    g = _group(group)
+    if _in_shard_map(g.axis_name):
+        def fn(a):
+            return lax.all_gather(a, g.axis_name)
+        gathered = apply(fn, tensor, name="all_gather")
+        if tensor_list is not None:
+            from .. import ops
+            tensor_list.extend(ops.unbind(gathered, axis=0))
+        return gathered
+    if tensor_list is not None:
+        tensor_list.extend([tensor] * g.nranks)
+    return tensor
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.extend([obj] * _group(group).nranks)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    g = _group(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from .. import ops
+        src = ops.concat(list(src), axis=0)
+    if _in_shard_map(g.axis_name):
+        def fn(a):
+            return lax.psum_scatter(a, g.axis_name, scatter_dimension=0,
+                                    tiled=True)
+        out = apply(fn, src, name="reduce_scatter")
+        if tensor is not None:
+            from ..ops import _inplace_from
+            return _inplace_from(tensor, out)
+        return out
+    return src
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    g = _group(group)
+    from .. import ops
+    stacked = in_tensor_list if isinstance(in_tensor_list, Tensor) else \
+        ops.stack(list(in_tensor_list), axis=0)
+    if _in_shard_map(g.axis_name):
+        def fn(a):
+            return lax.all_to_all(a, g.axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        out = apply(fn, stacked, name="all_to_all")
+    else:
+        out = stacked
+    if out_tensor_list is not None:
+        out_tensor_list.extend(ops.unbind(out, axis=0))
+    return out
+
+
+alltoall = all_to_all  # legacy name (reference c_ops alltoall)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # single-controller: every device computes the same program; broadcast
+    # of a replicated value is identity. In-trace from a sharded source we
+    # select src's shard.
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        return tensor_list[0]
+    return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if gather_list is not None:
+        gather_list.extend([tensor] * _group(group).nranks)
+    return tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv only exist inside shard_map pipelines "
+        "(use paddle_tpu.distributed.ppermute / the pipeline engine)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv only exist inside shard_map pipelines "
+        "(use paddle_tpu.distributed.ppermute / the pipeline engine)")
+
+
+def ppermute(tensor, perm, group=None):
+    """Ring/permutation p2p (the XLA-native form of batch_isend_irecv)."""
+    axis = _axis(group)
+
+    def fn(a):
+        return lax.ppermute(a, axis, perm)
+
+    return apply(fn, tensor, name="ppermute")
+
+
+def barrier(group=None):
+    jax.effects_barrier()
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    g = _group(group)
+    return g.nranks if g.axis_name is not None else jax.process_count()

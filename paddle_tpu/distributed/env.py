@@ -1,0 +1,63 @@
+"""Distributed bootstrap.
+
+Parity: reference `init_parallel_env` (python/paddle/distributed/
+parallel.py:977 — env parsing, TCPStore rendezvous, ProcessGroupNCCL
+creation) and the TCPStore itself (paddle/phi/core/distributed/store/
+tcp_store.h:121). TPU-first: `jax.distributed.initialize` speaks to the
+JAX coordination service (the TCPStore equivalent — rank-0-hosted KV +
+barriers with builtin health checking); NCCL comm setup is replaced by the
+runtime's ICI/DCN topology discovery, so there is nothing lazy to warm up.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+_initialized = False
+
+
+def init_parallel_env(strategy=None):
+    """Multi-host bootstrap. Single-host (or already-initialized) is a
+    no-op, mirroring paddle's idempotent init."""
+    global _initialized
+    if _initialized:
+        return
+    coord = os.environ.get("PADDLE_MASTER") or \
+        os.environ.get("COORDINATOR_ADDRESS")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                os.environ.get("NUM_PROCESSES", "1")))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID",
+                             os.environ.get("PROCESS_ID", "0")))
+    if coord and nprocs > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nprocs, process_id=pid)
+    _initialized = True
+
+
+def get_rank(group=None):
+    """Process rank (reference paddle.distributed.get_rank reads
+    PADDLE_TRAINER_ID; here: the jax process index)."""
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def is_initialized():
+    return _initialized
+
+
+def device_count():
+    return jax.device_count()
+
+
+def local_device_count():
+    return jax.local_device_count()

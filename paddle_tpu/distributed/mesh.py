@@ -1,0 +1,134 @@
+"""ProcessMesh: the device-mesh abstraction.
+
+Parity: reference `ProcessMesh` (paddle/phi/core/distributed/auto_parallel/
+process_mesh.h:34, python python/paddle/distributed/auto_parallel/
+process_mesh.py:85). TPU-first: a thin, faithful wrapper over
+`jax.sharding.Mesh` — mesh axes ARE the reference's comm groups (dp/mp/pp/
+sharding/sep axes of HybridCommunicateGroup, topology.py:65), laid out so
+inner axes ride ICI and the outermost axis can span DCN slices.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh as JaxMesh
+
+_global_mesh = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, shape=None):
+        """``mesh``: nested list / ndarray of device (process) ids, or a
+        jax.sharding.Mesh."""
+        if isinstance(mesh, JaxMesh):
+            self._jax_mesh = mesh
+            self._ids = np.array(
+                [[d.id for d in row] for row in
+                 mesh.devices.reshape(mesh.devices.shape[0], -1)]
+            ) if mesh.devices.ndim > 1 else np.array(
+                [d.id for d in mesh.devices.flat])
+            self._dim_names = list(mesh.axis_names)
+            self._shape = list(mesh.devices.shape)
+            return
+        arr = np.asarray(mesh)
+        if shape is not None:
+            arr = arr.reshape(shape)
+        self._ids = arr
+        self._shape = list(arr.shape)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        assert len(dim_names) == arr.ndim
+        self._dim_names = list(dim_names)
+        devices = np.array(jax.devices(), dtype=object)[arr.reshape(-1)]
+        self._jax_mesh = JaxMesh(devices.reshape(arr.shape),
+                                 axis_names=tuple(self._dim_names))
+
+    @property
+    def jax_mesh(self) -> JaxMesh:
+        return self._jax_mesh
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return [int(i) for i in self._ids.reshape(-1)]
+
+    @property
+    def mesh(self):
+        return self._ids
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        """Sub-mesh with ``dim_name`` moved out (paddle parity)."""
+        axis = self._dim_names.index(dim_name)
+        perm = [axis] + [i for i in range(self.ndim) if i != axis]
+        ids = np.transpose(self._ids, perm)
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        if index is None:
+            return ProcessMesh(ids, names)
+        return ProcessMesh(ids[index], names[1:])
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and
+                self._shape == other._shape and
+                self.process_ids == other.process_ids and
+                self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self.process_ids),
+                     tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+    def __enter__(self):
+        global _global_mesh
+        self._prev = _global_mesh
+        _global_mesh = self
+        return self
+
+    def __exit__(self, *exc):
+        global _global_mesh
+        _global_mesh = self._prev
+        return False
+
+
+def init_mesh(shape, dim_names):
+    """Build a ProcessMesh over all visible devices with the given logical
+    shape; `-1` infers one dimension."""
+    n = jax.device_count()
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = n // known
+    ids = np.arange(int(np.prod(shape))).reshape(shape)
+    return ProcessMesh(ids, dim_names)
+
+
+def get_mesh():
+    return _global_mesh
+
+
+def set_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh
+
+
+def auto_parallel_rank_in_mesh(mesh, axis):
+    """Host-side coordinate lookup (single-controller: informational)."""
+    return 0

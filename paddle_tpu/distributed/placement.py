@@ -1,0 +1,116 @@
+"""Placements: Shard / Replicate / Partial.
+
+Parity: reference placement types (paddle/phi/core/distributed/
+auto_parallel/placement_types.h; python placement_type.py) and
+`TensorDistAttr.dims_mapping` (dist_attr.h:81). TPU mapping: a list of
+placements (one per mesh dim) converts exactly to a
+`jax.sharding.PartitionSpec` (one entry per TENSOR dim) — the same duality
+the reference maintains between placements and dims_mapping.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("R")
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("S", self.dim))
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and \
+            other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("P", self.reduce_type))
+
+
+def placements_to_spec(placements, mesh, ndim):
+    """[placement per MESH dim] -> PartitionSpec (per TENSOR dim).
+
+    The inverse of the reference's dims_mapping: placements[i]=Shard(d)
+    means tensor dim d is split over mesh axis i. Multiple mesh axes on one
+    tensor dim stack (GSPMD tuple spec). Partial has no PartitionSpec form —
+    it only exists transiently inside computations (XLA handles it)."""
+    entries = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            axis_name = mesh.dim_names[mesh_dim]
+            cur = entries[pl.dim]
+            if cur is None:
+                entries[pl.dim] = axis_name
+            elif isinstance(cur, tuple):
+                entries[pl.dim] = cur + (axis_name,)
+            else:
+                entries[pl.dim] = (cur, axis_name)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(spec, mesh, ndim):
+    """PartitionSpec -> [placement per mesh dim]."""
+    placements = [Replicate() for _ in range(mesh.ndim)]
+    for tdim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            placements[mesh.dim_names.index(name)] = Shard(tdim)
+    return placements
+
+
+def named_sharding(mesh, placements, ndim):
+    return NamedSharding(mesh.jax_mesh,
+                         placements_to_spec(placements, mesh, ndim))

@@ -223,7 +223,13 @@ class TrainStep:
                     obj.stop_gradient = sg
 
         donate = (0, 1) if self._donate else ()
-        self._jitted = jax.jit(pure, donate_argnums=donate)
+        self._pure = pure
+        self._jitted = jax.jit(pure, donate_argnums=donate,
+                               out_shardings=self._out_shardings())
+
+    def _out_shardings(self):
+        """None everywhere (XLA's choice); ShardedTrainStep pins params."""
+        return None
 
     def __call__(self, *batch):
         if self._jitted is None:

@@ -172,3 +172,25 @@ class GPT(nn.Layer):
         n = self.num_params()
         l, d = self.config.num_layers, self.config.hidden_size
         return 6 * n + 12 * l * d * seq_len
+
+    @staticmethod
+    def tp_placement_rules(mesh, tp_axis="tp"):
+        """Megatron TP placements (see Llama.tp_placement_rules)."""
+        from ..distributed import Replicate, Shard
+        axis = mesh.dim_names.index(tp_axis)
+
+        def P(*pairs):
+            pl = [Replicate()] * mesh.ndim
+            for mesh_dim, tensor_dim in pairs:
+                pl[mesh_dim] = Shard(tensor_dim)
+            return pl
+
+        return [
+            ("qkv_proj.weight", P((axis, 1))),
+            ("qkv_proj.bias", P((axis, 0))),
+            ("out_proj.weight", P((axis, 0))),
+            ("fc_in.weight", P((axis, 1))),
+            ("fc_in.bias", P((axis, 0))),
+            ("fc_out.weight", P((axis, 0))),
+            ("wte.weight", P((axis, 0))),  # vocab-parallel
+        ]

@@ -199,3 +199,28 @@ class Llama(nn.Layer):
         n = self.num_params()
         l, d = self.config.num_layers, self.config.hidden_size
         return 6 * n + 12 * l * d * seq_len
+
+    @staticmethod
+    def tp_placement_rules(mesh, tp_axis="tp"):
+        """Megatron-style TP placements (reference mp_layers.py:47,334,541:
+        column-parallel q/k/v/gate/up, row-parallel o/down, vocab-parallel
+        embedding) as rules for distributed.apply_placement_rules."""
+        from ..distributed import Replicate, Shard
+        axis = mesh.dim_names.index(tp_axis)
+
+        def P(*pairs):
+            pl = [Replicate()] * mesh.ndim
+            for mesh_dim, tensor_dim in pairs:
+                pl[mesh_dim] = Shard(tensor_dim)
+            return pl
+
+        col = P((axis, 1))   # [in, out] split out
+        row = P((axis, 0))   # [in, out] split in
+        return [
+            ("q_proj.weight", col), ("k_proj.weight", col),
+            ("v_proj.weight", col), ("gate_proj.weight", col),
+            ("up_proj.weight", col),
+            ("o_proj.weight", row), ("down_proj.weight", row),
+            ("embed_tokens.weight", P((axis, 0))),  # vocab-parallel
+            ("lm_head.weight", col),
+        ]
