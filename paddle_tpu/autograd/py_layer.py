@@ -1,0 +1,97 @@
+"""PyLayer: user-defined autograd ops.
+
+Parity: reference `paddle/fluid/eager/pylayer/` + python
+python/paddle/autograd/py_layer.py — static forward/backward with a ctx
+carrying saved tensors. The recorded tape Node's vjp_fn simply invokes the
+user's backward; saved tensors are real Tensors (and under jit tracing
+they hold tracers, so PyLayers compile into the XLA program too — this is
+how recompute and the TP comm layers stay jittable).
+"""
+
+from __future__ import annotations
+
+from ..core.autograd import Node, is_grad_enabled, no_grad
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    # paddle also exposes mark_not_inplace / set_materialize_grads; accept
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tensors
+
+    def set_materialize_grads(self, value):
+        self._materialize = value
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        recording = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+
+        if recording:
+            out_meta = [(tuple(o._data.shape), o._data.dtype)
+                        for o in out_list if isinstance(o, Tensor)]
+
+            def vjp_fn(cotangents):
+                cts = [Tensor(c) for c in cotangents]
+                with no_grad():
+                    in_grads = cls.backward(ctx, *cts)
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = (in_grads,)
+                arrays = []
+                gi = iter(in_grads)
+                for t in tensor_inputs:
+                    g = next(gi, None)
+                    arrays.append(None if g is None else
+                                  (g._data if isinstance(g, Tensor) else g))
+                import jax.numpy as jnp
+                return tuple(
+                    jnp.zeros(t._data.shape, t._data.dtype) if a is None
+                    else a for t, a in zip(tensor_inputs, arrays))
+
+            node = Node(vjp_fn, tensor_inputs, out_meta, name=cls.__name__)
+            idx = 0
+            for o in out_list:
+                if isinstance(o, Tensor):
+                    from ..core.dtype import is_floating_point
+                    if is_floating_point(o.dtype):
+                        o.stop_gradient = False
+                        o._node = node
+                        o._out_idx = idx
+                    idx += 1
+        return out_list[0] if single else tuple(out_list)
+
+
+class LegacyPyLayer(PyLayer):
+    pass

@@ -1,0 +1,36 @@
+"""saved_tensors_hooks (reference: python/paddle/autograd/
+saved_tensors_hooks.py) — pack/unpack hooks for activation memory control
+(the reference's offload-recompute building block)."""
+
+from __future__ import annotations
+
+import threading
+
+
+class _HookState(threading.local):
+    def __init__(self):
+        self.pack = None
+        self.unpack = None
+
+
+_state = _HookState()
+
+
+def current_hooks():
+    return _state.pack, _state.unpack
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        self._prev = (_state.pack, _state.unpack)
+        _state.pack = self.pack_hook
+        _state.unpack = self.unpack_hook
+        return self
+
+    def __exit__(self, *exc):
+        _state.pack, _state.unpack = self._prev
+        return False

@@ -33,6 +33,10 @@ def apply(fn: Callable, *args, name: str = None, **kwargs):
       returns a single Tensor or a list of Tensors accordingly.
     """
     name = name or getattr(fn, "__name__", "op")
+    from ..amp import amp_state
+    if amp_state().enabled:
+        from ..amp import amp_dispatch_pre
+        args = amp_dispatch_pre(name, args)
     diff_idx = []
     payloads = []
     recording = is_grad_enabled()

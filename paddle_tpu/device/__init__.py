@@ -1,1 +1,183 @@
+"""`paddle.device`: device control.
 
+Parity: reference python/paddle/device/ (set_device :277, Stream :633,
+Event :457, synchronize, cuda memory stats). TPU-first: XLA owns stream
+scheduling, so Stream/Event are ordering no-ops that preserve the API;
+memory stats come from the PJRT device (`jax.local_devices()[0]
+.memory_stats()` — the reference's phi/core/memory/stats.h equivalent).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..core import place as place_mod
+
+__all__ = ["set_device", "get_device", "get_all_custom_device_type",
+           "is_compiled_with_cuda", "is_compiled_with_xpu",
+           "is_compiled_with_custom_device", "device_count", "synchronize",
+           "Stream", "Event", "current_stream", "stream_guard", "cuda",
+           "max_memory_allocated", "max_memory_reserved",
+           "memory_allocated", "memory_reserved", "empty_cache"]
+
+
+def set_device(device):
+    return place_mod.set_device(device)
+
+
+def get_device():
+    return place_mod.get_device()
+
+
+def device_count():
+    return jax.device_count()
+
+
+def synchronize(device=None):
+    place_mod.synchronize()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return device_type in (None, "tpu")
+
+
+def get_all_custom_device_type():
+    return ["tpu"]
+
+
+def get_all_device_type():
+    return ["cpu", "tpu"]
+
+
+class Stream:
+    """API-parity stream: XLA schedules async execution itself."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def query(self):
+        return True
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current = Stream()
+
+
+def current_stream(device=None):
+    return _current
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _mem_stats():
+    dev = jax.local_devices()[0]
+    try:
+        return dev.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None):
+    return int(_mem_stats().get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    return int(_mem_stats().get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    s = _mem_stats()
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None):
+    return max_memory_allocated(device)
+
+
+def empty_cache():
+    pass
+
+
+class _CudaShim:
+    """`paddle.device.cuda` names mapped onto the accelerator."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return max_memory_allocated()
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return max_memory_reserved()
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return memory_allocated()
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return memory_reserved()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+
+cuda = _CudaShim()

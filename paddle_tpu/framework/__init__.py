@@ -1,1 +1,10 @@
+"""`paddle.framework` surface: seed, save/load, dtype defaults."""
 
+from . import io  # noqa: F401
+from .io import load, save  # noqa: F401
+from ..core.random import seed  # noqa: F401
+from ..core.dtype import get_default_dtype, set_default_dtype  # noqa: F401
+
+
+def in_dynamic_mode():
+    return True

@@ -1,6 +1,81 @@
-def save(obj, path, **kwargs):
-    raise NotImplementedError
+"""`paddle.save` / `paddle.load`.
+
+Parity: reference python/paddle/framework/io.py (save :773, load :1020) —
+pickle container protocol with tensor payloads. Format: a pickle whose
+tensors are stored as numpy arrays plus a dtype tag (bf16 stored as uint16
+bits, like the reference serializes bf16). Distributed sharded checkpoint
+lives in paddle_tpu.distributed.checkpoint (orbax-style, SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_PROTO = 4
 
 
-def load(path, **kwargs):
-    raise NotImplementedError
+class _TensorPayload:
+    """Pickle-stable tensor container (handles bf16/f8 via raw bits)."""
+
+    def __init__(self, array):
+        import jax.numpy as jnp
+        import ml_dtypes  # ships with jax
+
+        self.dtype_name = str(array.dtype)
+        np_arr = np.asarray(array)
+        if np_arr.dtype == ml_dtypes.bfloat16 or "float8" in self.dtype_name:
+            self.bits = np_arr.view(
+                np.uint16 if np_arr.dtype.itemsize == 2 else np.uint8)
+        else:
+            self.bits = np_arr
+
+    def to_numpy(self):
+        import ml_dtypes
+
+        if self.dtype_name == "bfloat16":
+            return self.bits.view(ml_dtypes.bfloat16)
+        if "float8" in self.dtype_name:
+            return self.bits.view(getattr(ml_dtypes, self.dtype_name))
+        return self.bits
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj._data)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        arr = obj.to_numpy()
+        return arr if return_numpy else Tensor(arr)
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_unpack(v, return_numpy) for v in obj]
+        return out if isinstance(obj, list) else tuple(out)
+    return obj
+
+
+def save(obj, path, protocol=_PROTO, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)
