@@ -50,8 +50,10 @@ class PyLayer(metaclass=PyLayerMeta):
     def apply(cls, *args, **kwargs):
         ctx = PyLayerContext()
         tensor_inputs = [a for a in args if isinstance(a, Tensor)]
-        recording = is_grad_enabled() and any(
-            not t.stop_gradient for t in tensor_inputs)
+        # record whenever grad is enabled (paddle PyLayer semantics): the
+        # user backward may route grads to closed-over parameters even if
+        # no direct tensor input requires grad (e.g. recompute)
+        recording = is_grad_enabled()
 
         with no_grad():
             outputs = cls.forward(ctx, *args, **kwargs)

@@ -20,3 +20,5 @@ from .placement import (  # noqa: F401
     placements_to_spec, spec_to_placements,
 )
 from .sharded_step import ShardedTrainStep  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from . import fleet  # noqa: F401

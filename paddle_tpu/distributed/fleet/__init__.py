@@ -1,0 +1,123 @@
+"""`paddle.distributed.fleet` facade.
+
+Parity: reference python/paddle/distributed/fleet/fleet.py (`Fleet` :99,
+`fleet.init` :166 → RoleMaker → hybrid topology :598) and
+DistributedStrategy (base/distributed_strategy.py:175).
+"""
+
+from __future__ import annotations
+
+from . import topology as _topology
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .random import get_rng_state_tracker  # noqa: F401
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, get_hcg, set_hcg,
+)
+from ..recompute import recompute, recompute_sequential  # noqa: F401
+
+import paddle_tpu.distributed as _dist
+
+
+class DistributedStrategy:
+    """Config object (reference: protobuf-backed
+    distributed_strategy.proto). Plain attributes here; same knob names."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+
+
+class _FleetState:
+    def __init__(self):
+        self.initialized = False
+        self.strategy = None
+        self.hcg = None
+
+
+_state = _FleetState()
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level=2):
+    """fleet.init (reference fleet.py:166). Builds the hybrid topology mesh
+    from strategy.hybrid_configs and installs it as the global mesh."""
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    topo = CommunicateTopology(
+        hybrid_group_names=["data", "pipe", "sharding", "sep", "model"],
+        dims=[hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+              hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+              hc.get("mp_degree", 1)])
+    hcg = HybridCommunicateGroup(topo)
+    set_hcg(hcg)
+    _state.initialized = True
+    _state.strategy = strategy
+    _state.hcg = hcg
+    _dist.init_parallel_env()
+    return _state
+
+
+def is_initialized():
+    return _state.initialized
+
+
+def get_hybrid_communicate_group():
+    return _state.hcg
+
+
+def distributed_model(model):
+    """reference fleet/model.py:32 dispatch. Under GSPMD every strategy is
+    expressed through placements, so the model is returned as-is once its
+    params carry dist attrs; pure-DP models need no wrapper at all."""
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """reference fleet/optimizer.py → HybridParallelOptimizer
+    (hybrid_parallel_optimizer.py:255). Grad sync + cross-axis global-norm
+    clip happen inside the compiled step via GSPMD; the wrapper keeps the
+    fleet API surface."""
+    return optimizer
+
+
+def get_rank():
+    return _dist.get_rank()
+
+
+def worker_num():
+    return _dist.get_world_size()
+
+
+def worker_index():
+    return _dist.get_rank()
+
+
+def is_first_worker():
+    return _dist.get_rank() == 0
+
+
+def barrier_worker():
+    _dist.barrier()
+
+
+utils = None

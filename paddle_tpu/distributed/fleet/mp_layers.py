@@ -1,0 +1,154 @@
+"""Megatron tensor-parallel layers.
+
+Parity: reference `python/paddle/distributed/fleet/layers/mpu/mp_layers.py`
+— VocabParallelEmbedding (:47), ColumnParallelLinear (:334),
+RowParallelLinear (:541), ParallelCrossEntropy (:742) and the comm
+autograd ops of mp_ops.py (_c_identity/_mp_allreduce pairs).
+
+TPU-first: the layers hold the FULL logical weight annotated with a Shard
+placement on the mp mesh axis; GSPMD partitions the matmul and inserts the
+identity/allreduce pairs the reference hand-writes as PyLayers. The
+`gather_output` / `input_is_parallel` switches become sharding constraints
+on the activations (= Megatron-SP's scatter/gather points).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ... import nn
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ..api import shard_tensor
+from ..mesh import get_mesh
+from ..placement import Replicate, Shard, named_sharding
+from .topology import get_hcg
+
+
+def _mp_axis(mp_group=None):
+    if mp_group is not None and mp_group.axis_name:
+        return mp_group.mesh, mp_group.axis_name
+    hcg = get_hcg()
+    if hcg is not None and "mp" in hcg.mesh.dim_names:
+        return hcg.mesh, "mp"
+    mesh = get_mesh()
+    if mesh is not None and "mp" in mesh.dim_names:
+        return mesh, "mp"
+    return mesh, None
+
+
+def _constrain(t, mesh, placements):
+    """Sharding-constrain an activation (trace-safe)."""
+    if mesh is None:
+        return t
+    sharding = named_sharding(mesh, placements, t.ndim)
+
+    def fn(a):
+        return jax.lax.with_sharding_constraint(a, sharding)
+
+    return apply(fn, t, name="sharding_constraint")
+
+
+def _mp_placements(mesh, axis, tensor_dim):
+    pl = [Replicate()] * mesh.ndim
+    if axis is not None:
+        pl[mesh.dim_names.index(axis)] = Shard(tensor_dim)
+    return pl
+
+
+class ColumnParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        mesh, axis = _mp_axis(mp_group)
+        self._mesh, self._axis = mesh, axis
+        self._gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        if mesh is not None and axis is not None:
+            shard_tensor(self.weight, mesh, _mp_placements(mesh, axis, 1))
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], is_bias=True)
+            if mesh is not None and axis is not None:
+                shard_tensor(self.bias, mesh, _mp_placements(mesh, axis, 0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = nn.functional.linear(x, self.weight, self.bias)
+        if self._mesh is None or self._axis is None:
+            return out
+        if self._gather_output:
+            pl = [Replicate()] * self._mesh.ndim
+        else:
+            pl = _mp_placements(self._mesh, self._axis, out.ndim - 1)
+        return _constrain(out, self._mesh, pl)
+
+
+class RowParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        mesh, axis = _mp_axis(mp_group)
+        self._mesh, self._axis = mesh, axis
+        self._input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        if mesh is not None and axis is not None:
+            shard_tensor(self.weight, mesh, _mp_placements(mesh, axis, 0))
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self._mesh is not None and self._axis is not None and \
+                self._input_is_parallel:
+            x = _constrain(x, self._mesh,
+                           _mp_placements(self._mesh, self._axis, x.ndim - 1))
+        out = nn.functional.linear(x, self.weight, self.bias)
+        if self._mesh is not None:
+            out = _constrain(out, self._mesh,
+                             [Replicate()] * self._mesh.ndim)
+        return out
+
+
+class VocabParallelEmbedding(nn.Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        mesh, axis = _mp_axis(mp_group)
+        self._mesh, self._axis = mesh, axis
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal())
+        if mesh is not None and axis is not None:
+            shard_tensor(self.weight, mesh, _mp_placements(mesh, axis, 0))
+
+    def forward(self, x):
+        out = nn.functional.embedding(x, self.weight)
+        if self._mesh is not None:
+            out = _constrain(out, self._mesh,
+                             [Replicate()] * self._mesh.ndim)
+        return out
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Vocab-parallel CE (reference mp_layers.py:742 /
+    c_softmax_with_cross_entropy): with vocab-sharded logits GSPMD computes
+    the softmax reduction over the mp axis with one allreduce, which is
+    exactly the hand-written kernel's comm pattern."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return nn.functional.cross_entropy(
+            input, label, ignore_index=self.ignore_index, reduction="none")

@@ -1,0 +1,67 @@
+"""Per-axis RNG state tracking.
+
+Parity: reference `python/paddle/distributed/fleet/layers/mpu/random.py`
+(RNGStatesTracker) — distinct dropout randomness on the TP axis vs
+replicated randomness elsewhere, the determinism contract for TP training.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from ...core import random as random_mod
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        gen = random_mod.Generator(seed)
+        self.states_[name] = gen.get_state()
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        gen = random_mod.default_generator()
+        orig = gen.get_state()
+        gen.set_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = gen.get_state()
+            gen.set_state(orig)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+    seed = seed or (pyrandom.getrandbits(32))
+    _tracker.reset()
+    random_mod.seed(seed)
+    _tracker.add(MODEL_PARALLEL_RNG, seed + 1)

@@ -1,0 +1,146 @@
+"""Hybrid-parallel topology.
+
+Parity: reference `python/paddle/distributed/fleet/base/topology.py` —
+`CommunicateTopology` axes [data, pipe, sharding, sep, model] (:65) and
+`HybridCommunicateGroup` (:178) handing out per-axis comm groups.
+TPU-first: the topology IS a ProcessMesh; each axis group is a mesh-axis
+Group (collective.py), and rank coordinates are mesh coordinates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..collective import Group
+from ..mesh import ProcessMesh, set_mesh
+
+AXES = ["data", "pipe", "sharding", "sep", "model"]
+AXIS_SHORT = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+              "sep": "sep", "model": "mp"}
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._names = hybrid_group_names or list(AXES)
+        self._dims = list(dims or [1] * len(self._names))
+
+    def get_hybrid_group_names(self):
+        return list(self._names)
+
+    def get_dim(self, axis_name):
+        return self._dims[self._names.index(axis_name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        coords = [kwargs[n] for n in self._names]
+        return int(np.ravel_multi_index(coords, self._dims))
+
+    def get_coord(self, rank):
+        return tuple(int(c) for c in
+                     np.unravel_index(rank, self._dims))
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        dims = [topology.get_dim(n) for n in AXES if n in
+                topology.get_hybrid_group_names()]
+        names = [AXIS_SHORT[n] for n in topology.get_hybrid_group_names()]
+        # drop singleton axes from the physical mesh but remember them
+        self._degrees = dict(zip(names, dims))
+        mesh_names = [n for n, d in zip(names, dims)]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        self._mesh = ProcessMesh(ids, mesh_names)
+        set_mesh(self._mesh)
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._degrees.get("mp", 1) > 1 or self._degrees.get("pp", 1) > 1:
+            return "hybrid"
+        if self._degrees.get("sharding", 1) > 1:
+            return "sharding"
+        if self._degrees.get("dp", 1) > 1:
+            return "data"
+        return "single"
+
+    # -- world sizes -------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._degrees.get("dp", 1)
+
+    def get_model_parallel_world_size(self):
+        return self._degrees.get("mp", 1)
+
+    def get_pipe_parallel_world_size(self):
+        return self._degrees.get("pp", 1)
+
+    def get_sharding_parallel_world_size(self):
+        return self._degrees.get("sharding", 1)
+
+    def get_sep_parallel_world_size(self):
+        return self._degrees.get("sep", 1)
+
+    # -- ranks (single-controller: coordinates only exist in-trace) -------
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # -- groups ------------------------------------------------------------
+    def _group(self, axis):
+        return Group(axis_name=axis if axis in self._mesh.dim_names
+                     else None, mesh=self._mesh)
+
+    def get_data_parallel_group(self):
+        return self._group("dp")
+
+    def get_model_parallel_group(self):
+        return self._group("mp")
+
+    def get_pipe_parallel_group(self):
+        return self._group("pp")
+
+    def get_sharding_parallel_group(self):
+        return self._group("sharding")
+
+    def get_sep_parallel_group(self):
+        return self._group("sep")
+
+    def get_check_parallel_group(self, *a, **k):
+        return Group(axis_name=None, mesh=self._mesh)
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+
+_hcg = None
+
+
+def get_hcg():
+    return _hcg
+
+
+def set_hcg(hcg):
+    global _hcg
+    _hcg = hcg
+    return hcg

@@ -1,0 +1,100 @@
+"""Activation recompute (gradient checkpointing).
+
+Parity: reference `python/paddle/distributed/fleet/recompute/recompute.py`
+(RecomputeFunction :109, recompute() :423 with RNG-state replay,
+recompute_sequential). TPU-first: inside the compiled train step this is
+`jax.checkpoint` (XLA rematerialization — the exact FLOPs-for-HBM trade
+the reference implements by hand); on the eager tape we record a PyLayer
+that re-runs the function in backward with the saved RNG key.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..autograd.py_layer import PyLayer
+from ..core import random as random_mod
+from ..core.autograd import enable_grad, no_grad
+from ..core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+class _RecomputeFunction(PyLayer):
+    @staticmethod
+    def forward(ctx, fn, preserve_rng, kwargs, *args):
+        ctx.fn = fn
+        ctx.kwargs = kwargs
+        ctx.preserve_rng = preserve_rng
+        if preserve_rng:
+            ctx.rng_key = random_mod.default_generator().get_state()
+        ctx.inputs = args
+        ctx.tensor_indices = [i for i, a in enumerate(args)
+                              if isinstance(a, Tensor)]
+        with no_grad():
+            out = fn(*args, **kwargs)
+        return out
+
+    @staticmethod
+    def backward(ctx, *grads):
+        # re-run forward with grad recording under the saved RNG state
+        detached = []
+        for a in ctx.inputs:
+            if isinstance(a, Tensor):
+                d = a.detach()
+                d.stop_gradient = a.stop_gradient
+                detached.append(d)
+            else:
+                detached.append(a)
+        def rerun():
+            with enable_grad():
+                return ctx.fn(*detached, **ctx.kwargs)
+
+        if ctx.preserve_rng:
+            with random_mod.scoped_key(ctx.rng_key):
+                out = rerun()
+        else:
+            out = rerun()
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        outs = [o for o in outs if isinstance(o, Tensor)]
+        if all(o.stop_gradient for o in outs):  # nothing requires grad
+            return tuple(None for _ in ctx.tensor_indices)
+        # tape backward: accumulates into model param .grad directly
+        # (reference RecomputeFunction.backward runs paddle.autograd
+        # .backward on the re-forward) and into the detached inputs
+        from ..core.autograd import backward as tape_backward
+        tape_backward(outs, grad_tensors=list(grads), retain_graph=False)
+        result = []
+        for i in ctx.tensor_indices:
+            t = detached[i]
+            result.append(None if t.stop_gradient or t.grad is None
+                          else t.grad)
+        return tuple(result)
+
+
+def recompute(function, *args, **kwargs):
+    """paddle.distributed.fleet.recompute parity. ``use_reentrant`` and
+    ``preserve_rng_state`` accepted."""
+    preserve_rng = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", None)
+    return _RecomputeFunction.apply(function, preserve_rng, kwargs, *args)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Apply recompute over chunks of a Sequential (reference
+    recompute_sequential)."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    chunk = max(len(layers) // segments, 1)
+
+    def run_chunk(sub):
+        def fn(x):
+            for l in sub:
+                x = l(x)
+            return x
+        return fn
+
+    x = args[0]
+    for start in range(0, len(layers), chunk):
+        x = recompute(run_chunk(layers[start:start + chunk]), x, **kwargs)
+    return x

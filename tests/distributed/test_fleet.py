@@ -1,0 +1,121 @@
+"""Fleet facade + mpu layers + recompute on the virtual 8-device mesh."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu import distributed as dist
+from paddle_tpu.distributed import fleet
+
+
+def _fleet_init(dp=2, mp=4):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_hybrid_communicate_group()
+
+
+def test_fleet_init_topology():
+    hcg = _fleet_init()
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_world_size() == 4
+    assert hcg.get_parallel_mode() == "hybrid"
+    assert dist.get_mesh() is hcg.mesh
+
+
+def test_mpu_layers_forward_backward():
+    hcg = _fleet_init()
+    paddle.seed(5)
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = fleet.VocabParallelEmbedding(128, 32)
+            self.col = fleet.ColumnParallelLinear(32, 64, has_bias=True,
+                                                  gather_output=False)
+            self.row = fleet.RowParallelLinear(64, 32,
+                                               input_is_parallel=True)
+
+        def forward(self, ids):
+            return self.row(nn.functional.relu(self.col(self.embed(ids))))
+
+    model = MLP()
+    w = model.col.weight
+    assert "mp" in str(w._data.sharding.spec)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+    step = dist.ShardedTrainStep(
+        model, opt, lambda m, ids: m(ids).mean(), mesh=hcg.mesh,
+        data_placements=[dist.Shard(0)] + [dist.Replicate()] * 1)
+    ids = paddle.to_tensor(np.random.randint(0, 128, (8, 16)).astype(
+        "int64"))
+    loss = step(ids)
+    assert np.isfinite(float(loss))
+
+
+def test_mpu_matches_plain_linear():
+    """TP layers numerically equal plain layers with the same weights."""
+    hcg = _fleet_init()
+    paddle.seed(5)
+    col = fleet.ColumnParallelLinear(16, 32, has_bias=True)
+    plain = nn.Linear(16, 32)
+    plain.weight.set_value(col.weight.numpy())
+    plain.bias.set_value(col.bias.numpy())
+    x = paddle.randn([4, 16])
+    np.testing.assert_allclose(col(x).numpy(), plain(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_recompute_matches_plain():
+    paddle.seed(9)
+    model = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 8))
+    x_np = np.random.randn(4, 8).astype("float32")
+
+    x1 = paddle.to_tensor(x_np)
+    out1 = model(x1).sum()
+    out1.backward()
+    g1 = model[0].weight.grad.numpy().copy()
+    model.clear_gradients()
+
+    x2 = paddle.to_tensor(x_np)
+    out2 = dist.recompute(lambda t: model(t), x2).sum()
+    out2.backward()
+    g2 = model[0].weight.grad.numpy()
+    np.testing.assert_allclose(g1, g2, rtol=1e-5, atol=1e-6)
+
+
+def test_recompute_dropout_rng_replay():
+    """Dropout must produce identical masks in re-forward (RNG replay)."""
+    paddle.seed(42)
+    drop = nn.Dropout(0.5)
+    lin = nn.Linear(16, 16)
+    x = paddle.randn([4, 16])
+
+    def block(t):
+        return drop(lin(t))
+
+    out = dist.recompute(block, x)
+    out.sum().backward()
+    # grads exist and are finite — mask mismatch between fwd/bwd would
+    # surface as wrong (often inf/nan-free but inconsistent) grads; we
+    # check determinism by rerunning with the same seed
+    g1 = lin.weight.grad.numpy().copy()
+    lin.clear_gradients()
+    paddle.seed(42)
+    out2 = dist.recompute(block, x)
+    out2.sum().backward()
+    np.testing.assert_allclose(g1, lin.weight.grad.numpy())
+
+
+def test_rng_state_tracker():
+    tracker = fleet.get_rng_state_tracker()
+    tracker.reset()
+    tracker.add("model_parallel_rng", 1234)
+    with tracker.rng_state("model_parallel_rng"):
+        a = paddle.randn([4]).numpy()
+    with tracker.rng_state("model_parallel_rng"):
+        b = paddle.randn([4]).numpy()
+    # state advances across uses
+    assert not np.allclose(a, b)
