@@ -1,0 +1,198 @@
+"""Pipeline parallelism, TPU-native.
+
+Parity target: the reference's PP engine — `PipelineLayer`
+(pp_layers.py:257), 1F1B/interleave schedules (pipeline_parallel.py:545,
+1136), p2p via batch_isend_irecv (p2p_communication.py), and the C++
+fleet_executor 1F1B interceptors (SURVEY.md §2.1).
+
+TPU-first redesign: NCCL-style imperative p2p does not exist under XLA; a
+pipeline is instead expressed INSIDE the compiled program as a microbatch
+loop over a `shard_map` region on the `pp` mesh axis:
+
+- every stage's block parameters are STACKED on a leading layers axis that
+  is sharded over `pp` (each device holds its stage's contiguous slice);
+- one fused `lax.scan` loop runs the GPipe/FThenB schedule: at tick t a
+  stage computes its micro-step and hands the activation to the next stage
+  with `lax.ppermute` (the XLA-native batch_isend_irecv);
+- the loop is differentiable — `jax.vjp` through ppermute IS the backward
+  pipeline (reversed ring), so fwd+bwd+optimizer still compile into ONE
+  XLA program, with XLA overlapping the ICI transfer with stage compute;
+- embedding runs before the loop and the LM head after it, each under
+  plain GSPMD sharding (their params live replicated on the pp axis).
+
+Bubble fraction matches GPipe: (P-1)/(M+P-1); raise micro-batch count M to
+amortize, and wrap blocks in remat for the 1F1B memory profile.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..core.dispatch import apply
+from ..core.tensor import Parameter, Tensor
+from .api import shard_tensor
+from .mesh import ProcessMesh
+from .placement import Replicate, Shard
+
+__all__ = ["PipelineDecoderLM"]
+
+
+def _functional_call(layer, params, *xs):
+    """Run ``layer`` with ``params`` (dict name->array) swapped in;
+    trace-safe (same rebinding trick as jit.TrainStep). Gradients flow
+    through the pure wrapper, not the tape, so params are frozen here."""
+    items = list(layer.named_parameters())
+    restore = []
+    try:
+        for name, p in items:
+            restore.append((p, p._data, p._node, p.stop_gradient))
+            p._data = params[name]
+            p._node = None
+            p.stop_gradient = True
+        args = [Tensor(x) if not isinstance(x, Tensor) else x for x in xs]
+        out = layer(*args)
+        return out._data if isinstance(out, Tensor) else out
+    finally:
+        for p, data, node, sg in restore:
+            p._data = data
+            p._node = node
+            p.stop_gradient = sg
+
+
+class PipelineDecoderLM(nn.Layer):
+    """Decoder-LM pipeline wrapper.
+
+    ``embed``: Layer mapping int ids -> hidden states
+    ``blocks``: LayerList of IDENTICAL blocks (length L, L % pp == 0) —
+        stacked on a leading axis and sharded over ``pp``
+    ``head``: Layer mapping final hidden -> logits
+    ``loss_fn(logits, labels) -> scalar Tensor`` (mean reduction)
+
+    Block parameters are re-registered as stacked [L, ...] Parameters with
+    Shard(0) on the pp axis, so ``parameters()`` is pipeline-ready and any
+    Optimizer / (Sharded)TrainStep works unchanged.
+    """
+
+    def __init__(self, embed, blocks, head, loss_fn, mesh: ProcessMesh,
+                 pp_axis="pp", num_microbatches=None):
+        super().__init__()
+        self.embed = embed
+        self.head = head
+        self._loss_fn = loss_fn
+        self._mesh = mesh
+        self._pp_axis = pp_axis
+        self._pp = mesh.get_dim_size(pp_axis)
+        self._n_micro = num_microbatches or self._pp
+        self._template = blocks[0]
+        self._n_layers = len(blocks)
+        assert self._n_layers % self._pp == 0, \
+            "layer count must divide pp degree"
+
+        names = [n for n, _ in blocks[0].named_parameters()]
+        self._block_param_names = names
+        self._stacked = nn.ParameterList()
+        pp_idx = mesh.dim_names.index(pp_axis)
+        for name in names:
+            arrs = [dict(b.named_parameters())[name]._data for b in blocks]
+            stacked = Parameter(jnp.stack(arrs, 0))
+            stacked.name = "blocks." + name
+            placements = [Replicate()] * mesh.ndim
+            placements[pp_idx] = Shard(0)
+            shard_tensor(stacked, mesh, placements)
+            self._stacked.append(stacked)
+
+    def stacked_parameters(self):
+        return list(self._stacked)
+
+    def unstack_block_state(self):
+        """[L, ...] stacked arrays -> per-block state dicts (for
+        checkpoint interop with the unstacked model form)."""
+        out = []
+        for i in range(self._n_layers):
+            out.append({
+                name: Tensor(p._data[i])
+                for name, p in zip(self._block_param_names, self._stacked)})
+        return out
+
+    def forward(self, input_ids):
+        raise NotImplementedError(
+            "PipelineDecoderLM computes loss inside the pipeline; "
+            "use .loss(ids, labels)")
+
+    def loss(self, input_ids, labels):
+        mesh = self._mesh
+        pp_axis = self._pp_axis
+        pp = self._pp
+        M = self._n_micro
+        template = self._template
+        embed, head, loss_fn = self.embed, self.head, self._loss_fn
+        names = self._block_param_names
+
+        embed_items = list(embed.named_parameters())
+        head_items = list(head.named_parameters())
+        n_embed = len(embed_items)
+        n_head = len(head_items)
+
+        def pure(ids, lab, *flat_params):
+            e_params = dict(zip([n for n, _ in embed_items],
+                                flat_params[:n_embed]))
+            h_params = dict(zip([n for n, _ in head_items],
+                                flat_params[n_embed:n_embed + n_head]))
+            b_params = dict(zip(names, flat_params[n_embed + n_head:]))
+
+            x = _functional_call(embed, e_params, ids)
+            mb = ids.shape[0] // M
+            x_micro = x.reshape(M, mb, *x.shape[1:])
+
+            block_spec = jax.tree.map(lambda _: P(pp_axis), b_params)
+
+            def pipe_body(x_all, local_blocks):
+                stage = lax.axis_index(pp_axis)
+                is_first = stage == 0
+                is_last = stage == pp - 1
+                perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+                def run_stage(h):
+                    def scan_block(h, one_block):
+                        return _functional_call(template, one_block,
+                                                h), None
+                    h, _ = lax.scan(scan_block, h, local_blocks)
+                    return h
+
+                def tick(carry, t):
+                    src_idx = jnp.clip(t, 0, M - 1)
+                    inp = jnp.where(
+                        is_first,
+                        lax.dynamic_index_in_dim(x_all, src_idx, 0,
+                                                 keepdims=False),
+                        carry)
+                    out = run_stage(inp)
+                    collected = jnp.where(
+                        jnp.logical_and(is_last, t >= pp - 1), out, 0.0)
+                    carry = lax.ppermute(out, pp_axis, perm)
+                    return carry, collected
+
+                _, outs = lax.scan(tick, jnp.zeros_like(x_all[0]),
+                                   jnp.arange(M + pp - 1))
+                # outs[pp-1:] are the M last-stage results (zeros
+                # elsewhere); share across stages so the head can run
+                # under plain GSPMD afterwards
+                final = lax.psum(outs[pp - 1:], pp_axis)
+                return final
+
+            final = jax.shard_map(
+                pipe_body, mesh=mesh.jax_mesh,
+                in_specs=(P(), block_spec), out_specs=P(),
+                check_vma=False)(x_micro, b_params)
+            hidden = final.reshape(ids.shape[0], *final.shape[2:])
+            logits = _functional_call(head, h_params, hidden)
+            out = loss_fn(Tensor(logits), Tensor(lab))
+            return out._data if isinstance(out, Tensor) else out
+
+        flat = ([p for _, p in embed_items] + [p for _, p in head_items] +
+                list(self._stacked))
+        return apply(pure, input_ids, labels, *flat, name="pipeline_loss")
