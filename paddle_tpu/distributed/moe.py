@@ -1,0 +1,174 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Parity: reference `python/paddle/incubate/distributed/models/moe/`
+(MoELayer :263, MoEScatter/MoEGather PyLayers over global_scatter/
+global_gather all-to-all collective ops, gates gshard/switch/naive,
+capacity pruning kernels prune_gate_by_capacity/limit_by_capacity).
+
+TPU-first (GShard formulation): routing is expressed as dense one-hot
+dispatch/combine einsums over an expert axis; expert weights are stacked
+[E, ...] and sharded over the `ep` mesh axis, so GSPMD partitions the
+vmapped expert compute and inserts the all-to-alls the reference issues
+manually via global_scatter/global_gather. Capacity pruning is the
+position-in-expert cumsum mask — same semantics as limit_by_capacity.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import apply
+from ..core.tensor import Parameter, Tensor
+from .api import shard_tensor
+from .mesh import get_mesh
+from .placement import Replicate, Shard
+
+__all__ = ["MoELayer", "TopKGate"]
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+def topk_gating(logits, top_k, capacity, *, second_noise=0.0, key=None):
+    """GShard-style top-k dispatch/combine.
+
+    logits: [T, E] float32. Returns (dispatch [T,E,C] bool-ish,
+    combine [T,E,C] float, aux_loss scalar).
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    gates = []
+    masks = []
+    p = probs
+    for k in range(top_k):
+        idx = jnp.argmax(p, axis=-1)
+        mask = _one_hot(idx, E)
+        gates.append(jnp.sum(probs * mask, axis=-1))  # [T]
+        masks.append(mask)
+        p = p * (1.0 - mask)
+
+    # aux load-balance loss (GShard eq.4 / reference gshard_gate)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(masks[0], axis=0)
+    aux = jnp.sum(me * ce) * E
+
+    # position within each expert's queue, over all k choices in order
+    dispatch = jnp.zeros((T, E, capacity), jnp.float32)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    prev_counts = jnp.zeros((E,), jnp.float32)
+    # top-1 = Switch semantics (raw router prob); top-k>1 = Mixtral/GShard
+    # normalization over the chosen experts
+    denom = sum(gates) if top_k > 1 else jnp.ones_like(gates[0])
+    for mask, gate in zip(masks, gates):
+        pos = jnp.cumsum(mask, axis=0) - 1.0 + prev_counts[None, :]
+        prev_counts = prev_counts + jnp.sum(mask, axis=0)
+        in_cap = (pos < capacity) & (mask > 0)
+        pos_clamped = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+        sel = in_cap.astype(jnp.float32)  # [T, E]
+        pos_oh = _one_hot(pos_clamped, capacity) * sel[..., None]
+        dispatch = dispatch + mask[..., None] * pos_oh
+        gate_norm = jnp.where(denom > 0, gate / jnp.maximum(denom, 1e-9),
+                              0.0)
+        combine = combine + (gate_norm[:, None, None] *
+                             mask[..., None] * pos_oh)
+    return dispatch, combine, aux
+
+
+class TopKGate(nn.Layer):
+    """Gate network (reference gate/gshard_gate.py, switch_gate.py: switch
+    is top_k=1, gshard top_k=2)."""
+
+    def __init__(self, d_model, num_experts, top_k=2,
+                 capacity_factor=1.25):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter(
+            shape=[d_model, num_experts],
+            default_initializer=nn.initializer.XavierUniform())
+
+    def capacity(self, num_tokens):
+        return max(int(math.ceil(
+            self.top_k * num_tokens / self.num_experts *
+            self.capacity_factor)), 4)
+
+
+class MoELayer(nn.Layer):
+    """MoE layer (reference moe_layer.py:263 API: gate + experts +
+    moe_group). ``experts``: list of identical Layers (e.g. LlamaMLP).
+    aux loss is accumulated on ``self.aux_loss`` each forward (the
+    reference returns it via gate state)."""
+
+    def __init__(self, gate=None, experts=None, d_model=None,
+                 num_experts=None, top_k=2, capacity_factor=1.25,
+                 mesh=None, ep_axis=None, moe_group=None,
+                 recompute_interval=0):
+        super().__init__()
+        if gate is None:
+            gate = TopKGate(d_model, num_experts or len(experts),
+                            top_k=top_k, capacity_factor=capacity_factor)
+        self.gate = gate
+        self._template = experts[0]
+        self._n_experts = len(experts)
+        self._mesh = mesh or get_mesh()
+        self._ep_axis = ep_axis
+        self.aux_loss = None
+
+        names = [n for n, _ in experts[0].named_parameters()]
+        self._expert_param_names = names
+        self._stacked = nn.ParameterList()
+        for name in names:
+            arrs = [dict(e.named_parameters())[name]._data for e in experts]
+            stacked = Parameter(jnp.stack(arrs, 0))
+            stacked.name = "experts." + name
+            if self._mesh is not None and ep_axis is not None and \
+                    ep_axis in self._mesh.dim_names:
+                placements = [Replicate()] * self._mesh.ndim
+                placements[self._mesh.dim_names.index(ep_axis)] = Shard(0)
+                shard_tensor(stacked, self._mesh, placements)
+            self._stacked.append(stacked)
+
+    def forward(self, x):
+        E = self._n_experts
+        top_k = self.gate.top_k
+        template = self._template
+        names = self._expert_param_names
+        orig_shape = None
+
+        T = 1
+        for s in x.shape[:-1]:
+            T *= s
+        capacity = self.gate.capacity(T)
+
+        def pure(xa, gate_w, *expert_params):
+            shape = xa.shape
+            tokens = xa.reshape(-1, shape[-1])  # [T, d]
+            logits = (tokens.astype(jnp.float32) @
+                      gate_w.astype(jnp.float32))
+            dispatch, combine, aux = topk_gating(logits, top_k, capacity)
+            # dispatch tokens: [E, C, d]
+            expert_in = jnp.einsum("tec,td->ecd",
+                                   dispatch.astype(xa.dtype), tokens)
+            params = dict(zip(names, expert_params))
+
+            def run_one(p_one, x_one):
+                from .pipeline import _functional_call
+                return _functional_call(template, p_one, x_one)
+
+            expert_out = jax.vmap(run_one)(params, expert_in)  # [E, C, d']
+            out = jnp.einsum("ecd,tec->td", expert_out,
+                             combine.astype(expert_out.dtype))
+            out = out.reshape(*shape[:-1], out.shape[-1]).astype(xa.dtype)
+            return out, aux.astype(jnp.float32)
+
+        out, aux = apply(pure, x, self.gate.weight, *list(self._stacked),
+                         name="moe")
+        self.aux_loss = aux
+        return out

@@ -3,3 +3,4 @@
 
 from .gpt import GPT, GPTConfig  # noqa: F401
 from .llama import Llama, LlamaConfig  # noqa: F401
+from .mixtral import Mixtral, MixtralConfig  # noqa: F401

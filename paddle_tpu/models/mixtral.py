@@ -1,0 +1,103 @@
+"""Mixtral (MoE Llama) family — the EP workload of BASELINE.json's config
+ladder (reference analogue: incubate MoELayer + fused_moe,
+python/paddle/incubate/distributed/models/moe/moe_layer.py:263)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .. import nn
+from ..distributed.moe import MoELayer, TopKGate
+from ..nn import functional as F
+from .llama import (
+    LlamaAttention, LlamaConfig, LlamaMLP, _normal_attr,
+)
+
+
+@dataclasses.dataclass
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+    @staticmethod
+    def mixtral_8x7b():
+        return MixtralConfig(vocab_size=32000, hidden_size=4096,
+                             intermediate_size=14336, num_layers=32,
+                             num_heads=32, num_kv_heads=8,
+                             max_position_embeddings=32768,
+                             rope_theta=1e6, num_experts=8, top_k=2)
+
+    @staticmethod
+    def tiny():
+        return MixtralConfig(vocab_size=256, hidden_size=64,
+                             intermediate_size=128, num_layers=2,
+                             num_heads=4, num_kv_heads=2,
+                             max_position_embeddings=64, num_experts=4,
+                             top_k=2)
+
+
+class MixtralBlock(nn.Layer):
+    def __init__(self, config: MixtralConfig, mesh=None, ep_axis=None):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps)
+        experts = [LlamaMLP(config) for _ in range(config.num_experts)]
+        gate = TopKGate(config.hidden_size, config.num_experts,
+                        top_k=config.top_k,
+                        capacity_factor=config.capacity_factor)
+        self.moe = MoELayer(gate=gate, experts=experts, mesh=mesh,
+                            ep_axis=ep_axis)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.moe(self.post_attention_layernorm(x))
+        return x
+
+
+class Mixtral(nn.Layer):
+    def __init__(self, config: MixtralConfig, mesh=None, ep_axis=None):
+        super().__init__()
+        self.config = config
+        std = config.initializer_range
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size,
+                                         weight_attr=_normal_attr(std))
+        self.layers = nn.LayerList(
+            [MixtralBlock(config, mesh=mesh, ep_axis=ep_axis)
+             for _ in range(config.num_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size,
+                               epsilon=config.rms_norm_eps)
+        self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                 weight_attr=_normal_attr(std),
+                                 bias_attr=False)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for block in self.layers:
+            x = block(x)
+        return self.lm_head(self.norm(x))
+
+    def aux_loss(self):
+        from .. import ops
+        total = None
+        for block in self.layers:
+            a = block.moe.aux_loss
+            if a is not None:
+                total = a if total is None else total + a
+        return total
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        ce = F.cross_entropy(logits[:, :-1, :], labels[:, 1:])
+        aux = self.aux_loss()
+        if aux is not None:
+            ce = ce + self.config.aux_loss_weight * aux
+        return ce
+
+    def num_params(self):
+        return sum(p.size for p in self.parameters())
