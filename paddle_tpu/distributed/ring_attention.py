@@ -1,0 +1,100 @@
+"""Ring attention: context parallelism for long sequences.
+
+Fills the reference's acknowledged gap (SURVEY.md §5.7: the `sep` mesh
+axis exists — topology.py:65, segment_parallel.py:26 — but no ring /
+blockwise attention kernel ships in the snapshot; PaddleNLP carries it).
+
+TPU-native design: q/k/v are sequence-sharded over the `sep` mesh axis.
+Inside `shard_map`, each device computes blockwise attention between its
+local queries and a rotating ring of k/v chunks (`lax.ppermute` over ICI),
+merging partial results with the online-softmax recurrence (the flash-
+attention merge). Communication overlaps with the next chunk's compute
+under XLA's async collectives; memory is O(seq/cp) per device. Causal
+masking compares global positions, so chunks that are entirely in the
+future are numerically masked (their contribution underflows to zero
+weight) without data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.dispatch import apply
+
+__all__ = ["ring_attention"]
+
+
+def _ring_body(q, k, v, *, axis, cp, causal, scale):
+    """Runs on [b, s_local, h, d] shards inside shard_map."""
+    idx = lax.axis_index(axis)
+    b, sq, h, d = q.shape
+    NEG = jnp.float32(-1e30)
+
+    pos_q = idx * sq + jnp.arange(sq)  # global query positions
+
+    def partial_attn(carry, step):
+        o, m, l, k_chunk, v_chunk = carry
+        src = (idx - step) % cp  # which device's kv we hold this step
+        pos_k = src * sq + jnp.arange(sq)
+        logits = jnp.einsum("bsnd,btnd->bnst", q, k_chunk,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = pos_k[None, :] <= pos_q[:, None]  # [sq, sk]
+            logits = jnp.where(mask[None, None], logits, NEG)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        # guard: rows with no valid key yet keep m at -inf-ish
+        p = jnp.exp(logits - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bnst,btnd->bsnd", p.astype(v_chunk.dtype), v_chunk
+        ).astype(jnp.float32).transpose(0, 2, 1, 3)
+        # rotate kv ring: pass our chunk to the next device
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+        k_next = lax.ppermute(k_chunk, axis, perm)
+        v_next = lax.ppermute(v_chunk, axis, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(
+        partial_attn, (o0, m0, l0, k, v), jnp.arange(cp))
+    out = o / jnp.maximum(l[..., None], 1e-20)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [b, sq, h, d]
+
+
+def ring_attention(query, key, value, mesh=None, axis="sep", causal=True,
+                   scale=None):
+    """Context-parallel attention on Tensors [b, s, h, d] with the
+    sequence dim (logically) sharded over ``axis``. Differentiable; the
+    VJP is the reversed ring (jax transposes ppermute automatically)."""
+    from .mesh import get_mesh
+
+    mesh = mesh or get_mesh()
+    cp = mesh.get_dim_size(axis)
+    d = query.shape[-1]
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    def fn(q, k, v):
+        kh, qh = k.shape[2], q.shape[2]
+        if kh != qh:  # GQA
+            rep = qh // kh
+            k2 = jnp.repeat(k, rep, axis=2)
+            v2 = jnp.repeat(v, rep, axis=2)
+        else:
+            k2, v2 = k, v
+        spec = P(None, axis, None, None)
+        body = jax.shard_map(
+            lambda a, b_, c: _ring_body(a, b_, c, axis=axis, cp=cp,
+                                        causal=causal, scale=sm_scale),
+            mesh=mesh.jax_mesh, in_specs=(spec, spec, spec),
+            out_specs=spec, check_vma=False)
+        return body(q, k2, v2)
+
+    return apply(fn, query, key, value, name="ring_attention")
