@@ -1,0 +1,146 @@
+"""Distributed (sharded) checkpoint.
+
+Parity: reference `python/paddle/distributed/checkpoint/` —
+`save_state_dict` (save_state_dict.py:100: per-rank local shards + global
+metadata, replicated-tensor dedup :72) and `load_state_dict` (reshards
+across mismatched meshes/strategies at load).
+
+TPU-first: the single-controller runtime holds global (sharded) arrays, so
+"shards" are the addressable shards of each jax.Array. Each HOST writes
+only its addressable shards (multi-host safe) plus one metadata.json
+mapping tensor -> (global shape/dtype, shard index ranges, file). Loading
+reassembles the global array and `device_put`s it to the TARGET sharding —
+cross-strategy resharding for free (the reference needs explicit reshard
+functions). Async save runs on a background thread (orbax-style), double
+parity with the reference's async_save.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict"]
+
+_METADATA = "metadata.json"
+
+
+def _flatten(sd, prefix=""):
+    flat = {}
+    for k, v in sd.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            flat.update(_flatten(v, key))
+        else:
+            flat[key] = v
+    return flat
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None, async_save=False):
+    """Write sharded checkpoint to directory ``path``."""
+    os.makedirs(path, exist_ok=True)
+    flat = _flatten(state_dict)
+    host = jax.process_index()
+    meta = {"tensors": {}, "num_hosts": jax.process_count()}
+    shard_file = os.path.join(path, f"shards_{host}.npz")
+    arrays = {}
+    for name, t in flat.items():
+        if isinstance(t, Tensor):
+            arr = t._data
+        elif isinstance(t, (int, float, str)):
+            meta["tensors"][name] = {"scalar": t}
+            continue
+        else:
+            arr = t
+        arr = jax.device_get(arr) if not isinstance(arr, jax.Array) else arr
+        entry = {"shape": list(np.shape(arr)),
+                 "dtype": str(getattr(arr, "dtype", "float32")),
+                 "shards": []}
+        if isinstance(arr, jax.Array) and hasattr(arr, "addressable_shards"):
+            seen_indices = set()
+            for i, sh in enumerate(arr.addressable_shards):
+                idx = tuple(
+                    (0 if s.start is None else s.start,
+                     dim if s.stop is None else s.stop)
+                    for s, dim in zip(sh.index, arr.shape)) if sh.index \
+                    else ()
+                if idx in seen_indices:  # dedup replicated shards
+                    continue
+                seen_indices.add(idx)
+                key = f"{name}::{i}"
+                arrays[key] = np.asarray(sh.data)
+                entry["shards"].append({"key": key, "index": list(idx),
+                                        "host": host})
+        else:
+            key = f"{name}::0"
+            arrays[key] = np.asarray(arr)
+            entry["shards"].append(
+                {"key": key,
+                 "index": [[0, d] for d in np.shape(arr)], "host": host})
+        meta["tensors"][name] = entry
+
+    def _write():
+        np.savez(shard_file, **{k: v for k, v in arrays.items()})
+        if host == coordinator_rank:
+            with open(os.path.join(path, _METADATA), "w") as f:
+                json.dump(meta, f)
+
+    if async_save:
+        th = threading.Thread(target=_write, daemon=True)
+        th.start()
+        return th
+    _write()
+
+
+def async_save_state_dict(state_dict, path, **kw):
+    return save_state_dict(state_dict, path, async_save=True, **kw)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None):
+    """Fill ``state_dict``'s tensors in place from ``path``, resharding to
+    each target tensor's current sharding (any source strategy)."""
+    with open(os.path.join(path, _METADATA)) as f:
+        meta = json.load(f)
+    files = {}
+    for fn in os.listdir(path):
+        if fn.startswith("shards_") and fn.endswith(".npz"):
+            files[fn] = np.load(os.path.join(path, fn))
+
+    def lookup(key):
+        for z in files.values():
+            if key in z:
+                return z[key]
+        raise KeyError(key)
+
+    flat = _flatten(state_dict)
+    for name, target in flat.items():
+        if name not in meta["tensors"]:
+            continue
+        entry = meta["tensors"][name]
+        if "scalar" in entry:
+            continue
+        import ml_dtypes
+        dtype = entry["dtype"]
+        np_dtype = getattr(ml_dtypes, dtype) if "bfloat16" in dtype or \
+            "float8" in dtype else np.dtype(dtype)
+        full = np.zeros(entry["shape"], np_dtype)
+        for sh in entry["shards"]:
+            data = lookup(sh["key"])
+            sl = tuple(slice(lo, hi) for lo, hi in sh["index"]) or ...
+            full[sl] = data
+        if isinstance(target, Tensor):
+            arr = full
+            if getattr(target._data, "sharding", None) is not None and \
+                    not isinstance(target._data, jax.core.Tracer):
+                arr = jax.device_put(full, target._data.sharding)
+            target._rebind(arr if isinstance(arr, jax.Array)
+                           else jax.numpy.asarray(arr))
+    return state_dict
