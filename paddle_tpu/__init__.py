@@ -45,6 +45,11 @@ from . import metric  # noqa: F401
 from . import incubate  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import distributed  # noqa: F401
+from .core.flags import get_flags, set_flags  # noqa: F401
+from . import profiler  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
+from .amp import debugging as _amp_debugging  # noqa: F401
 
 __version__ = "0.1.0"
 

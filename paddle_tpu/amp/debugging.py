@@ -1,0 +1,130 @@
+"""Numerics debugging.
+
+Parity: reference `python/paddle/amp/debugging.py` — `TensorCheckerConfig`
+(:174), `enable_tensor_checker/disable_tensor_checker`, `check_numerics`
+(:362), op-stats collection (:482) — backed by `FLAGS_check_nan_inf` and
+the per-op check hook in core.dispatch (the analogue of the generated
+ad_funcs' CheckTensorHasNanOrInf, paddle/fluid/eager/nan_inf_utils.h:38).
+"""
+
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flags as flags_mod
+from ..core.tensor import Tensor
+
+__all__ = ["TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "DebugMode"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.
+                 CHECK_NAN_INF_AND_ABORT, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.checked_op_list = set(checked_op_list or ())
+        self.skipped_op_list = set(skipped_op_list or ())
+
+    def _apply(self):
+        flags_mod.set_flags({
+            "FLAGS_check_nan_inf": self.enable,
+            "FLAGS_check_nan_inf_level": self.debug_mode})
+
+
+_config = None
+
+
+def enable_tensor_checker(checker_config=None):
+    global _config
+    _config = checker_config or TensorCheckerConfig()
+    _config._apply()
+
+
+def disable_tensor_checker():
+    flags_mod.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """reference debugging.py:362: returns (num_nan, num_inf, num_zero)."""
+    arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    nan = jnp.sum(jnp.isnan(arr)).astype(jnp.int64)
+    inf = jnp.sum(jnp.isinf(arr)).astype(jnp.int64)
+    zero = jnp.sum(arr == 0).astype(jnp.int64)
+    return Tensor(nan), Tensor(inf), Tensor(zero)
+
+
+def check_array(name, arr):
+    """Dispatch hook: abort/warn on non-finite op outputs (eager only)."""
+    if isinstance(arr, jax.core.Tracer):
+        return
+    if not jnp.issubdtype(arr.dtype, jnp.floating):
+        return
+    finite = bool(jnp.isfinite(arr).all())
+    if finite:
+        return
+    level = flags_mod.flag("FLAGS_check_nan_inf_level")
+    msg = f"Operator {name!r} produced NaN/Inf output"
+    if level == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise FloatingPointError(msg)
+    import warnings
+    warnings.warn(msg)
+
+
+# -- op stats (reference debugging.py:482) --------------------------------
+_op_stats = None
+
+
+def enable_operator_stats_collection():
+    global _op_stats
+    _op_stats = collections.defaultdict(
+        lambda: {"fp32": 0, "fp16": 0, "bf16": 0, "other": 0})
+
+
+def disable_operator_stats_collection():
+    global _op_stats
+    stats = _op_stats
+    _op_stats = None
+    if stats:
+        print("<{:-^120}>".format(" op list "))
+        fmt = "{:<50} | {:<10} | {:<10} | {:<10} | {:<10}"
+        print(fmt.format("OP Type", "FP16 Calls", "BF16 Calls",
+                         "FP32 Calls", "Other Calls"))
+        for op, c in sorted(stats.items()):
+            print(fmt.format(op, c["fp16"], c["bf16"], c["fp32"],
+                             c["other"]))
+        print("<{:-^120}>".format(""))
+    return stats
+
+
+class collect_operator_stats:
+    def __enter__(self):
+        enable_operator_stats_collection()
+        return self
+
+    def __exit__(self, *exc):
+        disable_operator_stats_collection()
+        return False
+
+
+def record_op(name, dtype):
+    if _op_stats is None:
+        return
+    key = {"float32": "fp32", "float16": "fp16",
+           "bfloat16": "bf16"}.get(str(dtype), "other")
+    _op_stats[name][key] += 1

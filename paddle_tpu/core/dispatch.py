@@ -37,6 +37,8 @@ def apply(fn: Callable, *args, name: str = None, **kwargs):
     if amp_state().enabled:
         from ..amp import amp_dispatch_pre
         args = amp_dispatch_pre(name, args)
+    from . import flags as flags_mod
+    check_naninf = flags_mod.flag("FLAGS_check_nan_inf")
     diff_idx = []
     payloads = []
     recording = is_grad_enabled()
@@ -51,6 +53,8 @@ def apply(fn: Callable, *args, name: str = None, **kwargs):
 
     if not diff_idx:
         out = fn(*payloads, **kwargs)
+        _post_op_hooks(name, out if isinstance(out, (tuple, list))
+                       else (out,), check_naninf)
         if isinstance(out, (tuple, list)):
             return [Tensor(o) for o in out]
         return Tensor(out)
@@ -69,6 +73,7 @@ def apply(fn: Callable, *args, name: str = None, **kwargs):
         return (out,)
 
     out_tuple, vjp_fn = jax.vjp(pure, *diff_args)
+    _post_op_hooks(name, out_tuple, check_naninf)
     out_meta = [(o.shape, o.dtype) for o in out_tuple]
     node = Node(vjp_fn, [args[i] for i in diff_idx], out_meta, name=name)
 
@@ -89,6 +94,24 @@ def apply(fn: Callable, *args, name: str = None, **kwargs):
     if was_tuple[0]:
         return outs
     return outs[0]
+
+
+def _post_op_hooks(name, outs, check_naninf):
+    """Per-op post hooks: NaN/Inf sanitizer (FLAGS_check_nan_inf — the
+    generated-ad_func CheckTensorHasNanOrInf analogue) and AMP op-stats."""
+    import sys
+
+    dbg = sys.modules.get("paddle_tpu.amp.debugging")
+    if dbg is not None and getattr(dbg, "_op_stats", None) is not None:
+        for o in outs:
+            if hasattr(o, "dtype"):
+                dbg.record_op(name, o.dtype)
+                break
+    if check_naninf:
+        from ..amp import debugging
+        for o in outs:
+            if hasattr(o, "dtype"):
+                debugging.check_array(name, o)
 
 
 def unwrap(x):

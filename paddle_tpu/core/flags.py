@@ -1,0 +1,90 @@
+"""Runtime flags registry.
+
+Parity: reference `paddle/common/flags_native.cc:91` FlagRegistry + the
+~172 `PHI_DEFINE_EXPORTED_*` flags (paddle/common/flags.cc), surfaced in
+python as `paddle.set_flags/get_flags` and `FLAGS_*` env overrides.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_registry: dict[str, dict] = {}
+
+
+def define_flag(name, default, help="", type=None):
+    t = type or builtin_type(default)
+    env = os.environ.get(name)
+    value = _parse(env, t) if env is not None else default
+    with _lock:
+        _registry[name] = {"value": value, "default": default,
+                           "help": help, "type": t}
+
+
+def builtin_type(v):
+    if isinstance(v, bool):
+        return bool
+    if isinstance(v, int):
+        return int
+    if isinstance(v, float):
+        return float
+    return str
+
+
+def _parse(s, t):
+    if t is bool:
+        return s.lower() in ("1", "true", "yes", "on")
+    return t(s)
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags parity."""
+    with _lock:
+        for name, value in flags.items():
+            if name not in _registry:
+                raise ValueError(f"unknown flag {name!r}")
+            _registry[name]["value"] = _parse(str(value),
+                                              _registry[name]["type"]) \
+                if not isinstance(value, _registry[name]["type"]) else value
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    with _lock:
+        return {name: _registry[name]["value"] for name in flags}
+
+
+def flag(name):
+    return _registry[name]["value"]
+
+
+def get_exported_flag_info_map():
+    with _lock:
+        return {k: dict(v) for k, v in _registry.items()}
+
+
+# -- the flag set (TPU-relevant subset of paddle/common/flags.cc) ---------
+define_flag("FLAGS_check_nan_inf", False,
+            "check every op output for NaN/Inf (reference flags.cc)")
+define_flag("FLAGS_check_nan_inf_level", 0,
+            "0: raise on nan/inf; 1: warn; 3: collect stats only")
+define_flag("FLAGS_benchmark", False, "per-op timing")
+define_flag("FLAGS_use_stride_kernel", True, "strided view kernels")
+define_flag("FLAGS_embedding_deterministic", 0,
+            "deterministic embedding grad accumulation")
+define_flag("FLAGS_cudnn_deterministic", False,
+            "deterministic kernels (XLA is deterministic by default)")
+define_flag("FLAGS_low_precision_op_list", 0, "collect AMP op stats")
+define_flag("FLAGS_allocator_strategy", "auto_growth",
+            "allocator strategy name (HBM is managed by PJRT)")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,
+            "accepted for parity; PJRT preallocation is set via env")
+define_flag("FLAGS_enable_api_kernel_fallback", True,
+            "fall back to CPU when an op is unsupported on device")
+define_flag("FLAGS_max_inplace_grad_add", 0, "grad accumulation chunking")
+define_flag("FLAGS_enable_async_trace", False, "collective watchdog trace")
+define_flag("FLAGS_distributed_timeout", 1800,
+            "collective timeout seconds (coordination service barrier)")

@@ -1,0 +1,12 @@
+"""`paddle.hapi` / `paddle.Model` high-level API.
+
+Parity: reference python/paddle/hapi/model.py (Model.prepare/fit/evaluate/
+predict), callbacks (callbacks.py: ProgBarLogger, ModelCheckpoint,
+EarlyStopping, LRScheduler), summary (model_summary.py).
+"""
+
+from .model import Model  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+)
+from .summary import summary  # noqa: F401

