@@ -1,0 +1,50 @@
+"""On-demand native build for paddle_tpu's C++ runtime components.
+
+Parity note: the reference builds its native core through a CMake
+superbuild (SURVEY.md §2.1 build system); here the native surface is small
+enough that a direct g++ invocation with a content-hash cache does the job
+(rebuilds only when sources change).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_SRC_DIR, "_build")
+_LIBS = {
+    "pt_store": ["tcp_store.cc"],
+    "pt_data": ["token_dataset.cc"],
+}
+_loaded: dict[str, ctypes.CDLL] = {}
+_lock = threading.Lock()
+
+
+def _hash_sources(sources):
+    h = hashlib.sha256()
+    for s in sources:
+        with open(os.path.join(_SRC_DIR, s), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    with _lock:
+        if name in _loaded:
+            return _loaded[name]
+        sources = _LIBS[name]
+        tag = _hash_sources(sources)
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        so_path = os.path.join(_BUILD_DIR, f"lib{name}-{tag}.so")
+        if not os.path.exists(so_path):
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                   "-o", so_path] + \
+                [os.path.join(_SRC_DIR, s) for s in sources] + ["-lpthread"]
+            subprocess.run(cmd, check=True, capture_output=True)
+        lib = ctypes.CDLL(so_path)
+        _loaded[name] = lib
+        return lib

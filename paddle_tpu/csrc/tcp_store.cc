@@ -1,0 +1,381 @@
+// TCPStore: rank-0-hosted key-value rendezvous store.
+//
+// Capability parity with the reference's native store
+// (paddle/phi/core/distributed/store/tcp_store.h:121 TCPStore,
+// tcp_utils.cc socket plumbing): set/get/wait/add/check with blocking
+// waiters, serving distributed bootstrap (the reference broadcasts NCCL
+// unique ids through it; here it backs paddle_tpu.distributed bootstrap
+// and elastic coordination alongside the JAX coordination service).
+//
+// Build: g++ -O2 -shared -fPIC -o libpt_store.so tcp_store.cc -lpthread
+// Exposed as a C ABI consumed via ctypes (paddle_tpu/distributed/store.py).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum class Command : uint8_t { SET = 0, GET = 1, ADD = 2, WAIT = 3,
+                               CHECK = 4, DELETE = 5 };
+
+// ---- framing helpers ----------------------------------------------------
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_bytes(int fd, const std::string& s) {
+  uint32_t len = static_cast<uint32_t>(s.size());
+  return send_all(fd, &len, 4) && (len == 0 || send_all(fd, s.data(), len));
+}
+
+bool recv_bytes(int fd, std::string* out) {
+  uint32_t len = 0;
+  if (!recv_all(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || recv_all(fd, out->data(), len);
+}
+
+// ---- server -------------------------------------------------------------
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port) {}
+
+  bool start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0)
+      return false;
+    if (port_ == 0) {  // report kernel-chosen port
+      socklen_t len = sizeof(addr);
+      ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+      port_ = ntohs(addr.sin_port);
+    }
+    if (::listen(listen_fd_, 128) != 0) return false;
+    running_.store(true);
+    accept_thread_ = std::thread([this] { accept_loop(); });
+    return true;
+  }
+
+  void stop() {
+    running_.store(false);
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::lock_guard<std::mutex> lk(conn_mu_);
+    for (auto& t : handlers_)
+      if (t.joinable()) t.join();
+  }
+
+  int port() const { return port_; }
+
+  ~StoreServer() { stop(); }
+
+ private:
+  void accept_loop() {
+    while (running_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (!running_.load()) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      handlers_.emplace_back([this, fd] { serve(fd); });
+    }
+  }
+
+  void serve(int fd) {
+    while (true) {
+      uint8_t cmd;
+      if (!recv_all(fd, &cmd, 1)) break;
+      std::string key;
+      if (!recv_bytes(fd, &key)) break;
+      switch (static_cast<Command>(cmd)) {
+        case Command::SET: {
+          std::string value;
+          if (!recv_bytes(fd, &value)) { ::close(fd); return; }
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            data_[key] = value;
+          }
+          cv_.notify_all();
+          uint8_t ok = 1;
+          send_all(fd, &ok, 1);
+          break;
+        }
+        case Command::GET: {
+          std::unique_lock<std::mutex> lk(mu_);
+          auto it = data_.find(key);
+          std::string value = it == data_.end() ? "" : it->second;
+          uint8_t found = it != data_.end();
+          lk.unlock();
+          send_all(fd, &found, 1);
+          send_bytes(fd, value);
+          break;
+        }
+        case Command::ADD: {
+          int64_t delta;
+          if (!recv_all(fd, &delta, 8)) { ::close(fd); return; }
+          int64_t result;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            int64_t cur = 0;
+            auto it = data_.find(key);
+            if (it != data_.end())
+              cur = std::stoll(it->second);
+            result = cur + delta;
+            data_[key] = std::to_string(result);
+          }
+          cv_.notify_all();
+          send_all(fd, &result, 8);
+          break;
+        }
+        case Command::WAIT: {
+          int64_t timeout_ms;
+          if (!recv_all(fd, &timeout_ms, 8)) { ::close(fd); return; }
+          std::unique_lock<std::mutex> lk(mu_);
+          bool ok = cv_.wait_for(
+              lk, std::chrono::milliseconds(timeout_ms),
+              [&] { return data_.count(key) > 0; });
+          lk.unlock();
+          uint8_t r = ok ? 1 : 0;
+          send_all(fd, &r, 1);
+          break;
+        }
+        case Command::CHECK: {
+          std::lock_guard<std::mutex> lk(mu_);
+          uint8_t r = data_.count(key) > 0 ? 1 : 0;
+          send_all(fd, &r, 1);
+          break;
+        }
+        case Command::DELETE: {
+          size_t n;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            n = data_.erase(key);
+          }
+          uint8_t r = n > 0 ? 1 : 0;
+          send_all(fd, &r, 1);
+          break;
+        }
+      }
+    }
+    ::close(fd);
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  std::vector<std::thread> handlers_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+};
+
+// ---- client -------------------------------------------------------------
+class StoreClient {
+ public:
+  bool connect_to(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (std::chrono::steady_clock::now() < deadline) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      ::inet_pton(AF_INET, host, &addr.sin_addr);
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+                    sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd_);
+      fd_ = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    return false;
+  }
+
+  bool set(const std::string& key, const std::string& value) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint8_t cmd = static_cast<uint8_t>(Command::SET);
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key) ||
+        !send_bytes(fd_, value))
+      return false;
+    uint8_t ok;
+    return recv_all(fd_, &ok, 1) && ok == 1;
+  }
+
+  bool get(const std::string& key, std::string* value) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint8_t cmd = static_cast<uint8_t>(Command::GET);
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key)) return false;
+    uint8_t found;
+    if (!recv_all(fd_, &found, 1)) return false;
+    if (!recv_bytes(fd_, value)) return false;
+    return found == 1;
+  }
+
+  bool add(const std::string& key, int64_t delta, int64_t* result) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint8_t cmd = static_cast<uint8_t>(Command::ADD);
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key) ||
+        !send_all(fd_, &delta, 8))
+      return false;
+    return recv_all(fd_, result, 8);
+  }
+
+  bool wait(const std::string& key, int64_t timeout_ms) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint8_t cmd = static_cast<uint8_t>(Command::WAIT);
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key) ||
+        !send_all(fd_, &timeout_ms, 8))
+      return false;
+    uint8_t ok;
+    return recv_all(fd_, &ok, 1) && ok == 1;
+  }
+
+  bool check(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint8_t cmd = static_cast<uint8_t>(Command::CHECK);
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key)) return false;
+    uint8_t ok;
+    return recv_all(fd_, &ok, 1) && ok == 1;
+  }
+
+  bool del(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    uint8_t cmd = static_cast<uint8_t>(Command::DELETE);
+    if (!send_all(fd_, &cmd, 1) || !send_bytes(fd_, key)) return false;
+    uint8_t ok;
+    return recv_all(fd_, &ok, 1) && ok == 1;
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;  // one request at a time per connection
+};
+
+}  // namespace
+
+// ---- C ABI --------------------------------------------------------------
+extern "C" {
+
+void* pt_store_server_start(int port) {
+  auto* s = new StoreServer(port);
+  if (!s->start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int pt_store_server_port(void* server) {
+  return static_cast<StoreServer*>(server)->port();
+}
+
+void pt_store_server_stop(void* server) {
+  delete static_cast<StoreServer*>(server);
+}
+
+void* pt_store_client_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient();
+  if (!c->connect_to(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void pt_store_client_free(void* client) {
+  delete static_cast<StoreClient*>(client);
+}
+
+int pt_store_set(void* client, const char* key, const uint8_t* value,
+                 int len) {
+  return static_cast<StoreClient*>(client)->set(
+             key, std::string(reinterpret_cast<const char*>(value),
+                              static_cast<size_t>(len)))
+             ? 0
+             : -1;
+}
+
+// returns value length, -1 if missing; caller passes buffer + capacity
+int pt_store_get(void* client, const char* key, uint8_t* buf, int cap) {
+  std::string value;
+  if (!static_cast<StoreClient*>(client)->get(key, &value)) return -1;
+  int n = static_cast<int>(value.size());
+  if (n > cap) return -2;
+  std::memcpy(buf, value.data(), value.size());
+  return n;
+}
+
+int64_t pt_store_add(void* client, const char* key, int64_t delta) {
+  int64_t result = 0;
+  if (!static_cast<StoreClient*>(client)->add(key, delta, &result))
+    return INT64_MIN;
+  return result;
+}
+
+int pt_store_wait(void* client, const char* key, int64_t timeout_ms) {
+  return static_cast<StoreClient*>(client)->wait(key, timeout_ms) ? 0 : -1;
+}
+
+int pt_store_check(void* client, const char* key) {
+  return static_cast<StoreClient*>(client)->check(key) ? 1 : 0;
+}
+
+int pt_store_delete(void* client, const char* key) {
+  return static_cast<StoreClient*>(client)->del(key) ? 1 : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,109 @@
+"""TCPStore python binding (ctypes over the native store).
+
+Parity: reference `paddle.distributed.TCPStore`
+(paddle/phi/core/distributed/store/tcp_store.h:121, bound in
+pybind/communication.cc): rank-0 hosts the server; every rank connects a
+client. Used for rendezvous/bootstrap next to the JAX coordination
+service, and by the elastic controller.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from ..csrc.build import load_library
+
+
+def _lib():
+    lib = load_library("pt_store")
+    lib.pt_store_server_start.restype = ctypes.c_void_p
+    lib.pt_store_server_start.argtypes = [ctypes.c_int]
+    lib.pt_store_server_port.restype = ctypes.c_int
+    lib.pt_store_server_port.argtypes = [ctypes.c_void_p]
+    lib.pt_store_server_stop.argtypes = [ctypes.c_void_p]
+    lib.pt_store_client_connect.restype = ctypes.c_void_p
+    lib.pt_store_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                            ctypes.c_int]
+    lib.pt_store_client_free.argtypes = [ctypes.c_void_p]
+    lib.pt_store_set.restype = ctypes.c_int
+    lib.pt_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int]
+    lib.pt_store_get.restype = ctypes.c_int
+    lib.pt_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int]
+    lib.pt_store_add.restype = ctypes.c_int64
+    lib.pt_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_int64]
+    lib.pt_store_wait.restype = ctypes.c_int
+    lib.pt_store_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int64]
+    lib.pt_store_check.restype = ctypes.c_int
+    lib.pt_store_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.pt_store_delete.restype = ctypes.c_int
+    lib.pt_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    return lib
+
+
+class TCPStore:
+    """paddle.distributed.TCPStore parity: ``is_master`` hosts the server
+    in-process; all roles hold a client connection."""
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=900):
+        self._lib = _lib()
+        self._server = None
+        self._timeout_ms = int(timeout * 1000)
+        if is_master:
+            self._server = self._lib.pt_store_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = self._lib.pt_store_server_port(self._server)
+        self.host = host
+        self.port = port
+        self._client = self._lib.pt_store_client_connect(
+            host.encode(), port, self._timeout_ms)
+        if not self._client:
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+    def set(self, key, value):
+        data = value if isinstance(value, bytes) else str(value).encode()
+        if self._lib.pt_store_set(self._client, key.encode(), data,
+                                  len(data)) != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key):
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.pt_store_get(self._client, key.encode(), buf,
+                                   len(buf))
+        if n < 0:
+            raise KeyError(key)
+        return buf.raw[:n]
+
+    def add(self, key, amount):
+        r = self._lib.pt_store_add(self._client, key.encode(), int(amount))
+        if r == -(2 ** 63):
+            raise RuntimeError("TCPStore.add failed")
+        return int(r)
+
+    def wait(self, keys, timeout=None):
+        if isinstance(keys, str):
+            keys = [keys]
+        ms = int((timeout or self._timeout_ms / 1000) * 1000)
+        for k in keys:
+            if self._lib.pt_store_wait(self._client, k.encode(), ms) != 0:
+                raise TimeoutError(f"TCPStore.wait timeout on {k!r}")
+
+    def check(self, key):
+        return bool(self._lib.pt_store_check(self._client, key.encode()))
+
+    def delete_key(self, key):
+        return bool(self._lib.pt_store_delete(self._client, key.encode()))
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is None:
+            return
+        if getattr(self, "_client", None):
+            lib.pt_store_client_free(self._client)
+        if getattr(self, "_server", None):
+            lib.pt_store_server_stop(self._server)

@@ -1,0 +1,97 @@
+"""MMapTokenDataset: native LLM-pretraining data pipeline.
+
+Binding over csrc/token_dataset.cc (reference analogue: the C++ DataFeed/
+Dataset path, paddle/fluid/framework/data_feed.cc). Yields [batch,
+seq_len+1] int32 batches; the producer thread prefetches off-GIL so the
+host pipeline overlaps device compute.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ..csrc.build import load_library
+from ..core.tensor import Tensor
+
+
+def _lib():
+    lib = load_library("pt_data")
+    lib.pt_dataset_open.restype = ctypes.c_void_p
+    lib.pt_dataset_open.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_int64, ctypes.c_int64,
+                                    ctypes.c_uint64, ctypes.c_int]
+    lib.pt_dataset_num_batches.restype = ctypes.c_int64
+    lib.pt_dataset_num_batches.argtypes = [ctypes.c_void_p]
+    lib.pt_dataset_num_tokens.restype = ctypes.c_int64
+    lib.pt_dataset_num_tokens.argtypes = [ctypes.c_void_p]
+    lib.pt_dataset_start_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.pt_dataset_next.restype = ctypes.c_int
+    lib.pt_dataset_next.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_int32)]
+    lib.pt_dataset_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class MMapTokenDataset:
+    """Iterate [batch, seq_len+1] windows from a flat token .bin file.
+
+    dtype: 'uint16' (GPT-2 BPE ids) | 'int32' | 'uint8'.
+    """
+
+    _DTYPE_BYTES = {"uint8": 1, "uint16": 2, "int32": 4}
+
+    def __init__(self, path, batch_size, seq_len, dtype="uint16", seed=0,
+                 prefetch=4, return_tensor=True):
+        self._lib = _lib()
+        self._handle = self._lib.pt_dataset_open(
+            str(path).encode(), self._DTYPE_BYTES[dtype], batch_size,
+            seq_len, seed, prefetch)
+        if not self._handle:
+            raise ValueError(f"cannot open token dataset {path!r} "
+                             f"(too small for batch x seq?)")
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self._return_tensor = return_tensor
+        self._epoch = 0
+
+    @property
+    def num_batches(self):
+        return int(self._lib.pt_dataset_num_batches(self._handle))
+
+    @property
+    def num_tokens(self):
+        return int(self._lib.pt_dataset_num_tokens(self._handle))
+
+    def set_epoch(self, epoch):
+        self._epoch = int(epoch)
+
+    def __len__(self):
+        return self.num_batches
+
+    def __iter__(self):
+        self._lib.pt_dataset_start_epoch(self._handle, self._epoch)
+        out = np.empty((self.batch_size, self.seq_len + 1), np.int32)
+        ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        while True:
+            if self._lib.pt_dataset_next(self._handle, ptr) != 0:
+                break
+            batch = out.copy()
+            if self._return_tensor:
+                yield Tensor(batch[:, :-1].astype(np.int64)), \
+                    Tensor(batch[:, 1:].astype(np.int64))
+            else:
+                yield batch
+        self._epoch += 1
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.pt_dataset_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
