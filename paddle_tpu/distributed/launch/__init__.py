@@ -1,0 +1,4 @@
+"""`python -m paddle_tpu.distributed.launch` (reference:
+python/paddle/distributed/launch/main.py:23)."""
+
+from .main import launch, main  # noqa: F401

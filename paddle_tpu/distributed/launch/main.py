@@ -1,0 +1,131 @@
+"""Distributed launcher.
+
+Parity: reference `python/paddle/distributed/launch/` — main.py:23 CLI,
+`CollectiveController.build_pod` (controllers/collective.py:37: per-rank
+env assignment, master rendezvous, log watching), pod/container process
+management (job/), elastic restart (fleet/elastic/manager.py).
+
+TPU mapping: the unit of scheduling is one PROCESS PER HOST (JAX single-
+controller), not per device — `--nproc_per_node` exists for CPU-mesh
+testing and multi-host simulation (reference-style localhost harness,
+SURVEY.md §4). Rendezvous uses the native TCPStore (csrc/tcp_store.cc);
+workers get PADDLE_* envs so `init_parallel_env` finds the topology.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+class LaunchConfig:
+    def __init__(self, args):
+        self.args = args
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None,
+                   help="rendezvous endpoint ip:port (default: self)")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="node count or range 'min:max' (elastic)")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--devices", "--gpus", default=None,
+                   help="device ids (accepted for parity)")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective", "ps"])
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _spawn_worker(rank, world_size, master, args, log_dir):
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world_size),
+        "PADDLE_MASTER": master,
+        "PADDLE_LOCAL_RANK": str(rank % args.nproc_per_node),
+        "PADDLE_GLOBAL_SIZE": str(world_size),
+        "PADDLE_JOB_ID": args.job_id,
+        # JAX coordination-service equivalents
+        "COORDINATOR_ADDRESS": master,
+        "NUM_PROCESSES": str(world_size),
+        "PROCESS_ID": str(rank),
+    })
+    os.makedirs(log_dir, exist_ok=True)
+    log_path = os.path.join(log_dir, f"workerlog.{rank}")
+    logf = open(log_path, "a")
+    cmd = [sys.executable, args.training_script] + \
+        args.training_script_args
+    proc = subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf)
+    return proc, logf
+
+
+def launch(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    nnodes = int(str(args.nnodes).split(":")[0])
+    world = nnodes * args.nproc_per_node
+
+    # rendezvous master: start the native TCPStore on this (rank-0) node
+    store = None
+    if args.master is None:
+        from ..store import TCPStore
+        store = TCPStore("127.0.0.1", 0, is_master=True,
+                         world_size=world)
+        master = f"127.0.0.1:{store.port}"
+    else:
+        master = args.master
+
+    base = args.node_rank * args.nproc_per_node
+    restarts = 0
+    while True:
+        procs = [_spawn_worker(base + i, world, master, args, args.log_dir)
+                 for i in range(args.nproc_per_node)]
+
+        def _terminate(*_):
+            for p, _f in procs:
+                p.terminate()
+            sys.exit(1)
+
+        signal.signal(signal.SIGTERM, _terminate)
+        signal.signal(signal.SIGINT, _terminate)
+
+        rcs = []
+        failed = False
+        for p, f in procs:
+            rc = p.wait()
+            f.close()
+            rcs.append(rc)
+            failed = failed or rc != 0
+        if not failed:
+            print(f"launch: all {len(procs)} workers exited cleanly")
+            return 0
+        restarts += 1
+        if restarts > args.max_restart:
+            print(f"launch: workers failed (rc={rcs}); giving up after "
+                  f"{restarts - 1} restarts", file=sys.stderr)
+            return 1
+        print(f"launch: worker failure (rc={rcs}); elastic restart "
+              f"{restarts}/{args.max_restart}", file=sys.stderr)
+        for p, _ in procs:
+            if p.poll() is None:
+                p.terminate()
+        time.sleep(1)
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()
