@@ -1,1 +1,4 @@
+"""`paddle.incubate` (reference: python/paddle/incubate/)."""
 
+from . import nn  # noqa: F401
+from ..core.autograd import no_grad  # noqa: F401

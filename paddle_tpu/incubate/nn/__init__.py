@@ -1,0 +1,4 @@
+"""`paddle.incubate.nn` (reference: python/paddle/incubate/nn/)."""
+
+from . import functional  # noqa: F401
+from .layer import FusedMultiTransformer  # noqa: F401

@@ -1,0 +1,290 @@
+"""Fused-op functional surface.
+
+Parity: reference `python/paddle/incubate/nn/functional/` — the python API
+over the CUDA fusion library (paddle/phi/kernels/fusion/,
+paddle/fluid/operators/fused/; SURVEY.md §2.1 "fused LLM mega-ops").
+
+TPU-first: these are NOT separate kernels — each is the composition XLA
+already fuses (plus Pallas flash attention where it matters). The API
+exists so reference users keep their call sites; the performance parity
+comes from the compiler, which is the whole point of the redesign.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....core.dispatch import apply, unwrap
+from ....core.tensor import Tensor
+from ....nn import functional as F
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+    "swiglu", "fused_bias_act", "fused_linear", "fused_linear_activation",
+    "fused_multi_head_attention", "fused_feedforward",
+    "variable_length_memory_efficient_attention",
+    "masked_multihead_attention", "fused_dropout_add",
+]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kw):
+    """reference fused_rms_norm.py (phi fused_rms_norm kernel). Supports
+    the residual+bias pre-add variant; returns (out, residual_out) when a
+    residual is passed (kernel parity)."""
+    if bias is not None:
+        x = x + bias
+    if residual is not None:
+        x = x + residual
+        residual_out = x
+    out = F.rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    if residual is not None:
+        return out, residual_out
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **kw):
+    if bias is not None:
+        x = x + bias
+    if residual is not None:
+        x = x + residual
+        residual_out = x
+    shape = x.shape[begin_norm_axis:] if begin_norm_axis != -1 \
+        else x.shape[-1:]
+    out = F.layer_norm(x, list(shape), norm_weight, norm_bias, epsilon)
+    if residual is not None:
+        return out, residual_out
+    return out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """reference fused_rope (fused_ops.yaml:408). q/k/v: [b, s, h, d].
+    When sin/cos are None they are computed from rotary_emb_base."""
+
+    def rope(x, sin_a, cos_a):
+        if use_neox_rotary_style:
+            d2 = x.shape[-1] // 2
+            x1, x2 = x[..., :d2], x[..., d2:]
+            rotated = jnp.concatenate([-x2, x1], axis=-1)
+            return x * cos_a + rotated * sin_a
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        cos_h = cos_a[..., 0::2]
+        sin_h = sin_a[..., 0::2]
+        o1 = x1 * cos_h - x2 * sin_h
+        o2 = x2 * cos_h + x1 * sin_h
+        return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+
+    def fn(qa, *rest):
+        arrs = [qa] + list(rest[:sum(t is not None for t in (k, v))])
+        d = qa.shape[-1]
+        s = qa.shape[1]
+        if sin is None:
+            inv = 1.0 / (rotary_emb_base **
+                         (jnp.arange(0, d, 2, jnp.float32) / d))
+            pos = jnp.arange(s, dtype=jnp.float32)
+            freqs = jnp.outer(pos, inv)
+            if use_neox_rotary_style:
+                emb = jnp.concatenate([freqs, freqs], axis=-1)
+            else:
+                emb = jnp.repeat(freqs, 2, axis=-1)
+            sin_a = jnp.sin(emb)[None, :, None, :]
+            cos_a = jnp.cos(emb)[None, :, None, :]
+        else:
+            sin_a, cos_a = unwrap(sin), unwrap(cos)
+            if sin_a.ndim == 2:
+                sin_a = sin_a[None, :, None, :]
+                cos_a = cos_a[None, :, None, :]
+        outs = tuple(rope(a.astype(jnp.float32), sin_a, cos_a).astype(
+            a.dtype) for a in arrs)
+        return outs if len(outs) > 1 else outs[0]
+
+    args = [q] + [t for t in (k, v) if t is not None]
+    out = apply(fn, *args, name="fused_rope")
+    if k is None and v is None:
+        return out, None, None
+    outs = list(out) if isinstance(out, list) else [out]
+    while len(outs) < 3:
+        outs.append(None)
+    return tuple(outs)
+
+
+def swiglu(x, y=None, name=None):
+    return F.swiglu(x, y)
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None,
+                   smooth=None, act_method="gelu", **kw):
+    if bias is not None:
+        x = x + bias
+    act = {"gelu": lambda a: F.gelu(a, approximate=True),
+           "relu": F.relu, "silu": F.silu,
+           "swiglu": lambda a: F.swiglu(a),
+           "geglu": lambda a: F.glu(a)}.get(act_method)
+    if act is None:
+        raise ValueError(f"unknown act_method {act_method!r}")
+    return act(x)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """reference fused_gemm_epilogue (cublasLt). XLA fuses bias+epilogue."""
+    from .... import ops
+    out = ops.matmul(x, weight, transpose_y=transpose_weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    from .... import ops
+    out = ops.matmul(x, y, transpose_x=trans_x, transpose_y=trans_y) + bias
+    if activation == "gelu":
+        return F.gelu(out, approximate=True)
+    if activation == "relu":
+        return F.relu(out)
+    return out
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, name=None,
+                               num_heads=None, transpose_qkv_wb=False):
+    """reference fused_attention_op.cu capability: [pre-LN +] QKV matmul +
+    MHA + out proj [+ residual + post-LN] as one call."""
+    from .... import ops
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [x.shape[-1]], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    b, s, d = x.shape
+    if transpose_qkv_wb:
+        qkv = ops.matmul(x, qkv_weight)  # [b,s,3d]
+        nh = num_heads
+        qkv = ops.reshape(qkv, [b, s, 3, nh, d // nh])
+    else:
+        # qkv_weight [3, nh, head_dim, d]
+        nh = qkv_weight.shape[1]
+        w = ops.reshape(qkv_weight, [3 * d, d])
+        qkv = ops.matmul(x, w, transpose_y=True)
+        qkv = ops.reshape(qkv, [b, s, 3, nh, d // nh])
+    if qkv_bias is not None:
+        qkv = qkv + ops.reshape(qkv_bias, [1, 1, 3, nh, d // nh])
+    q, kk, v = ops.unbind(qkv, axis=2)
+    out = F.scaled_dot_product_attention(
+        q, kk, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0)
+    out = ops.reshape(out, [b, s, d])
+    out = ops.matmul(out, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias,
+                           ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, ring_id=-1,
+                      mode="upscale_in_train", name=None):
+    """reference fused_feedforward_op.cu capability."""
+    from .... import ops
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [x.shape[-1]], ln1_scale, ln1_bias,
+                         ln1_epsilon)
+    h = ops.matmul(x, linear1_weight)
+    if linear1_bias is not None:
+        h = h + linear1_bias
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = ops.matmul(h, linear2_weight)
+    if linear2_bias is not None:
+        h = h + linear2_bias
+    h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], ln2_scale, ln2_bias,
+                           ln2_epsilon)
+    return out
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
+                                               kv_seq_lens=None, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    """reference memory_efficient_attention (cutlass) capability: SDPA on
+    [b, h, s, d] layout with optional mask."""
+    from .... import ops
+    q = ops.transpose(query, [0, 2, 1, 3])
+    k = ops.transpose(key, [0, 2, 1, 3])
+    v = ops.transpose(value, [0, 2, 1, 3])
+    out = F.scaled_dot_product_attention(q, k, v, attn_mask=mask,
+                                         is_causal=causal)
+    return ops.transpose(out, [0, 2, 1, 3])
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", **kw):
+    """reference masked_multihead_attention_kernel.cu: single-token decode
+    attention against a [2, b, h, max_s, d] KV cache; returns
+    (out, updated_cache)."""
+
+    def fn(xa, cache):
+        b = xa.shape[0]
+        two, _, h, max_s, d = cache.shape
+        qkv = xa.reshape(b, 3, h, d)
+        q, knew, vnew = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        if sequence_lengths is not None:
+            cur = unwrap(sequence_lengths).reshape(-1)[0]
+        else:
+            cur = jnp.sum(
+                jnp.any(cache[0, 0, 0] != 0, axis=-1).astype(jnp.int32))
+        cache_k = jax.lax.dynamic_update_slice(
+            cache[0], knew[:, :, None, :], (0, 0, cur, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache[1], vnew[:, :, None, :], (0, 0, cur, 0))
+        scale = 1.0 / jnp.sqrt(jnp.float32(d))
+        logits = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                            cache_k.astype(jnp.float32)) * scale
+        pos = jnp.arange(max_s)
+        mask = pos[None, None, :] <= cur
+        logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", p,
+                         cache_v.astype(jnp.float32))
+        new_cache = jnp.stack([cache_k, cache_v], axis=0)
+        return out.reshape(b, h * d).astype(xa.dtype), \
+            new_cache.astype(cache.dtype)
+
+    out, new_cache = apply(fn, x, cache_kv, name="masked_mha")
+    return out, new_cache
