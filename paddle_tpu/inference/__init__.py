@@ -1,0 +1,211 @@
+"""`paddle.inference`: the deployment predictor API.
+
+Parity: reference `paddle/fluid/inference/` — `AnalysisConfig` +
+`AnalysisPredictor` (api/analysis_predictor.h:105: Init -> optimize
+program -> PrepareExecutor -> Run / ZeroCopyRun with named IO handles).
+
+TPU-first collapse: the pass-driven graph optimizer (200 fuse passes, TRT
+subgraphs, memory-optim) is XLA under `jax.jit` — `Predictor.run` compiles
+the network once per input signature and executes the cached XLA
+executable; IO handles map to host numpy buffers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class Config:
+    """AnalysisConfig parity. Model source is either a Layer instance
+    (`set_model_layer`) or a params file saved by paddle_tpu.save plus a
+    network factory."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        self._layer = None
+        self._factory = None
+        self._params_file = params_file
+        self._precision = PrecisionType.Float32
+        self._device = None
+
+    # -- model source ------------------------------------------------------
+    def set_model_layer(self, layer):
+        self._layer = layer
+        return self
+
+    def set_model_factory(self, factory, params_file=None):
+        self._factory = factory
+        if params_file:
+            self._params_file = params_file
+        return self
+
+    def set_model(self, prog_file=None, params_file=None):
+        self._params_file = params_file
+
+    # -- device / precision (accepted for parity; XLA owns placement) -----
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision_mode=PrecisionType.Float32):
+        self._device = "tpu"
+        self._precision = precision_mode
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_custom_device(self, device_type, device_id=0):
+        self._device = device_type
+
+    def enable_memory_optim(self, *a, **k):
+        pass
+
+    def switch_ir_optim(self, *a, **k):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass  # XLA is the engine
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def precision(self):
+        return self._precision
+
+
+class _IOHandle:
+    """Zero-copy-ish IO handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._array = None
+
+    def reshape(self, shape):
+        if self._array is None or list(self._array.shape) != list(shape):
+            self._array = np.zeros(shape, self._array.dtype
+                                   if self._array is not None
+                                   else np.float32)
+
+    def copy_from_cpu(self, arr):
+        self._array = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return self._array
+
+    def share_external_data(self, arr):
+        self._array = np.asarray(arr)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        self._config = config
+        layer = config._layer
+        if layer is None and config._factory is not None:
+            layer = config._factory()
+            if config._params_file:
+                from ..framework.io import load
+                layer.set_state_dict(load(config._params_file))
+        if layer is None:
+            raise ValueError(
+                "Config needs set_model_layer(layer) or "
+                "set_model_factory(factory, params_file)")
+        layer.eval()
+        if config._precision == PrecisionType.Bfloat16:
+            layer.to(dtype="bfloat16")
+        self._layer = layer
+        self._inputs: dict[str, _IOHandle] = {}
+        self._outputs: dict[str, _IOHandle] = {}
+        self._n_inputs = None
+        self._jitted = None
+
+    # -- IO surface --------------------------------------------------------
+    def get_input_names(self):
+        if self._n_inputs is None:
+            import inspect
+            params = [p for p in inspect.signature(
+                self._layer.forward).parameters if p != "self"]
+            self._n_inputs = len(params)
+            for p in params:
+                self._inputs.setdefault(p, _IOHandle(p))
+        return list(self._inputs.keys())
+
+    def get_input_handle(self, name):
+        self.get_input_names()
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return list(self._outputs.keys()) or ["output_0"]
+
+    def get_output_handle(self, name):
+        return self._outputs.setdefault(name, _IOHandle(name))
+
+    # -- execution ---------------------------------------------------------
+    def _ensure_jit(self):
+        if self._jitted is not None:
+            return
+        import jax
+
+        layer = self._layer
+        items = list(layer.named_parameters()) + \
+            list(layer.named_buffers())
+
+        def pure(arrays, *inputs):
+            restore = []
+            try:
+                for (_, p), a in zip(items, arrays):
+                    restore.append((p, p._data))
+                    p._data = a
+                with no_grad():
+                    out = layer(*[Tensor(x) for x in inputs])
+                outs = out if isinstance(out, (tuple, list)) else [out]
+                return [o._data if isinstance(o, Tensor) else o
+                        for o in outs]
+            finally:
+                for p, a in restore:
+                    p._data = a
+
+        self._items = items
+        self._jitted = jax.jit(pure)
+
+    def run(self, inputs=None):
+        """Feed from input handles (or ``inputs`` list), execute, fill
+        output handles; returns the output arrays."""
+        self._ensure_jit()
+        if inputs is None:
+            names = self.get_input_names()
+            inputs = [self._inputs[n]._array for n in names]
+        arrays = [p._data for _, p in self._items]
+        outs = self._jitted(arrays, *inputs)
+        out_np = [np.asarray(o) for o in outs]
+        self._outputs.clear()
+        for i, o in enumerate(out_np):
+            h = _IOHandle(f"output_{i}")
+            h._array = o
+            self._outputs[h.name] = h
+        return out_np
+
+    def zero_copy_run(self):
+        return self.run()
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,118 @@
+"""Autoregressive generation (greedy / top-k / top-p sampling).
+
+Capability parity with the reference's decode path (masked_multihead_
+attention / block_multihead_attention fused decode kernels + PaddleNLP
+generate). TPU-first: the decode step is ONE jitted function over a
+static-shape KV cache (dynamic_update_slice writes, length masking) —
+no shape growth, no recompilation per token.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+
+__all__ = ["generate", "sample_token"]
+
+
+def sample_token(logits, temperature=1.0, top_k=0, top_p=1.0, key=None):
+    """logits: [b, vocab] jnp array -> [b] int32 token ids."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
+             top_k=0, top_p=1.0, eos_token_id=None, use_cache=True):
+    """Greedy (temperature=0) or sampled decoding. Returns a Tensor of
+    shape [b, prompt_len + max_new_tokens]."""
+    from ..core.autograd import no_grad
+
+    ids = input_ids._data if isinstance(input_ids, Tensor) else \
+        jnp.asarray(input_ids)
+    b, prompt_len = ids.shape
+    max_len = prompt_len + max_new_tokens
+
+    if not (use_cache and hasattr(model, "init_cache")):
+        return _generate_no_cache(model, ids, max_new_tokens, temperature,
+                                  top_k, top_p, eos_token_id)
+
+    with no_grad():
+        caches = model.init_cache(b, max_len)
+        # prefill
+        logits, caches = model(Tensor(ids), caches=caches,
+                               position_offset=0)
+        next_logits = logits._data[:, -1, :]
+        cache_arrays = [(k._data, v._data) for k, v in caches]
+
+        param_items = list(model.named_parameters())
+
+        def step(token, cache_arrays, pos, key):
+            # rebind params happens outside; model weights are already
+            # concrete — call the model eagerly under trace
+            caches_t = [(Tensor(k), Tensor(v)) for k, v in cache_arrays]
+            logits, new_caches = model(Tensor(token[:, None]),
+                                       caches=caches_t,
+                                       position_offset=pos)
+            nxt = sample_token(logits._data[:, -1, :], temperature, top_k,
+                               top_p, key)
+            return nxt, [(k._data, v._data) for k, v in new_caches]
+
+        jit_step = jax.jit(step)
+
+        key = random_mod.next_key()
+        tok = sample_token(next_logits, temperature, top_k, top_p, key)
+        out_tokens = [tok]
+        done = jnp.zeros((b,), bool)
+        if eos_token_id is not None:
+            done = done | (tok == eos_token_id)
+        for t in range(1, max_new_tokens):
+            key = random_mod.next_key()
+            tok, cache_arrays = jit_step(tok, cache_arrays,
+                                         jnp.int32(prompt_len + t - 1),
+                                         key)
+            if eos_token_id is not None:
+                tok = jnp.where(done, eos_token_id, tok)
+                done = done | (tok == eos_token_id)
+                out_tokens.append(tok)
+                if bool(done.all()):
+                    out_tokens.extend(
+                        [jnp.full((b,), eos_token_id, jnp.int32)] *
+                        (max_new_tokens - 1 - t))
+                    break
+            else:
+                out_tokens.append(tok)
+        gen = jnp.stack(out_tokens, axis=1).astype(ids.dtype)
+        return Tensor(jnp.concatenate([ids, gen], axis=1))
+
+
+def _generate_no_cache(model, ids, max_new_tokens, temperature, top_k,
+                       top_p, eos_token_id):
+    """Fallback full-context decoding for models without cache support."""
+    from ..core.autograd import no_grad
+
+    with no_grad():
+        out = ids
+        for _ in range(max_new_tokens):
+            logits = model(Tensor(out))
+            key = random_mod.next_key()
+            tok = sample_token(logits._data[:, -1, :], temperature, top_k,
+                               top_p, key)
+            out = jnp.concatenate([out, tok[:, None].astype(out.dtype)],
+                                  axis=1)
+        return Tensor(out)

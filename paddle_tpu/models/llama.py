@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -72,7 +73,8 @@ def apply_rope(q, k, theta=10000.0, position_offset=0):
         d = qa.shape[-1]
         s = qa.shape[1]
         inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, jnp.float32) / d))
-        pos = jnp.arange(position_offset, position_offset + s, dtype=jnp.float32)
+        pos = jnp.asarray(position_offset, jnp.float32) + \
+            jnp.arange(s, dtype=jnp.float32)
         freqs = jnp.outer(pos, inv_freq)  # [s, d/2]
         cos = jnp.cos(freqs)[None, :, None, :]
         sin = jnp.sin(freqs)[None, :, None, :]
@@ -113,7 +115,7 @@ class LlamaAttention(nn.Layer):
         self.o_proj = nn.Linear(d, d, weight_attr=_normal_attr(std),
                                 bias_attr=False)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, position_offset=0):
         from .. import ops
         b, s, d = x.shape
         q = ops.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
@@ -121,10 +123,41 @@ class LlamaAttention(nn.Layer):
                         [b, s, self.num_kv_heads, self.head_dim])
         v = ops.reshape(self.v_proj(x),
                         [b, s, self.num_kv_heads, self.head_dim])
-        q, k = apply_rope(q, k, theta=self.rope_theta)
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        q, k = apply_rope(q, k, theta=self.rope_theta,
+                          position_offset=position_offset)
+        if cache is None:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            out = ops.reshape(out, [b, s, d])
+            return self.o_proj(out)
+        # decode/prefill with KV cache: cache = (k_cache, v_cache)
+        # [b, max_s, kv_heads, head_dim] Tensors; write at position_offset,
+        # attend against positions <= query position (static shapes for jit)
+        k_cache, v_cache = cache
+
+        def attend(qa, ka, va, kc, vc, off):
+            kc = jax.lax.dynamic_update_slice(kc, ka.astype(kc.dtype),
+                                              (0, off, 0, 0))
+            vc = jax.lax.dynamic_update_slice(vc, va.astype(vc.dtype),
+                                              (0, off, 0, 0))
+            max_s = kc.shape[1]
+            rep = qa.shape[2] // kc.shape[2]
+            kf = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
+            vf = jnp.repeat(vc, rep, axis=2) if rep > 1 else vc
+            scale = 1.0 / (qa.shape[-1] ** 0.5)
+            logits = jnp.einsum("bsnd,btnd->bnst", qa, kf,
+                                preferred_element_type=jnp.float32) * scale
+            pos_q = off + jnp.arange(qa.shape[1])
+            pos_k = jnp.arange(max_s)
+            mask = pos_k[None, :] <= pos_q[:, None]
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(qa.dtype)
+            out = jnp.einsum("bnst,btnd->bsnd", probs, vf)
+            return out, kc, vc
+
+        out, new_k, new_v = apply(attend, q, k, v, k_cache, v_cache,
+                                  position_offset, name="cached_attention")
         out = ops.reshape(out, [b, s, d])
-        return self.o_proj(out)
+        return self.o_proj(out), (new_k, new_v)
 
 
 class LlamaMLP(nn.Layer):
@@ -153,10 +186,17 @@ class LlamaBlock(nn.Layer):
             config.hidden_size, epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x):
-        x = x + self.self_attn(self.input_layernorm(x))
+    def forward(self, x, cache=None, position_offset=0):
+        if cache is None:
+            x = x + self.self_attn(self.input_layernorm(x))
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x
+        attn_out, new_cache = self.self_attn(
+            self.input_layernorm(x), cache=cache,
+            position_offset=position_offset)
+        x = x + attn_out
         x = x + self.mlp(self.post_attention_layernorm(x))
-        return x
+        return x, new_cache
 
 
 class Llama(nn.Layer):
@@ -178,15 +218,42 @@ class Llama(nn.Layer):
         else:
             self.lm_head = None
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, position_offset=0):
         from .. import ops
         x = self.embed_tokens(input_ids)
-        for block in self.layers:
-            x = block(x)
+        new_caches = [] if caches is not None else None
+        for i, block in enumerate(self.layers):
+            if caches is None:
+                x = block(x)
+            else:
+                x, c = block(x, cache=caches[i],
+                             position_offset=position_offset)
+                new_caches.append(c)
         x = self.norm(x)
         if self.lm_head is not None:
-            return self.lm_head(x)
-        return ops.matmul(x, self.embed_tokens.weight, transpose_y=True)
+            logits = self.lm_head(x)
+        else:
+            logits = ops.matmul(x, self.embed_tokens.weight,
+                                transpose_y=True)
+        if caches is None:
+            return logits
+        return logits, new_caches
+
+    def init_cache(self, batch_size, max_seq_len, dtype=None):
+        """Allocate empty KV caches: per layer (k, v) of
+        [b, max_s, kv_heads, head_dim]."""
+        from .. import ops
+        dt = dtype or (self.embed_tokens.weight.dtype)
+        kvh = self.config.num_kv_heads
+        hd = self.config.hidden_size // self.config.num_heads
+        return [(ops.zeros([batch_size, max_seq_len, kvh, hd], dt),
+                 ops.zeros([batch_size, max_seq_len, kvh, hd], dt))
+                for _ in range(self.config.num_layers)]
+
+    def generate(self, input_ids, max_new_tokens=32, **kwargs):
+        from .generation import generate
+        return generate(self, input_ids, max_new_tokens=max_new_tokens,
+                        **kwargs)
 
     def loss(self, input_ids, labels):
         logits = self(input_ids)
