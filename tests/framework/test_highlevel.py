@@ -1,0 +1,125 @@
+"""High-level surfaces: hapi Model, generation, inference predictor,
+incubate fused ops, recompute interplay."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, io
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.models import Llama, LlamaConfig
+from paddle_tpu.models.generation import generate
+
+
+class _RegDS(io.Dataset):
+    def __init__(self, n=64):
+        rng = np.random.default_rng(0)
+        self.x = rng.standard_normal((n, 8)).astype("float32")
+        self.w = rng.standard_normal((8, 1)).astype("float32")
+
+    def __getitem__(self, i):
+        return self.x[i], (self.x[i] @ self.w).astype("float32")
+
+    def __len__(self):
+        return len(self.x)
+
+
+def test_hapi_fit_reduces_loss():
+    paddle.seed(0)
+    ds = _RegDS()
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = paddle.Model(net)
+    model.prepare(optimizer.Adam(learning_rate=0.01,
+                                 parameters=net.parameters()),
+                  nn.MSELoss())
+    before = model.evaluate(ds, batch_size=16)["loss"]
+    model.fit(ds, batch_size=16, epochs=15, verbose=0)
+    after = model.evaluate(ds, batch_size=16)["loss"]
+    assert after < before * 0.2
+
+
+def test_hapi_save_load(tmp_path):
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    model = paddle.Model(net)
+    model.prepare(optimizer.Adam(learning_rate=0.01,
+                                 parameters=net.parameters()))
+    p = str(tmp_path / "ckpt")
+    model.save(p)
+    net2 = nn.Linear(4, 4)
+    model2 = paddle.Model(net2)
+    model2.prepare(optimizer.Adam(learning_rate=0.01,
+                                  parameters=net2.parameters()))
+    model2.load(p)
+    np.testing.assert_allclose(net.weight.numpy(), net2.weight.numpy())
+
+
+def test_generation_cached_matches_full():
+    paddle.seed(0)
+    model = Llama(LlamaConfig.tiny())
+    ids = paddle.to_tensor(
+        np.random.randint(0, 255, (2, 8)).astype("int64"))
+    a = model.generate(ids, max_new_tokens=8, temperature=0.0)
+    b = generate(model, ids, max_new_tokens=8, temperature=0.0,
+                 use_cache=False)
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    assert a.shape == [2, 16]
+
+
+def test_predictor_matches_eager():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    cfg = Config()
+    cfg.set_model_layer(net)
+    pred = create_predictor(cfg)
+    x = np.random.randn(3, 8).astype("float32")
+    pred.get_input_handle(pred.get_input_names()[0]).copy_from_cpu(x)
+    out = pred.run()
+    np.testing.assert_allclose(out[0], net(paddle.to_tensor(x)).numpy(),
+                               atol=1e-6)
+
+
+def test_fused_ops_numerics():
+    from paddle_tpu.incubate.nn import functional as IF
+    x = paddle.randn([2, 4, 16])
+    w = paddle.ones([16])
+    np.testing.assert_allclose(
+        IF.fused_rms_norm(x, w).numpy(),
+        nn.functional.rms_norm(x, w).numpy(), atol=1e-6)
+
+    q = paddle.randn([2, 6, 2, 8])
+    k = paddle.randn([2, 6, 2, 8])
+    from paddle_tpu.models.llama import apply_rope
+    q_ref, k_ref = apply_rope(q, k)
+    q_got, k_got, _ = IF.fused_rotary_position_embedding(
+        q, k, use_neox_rotary_style=False)
+    np.testing.assert_allclose(q_got.numpy(), q_ref.numpy(), atol=1e-5)
+    np.testing.assert_allclose(k_got.numpy(), k_ref.numpy(), atol=1e-5)
+
+
+def test_fused_multi_transformer_runs():
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    paddle.seed(0)
+    fmt = FusedMultiTransformer(32, 4, 64, num_layers=2)
+    x = paddle.randn([2, 6, 32])
+    y = fmt(x)
+    assert y.shape == [2, 6, 32]
+    # cached decode path
+    caches = [(paddle.zeros([2, 0, 4, 8]), paddle.zeros([2, 0, 4, 8]))
+              for _ in range(2)]
+    y2, new_caches = fmt(x, caches=caches)
+    assert new_caches[0][0].shape == [2, 6, 4, 8]
+
+
+def test_profiler_records_spans():
+    from paddle_tpu import profiler
+    with profiler.Profiler(
+            scheduler=lambda s: profiler.ProfilerState.RECORD,
+            timer_only=True) as prof:
+        with profiler.RecordEvent("myspan"):
+            paddle.matmul(paddle.randn([4, 4]), paddle.randn([4, 4]))
+    table = prof.summary()
+    assert "myspan" in table
