@@ -1,0 +1,6 @@
+"""`paddle.vision` (reference: python/paddle/vision/)."""
+
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from . import transforms  # noqa: F401

@@ -1,0 +1,233 @@
+"""`paddle.vision.datasets` (reference: python/paddle/vision/datasets/).
+
+Download-backed datasets (MNIST/FashionMNIST/Cifar) cache under
+~/.cache/paddle_tpu/dataset; FakeData generates synthetic samples for
+tests/CI (reference uses the same pattern in test/legacy_test)."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+import urllib.request
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder"]
+
+_HOME = os.path.expanduser(os.environ.get(
+    "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def _fetch(url, path):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if not os.path.exists(path):
+        urllib.request.urlretrieve(url, path)
+    return path
+
+
+class FakeData(Dataset):
+    """Synthetic images (for tests — no download)."""
+
+    def __init__(self, size=100, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.RandomState(seed)
+        self._images = self._rng.randint(
+            0, 256, (size,) + self.image_shape).astype("uint8")
+        self._labels = self._rng.randint(0, num_classes, size).astype(
+            "int64")
+
+    def __getitem__(self, idx):
+        img = self._images[idx]
+        if self.transform is not None:
+            img = self.transform(np.transpose(img, (1, 2, 0)))
+        else:
+            img = img.astype("float32") / 255.0
+        return img, self._labels[idx]
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    URL = "https://storage.googleapis.com/cvdf-datasets/mnist/"
+    FILES = {
+        "train": ("train-images-idx3-ubyte.gz",
+                  "train-labels-idx1-ubyte.gz"),
+        "test": ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"),
+    }
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        assert mode in ("train", "test")
+        self.transform = transform
+        img_file, lab_file = self.FILES[mode]
+        root = os.path.join(_HOME, self.NAME)
+        image_path = image_path or os.path.join(root, img_file)
+        label_path = label_path or os.path.join(root, lab_file)
+        if download and not os.path.exists(image_path):
+            _fetch(self.URL + img_file, image_path)
+            _fetch(self.URL + lab_file, label_path)
+        with gzip.open(image_path, "rb") as f:
+            data = np.frombuffer(f.read(), np.uint8, offset=16)
+        self.images = data.reshape(-1, 28, 28)
+        with gzip.open(label_path, "rb") as f:
+            self.labels = np.frombuffer(f.read(), np.uint8,
+                                        offset=8).astype("int64")
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype("float32") / 255.0)[None]
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    URL = "http://fashion-mnist.s3-website.eu-central-1.amazonaws.com/"
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+    NAME = "cifar-10-batches-py"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        assert mode in ("train", "test")
+        self.transform = transform
+        root = _HOME
+        archive = data_file or os.path.join(root, "cifar-10-python.tar.gz")
+        folder = os.path.join(root, self.NAME)
+        if download and not os.path.isdir(folder):
+            _fetch(self.URL, archive)
+            with tarfile.open(archive) as tf:
+                tf.extractall(root)
+        batches = [f"data_batch_{i}" for i in range(1, 6)] \
+            if mode == "train" else ["test_batch"]
+        xs, ys = [], []
+        for b in batches:
+            with open(os.path.join(folder, b), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(d[b"data"])
+            ys.extend(d[b"labels"])
+        self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, "int64")
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(np.transpose(img, (1, 2, 0)))
+        else:
+            img = img.astype("float32") / 255.0
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    URL = "https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz"
+    NAME = "cifar-100-python"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        root = _HOME
+        archive = data_file or os.path.join(root, "cifar-100-python.tar.gz")
+        folder = os.path.join(root, self.NAME)
+        if download and not os.path.isdir(folder):
+            _fetch(self.URL, archive)
+            with tarfile.open(archive) as tf:
+                tf.extractall(root)
+        fname = "train" if mode == "train" else "test"
+        with open(os.path.join(folder, fname), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        self.images = d[b"data"].reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(d[b"fine_labels"], "int64")
+
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subfolder image dataset (reference folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or _IMG_EXTS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if is_valid_file is not None:
+                    if is_valid_file(fn):
+                        self.samples.append((os.path.join(cdir, fn),
+                                             self.class_to_idx[c]))
+                elif fn.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError(
+                "loading non-.npy images requires pillow") from e
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """flat (unlabeled) image folder."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._default_loader
+        extensions = extensions or _IMG_EXTS
+        self.samples = [os.path.join(root, fn)
+                        for fn in sorted(os.listdir(root))
+                        if fn.lower().endswith(tuple(extensions))]
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,319 @@
+"""`paddle.vision.ops` — detection ops.
+
+Parity: reference python/paddle/vision/ops.py (nms, roi_align, roi_pool,
+deform_conv2d, box_coder, generate_proposals ... over phi kernels). These
+back the PP-OCR / detection workloads from BASELINE.json's config ladder.
+TPU-first: nms uses a fixed-iteration mask loop (compiles under jit, no
+dynamic shapes); roi_align/deform_conv2d are gather+bilinear einsums.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "deform_conv2d", "box_coder",
+           "DeformConv2D", "box_area", "box_iou"]
+
+
+def box_area(boxes):
+    def fn(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return apply(fn, boxes, name="box_area")
+
+
+def _iou_matrix(boxes):
+    area = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def box_iou(boxes1, boxes2):
+    def fn(b1, b2):
+        area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+        area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area1[:, None] + area2[None, :] - inter,
+                                   1e-9)
+    return apply(fn, boxes1, boxes2, name="box_iou")
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS (reference vision/ops.py nms / phi nms kernel). Returns
+    kept indices sorted by score. Static-shape mask loop: O(n) iterations
+    over a precomputed IoU matrix, jit-friendly."""
+    b = unwrap(boxes)
+    n = b.shape[0]
+    s = unwrap(scores) if scores is not None else jnp.arange(
+        n, 0, -1, dtype=jnp.float32)
+    iou = _iou_matrix(b.astype(jnp.float32))
+    if category_idxs is not None:
+        # category-aware: suppress only within the same class
+        cats = unwrap(category_idxs)
+        same = cats[:, None] == cats[None, :]
+        iou = jnp.where(same, iou, 0.0)
+    order = jnp.argsort(-s)
+
+    def body(i, keep):
+        idx = order[i]
+        # suppressed if any higher-scored kept box overlaps too much
+        higher = keep & (jnp.arange(n) < i)
+        overlaps = iou[idx, order] > iou_threshold
+        suppressed = jnp.any(higher & overlaps)
+        return keep.at[i].set(~suppressed)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    # keep[i] refers to order[i] (score-descending positions)
+    kept = np.asarray(order)[np.asarray(keep)]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept, jnp.int64))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign via bilinear grid sampling (reference roi_align phi
+    kernel)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def fn(feat, rois):
+        n, c, h, w = feat.shape
+        num_rois = rois.shape[0]
+        offset = 0.5 if aligned else 0.0
+        # roi batch mapping from boxes_num
+        bn = unwrap(boxes_num)
+        batch_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                               total_repeat_length=num_rois)
+
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        roi_w = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        roi_h = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample points per bin
+        iy = (jnp.arange(sr) + 0.5) / sr
+        ix = (jnp.arange(sr) + 0.5) / sr
+        py = jnp.arange(ph)
+        px = jnp.arange(pw)
+        # [R, ph, sr]
+        ys = y1[:, None, None] + (py[None, :, None] +
+                                  iy[None, None, :]) * bin_h[:, None, None]
+        xs = x1[:, None, None] + (px[None, :, None] +
+                                  ix[None, None, :]) * bin_w[:, None, None]
+
+        def bilinear(b_idx, yy, xx):
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(yy - y0, 0, 1)
+            wx = jnp.clip(xx - x0, 0, 1)
+            fm = feat[b_idx]  # [c, h, w]
+            v00 = fm[:, y0, x0]
+            v01 = fm[:, y0, x1_]
+            v10 = fm[:, y1_, x0]
+            v11 = fm[:, y1_, x1_]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                    v10 * wy * (1 - wx) + v11 * wy * wx)
+
+        def one_roi(r):
+            b_idx = batch_idx[r]
+            # [ph, sr] x [pw, sr] grids
+            vals = jax.vmap(lambda yy: jax.vmap(
+                lambda xx: bilinear(b_idx, yy, xx))(
+                    xs[r].reshape(-1)))(ys[r].reshape(-1))
+            # vals: [ph*sr, pw*sr, c]
+            vals = vals.reshape(ph, sr, pw, sr, c)
+            return vals.mean(axis=(1, 3)).transpose(2, 0, 1)  # [c,ph,pw]
+
+        return jax.vmap(one_roi)(jnp.arange(num_rois))
+
+    return apply(fn, x, boxes, name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    return roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                     sampling_ratio=1, aligned=False)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference deform_conv2d phi kernel; the
+    PP-OCR backbone op). Implemented as offset-shifted bilinear sampling +
+    matmul — gathers vectorize on TPU."""
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) else \
+        tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else \
+        tuple(dilation)
+
+    def fn(xa, off, w, *rest):
+        b, c, h, wd = xa.shape
+        oc, cg, kh, kw = w.shape
+        mask_a = None
+        bias_a = None
+        rest = list(rest)
+        if mask is not None:
+            mask_a = rest.pop(0)
+        if bias is not None:
+            bias_a = rest.pop(0)
+        out_h = (h + 2 * padding[0] - dilation[0] * (kh - 1) - 1) \
+            // stride[0] + 1
+        out_w = (wd + 2 * padding[1] - dilation[1] * (kw - 1) - 1) \
+            // stride[1] + 1
+        xp = jnp.pad(xa, ((0, 0), (0, 0), (padding[0], padding[0]),
+                          (padding[1], padding[1])))
+        ph, pw = xp.shape[2], xp.shape[3]
+        # base sampling grid [out_h, out_w, kh, kw]
+        gy = (jnp.arange(out_h) * stride[0])[:, None, None, None] + \
+            (jnp.arange(kh) * dilation[0])[None, None, :, None]
+        gx = (jnp.arange(out_w) * stride[1])[None, :, None, None] + \
+            (jnp.arange(kw) * dilation[1])[None, None, None, :]
+        gy = jnp.broadcast_to(gy, (out_h, out_w, kh, kw)).astype(
+            jnp.float32)
+        gx = jnp.broadcast_to(gx, (out_h, out_w, kh, kw)).astype(
+            jnp.float32)
+        # offsets: [b, 2*dg*kh*kw, out_h, out_w] (y,x interleaved pairs)
+        off = off.reshape(b, deformable_groups, kh * kw, 2, out_h, out_w)
+        oy = off[:, :, :, 0].transpose(0, 1, 3, 4, 2).reshape(
+            b, deformable_groups, out_h, out_w, kh, kw)
+        ox = off[:, :, :, 1].transpose(0, 1, 3, 4, 2).reshape(
+            b, deformable_groups, out_h, out_w, kh, kw)
+        sy = gy[None, None] + oy
+        sx = gx[None, None] + ox
+
+        y0 = jnp.floor(sy)
+        x0 = jnp.floor(sx)
+        wy = sy - y0
+        wx = sx - x0
+
+        def gather(feat_dg, yy, xx):
+            yi = jnp.clip(yy.astype(jnp.int32), 0, ph - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, pw - 1)
+            valid = (yy >= 0) & (yy <= ph - 1) & (xx >= 0) & (xx <= pw - 1)
+            v = feat_dg[:, yi, xi]
+            return jnp.where(valid[None], v, 0.0)
+
+        cd = c // deformable_groups
+        samples = []
+        for dg in range(deformable_groups):
+            feat = xp[:, dg * cd:(dg + 1) * cd]  # [b, cd, ph, pw]
+
+            def per_b(fb, y0b, x0b, wyb, wxb):
+                v00 = gather(fb, y0b, x0b)
+                v01 = gather(fb, y0b, x0b + 1)
+                v10 = gather(fb, y0b + 1, x0b)
+                v11 = gather(fb, y0b + 1, x0b + 1)
+                return (v00 * (1 - wyb) * (1 - wxb) +
+                        v01 * (1 - wyb) * wxb +
+                        v10 * wyb * (1 - wxb) + v11 * wyb * wxb)
+
+            s = jax.vmap(per_b)(feat, y0[:, dg], x0[:, dg], wy[:, dg],
+                                wx[:, dg])
+            samples.append(s)  # [b, cd, out_h, out_w, kh, kw]
+        sampled = jnp.concatenate(samples, axis=1)
+        if mask_a is not None:
+            m = mask_a.reshape(b, deformable_groups, kh * kw, out_h,
+                               out_w).transpose(0, 1, 3, 4, 2).reshape(
+                b, deformable_groups, out_h, out_w, kh, kw)
+            m = jnp.repeat(m, cd, axis=1)
+            sampled = sampled * m
+        # conv as einsum over sampled patches
+        out = jnp.einsum("bchwkl,ockl->bohw",
+                         sampled.astype(jnp.float32),
+                         w.astype(jnp.float32))
+        if bias_a is not None:
+            out = out + bias_a[None, :, None, None]
+        return out.astype(xa.dtype)
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply(fn, *args, name="deform_conv2d")
+
+
+class DeformConv2D:
+    """Layer form of deform_conv2d (reference vision/ops.py
+    DeformConv2D)."""
+
+    def __new__(cls, in_channels, out_channels, kernel_size, stride=1,
+                padding=0, dilation=1, deformable_groups=1, groups=1,
+                weight_attr=None, bias_attr=None):
+        from .. import nn
+
+        class _Layer(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+                    else (kernel_size, kernel_size)
+                self.weight = self.create_parameter(
+                    [out_channels, in_channels // groups, *k],
+                    attr=weight_attr,
+                    default_initializer=nn.initializer.XavierNormal())
+                if bias_attr is not False:
+                    self.bias = self.create_parameter([out_channels],
+                                                      attr=bias_attr,
+                                                      is_bias=True)
+                else:
+                    self.bias = None
+
+            def forward(self, x, offset, mask=None):
+                return deform_conv2d(
+                    x, offset, self.weight, self.bias, stride, padding,
+                    dilation, deformable_groups, groups, mask)
+
+        return _Layer()
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """reference box_coder op (SSD-style box encode/decode)."""
+
+    def fn(pb, pbv, tb):
+        pw = pb[:, 2] - pb[:, 0] + (0 if box_normalized else 1)
+        ph = pb[:, 3] - pb[:, 1] + (0 if box_normalized else 1)
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + (0 if box_normalized else 1)
+            th = tb[:, 3] - tb[:, 1] + (0 if box_normalized else 1)
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            ox = (tcx - pcx) / pw / pbv[:, 0]
+            oy = (tcy - pcy) / ph / pbv[:, 1]
+            ow = jnp.log(tw / pw) / pbv[:, 2]
+            oh = jnp.log(th / ph) / pbv[:, 3]
+            return jnp.stack([ox, oy, ow, oh], axis=1)
+        # decode
+        ox = tb[..., 0] * pbv[:, 0] * pw + pcx
+        oy = tb[..., 1] * pbv[:, 1] * ph + pcy
+        ow = jnp.exp(tb[..., 2] * pbv[:, 2]) * pw
+        oh = jnp.exp(tb[..., 3] * pbv[:, 3]) * ph
+        return jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                          ox + ow * 0.5, oy + oh * 0.5], axis=-1)
+
+    return apply(fn, prior_box, prior_box_var, target_box,
+                 name="box_coder")

@@ -1,0 +1,10 @@
+"""`paddle.vision.transforms` (reference: python/paddle/vision/
+transforms/)."""
+
+from .transforms import (  # noqa: F401
+    BaseTransform, BrightnessTransform, CenterCrop, ColorJitter, Compose,
+    ContrastTransform, Grayscale, Normalize, Pad, RandomCrop,
+    RandomHorizontalFlip, RandomResizedCrop, RandomRotation,
+    RandomVerticalFlip, Resize, ToTensor, Transpose,
+)
+from . import functional  # noqa: F401

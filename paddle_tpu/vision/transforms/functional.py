@@ -1,0 +1,169 @@
+"""Image transform functionals on numpy HWC arrays (reference:
+python/paddle/vision/transforms/functional*.py — we standardize on the
+'cv2'-style numpy backend; PIL objects are converted on entry)."""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def _to_numpy(img):
+    if isinstance(img, np.ndarray):
+        return img
+    if isinstance(img, Tensor):
+        return img.numpy()
+    # PIL image
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = _to_numpy(pic)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype("float32") / 255.0
+    else:
+        arr = arr.astype("float32")
+    if data_format == "CHW":
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    t = img
+    arr = t.numpy() if isinstance(t, Tensor) else _to_numpy(t).astype(
+        "float32")
+    mean = np.asarray(mean, "float32")
+    std = np.asarray(std, "float32")
+    if data_format == "CHW":
+        arr = (arr - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    else:
+        arr = (arr - mean) / std
+    return Tensor(arr) if isinstance(img, Tensor) else arr
+
+
+def _interp_resize(arr, h, w):
+    """Bilinear resize without external deps."""
+    ih, iw = arr.shape[:2]
+    if (ih, iw) == (h, w):
+        return arr
+    ys = (np.arange(h) + 0.5) * ih / h - 0.5
+    xs = (np.arange(w) + 0.5) * iw / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, ih - 1)
+    y1 = np.clip(y0 + 1, 0, ih - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, iw - 1)
+    x1 = np.clip(x0 + 1, 0, iw - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :]
+    a = arr[y0][:, x0]
+    b = arr[y0][:, x1]
+    c = arr[y1][:, x0]
+    d = arr[y1][:, x1]
+    if arr.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    top = a * (1 - wx) + b * wx
+    bot = c * (1 - wx) + d * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(arr.dtype) if arr.dtype != np.uint8 else \
+        np.clip(out, 0, 255).astype(np.uint8)
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = _to_numpy(img)
+    if isinstance(size, numbers.Number):
+        h, w = arr.shape[:2]
+        if h <= w:
+            new_h, new_w = int(size), int(size * w / h)
+        else:
+            new_h, new_w = int(size * h / w), int(size)
+    else:
+        new_h, new_w = size
+    return _interp_resize(arr, new_h, new_w)
+
+
+def crop(img, top, left, height, width):
+    arr = _to_numpy(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _to_numpy(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return crop(arr, top, left, th, tw)
+
+
+def hflip(img):
+    return _to_numpy(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _to_numpy(img)[::-1].copy()
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _to_numpy(img)
+    if isinstance(padding, numbers.Number):
+        padding = [padding] * 4
+    if len(padding) == 2:
+        padding = [padding[0], padding[1], padding[0], padding[1]]
+    left, top, right, bottom = padding
+    pads = [(top, bottom), (left, right)] + \
+        [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, pads, constant_values=fill)
+    return np.pad(arr, pads, mode=padding_mode)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    cy, cx = (h / 2, w / 2) if center is None else (center[1], center[0])
+    rad = -np.deg2rad(angle)
+    cos_a, sin_a = np.cos(rad), np.sin(rad)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ys = cos_a * (yy - cy) - sin_a * (xx - cx) + cy
+    xs = sin_a * (yy - cy) + cos_a * (xx - cx) + cx
+    yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+    xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+    out = arr[yi, xi]
+    inside = (ys >= 0) & (ys < h) & (xs >= 0) & (xs < w)
+    if arr.ndim == 3:
+        inside = inside[..., None]
+    return np.where(inside, out, fill).astype(arr.dtype)
+
+
+def adjust_brightness(img, factor):
+    arr = _to_numpy(img).astype("float32") * factor
+    return np.clip(arr, 0, 255).astype("uint8") \
+        if _to_numpy(img).dtype == np.uint8 else arr
+
+
+def adjust_contrast(img, factor):
+    arr = _to_numpy(img).astype("float32")
+    mean = arr.mean()
+    out = (arr - mean) * factor + mean
+    return np.clip(out, 0, 255).astype("uint8") \
+        if _to_numpy(img).dtype == np.uint8 else out
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _to_numpy(img).astype("float32")
+    if arr.ndim == 2:
+        g = arr
+    else:
+        g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+    g = g[..., None]
+    if num_output_channels == 3:
+        g = np.repeat(g, 3, axis=-1)
+    return g.astype(_to_numpy(img).dtype)

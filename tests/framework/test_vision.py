@@ -1,0 +1,100 @@
+"""Vision package: transforms, datasets, models, detection ops."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.vision import datasets, models, ops, transforms
+
+
+def test_transforms_pipeline():
+    img = np.random.randint(0, 256, (40, 48, 3), dtype=np.uint8)
+    t = transforms.Compose([
+        transforms.Resize(32),
+        transforms.CenterCrop(32),
+        transforms.RandomHorizontalFlip(0.0),
+        transforms.ToTensor(),
+        transforms.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5]),
+    ])
+    out = t(img)
+    assert out.shape == [3, 32, 32]
+    assert float(out.numpy().max()) <= 1.0 + 1e-6
+
+
+def test_resize_matches_aspect():
+    img = np.random.randint(0, 256, (40, 80, 3), dtype=np.uint8)
+    out = transforms.functional.resize(img, 20)
+    assert out.shape == (20, 40, 3)
+
+
+def test_fake_data_and_loader():
+    from paddle_tpu.io import DataLoader
+    ds = datasets.FakeData(size=20, image_shape=(1, 28, 28))
+    dl = DataLoader(ds, batch_size=5)
+    batches = list(dl)
+    assert len(batches) == 4
+    x, y = batches[0]
+    assert x.shape == [5, 1, 28, 28]
+
+
+def test_lenet_trains():
+    paddle.seed(0)
+    net = models.LeNet()
+    ds = datasets.FakeData(size=32, image_shape=(1, 28, 28),
+                           num_classes=10)
+    model = paddle.Model(net)
+    model.prepare(optimizer.Adam(learning_rate=1e-3,
+                                 parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    before = model.evaluate(ds, batch_size=16)["loss"]
+    model.fit(ds, batch_size=16, epochs=5, verbose=0)
+    after = model.evaluate(ds, batch_size=16)["loss"]
+    assert after < before
+
+
+def test_resnet18_forward():
+    paddle.seed(0)
+    net = models.resnet18(num_classes=10)
+    out = net(paddle.randn([2, 3, 32, 32]))
+    assert out.shape == [2, 10]
+
+
+def test_mobilenet_forward():
+    paddle.seed(0)
+    net = models.mobilenet_v2(num_classes=5)
+    out = net(paddle.randn([2, 3, 32, 32]))
+    assert out.shape == [2, 5]
+
+
+def test_nms_manual_oracle():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 10, 10], [20, 20, 30, 30],
+                      [20.5, 20.5, 30, 30], [50, 50, 60, 60]], "float32")
+    scores = np.array([0.9, 0.8, 0.7, 0.95, 0.5], "float32")
+    kept = ops.nms(paddle.to_tensor(boxes), 0.5,
+                   scores=paddle.to_tensor(scores)).numpy()
+    # expect: box3 (0.95), box0 (0.9) suppresses box1, box3 suppresses
+    # box2, box4 kept
+    assert list(kept) == [3, 0, 4]
+
+
+def test_roi_align_shape_and_values():
+    feat = np.zeros((1, 1, 8, 8), "float32")
+    feat[0, 0] = np.arange(64).reshape(8, 8)
+    boxes = np.array([[0.0, 0.0, 8.0, 8.0]], "float32")
+    out = ops.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                        paddle.to_tensor(np.array([1], "int32")),
+                        output_size=2, aligned=False)
+    assert out.shape == [1, 1, 2, 2]
+    vals = out.numpy()[0, 0]
+    # quadrant means of the 8x8 ramp: increasing left->right, top->bottom
+    assert vals[0, 0] < vals[0, 1] < vals[1, 1]
+
+
+def test_deform_conv_zero_offset_matches_conv():
+    paddle.seed(0)
+    x = paddle.randn([1, 4, 8, 8])
+    w = paddle.randn([6, 4, 3, 3])
+    offset = paddle.zeros([1, 2 * 9, 8, 8])
+    got = ops.deform_conv2d(x, offset, w, padding=1)
+    ref = nn.functional.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), atol=1e-4)
