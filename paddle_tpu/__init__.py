@@ -49,6 +49,16 @@ from .core.flags import get_flags, set_flags  # noqa: F401
 from . import profiler  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
+from . import audio  # noqa: F401
+from . import distribution  # noqa: F401
+from . import inference  # noqa: F401
+from . import models  # noqa: F401
+from . import quantization  # noqa: F401
+from . import sparse  # noqa: F401
+from . import static  # noqa: F401
+from . import utils  # noqa: F401
+from . import vision  # noqa: F401
+from .utils.flops import flops  # noqa: F401
 from .amp import debugging as _amp_debugging  # noqa: F401
 
 __version__ = "0.1.0"

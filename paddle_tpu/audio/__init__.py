@@ -1,0 +1,5 @@
+"""`paddle.audio` (reference: python/paddle/audio/ — features and
+functional: Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC)."""
+
+from . import functional  # noqa: F401
+from . import features  # noqa: F401

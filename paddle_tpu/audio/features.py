@@ -1,0 +1,119 @@
+"""Audio feature layers (reference: python/paddle/audio/features/
+layers.py: Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import apply, unwrap
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(nn.Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = unwrap(AF.get_window(window, self.win_length))
+        if self.win_length < n_fft:
+            pad = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (pad, n_fft - self.win_length - pad))
+        self._window = w
+
+    def forward(self, x):
+        n_fft, hop = self.n_fft, self.hop_length
+        win = self._window
+        power = self.power
+        center = self.center
+        pad_mode = self.pad_mode
+
+        def fn(a):
+            if a.ndim == 1:
+                a = a[None]
+            if center:
+                a = jnp.pad(a, ((0, 0), (n_fft // 2, n_fft // 2)),
+                            mode=pad_mode)
+            n_frames = 1 + (a.shape[-1] - n_fft) // hop
+            idx = (jnp.arange(n_frames)[:, None] * hop +
+                   jnp.arange(n_fft)[None, :])
+            frames = a[:, idx] * win  # [b, frames, n_fft]
+            spec = jnp.fft.rfft(frames, axis=-1)
+            mag = jnp.abs(spec)
+            if power != 1.0:
+                mag = mag ** power
+            return jnp.swapaxes(mag, 1, 2)  # [b, freq, frames]
+
+        return apply(fn, x, name="spectrogram")
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode)
+        self._fbank = unwrap(AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        fb = self._fbank
+
+        def fn(s):
+            return jnp.einsum("mf,bft->bmt", fb, s)
+
+        return apply(fn, spec, name="mel_spectrogram")
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, center, pad_mode, n_mels,
+                                  f_min, f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        n_mels, f_min, f_max, htk, norm,
+                                        ref_value, amin, top_db)
+        self._dct = unwrap(AF.create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        lm = self.logmel(x)
+        dct = self._dct
+
+        def fn(s):
+            # dct: [n_mels, n_mfcc]
+            return jnp.einsum("mk,bmt->bkt", dct, s)
+
+        return apply(fn, lm, name="mfcc")

@@ -1,0 +1,112 @@
+"""Audio functionals (reference: python/paddle/audio/functional/) —
+windows, mel filterbanks, dct matrices; all pure jnp."""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    if htk:
+        return 2595.0 * math.log10(1.0 + freq / 700.0) \
+            if isinstance(freq, (int, float)) else \
+            2595.0 * jnp.log10(1.0 + freq / 700.0)
+    # slaney
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if isinstance(freq, (int, float)):
+        if freq >= min_log_hz:
+            return min_log_mel + math.log(freq / min_log_hz) / logstep
+        return mels
+    return jnp.where(freq >= min_log_hz,
+                     min_log_mel + jnp.log(freq / min_log_hz) / logstep,
+                     mels)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(mel >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (mel - min_log_mel)),
+                     freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    low = hz_to_mel(f_min, htk)
+    high = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(low, high, n_mels)
+    return Tensor(mel_to_hz(mels, htk))
+
+
+def fft_frequencies(sr, n_fft):
+    return Tensor(jnp.linspace(0, sr / 2, n_fft // 2 + 1))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    f_max = f_max or sr / 2.0
+    fft_freqs = jnp.linspace(0, sr / 2, n_fft // 2 + 1)
+    mel_f = unwrap(mel_frequencies(n_mels + 2, f_min, f_max, htk))
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights)
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    s = unwrap(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    n = jnp.arange(float(n_mels))
+    k = jnp.arange(float(n_mfcc))[:, None]
+    dct = jnp.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct = dct.at[0].multiply(1.0 / math.sqrt(2))
+        dct = dct * math.sqrt(2.0 / n_mels)
+    return Tensor(dct.T)
+
+
+def get_window(window, win_length, fftbins=True):
+    n = win_length
+    i = jnp.arange(n)
+    denom = n if fftbins else n - 1
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * i / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * i / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * i / denom) +
+             0.08 * jnp.cos(4 * math.pi * i / denom))
+    elif window in ("rect", "boxcar", "rectangular"):
+        w = jnp.ones(n)
+    else:
+        raise ValueError(f"unknown window {window!r}")
+    return Tensor(w)

@@ -1,0 +1,304 @@
+"""`paddle.distribution` (reference: python/paddle/distribution/)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import unwrap
+from ..core.random import next_key
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Laplace",
+           "Multinomial", "kl_divergence"]
+
+
+def _t(x):
+    return Tensor(x)
+
+
+def _arr(x, dtype=jnp.float32):
+    return jnp.asarray(unwrap(x), dtype)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _t(jnp.exp(unwrap(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _t(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(next_key(), shape)
+        return _t(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = self.scale ** 2
+        return _t(-((v - self.loc) ** 2) / (2 * var) -
+                  jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape)
+        return _t(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = (v >= self.low) & (v < self.high)
+        return _t(jnp.where(inside, -jnp.log(self.high - self.low),
+                            -jnp.inf))
+
+    def entropy(self):
+        return _t(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            arr = _arr(logits)
+            # paddle semantics: `logits` are unnormalized probs
+            self.probs_arr = arr / jnp.sum(arr, -1, keepdims=True) \
+                if jnp.all(arr >= 0) else jax.nn.softmax(arr, -1)
+        else:
+            p = _arr(probs)
+            self.probs_arr = p / jnp.sum(p, -1, keepdims=True)
+        super().__init__(self.probs_arr.shape[:-1])
+
+    @property
+    def probs(self):
+        return _t(self.probs_arr)
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.maximum(self.probs_arr, 1e-38))
+        return _t(jax.random.categorical(
+            next_key(), logits, shape=tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        idx = _arr(value, jnp.int32)
+        return _t(jnp.log(jnp.take_along_axis(
+            self.probs_arr, idx[..., None], -1)[..., 0]))
+
+    def entropy(self):
+        p = self.probs_arr
+        return _t(-jnp.sum(p * jnp.log(jnp.maximum(p, 1e-38)), -1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_arr = _arr(probs)
+        super().__init__(self.probs_arr.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(jax.random.bernoulli(
+            next_key(), self.probs_arr, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = self.probs_arr
+        return _t(v * jnp.log(jnp.maximum(p, 1e-38)) +
+                  (1 - v) * jnp.log(jnp.maximum(1 - p, 1e-38)))
+
+    def entropy(self):
+        p = self.probs_arr
+        return _t(-(p * jnp.log(jnp.maximum(p, 1e-38)) +
+                    (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-38))))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(jax.random.beta(next_key(), self.alpha, self.beta,
+                                  shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        v = _arr(value)
+        return _t((self.alpha - 1) * jnp.log(v) +
+                  (self.beta - 1) * jnp.log1p(-v) -
+                  betaln(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return _t(self.alpha / (self.alpha + self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        return _t(jax.random.dirichlet(
+            next_key(), self.concentration,
+            tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        a = self.concentration
+        return _t(jnp.sum((a - 1) * jnp.log(v), -1) +
+                  gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(jax.random.exponential(next_key(), shape) / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _t(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(jax.random.gamma(next_key(), self.concentration, shape) /
+                  self.rate)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return _t(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v -
+                  gammaln(a))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(self.loc + self.scale *
+                  jax.random.laplace(next_key(), shape))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _t(-jnp.abs(v - self.loc) / self.scale -
+                  jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _t(1 + jnp.log(2 * self.scale))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        p = _arr(probs)
+        self.probs_arr = p / jnp.sum(p, -1, keepdims=True)
+        super().__init__(self.probs_arr.shape[:-1],
+                         self.probs_arr.shape[-1:])
+
+    def sample(self, shape=()):
+        cat = jax.random.categorical(
+            next_key(), jnp.log(jnp.maximum(self.probs_arr, 1e-38)),
+            shape=tuple(shape) + (self.total_count,) + self.batch_shape)
+        k = self.probs_arr.shape[-1]
+        onehot = jax.nn.one_hot(cat, k)
+        return _t(jnp.sum(onehot, axis=len(shape)))
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_p, var_q = p.scale ** 2, q.scale ** 2
+        return _t(jnp.log(q.scale / p.scale) +
+                  (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        pp, qq = p.probs_arr, q.probs_arr
+        return _t(jnp.sum(pp * (jnp.log(jnp.maximum(pp, 1e-38)) -
+                                jnp.log(jnp.maximum(qq, 1e-38))), -1))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return _t(jnp.log((q.high - q.low) / (p.high - p.low)))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        a, b = p.probs_arr, q.probs_arr
+        eps = 1e-38
+        return _t(a * (jnp.log(jnp.maximum(a, eps)) -
+                       jnp.log(jnp.maximum(b, eps))) +
+                  (1 - a) * (jnp.log(jnp.maximum(1 - a, eps)) -
+                             jnp.log(jnp.maximum(1 - b, eps))))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

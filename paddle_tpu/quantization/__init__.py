@@ -1,0 +1,178 @@
+"""`paddle.quantization` (reference: python/paddle/quantization/ — QAT/PTQ
+framework: QuantConfig, fake quanters, observers, QAT.quantize/convert).
+
+TPU-first: int8 fake-quant simulates on-device quantization; the real
+int8 path on TPU is XLA's native int8 matmul (v5e doubles int8 peak), so
+`convert` keeps weights int8 + scale and dequantizes at the op edge.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
+           "AbsmaxObserver", "quanted_linear"]
+
+
+class FakeQuanterWithAbsMaxObserver(nn.Layer):
+    """Fake quantization with a moving-average absmax observer (reference
+    fake_quanter.py)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
+                 name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self.register_buffer("scale",
+                             Tensor(jnp.ones([], jnp.float32)))
+        self._initialized = False
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+        if self.training:
+            cur = float(jnp.max(jnp.abs(x._data)))
+            if not self._initialized:
+                new_scale = cur
+                self._initialized = True
+            else:
+                new_scale = (self.moving_rate * float(self.scale._data) +
+                             (1 - self.moving_rate) * cur)
+            self.scale._rebind(jnp.asarray(new_scale, jnp.float32))
+        s = jnp.maximum(jnp.asarray(float(self.scale._data)), 1e-9)
+        import jax
+
+        def fq_ste(a):
+            # straight-through estimator: rounding is identity in grad
+            q = jnp.clip(jnp.round(a / s * qmax), -qmax, qmax)
+            deq = q * s / qmax
+            return a + jax.lax.stop_gradient(deq - a)
+
+        return apply(fq_ste, x, name="fake_quant")
+
+
+class AbsmaxObserver(nn.Layer):
+    """PTQ observer collecting absmax over calibration batches."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.absmax = 0.0
+
+    def forward(self, x):
+        self.absmax = max(self.absmax, float(jnp.max(jnp.abs(x._data))))
+        return x
+
+    def scale(self):
+        return self.absmax / (2 ** (self.quant_bits - 1) - 1)
+
+
+class QuantConfig:
+    """reference config.py QuantConfig: maps layer types/instances to
+    quanter factories."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_configs = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type_configs[t] = (activation, weight)
+
+    def _quanters_for(self, layer):
+        for t, (a, w) in self._type_configs.items():
+            if isinstance(layer, t):
+                return a, w
+        return self.activation, self.weight
+
+
+class QuantedLinear(nn.Layer):
+    """Linear with fake-quantized activations and weights (QAT form)."""
+
+    def __init__(self, linear, a_quanter, w_quanter):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self.a_quanter = a_quanter() if callable(a_quanter) else a_quanter
+        self.w_quanter = w_quanter() if callable(w_quanter) else w_quanter
+
+    def forward(self, x):
+        if self.a_quanter is not None:
+            x = self.a_quanter(x)
+        w = self.weight
+        if self.w_quanter is not None:
+            w = self.w_quanter(w)
+        return nn.functional.linear(x, w, self.bias)
+
+
+class ConvertedInt8Linear(nn.Layer):
+    """Deployment form: int8 weight + fp scale."""
+
+    def __init__(self, qlinear):
+        super().__init__()
+        qmax = 127.0
+        w = qlinear.weight._data
+        scale = float(jnp.max(jnp.abs(w))) / qmax
+        self.register_buffer("w_int8", Tensor(
+            jnp.clip(jnp.round(w / scale), -qmax, qmax).astype(jnp.int8)))
+        self.scale = scale
+        self.bias = qlinear.bias
+
+    def forward(self, x):
+        w = Tensor(self.w_int8._data.astype(jnp.float32) * self.scale)
+        return nn.functional.linear(x, w, self.bias)
+
+
+class QAT:
+    """Quantization-aware training driver (reference qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        target = model if inplace else _clone(model)
+        self._swap(target)
+        return target
+
+    def _swap(self, layer):
+        for name, sub in list(layer.named_children()):
+            if isinstance(sub, nn.Linear):
+                a, w = self.config._quanters_for(sub)
+                if a is not None or w is not None:
+                    setattr(layer, name, QuantedLinear(sub, a, w))
+            else:
+                self._swap(sub)
+
+    def convert(self, model, inplace=False):
+        target = model if inplace else _clone(model)
+        self._convert(target)
+        return target
+
+    def _convert(self, layer):
+        for name, sub in list(layer.named_children()):
+            if isinstance(sub, QuantedLinear):
+                setattr(layer, name, ConvertedInt8Linear(sub))
+            else:
+                self._convert(sub)
+
+
+class PTQ(QAT):
+    """Post-training quantization: observers instead of fake quanters."""
+
+    pass
+
+
+def quanted_linear(x, w_int8, scale, bias=None):
+    w = Tensor(w_int8._data.astype(jnp.float32) * scale)
+    return nn.functional.linear(x, w, bias)
+
+
+def _clone(model):
+    import copy
+    return copy.deepcopy(model)

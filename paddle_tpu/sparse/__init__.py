@@ -1,0 +1,206 @@
+"""`paddle.sparse` (reference: python/paddle/sparse/ over
+SparseCooTensor/SparseCsrTensor, paddle/phi/core/sparse_coo_tensor.h).
+
+TPU-first: COO tensors wrap `jax.experimental.sparse.BCOO` — XLA lowers
+scatter/gather/spmm natively; CSR keeps (crows, cols, values) and
+converts through COO for compute.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "add", "matmul", "masked_matmul", "mv",
+           "relu", "to_dense", "is_same_shape", "nn", "transpose"]
+
+
+class SparseCooTensor:
+    def __init__(self, bcoo, shape=None):
+        self._bcoo = bcoo
+        self._shape = list(shape or bcoo.shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    @property
+    def dtype(self):
+        return self._bcoo.data.dtype
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        coo = self._bcoo.sum_duplicates()
+        idx = np.asarray(coo.indices)
+        vals = np.asarray(coo.data)
+        order = np.lexsort((idx[:, 1], idx[:, 0]))
+        rows, cols = idx[order, 0], idx[order, 1]
+        n_rows = self._shape[0]
+        crows = np.zeros(n_rows + 1, np.int32)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        return SparseCsrTensor(crows, cols.astype(np.int32), vals[order],
+                               self._shape)
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates(), self._shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, "
+                f"nnz={self.nnz()})")
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self.crows_arr = jnp.asarray(unwrap(crows), jnp.int32)
+        self.cols_arr = jnp.asarray(unwrap(cols), jnp.int32)
+        self.values_arr = jnp.asarray(unwrap(values))
+        self._shape = list(shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def crows(self):
+        return Tensor(self.crows_arr)
+
+    def cols(self):
+        return Tensor(self.cols_arr)
+
+    def values(self):
+        return Tensor(self.values_arr)
+
+    def nnz(self):
+        return int(self.values_arr.shape[0])
+
+    def to_dense(self):
+        n_rows = self._shape[0]
+        counts = self.crows_arr[1:] - self.crows_arr[:-1]
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=self.nnz())
+        dense = jnp.zeros(self._shape, self.values_arr.dtype)
+        return Tensor(dense.at[rows, self.cols_arr].add(self.values_arr))
+
+    def to_sparse_coo(self, sparse_dim=2):
+        n_rows = self._shape[0]
+        counts = self.crows_arr[1:] - self.crows_arr[:-1]
+        rows = jnp.repeat(jnp.arange(n_rows), counts,
+                          total_repeat_length=self.nnz())
+        idx = jnp.stack([rows, self.cols_arr], axis=1)
+        bcoo = jsparse.BCOO((self.values_arr, idx), shape=tuple(self._shape))
+        return SparseCooTensor(bcoo)
+
+    def __repr__(self):
+        return f"SparseCsrTensor(shape={self._shape}, nnz={self.nnz()})"
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = jnp.asarray(unwrap(indices), jnp.int32)
+    vals = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from ..core import dtype as dtype_mod
+        vals = vals.astype(dtype_mod.convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    bcoo = jsparse.BCOO((vals, jnp.swapaxes(idx, 0, 1)),
+                        shape=tuple(shape))
+    return SparseCooTensor(bcoo, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def to_dense(x):
+    return x.to_dense() if isinstance(
+        x, (SparseCooTensor, SparseCsrTensor)) else x
+
+
+def add(x, y):
+    x, y = _coo(x), _coo(y)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        data = jnp.concatenate([x._bcoo.data, y._bcoo.data])
+        idx = jnp.concatenate([x._bcoo.indices, y._bcoo.indices])
+        return SparseCooTensor(
+            jsparse.BCOO((data, idx), shape=tuple(x._shape))
+            .sum_duplicates(), x._shape)
+    return Tensor(to_dense(x)._data + to_dense(y)._data)
+
+
+def matmul(x, y):
+    """sparse @ dense (reference paddle.sparse.matmul)."""
+    x = _coo(x)
+    y_arr = unwrap(y)
+    if isinstance(x, SparseCooTensor):
+        return Tensor(x._bcoo @ y_arr)
+    return Tensor(unwrap(x) @ y_arr)
+
+
+def mv(x, vec):
+    return matmul(x, vec)
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense evaluated only at mask's sparsity pattern."""
+    out = unwrap(x) @ unwrap(y)
+    m = _coo(mask)
+    idx = m._bcoo.indices
+    vals = out[idx[:, 0], idx[:, 1]]
+    return SparseCooTensor(jsparse.BCOO((vals, idx),
+                                        shape=tuple(m._shape)), m._shape)
+
+
+def relu(x):
+    x = _coo(x)
+    return SparseCooTensor(
+        jsparse.BCOO((jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
+                     shape=tuple(x._shape)), x._shape)
+
+
+def transpose(x, perm):
+    x = _coo(x)
+    idx = x._bcoo.indices[:, jnp.asarray(perm)]
+    shape = [x._shape[p] for p in perm]
+    return SparseCooTensor(jsparse.BCOO((x._bcoo.data, idx),
+                                        shape=tuple(shape)), shape)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+class _SparseNN:
+    @staticmethod
+    def ReLU():
+        class _R:
+            def __call__(self, x):
+                return relu(x)
+        return _R()
+
+
+nn = _SparseNN()

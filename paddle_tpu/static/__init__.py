@@ -1,0 +1,126 @@
+"""`paddle.static` compatibility surface.
+
+The reference's legacy static-graph mode (python/paddle/static/: Program /
+Executor / feed-fetch) has no TPU-native analogue — the compiled path is
+`paddle_tpu.jit` (trace once, XLA executes). This module keeps the most-
+used static entry points working by mapping them onto that path:
+`InputSpec`/`data` declare signatures, `save/load_inference_model` persist
+a network + params for the inference Predictor, and Executor/Program
+raise with precise migration guidance instead of silently diverging.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import dtype as dtype_mod
+
+__all__ = ["InputSpec", "data", "save_inference_model",
+           "load_inference_model", "Program", "Executor",
+           "default_main_program", "default_startup_program",
+           "program_guard", "name_scope", "gradients"]
+
+
+class InputSpec:
+    """reference paddle.static.InputSpec (python/paddle/static/
+    input.py)."""
+
+    def __init__(self, shape, dtype="float32", name=None,
+                 stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype_mod.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(list(ndarray.shape), str(ndarray.dtype), name)
+
+    def batch(self, batch_size):
+        return InputSpec([batch_size] + self.shape, self.dtype, self.name)
+
+    def unbatch(self):
+        return InputSpec(self.shape[1:], self.dtype, self.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Persist params of the layer owning ``fetch_vars`` for the
+    Predictor. In the eager front end the common call form is
+    save_inference_model(prefix, layer_or_specs, layer, ...)."""
+    from ..framework.io import save
+    layer = None
+    for cand in (fetch_vars, executor, program):
+        if hasattr(cand, "state_dict"):
+            layer = cand
+            break
+    if layer is None:
+        raise ValueError(
+            "save_inference_model: pass the Layer as fetch_vars "
+            "(TPU-native deployment serializes params + a network factory; "
+            "see paddle_tpu.inference.Config)")
+    save(layer.state_dict(), path_prefix + ".pdiparams")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from ..framework.io import load
+    return load(path_prefix + ".pdiparams")
+
+
+_MIGRATION = (
+    "the legacy static-graph Program/Executor does not exist in "
+    "paddle_tpu: decorate your model/step with paddle_tpu.jit.to_static "
+    "or use paddle_tpu.jit.TrainStep — the traced function IS the "
+    "program, compiled and scheduled by XLA")
+
+
+class Program:
+    def __init__(self):
+        raise NotImplementedError(_MIGRATION)
+
+
+class Executor:
+    def __init__(self, place=None):
+        raise NotImplementedError(_MIGRATION)
+
+
+def default_main_program():
+    raise NotImplementedError(_MIGRATION)
+
+
+def default_startup_program():
+    raise NotImplementedError(_MIGRATION)
+
+
+class program_guard:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MIGRATION)
+
+
+class name_scope:
+    def __init__(self, name=""):
+        self.name = name
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..core.autograd import grad
+    return grad(targets, inputs, grad_outputs=target_gradients,
+                retain_graph=True, allow_unused=True)

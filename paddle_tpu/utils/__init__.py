@@ -1,0 +1,26 @@
+"""`paddle.utils` (reference: python/paddle/utils/)."""
+
+from . import dlpack  # noqa: F401
+from . import cpp_extension  # noqa: F401
+from .flops import flops  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or f"{module_name} is required") from e
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the runtime works."""
+    import jax
+
+    import paddle_tpu as paddle
+    x = paddle.randn([4, 4])
+    y = paddle.matmul(x, x)
+    y.numpy()
+    n = jax.device_count()
+    print(f"paddle_tpu works. devices: {n} ({jax.default_backend()})")
+    return True

@@ -1,0 +1,79 @@
+"""Out-of-tree custom-op build system.
+
+Parity: reference `paddle.utils.cpp_extension` (cpp_extension/
+cpp_extension.py:86 `setup`, JIT `load`) compiling user C++/CUDA ops
+against the phi C++ API (PD_BUILD_OP). TPU-native equivalent: user C++
+builds against a plain C ABI (no framework headers needed) and the op is
+registered as a host callback or pure-python jnp composition; `load`
+compiles with g++ and returns a ctypes module. For device-side custom
+kernels users write Pallas (the Pallas guide is the CUDA-kernel
+replacement), which needs no build system at all.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+__all__ = ["load", "setup", "CppExtension", "CUDAExtension",
+           "get_build_directory"]
+
+_BUILD_ROOT = os.path.expanduser("~/.cache/paddle_tpu/extensions")
+
+
+def get_build_directory():
+    os.makedirs(_BUILD_ROOT, exist_ok=True)
+    return _BUILD_ROOT
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_cuda_cflags=None,
+         extra_ldflags=None, extra_include_paths=None, build_directory=None,
+         verbose=False):
+    """JIT-compile C++ sources into a shared library; returns the loaded
+    ctypes.CDLL. Functions use a plain C ABI."""
+    build_dir = build_directory or get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    so_path = os.path.join(build_dir, f"{name}-{h.hexdigest()[:12]}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               "-o", so_path]
+        for inc in extra_include_paths or []:
+            cmd.append(f"-I{inc}")
+        cmd += list(extra_cxx_cflags or [])
+        cmd += list(sources)
+        cmd += list(extra_ldflags or [])
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(so_path)
+
+
+class CppExtension:
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+CUDAExtension = CppExtension  # accepted for parity; no CUDA on TPU hosts
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Build-at-install parity: compiles each extension immediately and
+    drops the .so next to the build dir (a full setuptools flow is
+    unnecessary for the C-ABI contract)."""
+    exts = ext_modules if isinstance(ext_modules, (list, tuple)) else \
+        [ext_modules]
+    libs = []
+    for i, ext in enumerate(exts):
+        if ext is None:
+            continue
+        libs.append(load(f"{name or 'ext'}_{i}", ext.sources,
+                         **{k: v for k, v in ext.kwargs.items()
+                            if k.startswith("extra_")}))
+    return libs

@@ -1,0 +1,18 @@
+"""DLPack interop (reference: python/paddle/utils/dlpack.py)."""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+
+def to_dlpack(x):
+    import jax
+    arr = x._data if isinstance(x, Tensor) else x
+    return jax.dlpack.to_dlpack(arr) if hasattr(jax.dlpack, "to_dlpack") \
+        else arr.__dlpack__()
+
+
+def from_dlpack(capsule):
+    import jax
+    arr = jax.dlpack.from_dlpack(capsule)
+    return Tensor(arr)

@@ -1,0 +1,59 @@
+"""FLOPs counter (reference: python/paddle/hapi/dynamic_flops.py,
+exposed as paddle.flops)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Estimate forward FLOPs by hooking leaf layers."""
+    from .. import nn
+
+    counts = {}
+
+    def conv_flops(layer, inp, out):
+        arr = out[0] if isinstance(out, (tuple, list)) else out
+        k = int(np.prod(layer._kernel_size))
+        cin = layer._in_channels // layer._groups
+        return 2 * k * cin * arr.size
+
+    def linear_flops(layer, inp, out):
+        arr = out[0] if isinstance(out, (tuple, list)) else out
+        return 2 * layer.weight.shape[0] * arr.size
+
+    table = []
+    hooks = []
+    total = [0]
+
+    def make_hook(name, fn):
+        def hook(layer, inputs, outputs):
+            n = fn(layer, inputs, outputs)
+            total[0] += n
+            table.append((name, n))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        if isinstance(layer, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, conv_flops)))
+        elif isinstance(layer, nn.Linear):
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, linear_flops)))
+        if custom_ops and type(layer) in custom_ops:
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, custom_ops[type(layer)])))
+
+    x = Tensor(np.zeros(input_size, np.float32))
+    net.eval()
+    net(x)
+    for h in hooks:
+        h.remove()
+    if print_detail:
+        for name, n in table:
+            print(f"{name:<40} {n:,}")
+    print(f"Total Flops: {total[0]:,}  Total Params: "
+          f"{sum(p.size for p in net.parameters()):,}")
+    return total[0]

@@ -1,0 +1,122 @@
+"""sparse / distribution / quantization / static / utils / audio."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, sparse, distribution, quantization, static
+
+
+def test_sparse_coo_roundtrip():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    st = sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+    dense = st.to_dense().numpy()
+    expect = np.zeros((3, 3), "float32")
+    expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense, expect)
+    assert st.nnz() == 3
+
+    csr = st.to_sparse_csr()
+    np.testing.assert_allclose(csr.to_dense().numpy(), expect)
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(back.to_dense().numpy(), expect)
+
+
+def test_sparse_matmul_and_relu():
+    st = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [-2.0, 3.0],
+                                  shape=[2, 2])
+    d = np.random.randn(2, 4).astype("float32")
+    out = sparse.matmul(st, paddle.to_tensor(d)).numpy()
+    np.testing.assert_allclose(out, st.to_dense().numpy() @ d, atol=1e-6)
+    r = sparse.relu(st).to_dense().numpy()
+    assert r[0, 0] == 0 and r[1, 1] == 3
+
+
+def test_distribution_normal():
+    paddle.seed(0)
+    d = distribution.Normal(0.0, 1.0)
+    s = d.sample([10000])
+    assert abs(float(s.numpy().mean())) < 0.05
+    lp = d.log_prob(paddle.to_tensor(0.0))
+    np.testing.assert_allclose(float(lp), -0.5 * np.log(2 * np.pi),
+                               atol=1e-5)
+    q = distribution.Normal(1.0, 2.0)
+    kl = distribution.kl_divergence(d, q)
+    # analytic: log(2) + (1 + 1)/8 - 1/2
+    np.testing.assert_allclose(float(kl), np.log(2) + 2 / 8 - 0.5,
+                               atol=1e-5)
+
+
+def test_distribution_categorical():
+    paddle.seed(0)
+    c = distribution.Categorical(probs=[0.1, 0.2, 0.7])
+    s = c.sample([5000]).numpy()
+    assert (s == 2).mean() > 0.6
+    ent = float(c.entropy())
+    assert 0 < ent < np.log(3) + 1e-6
+
+
+def test_quantization_qat_roundtrip():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    cfg = quantization.QuantConfig(
+        activation=lambda: quantization.FakeQuanterWithAbsMaxObserver(),
+        weight=lambda: quantization.FakeQuanterWithAbsMaxObserver())
+    qat = quantization.QAT(cfg)
+    qnet = qat.quantize(net)
+    x = paddle.randn([4, 8])
+    out = qnet(x)
+    assert out.shape == [4, 4]
+    # backward works through STE
+    out.sum().backward()
+    # convert to int8 deployment form
+    qnet.eval()
+    deployed = qat.convert(qnet)
+    out2 = deployed(x)
+    # int8 sim should be close to fake-quant output
+    assert np.abs(out2.numpy() - out.numpy()).max() < 0.5
+
+
+def test_static_input_spec_and_gradients():
+    spec = static.InputSpec([None, 8], "float32", "x")
+    assert spec.batch(4).shape == [4, None, 8]
+    with pytest.raises(NotImplementedError):
+        static.Executor()
+
+    lin = nn.Linear(4, 1)
+    x = paddle.randn([3, 4])
+    y = lin(x).sum()
+    (g,) = static.gradients(y, [lin.weight])
+    assert g.shape == [4, 1]
+
+
+def test_utils_flops_and_dlpack():
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(8 * 16 * 16, 10))
+    n = paddle.flops(net, (1, 3, 16, 16))
+    assert n > 0
+    from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+    t = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    t2 = from_dlpack(t._data)  # jax arrays implement __dlpack__
+    np.testing.assert_allclose(t.numpy(), t2.numpy())
+
+
+def test_audio_features():
+    from paddle_tpu.audio.features import MFCC, LogMelSpectrogram
+    paddle.seed(0)
+    wav = paddle.randn([1, 2048])
+    mel = LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(wav)
+    assert mel.shape[1] == 32
+    mfcc = MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32)(wav)
+    assert mfcc.shape[1] == 13
+
+
+def test_cpp_extension_load(tmp_path):
+    src = tmp_path / "myop.cc"
+    src.write_text(
+        'extern "C" int add_int(int a, int b) { return a + b; }\n')
+    from paddle_tpu.utils import cpp_extension
+    lib = cpp_extension.load("myop", [str(src)],
+                             build_directory=str(tmp_path))
+    assert lib.add_int(2, 3) == 5
