@@ -1,0 +1,363 @@
+"""Recurrent layers.
+
+Parity: reference `python/paddle/nn/layer/rnn.py` (RNNCellBase,
+SimpleRNNCell/LSTMCell/GRUCell, RNN/BiRNN wrappers, multi-layer
+SimpleRNN/LSTM/GRU over phi rnn kernels/cuDNN). TPU-first: the time loop
+is `lax.scan` — one compiled fused step reused across time (no cuDNN
+descriptor machinery), gates are single [.., 4h] / [.., 3h] MXU matmuls.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from .. import functional as F
+from .. import initializer as init
+from .layers import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ... import ops
+        b = batch_ref.shape[batch_dim_idx]
+        return ops.full([b, self.hidden_size], init_value,
+                        dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / hidden_size ** 0.5
+        u = init.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr, default_initializer=u,
+            is_bias=True)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr, default_initializer=u,
+            is_bias=True)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else \
+            (lambda a: jnp.maximum(a, 0))
+
+        def fn(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out, out
+
+        out, new = apply(fn, inputs, states, self.weight_ih,
+                         self.weight_hh, self.bias_ih, self.bias_hh,
+                         name="simple_rnn_cell")
+        return out, new
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / hidden_size ** 0.5
+        u = init.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr, default_initializer=u,
+            is_bias=True)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr, default_initializer=u,
+            is_bias=True)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def fn(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = f * cc + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h_new, c_new = apply(fn, inputs, h, c, self.weight_ih,
+                             self.weight_hh, self.bias_ih, self.bias_hh,
+                             name="lstm_cell")
+        return h_new, (h_new, c_new)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / hidden_size ** 0.5
+        u = init.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr, default_initializer=u,
+            is_bias=True)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr, default_initializer=u,
+            is_bias=True)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            return (1 - z) * n + z * h
+
+        h_new = apply(fn, inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh, name="gru_cell")
+        return h_new, h_new
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+def _scan_layer(cell_kind, x, h0, params, reverse=False):
+    """One direction of one layer as lax.scan over time.
+    x: [b, t, in]; params: dict of arrays; h0: tuple of [b, h]."""
+
+    def lstm_step(carry, xt):
+        h, c = carry
+        gates = xt @ params["wi"].T + params["bi"] + \
+            h @ params["wh"].T + params["bh"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    def gru_step(carry, xt):
+        (h,) = carry
+        gi = xt @ params["wi"].T + params["bi"]
+        gh = h @ params["wh"].T + params["bh"]
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n)
+        h_new = (1 - z) * n + z * h
+        return (h_new,), h_new
+
+    def rnn_step(carry, xt):
+        (h,) = carry
+        h_new = jnp.tanh(xt @ params["wi"].T + params["bi"] +
+                         h @ params["wh"].T + params["bh"])
+        return (h_new,), h_new
+
+    step = {"lstm": lstm_step, "gru": gru_step, "rnn": rnn_step}[cell_kind]
+    xt = jnp.swapaxes(x, 0, 1)  # [t, b, in]
+    carry, ys = lax.scan(step, h0, xt, reverse=reverse)
+    return jnp.swapaxes(ys, 0, 1), carry
+
+
+class _RNNBase(Layer):
+    _kind = "rnn"
+    _gates = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirectional else 1
+        self.num_directions = num_dir
+        std = 1.0 / hidden_size ** 0.5
+        u = init.Uniform(-std, std)
+        g = self._gates
+        from .container import ParameterList
+        self._params = ParameterList()
+        self._layout = []  # (layer, dir) per 4-param group
+        for layer in range(num_layers):
+            for d in range(num_dir):
+                in_sz = input_size if layer == 0 else hidden_size * num_dir
+                for shape in ([g * hidden_size, in_sz],
+                              [g * hidden_size, hidden_size],
+                              [g * hidden_size], [g * hidden_size]):
+                    self._params.append(self.create_parameter(
+                        shape, default_initializer=u,
+                        is_bias=len(shape) == 1))
+                self._layout.append((layer, d))
+
+    def _group(self, layer, d):
+        idx = self._layout.index((layer, d)) * 4
+        p = list(self._params)[idx:idx + 4]
+        return {"wi": p[0], "wh": p[1], "bi": p[2], "bh": p[3]}
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import ops
+        x = inputs
+        if self.time_major:
+            x = ops.transpose(x, [1, 0, 2])
+        kind = self._kind
+        num_dir = self.num_directions
+        b = x.shape[0]
+
+        def run(xa, *flat):
+            it = iter(flat)
+            groups = [{k: next(it) for k in ("wi", "wh", "bi", "bh")}
+                      for _ in range(self.num_layers * num_dir)]
+            h_final, c_final = [], []
+            cur = xa
+            gi = 0
+            for layer in range(self.num_layers):
+                outs = []
+                for d in range(num_dir):
+                    params = groups[gi]
+                    gi += 1
+                    hsize = (b, self.hidden_size)
+                    if kind == "lstm":
+                        h0 = (jnp.zeros(hsize, xa.dtype),
+                              jnp.zeros(hsize, xa.dtype))
+                    else:
+                        h0 = (jnp.zeros(hsize, xa.dtype),)
+                    ys, carry = _scan_layer(kind, cur, h0, params,
+                                            reverse=(d == 1))
+                    outs.append(ys)
+                    h_final.append(carry[0])
+                    if kind == "lstm":
+                        c_final.append(carry[1])
+                cur = outs[0] if num_dir == 1 else \
+                    jnp.concatenate(outs, axis=-1)
+            h_stack = jnp.stack(h_final, 0)
+            if kind == "lstm":
+                return cur, h_stack, jnp.stack(c_final, 0)
+            return cur, h_stack
+
+        flat = []
+        for layer in range(self.num_layers):
+            for d in range(num_dir):
+                gp = self._group(layer, d)
+                flat += [gp["wi"], gp["wh"], gp["bi"], gp["bh"]]
+        out = apply(run, x, *flat, name=self._kind)
+        if self._kind == "lstm":
+            y, h, c = out
+            states = (h, c)
+        else:
+            y, h = out
+            states = h
+        if self.time_major:
+            y = ops.transpose(y, [1, 0, 2])
+        return y, states
+
+
+class SimpleRNN(_RNNBase):
+    _kind = "rnn"
+    _gates = 1
+
+
+class LSTM(_RNNBase):
+    _kind = "lstm"
+    _gates = 4
+
+
+class GRU(_RNNBase):
+    _kind = "gru"
+    _gates = 3
+
+
+class RNN(Layer):
+    """Wrapper running a cell over time (reference rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import ops
+        x = inputs
+        if self.time_major:
+            x = ops.transpose(x, [1, 0, 2])
+        t = x.shape[1]
+        steps = range(t - 1, -1, -1) if self.is_reverse else range(t)
+        states = initial_states
+        outs = [None] * t
+        for i in steps:
+            out, states = self.cell(x[:, i], states)
+            outs[i] = out
+        y = ops.stack(outs, axis=1)
+        if self.time_major:
+            y = ops.transpose(y, [1, 0, 2])
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import ops
+        sf = sb = None
+        if initial_states is not None:
+            sf, sb = initial_states
+        yf, stf = self.rnn_fw(inputs, sf)
+        yb, stb = self.rnn_bw(inputs, sb)
+        return ops.concat([yf, yb], axis=-1), (stf, stb)

@@ -1,0 +1,91 @@
+"""RNN layers vs torch oracle (reference op-test style, SURVEY.md §4)."""
+
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _copy_weights(group, tmod, suffix="l0"):
+    with torch.no_grad():
+        getattr(tmod, f"weight_ih_{suffix}").copy_(
+            torch.tensor(group["wi"].numpy()))
+        getattr(tmod, f"weight_hh_{suffix}").copy_(
+            torch.tensor(group["wh"].numpy()))
+        getattr(tmod, f"bias_ih_{suffix}").copy_(
+            torch.tensor(group["bi"].numpy()))
+        getattr(tmod, f"bias_hh_{suffix}").copy_(
+            torch.tensor(group["bh"].numpy()))
+
+
+def test_lstm_matches_torch():
+    paddle.seed(0)
+    B, T, I, H = 2, 5, 3, 4
+    lstm = nn.LSTM(I, H)
+    tl = torch.nn.LSTM(I, H, batch_first=True)
+    _copy_weights(lstm._group(0, 0), tl)
+    x = np.random.randn(B, T, I).astype("float32")
+    y, (h, c) = lstm(paddle.to_tensor(x))
+    ty, (th, tc) = tl(torch.tensor(x))
+    np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), th.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), tc.detach().numpy(), atol=1e-5)
+
+
+def test_gru_matches_torch():
+    paddle.seed(1)
+    B, T, I, H = 2, 6, 3, 4
+    gru = nn.GRU(I, H)
+    tg = torch.nn.GRU(I, H, batch_first=True)
+    _copy_weights(gru._group(0, 0), tg)
+    x = np.random.randn(B, T, I).astype("float32")
+    y, h = gru(paddle.to_tensor(x))
+    ty, th = tg(torch.tensor(x))
+    np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), atol=1e-5)
+
+
+def test_simple_rnn_matches_torch():
+    paddle.seed(2)
+    B, T, I, H = 2, 4, 3, 4
+    rnn = nn.SimpleRNN(I, H)
+    tr = torch.nn.RNN(I, H, batch_first=True)
+    _copy_weights(rnn._group(0, 0), tr)
+    x = np.random.randn(B, T, I).astype("float32")
+    y, h = rnn(paddle.to_tensor(x))
+    ty, th = tr(torch.tensor(x))
+    np.testing.assert_allclose(y.numpy(), ty.detach().numpy(), atol=1e-5)
+
+
+def test_bidirectional_multilayer_backward():
+    paddle.seed(3)
+    bl = nn.LSTM(3, 4, num_layers=2, direction="bidirectional")
+    x = paddle.randn([2, 5, 3])
+    y, (h, c) = bl(x)
+    assert y.shape == [2, 5, 8]
+    assert h.shape == [4, 2, 4]
+    y.sum().backward()
+    for p in bl.parameters():
+        assert p.grad is not None
+
+
+def test_lstm_cell_and_rnn_wrapper():
+    paddle.seed(4)
+    cell = nn.LSTMCell(3, 4)
+    rnn = nn.RNN(cell)
+    x = paddle.randn([2, 5, 3])
+    y, (h, c) = rnn(x)
+    assert y.shape == [2, 5, 4]
+    # manual unroll equals wrapper
+    states = None
+    for i in range(5):
+        out, states = cell(x[:, i], states)
+    np.testing.assert_allclose(y.numpy()[:, -1], out.numpy(), atol=1e-6)
+
+
+def test_birnn_wrapper():
+    paddle.seed(5)
+    fw, bw = nn.GRUCell(3, 4), nn.GRUCell(3, 4)
+    bi = nn.BiRNN(fw, bw)
+    y, states = bi(paddle.randn([2, 5, 3]))
+    assert y.shape == [2, 5, 8]
