@@ -50,7 +50,7 @@ def main():
     if on_tpu:
         batch, seq = 8, 1024
         config = GPTConfig.gpt2_medium()
-        steps = 10
+        steps = 20
     else:  # smoke mode off-TPU
         batch, seq = 2, 64
         config = GPTConfig.tiny()
