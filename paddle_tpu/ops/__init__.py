@@ -27,6 +27,7 @@ from .search import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .einsum import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
 from . import creation, math, reduction, manipulation, logic, search
 from . import random, linalg, einsum as einsum_mod

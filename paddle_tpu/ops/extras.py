@@ -1,0 +1,116 @@
+"""Remaining op-surface gaps (audited against reference
+python/paddle/tensor exports)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["addmm", "bincount", "increment", "index_fill", "inverse",
+           "is_complex", "is_floating_point", "renorm", "scatter_nd",
+           "scatter_nd_add", "signbit", "take", "tolist", "unfold"]
+
+
+def tolist(x):
+    return x.tolist() if isinstance(x, Tensor) else list(x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y,
+                 name="addmm")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = unwrap(x)
+    n = max(int(arr.max()) + 1 if arr.size else 0, minlength)
+    if weights is None:
+        return apply(lambda a: jnp.bincount(a, length=n), x,
+                     name="bincount")
+    return apply(lambda a, w: jnp.bincount(a, weights=w, length=n), x,
+                 weights, name="bincount")
+
+
+def increment(x, value=1.0, name=None):
+    from . import _inplace_from
+    out = apply(lambda a: a + value, x, name="increment")
+    return _inplace_from(x, out)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        filled = moved.at[idx].set(value)
+        return jnp.moveaxis(filled, 0, axis)
+    return apply(fn, x, index, name="index_fill")
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, x, name="inverse")
+
+
+def is_complex(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.floating)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return apply(fn, x, name="renorm")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def fn(idx, upd):
+        out = jnp.zeros(tuple(shape), upd.dtype)
+        return out.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply(fn, index, updates, name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(a, idx, upd):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply(fn, x, index, updates, name="scatter_nd_add")
+
+
+def signbit(x, name=None):
+    return apply(jnp.signbit, x, name="signbit")
+
+
+def take(x, index, mode="raise", name=None):
+    def fn(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            idx = idx % n
+        elif mode == "clip":
+            idx = jnp.clip(idx, 0, n - 1)
+        else:
+            idx = jnp.where(idx < 0, idx + n, idx)
+        return flat[idx]
+    return apply(fn, x, index, name="take")
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis`` (paddle.Tensor.unfold)."""
+    def fn(a):
+        length = a.shape[axis]
+        n = (length - size) // step + 1
+        starts = jnp.arange(n) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]
+        moved = jnp.moveaxis(a, axis, 0)
+        win = moved[idx]  # [n, size, ...rest]
+        win = jnp.moveaxis(win, 1, -1)  # size to the end
+        return jnp.moveaxis(win, 0, axis)
+    return apply(fn, x, name="unfold")
