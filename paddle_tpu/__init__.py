@@ -58,7 +58,10 @@ from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import utils  # noqa: F401
 from . import vision  # noqa: F401
+from . import text  # noqa: F401
+from . import geometric  # noqa: F401
 from .utils.flops import flops  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
 from .amp import debugging as _amp_debugging  # noqa: F401
 
 __version__ = "0.1.0"

@@ -24,5 +24,6 @@ from .recompute import recompute, recompute_sequential  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .moe import MoELayer, TopKGate  # noqa: F401
+from .parallel import DataParallel, spawn  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
 from .pipeline import PipelineDecoderLM  # noqa: F401

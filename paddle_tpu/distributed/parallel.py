@@ -1,0 +1,91 @@
+"""DataParallel + spawn.
+
+Parity: reference python/paddle/distributed/parallel.py — `DataParallel`
+(:218, wrapping a Layer with EagerReducer bucketed grad allreduce) and
+`spawn.py`. TPU-first: with a mesh-sharded batch GSPMD already reduce-
+scatters/all-reduces gradients inside the compiled step, so DataParallel
+is a transparent wrapper that (a) records the dp group, (b) keeps the
+`scale_loss`/`no_sync` API, and (c) placements-replicates params.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import multiprocessing
+import os
+
+from .. import nn
+from .api import apply_placement_rules
+from .mesh import get_mesh
+
+__all__ = ["DataParallel", "spawn"]
+
+
+class DataParallel(nn.Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        mesh = group.mesh if group is not None else get_mesh()
+        if mesh is not None:
+            apply_placement_rules(layers, [], mesh)  # replicate params
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # grad averaging happens in the mesh reduction; identity here
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+
+def _worker_entry(rank, nprocs, fn, args, env):
+    for k, v in env.items():
+        os.environ[k] = v
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    fn(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference spawn.py: launch ``nprocs`` training processes."""
+    if nprocs == -1:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    ctx = multiprocessing.get_context("spawn")
+    procs = []
+    env = {k: v for k, v in os.environ.items()
+           if k.startswith(("PADDLE_", "JAX_", "XLA_"))}
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker_entry,
+                        args=(rank, nprocs, func, args, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(
+                    f"spawn: worker exited with code {p.exitcode}")
+    return procs

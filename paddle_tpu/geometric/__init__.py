@@ -1,0 +1,112 @@
+"""`paddle.geometric` (reference: python/paddle/geometric/ — graph
+message passing + segment ops over phi graph_send_recv kernels).
+TPU-first: scatter-adds (`at[].add/max/min`) — XLA lowers these to sorted
+segment ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply, unwrap
+
+__all__ = ["send_u_recv", "send_ue_recv", "segment_sum", "segment_mean",
+           "segment_max", "segment_min"]
+
+
+def _out_size(dst, out_size):
+    if out_size is not None:
+        return int(out_size)
+    return int(unwrap(dst).max()) + 1
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    n = _out_size(dst_index, out_size)
+
+    def fn(a, src, dst):
+        msgs = a[src]
+        shape = (n,) + a.shape[1:]
+        if reduce_op == "sum":
+            return jnp.zeros(shape, a.dtype).at[dst].add(msgs)
+        if reduce_op == "mean":
+            s = jnp.zeros(shape, a.dtype).at[dst].add(msgs)
+            cnt = jnp.zeros((n,), a.dtype).at[dst].add(1.0)
+            return s / jnp.maximum(cnt, 1.0).reshape(
+                (n,) + (1,) * (a.ndim - 1))
+        if reduce_op == "max":
+            init = jnp.full(shape, -jnp.inf, a.dtype)
+            out = init.at[dst].max(msgs)
+            return jnp.where(jnp.isinf(out), 0.0, out)
+        if reduce_op == "min":
+            init = jnp.full(shape, jnp.inf, a.dtype)
+            out = init.at[dst].min(msgs)
+            return jnp.where(jnp.isinf(out), 0.0, out)
+        raise ValueError(reduce_op)
+
+    return apply(fn, x, src_index, dst_index, name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    n = _out_size(dst_index, out_size)
+
+    def fn(a, e, src, dst):
+        msgs = a[src]
+        if message_op == "add":
+            msgs = msgs + e
+        elif message_op == "mul":
+            msgs = msgs * e
+        shape = (n,) + msgs.shape[1:]
+        if reduce_op == "sum":
+            return jnp.zeros(shape, msgs.dtype).at[dst].add(msgs)
+        if reduce_op == "mean":
+            s = jnp.zeros(shape, msgs.dtype).at[dst].add(msgs)
+            cnt = jnp.zeros((n,), msgs.dtype).at[dst].add(1.0)
+            return s / jnp.maximum(cnt, 1.0).reshape(
+                (n,) + (1,) * (msgs.ndim - 1))
+        if reduce_op == "max":
+            out = jnp.full(shape, -jnp.inf, msgs.dtype).at[dst].max(msgs)
+            return jnp.where(jnp.isinf(out), 0.0, out)
+        if reduce_op == "min":
+            out = jnp.full(shape, jnp.inf, msgs.dtype).at[dst].min(msgs)
+            return jnp.where(jnp.isinf(out), 0.0, out)
+        raise ValueError(reduce_op)
+
+    return apply(fn, x, y, src_index, dst_index, name="send_ue_recv")
+
+
+def _segment(x, segment_ids, mode):
+    n = int(unwrap(segment_ids).max()) + 1
+
+    def fn(a, seg):
+        shape = (n,) + a.shape[1:]
+        if mode == "sum":
+            return jnp.zeros(shape, a.dtype).at[seg].add(a)
+        if mode == "mean":
+            s = jnp.zeros(shape, a.dtype).at[seg].add(a)
+            cnt = jnp.zeros((n,), a.dtype).at[seg].add(1.0)
+            return s / jnp.maximum(cnt, 1.0).reshape(
+                (n,) + (1,) * (a.ndim - 1))
+        if mode == "max":
+            out = jnp.full(shape, -jnp.inf, a.dtype).at[seg].max(a)
+            return jnp.where(jnp.isinf(out), 0.0, out)
+        out = jnp.full(shape, jnp.inf, a.dtype).at[seg].min(a)
+        return jnp.where(jnp.isinf(out), 0.0, out)
+
+    return apply(fn, x, segment_ids, name=f"segment_{mode}")
+
+
+def segment_sum(x, segment_ids, name=None):
+    return _segment(x, segment_ids, "sum")
+
+
+def segment_mean(x, segment_ids, name=None):
+    return _segment(x, segment_ids, "mean")
+
+
+def segment_max(x, segment_ids, name=None):
+    return _segment(x, segment_ids, "max")
+
+
+def segment_min(x, segment_ids, name=None):
+    return _segment(x, segment_ids, "min")

@@ -1,0 +1,69 @@
+"""`paddle.text` (reference: python/paddle/text/ — datasets + viterbi
+decode op)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (reference paddle.text.viterbi_decode /
+    phi viterbi_decode kernel). potentials: [b, t, n] emissions,
+    transition_params: [n, n] (+2 with bos/eos tags at [-2]=bos, [-1]=eos).
+    Returns (scores [b], paths [b, t])."""
+
+    def fn(emis, trans):
+        b, t, n = emis.shape
+
+        if include_bos_eos_tag:
+            start = trans[-2, :][None, :]  # bos -> tag
+            stop = trans[:, -1]
+        else:
+            start = jnp.zeros((1, n), emis.dtype)
+            stop = jnp.zeros((n,), emis.dtype)
+
+        alpha0 = emis[:, 0] + start  # [b, n]
+
+        def step(alpha, e_t):
+            # scores[b, i, j] = alpha[b, i] + trans[i, j]
+            scores = alpha[:, :, None] + trans[None, :n, :n]
+            best_prev = jnp.argmax(scores, axis=1)  # [b, n]
+            alpha_new = jnp.max(scores, axis=1) + e_t
+            return alpha_new, best_prev
+
+        alpha, backptrs = jax.lax.scan(step, alpha0,
+                                       jnp.swapaxes(emis[:, 1:], 0, 1))
+        alpha = alpha + stop[None, :]
+        last = jnp.argmax(alpha, axis=-1)  # [b]
+        score = jnp.max(alpha, axis=-1)
+
+        def backtrace(carry, bp_t):
+            tag = carry
+            prev = jnp.take_along_axis(bp_t, tag[:, None], 1)[:, 0]
+            return prev, tag
+
+        tag0, path_rest = jax.lax.scan(backtrace, last, backptrs,
+                                       reverse=True)
+        # path_rest[k] = tag at step k+1; tag0 = tag at step 0
+        path = jnp.concatenate([tag0[None], path_rest], axis=0) if t > 1 \
+            else last[None]
+        return score, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+
+    return apply(fn, potentials, transition_params, name="viterbi_decode")
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,75 @@
+"""text / geometric / DataParallel / extras ops."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric, nn
+from paddle_tpu.text import viterbi_decode
+
+
+def test_viterbi_vs_bruteforce():
+    import itertools
+    rng = np.random.default_rng(0)
+    b, t, n = 2, 5, 3
+    emis = rng.standard_normal((b, t, n)).astype("float32")
+    trans = rng.standard_normal((n, n)).astype("float32")
+    score, path = viterbi_decode(paddle.to_tensor(emis),
+                                 paddle.to_tensor(trans),
+                                 include_bos_eos_tag=False)
+    for i in range(b):
+        best, best_path = None, None
+        for p in itertools.product(range(n), repeat=t):
+            s = emis[i, 0, p[0]] + sum(
+                emis[i, k, p[k]] + trans[p[k - 1], p[k]]
+                for k in range(1, t))
+            if best is None or s > best:
+                best, best_path = s, list(p)
+        assert abs(float(score.numpy()[i]) - best) < 1e-4
+        assert list(path.numpy()[i]) == best_path
+
+
+def test_send_u_recv():
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                  "float32"))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+    out = geometric.send_u_recv(x, src, dst, reduce_op="sum").numpy()
+    expect = np.zeros((3, 2), "float32")
+    expect[1] += [1, 2]
+    expect[2] += [3, 4]
+    expect[1] += [5, 6]
+    expect[0] += [1, 2]
+    np.testing.assert_allclose(out, expect)
+
+    mx = geometric.send_u_recv(x, src, dst, reduce_op="max").numpy()
+    np.testing.assert_allclose(mx[1], [5, 6])
+
+
+def test_segment_ops():
+    x = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                  "float32"))
+    seg = paddle.to_tensor(np.array([0, 0, 1]))
+    np.testing.assert_allclose(
+        geometric.segment_sum(x, seg).numpy(), [[4, 6], [5, 6]])
+    np.testing.assert_allclose(
+        geometric.segment_mean(x, seg).numpy(), [[2, 3], [5, 6]])
+    np.testing.assert_allclose(
+        geometric.segment_max(x, seg).numpy(), [[3, 4], [5, 6]])
+
+
+def test_data_parallel_wrapper():
+    import paddle_tpu.distributed as dist
+    mesh = dist.init_mesh([8], ["dp"])
+    dist.set_mesh(mesh)
+    net = nn.Linear(4, 4)
+    dp = paddle.DataParallel(net)
+    x = paddle.randn([8, 4])
+    out = dp(x)
+    assert out.shape == [8, 4]
+    loss = dp.scale_loss(out.sum())
+    loss.backward()
+    dp.apply_collective_grads()
+    assert net.weight.grad is not None
+    with dp.no_sync():
+        pass
+    assert "weight" in dp.state_dict()
