@@ -98,8 +98,15 @@ def apply(fn: Callable, *args, name: str = None, **kwargs):
 
 def _post_op_hooks(name, outs, check_naninf):
     """Per-op post hooks: NaN/Inf sanitizer (FLAGS_check_nan_inf — the
-    generated-ad_func CheckTensorHasNanOrInf analogue) and AMP op-stats."""
+    generated-ad_func CheckTensorHasNanOrInf analogue), AMP op-stats, and
+    profiler op spans (the generated ad_funcs' RecordEvent analogue)."""
     import sys
+
+    prof = sys.modules.get("paddle_tpu.profiler")
+    if prof is not None and prof._recorder.enabled:
+        import time
+        now = time.perf_counter_ns() / 1000.0
+        prof._recorder.record(name, now, now, "Operator")
 
     dbg = sys.modules.get("paddle_tpu.amp.debugging")
     if dbg is not None and getattr(dbg, "_op_stats", None) is not None:

@@ -1,0 +1,86 @@
+"""Auto-tuner: search over parallel configurations.
+
+Parity: reference `python/paddle/distributed/auto_tuner/` (tuner.py:21 —
+grid/prune search over dp/mp/pp/sharding/micro-batch driven by
+`launch --auto_tuner_json`, with history + cost model). Here the search
+enumerates valid mesh factorizations, prunes infeasible ones (divisibility,
+memory heuristic), and measures each candidate with a user-supplied
+`trial_fn(config) -> cost` (step time); `history()` returns all results.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+
+__all__ = ["AutoTuner", "default_candidates"]
+
+
+def default_candidates(num_devices, num_layers=None, max_mp=8, max_pp=8):
+    cands = []
+    for mp, pp in itertools.product([1, 2, 4, 8], [1, 2, 4, 8]):
+        if mp > max_mp or pp > max_pp:
+            continue
+        if num_devices % (mp * pp) != 0:
+            continue
+        dp = num_devices // (mp * pp)
+        if num_layers is not None and pp > 1 and num_layers % pp != 0:
+            continue
+        for micro in (1, 2, 4, 8):
+            cands.append({"dp_degree": dp, "mp_degree": mp,
+                          "pp_degree": pp, "micro_batches": micro,
+                          "sharding_degree": 1})
+    return cands
+
+
+class AutoTuner:
+    def __init__(self, candidates=None, num_devices=None, num_layers=None,
+                 memory_limit_gb=None, model_params=None):
+        self.candidates = candidates if candidates is not None else \
+            default_candidates(num_devices or 8, num_layers)
+        self.memory_limit_gb = memory_limit_gb
+        self.model_params = model_params
+        self._history = []
+
+    def prune(self):
+        """Static pruning by a param-memory heuristic (reference
+        prune.py rules)."""
+        if self.memory_limit_gb is None or self.model_params is None:
+            return self.candidates
+        kept = []
+        for c in self.candidates:
+            shards = c["mp_degree"] * c["pp_degree"] * \
+                c.get("sharding_degree", 1)
+            # bf16 params + fp32 master/moments ≈ 14 bytes/param
+            mem_gb = self.model_params * 14 / shards / 1e9
+            if mem_gb <= self.memory_limit_gb:
+                kept.append(c)
+        self.candidates = kept
+        return kept
+
+    def tune(self, trial_fn, max_trials=None):
+        """Run trials; returns the best config. ``trial_fn(config)`` must
+        return a cost (lower is better) or raise / return None on
+        failure."""
+        best, best_cost = None, float("inf")
+        for i, cfg in enumerate(self.candidates):
+            if max_trials is not None and i >= max_trials:
+                break
+            try:
+                cost = trial_fn(cfg)
+            except Exception as e:  # OOM / invalid: record and continue
+                self._history.append({"config": cfg, "error": str(e)})
+                continue
+            if cost is None:
+                continue
+            self._history.append({"config": cfg, "cost": float(cost)})
+            if cost < best_cost:
+                best, best_cost = cfg, cost
+        return best
+
+    def history(self):
+        return list(self._history)
+
+    def save_history(self, path):
+        with open(path, "w") as f:
+            json.dump(self._history, f, indent=2)

@@ -1,0 +1,100 @@
+"""Elastic training manager.
+
+Parity: reference `python/paddle/distributed/fleet/elastic/manager.py` —
+ElasticManager (:124): node registration + lease heartbeat (:253), host
+watching, fault-tolerance vs scale-in/out (:456,:483,:506), relaunch via
+LauncherInterface. TPU-first: the native TCPStore replaces etcd for
+registration/heartbeat (the launch CLI supplies process restart; on Cloud
+TPU the platform handles node replacement, so the manager's job is
+membership tracking + restart signaling).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .store import TCPStore
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, host="127.0.0.1", port=0, np=1, node_id=0,
+                 is_master=False, heartbeat_interval=2.0,
+                 lease_ttl=10.0):
+        self.np = np
+        self.node_id = node_id
+        self.heartbeat_interval = heartbeat_interval
+        self.lease_ttl = lease_ttl
+        self.store = TCPStore(host, port, is_master=is_master,
+                              world_size=np)
+        self.port = self.store.port
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self.enabled = True
+
+    # -- registration + heartbeat (reference manager.py:253) --------------
+    def register(self):
+        self.store.set(f"node/{self.node_id}", str(time.time()))
+        self.store.add("nodes", 1)
+        self._hb_thread = threading.Thread(target=self._heartbeat,
+                                           daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat(self):
+        while not self._stop.is_set():
+            self.store.set(f"node/{self.node_id}", str(time.time()))
+            self._stop.wait(self.heartbeat_interval)
+
+    def alive_nodes(self, expect=None):
+        """Nodes whose lease is fresh."""
+        n = expect or self.np
+        now = time.time()
+        alive = []
+        for i in range(n):
+            try:
+                ts = float(self.store.get(f"node/{i}"))
+            except KeyError:
+                continue
+            if now - ts < self.lease_ttl:
+                alive.append(i)
+        return alive
+
+    # -- failure classification (reference :456,:483,:506) ----------------
+    def watch(self, expect=None):
+        """Classify the current membership: HOLD (all present), RESTART
+        (fault tolerance: same np possible after relaunch), EXIT (cannot
+        recover)."""
+        n = expect or self.np
+        alive = self.alive_nodes(n)
+        if len(alive) == n:
+            return ElasticStatus.HOLD
+        if len(alive) >= 1:
+            return ElasticStatus.RESTART
+        return ElasticStatus.EXIT
+
+    def signal_restart(self):
+        self.store.add("restart_epoch", 1)
+
+    def restart_epoch(self):
+        try:
+            return int(self.store.get("restart_epoch"))
+        except KeyError:
+            return 0
+
+    def exit(self, completed=True):
+        self._stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2)
+        self.store.set(f"node/{self.node_id}/status",
+                       ElasticStatus.COMPLETED if completed else
+                       ElasticStatus.ERROR)

@@ -1,0 +1,80 @@
+"""ASP: 2:4 structured sparsity.
+
+Parity: reference `python/paddle/incubate/asp/` — calculate_density,
+prune_model (2:4 masks on Linear weights), `decorate(optimizer)` keeping
+masks applied after each update (ASPHelper). TPU note: XLA has no sparse
+tensor-core path, so this provides the *workflow* (mask computation and
+maintenance); the compressed speedup story on TPU is int8/int4 quant.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+
+__all__ = ["calculate_density", "prune_model", "decorate",
+           "reset_excluded_layers", "set_excluded_layers"]
+
+_masks: dict[int, jnp.ndarray] = {}
+_excluded: set = set()
+
+
+def calculate_density(x):
+    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def _mask_2_4(w):
+    """Keep the 2 largest-|.| of every 4 along the last axis."""
+    shape = w.shape
+    flat = w.reshape(-1, 4) if shape[-1] % 4 == 0 else None
+    if flat is None:
+        return jnp.ones_like(w)
+    idx = jnp.argsort(jnp.abs(flat), axis=1)
+    mask = jnp.ones_like(flat)
+    rows = jnp.arange(flat.shape[0])
+    mask = mask.at[rows, idx[:, 0]].set(0.0)
+    mask = mask.at[rows, idx[:, 1]].set(0.0)
+    return mask.reshape(shape)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to weights of Linear layers (reference
+    prune_model)."""
+    for lname, layer in model.named_sublayers(include_self=True):
+        if not isinstance(layer, nn.Linear):
+            continue
+        p = layer.weight
+        if (p.name or lname + ".weight") in _excluded:
+            continue
+        mask = _mask_2_4(p._data)
+        p._rebind(p._data * mask)
+        _masks[id(p)] = mask
+    return _masks
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply masks after each update (the
+    reference's OptimizerWithSparsityGuarantee)."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        for p in optimizer._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._rebind(p._data * mask)
+
+    optimizer.step = step
+    return optimizer

@@ -1,0 +1,46 @@
+"""`paddle.onnx` export surface (reference: python/paddle/onnx/export.py
+delegates to the external paddle2onnx package).
+
+TPU-native path: a jitted model already lowers to StableHLO, which is the
+supported interchange format (`export_stablehlo`); ONNX conversion from
+StableHLO is an external-tool concern exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+__all__ = ["export", "export_stablehlo"]
+
+
+def export_stablehlo(layer, input_spec, path=None):
+    """Lower the layer to StableHLO text (the XLA-world ONNX)."""
+    import jax
+    import numpy as np
+
+    from ..core.autograd import no_grad
+    from ..core.tensor import Tensor
+
+    examples = []
+    for spec in input_spec:
+        shape = [1 if s is None else s for s in spec.shape]
+        examples.append(np.zeros(shape, np.dtype(str(np.dtype(
+            spec.dtype.name if hasattr(spec.dtype, "name")
+            else spec.dtype)))))
+
+    def fn(*arrays):
+        with no_grad():
+            out = layer(*[Tensor(a) for a in arrays])
+        return out._data if isinstance(out, Tensor) else out
+
+    lowered = jax.jit(fn).lower(*examples)
+    text = lowered.as_text()
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export requires an external converter in the reference too "
+        "(paddle2onnx); paddle_tpu exports StableHLO instead: "
+        "paddle_tpu.onnx.export_stablehlo(layer, input_spec, path)")
