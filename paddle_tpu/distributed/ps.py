@@ -1,0 +1,134 @@
+"""Parameter-server training (workflow parity).
+
+Parity target: reference `paddle/fluid/distributed/ps/` + python
+`distributed/ps/` + `fleet/runtime/the_one_ps.py` — brpc dense/sparse
+tables with async push/pull for CPU-cluster recommendation workloads.
+
+TPU scope note: PS-style async training targets CPU parameter clusters;
+on a TPU pod the same models train synchronously with mesh-sharded
+embeddings. This module keeps the WORKFLOW (server hosting dense/sparse
+tables, workers pulling params and pushing grads, async SGD apply) over
+the native TCPStore transport so reference PS call sites have a
+functional home.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from .store import TCPStore
+
+__all__ = ["PSServer", "PSWorker", "DenseTable", "SparseTable"]
+
+
+class DenseTable:
+    def __init__(self, name, shape, lr=0.01):
+        self.name = name
+        self.value = np.zeros(shape, np.float32)
+        self.lr = lr
+
+    def pull(self):
+        return self.value
+
+    def push_grad(self, grad):
+        self.value = self.value - self.lr * grad
+
+
+class SparseTable:
+    """Row-sparse embedding table (reference ps/table/ sparse tables):
+    rows materialize on first access (the reference's lazy init)."""
+
+    def __init__(self, name, dim, lr=0.01, initializer=None):
+        self.name = name
+        self.dim = dim
+        self.lr = lr
+        self.rows: dict[int, np.ndarray] = {}
+        self.initializer = initializer or (
+            lambda: np.random.uniform(-0.01, 0.01, dim).astype(np.float32))
+
+    def pull(self, ids):
+        return np.stack([
+            self.rows.setdefault(int(i), self.initializer()) for i in ids])
+
+    def push_grad(self, ids, grads):
+        for i, g in zip(ids, grads):
+            i = int(i)
+            row = self.rows.setdefault(i, self.initializer())
+            self.rows[i] = row - self.lr * g
+
+
+class PSServer:
+    """Hosts tables; serves pull/push via the TCPStore KV (each request is
+    a serialized message under a sequenced key — the brpc service
+    analogue, minus brpc)."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self.store = TCPStore(host, port, is_master=True)
+        self.port = self.store.port
+        self.tables: dict[str, object] = {}
+
+    def add_dense_table(self, name, shape, lr=0.01):
+        self.tables[name] = DenseTable(name, shape, lr)
+
+    def add_sparse_table(self, name, dim, lr=0.01):
+        self.tables[name] = SparseTable(name, dim, lr)
+
+    def handle_once(self, req_key):
+        """Process one serialized request (in-process server loop body)."""
+        req = pickle.loads(self.store.get(req_key))
+        table = self.tables[req["table"]]
+        kind = req["op"]
+        if kind == "pull_dense":
+            resp = table.pull()
+        elif kind == "push_dense":
+            table.push_grad(req["grad"])
+            resp = b"ok"
+        elif kind == "pull_sparse":
+            resp = table.pull(req["ids"])
+        elif kind == "push_sparse":
+            table.push_grad(req["ids"], req["grads"])
+            resp = b"ok"
+        else:
+            raise ValueError(kind)
+        self.store.set(req_key + "/resp", pickle.dumps(resp))
+
+
+class PSWorker:
+    def __init__(self, server: PSServer = None, host=None, port=None):
+        # in-process mode (tests / single host): direct server reference
+        self.server = server
+        self._seq = 0
+        if server is None:
+            self.store = TCPStore(host, port, is_master=False)
+        else:
+            self.store = server.store
+
+    def _rpc(self, msg):
+        self._seq += 1
+        key = f"psreq/{id(self)}/{self._seq}"
+        self.store.set(key, pickle.dumps(msg))
+        if self.server is not None:
+            self.server.handle_once(key)
+        self.store.wait([key + "/resp"], timeout=30)
+        resp = pickle.loads(self.store.get(key + "/resp"))
+        self.store.delete_key(key)
+        self.store.delete_key(key + "/resp")
+        return resp
+
+    def pull_dense(self, table):
+        return self._rpc({"op": "pull_dense", "table": table})
+
+    def push_dense_grad(self, table, grad):
+        return self._rpc({"op": "push_dense", "table": table,
+                          "grad": np.asarray(grad, np.float32)})
+
+    def pull_sparse(self, table, ids):
+        return self._rpc({"op": "pull_sparse", "table": table,
+                          "ids": list(map(int, ids))})
+
+    def push_sparse_grad(self, table, ids, grads):
+        return self._rpc({"op": "push_sparse", "table": table,
+                          "ids": list(map(int, ids)),
+                          "grads": np.asarray(grads, np.float32)})

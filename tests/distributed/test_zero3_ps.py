@@ -1,0 +1,67 @@
+"""ZeRO-3 (param sharding) + PS workflow tests."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import distributed as dist
+from paddle_tpu import nn, optimizer
+from paddle_tpu.models import GPT, GPTConfig
+
+
+def test_zero3_param_sharded_training():
+    """Stage-3: params themselves sharded over dp; training still matches
+    the single-device loss curve (GSPMD inserts the allgathers the
+    reference does imperatively in GroupShardedStage3)."""
+    ids_np = np.random.default_rng(0).integers(0, 255, (8, 32)).astype(
+        "int64")
+    ids = paddle.to_tensor(ids_np)
+
+    paddle.seed(31)
+    single = GPT(GPTConfig.tiny())
+    opt_s = optimizer.AdamW(learning_rate=1e-3,
+                            parameters=single.parameters())
+    step_s = paddle.jit.TrainStep(single, opt_s,
+                                  lambda m, i: m.loss(i, i))
+    ref = [float(step_s(ids)) for _ in range(3)]
+
+    mesh = dist.init_mesh([8], ["dp"])
+    paddle.seed(31)
+    model = GPT(GPTConfig.tiny())
+    # shard every param's largest divisible dim over dp (stage-3)
+    for _, p in model.named_parameters():
+        placements = [dist.Replicate()]
+        for d in sorted(range(p.ndim), key=lambda i: -p.shape[i]):
+            if p.shape[d] % 8 == 0:
+                placements = [dist.Shard(d)]
+                break
+        dist.shard_tensor(p, mesh, placements)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+    step = dist.ShardedTrainStep(model, opt,
+                                 lambda m, i: m.loss(i, i), mesh=mesh,
+                                 data_placements=[dist.Shard(0)])
+    got = [float(step(ids)) for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    # params remain sharded after steps
+    w = dict(model.named_parameters())["h.0.attn.qkv_proj.weight"]
+    assert "dp" in str(w._data.sharding.spec)
+
+
+def test_ps_dense_and_sparse():
+    from paddle_tpu.distributed.ps import PSServer, PSWorker
+    server = PSServer()
+    server.add_dense_table("w", (4, 3), lr=0.5)
+    server.add_sparse_table("emb", dim=5, lr=1.0)
+    worker = PSWorker(server)
+
+    w0 = worker.pull_dense("w")
+    assert w0.shape == (4, 3) and (w0 == 0).all()
+    worker.push_dense_grad("w", np.ones((4, 3), "float32"))
+    w1 = worker.pull_dense("w")
+    np.testing.assert_allclose(w1, -0.5 * np.ones((4, 3)))
+
+    rows = worker.pull_sparse("emb", [3, 7])
+    assert rows.shape == (2, 5)
+    worker.push_sparse_grad("emb", [3], np.ones((1, 5), "float32"))
+    rows2 = worker.pull_sparse("emb", [3])
+    np.testing.assert_allclose(rows2[0], rows[0] - 1.0, atol=1e-6)
