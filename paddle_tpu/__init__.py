@@ -60,6 +60,8 @@ from . import utils  # noqa: F401
 from . import vision  # noqa: F401
 from . import text  # noqa: F401
 from . import geometric  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from .utils.flops import flops  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from .amp import debugging as _amp_debugging  # noqa: F401

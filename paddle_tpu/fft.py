@@ -1,0 +1,77 @@
+"""`paddle.fft` (reference: python/paddle/fft.py over phi fft kernels /
+cuFFT; here jnp.fft → XLA FFT)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2",
+           "ifft2", "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _norm(norm):
+    return None if norm == "backward" else norm
+
+
+def _wrap1(jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(lambda a: jfn(a, n=n, axis=axis, norm=_norm(norm)), x,
+                     name=jfn.__name__)
+    return op
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+
+
+def _wrap2(jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply(lambda a: jfn(a, s=s, axes=axes, norm=_norm(norm)), x,
+                     name=jfn.__name__)
+    return op
+
+
+fft2 = _wrap2(jnp.fft.fft2)
+ifft2 = _wrap2(jnp.fft.ifft2)
+rfft2 = _wrap2(jnp.fft.rfft2)
+irfft2 = _wrap2(jnp.fft.irfft2)
+
+
+def _wrapn(jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply(lambda a: jfn(a, s=s, axes=axes, norm=_norm(norm)), x,
+                     name=jfn.__name__)
+    return op
+
+
+fftn = _wrapn(jnp.fft.fftn)
+ifftn = _wrapn(jnp.fft.ifftn)
+rfftn = _wrapn(jnp.fft.rfftn)
+irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x,
+                 name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x,
+                 name="ifftshift")

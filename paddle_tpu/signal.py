@@ -1,0 +1,104 @@
+"""`paddle.signal` (reference: python/paddle/signal.py — stft/istft)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply, unwrap
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def fn(a):
+        n = (a.shape[axis] - frame_length) // hop_length + 1
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        moved = jnp.moveaxis(a, axis, -1)
+        frames = moved[..., idx]  # [..., n, frame_length]
+        frames = jnp.swapaxes(frames, -1, -2)  # [..., frame_length, n]
+        return frames if axis in (-1, a.ndim - 1) else \
+            jnp.moveaxis(frames, (-2, -1), (axis, axis + 1))
+    return apply(fn, x, name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def fn(a):
+        # a: [..., frame_length, n_frames]
+        fl, n = a.shape[-2], a.shape[-1]
+        out_len = (n - 1) * hop_length + fl
+        out = jnp.zeros(a.shape[:-2] + (out_len,), a.dtype)
+        for i in range(n):  # static unroll (n known at trace time)
+            out = out.at[..., i * hop_length:i * hop_length + fl].add(
+                a[..., i])
+        return out
+    return apply(fn, x, name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = unwrap(window) if window is not None else jnp.ones(win_length)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+
+    def fn(a):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            a = jnp.pad(a, ((0, 0), (n_fft // 2, n_fft // 2)),
+                        mode=pad_mode)
+        n_frames = 1 + (a.shape[-1] - n_fft) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length +
+               jnp.arange(n_fft)[None, :])
+        frames = a[:, idx] * win
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
+            jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.float32(n_fft))
+        out = jnp.swapaxes(spec, 1, 2)  # [b, freq, frames]
+        return out[0] if squeeze else out
+
+    return apply(fn, x, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = unwrap(window) if window is not None else jnp.ones(win_length)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+
+    def fn(s):
+        squeeze = s.ndim == 2
+        if squeeze:
+            s = s[None]
+        spec = jnp.swapaxes(s, 1, 2)  # [b, frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.float32(n_fft))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else \
+            jnp.fft.ifft(spec, axis=-1).real
+        frames = frames * win
+        n = frames.shape[1]
+        out_len = (n - 1) * hop_length + n_fft
+        out = jnp.zeros((frames.shape[0], out_len), frames.dtype)
+        wsum = jnp.zeros((out_len,), frames.dtype)
+        for i in range(n):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[:, sl].add(frames[:, i])
+            wsum = wsum.at[sl].add(win * win)
+        out = out / jnp.maximum(wsum, 1e-10)
+        if center:
+            out = out[:, n_fft // 2:-(n_fft // 2) or None]
+        if length is not None:
+            out = out[:, :length]
+        return out[0] if squeeze else out
+
+    return apply(fn, x, name="istft")
