@@ -1,4 +1,7 @@
 """`paddle.incubate` (reference: python/paddle/incubate/)."""
 
 from . import nn  # noqa: F401
+from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from ..core.autograd import no_grad  # noqa: F401

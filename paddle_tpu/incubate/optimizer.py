@@ -1,0 +1,133 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/ —
+LookAhead, ModelAverage, GradientMergeOptimizer; DistributedFusedLamb is
+plain Lamb under GSPMD sharding)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..optimizer import Lamb
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage", "GradientMerge",
+           "DistributedFusedLamb"]
+
+
+class LookAhead(Optimizer):
+    """k steps of the inner optimizer, then interpolate toward the slow
+    weights (reference lookahead.py)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        self._slow = {}
+        self._groups = inner_optimizer._groups
+        self._grad_clip = None
+        self._lr_scheduler = inner_optimizer._lr_scheduler
+        self._lr = inner_optimizer._lr
+        self._state = inner_optimizer._state
+        self._global_step = 0
+        self._multi_precision = inner_optimizer._multi_precision
+
+    def step(self):
+        self.inner.step()
+        self._step_count += 1
+        if self._step_count % self.k != 0:
+            return
+        for p in self.inner._parameter_list:
+            key = id(p)
+            if key not in self._slow:
+                self._slow[key] = p._data.astype(jnp.float32)
+            slow = self._slow[key] + self.alpha * (
+                p._data.astype(jnp.float32) - self._slow[key])
+            self._slow[key] = slow
+            p._rebind(slow.astype(p._data.dtype))
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner.get_lr()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        return self.inner.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.inner.set_state_dict(sd)
+
+
+class ModelAverage(Optimizer):
+    """EMA-style parameter averaging window (reference
+    modelaverage.py); apply()/restore() swap averaged params in and out."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(0.0, parameters)
+        self._sums = {id(p): jnp.zeros(p._data.shape, jnp.float32)
+                      for p in self._parameter_list}
+        self._counts = 0
+        self._backup = {}
+
+    def step(self):
+        self._counts += 1
+        for p in self._parameter_list:
+            self._sums[id(p)] = self._sums[id(p)] + \
+                p._data.astype(jnp.float32)
+
+    def apply(self, executor=None, need_restore=True):
+        for p in self._parameter_list:
+            self._backup[id(p)] = p._data
+            avg = self._sums[id(p)] / max(self._counts, 1)
+            p._rebind(avg.astype(p._data.dtype))
+
+    def restore(self, executor=None):
+        for p in self._parameter_list:
+            if id(p) in self._backup:
+                p._rebind(self._backup.pop(id(p)))
+
+
+class GradientMerge:
+    """Accumulate grads over k micro-steps before the inner step
+    (reference gradient_merge.py / meta optimizer)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner = inner_optimizer
+        self.k = k_steps
+        self.avg = avg
+        self._count = 0
+
+    def step(self):
+        self._count += 1
+        if self._count % self.k != 0:
+            return  # keep accumulating (.grad already sums)
+        if self.avg and self.k > 1:
+            for p in self.inner._parameter_list:
+                if p.grad is not None:
+                    p.grad._rebind(p.grad._data / self.k)
+        self.inner.step()
+        self.inner.clear_grad()
+
+    def clear_grad(self, set_to_zero=False):
+        if self._count % self.k == 0:
+            self.inner.clear_grad(set_to_zero)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class DistributedFusedLamb(Lamb):
+    """reference distributed_fused_lamb.py: under GSPMD-sharded params and
+    grads the plain Lamb update IS distributed+fused — XLA partitions the
+    trust-ratio norms with the same collectives the CUDA kernel issues."""
+
+    pass
