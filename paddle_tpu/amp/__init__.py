@@ -146,6 +146,11 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # per-optimizer state machine READY -> UNSCALED -> STEPPED, reset by
+        # update() (reference grad_scaler.py:358 OptimizerState): step()
+        # must not re-unscale after an explicit unscale_(), and calling
+        # unscale_() twice between updates is an error.
+        self._opt_states = {}
 
     def is_enable(self):
         return self._enable
@@ -164,39 +169,62 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        import numpy as np
+        state = self._opt_states.get(id(optimizer))
+        if state is not None and state[0] == "unscaled":
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update().")
+        if state is not None and state[0] == "stepped":
+            raise RuntimeError("unscale_() is being called after step().")
         inv = 1.0 / self._scale
-        found = False
+        # Single found_inf scalar accumulated on-device across all grads,
+        # synced to host ONCE (the reference fuses this into
+        # check_finite_and_unscale; per-param bool() syncs serialize the
+        # device pipeline).
+        found = jnp.asarray(False)
         for p in optimizer._parameter_list:
             if p.grad is None:
                 continue
             g = p.grad._data.astype(jnp.float32) * inv
-            if not bool(jnp.isfinite(g).all()):
-                found = True
+            found = found | ~jnp.isfinite(g).all()
             p.grad._rebind(g.astype(p.grad.dtype))
-        self._found_inf = found
+        found = bool(found)
+        # _found_inf ORs across all optimizers since the last update() (for
+        # the scale adjustment); step() consults the per-optimizer verdict.
+        self._found_inf = self._found_inf or found
+        self._opt_states[id(optimizer)] = ("unscaled", found)
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
-        if not self._found_inf:
+        state = self._opt_states.get(id(optimizer))
+        if state is not None and state[0] == "stepped":
+            raise RuntimeError(
+                "step() has already been called since the last update().")
+        if state is None:
+            self.unscale_(optimizer)
+        found = self._opt_states[id(optimizer)][1]
+        if not found:
             optimizer.step()
-        self._update()
+        self._opt_states[id(optimizer)] = ("stepped", found)
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
         optimizer.clear_grad()
 
     def update(self):
         self._update()
 
     def _update(self):
+        self._opt_states.clear()
+        found = self._found_inf
+        self._found_inf = False
         if not self._dynamic:
             return
-        if self._found_inf:
+        if found:
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
@@ -208,7 +236,6 @@ class GradScaler:
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
-        self._found_inf = False
 
     def state_dict(self):
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,

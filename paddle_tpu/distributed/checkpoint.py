@@ -7,12 +7,15 @@ across mismatched meshes/strategies at load).
 
 TPU-first: the single-controller runtime holds global (sharded) arrays, so
 "shards" are the addressable shards of each jax.Array. Each HOST writes
-only its addressable shards (multi-host safe) plus one metadata.json
-mapping tensor -> (global shape/dtype, shard index ranges, file). Loading
+only its addressable shards plus its own ``metadata_{host}.json`` (the
+reference's per-rank `.distcp` + global metadata, without needing a
+cross-host barrier); the loader unions all per-host metadata files. Shard
+keys are host-qualified and each shard entry records its source file, so
+same-named shards from different hosts can never collide. Loading
 reassembles the global array and `device_put`s it to the TARGET sharding —
 cross-strategy resharding for free (the reference needs explicit reshard
-functions). Async save runs on a background thread (orbax-style), double
-parity with the reference's async_save.
+functions). Async save runs on a background thread (orbax-style), parity
+with the reference's async_save.
 """
 
 from __future__ import annotations
@@ -28,7 +31,7 @@ from ..core.tensor import Tensor
 
 __all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict"]
 
-_METADATA = "metadata.json"
+_LEGACY_METADATA = "metadata.json"
 
 
 def _flatten(sd, prefix=""):
@@ -44,12 +47,17 @@ def _flatten(sd, prefix=""):
 
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
-    """Write sharded checkpoint to directory ``path``."""
+    """Write sharded checkpoint to directory ``path``.
+
+    Multi-host safe: every host writes ``shards_{host}.npz`` with its
+    addressable shards and ``metadata_{host}.json`` describing them; no
+    host needs to see another host's shards.
+    """
     os.makedirs(path, exist_ok=True)
     flat = _flatten(state_dict)
     host = jax.process_index()
-    meta = {"tensors": {}, "num_hosts": jax.process_count()}
-    shard_file = os.path.join(path, f"shards_{host}.npz")
+    shard_fn = f"shards_{host}.npz"
+    meta = {"tensors": {}, "host": host, "num_hosts": jax.process_count()}
     arrays = {}
     for name, t in flat.items():
         if isinstance(t, Tensor):
@@ -71,26 +79,25 @@ def save_state_dict(state_dict, path, process_group=None,
                      dim if s.stop is None else s.stop)
                     for s, dim in zip(sh.index, arr.shape)) if sh.index \
                     else ()
-                if idx in seen_indices:  # dedup replicated shards
+                if idx in seen_indices:  # dedup locally-replicated shards
                     continue
                 seen_indices.add(idx)
-                key = f"{name}::{i}"
+                key = f"{name}::{host}::{i}"
                 arrays[key] = np.asarray(sh.data)
                 entry["shards"].append({"key": key, "index": list(idx),
-                                        "host": host})
+                                        "host": host, "file": shard_fn})
         else:
-            key = f"{name}::0"
+            key = f"{name}::{host}::0"
             arrays[key] = np.asarray(arr)
             entry["shards"].append(
-                {"key": key,
+                {"key": key, "file": shard_fn,
                  "index": [[0, d] for d in np.shape(arr)], "host": host})
         meta["tensors"][name] = entry
 
     def _write():
-        np.savez(shard_file, **{k: v for k, v in arrays.items()})
-        if host == coordinator_rank:
-            with open(os.path.join(path, _METADATA), "w") as f:
-                json.dump(meta, f)
+        np.savez(os.path.join(path, shard_fn), **arrays)
+        with open(os.path.join(path, f"metadata_{host}.json"), "w") as f:
+            json.dump(meta, f)
 
     if async_save:
         th = threading.Thread(target=_write, daemon=True)
@@ -103,28 +110,56 @@ def async_save_state_dict(state_dict, path, **kw):
     return save_state_dict(state_dict, path, async_save=True, **kw)
 
 
+def _read_metadata(path):
+    """Union all per-host metadata files (legacy single-file fallback)."""
+    metas = []
+    for fn in sorted(os.listdir(path)):
+        if fn.startswith("metadata_") and fn.endswith(".json"):
+            with open(os.path.join(path, fn)) as f:
+                metas.append(json.load(f))
+    if not metas and os.path.exists(os.path.join(path, _LEGACY_METADATA)):
+        with open(os.path.join(path, _LEGACY_METADATA)) as f:
+            metas.append(json.load(f))
+    merged = {}
+    for m in metas:
+        default_file = f"shards_{m.get('host', 0)}.npz"
+        for name, entry in m["tensors"].items():
+            if "scalar" in entry:
+                merged.setdefault(name, entry)
+                continue
+            tgt = merged.setdefault(
+                name, {"shape": entry["shape"], "dtype": entry["dtype"],
+                       "shards": []})
+            seen = {tuple(map(tuple, s["index"])) for s in tgt["shards"]}
+            for sh in entry["shards"]:
+                idx = tuple(map(tuple, sh["index"]))
+                if idx in seen:  # same range replicated on another host
+                    continue
+                seen.add(idx)
+                sh = dict(sh)
+                sh.setdefault("file", default_file)
+                tgt["shards"].append(sh)
+    return merged
+
+
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None):
     """Fill ``state_dict``'s tensors in place from ``path``, resharding to
     each target tensor's current sharding (any source strategy)."""
-    with open(os.path.join(path, _METADATA)) as f:
-        meta = json.load(f)
+    tensors = _read_metadata(path)
     files = {}
-    for fn in os.listdir(path):
-        if fn.startswith("shards_") and fn.endswith(".npz"):
-            files[fn] = np.load(os.path.join(path, fn))
 
-    def lookup(key):
-        for z in files.values():
-            if key in z:
-                return z[key]
-        raise KeyError(key)
+    def lookup(shard):
+        fn = shard["file"]
+        if fn not in files:
+            files[fn] = np.load(os.path.join(path, fn))
+        return files[fn][shard["key"]]
 
     flat = _flatten(state_dict)
     for name, target in flat.items():
-        if name not in meta["tensors"]:
+        if name not in tensors:
             continue
-        entry = meta["tensors"][name]
+        entry = tensors[name]
         if "scalar" in entry:
             continue
         import ml_dtypes
@@ -132,10 +167,18 @@ def load_state_dict(state_dict, path, process_group=None,
         np_dtype = getattr(ml_dtypes, dtype) if "bfloat16" in dtype or \
             "float8" in dtype else np.dtype(dtype)
         full = np.zeros(entry["shape"], np_dtype)
+        filled = 0
         for sh in entry["shards"]:
-            data = lookup(sh["key"])
+            data = lookup(sh)
             sl = tuple(slice(lo, hi) for lo, hi in sh["index"]) or ...
             full[sl] = data
+            filled += int(np.prod(np.shape(data))) or 1
+        expected = int(np.prod(entry["shape"])) or 1
+        if filled < expected:
+            raise ValueError(
+                f"checkpoint shard(s) missing for '{name}': covered "
+                f"{filled}/{expected} elements — a host's shard/metadata "
+                "file is absent from the checkpoint directory")
         if isinstance(target, Tensor):
             arr = full
             if getattr(target._data, "sharding", None) is not None and \

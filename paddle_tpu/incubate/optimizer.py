@@ -22,7 +22,11 @@ class LookAhead(Optimizer):
         self.alpha = alpha
         self.k = k
         self._step_count = 0
-        self._slow = {}
+        # snapshot slow weights when training starts (reference
+        # lookahead.py), so the first k-step sync pulls fast weights back
+        # toward the initial point instead of being a no-op
+        self._slow = {id(p): p._data.astype(jnp.float32)
+                      for p in inner_optimizer._parameter_list}
         self._groups = inner_optimizer._groups
         self._grad_clip = None
         self._lr_scheduler = inner_optimizer._lr_scheduler
@@ -38,7 +42,7 @@ class LookAhead(Optimizer):
             return
         for p in self.inner._parameter_list:
             key = id(p)
-            if key not in self._slow:
+            if key not in self._slow:  # param added after construction
                 self._slow[key] = p._data.astype(jnp.float32)
             slow = self._slow[key] + self.alpha * (
                 p._data.astype(jnp.float32) - self._slow[key])

@@ -24,10 +24,15 @@ def _tuplize(v, n):
     return (int(v),) * n
 
 
-def _padding_pairs(padding, n, kernel, dilation):
+def _padding_pairs(padding, n, kernel, dilation, in_sizes=None, stride=None):
     """Normalize paddle's padding forms to lax pairs.
 
     Accepts int, per-dim ints, explicit lo/hi pairs, or "SAME"/"VALID".
+    "SAME" follows the reference algorithm (nn/functional/conv.py
+    `_update_padding_nd`): per spatial dim,
+    ``pad_total = max((ceil(in/stride) - 1)*stride + k - in, 0)`` with
+    dilation reset to 1, split lo = pad_total//2 / hi = rest — which for
+    stride > 1 depends on the input size, not just the kernel.
     """
     if isinstance(padding, str):
         p = padding.upper()
@@ -35,9 +40,14 @@ def _padding_pairs(padding, n, kernel, dilation):
             return [(0, 0)] * n
         if p == "SAME":
             pairs = []
-            for k, d in zip(kernel, dilation):
-                eff = d * (k - 1)
-                pairs.append((eff // 2, eff - eff // 2))
+            if in_sizes is not None and stride is not None:
+                for k, s, i in zip(kernel, stride, in_sizes):
+                    total = max((-(-i // s) - 1) * s + k - i, 0)
+                    pairs.append((total // 2, total - total // 2))
+            else:  # no input size (transpose path): stride-1 formula
+                for k, d in zip(kernel, dilation):
+                    eff = d * (k - 1)
+                    pairs.append((eff // 2, eff - eff // 2))
             return pairs
         raise ValueError(f"unknown padding {padding!r}")
     if isinstance(padding, int):
@@ -57,6 +67,8 @@ def _conv_nd(n, x, weight, bias, stride, padding, dilation, groups,
              data_format, name):
     stride = _tuplize(stride, n)
     dilation = _tuplize(dilation, n)
+    if isinstance(padding, str) and padding.upper() == "SAME":
+        dilation = (1,) * n  # reference resets dilation under SAME
     channel_last = data_format in ("NLC", "NHWC", "NDHWC")
     spatial = {1: "W", 2: "HW", 3: "DHW"}[n]
     lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
@@ -65,7 +77,9 @@ def _conv_nd(n, x, weight, bias, stride, padding, dilation, groups,
 
     def fwd(a, w, *rest):
         kshape = w.shape[2:]
-        pads = _padding_pairs(padding, n, kshape, dilation)
+        in_sizes = a.shape[1:1 + n] if channel_last else a.shape[2:2 + n]
+        pads = _padding_pairs(padding, n, kshape, dilation,
+                              in_sizes=in_sizes, stride=stride)
         out = lax.conv_general_dilated(
             a, w, window_strides=stride, padding=pads,
             rhs_dilation=dilation, feature_group_count=groups,

@@ -1,0 +1,66 @@
+"""SAME padding parity (ADVICE r1): must follow the reference formula
+pad_total = max((ceil(in/stride)-1)*stride + k - in, 0) computed from the
+input size — for stride>1 this differs from the static dilation*(k-1)
+split. Oracle: torch.nn.functional.conv2d with explicitly computed pads
+(= lax padding="SAME")."""
+
+import math
+
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _same_pairs(in_sizes, ks, s):
+    out = []
+    for i, k in zip(in_sizes, ks):
+        total = max((math.ceil(i / s) - 1) * s + k - i, 0)
+        out.append((total // 2, total - total // 2))
+    return out
+
+
+def _torch_same_conv(x, w, stride):
+    pads = _same_pairs(x.shape[2:], w.shape[2:], stride)
+    xt = torch.nn.functional.pad(
+        torch.tensor(x),
+        (pads[1][0], pads[1][1], pads[0][0], pads[0][1]))
+    return torch.nn.functional.conv2d(
+        xt, torch.tensor(w), stride=stride).numpy()
+
+
+def test_conv2d_same_stride_gt1_matches_torch():
+    rng = np.random.default_rng(0)
+    for (h, w, k, s) in [(13, 13, 3, 2), (14, 9, 5, 3), (7, 10, 4, 2),
+                         (8, 8, 3, 1)]:
+        x = rng.standard_normal((2, 3, h, w)).astype("float32")
+        wt = rng.standard_normal((4, 3, k, k)).astype("float32")
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(wt),
+                       stride=s, padding="SAME")
+        ref = _torch_same_conv(x, wt, s)
+        np.testing.assert_allclose(np.asarray(out._data), ref,
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_same_resets_dilation():
+    """Reference resets dilation to 1 under SAME."""
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 2, 9, 9)).astype("float32")
+    wt = rng.standard_normal((3, 2, 3, 3)).astype("float32")
+    out_d2 = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(wt),
+                      stride=2, padding="SAME", dilation=2)
+    out_d1 = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(wt),
+                      stride=2, padding="SAME", dilation=1)
+    np.testing.assert_allclose(np.asarray(out_d2._data),
+                               np.asarray(out_d1._data))
+
+
+def test_conv1d_same_output_length():
+    rng = np.random.default_rng(2)
+    for (l, k, s) in [(13, 4, 3), (10, 3, 2)]:
+        x = rng.standard_normal((2, 3, l)).astype("float32")
+        wt = rng.standard_normal((5, 3, k)).astype("float32")
+        out = F.conv1d(paddle.to_tensor(x), paddle.to_tensor(wt),
+                       stride=s, padding="SAME")
+        assert out.shape[2] == math.ceil(l / s)

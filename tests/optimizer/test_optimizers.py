@@ -167,3 +167,40 @@ def test_optimizer_state_roundtrip():
     opt2 = optimizer.Adam(learning_rate=0.01, parameters=lin2.parameters())
     opt2.set_state_dict(sd)
     assert opt2._global_step == 3
+
+
+def test_lookahead_first_sync_pulls_toward_init():
+    """ADVICE r1: slow weights snapshot at construction, so the first
+    k-step sync interpolates fast weights back toward the INITIAL point
+    (not a no-op)."""
+    import numpy as np
+
+    from paddle_tpu import incubate, nn, optimizer
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    model = nn.Linear(4, 4)
+    w0 = model.weight.numpy().copy()
+    inner = optimizer.SGD(learning_rate=0.5,
+                          parameters=model.parameters())
+    la = incubate.optimizer.LookAhead(inner, alpha=0.5, k=2)
+    for _ in range(2):
+        x = paddle.randn([2, 4])
+        model(x).sum().backward()
+        la.step()
+        la.clear_grad()
+    w_fast_would_be = model.weight.numpy()  # after sync: slow interpolation
+    # after k=2 steps the weights must NOT equal the pure-SGD fast weights:
+    # they were pulled halfway back toward w0
+    paddle.seed(0)
+    model2 = nn.Linear(4, 4)
+    inner2 = optimizer.SGD(learning_rate=0.5,
+                           parameters=model2.parameters())
+    for _ in range(2):
+        x = paddle.randn([2, 4])
+        model2(x).sum().backward()
+        inner2.step()
+        inner2.clear_grad()
+    fast = model2.weight.numpy()
+    np.testing.assert_allclose(w_fast_would_be, w0 + 0.5 * (fast - w0),
+                               rtol=1e-5, atol=1e-6)
