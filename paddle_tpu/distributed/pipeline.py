@@ -26,19 +26,23 @@ amortize, and wrap blocks in remat for the 1F1B memory profile.
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .. import nn
+from ..core.autograd import Node, is_grad_enabled
 from ..core.dispatch import apply
 from ..core.tensor import Parameter, Tensor
 from .api import shard_tensor
 from .mesh import ProcessMesh
+from .pipeline_schedule import build_schedule
 from .placement import Replicate, Shard
 
-__all__ = ["PipelineDecoderLM"]
+__all__ = ["PipelineDecoderLM", "LayerDesc", "SharedLayerDesc"]
 
 
 def _functional_call(layer, params, *xs):
@@ -63,6 +67,60 @@ def _functional_call(layer, params, *xs):
             p.stop_gradient = sg
 
 
+class LayerDesc:
+    """Build-on-demand layer descriptor (reference `LayerDesc`,
+    fleet/meta_parallel/parallel_layers/pp_layers.py:56): lets a pipeline
+    be declared without materializing every stage's parameters first."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Descriptor for a layer whose ``shared_weight_attr`` Parameter is
+    TIED across pipeline positions with the same ``key`` (reference
+    `SharedLayerDesc` pp_layers.py:76 — tied input/output embeddings).
+
+    TPU-first: instead of the reference's cross-stage allreduce of the
+    shared weight's gradient, both positions hold the SAME Parameter
+    object (replicated over pp under GSPMD); the engine's grad psum over
+    pp plus the tape's duplicate-parent accumulation realize the tied
+    gradient sum exactly.
+    """
+
+    def __init__(self, key, layer_cls, *args, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.key = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+    def build(self, shared_registry=None):
+        import types
+
+        layer = super().build()
+        if shared_registry is not None:
+            if self.key in shared_registry:
+                # tie: rebind this layer's shared weight to the first
+                # occurrence's Parameter object
+                owner = shared_registry[self.key]
+                shared = getattr(owner, self.shared_weight_attr)
+                setattr(layer, self.shared_weight_attr, shared)
+            else:
+                shared_registry[self.key] = layer
+        if self.forward_func is not None:
+            # reference pp_layers.py:76: forward_func replaces the layer's
+            # forward at THIS pipeline position (e.g. the tied embedding
+            # running as a logits head)
+            layer.forward = types.MethodType(self.forward_func, layer)
+        return layer
+
+
 class PipelineDecoderLM(nn.Layer):
     """Decoder-LM pipeline wrapper.
 
@@ -78,7 +136,8 @@ class PipelineDecoderLM(nn.Layer):
     """
 
     def __init__(self, embed, blocks, head, loss_fn, mesh: ProcessMesh,
-                 pp_axis="pp", num_microbatches=None):
+                 pp_axis="pp", num_microbatches=None, schedule="gpipe",
+                 num_virtual_stages=1):
         super().__init__()
         self.embed = embed
         self.head = head
@@ -89,9 +148,46 @@ class PipelineDecoderLM(nn.Layer):
         self._n_micro = num_microbatches or self._pp
         self._template = blocks[0]
         self._n_layers = len(blocks)
-        assert self._n_layers % self._pp == 0, \
-            "layer count must divide pp degree"
+        self._schedule = schedule
+        self._vpp = num_virtual_stages
+        if schedule == "gpipe":
+            assert num_virtual_stages == 1, \
+                "gpipe schedule has no virtual stages"
+            assert self._n_layers % self._pp == 0, \
+                "layer count must divide pp degree"
+        else:
+            assert schedule in ("fthenb", "1f1b", "interleave"), schedule
+            self._sched = build_schedule(self._pp, self._vpp,
+                                         self._n_micro, schedule)
 
+        # pad to a multiple of P*V virtual-stage rows (identity-masked,
+        # parity with the reference's uneven SegmentLayers), then permute
+        # rows so each device's contiguous Shard(0) slice is the concat of
+        # its V chunks (virtual stage g = c*P + d lives on device d).
+        N = self._pp * self._vpp
+        L = self._n_layers
+        Lpad = -(-L // N) * N
+        Lc = Lpad // N
+        perm = []
+        for d in range(self._pp):
+            for c in range(self._vpp):
+                g = c * self._pp + d
+                perm.extend(range(g * Lc, (g + 1) * Lc))
+        self._perm = perm          # stacked row r holds original layer perm[r]
+        self._rows_per_chunk = Lc
+        self._n_layers_padded = Lpad
+        self._layer_mask = np.array(
+            [perm[r] < L for r in range(Lpad)], bool)
+
+        # inverse permutation: padded-position j -> engine row index
+        self._inv_perm = [0] * Lpad
+        for r, j in enumerate(perm):
+            self._inv_perm[j] = r
+
+        # Stacked params are STORED in original layer order [L, ...] so
+        # state_dicts are schedule-independent (a checkpoint saved under
+        # interleave loads into gpipe and vice versa); the engine pads +
+        # permutes at entry and inverse-permutes grads on return.
         names = [n for n, _ in blocks[0].named_parameters()]
         self._block_param_names = names
         self._stacked = nn.ParameterList()
@@ -101,9 +197,37 @@ class PipelineDecoderLM(nn.Layer):
             stacked = Parameter(jnp.stack(arrs, 0))
             stacked.name = "blocks." + name
             placements = [Replicate()] * mesh.ndim
-            placements[pp_idx] = Shard(0)
+            if L % self._pp == 0:
+                placements[pp_idx] = Shard(0)
+            # (uneven L: stored replicated — NamedSharding needs
+            # divisibility; the engine pads to Lpad and shards internally)
             shard_tensor(stacked, mesh, placements)
             self._stacked.append(stacked)
+
+    @classmethod
+    def from_descs(cls, descs, loss_fn, mesh, pp_axis="pp",
+                   num_microbatches=None, schedule="gpipe",
+                   num_virtual_stages=1):
+        """Build a pipeline from LayerDesc/SharedLayerDesc descriptors
+        (reference PipelineLayer(layers=[...]) form): descs[0] is the
+        embedding stage, descs[-1] the head stage, the rest identical
+        blocks. SharedLayerDescs with the same key share their weight
+        Parameter (tied embeddings)."""
+        registry = {}
+
+        def build(d):
+            if isinstance(d, SharedLayerDesc):
+                return d.build(registry)
+            if isinstance(d, LayerDesc):
+                return d.build()
+            return d  # already a Layer
+
+        embed = build(descs[0])
+        blocks = nn.LayerList([build(d) for d in descs[1:-1]])
+        head = build(descs[-1])
+        return cls(embed, blocks, head, loss_fn, mesh, pp_axis=pp_axis,
+                   num_microbatches=num_microbatches, schedule=schedule,
+                   num_virtual_stages=num_virtual_stages)
 
     def stacked_parameters(self):
         return list(self._stacked)
@@ -124,6 +248,8 @@ class PipelineDecoderLM(nn.Layer):
             "use .loss(ids, labels)")
 
     def loss(self, input_ids, labels):
+        if self._schedule != "gpipe":
+            return self._table_loss(input_ids, labels)
         mesh = self._mesh
         pp_axis = self._pp_axis
         pp = self._pp
@@ -196,3 +322,346 @@ class PipelineDecoderLM(nn.Layer):
         flat = ([p for _, p in embed_items] + [p for _, p in head_items] +
                 list(self._stacked))
         return apply(pure, input_ids, labels, *flat, name="pipeline_loss")
+
+    # ------------------------------------------------------------------
+    # table-driven schedules (fthenb / 1f1b / interleave)
+    # ------------------------------------------------------------------
+
+    def _table_loss(self, input_ids, labels):
+        """1F1B-family loss: the whole schedule — forwards, per-microbatch
+        remat backwards, grad accumulation — runs inside ONE compiled
+        shard_map scan following the precomputed tables (reference
+        pipeline_parallel.py:545/:1136 semantics). The backward having
+        already run, loss.backward() just scales the precomputed grads
+        (a hand-built tape Node), so TrainStep/ShardedTrainStep work
+        unchanged. Memory: stash depth from the scheduler (~P for 1F1B,
+        not M)."""
+        ids = input_ids._data if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        lab = labels._data if isinstance(labels, Tensor) \
+            else jnp.asarray(labels)
+
+        embed_items = list(self.embed.named_parameters())
+        head_items = list(self.head.named_parameters())
+        e_tensors = [p for _, p in embed_items]
+        h_tensors = [p for _, p in head_items]
+        b_tensors = list(self._stacked)
+        e_arrs = [p._data for p in e_tensors]
+        h_arrs = [p._data for p in h_tensors]
+        b_arrs = [p._data for p in b_tensors]
+
+        recording = is_grad_enabled() and any(
+            not p.stop_gradient
+            for p in e_tensors + h_tensors + b_tensors)
+        loss_arr, grads_out = self._run_schedule(
+            ids, lab, e_arrs, h_arrs, b_arrs, with_backward=recording)
+
+        out = Tensor(loss_arr)
+        if recording:
+            ge, gh, gb = grads_out
+            parents = e_tensors + h_tensors + b_tensors
+            grads = list(ge) + list(gh) + list(gb)
+            diff = [(p, g) for p, g in zip(parents, grads)
+                    if not p.stop_gradient]
+            d_parents = [p for p, _ in diff]
+            d_grads = [g for _, g in diff]
+
+            def vjp_fn(cts):
+                return tuple(g * cts[0] for g in d_grads)
+
+            node = Node(vjp_fn, d_parents,
+                        [(loss_arr.shape, loss_arr.dtype)],
+                        name=f"pipeline_{self._schedule}")
+            out.stop_gradient = False
+            out._node = node
+            out._out_idx = 0
+        return out
+
+    def _run_schedule(self, ids, lab, e_arrs, h_arrs, b_arrs,
+                      with_backward=True):
+        """Pure jax: returns (loss, (embed grads, head grads, block
+        grads)) by following the schedule tables (grads None when
+        ``with_backward`` is off — the backward half is not even traced,
+        so eval/no_grad pays forward cost only)."""
+        mesh = self._mesh
+        pp_axis = self._pp_axis
+        Pdeg, V, M = self._pp, self._vpp, self._n_micro
+        sched = self._sched
+        K, K2 = sched.stash_depth, sched.cot_depth
+        Lc = self._rows_per_chunk
+        L, Lpad = self._n_layers, self._n_layers_padded
+
+        # engine layout: pad [L]->[Lpad] rows (duplicating layer 0 —
+        # numerically inert under the mask, NaN-safe unlike zeros), then
+        # permute so each device's Shard(0) slice is its V chunks.
+        # Stored params stay in original layer order (see __init__).
+        perm_idx = jnp.asarray(self._perm)
+        b_arrs = [jnp.concatenate(
+            [a] + [a[:1]] * (Lpad - L), 0)[perm_idx] if Lpad > L
+            else a[perm_idx] for a in b_arrs]
+        embed, head, loss_fn = self.embed, self.head, self._loss_fn
+        template = self._template
+        names = self._block_param_names
+        e_names = [n for n, _ in list(embed.named_parameters())]
+        h_names = [n for n, _ in list(head.named_parameters())]
+
+        # data parallelism inside the pipeline region: microbatches are
+        # sharded over the "dp" mesh axis (when present) on their batch
+        # dim; grads/loss psum over it
+        dp_axis = "dp" if ("dp" in mesh.dim_names and
+                           mesh.get_dim_size("dp") > 1) else None
+        B = ids.shape[0]
+        mb = B // M
+        assert B % M == 0, f"batch {B} % microbatches {M} != 0"
+        if dp_axis is not None and mb % mesh.get_dim_size("dp") != 0:
+            dp_axis = None  # microbatch too small to shard: replicate
+        DP = mesh.get_dim_size("dp") if dp_axis else 1
+        red_axes = (pp_axis, dp_axis) if dp_axis else (pp_axis,)
+        ids_micro = ids.reshape(M, mb, *ids.shape[1:])
+        lab_micro = lab.reshape(M, mb, *lab.shape[1:])
+
+        # dense schedule tables as device-indexed constants
+        tabs = dict(
+            fchunk=jnp.asarray(sched.fchunk), fmb=jnp.asarray(sched.fmb),
+            bchunk=jnp.asarray(sched.bchunk), bmb=jnp.asarray(sched.bmb),
+            rcvf=jnp.asarray(sched.rcvf), rcvb=jnp.asarray(sched.rcvb))
+        mask_rows = jnp.asarray(self._layer_mask)  # [Lpad] over all devices
+
+        perm_fwd = [(i, (i + 1) % Pdeg) for i in range(Pdeg)]
+        perm_bwd = [(i, (i - 1) % Pdeg) for i in range(Pdeg)]
+
+        def run_chunk(x, rows, row_mask):
+            """Apply one chunk's blocks (masked rows are identity)."""
+            def scan_block(h, row):
+                row_params, m = row
+                out = _functional_call(template, dict(zip(names,
+                                                          row_params)), h)
+                return jnp.where(m, out, h), None
+            row_leaves = [r for r in rows]
+            h, _ = lax.scan(scan_block, x, (row_leaves, row_mask))
+            return h
+
+        def body(ids_m, lab_m, e_p, h_p, b_local):
+            d = lax.axis_index(pp_axis)
+            # rows of this device: [V * Lc, ...]; chunk c = rows
+            # [c*Lc:(c+1)*Lc]. mask rows for this device:
+            lmask = lax.dynamic_slice_in_dim(mask_rows, d * V * Lc, V * Lc)
+
+            def embed_fn(f):
+                x = _functional_call(embed, dict(zip(e_names, e_p)),
+                                     ids_m[f])
+                return x
+
+            # probe hidden shape statically via eval_shape on microbatch 0
+            probe = jax.eval_shape(embed_fn, 0)
+            hshape, hdtype = probe.shape, probe.dtype
+
+            def chunk_fwd(c, x_in, f, e_p_, h_p_, rows):
+                """Full chunk-c computation: returns (h_out, loss/M)."""
+                if c == 0:
+                    x0 = _functional_call(
+                        embed, dict(zip(e_names, e_p_)), ids_m[f])
+                    x_in = jnp.where(jnp.equal(d, 0), x0, x_in)
+                h = run_chunk(x_in, rows, lmask[c * Lc:(c + 1) * Lc])
+                # NOTE: chunk_fwd is differentiated (jax.vjp in the
+                # backward tick). No pcast may appear in here: the
+                # transpose of an invariant->varying pcast is a psum over
+                # pp, and inside a stage-divergent cond branch that
+                # collective deadlocks the mesh. "Zero" outputs are
+                # derived from the (already pp-varying) hidden state
+                # instead.
+                if c == V - 1:
+                    def head_loss(hh):
+                        logits = _functional_call(
+                            head, dict(zip(h_names, h_p_)), hh)
+                        ls = loss_fn(Tensor(logits), Tensor(lab_m[f]))
+                        ls = ls._data if isinstance(ls, Tensor) else ls
+                        # mean over microbatches AND dp shards of each
+                        # microbatch (full-manual: dp is reduced by the
+                        # final psum)
+                        return (ls / (M * DP)).astype(jnp.float32)
+
+                    def no_loss(hh):
+                        return (hh * 0.0).sum().astype(jnp.float32)
+
+                    lval = lax.cond(jnp.equal(d, Pdeg - 1), head_loss,
+                                    no_loss, h)
+                else:
+                    lval = (h * 0.0).sum().astype(jnp.float32)
+                return h, lval
+
+            zero_e = jax.tree.map(jnp.zeros_like, tuple(e_p))
+            zero_h = jax.tree.map(jnp.zeros_like, tuple(h_p))
+
+            def tick(carry, xs):
+                if with_backward:
+                    stash, cots, fmsg, bmsg, loss_acc, ge, gh, gb = carry
+                else:
+                    stash, fmsg, loss_acc = carry
+                fc, fm, bc, bm, rf, rb = xs
+
+                # --- receive (messages sent at the end of tick t-1) ---
+                f_in = jnp.where(jnp.equal(d, 0),
+                                 jnp.roll(fmsg, 1, axis=0), fmsg)
+                if with_backward:
+                    b_in = jnp.where(jnp.equal(d, Pdeg - 1),
+                                     jnp.roll(bmsg, -1, axis=0), bmsg)
+                for c in range(V):
+                    slot = jnp.mod(rf[c], K)
+                    stash = stash.at[c, slot].set(
+                        jnp.where(rf[c] >= 0, f_in[c], stash[c, slot]))
+                    if with_backward:
+                        slot2 = jnp.mod(rb[c], K2)
+                        cots = cots.at[c, slot2].set(
+                            jnp.where(rb[c] >= 0, b_in[c], cots[c, slot2]))
+
+                # --- forward compute ---
+                new_fmsg = []
+                for c in range(V):
+                    rows = [leaf[c * Lc:(c + 1) * Lc] for leaf in b_local]
+
+                    def f_fire(args, c=c, rows=rows):
+                        stash_, f_ = args
+                        x_in = stash_[c, jnp.mod(f_, K)]
+                        h, lval = chunk_fwd(c, x_in, f_, e_p, h_p, rows)
+                        return h, lval
+
+                    def f_skip(args, c=c):
+                        stash_, _ = args
+                        return (jnp.zeros(hshape, hdtype),
+                                jnp.zeros((), jnp.float32))
+
+                    h_out, lval = lax.cond(jnp.equal(fc, c), f_fire,
+                                           f_skip, (stash, fm))
+                    new_fmsg.append(h_out)
+                    loss_acc = loss_acc + lval
+                fmsg = jnp.stack(new_fmsg, 0)
+
+                # --- backward compute (remat from stash) ---
+                if not with_backward:
+                    fmsg = lax.ppermute(fmsg, pp_axis, perm_fwd)
+                    return (stash, fmsg, loss_acc), None
+                new_bmsg = []
+                for c in range(V):
+                    rows = [leaf[c * Lc:(c + 1) * Lc] for leaf in b_local]
+
+                    def b_fire(args, c=c, rows=rows):
+                        stash_, cots_, b_ = args
+                        x_in = stash_[c, jnp.mod(b_, K)]
+
+                        if c == 0 and c == V - 1:
+                            fn = lambda x, r, e_, h_: chunk_fwd(
+                                c, x, b_, e_, h_, r)
+                            outs, vjp = jax.vjp(fn, x_in, rows,
+                                                tuple(e_p), tuple(h_p))
+                        elif c == 0:
+                            fn = lambda x, r, e_: chunk_fwd(
+                                c, x, b_, e_, h_p, r)
+                            outs, vjp = jax.vjp(fn, x_in, rows,
+                                                tuple(e_p))
+                        elif c == V - 1:
+                            fn = lambda x, r, h_: chunk_fwd(
+                                c, x, b_, e_p, h_, r)
+                            outs, vjp = jax.vjp(fn, x_in, rows,
+                                                tuple(h_p))
+                        else:
+                            fn = lambda x, r: chunk_fwd(c, x, b_, e_p,
+                                                        h_p, r)
+                            outs, vjp = jax.vjp(fn, x_in, rows)
+                        h_out, _ = outs
+                        is_final = jnp.logical_and(
+                            jnp.equal(d, Pdeg - 1), c == V - 1)
+                        cot_h = jnp.where(is_final,
+                                          jnp.zeros(hshape, hdtype),
+                                          cots_[c, jnp.mod(b_, K2)]
+                                          .astype(hdtype))
+                        cot_l = jnp.where(is_final, 1.0, 0.0).astype(
+                            jnp.float32)
+                        cot = vjp((cot_h, cot_l))
+                        d_x = cot[0]
+                        d_rows = cot[1]
+                        d_e = cot[2] if c == 0 else zero_e
+                        d_h = (cot[-1] if c == V - 1 else zero_h)
+                        return d_x, d_rows, d_e, d_h
+
+                    def b_skip(args, c=c, rows=rows):
+                        return (jnp.zeros(hshape, hdtype),
+                                jax.tree.map(jnp.zeros_like, rows),
+                                zero_e, zero_h)
+
+                    d_x, d_rows, d_e, d_h = lax.cond(
+                        jnp.equal(bc, c), b_fire, b_skip,
+                        (stash, cots, bm))
+                    new_bmsg.append(d_x)
+                    gb = [acc.at[c * Lc:(c + 1) * Lc].add(dr)
+                          for acc, dr in zip(gb, d_rows)]
+                    ge = jax.tree.map(jnp.add, ge, d_e)
+                    gh = jax.tree.map(jnp.add, gh, d_h)
+                bmsg = jnp.stack(new_bmsg, 0)
+
+                # --- ring messages (unconditional) ---
+                fmsg = lax.ppermute(fmsg, pp_axis, perm_fwd)
+                bmsg = lax.ppermute(bmsg, pp_axis, perm_bwd)
+                return (stash, cots, fmsg, bmsg, loss_acc, ge, gh, gb), \
+                    None
+
+            stash0 = jnp.zeros((V, K) + hshape, hdtype)
+            cots0 = jnp.zeros((V, K2) + hshape, hdtype)
+            fmsg0 = jnp.zeros((V,) + hshape, hdtype)
+            bmsg0 = jnp.zeros((V,) + hshape, hdtype)
+            ge0 = jax.tree.map(jnp.zeros_like, tuple(e_p))
+            gh0 = jax.tree.map(jnp.zeros_like, tuple(h_p))
+            gb0 = [jnp.zeros_like(leaf) for leaf in b_local]
+
+            d_tabs = [lax.dynamic_index_in_dim(tabs[k], d, 0,
+                                               keepdims=False)
+                      for k in ("fchunk", "fmb", "bchunk", "bmb",
+                                "rcvf", "rcvb")]
+            if with_backward:
+                carry0 = (stash0, cots0, fmsg0, bmsg0,
+                          jnp.zeros((), jnp.float32), ge0, gh0, gb0)
+            else:
+                carry0 = (stash0, fmsg0, jnp.zeros((), jnp.float32))
+            carry, _ = lax.scan(tick, carry0, tuple(d_tabs))
+            if not with_backward:
+                return lax.psum(carry[-1], red_axes)
+            _, _, _, _, loss_acc, ge, gh, gb = carry
+            # uniform (device-unconditional) reductions: stages' partial
+            # loss / embed / head grads sum over pp, data-parallel
+            # partials over dp; block grads are per-stage rows, dp-only
+            loss_total = lax.psum(loss_acc, red_axes)
+            ge = jax.tree.map(lambda g: lax.psum(g, red_axes), ge)
+            gh = jax.tree.map(lambda g: lax.psum(g, red_axes), gh)
+            if dp_axis is not None:
+                gb = [lax.psum(g, dp_axis) for g in gb]
+            return loss_total, ge, gh, gb
+
+        pp_spec = P(pp_axis)
+        rep = P()
+        data_spec = P(None, dp_axis) if dp_axis is not None else rep
+        n_e, n_h = len(e_arrs), len(h_arrs)
+        in_specs = (data_spec, data_spec, tuple([rep] * n_e),
+                    tuple([rep] * n_h), [pp_spec] * len(b_arrs))
+        if with_backward:
+            out_specs = (rep, tuple([rep] * n_e), tuple([rep] * n_h),
+                         [pp_spec] * len(b_arrs))
+        else:
+            out_specs = rep
+        out = jax.shard_map(
+            body, mesh=mesh.jax_mesh,
+            in_specs=in_specs, out_specs=out_specs,
+            # full-manual over the whole mesh (the partial-manual pp-only
+            # form trips XLA SPMD partitioner bugs when embed/head carry
+            # Megatron-TP shardings on auto axes); microbatches are
+            # dp-sharded manually, other axes replicated inside the
+            # pipeline region
+            check_vma=False,
+        )(ids_micro, lab_micro, tuple(e_arrs), tuple(h_arrs), b_arrs)
+        if not with_backward:
+            return out, None
+        loss_total, ge, gh, gb = out
+        # grads back to original layer order, pad rows dropped (their
+        # masked grads are exactly zero)
+        unperm = jnp.asarray(self._inv_perm[:L])
+        gb = [g[unperm] for g in gb]
+        return loss_total, (list(ge), list(gh), list(gb))

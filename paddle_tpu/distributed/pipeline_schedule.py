@@ -1,0 +1,214 @@
+"""Pipeline schedule builder: FThenB / 1F1B / interleaved (VPP) tables.
+
+Parity: the reference's schedule zoo — `PipelineParallel.
+forward_backward_pipeline` 1F1B (fleet/meta_parallel/pipeline_parallel.py:
+545), `PipelineParallelWithInterleave` VPP (:1136), FThenB (:1957) — and
+the static `pipeline_scheduler_pass/` family.
+
+TPU-first: instead of an imperative per-rank schedule loop issuing NCCL
+p2p, we PRECOMPUTE the whole schedule as dense per-(device, tick) tables
+and let one compiled `lax.scan` follow them (see pipeline.py). A greedy
+list scheduler with per-style in-flight caps and backward-priority
+reproduces the reference schedules' dependency structure:
+
+- fthenb:     no cap, all forwards first (GPipe memory: M in flight)
+- 1f1b:       cap P - d in-flight microbatches on device d -> the classic
+              1F1B profile (~P, not M, stashed activations)
+- interleave: V virtual chunks per device on a circular ring (device d
+              owns virtual stages {d, d+P, ...}); cap (V-1)*P + (P-d)
+
+Virtual stage g (0..P*V-1) lives on device g % P, local chunk g // P;
+activations travel the +1 ring (the chunk boundary from device P-1 wraps
+to device 0's next chunk), cotangents the -1 ring.
+
+The builder also derives the exact activation-stash depth the engine must
+carry — the scheduler's in-flight maximum IS the 1F1B memory claim, and
+tests assert it stays ~P as M grows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["Schedule", "build_schedule"]
+
+
+@dataclass
+class Schedule:
+    P: int              # pipeline devices
+    V: int              # virtual chunks per device
+    M: int              # microbatches
+    T: int              # total ticks
+    style: str
+    # per (device, tick): local chunk firing a forward / backward (-1 none)
+    fchunk: np.ndarray  # [P, T] int32
+    fmb: np.ndarray     # [P, T] microbatch id of that forward
+    bchunk: np.ndarray  # [P, T]
+    bmb: np.ndarray     # [P, T]
+    # per (device, tick, local chunk): microbatch id whose forward
+    # activation / backward cotangent ARRIVES this tick (-1 none)
+    rcvf: np.ndarray    # [P, T, V]
+    rcvb: np.ndarray    # [P, T, V]
+    stash_depth: int    # fwd-input stash slots needed per chunk
+    cot_depth: int      # cotangent stash slots needed per chunk
+
+    @property
+    def num_virtual_stages(self):
+        return self.P * self.V
+
+
+def build_schedule(P: int, V: int, M: int, style: str = "1f1b") -> Schedule:
+    """Greedy list-schedule of M microbatches over P*V virtual stages.
+
+    Dependencies (1-tick message latency on the ring):
+      F(g, f) needs F(g-1, f) finished at an earlier tick (g > 0)
+      B(g, b) needs B(g+1, b) finished at an earlier tick (g < N-1)
+      B(N-1, b) needs F(N-1, b) finished at an earlier tick (loss seed)
+    One op (F or B) per device per tick; backward has priority for
+    1f1b/interleave, forward for fthenb.
+    """
+    if style == "gpipe":
+        style = "fthenb"
+    assert style in ("fthenb", "1f1b", "interleave"), style
+    N = P * V
+    if style == "1f1b":
+        assert V == 1, "1f1b is the V=1 schedule; use interleave for V>1"
+        assert M >= P, f"1F1B needs microbatches >= pp degree ({M} < {P})"
+    if style == "interleave":
+        assert V > 1, "interleave needs num_virtual_stages V > 1"
+        assert M % P == 0, \
+            f"interleave needs microbatches % pp == 0 ({M} % {P})"
+
+    if style == "fthenb":
+        cap = [M * V + 1] * P
+        b_priority = False
+    elif style == "1f1b":
+        cap = [P - d for d in range(P)]
+        b_priority = True
+    else:  # interleave (Megatron-style warmup depth)
+        cap = [(V - 1) * P + (P - d) for d in range(P)]
+        b_priority = True
+
+    def f_order(d):
+        """Per-device forward issue order: groups of P microbatches cycle
+        through the chunks (Megatron interleave order; for V=1 this is
+        plain microbatch order)."""
+        seq = []
+        for k in range(V * M):
+            group, r = divmod(k, P)
+            chunk = group % V
+            mb = (group // V) * P + r
+            if V == 1:
+                chunk, mb = 0, k
+            seq.append((chunk, mb))
+        return seq
+
+    def b_order(d):
+        """Backward order: same grouping, chunks cycled deepest-first."""
+        seq = []
+        for k in range(V * M):
+            group, r = divmod(k, P)
+            chunk = V - 1 - (group % V)
+            mb = (group // V) * P + r
+            if V == 1:
+                chunk, mb = 0, k
+            seq.append((chunk, mb))
+        return seq
+
+    forder = [f_order(d) for d in range(P)]
+    border = [b_order(d) for d in range(P)]
+    fptr = [0] * P
+    bptr = [0] * P
+    fdone = {}  # (g, f) -> tick
+    bdone = {}
+    fire_f = []  # (t, g, f)
+    fire_b = []
+    t = 0
+    max_t = 8 * (M * V + N) + 64
+    while sum(bptr) < P * V * M:
+        assert t < max_t, f"pipeline scheduler did not converge ({style})"
+        for d in range(P):
+            b_ready = f_ready = False
+            if bptr[d] < V * M:
+                c, b = border[d][bptr[d]]
+                g = c * P + d
+                if g == N - 1:
+                    b_ready = fdone.get((g, b), max_t) < t
+                else:
+                    b_ready = bdone.get((g + 1, b), max_t) < t
+            if fptr[d] < V * M and fptr[d] - bptr[d] < cap[d]:
+                c, f = forder[d][fptr[d]]
+                g = c * P + d
+                f_ready = g == 0 or fdone.get((g - 1, f), max_t) < t
+            if b_ready and (b_priority or not f_ready):
+                c, b = border[d][bptr[d]]
+                g = c * P + d
+                fire_b.append((t, g, b))
+                bdone[(g, b)] = t
+                bptr[d] += 1
+            elif f_ready:
+                c, f = forder[d][fptr[d]]
+                g = c * P + d
+                fire_f.append((t, g, f))
+                fdone[(g, f)] = t
+                fptr[d] += 1
+        t += 1
+    T = t
+
+    fchunk = np.full((P, T), -1, np.int32)
+    fmb = np.full((P, T), -1, np.int32)
+    bchunk = np.full((P, T), -1, np.int32)
+    bmb = np.full((P, T), -1, np.int32)
+    rcvf = np.full((P, T, V), -1, np.int32)
+    rcvb = np.full((P, T, V), -1, np.int32)
+    for tick, g, f in fire_f:
+        d, c = g % P, g // P
+        fchunk[d, tick] = c
+        fmb[d, tick] = f
+        if g + 1 < N:  # arrival of this activation downstream
+            nd, nc = (g + 1) % P, (g + 1) // P
+            rcvf[nd, tick + 1, nc] = f
+    for tick, g, b in fire_b:
+        d, c = g % P, g // P
+        bchunk[d, tick] = c
+        bmb[d, tick] = b
+        if g - 1 >= 0:
+            pd, pc = (g - 1) % P, (g - 1) // P
+            rcvb[pd, tick + 1, pc] = b
+
+    # exact stash depths: max simultaneously-live entries per chunk.
+    # fwd input of (g, f) lives from its arrival tick through B(g, f)'s
+    # tick (the remat backward re-reads it); chunk 0's stage-0 input is
+    # the ids array itself (no stash).
+    stash_depth = 1
+    for g in range(1, N):
+        events = []
+        for f in range(M):
+            arrive = fdone[(g - 1, f)] + 1
+            release = bdone[(g, f)] + 1
+            events.append((arrive, 1))
+            events.append((release, -1))
+        stash_depth = max(stash_depth, _max_overlap(events))
+    cot_depth = 1
+    for g in range(N - 1):
+        events = []
+        for b in range(M):
+            arrive = bdone[(g + 1, b)] + 1
+            release = bdone[(g, b)] + 1
+            events.append((arrive, 1))
+            events.append((release, -1))
+        cot_depth = max(cot_depth, _max_overlap(events))
+
+    return Schedule(P=P, V=V, M=M, T=T, style=style, fchunk=fchunk,
+                    fmb=fmb, bchunk=bchunk, bmb=bmb, rcvf=rcvf, rcvb=rcvb,
+                    stash_depth=stash_depth, cot_depth=cot_depth)
+
+
+def _max_overlap(events):
+    cur = peak = 0
+    for _, delta in sorted(events, key=lambda e: (e[0], -e[1])):
+        cur += delta
+        peak = max(peak, cur)
+    return peak
