@@ -14,12 +14,17 @@ Architecture (vs the reference's layer map, SURVEY.md §1):
 
 from __future__ import annotations
 
-import warnings as _warnings
+import jax as _jax
 
-# x64 stays disabled (TPU-first: int32/float32 are the wide types); silence
-# jnp's per-call truncation notice for paddle-parity int64 requests.
-_warnings.filterwarnings(
-    "ignore", message=".*truncated to dtype int32.*", category=UserWarning)
+# Paddle's default integer dtype is int64 (`paddle/phi/common/data_type.h`);
+# without x64, jnp silently truncates every int64 request to int32 — a live
+# semantic divergence. Enable x64 so integer semantics match; floats keep the
+# TPU-first float32/bfloat16 defaults because every creation/op path passes an
+# explicit dtype (see ops/creation.py) and Tensor.__init__ coerces stray
+# float64 literals back to get_default_dtype().
+import os as _os
+if _os.environ.get("PADDLE_TPU_X64", "1") != "0":
+    _jax.config.update("jax_enable_x64", True)
 
 from . import core
 from .core import (  # noqa: F401
@@ -62,6 +67,8 @@ from . import text  # noqa: F401
 from . import geometric  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+# the reference re-exports stft/istft at top level from paddle.signal
+from .signal import istft, stft  # noqa: F401
 from .utils.flops import flops  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from .amp import debugging as _amp_debugging  # noqa: F401

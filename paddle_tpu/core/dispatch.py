@@ -123,3 +123,21 @@ def _post_op_hooks(name, outs, check_naninf):
 
 def unwrap(x):
     return x._data if isinstance(x, Tensor) else x
+
+
+def as_index(arr):
+    """Downcast an integer index array to int32 for use inside traced
+    programs.
+
+    The API surface keeps paddle's default int64 (jax_enable_x64), but index
+    operands of gather/scatter-family ops are bounded by array dimensions
+    (< 2^31), and int32 indices are both faster on TPU (s64 is emulated) and
+    required to sidestep an XLA SPMD-partitioner check failure when s64
+    index tensors cross a sharded boundary (spmd_partitioner_util.h:117).
+    """
+    import jax.numpy as jnp
+
+    if hasattr(arr, "dtype") and jnp.issubdtype(arr.dtype, jnp.integer) \
+            and arr.dtype != jnp.int32:
+        return arr.astype(jnp.int32)
+    return arr

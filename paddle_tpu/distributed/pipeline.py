@@ -395,7 +395,7 @@ class PipelineDecoderLM(nn.Layer):
         # numerically inert under the mask, NaN-safe unlike zeros), then
         # permute so each device's Shard(0) slice is its V chunks.
         # Stored params stay in original layer order (see __init__).
-        perm_idx = jnp.asarray(self._perm)
+        perm_idx = jnp.asarray(self._perm, jnp.int32)
         b_arrs = [jnp.concatenate(
             [a] + [a[:1]] * (Lpad - L), 0)[perm_idx] if Lpad > L
             else a[perm_idx] for a in b_arrs]
@@ -662,6 +662,6 @@ class PipelineDecoderLM(nn.Layer):
         loss_total, ge, gh, gb = out
         # grads back to original layer order, pad rows dropped (their
         # masked grads are exactly zero)
-        unperm = jnp.asarray(self._inv_perm[:L])
+        unperm = jnp.asarray(self._inv_perm[:L], jnp.int32)
         gb = [g[unperm] for g in gb]
         return loss_total, (list(ge), list(gh), list(gb))

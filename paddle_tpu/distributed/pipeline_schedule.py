@@ -80,6 +80,10 @@ def build_schedule(P: int, V: int, M: int, style: str = "1f1b") -> Schedule:
         assert V > 1, "interleave needs num_virtual_stages V > 1"
         assert M % P == 0, \
             f"interleave needs microbatches % pp == 0 ({M} % {P})"
+    if style == "fthenb" and V > 1:
+        assert M % P == 0, \
+            f"fthenb with virtual stages needs microbatches % pp == 0 " \
+            f"({M} % {P})"
 
     if style == "fthenb":
         cap = [M * V + 1] * P

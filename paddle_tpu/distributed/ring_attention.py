@@ -30,43 +30,51 @@ __all__ = ["ring_attention"]
 
 
 def _ring_body(q, k, v, *, axis, cp, causal, scale):
-    """Runs on [b, s_local, h, d] shards inside shard_map."""
+    """Runs on local shards inside shard_map: q [b, s_local, hq, d],
+    k/v [b, s_local, hk, d] with hq = g*hk (native GQA — the group axis is
+    carried through the einsums instead of expanding KV, so each ring hop
+    moves the grouped KV chunk, g x less ICI traffic than repeat)."""
     idx = lax.axis_index(axis)
-    b, sq, h, d = q.shape
+    b, sq, hq, d = q.shape
+    hk = k.shape[2]
+    g = hq // hk
+    qg = q.reshape(b, sq, hk, g, d)
     NEG = jnp.float32(-1e30)
 
-    pos_q = idx * sq + jnp.arange(sq)  # global query positions
+    pos_q = idx * sq + jnp.arange(sq, dtype=jnp.int32)  # global positions
 
     def partial_attn(carry, step):
         o, m, l, k_chunk, v_chunk = carry
         src = (idx - step) % cp  # which device's kv we hold this step
-        pos_k = src * sq + jnp.arange(sq)
-        logits = jnp.einsum("bsnd,btnd->bnst", q, k_chunk,
+        pos_k = src * sq + jnp.arange(sq, dtype=jnp.int32)
+        # [b, hk, g, sq_q, sq_k]
+        logits = jnp.einsum("bsngd,btnd->bngst", qg, k_chunk,
                             preferred_element_type=jnp.float32) * scale
         if causal:
             mask = pos_k[None, :] <= pos_q[:, None]  # [sq, sk]
-            logits = jnp.where(mask[None, None], logits, NEG)
+            logits = jnp.where(mask[None, None, None], logits, NEG)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         # guard: rows with no valid key yet keep m at -inf-ish
         p = jnp.exp(logits - m_new[..., None])
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
         o_new = o * corr[..., None] + jnp.einsum(
-            "bnst,btnd->bsnd", p.astype(v_chunk.dtype), v_chunk
-        ).astype(jnp.float32).transpose(0, 2, 1, 3)
+            "bngst,btnd->bngsd", p.astype(v_chunk.dtype), v_chunk
+        ).astype(jnp.float32)
         # rotate kv ring: pass our chunk to the next device
         perm = [(i, (i + 1) % cp) for i in range(cp)]
         k_next = lax.ppermute(k_chunk, axis, perm)
         v_next = lax.ppermute(v_chunk, axis, perm)
         return (o_new, m_new, l_new, k_next, v_next), None
 
-    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
-    m0 = jnp.full((b, h, sq), NEG, jnp.float32)
-    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    o0 = jnp.zeros((b, hk, g, sq, d), jnp.float32)
+    m0 = jnp.full((b, hk, g, sq), NEG, jnp.float32)
+    l0 = jnp.zeros((b, hk, g, sq), jnp.float32)
     (o, m, l, _, _), _ = lax.scan(
         partial_attn, (o0, m0, l0, k, v), jnp.arange(cp))
     out = o / jnp.maximum(l[..., None], 1e-20)
-    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [b, sq, h, d]
+    # [b, hk, g, sq, d] -> [b, sq, hq, d]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, hq, d).astype(q.dtype)
 
 
 def ring_attention(query, key, value, mesh=None, axis="sep", causal=True,
@@ -82,19 +90,12 @@ def ring_attention(query, key, value, mesh=None, axis="sep", causal=True,
     sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
     def fn(q, k, v):
-        kh, qh = k.shape[2], q.shape[2]
-        if kh != qh:  # GQA
-            rep = qh // kh
-            k2 = jnp.repeat(k, rep, axis=2)
-            v2 = jnp.repeat(v, rep, axis=2)
-        else:
-            k2, v2 = k, v
         spec = P(None, axis, None, None)
         body = jax.shard_map(
             lambda a, b_, c: _ring_body(a, b_, c, axis=axis, cp=cp,
                                         causal=causal, scale=sm_scale),
             mesh=mesh.jax_mesh, in_specs=(spec, spec, spec),
             out_specs=spec, check_vma=False)
-        return body(q, k2, v2)
+        return body(q, k, v)
 
     return apply(fn, query, key, value, name="ring_attention")

@@ -269,10 +269,12 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         else:
             cur = jnp.sum(
                 jnp.any(cache[0, 0, 0] != 0, axis=-1).astype(jnp.int32))
+        z = jnp.int32(0)
+        cur32 = jnp.asarray(cur, jnp.int32)
         cache_k = jax.lax.dynamic_update_slice(
-            cache[0], knew[:, :, None, :], (0, 0, cur, 0))
+            cache[0], knew[:, :, None, :], (z, z, cur32, z))
         cache_v = jax.lax.dynamic_update_slice(
-            cache[1], vnew[:, :, None, :], (0, 0, cur, 0))
+            cache[1], vnew[:, :, None, :], (z, z, cur32, z))
         scale = 1.0 / jnp.sqrt(jnp.float32(d))
         logits = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
                             cache_k.astype(jnp.float32)) * scale

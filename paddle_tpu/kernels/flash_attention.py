@@ -61,6 +61,30 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen (packed ragged-batch) flash attention, parity with the
+    reference `flash_attn_unpadded` (`flash_attn_kernel.cu:128`
+    flash_attn_varlen_fwd): q/k/v are [total_tokens, heads, dim] with
+    cu_seqlens prefix sums. TPU path: segment-ids Pallas kernel; CPU/mask
+    fallback computes per-segment masked attention."""
+    cu_q = unwrap(cu_seqlens_q)
+    cu_k = unwrap(cu_seqlens_k)
+
+    def _varlen(q, k, v):
+        from .pallas.flash_attention import flash_attn_varlen
+        out = flash_attn_varlen(q, k, v, cu_q, cu_k, causal=causal,
+                                scale=scale)
+        if training and dropout > 0.0:
+            keep = jax.random.bernoulli(next_key(), 1.0 - dropout, out.shape)
+            out = jnp.where(keep, out / (1.0 - dropout), 0.0)
+        return out.astype(q.dtype)
+    return apply(_varlen, query, key, value, name="flash_attn_unpadded"), None
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
@@ -71,20 +95,22 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     key_rng = next_key() if use_dropout else None
 
     def _sdpa(q, k, v):
+        if _use_pallas(q) and mask_arr is None and not use_dropout:
+            # native-GQA Pallas kernel: grouped KV heads are never expanded
+            try:
+                from .pallas.flash_attention import (
+                    flash_attention as pallas_flash)
+            except ImportError:
+                pallas_flash = None
+            if pallas_flash is not None:
+                return pallas_flash(q, k, v, causal=is_causal)
         qh, kh = q.shape[2], k.shape[2]
-        if kh != qh:  # GQA: repeat kv heads
+        if kh != qh:  # GQA on the XLA fallback path: repeat kv heads
             rep = qh // kh
             k2 = jnp.repeat(k, rep, axis=2)
             v2 = jnp.repeat(v, rep, axis=2)
         else:
             k2, v2 = k, v
-        if _use_pallas(q) and mask_arr is None and not use_dropout:
-            try:
-                from .pallas.flash_attention import flash_attention_fwd
-            except ImportError:
-                flash_attention_fwd = None
-            if flash_attention_fwd is not None:
-                return flash_attention_fwd(q, k2, v2, causal=is_causal)
         bias = None
         if mask_arr is not None:
             m = mask_arr

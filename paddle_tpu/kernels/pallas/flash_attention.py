@@ -1,12 +1,34 @@
-"""Flash attention on TPU via Pallas.
+"""Original TPU flash-attention kernels (Pallas): fwd + bwd, native GQA,
+varlen.
 
 Capability parity with the reference's FA2 integration
-(`paddle/phi/kernels/gpu/flash_attn_kernel.cu:128` dynload to the vendored
-flashattn lib). On TPU the equivalent "vendor kernel" is a Pallas kernel
-tiled for the MXU; we use the canonical Pallas flash-attention kernel that
-ships with JAX (fwd + custom-vjp bwd), adapted to paddle's [B, S, H, D]
-layout. Sequence/context-parallel ring attention builds on top of this in
-paddle_tpu/distributed.
+(`paddle/phi/kernels/gpu/flash_attn_kernel.cu:128` — `flash_attn_fwd` and
+`flash_attn_varlen_fwd` dynload into the vendored flashattn library, GQA via
+`num_heads_k != num_heads`). On TPU the "vendor kernel" seam is Pallas; these
+kernels are written for the MXU rather than translated from the CUDA library:
+
+- **Native GQA**: q is laid out [batch, kv_head, group, seq, dim] and the
+  `group` axis is folded into the matmul row dimension, so each KV block is
+  fetched from HBM once per *group* (not once per query head) and KV is never
+  materialized expanded. The group fold also makes the MXU operand taller
+  (group*block_q rows), improving systolic-array utilization at small
+  block_q.
+- **Online softmax** with running (m, l) in VMEM scratch across the KV grid
+  dimension; output and per-row logsumexp L are written on the last KV step.
+  L is the only extra residual the backward needs.
+- **Backward** recomputes P = exp(s - L) blockwise (flash-attention-2 style:
+  no dP materialization in HBM): a dq kernel (grid over q blocks, accumulate
+  over kv blocks) and a fused dk/dv kernel (grid over kv blocks, accumulate
+  over q blocks — the GQA group fold makes the sum over grouped query heads
+  implicit in the matmul reduction).
+- **Varlen / ragged batches** via segment ids + intra-segment positions
+  (the TPU-native encoding of `cu_seqlens`): tokens attend only within equal
+  segment ids; causal masking compares intra-segment positions. The packed
+  `flash_attn_varlen` entry point converts `cu_seqlens` to segments.
+- Causal runs skip fully-masked blocks (predicated on grid position).
+
+Tested against the dense-softmax oracle (tests/kernels/
+test_flash_attention.py) in interpret mode on CPU; compiled on TPU.
 """
 
 from __future__ import annotations
@@ -16,43 +38,538 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-try:
-    from jax.experimental.pallas.ops.tpu.flash_attention import (
-        BlockSizes, flash_attention as _pallas_mha)
-    HAVE_PALLAS_FA = True
-except ImportError:  # pragma: no cover
-    HAVE_PALLAS_FA = False
+__all__ = [
+    "flash_attention", "flash_attn_varlen", "flash_attention_fwd",
+]
+
+# f32-typed constants: under jax_enable_x64 a bare Python float traces as a
+# weak f64 constant, and Mosaic cannot legalize the resulting f64->f32 truncf
+# inside a TPU kernel — every in-kernel literal must be explicitly f32.
+_NEG = np.float32(-1e30)  # large-negative logit for masked entries
+_BIG = np.float32(1e30)   # lse sentinel for fully-masked rows -> P == 0
+_ZERO = np.float32(0.0)
+_I0 = np.int32(0)   # index-map literal (i64 under x64 breaks Mosaic)
+_ONE = np.float32(1.0)
 
 
-def _block_sizes(seq_q, seq_k, head_dim):
-    # swept on v5e (GPT-2 345M, b8 x s1024): q-blocks of 1024 with 512-wide
-    # k tiles beat the 512/512 default by ~8%
-    blk_q, blk_k = 1024, 512
-    return BlockSizes(
-        block_q=min(blk_q, seq_q), block_k_major=min(blk_k, seq_k),
-        block_k=min(blk_k, seq_k), block_b=1,
-        block_q_major_dkv=min(blk_q, seq_q),
-        block_k_major_dkv=min(blk_k, seq_k),
-        block_k_dkv=min(blk_k, seq_k), block_q_dkv=min(blk_q, seq_q),
-        block_k_major_dq=min(blk_k, seq_k), block_k_dq=min(blk_k, seq_k),
-        block_q_dq=min(blk_q, seq_q),
-    )
+def _interpret() -> bool:
+    try:
+        return jax.default_backend() == "cpu"
+    except RuntimeError:  # pragma: no cover
+        return True
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def default_block_sizes(sq: int, sk: int, group: int):
+    """Per-shape block table (swept on v5e; see BASELINE.md kernel notes).
+    Rows of the q operand are group*block_q, so larger GQA groups take a
+    smaller block_q to keep the operand within VMEM."""
+    if group >= 8:
+        bq = 128
+    elif group >= 2:
+        bq = 256
+    else:
+        bq = 512
+    bk = 512
+    return min(bq, _round_up(sq, 128)), min(bk, _round_up(sk, 128))
+
+
+# ---------------------------------------------------------------------------
+# masking helper (shared by fwd and both bwd kernels)
+# ---------------------------------------------------------------------------
+
+def _block_mask(i, j, bq, bk, sk, causal, off, has_seg, qseg, kseg, qpos,
+                kpos):
+    """(bq, bk) bool mask for q block i vs kv block j.
+
+    Without segments, positions are global (block index * block size + iota)
+    and padded kv columns (>= true sk) are invalid; causal masking is
+    bottom-right aligned (`off = sk - sq`), matching FA2/paddle semantics
+    for cross seqlens — a decode query attends the whole prefix. With
+    segments, validity is segment equality and causality uses intra-segment
+    positions (padding carries segment id -1 for kv / -2 for q so it never
+    matches).
+    """
+    if has_seg:
+        valid = qseg[:, None] == kseg[None, :]
+        if causal:
+            valid &= qpos[:, None] >= kpos[None, :]
+        return valid
+    kv_idx = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    valid = kv_idx < sk
+    if causal:
+        q_idx = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        valid &= (q_idx + off) >= kv_idx
+    return valid
+
+
+def _expand_rows(mask_2d, group, rows):
+    """(bq, bk) -> (group*bq, bk): every query head in the group sees the
+    same positions, so the mask is replicated along the folded group axis."""
+    bq, bk = mask_2d.shape
+    return jnp.broadcast_to(mask_2d[None], (group, bq, bk)).reshape(rows, bk)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(*refs, group, bq, bk, nk, sk, off, scale, causal,
+                has_seg):
+    if has_seg:
+        (qseg_ref, kseg_ref, qpos_ref, kpos_ref,
+         q_ref, k_ref, v_ref, o_ref, l_ref, acc, m_scr, l_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, o_ref, l_ref, acc, m_scr, l_scr) = refs
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    rows = group * bq
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    def _body():
+        q = q_ref[0, 0].reshape(rows, q_ref.shape[-1])
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if has_seg:
+            mask2 = _block_mask(i, j, bq, bk, sk, causal, off, True,
+                                qseg_ref[0], kseg_ref[0],
+                                qpos_ref[0], kpos_ref[0])
+        else:
+            mask2 = _block_mask(i, j, bq, bk, sk, causal, off, False,
+                                None, None, None, None)
+        mask = _expand_rows(mask2, group, rows)
+        s = jnp.where(mask, s, _NEG)
+
+        m_prev = m_scr[:, :1]                        # (rows, 1)
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # explicit zero for masked entries: when a whole row is masked so
+        # far, exp(s - m) would be 1, not 0
+        p = jnp.where(mask, jnp.exp(s - m_new), _ZERO)
+        alpha = jnp.exp(m_prev - m_new)              # (rows, 1)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[0, 0],
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    # causal block skip: a block fully above the diagonal does no work
+    if causal and not has_seg:
+        pl.when((i + 1) * bq - 1 + off >= j * bk)(_body)
+    else:
+        _body()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        m = m_scr[:, :1]
+        safe_l = jnp.where(l > _ZERO, l, _ONE)
+        o = (acc[:] / safe_l).astype(o_ref.dtype)
+        o_ref[0, 0] = o.reshape(o_ref.shape[2:])
+        lse = jnp.where(l > _ZERO, m + jnp.log(safe_l), _BIG)
+        l_ref[0, 0] = lse.reshape(group, bq, 1)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _recompute_p(q, k, lse, mask, scale):
+    """P = softmax block recomputed from the saved logsumexp (already
+    normalized: p = exp(s - L))."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = jnp.where(mask, s, _NEG)
+    return jnp.where(mask, jnp.exp(s - lse), _ZERO)
+
+
+def _dq_kernel(*refs, group, bq, bk, nk, sk, off, scale, causal,
+               has_seg):
+    if has_seg:
+        (qseg_ref, kseg_ref, qpos_ref, kpos_ref,
+         q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, l_ref, d_ref, dq_ref, dq_acc) = refs
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    rows = group * bq
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _body():
+        dp_dim = q_ref.shape[-1]
+        q = q_ref[0, 0].reshape(rows, dp_dim)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].reshape(rows, dp_dim)
+        lse = l_ref[0, 0].reshape(rows, 1)
+        delta = d_ref[0, 0].reshape(rows, 1)
+        if has_seg:
+            mask2 = _block_mask(i, j, bq, bk, sk, causal, off, True,
+                                qseg_ref[0], kseg_ref[0],
+                                qpos_ref[0], kpos_ref[0])
+        else:
+            mask2 = _block_mask(i, j, bq, bk, sk, causal, off, False,
+                                None, None, None, None)
+        mask = _expand_rows(mask2, group, rows)
+        p = _recompute_p(q, k, lse, mask, scale)
+        dp = jax.lax.dot_general(do.astype(v.dtype), v,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot(ds.astype(k.dtype), k,
+                                 preferred_element_type=jnp.float32)
+
+    if causal and not has_seg:
+        pl.when((i + 1) * bq - 1 + off >= j * bk)(_body)
+    else:
+        _body()
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype).reshape(
+            dq_ref.shape[2:])
+
+
+def _dkv_kernel(*refs, group, bq, bk, nq, sk, off, scale, causal,
+                has_seg):
+    # grid is (batch, kv_head, kv_block, q_block): accumulate over q blocks
+    if has_seg:
+        (qseg_ref, kseg_ref, qpos_ref, kpos_ref, q_ref, k_ref, v_ref,
+         do_ref, l_ref, d_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, l_ref, d_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    j = pl.program_id(2)   # kv block
+    i = pl.program_id(3)   # q block
+    rows = group * bq
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _body():
+        dp_dim = q_ref.shape[-1]
+        q = q_ref[0, 0].reshape(rows, dp_dim)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0].reshape(rows, dp_dim)
+        lse = l_ref[0, 0].reshape(rows, 1)
+        delta = d_ref[0, 0].reshape(rows, 1)
+        if has_seg:
+            mask2 = _block_mask(i, j, bq, bk, sk, causal, off, True,
+                                qseg_ref[0], kseg_ref[0],
+                                qpos_ref[0], kpos_ref[0])
+        else:
+            mask2 = _block_mask(i, j, bq, bk, sk, causal, off, False,
+                                None, None, None, None)
+        mask = _expand_rows(mask2, group, rows)
+        p = _recompute_p(q, k, lse, mask, scale)
+        # dv += P^T dO  — the matmul reduction over `rows` sums over the
+        # GQA group, which is exactly the grouped-head gradient sum
+        pt = p.astype(do.dtype)
+        dv_acc[:] += jax.lax.dot_general(
+            pt, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do.astype(v.dtype), v,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal and not has_seg:
+        pl.when((i + 1) * bq - 1 + off >= j * bk)(_body)
+    else:
+        _body()
+
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+
+def _seg_specs(bq, bk):
+    """BlockSpecs for (q_seg, kv_seg, q_pos, kv_pos): [B, S] int32."""
+    return [
+        pl.BlockSpec((1, bq), lambda b, h, i, j: (b, i)),
+        pl.BlockSpec((1, bk), lambda b, h, i, j: (b, j)),
+        pl.BlockSpec((1, bq), lambda b, h, i, j: (b, i)),
+        pl.BlockSpec((1, bk), lambda b, h, i, j: (b, j)),
+    ]
+
+
+def _seg_specs_kvmajor(bq, bk):
+    # grid (b, h, kv_block j, q_block i)
+    return [
+        pl.BlockSpec((1, bq), lambda b, h, j, i: (b, i)),
+        pl.BlockSpec((1, bk), lambda b, h, j, i: (b, j)),
+        pl.BlockSpec((1, bq), lambda b, h, j, i: (b, i)),
+        pl.BlockSpec((1, bk), lambda b, h, j, i: (b, j)),
+    ]
+
+
+def _sem(n):
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel",) * 3 + ("arbitrary",) * (n - 3))
+
+
+@functools.lru_cache(maxsize=64)
+def _make_flash(causal, scale, bq, bk, has_seg, sk_true, off):
+    """Build the custom-vjp flash attention for static (causal, scale,
+    blocks, segments?) so jax caches one callable per configuration.
+
+    Operates on the GQA-native internal layout:
+      q5 [B, Hk, G, Sqp, Dp], k4/v4 [B, Hk, Skp, Dp] (padded), optional
+      seg/pos arrays [B, Sqp]/[B, Skp] (int32).
+    Returns (out5, lse [B, Hk, G, Sqp] f32).
+    """
+
+    def fwd_call(q5, k4, v4, qseg, kseg, qpos, kpos):
+        B, Hk, G, Sq, Dp = q5.shape
+        Sk = k4.shape[2]
+        nq, nk = Sq // bq, Sk // bk
+        rows = G * bq
+        kernel = functools.partial(
+            _fwd_kernel, group=G, bq=bq, bk=bk, nk=nk, sk=sk_true,
+            off=off, scale=np.float32(scale), causal=causal,
+            has_seg=has_seg)
+        in_specs = []
+        args = []
+        if has_seg:
+            in_specs += _seg_specs(bq, bk)
+            args += [qseg, kseg, qpos, kpos]
+        in_specs += [
+            pl.BlockSpec((1, 1, G, bq, Dp), lambda b, h, i, j: (b, h, _I0, i, _I0)),
+            pl.BlockSpec((1, 1, bk, Dp), lambda b, h, i, j: (b, h, j, _I0)),
+            pl.BlockSpec((1, 1, bk, Dp), lambda b, h, i, j: (b, h, j, _I0)),
+        ]
+        args += [q5, k4, v4]
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(B, Hk, nq, nk),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, 1, G, bq, Dp),
+                             lambda b, h, i, j: (b, h, _I0, i, _I0)),
+                pl.BlockSpec((1, 1, G, bq, 1),
+                             lambda b, h, i, j: (b, h, _I0, i, _I0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(q5.shape, q5.dtype),
+                jax.ShapeDtypeStruct((B, Hk, G, Sq, 1), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((rows, Dp), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+            ],
+            compiler_params=_sem(4),
+            interpret=_interpret(),
+        )(*args)
+        return out, lse
+
+    @jax.custom_vjp
+    def flash(q5, k4, v4, qseg, kseg, qpos, kpos):
+        return fwd_call(q5, k4, v4, qseg, kseg, qpos, kpos)
+
+    def flash_fwd(q5, k4, v4, qseg, kseg, qpos, kpos):
+        out, lse = fwd_call(q5, k4, v4, qseg, kseg, qpos, kpos)
+        return (out, lse), (q5, k4, v4, qseg, kseg, qpos, kpos, out, lse)
+
+    def flash_bwd(res, cts):
+        q5, k4, v4, qseg, kseg, qpos, kpos, out, lse = res
+        do5, _ = cts  # no cotangent flows into lse
+        do5 = do5.astype(q5.dtype)
+        B, Hk, G, Sq, Dp = q5.shape
+        Sk = k4.shape[2]
+        nq, nk = Sq // bq, Sk // bk
+        rows = G * bq
+        # delta = rowsum(dO * O), f32, same layout as lse
+        delta = jnp.sum(do5.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+
+        common = dict(group=G, bq=bq, bk=bk, sk=sk_true, off=off,
+                      scale=np.float32(scale), causal=causal,
+                      has_seg=has_seg)
+        seg_args = [qseg, kseg, qpos, kpos] if has_seg else []
+
+        q_spec = pl.BlockSpec((1, 1, G, bq, Dp),
+                              lambda b, h, i, j: (b, h, _I0, i, _I0))
+        kv_spec = pl.BlockSpec((1, 1, bk, Dp), lambda b, h, i, j: (b, h, j, _I0))
+        lse_spec = pl.BlockSpec((1, 1, G, bq, 1),
+                                lambda b, h, i, j: (b, h, _I0, i, _I0))
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, nk=nk, **common),
+            grid=(B, Hk, nq, nk),
+            in_specs=(_seg_specs(bq, bk) if has_seg else [])
+            + [q_spec, kv_spec, kv_spec, q_spec, lse_spec, lse_spec],
+            out_specs=q_spec,
+            out_shape=jax.ShapeDtypeStruct(q5.shape, q5.dtype),
+            scratch_shapes=[pltpu.VMEM((rows, Dp), jnp.float32)],
+            compiler_params=_sem(4),
+            interpret=_interpret(),
+        )(*seg_args, q5, k4, v4, do5, lse, delta)
+
+        # kv-major grid for dk/dv
+        q_spec2 = pl.BlockSpec((1, 1, G, bq, Dp),
+                               lambda b, h, j, i: (b, h, _I0, i, _I0))
+        kv_spec2 = pl.BlockSpec((1, 1, bk, Dp),
+                                lambda b, h, j, i: (b, h, j, _I0))
+        lse_spec2 = pl.BlockSpec((1, 1, G, bq, 1),
+                                 lambda b, h, j, i: (b, h, _I0, i, _I0))
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, nq=nq, **common),
+            grid=(B, Hk, nk, nq),
+            in_specs=(_seg_specs_kvmajor(bq, bk) if has_seg else [])
+            + [q_spec2, kv_spec2, kv_spec2, q_spec2, lse_spec2, lse_spec2],
+            out_specs=[kv_spec2, kv_spec2],
+            out_shape=[
+                jax.ShapeDtypeStruct(k4.shape, k4.dtype),
+                jax.ShapeDtypeStruct(v4.shape, v4.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, Dp), jnp.float32),
+                pltpu.VMEM((bk, Dp), jnp.float32),
+            ],
+            compiler_params=_sem(4),
+            interpret=_interpret(),
+        )(*seg_args, q5, k4, v4, do5, lse, delta)
+        if has_seg:
+            # integer inputs take float0 cotangents
+            zct = lambda x: np.zeros(x.shape, jax.dtypes.float0)
+            zeros = (zct(qseg), zct(kseg), zct(qpos), zct(kpos))
+        else:
+            zeros = (None, None, None, None)
+        return (dq, dk, dv) + zeros
+
+    flash.defvjp(flash_fwd, flash_bwd)
+    return flash
+
+
+# ---------------------------------------------------------------------------
+# public entry points ([B, S, H, D] paddle layout)
+# ---------------------------------------------------------------------------
+
+def flash_attention(q, k, v, causal=False, scale=None,
+                    q_segment_ids=None, kv_segment_ids=None,
+                    q_positions=None, kv_positions=None,
+                    block_q=None, block_k=None, return_lse=False):
+    """Flash attention on [B, Sq, Hq, D] / [B, Sk, Hk, D] arrays with
+    Hq = group * Hk (native GQA — KV heads are NOT expanded). Segment ids
+    (with optional intra-segment positions) give varlen/ragged semantics.
+    Differentiable (custom VJP runs the Pallas dq and dk/dv kernels)."""
+    B, Sq, Hq, D = q.shape
+    Sk, Hk = k.shape[1], k.shape[2]
+    if Hq % Hk != 0:
+        raise ValueError(f"query heads {Hq} not a multiple of kv heads {Hk}")
+    G = Hq // Hk
+    sm_scale = float(scale if scale is not None else 1.0 / math.sqrt(D))
+
+    has_seg = q_segment_ids is not None
+    bq, bk = default_block_sizes(Sq, Sk, G)
+    if block_q:
+        bq = min(block_q, _round_up(Sq, 128))
+    if block_k:
+        bk = min(block_k, _round_up(Sk, 128))
+
+    Sqp, Skp = _round_up(Sq, bq), _round_up(Sk, bk)
+    Dp = _round_up(D, 128)
+
+    # [B, S, H, D] -> [B, Hk, G, S, D] (+ pad seq to block, head dim to 128)
+    q5 = q.reshape(B, Sq, Hk, G, D).transpose(0, 2, 3, 1, 4)
+    q5 = jnp.pad(q5, ((0, 0), (0, 0), (0, 0), (0, Sqp - Sq), (0, Dp - D)))
+    k4 = jnp.pad(k.transpose(0, 2, 1, 3),
+                 ((0, 0), (0, 0), (0, Skp - Sk), (0, Dp - D)))
+    v4 = jnp.pad(v.transpose(0, 2, 1, 3),
+                 ((0, 0), (0, 0), (0, Skp - Sk), (0, Dp - D)))
+
+    if has_seg:
+        if kv_segment_ids is None:
+            kv_segment_ids = q_segment_ids
+        qseg = jnp.pad(q_segment_ids.astype(jnp.int32),
+                       ((0, 0), (0, Sqp - Sq)), constant_values=-2)
+        kseg = jnp.pad(kv_segment_ids.astype(jnp.int32),
+                       ((0, 0), (0, Skp - Sk)), constant_values=-1)
+        if q_positions is None:
+            q_positions = jnp.broadcast_to(jnp.arange(Sq, dtype=jnp.int32),
+                                           (B, Sq))
+        if kv_positions is None:
+            kv_positions = jnp.broadcast_to(jnp.arange(Sk, dtype=jnp.int32),
+                                            (B, Sk))
+        qpos = jnp.pad(q_positions.astype(jnp.int32), ((0, 0), (0, Sqp - Sq)))
+        kpos = jnp.pad(kv_positions.astype(jnp.int32),
+                       ((0, 0), (0, Skp - Sk)))
+    else:
+        qseg = kseg = qpos = kpos = None
+
+    # bottom-right causal alignment (FA2/paddle): off = Sk - Sq
+    flash = _make_flash(bool(causal), sm_scale, bq, bk, has_seg,
+                        Sk, Sk - Sq)
+    out5, lse = flash(q5, k4, v4, qseg, kseg, qpos, kpos)
+
+    out = out5[:, :, :, :Sq, :D].transpose(0, 3, 1, 2, 4).reshape(
+        B, Sq, Hq, D)
+    if return_lse:
+        # [B, Hk, G, Sqp, 1] -> [B, Hq, Sq]
+        lse_out = lse[:, :, :, :Sq, 0].reshape(B, Hq, Sq)
+        return out, lse_out
+    return out
+
+
+def flash_attn_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k, causal=False,
+                      scale=None, block_q=None, block_k=None):
+    """Packed varlen attention (reference `flash_attn_varlen_fwd`,
+    `flash_attn_kernel.cu:128`): q [Tq, Hq, D], k/v [Tk, Hk, D] with
+    `cu_seqlens_*` [n+1] prefix sums. Sequences attend only within
+    themselves; causal uses intra-sequence positions."""
+    tq = q.shape[0]
+    tk = k.shape[0]
+    cu_q = cu_seqlens_q.astype(jnp.int32)
+    cu_k = cu_seqlens_k.astype(jnp.int32)
+    pos_q = jnp.arange(tq, dtype=jnp.int32)
+    pos_k = jnp.arange(tk, dtype=jnp.int32)
+    seg_q = jnp.searchsorted(cu_q, pos_q, side="right").astype(jnp.int32) - 1
+    seg_k = jnp.searchsorted(cu_k, pos_k, side="right").astype(jnp.int32) - 1
+    # bottom-right causal alignment per sequence (FA2 varlen semantics):
+    # shift query positions by len_k - len_q so the last query lines up with
+    # the last key even when the two sides have different lengths
+    len_q = cu_q[seg_q + 1] - cu_q[seg_q]
+    len_k_q = cu_k[jnp.minimum(seg_q + 1, cu_k.shape[0] - 1)] - \
+        cu_k[jnp.minimum(seg_q, cu_k.shape[0] - 1)]
+    rel_q = pos_q - cu_q[seg_q] + (len_k_q - len_q)
+    rel_k = pos_k - cu_k[seg_k]
+    out = flash_attention(
+        q[None], k[None], v[None], causal=causal, scale=scale,
+        q_segment_ids=seg_q[None], kv_segment_ids=seg_k[None],
+        q_positions=rel_q[None], kv_positions=rel_k[None],
+        block_q=block_q, block_k=block_k)
+    return out[0]
 
 
 def flash_attention_fwd(q, k, v, causal=False, scale=None):
-    """q/k/v: [batch, seq, heads, head_dim] arrays (post-GQA-expansion).
-    Returns [batch, seq, heads, head_dim]. Differentiable (the underlying
-    kernel carries a custom VJP with dq/dk/dv Pallas kernels)."""
-    if not HAVE_PALLAS_FA:
-        raise ImportError("pallas flash attention unavailable")
-    d = q.shape[-1]
-    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    # [B,S,H,D] -> [B,H,S,D]
-    qt = jnp.swapaxes(q, 1, 2)
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    out = _pallas_mha(
-        qt, kt, vt, causal=causal, sm_scale=sm_scale,
-        block_sizes=_block_sizes(qt.shape[2], kt.shape[2], d))
-    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+    """Back-compat dense entry point ([B, S, H, D], KV may be grouped)."""
+    return flash_attention(q, k, v, causal=causal, scale=scale)

@@ -135,10 +135,12 @@ class LlamaAttention(nn.Layer):
         k_cache, v_cache = cache
 
         def attend(qa, ka, va, kc, vc, off):
+            z = jnp.int32(0)
+            off32 = jnp.asarray(off, jnp.int32)
             kc = jax.lax.dynamic_update_slice(kc, ka.astype(kc.dtype),
-                                              (0, off, 0, 0))
+                                              (z, off32, z, z))
             vc = jax.lax.dynamic_update_slice(vc, va.astype(vc.dtype),
-                                              (0, off, 0, 0))
+                                              (z, off32, z, z))
             max_s = kc.shape[1]
             rep = qa.shape[2] // kc.shape[2]
             kf = jnp.repeat(kc, rep, axis=2) if rep > 1 else kc
@@ -146,8 +148,8 @@ class LlamaAttention(nn.Layer):
             scale = 1.0 / (qa.shape[-1] ** 0.5)
             logits = jnp.einsum("bsnd,btnd->bnst", qa, kf,
                                 preferred_element_type=jnp.float32) * scale
-            pos_q = off + jnp.arange(qa.shape[1])
-            pos_k = jnp.arange(max_s)
+            pos_q = off + jnp.arange(qa.shape[1], dtype=jnp.int32)
+            pos_k = jnp.arange(max_s, dtype=jnp.int32)
             mask = pos_k[None, :] <= pos_q[:, None]
             logits = jnp.where(mask[None, None], logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1).astype(qa.dtype)

@@ -19,7 +19,8 @@ __all__ = [
     "linear", "embedding", "dropout", "dropout2d", "dropout3d",
     "alpha_dropout", "layer_norm", "rms_norm", "batch_norm", "group_norm",
     "instance_norm", "local_response_norm", "normalize",
-    "scaled_dot_product_attention", "cosine_similarity", "pairwise_distance",
+    "scaled_dot_product_attention", "flash_attention", "flash_attn_unpadded",
+    "cosine_similarity", "pairwise_distance",
     "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "unfold", "fold",
     "interpolate", "upsample", "label_smooth", "bilinear",
 ]
@@ -38,7 +39,8 @@ def linear(x, weight, bias=None, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    idx = unwrap(x)
+    from ...core.dispatch import as_index
+    idx = as_index(unwrap(x))
 
     def _embedding(w):
         out = jnp.take(w, idx, axis=0)
@@ -265,6 +267,29 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return fa.scaled_dot_product_attention(
         query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
         is_causal=is_causal, training=training)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, training=True, name=None):
+    """Reference `nn.functional.flash_attention.flash_attention` parity."""
+    from ...kernels import flash_attention as fa
+    return fa.flash_attention(query, key, value, dropout=dropout,
+                              causal=causal, return_softmax=return_softmax,
+                              training=training)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen flash attention on packed [total_tokens, heads, dim] inputs
+    (reference `flash_attn_unpadded`, `flash_attn_kernel.cu:128`)."""
+    from ...kernels import flash_attention as fa
+    return fa.flash_attn_unpadded(
+        query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+        max_seqlen_k, scale, dropout=dropout, causal=causal,
+        return_softmax=return_softmax, training=training)
 
 
 def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):

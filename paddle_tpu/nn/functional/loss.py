@@ -8,7 +8,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...core.dispatch import apply, unwrap
+from ...core.dispatch import apply, as_index, unwrap
 
 __all__ = [
     "cross_entropy", "softmax_with_cross_entropy", "nll_loss", "mse_loss",
@@ -58,10 +58,16 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             li = jnp.squeeze(li, axis=axis)
         k = logits.shape[axis]
         valid = li != ignore_index
-        safe = jnp.where(valid, li, 0)
-        picked = jnp.take_along_axis(
-            logp, jnp.expand_dims(safe, axis), axis=axis)
-        nll = -jnp.squeeze(picked, axis=axis)
+        safe = as_index(jnp.where(valid, li, 0))
+        # gather-free pick: one-hot mask-reduce instead of take_along_axis.
+        # XLA fuses the compare+select into the log_softmax epilogue, the
+        # backward is scatter-free (a broadcast multiply), and no s64 gather
+        # indices ever reach the SPMD partitioner (whose scatter partitioning
+        # chokes on them: spmd_partitioner_util.h:117).
+        ax = axis % logp.ndim
+        onehot = jax.lax.broadcasted_iota(jnp.int32, logp.shape, ax) \
+            == jnp.expand_dims(safe, axis)
+        nll = -jnp.sum(jnp.where(onehot, logp, 0.0), axis=axis)
         if label_smoothing > 0.0:
             smooth_term = -jnp.mean(logp, axis=axis)
             nll = (1 - label_smoothing) * nll + label_smoothing * smooth_term
@@ -105,10 +111,11 @@ def _nll(input, label, weight, ignore_index, reduction):
 
     def _loss(logp):
         valid = lbl != ignore_index
-        safe = jnp.where(valid, lbl, 0)
-        picked = jnp.take_along_axis(
-            logp, jnp.expand_dims(safe, 1), axis=1)
-        nll = -jnp.squeeze(picked, axis=1)
+        safe = as_index(jnp.where(valid, lbl, 0))
+        # gather-free pick (see cross_entropy): partitioner-safe + fusible
+        onehot = jax.lax.broadcasted_iota(jnp.int32, logp.shape, 1) \
+            == jnp.expand_dims(safe, 1)
+        nll = -jnp.sum(jnp.where(onehot, logp, 0.0), axis=1)
         if w_arr is not None:
             sw = jnp.where(valid, w_arr[safe], 0.0)
             nll = nll * sw

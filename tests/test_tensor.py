@@ -12,11 +12,23 @@ class TestTensorBasics:
         assert t.stop_gradient
 
     def test_create_dtypes(self):
-        assert paddle.to_tensor(1).dtype.name in ("int64", "int32")
+        # paddle's default integer dtype is int64 — real int64, not a
+        # truncated int32 (jax_enable_x64 is on; see paddle_tpu/__init__.py)
+        assert paddle.to_tensor(1).dtype == paddle.int64
+        assert paddle.to_tensor([1, 2]).dtype == paddle.int64
         assert paddle.to_tensor(1.0).dtype == paddle.float32
         assert paddle.to_tensor([True]).dtype.name == "bool"
         t = paddle.to_tensor([1, 2], dtype="bfloat16")
         assert t.dtype == paddle.bfloat16
+
+    def test_int64_values_not_truncated(self):
+        big = 2**40 + 7
+        t = paddle.to_tensor([big])
+        assert int(t.numpy()[0]) == big
+        assert (t + 1).dtype == paddle.int64
+        assert paddle.arange(3).dtype == paddle.int64
+        assert paddle.argmax(paddle.to_tensor([1.0, 3.0])).dtype == \
+            paddle.int64
 
     def test_default_dtype(self):
         paddle.set_default_dtype("bfloat16")
