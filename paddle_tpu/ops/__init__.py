@@ -28,9 +28,11 @@ from .random import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .einsum import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
+from .special import *  # noqa: F401,F403
 
 from . import creation, math, reduction, manipulation, logic, search
 from . import random, linalg, einsum as einsum_mod
+from . import special
 
 
 def _inplace_from(t: Tensor, out: Tensor) -> Tensor:
@@ -167,7 +169,9 @@ _METHODS = {
     "diagflat": diagflat,
     # random inplace
     "exponential_": random.exponential_, "uniform_": random.uniform_,
-    "normal_": random.normal_,
+    "normal_": random.normal_, "bernoulli_": random.bernoulli_,
+    "cauchy_": random.cauchy_, "geometric_": random.geometric_,
+    "log_normal_": random.log_normal_,
 }
 
 # ops whose first arg is the tensor and have natural inplace variants
@@ -226,6 +230,45 @@ def bind_tensor_methods(cls=Tensor):
 
 
 bind_tensor_methods()
+
+
+# Module-level inplace variants (`paddle.add_(x, y)` etc. — reference
+# exports one `<op>_` wrapper per inplace-capable op from
+# python/paddle/tensor/__init__.py). Generated from the out-of-place fns.
+_MODULE_INPLACE_BASES = _INPLACE_BASES + [
+    "addmm", "bitwise_and", "bitwise_left_shift", "bitwise_not",
+    "bitwise_or", "bitwise_right_shift", "bitwise_xor", "copysign",
+    "cumprod", "equal", "floor_mod", "gammainc", "gammaincc", "gammaln",
+    "gcd", "greater_equal", "greater_than", "hypot", "i0", "index_add",
+    "index_fill", "lcm", "ldexp", "less_equal", "less_than", "logical_and",
+    "logical_not", "logical_or", "logical_xor", "masked_scatter",
+    "multigammaln", "not_equal", "polygamma", "renorm", "sinc", "t",
+    "transpose", "where",
+]
+
+
+def _make_module_inplace(fn, iname):
+    def f(x, *args, **kwargs):
+        return _inplace_from(x, fn(x, *args, **kwargs))
+    f.__name__ = iname
+    f.__doc__ = f"In-place variant of `{fn.__name__}`."
+    return f
+
+
+def _bind_module_inplace():
+    g = globals()
+    for base in _MODULE_INPLACE_BASES:
+        fn = g.get(base) or _METHODS.get(base)
+        if fn is None:
+            continue
+        iname = base + "_"
+        if iname not in g:
+            g[iname] = _make_module_inplace(fn, iname)
+        if not hasattr(Tensor, iname):
+            setattr(Tensor, iname, _make_module_inplace(fn, iname))
+
+
+_bind_module_inplace()
 
 
 def inplace_from(t, out):

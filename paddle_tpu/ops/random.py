@@ -19,7 +19,8 @@ __all__ = [
     "rand", "randn", "randint", "randint_like", "randperm", "uniform",
     "normal", "standard_normal", "bernoulli", "multinomial", "poisson",
     "exponential_", "uniform_", "normal_", "rand_like", "randn_like",
-    "standard_gamma", "binomial", "log_normal",
+    "standard_gamma", "binomial", "log_normal", "bernoulli_", "cauchy_",
+    "geometric_", "log_normal_",
 ]
 
 
@@ -146,4 +147,28 @@ def uniform_(x, min=-1.0, max=1.0, name=None):
 
 def normal_(x, mean=0.0, std=1.0, name=None):
     draw = jax.random.normal(next_key(), tuple(x.shape), x.dtype) * std + mean
+    return x._rebind(draw)
+
+
+def bernoulli_(x, p=0.5, name=None):
+    draw = jax.random.bernoulli(next_key(), p, tuple(x.shape))
+    return x._rebind(draw.astype(x.dtype))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    draw = jax.random.cauchy(next_key(), tuple(x.shape), x.dtype)
+    return x._rebind(draw * scale + loc)
+
+
+def geometric_(x, probs, name=None):
+    u = jax.random.uniform(next_key(), tuple(x.shape), jnp.float32,
+                           minval=jnp.finfo(jnp.float32).tiny, maxval=1.0)
+    # number of Bernoulli(p) trials until first success (support 1, 2, ...)
+    draw = jnp.ceil(jnp.log(u) / jnp.log1p(-probs))
+    return x._rebind(draw.astype(x.dtype))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    draw = jnp.exp(
+        jax.random.normal(next_key(), tuple(x.shape), x.dtype) * std + mean)
     return x._rebind(draw)

@@ -1,0 +1,315 @@
+"""Long-tail tensor ops closing the reference tensor-API gaps found by
+tools/ops_audit.py.
+
+Reference surface: `python/paddle/tensor/__init__.py` (math.py, linalg.py,
+manipulation.py, einsum.py wrappers over `_C_ops`). Implementations are
+jnp/jax.scipy compositions — XLA fuses them; none warrant Pallas.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math as _pymath
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply, as_index, unwrap
+
+__all__ = [
+    "as_strided", "block_diag", "cartesian_prod", "cdist",
+    "cholesky_inverse", "combinations", "diagonal_scatter", "floor_mod",
+    "frexp", "gammainc", "gammaincc", "histogram_bin_edges",
+    "householder_product", "i0e", "i1e", "is_integer", "isin", "isneginf",
+    "isposinf", "isreal", "masked_scatter", "multigammaln", "multiplex",
+    "ormqr", "pca_lowrank", "polygamma", "reduce_as", "select_scatter",
+    "sinc", "slice_scatter", "svd_lowrank", "top_p_sampling",
+]
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view (reference `stride/as_strided_kernel.cc`). XLA has no
+    aliasing views, so this materializes the gather with the same
+    element-mapping semantics."""
+    shape = [int(s) for s in shape]
+    stride = [int(s) for s in stride]
+
+    def fn(a):
+        flat = a.reshape(-1)
+        idx = np.asarray(offset, np.int64)
+        for dim, (n, st) in enumerate(zip(shape, stride)):
+            ar = np.arange(n, dtype=np.int64) * st
+            idx = np.expand_dims(idx, -1) + ar.reshape(
+                (1,) * np.ndim(idx) + (n,))
+        return flat[jnp.asarray(idx.reshape(shape), jnp.int32)]
+    return apply(fn, x, name="as_strided")
+
+
+def block_diag(inputs, name=None):
+    def fn(*arrs):
+        arrs = [a if a.ndim == 2 else jnp.atleast_2d(a) for a in arrs]
+        return jax.scipy.linalg.block_diag(*arrs)
+    return apply(fn, *inputs, name="block_diag")
+
+
+def cartesian_prod(x, name=None):
+    def fn(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return apply(fn, *x, name="cartesian_prod")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def fn(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(
+                jnp.sum(diff * diff, axis=-1), 0.0))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(diff), axis=-1)
+        if p == 0.0:
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return apply(fn, x, y, name="cdist")
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def fn(l):
+        n = l.shape[-1]
+        eye = jnp.eye(n, dtype=l.dtype)
+        inv = jax.scipy.linalg.cho_solve((l, not upper), eye)
+        return inv
+    return apply(fn, x, name="cholesky_inverse")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    def fn(a):
+        n = a.shape[0]
+        gen = itertools.combinations_with_replacement(range(n), r) \
+            if with_replacement else itertools.combinations(range(n), r)
+        idx = np.asarray(list(gen), np.int32).reshape(-1, r)
+        return a[jnp.asarray(idx)]
+    return apply(fn, x, name="combinations")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def fn(a, b):
+        mask_np = np.zeros(a.shape, bool)
+        rng = range(min(a.shape[axis1], a.shape[axis2]))
+        it = np.arange(min(a.shape[axis1], a.shape[axis2]))
+        i = it if offset >= 0 else it - offset
+        j = it + offset if offset >= 0 else it
+        keep = (i < a.shape[axis1]) & (j < a.shape[axis2]) & (i >= 0) & \
+            (j >= 0)
+        i, j = i[keep], j[keep]
+        moved = jnp.moveaxis(jnp.moveaxis(a, axis1, 0), axis2, 1)
+        upd = jnp.moveaxis(b, -1, 0)  # diag dim leads
+        moved = moved.at[i, j].set(upd.astype(moved.dtype))
+        return jnp.moveaxis(jnp.moveaxis(moved, 1, axis2), 0, axis1)
+    return apply(fn, x, y, name="diagonal_scatter")
+
+
+def floor_mod(x, y, name=None):
+    from .math import mod
+    return mod(x, y)
+
+
+def frexp(x, name=None):
+    return apply(lambda a: jnp.frexp(a), x, name="frexp")
+
+
+def gammainc(x, y, name=None):
+    return apply(lambda a, b: jax.scipy.special.gammainc(a, b), x, y,
+                 name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    return apply(lambda a, b: jax.scipy.special.gammaincc(a, b), x, y,
+                 name="gammaincc")
+
+
+def histogram_bin_edges(input, bins=100, min=0.0, max=0.0, name=None):
+    def fn(a):
+        lo, hi = float(min), float(max)
+        if lo == 0.0 and hi == 0.0:
+            return jnp.histogram_bin_edges(a, bins=bins)
+        return jnp.linspace(lo, hi, bins + 1, dtype=jnp.float32)
+    return apply(fn, input, name="histogram_bin_edges")
+
+
+def householder_product(x, tau, name=None):
+    return apply(lambda a, t: jax.lax.linalg.householder_product(a, t),
+                 x, tau, name="householder_product")
+
+
+def i0e(x, name=None):
+    return apply(lambda a: jax.scipy.special.i0e(a), x, name="i0e")
+
+
+def i1e(x, name=None):
+    return apply(lambda a: jax.scipy.special.i1e(a), x, name="i1e")
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply(lambda a, b: jnp.isin(a, b, invert=invert), x, test_x,
+                 name="isin")
+
+
+def isneginf(x, name=None):
+    return apply(jnp.isneginf, x, name="isneginf")
+
+
+def isposinf(x, name=None):
+    return apply(jnp.isposinf, x, name="isposinf")
+
+
+def isreal(x, name=None):
+    return apply(jnp.isreal, x, name="isreal")
+
+
+def masked_scatter(x, mask, value, name=None):
+    """out[mask] = value[:mask.sum()] elementwise in row-major order
+    (reference `masked_scatter` semantics). Static-shape friendly: the
+    running count of True entries indexes into the flattened source."""
+    def fn(a, m, v):
+        m = jnp.broadcast_to(m, a.shape)
+        flat_m = m.reshape(-1)
+        pos = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        src = v.reshape(-1)
+        take = jnp.clip(pos, 0, src.shape[0] - 1)
+        out = jnp.where(flat_m, src[take].astype(a.dtype), a.reshape(-1))
+        return out.reshape(a.shape)
+    return apply(fn, x, mask, value, name="masked_scatter")
+
+
+def multigammaln(x, p, name=None):
+    return apply(lambda a: jax.scipy.special.multigammaln(a, int(p)), x,
+                 name="multigammaln")
+
+
+def multiplex(inputs, index, name=None):
+    """out[i] = inputs[index[i]][i] (reference `multiplex` op)."""
+    idx = as_index(unwrap(index)).reshape(-1)
+
+    def fn(*arrs):
+        stacked = jnp.stack(arrs, axis=0)  # [n, rows, ...]
+        rows = jnp.arange(stacked.shape[1], dtype=jnp.int32)
+        return stacked[idx[:stacked.shape[1]], rows]
+    return apply(fn, *inputs, name="multiplex")
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply `other` by Q from a geqrf-style factorization of x.
+
+    Q is the full m x m orthogonal matrix implied by the reflectors: pad
+    the reflector block with identity columns (tau = 0) so
+    householder_product yields full Q, matching LAPACK `ormqr`."""
+    def fn(a, t, c):
+        m, k = a.shape[-2], a.shape[-1]
+        side = m if left else c.shape[-1]
+        if k < side:
+            pad_a = [(0, 0)] * (a.ndim - 1) + [(0, side - k)]
+            pad_t = [(0, 0)] * (t.ndim - 1) + [(0, side - k)]
+            a = jnp.pad(a, pad_a)
+            t = jnp.pad(t, pad_t)
+        q = jax.lax.linalg.householder_product(a, t)
+        qm = jnp.swapaxes(q, -1, -2) if transpose else q
+        return qm @ c if left else c @ qm
+    return apply(fn, x, tau, other, name="ormqr")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def fn(a):
+        m, n = a.shape[-2], a.shape[-1]
+        k = q if q is not None else min(6, m, n)
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+    return apply(fn, x, name="pca_lowrank")
+
+
+def polygamma(x, n, name=None):
+    def fn(a):
+        return jax.scipy.special.polygamma(int(n), a)
+    return apply(fn, x, name="polygamma")
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x down to target's (broadcastable) shape (reference
+    `reduce_as` — the gradient-of-broadcast reduction)."""
+    tgt_shape = list(target.shape)
+
+    def fn(a):
+        extra = a.ndim - len(tgt_shape)
+        if extra > 0:
+            a = jnp.sum(a, axis=tuple(range(extra)))
+        axes = tuple(i for i, (s, t) in enumerate(zip(a.shape, tgt_shape))
+                     if s != t and t == 1)
+        if axes:
+            a = jnp.sum(a, axis=axes, keepdims=True)
+        return a
+    return apply(fn, x, name="reduce_as")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def fn(a, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = index
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+    return apply(fn, x, values, name="select_scatter")
+
+
+def is_integer(x):
+    from ..core import dtype as dtype_mod
+    return dtype_mod.is_integer(x.dtype)
+
+
+def sinc(x, name=None):
+    return apply(jnp.sinc, x, name="sinc")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def fn(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = slice(int(st), int(en), int(sd))
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+    return apply(fn, x, value, name="slice_scatter")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    def fn(a):
+        b = a if M is None else a - unwrap(M)
+        u, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        k = builtins_min(q, s.shape[-1])
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+    builtins_min = min
+    return apply(fn, x, name="svd_lowrank")
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus sampling (reference `top_p_sampling` op): sample one token
+    id per row from the smallest set of logits whose cumulative softmax
+    probability exceeds `ps`."""
+    from ..core.random import next_key
+    key = next_key()
+
+    def fn(logits, p):
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        sort_idx = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, sort_idx, axis=-1)
+        cum = jnp.cumsum(sorted_p, axis=-1)
+        keep = cum - sorted_p < p.reshape(-1, 1)
+        filt = jnp.where(keep, sorted_p, 0.0)
+        filt = filt / jnp.sum(filt, axis=-1, keepdims=True)
+        choice = jax.random.categorical(key, jnp.log(
+            jnp.maximum(filt, 1e-38)), axis=-1)
+        ids = jnp.take_along_axis(
+            sort_idx, choice[..., None], axis=-1).astype(jnp.int64)
+        scores = jnp.take_along_axis(probs, ids, axis=-1)
+        return ids, scores
+    return apply(fn, x, ps, name="top_p_sampling")
