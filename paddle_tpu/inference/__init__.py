@@ -91,6 +91,32 @@ class Config:
     def precision(self):
         return self._precision
 
+    # -- paged KV-cache decode (reference block_multihead_attention /
+    # AnalysisConfig block-attention switches) ----------------------------
+    def enable_block_attention(self, block_size=16, max_batch=8,
+                               max_seq_len=2048, num_blocks=None):
+        """Turn on paged (block) KV-cache decoding for generation served
+        through this config (see inference/paged.py)."""
+        self._block_attn = dict(block_size=block_size, max_batch=max_batch,
+                                max_seq_len=max_seq_len,
+                                num_blocks=num_blocks)
+        return self
+
+    def block_attention_config(self):
+        return getattr(self, "_block_attn", None)
+
+    def create_generation_engine(self, model=None, temperature=0.0,
+                                 eos_token_id=None, dtype=None):
+        """Build a ContinuousBatchingEngine over the configured model."""
+        import jax.numpy as jnp
+
+        from .paged import ContinuousBatchingEngine
+        model = model or self._layer
+        ba = self.block_attention_config() or {}
+        return ContinuousBatchingEngine(
+            model, temperature=temperature, eos_token_id=eos_token_id,
+            dtype=dtype or jnp.bfloat16, **ba)
+
 
 class _IOHandle:
     """Zero-copy-ish IO handle (reference ZeroCopyTensor)."""

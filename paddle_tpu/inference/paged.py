@@ -1,0 +1,323 @@
+"""Paged (block) KV cache + continuous batching for autoregressive decode.
+
+Capability parity with the reference's paged-attention decode stack
+(`paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu:1` —
+block tables over a shared KV pool — and
+`masked_multihead_attention_kernel.cu` — single-token masked decode), and
+the `block_multihead_attention` python API
+(`python/paddle/incubate/nn/functional/block_multihead_attention.py`).
+
+TPU-native design instead of a CUDA-kernel translation:
+- The KV pool is one array per layer `[num_blocks, block_size, Hk, D]` in
+  HBM; a per-slot block table `[max_batch, max_blocks_per_seq]` int32 maps
+  logical token positions to pool blocks. All shapes static — the decode
+  step is ONE jitted XLA program regardless of which sequences are live.
+- Decode attention gathers each slot's blocks (`pool[table]`, an XLA
+  gather that moves only index metadata, fused with the attention that
+  follows), masks by sequence length, and runs the GQA group-folded
+  attention — KV heads are never expanded.
+- Block allocation/free is host-side Python (a free list): allocation is
+  control flow, not compute, and stays off the device.
+
+Continuous batching: `ContinuousBatchingEngine` keeps `max_batch` decode
+slots; finished sequences free their blocks and new prompts prefill into
+freed blocks while other slots keep decoding — the decode step function
+never recompiles.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["PagedKVCache", "paged_prefill_write", "paged_decode_attention",
+           "ContinuousBatchingEngine"]
+
+
+class PagedKVCache:
+    """Per-layer block pools + block tables + sequence lengths.
+
+    Device state (jit-carried): k_pools/v_pools (list per layer),
+    block_tables [max_batch, max_blocks_per_seq] int32, seq_lens
+    [max_batch] int32. Host state: free-list of block ids.
+    """
+
+    def __init__(self, num_layers, num_kv_heads, head_dim, *, num_blocks,
+                 block_size=16, max_blocks_per_seq, max_batch,
+                 dtype=jnp.bfloat16):
+        self.num_layers = num_layers
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.max_batch = max_batch
+        self.dtype = dtype
+        shape = (num_blocks, block_size, num_kv_heads, head_dim)
+        self.k_pools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.v_pools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        # block 0 is reserved as the null block so fresh table entries are
+        # valid indices; the length mask hides its contents
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self.block_tables = jnp.zeros((max_batch, max_blocks_per_seq),
+                                      jnp.int32)
+        self.seq_lens = jnp.zeros((max_batch,), jnp.int32)
+        self._slot_blocks = [[] for _ in range(max_batch)]
+        self._live = [False] * max_batch
+
+    # -- host-side management ---------------------------------------------
+
+    @property
+    def max_seq_len(self):
+        return self.max_blocks_per_seq * self.block_size
+
+    def free_slots(self):
+        return [i for i, l in enumerate(self._live) if not l]
+
+    def num_free_blocks(self):
+        return len(self._free)
+
+    def alloc_slot(self, num_tokens):
+        """Claim a slot + enough blocks for `num_tokens`; returns slot id
+        or None if out of slots/blocks."""
+        need = max(1, math.ceil(num_tokens / self.block_size))
+        free = self.free_slots()
+        if not free or need > len(self._free) or \
+                need > self.max_blocks_per_seq:
+            return None
+        slot = free[0]
+        blocks = [self._free.pop() for _ in range(need)]
+        self._slot_blocks[slot] = blocks
+        self._live[slot] = True
+        row = np.zeros((self.max_blocks_per_seq,), np.int32)
+        row[:need] = blocks
+        self.block_tables = self.block_tables.at[slot].set(jnp.asarray(row))
+        self.seq_lens = self.seq_lens.at[slot].set(0)
+        return slot
+
+    def ensure_capacity(self, slot, new_len):
+        """Grow the slot's table if `new_len` tokens need another block.
+        Returns False if the pool is exhausted."""
+        have = len(self._slot_blocks[slot])
+        need = math.ceil(new_len / self.block_size)
+        while have < need:
+            if not self._free or have >= self.max_blocks_per_seq:
+                return False
+            b = self._free.pop()
+            self.block_tables = self.block_tables.at[slot, have].set(b)
+            self._slot_blocks[slot].append(b)
+            have += 1
+        return True
+
+    def free_slot(self, slot):
+        self._free.extend(reversed(self._slot_blocks[slot]))
+        self._slot_blocks[slot] = []
+        self._live[slot] = False
+        self.block_tables = self.block_tables.at[slot].set(
+            jnp.zeros((self.max_blocks_per_seq,), jnp.int32))
+        self.seq_lens = self.seq_lens.at[slot].set(0)
+
+
+# ---------------------------------------------------------------------------
+# device-side functional ops (static shapes, jit-safe)
+# ---------------------------------------------------------------------------
+
+def paged_prefill_write(k_pool, v_pool, block_row, k_new, v_new):
+    """Write a prompt's KV [S, Hk, D] into the pool blocks listed in
+    `block_row` [max_blocks_per_seq]. S is padded to a block multiple by
+    the caller; returns updated pools."""
+    s = k_new.shape[0]
+    bs = k_pool.shape[1]
+    nb = s // bs
+    kb = k_new.reshape(nb, bs, *k_new.shape[1:]).astype(k_pool.dtype)
+    vb = v_new.reshape(nb, bs, *v_new.shape[1:]).astype(v_pool.dtype)
+    blocks = block_row[:nb]
+    return k_pool.at[blocks].set(kb), v_pool.at[blocks].set(vb)
+
+
+def paged_decode_write(k_pool, v_pool, block_tables, positions, k_new,
+                       v_new, active):
+    """Scatter one new token's KV per slot: k_new/v_new [B, Hk, D] at
+    `positions` [B] (the token's index). Inactive slots write to the null
+    block 0 slot 0 — harmless, masked everywhere."""
+    bs = k_pool.shape[1]
+    b_idx = positions // bs
+    offs = positions % bs
+    rows = jnp.arange(block_tables.shape[0], dtype=jnp.int32)
+    blocks = jnp.where(active, block_tables[rows, b_idx], 0)
+    offs = jnp.where(active, offs, 0)
+    k_pool = k_pool.at[blocks, offs].set(
+        jnp.where(active[:, None, None], k_new.astype(k_pool.dtype),
+                  k_pool[blocks, offs]))
+    v_pool = v_pool.at[blocks, offs].set(
+        jnp.where(active[:, None, None], v_new.astype(v_pool.dtype),
+                  v_pool[blocks, offs]))
+    return k_pool, v_pool
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens,
+                           scale=None):
+    """Masked decode attention over the paged cache.
+
+    q [B, Hq, D] (one query token per slot); returns [B, Hq, D].
+    Gathers each slot's blocks, masks positions >= seq_len, GQA
+    group-folded (no KV expansion).
+    """
+    b, hq, d = q.shape
+    nb_pool, bs, hk, _ = k_pool.shape
+    g = hq // hk
+    s_max = block_tables.shape[1] * bs
+
+    k = k_pool[block_tables]  # [B, nb, bs, Hk, D]
+    v = v_pool[block_tables]
+    k = k.reshape(b, s_max, hk, d)
+    v = v.reshape(b, s_max, hk, d)
+
+    sm_scale = jnp.float32(scale if scale is not None
+                           else 1.0 / math.sqrt(d))
+    qg = q.reshape(b, hk, g, d)
+    logits = jnp.einsum("bngd,btnd->bngt", qg, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    pos = jnp.arange(s_max, dtype=jnp.int32)
+    mask = pos[None, :] < seq_lens[:, None]  # [B, s_max]
+    logits = jnp.where(mask[:, None, None, :], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    # fully-masked (inactive) slots: softmax of all -1e30 is uniform junk;
+    # zero it so output is exactly 0
+    probs = jnp.where(mask[:, None, None, :], probs, 0.0)
+    out = jnp.einsum("bngt,btnd->bngd", probs.astype(v.dtype), v)
+    return out.reshape(b, hq, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# continuous batching engine
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    generated: list = field(default_factory=list)
+    slot: int = -1
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching over a paged cache.
+
+    add_request() enqueues prompts; step() admits waiting prompts into
+    free slots (prefill) and decodes ONE token for every live slot (a
+    single jitted program whose shapes never change); finished sequences
+    release their blocks immediately.
+    """
+
+    def __init__(self, model, *, max_batch=8, block_size=16,
+                 max_seq_len=2048, num_blocks=None, temperature=0.0,
+                 eos_token_id=None, dtype=jnp.bfloat16):
+        cfg = model.config
+        self.model = model
+        self.eos_token_id = eos_token_id
+        self.temperature = temperature
+        mbps = math.ceil(max_seq_len / block_size)
+        if num_blocks is None:
+            num_blocks = max_batch * mbps + 1  # +1: reserved null block
+        self.cache = PagedKVCache(
+            cfg.num_layers, cfg.num_kv_heads,
+            cfg.hidden_size // cfg.num_heads, num_blocks=num_blocks,
+            block_size=block_size, max_blocks_per_seq=mbps,
+            max_batch=max_batch, dtype=dtype)
+        self.waiting: list[_Request] = []
+        self.running: dict[int, _Request] = {}  # slot -> request
+        self.finished: dict[int, _Request] = {}
+        self._next_rid = 0
+        self._last_tok = np.zeros((max_batch,), np.int64)
+        self._remaining = np.zeros((max_batch,), np.int64)
+
+    def add_request(self, prompt_ids, max_new_tokens=32):
+        rid = self._next_rid
+        self._next_rid += 1
+        self.waiting.append(_Request(rid, np.asarray(prompt_ids).reshape(-1),
+                                     max_new_tokens))
+        return rid
+
+    @property
+    def has_work(self):
+        return bool(self.waiting or self.running)
+
+    def _admit(self):
+        admitted = []
+        still_waiting = []
+        for req in self.waiting:
+            slot = self.cache.alloc_slot(len(req.prompt)) \
+                if len(self.running) < self.cache.max_batch else None
+            if slot is None:
+                still_waiting.append(req)
+                continue
+            req.slot = slot
+            self.running[slot] = req
+            admitted.append(req)
+        self.waiting = still_waiting
+        for req in admitted:
+            tok = self.model.paged_prefill(self.cache, req.slot, req.prompt,
+                                           temperature=self.temperature)
+            self._last_tok[req.slot] = tok
+            self._remaining[req.slot] = req.max_new_tokens - 1
+            req.generated.append(int(tok))
+            self._maybe_finish(req.slot)
+
+    def _maybe_finish(self, slot):
+        req = self.running.get(slot)
+        if req is None:
+            return
+        done = self._remaining[slot] <= 0 or (
+            self.eos_token_id is not None
+            and req.generated and req.generated[-1] == self.eos_token_id)
+        if done:
+            self.cache.free_slot(slot)
+            del self.running[slot]
+            self.finished[req.rid] = req
+
+    def step(self):
+        """Admit waiting prompts, then decode one token for all live
+        slots. Returns list of (rid, token) produced this step."""
+        self._admit()
+        if not self.running:
+            return []
+        active_np = np.zeros((self.cache.max_batch,), bool)
+        for slot in self.running:
+            active_np[slot] = True
+        # grow tables where the next token crosses a block boundary
+        lens = np.asarray(self.cache.seq_lens)
+        for slot in list(self.running):
+            if not self.cache.ensure_capacity(slot, int(lens[slot]) + 1):
+                # pool exhausted: finish the victim early
+                self._remaining[slot] = 0
+                self._maybe_finish(slot)
+                active_np[slot] = False
+        if not self.running:
+            return []
+        toks = self.model.paged_decode_step(
+            self.cache, jnp.asarray(self._last_tok),
+            jnp.asarray(active_np), temperature=self.temperature)
+        toks_np = np.asarray(toks)
+        out = []
+        for slot, req in list(self.running.items()):
+            t = int(toks_np[slot])
+            req.generated.append(t)
+            self._last_tok[slot] = t
+            self._remaining[slot] -= 1
+            out.append((req.rid, t))
+            self._maybe_finish(slot)
+        return out
+
+    def run_to_completion(self):
+        """Drain all requests; returns {rid: generated token list}."""
+        while self.has_work:
+            self.step()
+        return {rid: req.generated for rid, req in self.finished.items()}

@@ -14,9 +14,11 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import nn
 from ..core.dispatch import apply
+from ..core.tensor import Tensor
 from ..nn import functional as F
 
 
@@ -73,11 +75,17 @@ def apply_rope(q, k, theta=10000.0, position_offset=0):
         d = qa.shape[-1]
         s = qa.shape[1]
         inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, jnp.float32) / d))
-        pos = jnp.asarray(position_offset, jnp.float32) + \
-            jnp.arange(s, dtype=jnp.float32)
-        freqs = jnp.outer(pos, inv_freq)  # [s, d/2]
-        cos = jnp.cos(freqs)[None, :, None, :]
-        sin = jnp.sin(freqs)[None, :, None, :]
+        off = jnp.asarray(position_offset, jnp.float32)
+        if off.ndim == 1:  # per-batch offsets (paged decode slots)
+            pos = off[:, None] + jnp.arange(s, dtype=jnp.float32)[None, :]
+            freqs = pos[..., None] * inv_freq  # [b, s, d/2]
+            cos = jnp.cos(freqs)[:, :, None, :]
+            sin = jnp.sin(freqs)[:, :, None, :]
+        else:
+            pos = off + jnp.arange(s, dtype=jnp.float32)
+            freqs = jnp.outer(pos, inv_freq)  # [s, d/2]
+            cos = jnp.cos(freqs)[None, :, None, :]
+            sin = jnp.sin(freqs)[None, :, None, :]
 
         def rot(x):
             x1 = x[..., 0::2].astype(jnp.float32)
@@ -115,7 +123,7 @@ class LlamaAttention(nn.Layer):
         self.o_proj = nn.Linear(d, d, weight_attr=_normal_attr(std),
                                 bias_attr=False)
 
-    def forward(self, x, cache=None, position_offset=0):
+    def forward(self, x, cache=None, position_offset=0, kv_sink=None):
         from .. import ops
         b, s, d = x.shape
         q = ops.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
@@ -125,6 +133,8 @@ class LlamaAttention(nn.Layer):
                         [b, s, self.num_kv_heads, self.head_dim])
         q, k = apply_rope(q, k, theta=self.rope_theta,
                           position_offset=position_offset)
+        if kv_sink is not None:  # paged prefill captures post-rope KV
+            kv_sink.append((k, v))
         if cache is None:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
             out = ops.reshape(out, [b, s, d])
@@ -188,9 +198,10 @@ class LlamaBlock(nn.Layer):
             config.hidden_size, epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, cache=None, position_offset=0):
+    def forward(self, x, cache=None, position_offset=0, kv_sink=None):
         if cache is None:
-            x = x + self.self_attn(self.input_layernorm(x))
+            x = x + self.self_attn(self.input_layernorm(x),
+                                   kv_sink=kv_sink)
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x
         attn_out, new_cache = self.self_attn(
@@ -220,13 +231,14 @@ class Llama(nn.Layer):
         else:
             self.lm_head = None
 
-    def forward(self, input_ids, caches=None, position_offset=0):
+    def forward(self, input_ids, caches=None, position_offset=0,
+                kv_sink=None):
         from .. import ops
         x = self.embed_tokens(input_ids)
         new_caches = [] if caches is not None else None
         for i, block in enumerate(self.layers):
             if caches is None:
-                x = block(x)
+                x = block(x, kv_sink=kv_sink)
             else:
                 x, c = block(x, cache=caches[i],
                              position_offset=position_offset)
@@ -256,6 +268,154 @@ class Llama(nn.Layer):
         from .generation import generate
         return generate(self, input_ids, max_new_tokens=max_new_tokens,
                         **kwargs)
+
+    # -- paged (block) KV-cache decode ------------------------------------
+    # Reference: block_multi_head_attention_kernel.cu (paged cache) +
+    # masked_multihead_attention_kernel.cu (decode). See inference/paged.py.
+
+    def _param_rebind(self):
+        if not hasattr(self, "_pb_names"):
+            self._pb_names = [n for n, _ in self.named_parameters()]
+        if hasattr(self, "_pb_rebind"):
+            return self._pb_rebind
+
+        def rebind(param_arrays):
+            for n, arr in zip(self._pb_names, param_arrays):
+                obj = self
+                *path, leaf = n.split(".")
+                for seg in path:
+                    obj = obj[int(seg)] if seg.isdigit() else \
+                        getattr(obj, seg)
+                getattr(obj, leaf)._data = arr
+        self._pb_rebind = rebind
+        return rebind
+
+    def _param_arrays(self):
+        return tuple(p._data for _, p in self.named_parameters())
+
+    def paged_prefill(self, cache, slot, prompt_ids, temperature=0.0):
+        """Run the prompt through the dense forward (causal), write its
+        post-rope KV into the slot's pool blocks, set seq_len, and return
+        the first sampled token."""
+        from ..core.random import next_key
+        from ..inference.paged import paged_prefill_write
+
+        prompt = np.asarray(prompt_ids).reshape(-1)
+        s = prompt.shape[0]
+        bs = cache.block_size
+        spad = -(-s // bs) * bs
+        ids = np.zeros((1, spad), np.int64)
+        ids[:, :s] = prompt
+
+        if not hasattr(self, "_paged_prefill_jit"):
+            rebind = self._param_rebind()
+
+            def fn(param_arrays, ids_arr, true_len, key, temp):
+                from .generation import sample_token
+                rebind(param_arrays)
+                sink = []
+                from ..core.autograd import no_grad
+                with no_grad():
+                    logits = self.forward(Tensor(ids_arr), kv_sink=sink)
+                last = jnp.take_along_axis(
+                    logits._data, (true_len - 1)[None, None, None],
+                    axis=1)[:, 0]
+                tok = jax.lax.cond(
+                    temp > 0,
+                    lambda: sample_token(last / jnp.maximum(temp, 1e-6),
+                                         temperature=1.0, key=key),
+                    lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
+                ks = [k._data[0] for k, _ in sink]
+                vs = [v._data[0] for _, v in sink]
+                return tok[0], ks, vs
+            self._paged_prefill_jit = jax.jit(fn)
+
+        arrs = self._param_arrays()
+        tok, ks, vs = self._paged_prefill_jit(
+            arrs, jnp.asarray(ids), jnp.int32(s),
+            next_key(), jnp.float32(temperature))
+        # tracing left tracers bound into the module params; restore
+        self._param_rebind()(arrs)
+        row = cache.block_tables[slot]
+        for i in range(cache.num_layers):
+            cache.k_pools[i], cache.v_pools[i] = paged_prefill_write(
+                cache.k_pools[i], cache.v_pools[i], row, ks[i], vs[i])
+        cache.seq_lens = cache.seq_lens.at[slot].set(s)
+        return int(tok)
+
+    def paged_decode_step(self, cache, last_tokens, active,
+                          temperature=0.0):
+        """One decode step for every live slot: write the incoming token's
+        KV at position seq_len, attend against the paged cache (masked to
+        seq_len+1), sample the next token. Single static-shape jitted
+        program; updates `cache` pools/lens in place."""
+        from ..core.random import next_key
+
+        if not hasattr(self, "_paged_decode_jit"):
+            rebind = self._param_rebind()
+            cfg = self.config
+            hq = cfg.num_heads
+            hk = cfg.num_kv_heads
+            hd = cfg.hidden_size // hq
+
+            def fn(param_arrays, toks, k_pools, v_pools, tables, lens,
+                   active, key, temp):
+                from ..inference.paged import (paged_decode_attention,
+                                               paged_decode_write)
+                from .generation import sample_token
+                from ..core.autograd import no_grad
+                rebind(param_arrays)
+                b = toks.shape[0]
+                with no_grad():
+                    x = self.embed_tokens(Tensor(toks[:, None]))
+                    new_k, new_v = [], []
+                    for i, blk in enumerate(self.layers):
+                        attn = blk.self_attn
+                        h = blk.input_layernorm(x)
+                        q = attn.q_proj(h).reshape([b, 1, hq, hd])
+                        k = attn.k_proj(h).reshape([b, 1, hk, hd])
+                        v = attn.v_proj(h).reshape([b, 1, hk, hd])
+                        q, k = apply_rope(q, k, theta=attn.rope_theta,
+                                          position_offset=lens)
+                        kp, vp = paged_decode_write(
+                            k_pools[i], v_pools[i], tables, lens,
+                            k._data[:, 0], v._data[:, 0], active)
+                        out = paged_decode_attention(
+                            q._data[:, 0], kp, vp, tables,
+                            jnp.where(active, lens + 1, lens))
+                        x = x + attn.o_proj(
+                            Tensor(out.reshape(b, 1, hq * hd)))
+                        x = x + blk.mlp(blk.post_attention_layernorm(x))
+                        new_k.append(kp)
+                        new_v.append(vp)
+                    x = self.norm(x)
+                    if self.lm_head is not None:
+                        logits = self.lm_head(x)
+                    else:
+                        from .. import ops
+                        logits = ops.matmul(x, self.embed_tokens.weight,
+                                            transpose_y=True)
+                last = logits._data[:, 0]
+                nxt = jax.lax.cond(
+                    temp > 0,
+                    lambda: sample_token(last / jnp.maximum(temp, 1e-6),
+                                         temperature=1.0, key=key),
+                    lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
+                return nxt, new_k, new_v
+            self._paged_decode_jit = jax.jit(fn)
+
+        arrs = self._param_arrays()
+        toks, new_k, new_v = self._paged_decode_jit(
+            arrs, jnp.asarray(last_tokens, jnp.int32),
+            cache.k_pools, cache.v_pools, cache.block_tables,
+            cache.seq_lens, jnp.asarray(active), next_key(),
+            jnp.float32(temperature))
+        self._param_rebind()(arrs)
+        cache.k_pools = list(new_k)
+        cache.v_pools = list(new_v)
+        cache.seq_lens = jnp.where(jnp.asarray(active),
+                                   cache.seq_lens + 1, cache.seq_lens)
+        return toks
 
     def loss(self, input_ids, labels):
         logits = self(input_ids)
