@@ -17,8 +17,12 @@ import jax
 
 class Generator:
     def __init__(self, seed: int = 0):
+        # the key materializes lazily: creating it at construction would
+        # initialize the XLA backend at `import paddle_tpu` time, which
+        # breaks multi-process bootstrap (jax.distributed.initialize must
+        # run before the first backend touch)
         self._seed = seed
-        self._key = jax.random.PRNGKey(seed)
+        self._key = None
 
     def manual_seed(self, seed: int):
         self._seed = seed
@@ -30,10 +34,14 @@ class Generator:
 
     def split(self):
         """Return a fresh subkey, advancing the state."""
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 
     def get_state(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
         return self._key
 
     def set_state(self, key):
