@@ -88,3 +88,6 @@ define_flag("FLAGS_max_inplace_grad_add", 0, "grad accumulation chunking")
 define_flag("FLAGS_enable_async_trace", False, "collective watchdog trace")
 define_flag("FLAGS_distributed_timeout", 1800,
             "collective timeout seconds (coordination service barrier)")
+define_flag("FLAGS_enable_collective_watchdog", False,
+            "supervise each dispatched step with a timeout + flight "
+            "records (reference comm_task_manager.h:37)")

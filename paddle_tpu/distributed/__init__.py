@@ -27,3 +27,6 @@ from .moe import MoELayer, TopKGate  # noqa: F401
 from .parallel import DataParallel, spawn  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401
 from .pipeline import PipelineDecoderLM  # noqa: F401
+from .watchdog import (  # noqa: F401
+    CollectiveWatchdog, FlightRecorder, get_watchdog, watch_step,
+)

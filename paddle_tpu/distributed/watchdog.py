@@ -1,0 +1,204 @@
+"""Collective watchdog: per-step timeout detection + flight records +
+coordination-service heartbeats.
+
+Capability parity with the reference's comm watchdog
+(`paddle/phi/core/distributed/comm_task_manager.h:37` background thread,
+`nccl_comm_task.cc:234` IsTimeout, `comm_task_manager.cc:142-180`
+store-based flight records for hang diagnosis).
+
+TPU mapping: collectives live inside compiled XLA programs, so the unit of
+supervision is the STEP (one dispatched executable), not one NCCL kernel.
+The watchdog arms a timer around each watched step; if the step's outputs
+do not become ready within `FLAGS_distributed_timeout` seconds it dumps a
+diagnosis — the flight-record ring (recent steps with timings and mesh
+info), every Python thread's stack, and peer heartbeat ages — then either
+aborts the process (`fatal=True`, the reference's store-teardown analogue)
+or keeps waiting with the diagnosis logged.
+
+Heartbeats: in multi-process runs a daemon thread publishes
+`heartbeat/<rank>` through the TCPStore (or any dict-like store) every
+`interval` seconds; the timeout report shows each peer's last-seen age so
+a hang can be attributed (all peers alive = deadlock/slow collective; a
+dead peer = failed host).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import io
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+from ..core.flags import get_flags
+
+__all__ = ["FlightRecorder", "CollectiveWatchdog", "get_watchdog",
+           "watch_step"]
+
+
+class FlightRecorder:
+    """Ring buffer of recent step records (the reference's store-based
+    flight recording, comm_task_manager.cc:142)."""
+
+    def __init__(self, capacity=64):
+        self._buf = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def start(self, tag, meta=None):
+        with self._lock:
+            self._seq += 1
+            rec = {"seq": self._seq, "tag": tag, "start": time.time(),
+                   "end": None, "status": "running", **(meta or {})}
+            self._buf.append(rec)
+            return rec
+
+    def finish(self, rec, status="done"):
+        with self._lock:
+            rec["end"] = time.time()
+            rec["status"] = status
+
+    def records(self):
+        with self._lock:
+            return [dict(r) for r in self._buf]
+
+    def dump(self, file=None):
+        out = file or sys.stderr
+        now = time.time()
+        for r in self.records():
+            dur = (r["end"] or now) - r["start"]
+            print(f"  [flight {r['seq']}] {r['tag']}: {r['status']} "
+                  f"{dur:.1f}s" + (
+                      f" meta={json.dumps({k: v for k, v in r.items() if k not in ('seq', 'tag', 'start', 'end', 'status')})}"
+                      if len(r) > 5 else ""), file=out)
+
+
+class _Heartbeat(threading.Thread):
+    def __init__(self, store, rank, world, interval):
+        super().__init__(daemon=True, name="paddle-tpu-heartbeat")
+        self.store = store
+        self.rank = rank
+        self.world = world
+        self.interval = interval
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                self.store.set(f"heartbeat/{self.rank}",
+                               str(time.time()).encode())
+            except Exception:
+                pass
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+
+    def peer_ages(self):
+        ages = {}
+        now = time.time()
+        for r in range(self.world):
+            try:
+                raw = self.store.get(f"heartbeat/{r}", timeout=1)
+                ages[r] = now - float(raw.decode())
+            except Exception:
+                ages[r] = None  # never seen / unreachable
+        return ages
+
+
+class CollectiveWatchdog:
+    """Supervises watched steps; see module docstring."""
+
+    def __init__(self, timeout=None, store=None, rank=0, world=1,
+                 heartbeat_interval=10.0, fatal=False, out=None):
+        flag_timeout = get_flags("FLAGS_distributed_timeout")[
+            "FLAGS_distributed_timeout"]
+        self.timeout = float(timeout if timeout is not None
+                             else flag_timeout)
+        self.recorder = FlightRecorder()
+        self.fatal = fatal
+        self.out = out
+        self.timed_out = threading.Event()
+        self._hb = None
+        if store is not None and world > 1:
+            self._hb = _Heartbeat(store, rank, world, heartbeat_interval)
+            self._hb.start()
+
+    def close(self):
+        if self._hb is not None:
+            self._hb.stop()
+
+    # -- supervision ------------------------------------------------------
+
+    def watch(self, tag, meta=None):
+        return _Watch(self, tag, meta)
+
+    def _on_timeout(self, rec):
+        self.timed_out.set()
+        out = self.out or sys.stderr
+        print(f"\n=== paddle_tpu collective watchdog: step "
+              f"'{rec['tag']}' exceeded {self.timeout:.0f}s ===", file=out)
+        print("flight records (most recent last):", file=out)
+        self.recorder.dump(out)
+        if self._hb is not None:
+            print("peer heartbeat ages (s):", self._hb.peer_ages(),
+                  file=out)
+        print("python thread stacks:", file=out)
+        buf = io.StringIO()
+        try:
+            faulthandler.dump_traceback(file=buf)
+        except Exception:
+            pass
+        print(buf.getvalue(), file=out)
+        print("=== end watchdog report ===", file=out, flush=True)
+        if self.fatal:
+            os._exit(113)
+
+
+class _Watch:
+    def __init__(self, wd, tag, meta):
+        self.wd = wd
+        self.tag = tag
+        self.meta = meta
+        self.rec = None
+        self.timer = None
+
+    def __enter__(self):
+        self.rec = self.wd.recorder.start(self.tag, self.meta)
+        self.timer = threading.Timer(self.wd.timeout,
+                                     self.wd._on_timeout, (self.rec,))
+        self.timer.daemon = True
+        self.timer.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.timer.cancel()
+        self.wd.recorder.finish(
+            self.rec, "done" if exc_type is None else "error")
+        return False
+
+
+_global = [None]
+
+
+def get_watchdog(**kwargs):
+    """Process-global watchdog (created on first use). Pass kwargs on the
+    first call to configure; subsequent calls return the instance."""
+    if _global[0] is None:
+        _global[0] = CollectiveWatchdog(**kwargs)
+    return _global[0]
+
+
+def watch_step(tag="step", meta=None):
+    """Context manager supervising one training/eval step with the global
+    watchdog. Enabled when FLAGS_enable_collective_watchdog is on or a
+    watchdog was explicitly configured; otherwise a no-op."""
+    flags = get_flags(["FLAGS_enable_collective_watchdog"])
+    if _global[0] is None and \
+            not flags.get("FLAGS_enable_collective_watchdog"):
+        import contextlib
+        return contextlib.nullcontext()
+    return get_watchdog().watch(tag, meta)

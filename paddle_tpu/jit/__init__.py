@@ -249,9 +249,13 @@ class TrainStep:
         key = random_mod.next_key()
         batch_arrays = jax.tree.map(_tree_unwrap, batch,
                                     is_leaf=lambda x: isinstance(x, Tensor))
-        loss, aux, new_params, new_slots, new_buffers = self._jitted(
-            param_arrays, slot_states, buffer_arrays, t,
-            jnp.asarray(lr, jnp.float32), key, batch_arrays)
+        from ..distributed.watchdog import watch_step
+        with watch_step("TrainStep") as w:
+            loss, aux, new_params, new_slots, new_buffers = self._jitted(
+                param_arrays, slot_states, buffer_arrays, t,
+                jnp.asarray(lr, jnp.float32), key, batch_arrays)
+            if w is not None:  # watchdog on: surface hangs at this step
+                jax.block_until_ready(loss)
         for p, arr, st in zip(param_objs, new_params, new_slots):
             p._rebind(arr)
             opt._state[id(p)] = st
