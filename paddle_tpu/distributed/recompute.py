@@ -20,15 +20,33 @@ from ..core.tensor import Tensor
 __all__ = ["recompute", "recompute_sequential"]
 
 
+def _to_memory_kind(arr, kind):
+    try:
+        sh = arr.sharding.with_memory_kind(kind)
+    except Exception:
+        return arr
+    return jax.device_put(arr, sh)
+
+
 class _RecomputeFunction(PyLayer):
     @staticmethod
-    def forward(ctx, fn, preserve_rng, kwargs, *args):
+    def forward(ctx, fn, preserve_rng, offload, kwargs, *args):
         ctx.fn = fn
         ctx.kwargs = kwargs
         ctx.preserve_rng = preserve_rng
+        ctx.offload = offload
         if preserve_rng:
             ctx.rng_key = random_mod.default_generator().get_state()
-        ctx.inputs = args
+        if offload:
+            # recompute_hybrid.py parity: the stashed boundary activations
+            # live in host memory until the backward re-forward needs
+            # them; the forward itself still computes on the device args
+            ctx.inputs = tuple(
+                Tensor(_to_memory_kind(a._data, "pinned_host"),
+                       stop_gradient=a.stop_gradient)
+                if isinstance(a, Tensor) else a for a in args)
+        else:
+            ctx.inputs = args
         ctx.tensor_indices = [i for i, a in enumerate(args)
                               if isinstance(a, Tensor)]
         with no_grad():
@@ -42,6 +60,8 @@ class _RecomputeFunction(PyLayer):
         for a in ctx.inputs:
             if isinstance(a, Tensor):
                 d = a.detach()
+                if ctx.offload:  # fetch the stash back to device memory
+                    d = Tensor(_to_memory_kind(d._data, "device"))
                 d.stop_gradient = a.stop_gradient
                 detached.append(d)
             else:
@@ -74,10 +94,14 @@ class _RecomputeFunction(PyLayer):
 
 def recompute(function, *args, **kwargs):
     """paddle.distributed.fleet.recompute parity. ``use_reentrant`` and
-    ``preserve_rng_state`` accepted."""
+    ``preserve_rng_state`` accepted; ``offload_to_host=True`` stashes the
+    boundary activations in pinned host memory between forward and the
+    backward re-forward (reference recompute_hybrid.py offload)."""
     preserve_rng = kwargs.pop("preserve_rng_state", True)
+    offload = kwargs.pop("offload_to_host", False)
     kwargs.pop("use_reentrant", None)
-    return _RecomputeFunction.apply(function, preserve_rng, kwargs, *args)
+    return _RecomputeFunction.apply(function, preserve_rng, offload,
+                                    kwargs, *args)
 
 
 def recompute_sequential(ctx, functions, *args, **kwargs):

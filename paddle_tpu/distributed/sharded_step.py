@@ -59,7 +59,7 @@ class ShardedTrainStep(TrainStep):
 
     def __init__(self, model, optimizer, step_fn=None, mesh=None,
                  data_placements=None, shard_optimizer_axis=None,
-                 donate=True):
+                 donate=True, offload=None):
         super().__init__(model, optimizer, step_fn, donate=donate)
         assert mesh is not None, "ShardedTrainStep requires a ProcessMesh"
         self._mesh = mesh
@@ -69,6 +69,15 @@ class ShardedTrainStep(TrainStep):
         self._data_placements = data_placements
         self._opt_axis = shard_optimizer_axis
         self._slots_placed = set()
+        # CPU offload (reference group_sharded_stage3.py:85 `offload`):
+        # "os" parks optimizer slots in `pinned_host` memory between
+        # steps; "os+params" parks the (ZeRO-3-sharded) params there too.
+        # __call__ prefetches them onto their device shardings (async
+        # device_put, overlapped with batch placement) and flushes the
+        # updated state back after the step — the reference's hand-rolled
+        # CUDA-stream prefetch/flush, expressed as memory-kind transfers.
+        assert offload in (None, "os", "os+params"), offload
+        self._offload = offload
 
     def _out_shardings(self):
         """Pin updated params (and their slots) to their declared
@@ -100,15 +109,61 @@ class ShardedTrainStep(TrainStep):
                 continue
             sh = _shard_like_param(arr, p, self._mesh, self._opt_axis)
             if sh is not None:
+                if self._offload is not None:
+                    sh = sh.with_memory_kind("pinned_host")
                 st[nm] = jax.device_put(arr, sh)
         self._slots_placed.add(id(p))
         return st
+
+    def _prefetch(self):
+        """H2D: move offloaded slots (and params) onto their device
+        shardings before dispatching the step. The device_puts are async —
+        they overlap with the host-side batch placement below."""
+        if self._offload is None:
+            return
+        opt = self._opt
+        for _, p in self._params:
+            if p._dist_attr is None:
+                continue
+            st = opt._slots_for(p)
+            for nm, arr in st.items():
+                if arr is None:
+                    continue
+                sh = _shard_like_param(arr, p, self._mesh, self._opt_axis)
+                if sh is not None:
+                    st[nm] = jax.device_put(arr, sh)
+            if self._offload == "os+params":
+                pmesh, placements = p._dist_attr
+                p._rebind(jax.device_put(
+                    p._data, named_sharding(pmesh, placements, p.ndim)))
+
+    def _flush_to_host(self):
+        """D2H: park the updated slots (and params) back in pinned host
+        memory until the next step."""
+        if self._offload is None:
+            return
+        opt = self._opt
+        for _, p in self._params:
+            if p._dist_attr is None:
+                continue
+            st = opt._state.get(id(p))
+            if st:
+                for nm, arr in st.items():
+                    if arr is None or not hasattr(arr, "sharding"):
+                        continue
+                    st[nm] = jax.device_put(
+                        arr, arr.sharding.with_memory_kind("pinned_host"))
+            if self._offload == "os+params":
+                p._rebind(jax.device_put(
+                    p._data,
+                    p._data.sharding.with_memory_kind("pinned_host")))
 
     def __call__(self, *batch):
         # place params (idempotent: already committed), slots, and batch
         for _, p in self._params:
             if p._dist_attr is not None:
                 self._place_slots(p)
+        self._prefetch()
         placed = []
         for leaf in batch:
             t = leaf if isinstance(leaf, Tensor) else Tensor(leaf)
@@ -116,4 +171,6 @@ class ShardedTrainStep(TrainStep):
                                       t.ndim)
             placed.append(Tensor(jax.device_put(t._data, sharding)))
         with self._mesh.jax_mesh:
-            return super().__call__(*placed)
+            out = super().__call__(*placed)
+        self._flush_to_host()
+        return out

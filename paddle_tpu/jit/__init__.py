@@ -156,6 +156,8 @@ class TrainStep:
 
         def pure(param_arrays, slot_states, buffer_arrays, t, lr, key,
                  batch):
+            param_arrays, slot_states = self._prepare_state(
+                param_arrays, slot_states)
             restore = []
             try:
                 for p, arr in zip(param_objs, param_arrays):
@@ -230,6 +232,12 @@ class TrainStep:
     def _out_shardings(self):
         """None everywhere (XLA's choice); ShardedTrainStep pins params."""
         return None
+
+    def _prepare_state(self, param_arrays, slot_states):
+        """Hook run inside the traced step before any compute; sharded
+        subclasses use it to stream offloaded (host-memory) state onto the
+        device."""
+        return param_arrays, slot_states
 
     def __call__(self, *batch):
         if self._jitted is None:
