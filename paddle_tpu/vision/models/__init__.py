@@ -8,3 +8,6 @@ from .resnet import (  # noqa: F401
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from .vit import (  # noqa: F401
+    VisionTransformer, ViTConfig, vit_b_16, vit_h_14, vit_l_16,
+)

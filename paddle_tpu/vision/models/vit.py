@@ -1,0 +1,106 @@
+"""Vision Transformer (ViT-B/L/H), the BASELINE.json ladder's vision
+workload.
+
+Reference: the paddle ecosystem's ViT (PaddleClas `ppcls/arch/backbone/
+model_zoo/vision_transformer.py`; the in-repo reference ships the CNN zoo
+in `python/paddle/vision/models/`). TPU-first: patch embedding is one
+conv (= big MXU matmul after im2col), the encoder rides the same
+pre-LN transformer blocks XLA fuses well, bf16-friendly throughout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ... import nn
+from ...nn import functional as F
+from ... import ops
+
+__all__ = ["VisionTransformer", "ViTConfig", "vit_b_16", "vit_l_16",
+           "vit_h_14"]
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_ratio: float = 4.0
+    dropout: float = 0.0
+    attention_dropout: float = 0.0
+
+
+class _EncoderBlock(nn.Layer):
+    def __init__(self, cfg: ViTConfig):
+        super().__init__()
+        d = cfg.hidden_size
+        self.ln_1 = nn.LayerNorm(d)
+        self.self_attention = nn.MultiHeadAttention(
+            d, cfg.num_heads, dropout=cfg.attention_dropout)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.ln_2 = nn.LayerNorm(d)
+        hidden = int(d * cfg.mlp_ratio)
+        self.mlp = nn.Sequential(
+            nn.Linear(d, hidden), nn.GELU(), nn.Dropout(cfg.dropout),
+            nn.Linear(hidden, d), nn.Dropout(cfg.dropout))
+
+    def forward(self, x):
+        x = x + self.dropout(self.self_attention(self.ln_1(x)))
+        return x + self.mlp(self.ln_2(x))
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(self, config: ViTConfig = None, **kwargs):
+        super().__init__()
+        config = config or ViTConfig(**kwargs)
+        self.config = config
+        d = config.hidden_size
+        n_patches = (config.image_size // config.patch_size) ** 2
+        self.conv_proj = nn.Conv2D(3, d, config.patch_size,
+                                   stride=config.patch_size)
+        self.class_token = self.create_parameter(
+            [1, 1, d], default_initializer=nn.initializer.Constant(0.0))
+        self.pos_embedding = self.create_parameter(
+            [1, n_patches + 1, d],
+            default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        self.encoder = nn.LayerList(
+            [_EncoderBlock(config) for _ in range(config.num_layers)])
+        self.ln = nn.LayerNorm(d)
+        self.heads = nn.Linear(d, config.num_classes)
+        self.dropout = nn.Dropout(config.dropout)
+
+    def forward(self, x):
+        b = x.shape[0]
+        x = self.conv_proj(x)                      # [b, d, h', w']
+        d = self.config.hidden_size
+        x = ops.reshape(x, [b, d, -1])
+        x = ops.transpose(x, [0, 2, 1])            # [b, n_patches, d]
+        cls = ops.expand(self.class_token, [b, 1, d])
+        x = ops.concat([cls, x], axis=1)
+        x = self.dropout(x + self.pos_embedding)
+        for blk in self.encoder:
+            x = blk(x)
+        x = self.ln(x)
+        return self.heads(x[:, 0])
+
+    def loss(self, images, labels):
+        return F.cross_entropy(self(images), labels)
+
+
+def vit_b_16(**kwargs):
+    return VisionTransformer(ViTConfig(hidden_size=768, num_layers=12,
+                                       num_heads=12, **kwargs))
+
+
+def vit_l_16(**kwargs):
+    return VisionTransformer(ViTConfig(hidden_size=1024, num_layers=24,
+                                       num_heads=16, **kwargs))
+
+
+def vit_h_14(**kwargs):
+    return VisionTransformer(ViTConfig(hidden_size=1280, num_layers=32,
+                                       num_heads=16, patch_size=14,
+                                       **kwargs))

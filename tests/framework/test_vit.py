@@ -1,0 +1,108 @@
+"""VisionTransformer: forward shapes, training step, torch oracle.
+
+The BASELINE.json ladder's vision workload (ViT-L); reference CNN zoo
+lives in python/paddle/vision/models/, ViT in the paddle ecosystem
+(PaddleClas vision_transformer.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.vision.models import (VisionTransformer, ViTConfig,
+                                      vit_b_16, vit_l_16)
+
+
+def _tiny():
+    return ViTConfig(image_size=32, patch_size=8, hidden_size=64,
+                     num_layers=2, num_heads=4, num_classes=10)
+
+
+def test_forward_shapes():
+    paddle.seed(0)
+    m = VisionTransformer(_tiny())
+    x = paddle.to_tensor(np.random.randn(3, 3, 32, 32).astype("float32"))
+    assert m(x).shape == [3, 10]
+
+
+def test_presets_configs():
+    assert vit_b_16.__call__ is not None
+    b = vit_b_16(num_classes=10, image_size=32, patch_size=16)
+    assert b.config.hidden_size == 768 and b.config.num_layers == 12
+    l = vit_l_16(num_classes=10, image_size=32, patch_size=16)
+    assert l.config.hidden_size == 1024 and l.config.num_layers == 24
+
+
+def test_train_step_loss_decreases():
+    paddle.seed(1)
+    m = VisionTransformer(_tiny())
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, opt, lambda mm, x, y: mm.loss(x, y))
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 3, 32, 32)).astype(
+        "float32"))
+    y = paddle.to_tensor(rng.integers(0, 10, (8,)).astype("int64"))
+    losses = [float(step(x, y)) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_matches_torch_oracle():
+    """One encoder block + patchify pipeline vs a hand-rolled torch
+    reference with copied weights."""
+    torch = pytest.importorskip("torch")
+    paddle.seed(2)
+    cfg = _tiny()
+    m = VisionTransformer(cfg)
+    m.eval()
+    rng = np.random.default_rng(3)
+    x_np = rng.standard_normal((2, 3, 32, 32)).astype("float32")
+
+    out = m(paddle.to_tensor(x_np)).numpy()
+
+    # torch replica
+    d, heads = cfg.hidden_size, cfg.num_heads
+    conv = torch.nn.Conv2d(3, d, cfg.patch_size, stride=cfg.patch_size)
+    conv.weight.data = torch.tensor(
+        np.transpose(m.conv_proj.weight.numpy(), (0, 1, 2, 3)))
+    conv.bias.data = torch.tensor(m.conv_proj.bias.numpy())
+    xt = conv(torch.tensor(x_np))                       # [b, d, h, w]
+    xt = xt.flatten(2).transpose(1, 2)                  # [b, n, d]
+    cls = torch.tensor(m.class_token.numpy()).expand(2, 1, d)
+    xt = torch.cat([cls, xt], 1) + torch.tensor(m.pos_embedding.numpy())
+    for blk in m.encoder:
+        ln1 = torch.nn.functional.layer_norm(
+            xt, (d,), torch.tensor(blk.ln_1.weight.numpy()),
+            torch.tensor(blk.ln_1.bias.numpy()))
+        attn = blk.self_attention
+        q = ln1 @ torch.tensor(attn.q_proj.weight.numpy()) + \
+            torch.tensor(attn.q_proj.bias.numpy())
+        k = ln1 @ torch.tensor(attn.k_proj.weight.numpy()) + \
+            torch.tensor(attn.k_proj.bias.numpy())
+        v = ln1 @ torch.tensor(attn.v_proj.weight.numpy()) + \
+            torch.tensor(attn.v_proj.bias.numpy())
+        b, n, _ = q.shape
+        hd = d // heads
+        q = q.view(b, n, heads, hd).transpose(1, 2)
+        k = k.view(b, n, heads, hd).transpose(1, 2)
+        v = v.view(b, n, heads, hd).transpose(1, 2)
+        a = torch.softmax(q @ k.transpose(-1, -2) / hd ** 0.5, -1)
+        o = (a @ v).transpose(1, 2).reshape(b, n, d)
+        o = o @ torch.tensor(attn.out_proj.weight.numpy()) + \
+            torch.tensor(attn.out_proj.bias.numpy())
+        xt = xt + o
+        ln2 = torch.nn.functional.layer_norm(
+            xt, (d,), torch.tensor(blk.ln_2.weight.numpy()),
+            torch.tensor(blk.ln_2.bias.numpy()))
+        h = ln2 @ torch.tensor(blk.mlp[0].weight.numpy()) + \
+            torch.tensor(blk.mlp[0].bias.numpy())
+        h = torch.nn.functional.gelu(h)
+        h = h @ torch.tensor(blk.mlp[3].weight.numpy()) + \
+            torch.tensor(blk.mlp[3].bias.numpy())
+        xt = xt + h
+    xt = torch.nn.functional.layer_norm(
+        xt, (d,), torch.tensor(m.ln.weight.numpy()),
+        torch.tensor(m.ln.bias.numpy()))
+    ref = (xt[:, 0] @ torch.tensor(m.heads.weight.numpy()) +
+           torch.tensor(m.heads.bias.numpy())).detach().numpy()
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
