@@ -182,17 +182,21 @@ def _adaptive(n, x, output_size, mode, name):
         spatial = a.shape[2:]
         res = a
         if all(o is None or s % o == 0 for s, o in zip(spatial, out_sizes)):
+            # even bins: reshape each spatial dim to (out, kernel) and
+            # reduce the kernel axes — differentiable (reduce_window with a
+            # generic computation has no reverse-mode rule) and XLA fuses
+            # the reshape+reduce into one pass
             kernel = tuple(1 if o is None else s // o
                            for s, o in zip(spatial, out_sizes))
-            window = (1, 1) + kernel
+            shape = list(a.shape[:2])
+            red_axes = []
+            for dim, (s, k) in enumerate(zip(spatial, kernel)):
+                shape.extend([s // k, k])
+                red_axes.append(2 + 2 * dim + 1)
+            res = res.reshape(shape)
             if mode == "avg":
-                out = lax.reduce_window(res, jnp.asarray(0, a.dtype), lax.add,
-                                        window, window,
-                                        [(0, 0)] * (n + 2))
-                return out / jnp.asarray(np.prod(kernel), a.dtype)
-            return lax.reduce_window(res, jnp.asarray(-np.inf, a.dtype),
-                                     lax.max, window, window,
-                                     [(0, 0)] * (n + 2))
+                return jnp.mean(res, axis=tuple(red_axes))
+            return jnp.max(res, axis=tuple(red_axes))
         # uneven bins: gather each bin (static python loop — small outputs)
         for dim in range(n):
             s = res.shape[2 + dim]

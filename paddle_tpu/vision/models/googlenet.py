@@ -1,0 +1,96 @@
+"""GoogLeNet / Inception-v1 (reference: python/paddle/vision/models/
+googlenet.py API — forward returns (out, aux1, aux2) like the
+reference)."""
+
+from __future__ import annotations
+
+from ... import nn, ops
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+def _conv_bn(in_ch, out_ch, k, stride=1, padding=0):
+    return nn.Sequential(
+        nn.Conv2D(in_ch, out_ch, k, stride=stride, padding=padding),
+        nn.BatchNorm2D(out_ch), nn.ReLU())
+
+
+class _Inception(nn.Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = _conv_bn(in_ch, c1, 1)
+        self.b2 = nn.Sequential(_conv_bn(in_ch, c3r, 1),
+                                _conv_bn(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_conv_bn(in_ch, c5r, 1),
+                                _conv_bn(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, 1),
+                                _conv_bn(in_ch, pp, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b2(x), self.b3(x),
+                           self.b4(x)], axis=1)
+
+
+class _AuxHead(nn.Layer):
+    def __init__(self, in_ch, num_classes):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(4)
+        self.conv = _conv_bn(in_ch, 128, 1)
+        self.fc1 = nn.Linear(128 * 16, 1024)
+        self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = self.relu(self.fc1(ops.flatten(x, 1)))
+        return self.fc2(self.dropout(x))
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _conv_bn(3, 64, 7, stride=2, padding=3), nn.MaxPool2D(3, 2, 1),
+            _conv_bn(64, 64, 1), _conv_bn(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, 2, 1))
+        self.inc3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, 2, 1)
+        self.inc4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, 2, 1)
+        self.inc5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.aux1 = _AuxHead(512, num_classes)
+        self.aux2 = _AuxHead(528, num_classes)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.4)
+        if num_classes > 0:
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.inc3b(self.inc3a(x)))
+        x = self.inc4a(x)
+        a1 = self.aux1(x)
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        a2 = self.aux2(x)
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        x = self.dropout(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x, a1, a2
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

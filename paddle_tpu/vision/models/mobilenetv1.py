@@ -1,0 +1,51 @@
+"""MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py
+API). Depthwise-separable convs — depthwise = grouped conv, XLA maps it
+onto the VPU; pointwise 1x1 hits the MXU."""
+
+from __future__ import annotations
+
+from ... import nn, ops
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+def _dw_sep(in_ch, out_ch, stride):
+    return nn.Sequential(
+        nn.Conv2D(in_ch, in_ch, 3, stride=stride, padding=1,
+                  groups=in_ch, bias_attr=False),
+        nn.BatchNorm2D(in_ch), nn.ReLU(),
+        nn.Conv2D(in_ch, out_ch, 1, bias_attr=False),
+        nn.BatchNorm2D(out_ch), nn.ReLU())
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        c = lambda ch: max(int(ch * scale), 8)  # noqa: E731
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [nn.Conv2D(3, c(32), 3, stride=2, padding=1,
+                            bias_attr=False),
+                  nn.BatchNorm2D(c(32)), nn.ReLU()]
+        for in_ch, out_ch, s in cfg:
+            layers.append(_dw_sep(c(in_ch), c(out_ch), s))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

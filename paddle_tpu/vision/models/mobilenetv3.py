@@ -1,0 +1,130 @@
+"""MobileNetV3 small/large (reference: python/paddle/vision/models/
+mobilenetv3.py API): inverted residuals + squeeze-excite + hardswish."""
+
+from __future__ import annotations
+
+from ... import nn, ops
+from ...nn import functional as F
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, ch, squeeze_ch):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, squeeze_ch, 1)
+        self.fc2 = nn.Conv2D(squeeze_ch, ch, 1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        s = self.relu(self.fc1(self.pool(x)))
+        return x * F.hardsigmoid(self.fc2(s))
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_ch, exp_ch, out_ch, k, stride, use_se, use_hs):
+        super().__init__()
+        self.use_res = stride == 1 and in_ch == out_ch
+        act = nn.Hardswish() if use_hs else nn.ReLU()
+        layers = []
+        if exp_ch != in_ch:
+            layers += [nn.Conv2D(in_ch, exp_ch, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp_ch), act]
+        layers += [nn.Conv2D(exp_ch, exp_ch, k, stride=stride,
+                             padding=k // 2, groups=exp_ch,
+                             bias_attr=False),
+                   nn.BatchNorm2D(exp_ch), act]
+        if use_se:
+            layers.append(_SqueezeExcite(exp_ch,
+                                         _make_divisible(exp_ch // 4)))
+        layers += [nn.Conv2D(exp_ch, out_ch, 1, bias_attr=False),
+                   nn.BatchNorm2D(out_ch)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, expanded, out, use_se, use_hs, stride)
+_LARGE = [
+    (3, 16, 16, False, False, 1), (3, 64, 24, False, False, 2),
+    (3, 72, 24, False, False, 1), (5, 72, 40, True, False, 2),
+    (5, 120, 40, True, False, 1), (5, 120, 40, True, False, 1),
+    (3, 240, 80, False, True, 2), (3, 200, 80, False, True, 1),
+    (3, 184, 80, False, True, 1), (3, 184, 80, False, True, 1),
+    (3, 480, 112, True, True, 1), (3, 672, 112, True, True, 1),
+    (5, 672, 160, True, True, 2), (5, 960, 160, True, True, 1),
+    (5, 960, 160, True, True, 1),
+]
+_SMALL = [
+    (3, 16, 16, True, False, 2), (3, 72, 24, False, False, 2),
+    (3, 88, 24, False, False, 1), (5, 96, 40, True, True, 2),
+    (5, 240, 40, True, True, 1), (5, 240, 40, True, True, 1),
+    (5, 120, 48, True, True, 1), (5, 144, 48, True, True, 1),
+    (5, 288, 96, True, True, 2), (5, 576, 96, True, True, 1),
+    (5, 576, 96, True, True, 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_ch, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        ch = _make_divisible(16 * scale)
+        layers = [nn.Conv2D(3, ch, 3, stride=2, padding=1,
+                            bias_attr=False),
+                  nn.BatchNorm2D(ch), nn.Hardswish()]
+        for k, exp, out, se, hs, s in cfg:
+            exp_ch = _make_divisible(exp * scale)
+            out_ch = _make_divisible(out * scale)
+            layers.append(_InvertedResidual(ch, exp_ch, out_ch, k, s,
+                                            se, hs))
+            ch = out_ch
+        final = _make_divisible(cfg[-1][1] * scale)
+        layers += [nn.Conv2D(ch, final, 1, bias_attr=False),
+                   nn.BatchNorm2D(final), nn.Hardswish()]
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(final, last_ch), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(ops.flatten(x, 1))
+        return x
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)

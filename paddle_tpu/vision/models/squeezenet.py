@@ -1,0 +1,66 @@
+"""SqueezeNet 1.0/1.1 (reference: python/paddle/vision/models/
+squeezenet.py API)."""
+
+from __future__ import annotations
+
+from ... import nn, ops
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_ch, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_ch, squeeze, 1)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        s = self.relu(self.squeeze(x))
+        return ops.concat([self.relu(self.expand1(s)),
+                           self.relu(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        relu = nn.ReLU()
+        pool = lambda: nn.MaxPool2D(3, 2, ceil_mode=True)  # noqa: E731
+        if version == "1.0":
+            feats = [nn.Conv2D(3, 96, 7, stride=2), relu, pool(),
+                     _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                     _Fire(128, 32, 128, 128), pool(),
+                     _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                     _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                     pool(), _Fire(512, 64, 256, 256)]
+        else:
+            feats = [nn.Conv2D(3, 64, 3, stride=2), relu, pool(),
+                     _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64), pool(),
+                     _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                     pool(), _Fire(256, 48, 192, 192),
+                     _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                     _Fire(512, 64, 256, 256)]
+        self.features = nn.Sequential(*feats)
+        self.dropout = nn.Dropout(0.5)
+        self.final_conv = nn.Conv2D(512, num_classes, 1)
+        self.relu = nn.ReLU()
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = self.relu(self.final_conv(self.dropout(x)))
+        if self.with_pool:
+            x = self.avgpool(x)
+        return ops.flatten(x, 1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet("1.1", **kwargs)

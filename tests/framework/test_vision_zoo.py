@@ -1,0 +1,78 @@
+"""CNN model zoo forward-shape + train smoke tests.
+
+Covers the reference zoo surface (python/paddle/vision/models/__init__.py)
+added beyond round 1: densenet, googlenet, inception_v3, mobilenet v1/v3,
+shufflenet_v2 (+swish), squeezenet, resnext/wide-resnet variants.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+
+def _x(n=1, size=64):
+    return paddle.to_tensor(
+        np.random.default_rng(0).standard_normal(
+            (n, 3, size, size)).astype("float32"))
+
+
+@pytest.mark.parametrize("factory,kwargs", [
+    (M.densenet121, {}),
+    (M.mobilenet_v1, {"scale": 0.25}),
+    (M.mobilenet_v3_small, {"scale": 0.5}),
+    (M.mobilenet_v3_large, {"scale": 0.5}),
+    (M.shufflenet_v2_x0_25, {}),
+    (M.shufflenet_v2_swish, {}),
+    (M.squeezenet1_0, {}),
+    (M.squeezenet1_1, {}),
+    (M.resnext50_32x4d, {}),
+])
+def test_forward_shape(factory, kwargs):
+    paddle.seed(0)
+    m = factory(num_classes=10, **kwargs)
+    m.eval()
+    out = m(_x())
+    assert out.shape == [1, 10]
+
+
+def test_googlenet_aux_heads():
+    paddle.seed(0)
+    m = M.googlenet(num_classes=10)
+    m.eval()
+    out, a1, a2 = m(_x())
+    assert out.shape == [1, 10] and a1.shape == [1, 10] \
+        and a2.shape == [1, 10]
+
+
+def test_inception_v3_shape():
+    paddle.seed(0)
+    m = M.inception_v3(num_classes=10)
+    m.eval()
+    out = m(_x(size=299))
+    assert out.shape == [1, 10]
+
+
+def test_densenet_variant_channels():
+    # densenet161 uses growth 48 / init 96: distinct classifier width
+    m121 = M.densenet121(num_classes=1)
+    m161 = M.densenet161(num_classes=1)
+    assert m121.classifier.weight.shape[0] == 1024
+    assert m161.classifier.weight.shape[0] == 2208
+
+
+def test_zoo_trains():
+    paddle.seed(1)
+    from paddle_tpu import nn, optimizer
+
+    m = M.mobilenet_v3_small(scale=0.35, num_classes=4)
+    opt = optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    step = paddle.jit.TrainStep(
+        m, opt, lambda mm, x, y: paddle.nn.functional.cross_entropy(
+            mm(x), y))
+    x = _x(8, 32)
+    y = paddle.to_tensor(np.random.default_rng(1).integers(
+        0, 4, (8,)).astype("int64"))
+    losses = [float(step(x, y)) for _ in range(6)]
+    assert losses[-1] < losses[0]
