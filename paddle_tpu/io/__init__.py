@@ -1,19 +1,24 @@
 """`paddle.io`: datasets and DataLoader.
 
 Parity: reference python/paddle/io/ — Dataset/IterableDataset,
-`DataLoader` (reader.py:266) with worker processes, BatchSampler,
-DistributedBatchSampler, pin-memory. TPU-first: workers are threads
-feeding a host-side prefetch queue (host→HBM transfer is the pipeline
-stage that matters on TPU; jax arrays are device-committed on first use,
-and double-buffering hides the transfer under the previous step — the
-stream-overlap the reference gets from pinned memory + CUDA streams).
+`DataLoader` (reader.py:266) with worker PROCESSES
+(dataloader/dataloader_iter.py: _DataLoaderIterMultiProcess), BatchSampler,
+DistributedBatchSampler, pin-memory. TPU-first: num_workers>0 forks OS
+worker processes so heavy Python transforms run off the GIL; workers ship
+numpy over the result queue (they never touch jax — forking a process
+with a live TPU backend deadlocks) and the parent converts to Tensors, so
+the host→HBM transfer overlaps the previous step exactly like the
+reference's pinned-memory + CUDA-stream pipeline.
 """
 
 from __future__ import annotations
 
 import itertools
+import multiprocessing as mp
+import os
 import queue as queue_mod
 import threading
+import traceback
 
 import numpy as np
 
@@ -263,9 +268,12 @@ class _WorkerInfo:
 
 
 _worker_info = threading.local()
+_mp_worker_info = [None]  # set in forked worker processes
 
 
 def get_worker_info():
+    if _mp_worker_info[0] is not None:
+        return _mp_worker_info[0]
     return getattr(_worker_info, "info", None)
 
 
@@ -298,12 +306,16 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_process_workers=True):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        # process workers (reference behavior) by default; threads remain
+        # as an explicit opt-out for un-forkable setups
+        self.use_process_workers = use_process_workers
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_size = batch_size
@@ -377,9 +389,95 @@ class DataLoader:
             yield hold.pop(next_seq)
             next_seq += 1
 
+    # -- multiprocess workers (reference dataloader_iter.py) ---------------
+
+    @staticmethod
+    def _np_leaves(obj):
+        """Tensor leaves -> numpy for the cross-process pipe."""
+        if isinstance(obj, Tensor):
+            return np.asarray(obj.numpy())
+        if isinstance(obj, (tuple, list)):
+            return [DataLoader._np_leaves(o) for o in obj]
+        if isinstance(obj, dict):
+            return {k: DataLoader._np_leaves(v) for k, v in obj.items()}
+        return obj
+
+    @staticmethod
+    def _tensor_leaves(obj):
+        if isinstance(obj, np.ndarray):
+            return Tensor(obj)
+        if isinstance(obj, (tuple, list)):
+            return [DataLoader._tensor_leaves(o) for o in obj]
+        if isinstance(obj, dict):
+            return {k: DataLoader._tensor_leaves(v) for k, v in obj.items()}
+        return obj
+
+    def _worker_loop(self, wid, index_q, result_q):
+        _mp_worker_info[0] = _WorkerInfo(wid, self.num_workers,
+                                         self.dataset)
+        if self.worker_init_fn is not None:
+            self.worker_init_fn(wid)
+        collate = self.collate_fn
+        while True:
+            job = index_q.get()
+            if job is None:
+                result_q.put(("done", wid, None))
+                return
+            seq, indices = job
+            try:
+                batch = collate([self.dataset[i] for i in indices])
+                result_q.put(("ok", seq, self._np_leaves(batch)))
+            except Exception:
+                result_q.put(("error", seq, traceback.format_exc()))
+                return
+
+    def _iter_multiprocess(self):
+        ctx = mp.get_context("fork")
+        index_q = ctx.Queue()
+        result_q = ctx.Queue()
+        procs = [ctx.Process(target=self._worker_loop,
+                             args=(w, index_q, result_q), daemon=True)
+                 for w in range(self.num_workers)]
+        for p in procs:
+            p.start()
+        n_batches = 0
+        for seq, indices in enumerate(iter(self.batch_sampler)):
+            index_q.put((seq, list(indices)))
+            n_batches += 1
+        for _ in procs:
+            index_q.put(None)
+        timeout = self.timeout or None
+        try:
+            done, next_seq, hold = 0, 0, {}
+            received = 0
+            while received < n_batches and done < self.num_workers:
+                kind, seq, payload = result_q.get(timeout=timeout)
+                if kind == "done":
+                    done += 1
+                    continue
+                if kind == "error":
+                    raise RuntimeError(
+                        f"DataLoader worker failed:\n{payload}")
+                received += 1
+                hold[seq] = payload
+                while next_seq in hold:  # sampler-order delivery
+                    yield self._tensor_leaves(hold.pop(next_seq))
+                    next_seq += 1
+            while next_seq in hold:
+                yield self._tensor_leaves(hold.pop(next_seq))
+                next_seq += 1
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+
     def __iter__(self):
         if self._iterable:
             return self._iter_iterable()
         if self.num_workers and self.num_workers > 0:
+            if self.use_process_workers and hasattr(os, "fork"):
+                return self._iter_multiprocess()
             return self._iter_threaded()
         return self._iter_map()
