@@ -129,6 +129,22 @@ def set_mesh(mesh):
     return mesh
 
 
-def auto_parallel_rank_in_mesh(mesh, axis):
-    """Host-side coordinate lookup (single-controller: informational)."""
-    return 0
+def auto_parallel_rank_in_mesh(mesh, axis, process_id=None):
+    """This process's coordinate along ``axis`` in the mesh (reference
+    HybridCommunicateGroup rank-in-group, topology.py:178).
+
+    ``process_id`` defaults to the calling process's first addressable
+    device's position in the mesh (single-controller: each jax process
+    owns a contiguous block of mesh devices)."""
+    import numpy as np
+
+    jm = mesh.jax_mesh
+    axis_idx = mesh.dim_names.index(axis) if isinstance(axis, str) else axis
+    if process_id is None:
+        import jax
+
+        local = jax.local_devices()[0]
+        flat = list(np.ravel(jm.devices))
+        process_id = flat.index(local) if local in flat else 0
+    coords = np.unravel_index(process_id, jm.devices.shape)
+    return int(coords[axis_idx])

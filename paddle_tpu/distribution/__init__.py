@@ -14,7 +14,14 @@ from ..core.tensor import Tensor
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Beta", "Dirichlet", "Exponential", "Gamma", "Laplace",
-           "Multinomial", "kl_divergence"]
+           "Multinomial", "kl_divergence",
+           # long tail (distribution/extra.py)
+           "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli",
+           "Geometric", "Gumbel", "Independent", "LKJCholesky",
+           "LogNormal", "MultivariateNormal", "Poisson", "StudentT",
+           "Transform", "AffineTransform", "ExpTransform",
+           "PowerTransform", "SigmoidTransform", "TanhTransform",
+           "ChainTransform", "TransformedDistribution"]
 
 
 def _t(x):
@@ -302,3 +309,6 @@ def kl_divergence(p, q):
                              jnp.log(jnp.maximum(1 - b, eps))))
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+from .extra import *  # noqa: F401,F403,E402

@@ -1,0 +1,530 @@
+"""Long-tail distribution families + transforms.
+
+Reference: python/paddle/distribution/ (binomial.py, cauchy.py, chi2.py,
+continuous_bernoulli.py, geometric.py, gumbel.py, independent.py,
+lognormal.py, multivariate_normal.py, poisson.py, student_t.py,
+lkj_cholesky.py, transform.py, transformed_distribution.py).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import unwrap
+from ..core.random import next_key
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli", "Geometric",
+    "Gumbel", "Independent", "LKJCholesky", "LogNormal",
+    "MultivariateNormal", "Poisson", "StudentT", "Transform",
+    "AffineTransform", "ExpTransform", "PowerTransform",
+    "SigmoidTransform", "TanhTransform", "ChainTransform",
+    "TransformedDistribution",
+]
+
+
+def _t(x):
+    return Tensor(x)
+
+
+def _arr(x, dtype=jnp.float32):
+    return jnp.asarray(unwrap(x), dtype)
+
+
+def _lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+from . import Distribution, Normal  # noqa: E402  (shares the base class)
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _arr(total_count)
+        self.probs = _arr(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return _t(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _t(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        n = jnp.broadcast_to(self.total_count, shape)
+        p = jnp.broadcast_to(self.probs, shape)
+        out = jax.random.binomial(next_key(), n, p, shape=shape)
+        return _t(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        k = _arr(value)
+        n, p = self.total_count, self.probs
+        logc = _lgamma(n + 1) - _lgamma(k + 1) - _lgamma(n - k + 1)
+        return _t(logc + k * jnp.log(p) + (n - k) * jnp.log1p(-p))
+
+    def entropy(self):
+        # gaussian-ish analytic surrogate is inexact; sum the pmf support
+        # only for scalar small n, else use 0.5*log(2*pi*e*npq)
+        npq = self.total_count * self.probs * (1 - self.probs)
+        return _t(0.5 * jnp.log(2 * math.pi * math.e * npq))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(self.loc + self.scale *
+                  jax.random.cauchy(next_key(), shape))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _t(-jnp.log(math.pi * self.scale * (1 + z * z)))
+
+    def cdf(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _t(jnp.arctan(z) / math.pi + 0.5)
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                   self.batch_shape))
+
+
+class Chi2(Distribution):
+    def __init__(self, df, name=None):
+        self.df = _arr(df)
+        super().__init__(self.df.shape)
+
+    @property
+    def mean(self):
+        return _t(self.df)
+
+    @property
+    def variance(self):
+        return _t(2 * self.df)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        g = jax.random.gamma(next_key(),
+                             jnp.broadcast_to(self.df / 2, shape))
+        return _t(2 * g)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        k2 = self.df / 2
+        return _t((k2 - 1) * jnp.log(v) - v / 2 - k2 * math.log(2.0)
+                  - _lgamma(k2))
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _arr(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _log_norm(self):
+        p = self.probs
+        # C(p) = 2*atanh(1-2p)/(1-2p), with the p->1/2 limit of log(2)
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near, 0.25, p)
+        c = 2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe)
+        return jnp.where(near, jnp.log(2.0), jnp.log(jnp.abs(c)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = self.probs
+        return _t(v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                  + self._log_norm())
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape,
+                               minval=1e-6, maxval=1 - 1e-6)
+        p = jnp.broadcast_to(self.probs, shape)
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near, 0.25, p)
+        # inverse cdf for p != 1/2
+        icdf = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                / (jnp.log(safe) - jnp.log1p(-safe)))
+        return _t(jnp.where(near, u, icdf))
+
+
+class Geometric(Distribution):
+    """Trials-until-first-success on support {0, 1, 2, ...} (reference
+    geometric.py)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _t((1 - self.probs) / self.probs)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(next_key(), shape, minval=1e-9,
+                               maxval=1.0)
+        return _t(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        k = _arr(value)
+        return _t(k * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def entropy(self):
+        p = self.probs
+        return _t(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _t(self.loc + self.scale * np_euler)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(self.loc + self.scale *
+                  jax.random.gumbel(next_key(), shape))
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _t(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(jnp.log(self.scale) + 1 + np_euler,
+                                   self.batch_shape))
+
+
+np_euler = 0.5772156649015329
+
+
+class Independent(Distribution):
+    """Reinterprets trailing batch dims as event dims (reference
+    independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = unwrap(self.base.log_prob(value))
+        return _t(jnp.sum(lp, axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        e = unwrap(self.base.entropy())
+        return _t(jnp.sum(e, axis=tuple(range(-self.rank, 0))))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return _t(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return _t((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return _t(jnp.exp(self.loc + self.scale *
+                          jax.random.normal(next_key(), shape)))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logv = jnp.log(v)
+        return _t(-((logv - self.loc) ** 2) / (2 * self.scale ** 2)
+                  - logv - jnp.log(self.scale)
+                  - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _t(self.loc + 0.5 + 0.5 * jnp.log(
+            2 * math.pi * self.scale ** 2))
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _arr(loc)
+        if scale_tril is not None:
+            self.scale_tril = _arr(scale_tril)
+        else:
+            self.scale_tril = jnp.linalg.cholesky(_arr(covariance_matrix))
+        d = self.loc.shape[-1]
+        super().__init__(self.loc.shape[:-1], (d,))
+
+    @property
+    def mean(self):
+        return _t(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return _t(self.scale_tril @ jnp.swapaxes(self.scale_tril, -1, -2))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(next_key(), shape)
+        return _t(self.loc + jnp.einsum("...ij,...j->...i",
+                                        self.scale_tril, eps))
+
+    def log_prob(self, value):
+        d = self.event_shape[0]
+        diff = _arr(value) - self.loc
+        sol = jax.scipy.linalg.solve_triangular(
+            self.scale_tril, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(sol ** 2, -1)
+        logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self.scale_tril, axis1=-2, axis2=-1)), -1)
+        return _t(-0.5 * (maha + d * math.log(2 * math.pi)) - logdet)
+
+    def entropy(self):
+        d = self.event_shape[0]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self.scale_tril, axis1=-2, axis2=-1)), -1)
+        return _t(0.5 * d * (1 + math.log(2 * math.pi)) + logdet)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _t(self.rate)
+
+    @property
+    def variance(self):
+        return _t(self.rate)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        out = jax.random.poisson(next_key(),
+                                 jnp.broadcast_to(self.rate, shape))
+        return _t(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        k = _arr(value)
+        return _t(k * jnp.log(self.rate) - self.rate - _lgamma(k + 1))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        t = jax.random.t(next_key(), jnp.broadcast_to(self.df, shape),
+                         shape)
+        return _t(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        nu = self.df
+        return _t(_lgamma((nu + 1) / 2) - _lgamma(nu / 2)
+                  - 0.5 * jnp.log(nu * math.pi) - jnp.log(self.scale)
+                  - (nu + 1) / 2 * jnp.log1p(z * z / nu))
+
+
+class LKJCholesky(Distribution):
+    """Cholesky factors of LKJ-distributed correlation matrices
+    (reference lkj_cholesky.py; onion-method sampler)."""
+
+    def __init__(self, dim, concentration=1.0, name=None):
+        self.dim = int(dim)
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape,
+                         (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        d = self.dim
+        eta = float(jnp.reshape(self.concentration, (-1,))[0])
+        shape = tuple(shape)
+        key = next_key()
+        # onion method: build the cholesky row by row
+        L = jnp.zeros(shape + (d, d), jnp.float32)
+        L = L.at[..., 0, 0].set(1.0)
+        beta = eta + (d - 2) / 2
+        for i in range(1, d):
+            key, k1, k2 = jax.random.split(key, 3)
+            y = jax.random.beta(k1, jnp.float32(i / 2), jnp.float32(beta),
+                                shape, dtype=jnp.float32)
+            beta = beta - 0.5
+            u = jax.random.normal(k2, shape + (i,), jnp.float32)
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(y)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.maximum(1 - y, 1e-12)))
+        return _t(L)
+
+    def log_prob(self, value):
+        L = _arr(value)
+        d = self.dim
+        eta = self.concentration
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        orders = jnp.arange(d - 1, 0, -1, dtype=jnp.float32)
+        unnorm = jnp.sum((2 * (eta[..., None] - 1) + d - 1 - orders)
+                         * jnp.log(diag), -1)
+        # normalization (reference lkj_cholesky.py closed form)
+        alpha = eta + (d - 1) / 2.0
+        k = jnp.arange(1, d, dtype=jnp.float32)
+        norm = jnp.sum(
+            0.5 * k * math.log(math.pi)
+            + _lgamma(alpha - k / 2.0) - _lgamma(alpha), -1)
+        return _t(unnorm - norm)
+
+
+# ---------------------------------------------------------------------------
+# transforms (reference transform.py) + TransformedDistribution
+# ---------------------------------------------------------------------------
+
+class Transform:
+    def forward(self, x):
+        return _t(self._fwd(_arr(x)))
+
+    def inverse(self, y):
+        return _t(self._inv(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(self._fldj(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return _t(-self._fldj(self._inv(_arr(y))))
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _fwd(self, x):
+        return self.loc + self.scale * x
+
+    def _inv(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _fwd(self, x):
+        return jnp.exp(x)
+
+    def _inv(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _fwd(self, x):
+        return jnp.power(x, self.power)
+
+    def _inv(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _fwd(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inv(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _fwd(self, x):
+        return jnp.tanh(x)
+
+    def _inv(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _fwd(self, x):
+        for t in self.transforms:
+            x = t._fwd(x)
+        return x
+
+    def _inv(self, y):
+        for t in reversed(self.transforms):
+            y = t._inv(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._fwd(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(transforms) \
+            if len(transforms) > 1 else transforms[0]
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = unwrap(self.base.sample(shape))
+        return _t(self.transform._fwd(x))
+
+    def log_prob(self, value):
+        y = _arr(value)
+        x = self.transform._inv(y)
+        base_lp = unwrap(self.base.log_prob(_t(x)))
+        return _t(base_lp - self.transform._fldj(x))

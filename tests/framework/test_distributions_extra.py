@@ -1,0 +1,130 @@
+"""Long-tail distribution families vs scipy oracles.
+
+Reference: python/paddle/distribution/ per-family test files
+(test/distribution/test_distribution_*.py: log_prob vs scipy, sample
+moments)."""
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(7)
+
+
+def _lp(dist, v):
+    return float(dist.log_prob(paddle.to_tensor(np.float32(v))))
+
+
+def test_log_probs_match_scipy():
+    assert abs(_lp(D.Cauchy(0.5, 2.0), 1.3)
+               - st.cauchy(0.5, 2.0).logpdf(1.3)) < 1e-4
+    assert abs(_lp(D.Chi2(np.float32(3.0)), 2.1)
+               - st.chi2(3.0).logpdf(2.1)) < 1e-4
+    assert abs(_lp(D.Gumbel(1.0, 2.0), 0.7)
+               - st.gumbel_r(1.0, 2.0).logpdf(0.7)) < 1e-4
+    assert abs(_lp(D.LogNormal(0.2, 0.9), 1.4)
+               - st.lognorm(0.9, scale=np.exp(0.2)).logpdf(1.4)) < 1e-4
+    assert abs(_lp(D.Poisson(np.float32(3.5)), 2.0)
+               - st.poisson(3.5).logpmf(2)) < 1e-4
+    assert abs(_lp(D.StudentT(np.float32(5.0)), 0.3)
+               - st.t(5.0).logpdf(0.3)) < 1e-4
+    # support {0,1,...} like paddle (scipy geom is 1-based)
+    assert abs(_lp(D.Geometric(np.float32(0.3)), 4.0)
+               - st.geom(0.3, loc=-1).logpmf(4)) < 1e-4
+    assert abs(_lp(D.Binomial(np.float32(10), np.float32(0.4)), 3.0)
+               - st.binom(10, 0.4).logpmf(3)) < 1e-4
+
+
+def test_sample_moments():
+    n = 20000
+    s = np.asarray(D.Gumbel(1.0, 2.0).sample((n,))._data)
+    assert abs(s.mean() - st.gumbel_r(1.0, 2.0).mean()) < 0.1
+    s = np.asarray(D.Poisson(np.float32(4.0)).sample((n,))._data)
+    assert abs(s.mean() - 4.0) < 0.1
+    s = np.asarray(D.Chi2(np.float32(5.0)).sample((n,))._data)
+    assert abs(s.mean() - 5.0) < 0.15
+    s = np.asarray(D.Geometric(np.float32(0.25)).sample((n,))._data)
+    assert abs(s.mean() - 3.0) < 0.15  # (1-p)/p
+
+
+def test_multivariate_normal():
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+    mvn = D.MultivariateNormal(np.zeros(2, np.float32),
+                               covariance_matrix=cov)
+    v = np.array([0.3, -0.7], np.float32)
+    want = st.multivariate_normal(np.zeros(2), cov).logpdf(v)
+    assert abs(float(mvn.log_prob(paddle.to_tensor(v))) - want) < 1e-4
+    s = np.asarray(mvn.sample((20000,))._data)
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.08)
+    want_h = st.multivariate_normal(np.zeros(2), cov).entropy()
+    assert abs(float(mvn.entropy()) - want_h) < 1e-4
+
+
+def test_independent_sums_event_dims():
+    base = D.Normal(np.zeros((3, 4), np.float32),
+                    np.ones((3, 4), np.float32))
+    ind = D.Independent(base, 1)
+    v = np.random.default_rng(0).standard_normal((3, 4)).astype("float32")
+    lp = ind.log_prob(paddle.to_tensor(v))
+    assert list(lp.shape) == [3]
+    np.testing.assert_allclose(
+        np.asarray(lp._data),
+        np.asarray(base.log_prob(paddle.to_tensor(v))._data).sum(-1),
+        rtol=1e-6)
+
+
+def test_transformed_distribution_lognormal():
+    td = D.TransformedDistribution(D.Normal(0.0, 1.0),
+                                   [D.ExpTransform()])
+    got = float(td.log_prob(paddle.to_tensor(np.float32(2.0))))
+    assert abs(got - st.lognorm(1.0).logpdf(2.0)) < 1e-4
+    s = np.asarray(td.sample((20000,))._data)
+    assert abs(np.log(s).mean()) < 0.05
+
+
+def test_transforms_roundtrip_and_jacobian():
+    x = np.linspace(-1.5, 1.5, 7).astype("float32")
+    for tr in [D.AffineTransform(0.5, 2.0), D.ExpTransform(),
+               D.SigmoidTransform(), D.TanhTransform(),
+               D.ChainTransform([D.AffineTransform(0.1, 0.7),
+                                 D.TanhTransform()])]:
+        y = tr.forward(paddle.to_tensor(x))
+        back = tr.inverse(y)
+        np.testing.assert_allclose(np.asarray(back._data), x, atol=1e-4,
+                                   rtol=1e-4)
+        # numeric jacobian check
+        eps = 1e-3
+        yp = np.asarray(tr.forward(paddle.to_tensor(x + eps))._data)
+        ym = np.asarray(tr.forward(paddle.to_tensor(x - eps))._data)
+        num = np.log(np.abs((yp - ym) / (2 * eps)))
+        got = np.asarray(tr.forward_log_det_jacobian(
+            paddle.to_tensor(x))._data)
+        np.testing.assert_allclose(got, num, atol=2e-3, rtol=2e-3)
+
+
+def test_lkj_cholesky_valid_and_uniform_eta1():
+    lkj = D.LKJCholesky(3, 1.0)
+    L = np.asarray(lkj.sample((2000,))._data)
+    corr = L @ np.swapaxes(L, -1, -2)
+    np.testing.assert_allclose(
+        np.diagonal(corr, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+    # eta=1: off-diagonal marginals ~ uniform-ish on (-1,1), mean 0
+    off = corr[:, 1, 0]
+    assert abs(off.mean()) < 0.05
+    lp = lkj.log_prob(paddle.to_tensor(L[0]))
+    assert np.isfinite(float(lp))
+
+
+def test_continuous_bernoulli_normalized():
+    """pdf integrates to 1 (the C(p) normalizer is the whole point)."""
+    cb = D.ContinuousBernoulli(np.float32(0.3))
+    xs = np.linspace(1e-4, 1 - 1e-4, 20001).astype("float32")
+    pdf = np.exp(np.asarray(cb.log_prob(paddle.to_tensor(xs))._data))
+    integral = np.trapezoid(pdf, xs)
+    assert abs(integral - 1.0) < 1e-3
