@@ -1,6 +1,10 @@
-"""Driver benchmark: GPT-2 345M LM pretrain step throughput on one TPU chip.
+"""Driver benchmark: the BASELINE.json config ladder on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Prints ONE JSON line. Headline metric: GPT-2 345M LM pretrain throughput
+(tokens/s/chip + MFU). Extra rungs (reported under "ladder"): a ~770M
+GPT bf16 train config, Llama-7B bf16 paged-cache decode throughput, and
+ViT-L image/s train — the single-chip-feasible slice of the ladder
+(GPT-2 345M -> Llama-2 7B -> 70B -> Mixtral -> ViT-L).
 
 vs_baseline: the reference publishes no numbers (BASELINE.md). The agreed
 comparator is the north-star "match or beat A100 MFU" (BASELINE.json): we
@@ -10,9 +14,9 @@ pretraining — as the baseline MFU, and report vs_baseline = our_MFU / 0.40.
 
 import json
 import time
+import traceback
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 # bf16 peak FLOP/s per chip by device generation
@@ -41,62 +45,211 @@ def detect_peak():
     return kind or "cpu", PEAK_BF16["cpu"]
 
 
-def main():
+def _sync(t):
+    """Host fetch — on the axon remote relay block_until_ready can return
+    before the chain finishes executing."""
+    return float(np.asarray(t._data if hasattr(t, "_data") else t))
+
+
+def bench_gpt_train(config, batch, seq, steps, tag):
     import paddle_tpu as paddle
     from paddle_tpu import nn, optimizer
-    from paddle_tpu.models import GPT, GPTConfig
-
-    on_tpu = jax.default_backend() != "cpu"
-    if on_tpu:
-        batch, seq = 8, 1024
-        config = GPTConfig.gpt2_medium()
-        steps = 20
-    else:  # smoke mode off-TPU
-        batch, seq = 2, 64
-        config = GPTConfig.tiny()
-        steps = 3
+    from paddle_tpu.models import GPT
 
     paddle.seed(0)
     model = GPT(config)
+    on_tpu = jax.default_backend() != "cpu"
     if on_tpu:
         model.to(dtype="bfloat16")  # params bf16; AdamW keeps fp32 masters
-    opt = optimizer.AdamW(learning_rate=3e-4, parameters=model.parameters(),
+    opt = optimizer.AdamW(learning_rate=3e-4,
+                          parameters=model.parameters(),
                           grad_clip=nn.ClipGradByGlobalNorm(1.0))
     step = paddle.jit.TrainStep(model, opt,
                                 lambda m, ids: m.loss(ids, ids))
-
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(
         rng.integers(0, config.vocab_size, (batch, seq)).astype("int64"))
-
-    # warmup (compile). NB: sync via host fetch — on the axon remote relay
-    # block_until_ready can return before the chain finishes executing.
-    loss = step(ids)
-    loss = step(ids)
-    loss_val = float(np.asarray(loss._data))
-
+    _sync(step(ids))
+    _sync(step(ids))
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step(ids)
-    loss_val = float(np.asarray(loss._data))
+    loss_val = _sync(loss)
     dt = time.perf_counter() - t0
 
     tokens_per_s = batch * seq * steps / dt
     flops_tok = model.flops_per_token(seq)
     kind, peak = detect_peak()
     mfu = tokens_per_s * flops_tok / peak
+    return {
+        "tag": tag, "tokens_per_s": round(tokens_per_s, 1),
+        "mfu": round(mfu, 4), "step_time_ms": round(1000 * dt / steps, 2),
+        "loss": loss_val, "batch": batch, "seq": seq,
+        "params": model.num_params(), "device": kind,
+    }
+
+
+def bench_llama_decode(config, max_batch, prompt_len, new_tokens, tag,
+                       dtype="bfloat16"):
+    """Paged-cache decode throughput (reference block_multihead_attention
+    decode path)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.paged import ContinuousBatchingEngine
+    from paddle_tpu.models import Llama
+
+    paddle.seed(0)
+    model = Llama(config)
+    model.eval()
+    if jax.default_backend() != "cpu":
+        model.to(dtype=dtype)
+    eng = ContinuousBatchingEngine(
+        model, max_batch=max_batch, block_size=32,
+        max_seq_len=prompt_len + new_tokens + 32, temperature=0.0,
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    rng = np.random.default_rng(0)
+    for _ in range(max_batch):
+        eng.add_request(
+            rng.integers(0, config.vocab_size, (prompt_len,)), new_tokens)
+    # prefill + first decode step compile outside the timed window
+    eng.step()
+    eng.step()
+    done_tokens = 0
+    t0 = time.perf_counter()
+    while eng.has_work:
+        done_tokens += len(eng.step())
+    dt = time.perf_counter() - t0
+    return {
+        "tag": tag, "decode_tokens_per_s": round(done_tokens / dt, 1),
+        "batch": max_batch, "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "params": model.num_params(), "dtype": dtype,
+    }
+
+
+def bench_vit_train(factory, batch, steps, tag, image=224):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    paddle.seed(0)
+    model = factory(num_classes=1000)
+    if jax.default_backend() != "cpu":
+        model.to(dtype="bfloat16")
+    opt = optimizer.AdamW(learning_rate=3e-4,
+                          parameters=model.parameters(),
+                          grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    step = paddle.jit.TrainStep(
+        model, opt, lambda m, x, y: m.loss(x, y))
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(
+        rng.standard_normal((batch, 3, image, image)).astype("float32"))
+    y = paddle.to_tensor(rng.integers(0, 1000, (batch,)).astype("int64"))
+    _sync(step(x, y))
+    _sync(step(x, y))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    loss_val = _sync(loss)
+    dt = time.perf_counter() - t0
+    n_params = sum(p.size for p in model.parameters())
+    return {
+        "tag": tag, "images_per_s": round(batch * steps / dt, 1),
+        "step_time_ms": round(1000 * dt / steps, 2), "loss": loss_val,
+        "batch": batch, "params": n_params,
+    }
+
+
+def bench_eager(tag="eager"):
+    """Dygraph hot-loop throughput (SURVEY hard-part #5: responsive eager
+    UX when every op is an async XLA dispatch; reference comparator is the
+    per-op ad_func dispatch chain, SURVEY §3.1)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    paddle.seed(0)
+    x = paddle.to_tensor(np.ones((256, 256), np.float32))
+    # single-op dispatch rate (async: don't sync per op)
+    n = 300
+    y = x
+    t0 = time.perf_counter()
+    for _ in range(n):
+        y = y * 1.0001 + 0.0001
+    _sync(y.sum())
+    ops_per_s = 2 * n / (time.perf_counter() - t0)
+
+    # eager train step (forward + tape backward + SGD), no jit
+    net = nn.Sequential(nn.Linear(256, 256), nn.GELU(),
+                        nn.Linear(256, 256))
+    opt = optimizer.SGD(learning_rate=1e-3, parameters=net.parameters())
+    data = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (64, 256)).astype("float32"))
+    for _ in range(2):  # warm caches
+        loss = net(data).square().mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = net(data).square().mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    return {
+        "tag": tag, "eager_elementwise_ops_per_s": round(ops_per_s, 1),
+        "eager_train_steps_per_s": round(steps / dt, 2),
+    }
+
+
+def _try(fn, *args, **kwargs):
+    try:
+        return fn(*args, **kwargs)
+    except Exception as e:  # OOM etc: report, don't kill the headline
+        return {"tag": kwargs.get("tag") or (args[-1] if args else "?"),
+                "skipped": f"{type(e).__name__}: {e}"[:300]}
+
+
+def main():
+    from paddle_tpu.models import GPTConfig, LlamaConfig
+    from paddle_tpu.vision.models import vit_l_16
+
+    on_tpu = jax.default_backend() != "cpu"
+    ladder = {}
+
+    if on_tpu:
+        head = bench_gpt_train(GPTConfig.gpt2_medium(), 8, 1024, 20,
+                               "gpt2_345m")
+        ladder["gpt_770m_train"] = _try(
+            bench_gpt_train, GPTConfig.gpt2_large(), 4, 1024, 10,
+            "gpt2_770m")
+        ladder["llama7b_decode"] = _try(
+            bench_llama_decode, LlamaConfig.llama2_7b(), 4, 128, 128,
+            "llama2_7b_decode")
+        ladder["vit_l_train"] = _try(
+            bench_vit_train, vit_l_16, 32, 10, "vit_l_16")
+        ladder["eager"] = _try(bench_eager)
+    else:  # smoke mode off-TPU
+        head = bench_gpt_train(GPTConfig.tiny(), 2, 64, 3, "gpt2_tiny")
+        ladder["llama_decode_smoke"] = _try(
+            bench_llama_decode, LlamaConfig.tiny(), 2, 8, 8,
+            "llama_tiny_decode", dtype="float32")
+        ladder["eager"] = _try(bench_eager)
 
     print(json.dumps({
         "metric": "gpt2_345m_pretrain_tokens_per_sec_per_chip",
-        "value": round(tokens_per_s, 1),
+        "value": head["tokens_per_s"],
         "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / BASELINE_MFU, 4),
-        "mfu": round(mfu, 4),
-        "device": kind,
-        "step_time_ms": round(1000 * dt / steps, 2),
-        "loss": loss_val,
-        "batch": batch, "seq": seq,
-        "params": model.num_params(),
+        "vs_baseline": round(head["mfu"] / BASELINE_MFU, 4),
+        "mfu": head["mfu"],
+        "device": head["device"],
+        "step_time_ms": head["step_time_ms"],
+        "loss": head["loss"],
+        "batch": head["batch"], "seq": head["seq"],
+        "params": head["params"],
+        "ladder": ladder,
     }))
 
 

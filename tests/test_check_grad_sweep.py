@@ -1,0 +1,122 @@
+"""Finite-difference gradient sweep across the differentiable op surface.
+
+The reference applies numeric `check_grad` to every op test
+(test/legacy_test/op_test.py:3109 via get_numeric_gradient :148); this
+sweep pins the tape gradients of ~60 ops the same way.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from tests.op_test import check_grad
+
+
+def _pos(*shape):  # strictly positive inputs (log/sqrt/pow domains)
+    return np.random.default_rng(0).uniform(0.5, 2.0, shape).astype(
+        "float32")
+
+
+def _any(*shape):
+    return np.random.default_rng(1).standard_normal(shape).astype(
+        "float32")
+
+
+def _unit(*shape):  # inside (-0.9, 0.9) for atanh/asin/acos
+    return (np.random.default_rng(2).uniform(-0.9, 0.9, shape)).astype(
+        "float32")
+
+
+UNARY = [
+    (paddle.exp, _any), (paddle.log, _pos), (paddle.log1p, _pos),
+    (paddle.log2, _pos), (paddle.log10, _pos), (paddle.sqrt, _pos),
+    (paddle.rsqrt, _pos), (paddle.square, _any), (paddle.abs, _pos),
+    (paddle.sin, _any), (paddle.cos, _any), (paddle.tan, _unit),
+    (paddle.asin, _unit), (paddle.acos, _unit), (paddle.atan, _any),
+    (paddle.sinh, _any), (paddle.cosh, _any), (paddle.tanh, _any),
+    (paddle.asinh, _any), (paddle.acosh, lambda *s: _pos(*s) + 1.0),
+    (paddle.atanh, _unit), (paddle.sigmoid, _any), (paddle.erf, _any),
+    (paddle.erfinv, _unit), (paddle.expm1, _any),
+    (paddle.reciprocal, _pos), (paddle.digamma, _pos),
+    (paddle.lgamma, _pos), (paddle.logit, lambda *s: _unit(*s) * 0.4 + 0.5),
+    (paddle.sinc, _pos), (paddle.i0, _any), (paddle.i0e, _any),
+    (paddle.i1, _any), (paddle.i1e, _any), (paddle.softplus, _any)
+    if hasattr(paddle, "softplus") else (paddle.exp, _any),
+]
+
+BINARY = [
+    (paddle.add, _any, _any), (paddle.subtract, _any, _any),
+    (paddle.multiply, _any, _any), (paddle.divide, _any, _pos),
+    (paddle.maximum, _any, _any), (paddle.minimum, _any, _any),
+    (paddle.pow, _pos, None), (paddle.atan2, _pos, _pos),
+    (paddle.hypot, _pos, _pos), (paddle.logaddexp, _any, _any)
+    if hasattr(paddle, "logaddexp") else (paddle.add, _any, _any),
+]
+
+REDUCTIONS = [
+    paddle.sum, paddle.mean, paddle.max, paddle.min, paddle.prod,
+    paddle.logsumexp, paddle.norm,
+]
+
+
+@pytest.mark.parametrize("fn,gen", UNARY,
+                         ids=[f[0].__name__ for f in UNARY])
+def test_unary_grads(fn, gen):
+    check_grad(fn, [gen(3, 4)], atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.parametrize("fn,ga,gb", BINARY,
+                         ids=[f[0].__name__ for f in BINARY])
+def test_binary_grads(fn, ga, gb):
+    if gb is None:  # pow with scalar exponent
+        check_grad(lambda a: fn(a, 2.5), [ga(3, 4)], atol=2e-2, rtol=2e-2)
+    else:
+        check_grad(fn, [ga(3, 4), gb(3, 4)], atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.parametrize("fn", REDUCTIONS,
+                         ids=[f.__name__ for f in REDUCTIONS])
+def test_reduction_grads(fn):
+    check_grad(fn, [_pos(3, 4) + np.arange(12).reshape(3, 4) * 0.01],
+               atol=2e-2, rtol=2e-2)
+
+
+def test_matmul_family_grads():
+    check_grad(paddle.matmul, [_any(3, 4), _any(4, 5)], atol=2e-2,
+               rtol=2e-2)
+    check_grad(lambda a, x, y: paddle.addmm(a, x, y),
+               [_any(3, 5), _any(3, 4), _any(4, 5)], atol=2e-2, rtol=2e-2)
+    check_grad(paddle.dot, [_any(6), _any(6)], atol=2e-2, rtol=2e-2)
+    check_grad(lambda x: paddle.einsum("ij,jk->ik", x,
+                                       paddle.to_tensor(_any(4, 3))),
+               [_any(2, 4)], atol=2e-2, rtol=2e-2)
+
+
+def test_manipulation_grads():
+    check_grad(lambda x: paddle.transpose(x, [1, 0]), [_any(3, 4)])
+    check_grad(lambda x: paddle.reshape(x, [12]), [_any(3, 4)])
+    check_grad(lambda x: paddle.concat([x, x], axis=0), [_any(2, 3)])
+    check_grad(lambda x: paddle.split(x, 2, axis=0)[0], [_any(4, 3)])
+    check_grad(lambda x: paddle.flip(x, axis=[0]), [_any(3, 4)])
+    check_grad(lambda x: paddle.roll(x, 1, axis=0), [_any(3, 4)])
+    check_grad(lambda x: paddle.tile(x, [2, 1]), [_any(2, 3)])
+    check_grad(lambda x: paddle.squeeze(paddle.unsqueeze(x, 0), 0),
+               [_any(3, 4)])
+    check_grad(lambda x: paddle.pad(x, [1, 1, 1, 1]), [_any(3, 4)])
+
+
+def test_activation_grads():
+    F = paddle.nn.functional
+    for fn in [F.relu, F.gelu, F.silu, F.mish, F.softplus, F.hardswish,
+               F.elu, F.selu, F.leaky_relu]:
+        check_grad(fn, [_any(3, 4)], atol=3e-2, rtol=3e-2)
+    check_grad(lambda x: F.softmax(x, axis=-1), [_any(3, 4)])
+    check_grad(lambda x: F.log_softmax(x, axis=-1), [_any(3, 4)])
+
+
+def test_norm_layer_grads():
+    F = paddle.nn.functional
+    x = _any(4, 6)
+    w, b = _pos(6), _any(6)
+    check_grad(lambda x, w, b: F.layer_norm(x, [6], w, b), [x, w, b],
+               atol=2e-2, rtol=2e-2)

@@ -1,0 +1,97 @@
+"""Sweep Pallas flash-attention block sizes on the current device.
+
+The kernel-autotune capability the reference ships as
+`python/paddle/incubate/autotune` (cached per-shape config selection):
+run on a real TPU to refresh the per-shape table in
+`paddle_tpu/kernels/pallas/flash_attention.py::default_block_sizes`.
+
+    python tools/sweep_flash_blocks.py [--seq 1024] [--heads 16]
+        [--kv-heads 16] [--dim 128] [--batch 4] [--causal]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def time_config(q, k, v, causal, bq, bk, iters=30):
+    from paddle_tpu.kernels.pallas.flash_attention import flash_attention
+
+    @jax.jit
+    def many(q0, k0, v0):
+        def body(c, _):
+            o = flash_attention(q0 + c.astype(q0.dtype) * q0.dtype.type(0),
+                                k0, v0, causal=causal, block_q=bq,
+                                block_k=bk)
+            return o.astype(jnp.float32).mean(), None
+        c, _ = jax.lax.scan(body, jnp.float32(0), None, length=iters)
+        return c
+
+    float(many(q, k, v))  # compile + warm
+    t0 = time.perf_counter()
+    float(many(q, k, v))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--kv-seq", type=int, default=None)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--kv-heads", type=int, default=None)
+    ap.add_argument("--dim", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--causal", action="store_true")
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args()
+    kv_seq = args.kv_seq or args.seq
+    kv_heads = args.kv_heads or args.heads
+
+    rng = np.random.default_rng(0)
+    dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    q = jnp.asarray(rng.standard_normal(
+        (args.batch, args.seq, args.heads, args.dim)), dt)
+    k = jnp.asarray(rng.standard_normal(
+        (args.batch, kv_seq, kv_heads, args.dim)), dt)
+    v = jnp.asarray(rng.standard_normal(
+        (args.batch, kv_seq, kv_heads, args.dim)), dt)
+
+    group = args.heads // kv_heads
+    flops = 4 * args.batch * args.seq * kv_seq * args.heads * args.dim \
+        * (0.5 if args.causal else 1.0)
+    results = []
+    for bq, bk in itertools.product([128, 256, 512, 1024],
+                                    [128, 256, 512, 1024]):
+        if bq > args.seq or bk > kv_seq:
+            continue
+        if group * bq > 2048:  # VMEM guard for the folded q operand
+            continue
+        try:
+            dt_s = time_config(q, k, v, args.causal, bq, bk)
+        except Exception as e:
+            print(f"bq={bq:5d} bk={bk:5d}  FAILED "
+                  f"{type(e).__name__}: {str(e)[:80]}")
+            continue
+        tflops = flops / dt_s / 1e12
+        results.append((dt_s, bq, bk))
+        print(f"bq={bq:5d} bk={bk:5d}  {dt_s * 1e3:7.3f} ms  "
+              f"{tflops:6.1f} TFLOP/s")
+    if results:
+        best = min(results)
+        print(f"\nbest: block_q={best[1]} block_k={best[2]} "
+              f"({best[0] * 1e3:.3f} ms) — update default_block_sizes for "
+              f"(seq={args.seq}, kv_seq={kv_seq}, group={group})")
+
+
+if __name__ == "__main__":
+    main()
