@@ -78,3 +78,4 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
         p.grad._rebind((p.grad._data.astype(jnp.float32) *
                         clip_coef).astype(p.grad.dtype))
     return Tensor(total)
+from . import quant  # noqa: F401

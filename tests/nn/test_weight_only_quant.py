@@ -1,0 +1,102 @@
+"""Weight-only quantization (reference nn/quant/quantized_linear.py:
+weight_quantize/weight_dequantize/weight_only_linear) + quantized decode.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _x(*shape):
+    return paddle.to_tensor(
+        np.random.default_rng(0).standard_normal(shape).astype("float32"))
+
+
+def test_int8_roundtrip_and_linear():
+    paddle.seed(0)
+    lin = nn.Linear(64, 32)
+    x = _x(4, 64)
+    ref = lin(x).numpy()
+    q, scale = nn.quant.weight_quantize(lin.weight)
+    assert str(q.dtype) == "int8" and list(scale.shape) == [32]
+    deq = nn.quant.weight_dequantize(q, scale, out_dtype="float32")
+    assert np.abs(deq.numpy() - lin.weight.numpy()).max() < 0.01
+    out = nn.quant.weight_only_linear(x, q, lin.bias, scale)
+    rel = np.abs(out.numpy() - ref).max() / np.abs(ref).max()
+    assert rel < 0.02, rel
+
+
+def test_int4_grouped_beats_per_channel():
+    paddle.seed(1)
+    lin = nn.Linear(128, 32)
+    x = _x(4, 128)
+    ref = lin(x).numpy()
+
+    def rel_err(group_size):
+        q, s = nn.quant.weight_quantize(lin.weight,
+                                        algo="weight_only_int4",
+                                        group_size=group_size)
+        out = nn.quant.weight_only_linear(x, q, lin.bias, s,
+                                          weight_dtype="int4",
+                                          group_size=group_size)
+        return np.abs(out.numpy() - ref).max() / np.abs(ref).max()
+
+    per_channel = rel_err(-1)
+    grouped = rel_err(64)
+    assert grouped < per_channel       # finer scales help
+    assert grouped < 0.12, grouped
+    # int4 storage really is half of int8 (packed 2/byte)
+    q8, _ = nn.quant.weight_quantize(lin.weight)
+    q4, _ = nn.quant.weight_quantize(lin.weight, algo="weight_only_int4")
+    assert q4.shape[0] == q8.shape[0] // 2
+
+
+def test_int8_grouped_scales():
+    paddle.seed(2)
+    lin = nn.Linear(128, 16)
+    q, s = nn.quant.weight_quantize(lin.weight, group_size=64)
+    assert list(s.shape) == [2, 16]
+    deq = nn.quant.weight_dequantize(q, s, out_dtype="float32",
+                                     group_size=64)
+    assert np.abs(deq.numpy() - lin.weight.numpy()).max() < 0.01
+
+
+def test_quantize_for_inference_transform():
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    paddle.seed(3)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    n = nn.quant.quantize_for_inference(m)
+    assert n > 0
+    # lm_head excluded by default
+    assert not hasattr(m.lm_head, "_weight_only")
+    out = m(paddle.to_tensor(np.arange(6)[None]))
+    assert out.shape == [1, 6, 256]
+
+
+def test_quantized_decode_close_to_fp():
+    """Weight-only int8 paged decode: same early tokens as fp decode on a
+    confident model (quantized decode capability — reference
+    block/masked-MHA weight-only path)."""
+    from paddle_tpu.inference.paged import ContinuousBatchingEngine
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    paddle.seed(4)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    prompt = np.random.default_rng(5).integers(0, 255, (10,)).astype(
+        "int64")
+    full = m.generate(paddle.to_tensor(prompt[None]), max_new_tokens=6,
+                      temperature=0.0).numpy()[0, 10:]
+    nn.quant.quantize_for_inference(m)
+    eng = ContinuousBatchingEngine(m, max_batch=1, block_size=8,
+                                   max_seq_len=64, temperature=0.0)
+    rid = eng.add_request(prompt, max_new_tokens=6)
+    outq = eng.run_to_completion()[rid]
+    # int8 weight noise may flip late low-margin tokens; the first token
+    # of a greedy decode must survive
+    assert outq[0] == full[0]
+    assert len(outq) == 6
