@@ -8,5 +8,6 @@ EarlyStopping, LRScheduler), summary (model_summary.py).
 from .model import Model  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ReduceLROnPlateau, VisualDL, WandbCallback,
 )
 from .summary import summary  # noqa: F401

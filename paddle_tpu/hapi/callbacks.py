@@ -131,3 +131,148 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait >= self.patience:
                 self.model.stop_training = True
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer LR when a monitored metric plateaus
+    (reference callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = float("-inf") if mode == "max" else float("inf")
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _better(self, cur):
+        if self.mode == "max":
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        cur = float(cur)
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            old = opt.get_lr()
+            new = max(old * self.factor, self.min_lr)
+            if old - new > 1e-12:
+                if opt._lr_scheduler is not None:
+                    opt._lr_scheduler.base_lr = new
+                    opt._lr_scheduler.last_lr = new
+                else:
+                    opt.set_lr(new)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {old:.3g} -> {new:.3g}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference callbacks.py VisualDL). The
+    visualdl package isn't vendored; scalars append to a jsonl file under
+    log_dir that its UI (or anything else) can tail."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = 0
+        self._f = None
+
+    def _writer(self):
+        if self._f is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._f = open(os.path.join(self.log_dir, "vdl_scalars.jsonl"),
+                           "a")
+        return self._f
+
+    def _log(self, tag, logs, step):
+        import json
+        logs = logs or {}
+        w = self._writer()
+        for k, v in logs.items():
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else None
+            if isinstance(v, numbers.Number):
+                w.write(json.dumps({"tag": f"{tag}/{k}", "step": step,
+                                    "value": float(v)}) + "\n")
+        w.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % 10 == 0:
+            self._log("train", logs, self._step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._log("train_epoch", logs, epoch)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs, self._step)
+
+    def on_train_end(self, logs=None):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (reference callbacks.py WandbCallback).
+    Uses the wandb package when importable; otherwise degrades to the
+    same jsonl scalar log as VisualDL."""
+
+    def __init__(self, project=None, dir=None, **kwargs):
+        super().__init__()
+        self.project = project
+        self.dir = dir or "./wandb"
+        self.kwargs = kwargs
+        try:
+            import wandb
+            self._wandb = wandb
+        except ImportError:
+            self._wandb = None
+            self._fallback = VisualDL(log_dir=self.dir)
+        self._run = None
+
+    def on_train_begin(self, logs=None):
+        if self._wandb is not None:
+            self._run = self._wandb.init(project=self.project,
+                                         dir=self.dir, **self.kwargs)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._wandb is not None and self._run is not None:
+            self._run.log({k: v for k, v in (logs or {}).items()
+                           if isinstance(v, numbers.Number)})
+        elif self._wandb is None:
+            self._fallback.on_train_batch_end(step, logs)
+
+    def on_train_end(self, logs=None):
+        if self._run is not None:
+            self._run.finish()
+        elif self._wandb is None:
+            self._fallback.on_train_end(logs)

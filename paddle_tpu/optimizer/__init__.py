@@ -2,6 +2,7 @@
 
 from . import lr  # noqa: F401
 from .adam import Adam, Adamax, AdamW, Lamb, NAdam, RAdam  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from .optimizer import (  # noqa: F401
     ASGD, Adadelta, Adagrad, Momentum, Optimizer, RMSProp, Rprop, SGD,
 )

@@ -341,3 +341,22 @@ class ReduceOnPlateau(LRScheduler):
         if self.threshold_mode == "rel":
             return a > best * (1 + self.threshold)
         return a > best + self.threshold
+
+
+class LinearLR(LRScheduler):
+    """Linear interpolation from start_factor to end_factor over
+    total_steps (reference optimizer/lr.py LinearLR)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        assert total_steps > 0
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(max(self.last_epoch, 0), self.total_steps)
+        frac = self.start_factor + (self.end_factor - self.start_factor) \
+            * t / self.total_steps
+        return self.base_lr * frac

@@ -1,0 +1,99 @@
+"""LBFGS (closure + strong-Wolfe), LinearLR, new hapi callbacks."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.core.tensor import Parameter
+
+
+def test_lbfgs_solves_quadratic():
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((8, 8)).astype("float32")
+    A = A @ A.T + 0.5 * np.eye(8, dtype="float32")
+    b = rng.standard_normal((8,)).astype("float32")
+    w = Parameter(np.zeros(8, "float32"))
+    opt = optimizer.LBFGS(learning_rate=1.0, max_iter=30,
+                          line_search_fn="strong_wolfe", parameters=[w])
+    At, bt = paddle.to_tensor(A), paddle.to_tensor(b)
+
+    def closure():
+        loss = 0.5 * paddle.matmul(w, paddle.matmul(At, w)) \
+            - paddle.dot(bt, w)
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    np.testing.assert_allclose(w.numpy(), np.linalg.solve(A, b),
+                               atol=1e-3)
+
+
+def test_lbfgs_trains_model():
+    paddle.seed(1)
+    from paddle_tpu import nn
+
+    net = nn.Linear(4, 1)
+    x = paddle.to_tensor(
+        np.random.default_rng(1).standard_normal((32, 4)).astype(
+            "float32"))
+    target = paddle.to_tensor(
+        (x.numpy() @ np.array([[1.0], [-2.0], [0.5], [3.0]],
+                              "float32")) + 0.7)
+    opt = optimizer.LBFGS(learning_rate=1.0, max_iter=50,
+                          line_search_fn="strong_wolfe",
+                          parameters=net.parameters())
+
+    def closure():
+        loss = paddle.nn.functional.mse_loss(net(x), target)
+        loss.backward()
+        return loss
+
+    final = float(opt.step(closure))
+    assert final < 1e-4, final
+
+
+def test_linear_lr_schedule():
+    sch = optimizer.lr.LinearLR(0.1, total_steps=4, start_factor=0.5)
+    vals = [sch.last_lr]
+    for _ in range(5):
+        sch.step()
+        vals.append(sch.last_lr)
+    np.testing.assert_allclose(
+        vals[:5], [0.05, 0.0625, 0.075, 0.0875, 0.1], rtol=1e-6)
+    assert vals[5] == 0.1  # clamps after total_steps
+
+
+def test_visualdl_callback_writes_scalars(tmp_path):
+    import json
+
+    from paddle_tpu.hapi import VisualDL
+
+    cb = VisualDL(log_dir=str(tmp_path))
+    cb.on_train_batch_end(9, {"loss": 1.5})  # step 1: skipped (every 10)
+    for i in range(10):
+        cb.on_train_batch_end(i, {"loss": 1.0 - i * 0.01})
+    cb.on_eval_end({"acc": 0.9})
+    cb.on_train_end()
+    lines = [json.loads(l) for l in
+             (tmp_path / "vdl_scalars.jsonl").read_text().splitlines()]
+    tags = {l["tag"] for l in lines}
+    assert "train/loss" in tags and "eval/acc" in tags
+
+
+def test_reduce_lr_on_plateau_callback():
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import ReduceLROnPlateau
+
+    net = nn.Linear(2, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+
+    class FakeModel:
+        _optimizer = opt
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                           verbose=0)
+    cb.set_model(FakeModel())
+    cb.on_eval_end({"loss": 1.0})
+    for _ in range(3):  # no improvement
+        cb.on_eval_end({"loss": 1.0})
+    assert abs(opt.get_lr() - 0.05) < 1e-9
