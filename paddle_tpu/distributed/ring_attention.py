@@ -77,23 +77,74 @@ def _ring_body(q, k, v, *, axis, cp, causal, scale):
     return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, hq, d).astype(q.dtype)
 
 
+def _ring_body_flash(q, k, v, *, axis, cp, causal, scale):
+    """Pallas-kernel ring body: each ring step runs the MXU flash kernel
+    on (local q, rotating kv chunk) with explicit global positions, and
+    partial outputs merge through their logsumexps —
+    o = o1*exp(L1-L) + o2*exp(L2-L), L = logaddexp(L1, L2)."""
+    from ..kernels.pallas.flash_attention import _BIG, flash_attention
+
+    idx = lax.axis_index(axis)
+    b, sq, hq, d = q.shape
+    NEG = jnp.float32(-1e30)
+
+    pos_q = jnp.broadcast_to(
+        idx * sq + jnp.arange(sq, dtype=jnp.int32), (b, sq))
+    seg = jnp.zeros((b, sq), jnp.int32)
+
+    def partial_attn(carry, step):
+        o_acc, l_acc, k_chunk, v_chunk = carry
+        src = (idx - step) % cp
+        pos_k = jnp.broadcast_to(
+            src * sq + jnp.arange(sq, dtype=jnp.int32), (b, sq))
+        o_part, lse = flash_attention(
+            q, k_chunk, v_chunk, causal=causal, scale=scale,
+            q_segment_ids=seg, kv_segment_ids=seg,
+            q_positions=pos_q, kv_positions=pos_k, return_lse=True)
+        # kernel sentinel for fully-masked rows is +_BIG (so its own bwd
+        # zeroes); for the cross-chunk merge that row must be -inf-like
+        lse = jnp.where(lse > jnp.float32(_BIG) * 0.5, NEG, lse)
+        lse = jnp.swapaxes(lse, 1, 2)  # [b, hq, sq] -> [b, sq, hq]
+        l_new = jnp.logaddexp(l_acc, lse)
+        w_old = jnp.exp(l_acc - l_new)[..., None]
+        w_new = jnp.exp(lse - l_new)[..., None]
+        o_new = o_acc * w_old + o_part.astype(jnp.float32) * w_new
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+        k_next = lax.ppermute(k_chunk, axis, perm)
+        v_next = lax.ppermute(v_chunk, axis, perm)
+        return (o_new, l_new, k_next, v_next), None
+
+    o0 = jnp.zeros((b, sq, hq, d), jnp.float32)
+    l0 = jnp.full((b, sq, hq), NEG, jnp.float32)
+    (o, _, _, _), _ = lax.scan(partial_attn, (o0, l0, k, v),
+                               jnp.arange(cp))
+    return o.astype(q.dtype)
+
+
 def ring_attention(query, key, value, mesh=None, axis="sep", causal=True,
-                   scale=None):
+                   scale=None, use_flash=None):
     """Context-parallel attention on Tensors [b, s, h, d] with the
     sequence dim (logically) sharded over ``axis``. Differentiable; the
-    VJP is the reversed ring (jax transposes ppermute automatically)."""
+    VJP is the reversed ring (jax transposes ppermute automatically).
+
+    ``use_flash``: run each ring step through the Pallas flash kernel
+    (MXU tiling + causal block skip) and merge partials by logsumexp;
+    default on for TPU, off for the CPU mesh (interpret mode is slow)."""
     from .mesh import get_mesh
 
     mesh = mesh or get_mesh()
     cp = mesh.get_dim_size(axis)
     d = query.shape[-1]
     sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if use_flash is None:
+        use_flash = jax.default_backend() != "cpu"
+    body_fn = _ring_body_flash if use_flash else _ring_body
 
     def fn(q, k, v):
         spec = P(None, axis, None, None)
         body = jax.shard_map(
-            lambda a, b_, c: _ring_body(a, b_, c, axis=axis, cp=cp,
-                                        causal=causal, scale=sm_scale),
+            lambda a, b_, c: body_fn(a, b_, c, axis=axis, cp=cp,
+                                     causal=causal, scale=sm_scale),
             mesh=mesh.jax_mesh, in_specs=(spec, spec, spec),
             out_specs=spec, check_vma=False)
         return body(q, k, v)

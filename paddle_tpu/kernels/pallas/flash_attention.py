@@ -402,15 +402,20 @@ def _make_flash(causal, scale, bq, bk, has_seg, sk_true, off):
 
     def flash_bwd(res, cts):
         q5, k4, v4, qseg, kseg, qpos, kpos, out, lse = res
-        do5, _ = cts  # no cotangent flows into lse
+        do5, dlse = cts
         do5 = do5.astype(q5.dtype)
         B, Hk, G, Sq, Dp = q5.shape
         Sk = k4.shape[2]
         nq, nk = Sq // bq, Sk // bk
         rows = G * bq
-        # delta = rowsum(dO * O), f32, same layout as lse
+        # delta = rowsum(dO * O), f32, same layout as lse. A cotangent on
+        # the lse output folds straight in: dL/ds_ij picks up
+        # glse_i * p_ij, and the kernels compute ds = p * (dp - delta),
+        # so delta_eff = delta - glse carries it with no kernel change.
         delta = jnp.sum(do5.astype(jnp.float32) * out.astype(jnp.float32),
                         axis=-1, keepdims=True)
+        if dlse is not None:
+            delta = delta - dlse.astype(jnp.float32)
 
         common = dict(group=G, bq=bq, bk=bk, sk=sk_true, off=off,
                       scale=np.float32(scale), causal=causal,
