@@ -9,7 +9,12 @@ import jax.numpy as jnp
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imikolov",
+           "Imdb", "Movielens", "Conll05st", "WMT14", "WMT16"]
+
+from .datasets import (  # noqa: F401,E402
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

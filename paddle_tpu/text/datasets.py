@@ -1,0 +1,399 @@
+"""`paddle.text` datasets (reference: python/paddle/text/datasets/ —
+conll05.py, imdb.py, imikolov.py, movielens.py, uci_housing.py,
+wmt14.py, wmt16.py).
+
+Same contract as the vision datasets: a local ``data_file`` (the same
+archive/format the reference downloads) is parsed directly; without one,
+download is attempted from the reference URLs (which requires network
+egress — pass local files in hermetic environments).
+"""
+
+from __future__ import annotations
+
+import gzip
+import io
+import os
+import re
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["UCIHousing", "Imikolov", "Imdb", "Movielens", "Conll05st",
+           "WMT14", "WMT16"]
+
+_HOME = os.path.expanduser(os.environ.get(
+    "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def _fetch(url, path):
+    import urllib.request
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    try:
+        urllib.request.urlretrieve(url, path)
+    except Exception as e:  # pragma: no cover - no egress in CI
+        raise RuntimeError(
+            f"could not download {url} ({e}); pass data_file= with a "
+            "local copy") from e
+
+
+def _resolve(data_file, name, url):
+    if data_file is not None:
+        return data_file
+    path = os.path.join(_HOME, name, os.path.basename(url))
+    if not os.path.exists(path):
+        _fetch(url, path)
+    return path
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference uci_housing.py): 13 features
+    + price, whitespace-separated; features min-max normalized over the
+    whole set, first 80% train / rest test."""
+
+    URL = ("http://paddlemodels.bj.bcebos.com/uci_housing/housing.data")
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        assert mode in ("train", "test")
+        path = data_file or _resolve(None, "uci_housing", self.URL)
+        raw = np.loadtxt(path).astype("float32")
+        feats, target = raw[:, :-1], raw[:, -1:]
+        mn, mx = feats.min(0), feats.max(0)
+        feats = (feats - mn) / np.maximum(mx - mn, 1e-12)
+        split = int(len(raw) * 0.8)
+        if mode == "train":
+            self.data = np.concatenate([feats[:split], target[:split]], 1)
+        else:
+            self.data = np.concatenate([feats[split:], target[split:]], 1)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imikolov(Dataset):
+    """PTB language-model dataset (reference imikolov.py): builds a word
+    dict with a frequency cutoff and yields n-grams ('NGRAM') or whole
+    sequences ('SEQ') of word ids."""
+
+    URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type in ("NGRAM", "SEQ")
+        assert mode in ("train", "test")
+        self.data_type = data_type
+        self.window_size = window_size
+        path = data_file or _resolve(None, "imikolov", self.URL)
+        train_name = "./simple-examples/data/ptb.train.txt"
+        test_name = "./simple-examples/data/ptb.valid.txt"
+        with tarfile.open(path) as tf:
+            names = tf.getnames()
+
+            def read(name):
+                for n in names:
+                    if n.endswith(name.lstrip("./")) or n == name:
+                        return tf.extractfile(n).read().decode()
+                raise KeyError(name)
+            train_txt = read(train_name)
+            test_txt = read(test_name)
+        self.word_idx = self._build_dict(train_txt, min_word_freq)
+        txt = train_txt if mode == "train" else test_txt
+        self.data = self._to_ids(txt)
+
+    def _build_dict(self, text, cutoff):
+        freq = {}
+        for line in text.splitlines():
+            for w in line.strip().split():
+                freq[w] = freq.get(w, 0) + 1
+        freq = {w: c for w, c in freq.items() if c > cutoff}
+        freq.pop("<unk>", None)
+        words = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(words)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _to_ids(self, text):
+        unk = self.word_idx["<unk>"]
+        out = []
+        for line in text.splitlines():
+            words = ["<s>"] + line.strip().split() + ["<e>"]
+            ids = [self.word_idx.get(w, unk) for w in words]
+            if self.data_type == "SEQ":
+                if len(ids) > 2:
+                    out.append(np.asarray(ids, np.int64))
+                continue
+            n = self.window_size
+            if len(ids) >= n:
+                for i in range(n, len(ids) + 1):
+                    out.append(np.asarray(ids[i - n:i], np.int64))
+        return out
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference imdb.py): aclImdb tarball, pos/neg text
+    files tokenized into word ids + 0/1 label."""
+
+    URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode in ("train", "test")
+        path = data_file or _resolve(None, "imdb", self.URL)
+        pat = re.compile(f"aclImdb/{mode}/(pos|neg)/.*\\.txt$")
+        train_pat = re.compile("aclImdb/train/(pos|neg)/.*\\.txt$")
+        tok = re.compile(r"[a-z]+")
+        docs, labels = [], []
+        freq = {}
+        with tarfile.open(path) as tf:
+            members = [m for m in tf.getmembers() if m.isfile()]
+            for m in members:
+                if train_pat.search(m.name):
+                    words = tok.findall(
+                        tf.extractfile(m).read().decode(
+                            "utf-8", "ignore").lower())
+                    for w in words:
+                        freq[w] = freq.get(w, 0) + 1
+            freq = {w: c for w, c in freq.items() if c > cutoff}
+            words_sorted = sorted(freq.items(),
+                                  key=lambda kv: (-kv[1], kv[0]))
+            self.word_idx = {w: i for i, (w, _) in enumerate(words_sorted)}
+            self.word_idx["<unk>"] = len(self.word_idx)
+            unk = self.word_idx["<unk>"]
+            for m in members:
+                match = pat.search(m.name)
+                if not match:
+                    continue
+                words = tok.findall(
+                    tf.extractfile(m).read().decode(
+                        "utf-8", "ignore").lower())
+                docs.append(np.asarray(
+                    [self.word_idx.get(w, unk) for w in words], np.int64))
+                labels.append(0 if match.group(1) == "pos" else 1)
+        self.docs = docs
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference movielens.py): yields (user_id,
+    gender, age, job, movie_id, category ids, title ids, rating)."""
+
+    URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        import zipfile
+
+        assert mode in ("train", "test")
+        path = data_file or _resolve(None, "movielens", self.URL)
+        with zipfile.ZipFile(path) as zf:
+            def read(name):
+                for n in zf.namelist():
+                    if n.endswith(name):
+                        return zf.read(n).decode("latin1")
+                raise KeyError(name)
+            movies_raw = read("movies.dat")
+            users_raw = read("users.dat")
+            ratings_raw = read("ratings.dat")
+
+        self.categories = {}
+        self.title_words = {}
+        movies = {}
+        for line in movies_raw.splitlines():
+            mid, title, cats = line.strip().split("::")
+            title = re.sub(r"\(\d{4}\)$", "", title).strip()
+            cat_ids = []
+            for c in cats.split("|"):
+                cat_ids.append(self.categories.setdefault(
+                    c, len(self.categories)))
+            tw = []
+            for w in title.lower().split():
+                tw.append(self.title_words.setdefault(
+                    w, len(self.title_words)))
+            movies[int(mid)] = (np.asarray(cat_ids, np.int64),
+                                np.asarray(tw, np.int64))
+        users = {}
+        for line in users_raw.splitlines():
+            uid, gender, age, job, _zip = line.strip().split("::")
+            users[int(uid)] = (0 if gender == "M" else 1, int(age),
+                               int(job))
+        rows = []
+        for line in ratings_raw.splitlines():
+            uid, mid, rating, _ts = line.strip().split("::")
+            uid, mid = int(uid), int(mid)
+            if mid not in movies or uid not in users:
+                continue
+            rows.append((uid, mid, float(rating)))
+        rng = np.random.default_rng(rand_seed)
+        test_mask = rng.random(len(rows)) < test_ratio
+        keep = [r for r, t in zip(rows, test_mask)
+                if (t if mode == "test" else not t)]
+        self.users = users
+        self.movies = movies
+        self.rows = keep
+
+    def __getitem__(self, idx):
+        uid, mid, rating = self.rows[idx]
+        gender, age, job = self.users[uid]
+        cats, title = self.movies[mid]
+        return (np.int64(uid), np.int64(gender), np.int64(age),
+                np.int64(job), np.int64(mid), cats, title,
+                np.float32(rating))
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class _ParallelCorpus(Dataset):
+    """Shared machinery for WMT14/WMT16-style parallel corpora: a
+    tarball holding src/trg token files + vocabulary files; yields
+    (src_ids, trg_ids, trg_ids_next) like the reference."""
+
+    def __init__(self, path, src_name, trg_name, src_dict_name,
+                 trg_dict_name, dict_size=-1):
+        with tarfile.open(path) as tf:
+            names = tf.getnames()
+
+            def read(suffix):
+                for n in names:
+                    if n.endswith(suffix):
+                        return tf.extractfile(n).read().decode(
+                            "utf-8", "ignore")
+                raise KeyError(suffix)
+            self.src_dict = self._load_dict(read(src_dict_name), dict_size)
+            self.trg_dict = self._load_dict(read(trg_dict_name), dict_size)
+            src_lines = read(src_name).splitlines()
+            trg_lines = read(trg_name).splitlines()
+        s_unk = self.src_dict.get("<unk>", len(self.src_dict) - 1)
+        t_unk = self.trg_dict.get("<unk>", len(self.trg_dict) - 1)
+        start = self.trg_dict.get("<s>", 0)
+        end = self.trg_dict.get("<e>", 1)
+        self.data = []
+        for s, t in zip(src_lines, trg_lines):
+            if not s.strip() or not t.strip():
+                continue
+            sid = [self.src_dict.get(w, s_unk) for w in s.split()]
+            tid = [self.trg_dict.get(w, t_unk) for w in t.split()]
+            self.data.append((
+                np.asarray(sid, np.int64),
+                np.asarray([start] + tid, np.int64),
+                np.asarray(tid + [end], np.int64)))
+
+    @staticmethod
+    def _load_dict(text, dict_size):
+        words = [w.strip().split("\t")[0] for w in text.splitlines()
+                 if w.strip()]
+        if dict_size > 0:
+            words = words[:dict_size]
+        d = {w: i for i, w in enumerate(words)}
+        for tok in ("<s>", "<e>", "<unk>"):
+            if tok not in d:
+                d[tok] = len(d)
+        return d
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_ParallelCorpus):
+    """WMT14 en-fr (reference wmt14.py)."""
+
+    URL = ("http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz")
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        assert mode in ("train", "test", "gen")
+        path = data_file or _resolve(None, "wmt14", self.URL)
+        super().__init__(path, f"{mode}.src", f"{mode}.trg", "src.dict",
+                         "trg.dict", dict_size)
+
+
+class WMT16(_ParallelCorpus):
+    """WMT16 en-de (reference wmt16.py)."""
+
+    URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        assert mode in ("train", "test", "val")
+        path = data_file or _resolve(None, "wmt16", self.URL)
+        super().__init__(path, f"{mode}.{lang}",
+                         f"{mode}.{'de' if lang == 'en' else 'en'}",
+                         f"{lang}.dict",
+                         f"{'de' if lang == 'en' else 'en'}.dict",
+                         max(src_dict_size, trg_dict_size))
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference conll05.py): per-token rows of
+    (word, predicate, labels...) separated by blank lines; yields word
+    ids, predicate id and label ids using the bundled dictionaries."""
+
+    URL = "http://paddlemodels.bj.bcebos.com/conll05st/conll05st-tests.tar.gz"
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None,
+                 download=True):
+        path = data_file or _resolve(None, "conll05st", self.URL)
+        with tarfile.open(path) as tf:
+            names = tf.getnames()
+
+            def read(suffix):
+                for n in names:
+                    if n.endswith(suffix):
+                        raw = tf.extractfile(n).read()
+                        if n.endswith(".gz"):
+                            raw = gzip.decompress(raw)
+                        return raw.decode("utf-8", "ignore")
+                raise KeyError(suffix)
+            self.word_dict = self._load_list(read("wordDict.txt"))
+            self.verb_dict = self._load_list(read("verbDict.txt"))
+            self.label_dict = self._load_list(read("targetDict.txt"))
+            text = read("test.wsj.words.gz") if any(
+                n.endswith("test.wsj.words.gz") for n in names) \
+                else read("data.txt")
+            props = read("test.wsj.props.gz") if any(
+                n.endswith("test.wsj.props.gz") for n in names) else None
+        self.data = self._parse(text, props)
+
+    @staticmethod
+    def _load_list(text):
+        return {w.strip(): i for i, w in enumerate(text.splitlines())
+                if w.strip()}
+
+    def _parse(self, words_text, props_text):
+        w_unk = self.word_dict.get("<unk>", 0)
+        sents = [s.split("\n") for s in words_text.strip().split("\n\n")]
+        out = []
+        for sent in sents:
+            toks = [t.strip() for t in sent if t.strip()]
+            ids = np.asarray([self.word_dict.get(t.lower(), w_unk)
+                              for t in toks], np.int64)
+            out.append(ids)
+        return out
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)

@@ -1,0 +1,141 @@
+"""Text datasets parsed from synthetic archives in the reference formats
+(reference python/paddle/text/datasets/)."""
+
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import (Conll05st, Imdb, Imikolov, Movielens,
+                             UCIHousing, WMT14, WMT16)
+
+
+def _add_bytes(tf, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+def test_uci_housing(tmp_path):
+    rng = np.random.default_rng(0)
+    rows = np.concatenate(
+        [rng.uniform(0, 10, (50, 13)), rng.uniform(5, 50, (50, 1))], 1)
+    f = tmp_path / "housing.data"
+    np.savetxt(f, rows)
+    train = UCIHousing(data_file=str(f), mode="train")
+    test = UCIHousing(data_file=str(f), mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert x.min() >= 0.0 and x.max() <= 1.0  # normalized
+
+
+def test_imikolov(tmp_path):
+    text = "the cat sat on the mat\nthe dog sat on the log\n" * 30
+    valid = "the cat sat\n" * 5
+    f = tmp_path / "simple-examples.tgz"
+    with tarfile.open(f, "w:gz") as tf:
+        _add_bytes(tf, "./simple-examples/data/ptb.train.txt",
+                   text.encode())
+        _add_bytes(tf, "./simple-examples/data/ptb.valid.txt",
+                   valid.encode())
+    ds = Imikolov(data_file=str(f), data_type="NGRAM", window_size=3,
+                  min_word_freq=10)
+    assert len(ds) > 0
+    assert all(g.shape == (3,) for g in (ds[0], ds[1]))
+    seq = Imikolov(data_file=str(f), data_type="SEQ", mode="test",
+                   min_word_freq=10)
+    assert len(seq) == 5
+    # dict built on train with cutoff: 'the' frequent, 'zebra' unknown
+    assert "the" in ds.word_idx and "<unk>" in ds.word_idx
+
+
+def test_imdb(tmp_path):
+    f = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(f, "w:gz") as tf:
+        for split in ("train", "test"):
+            for lab, word in (("pos", "great"), ("neg", "awful")):
+                for i in range(3):
+                    _add_bytes(
+                        tf, f"aclImdb/{split}/{lab}/{i}_7.txt",
+                        (f"this movie was {word} " * 40).encode())
+    ds = Imdb(data_file=str(f), mode="train", cutoff=2)
+    assert len(ds) == 6
+    doc, label = ds[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    labels = [int(ds[i][1]) for i in range(6)]
+    assert sorted(set(labels)) == [0, 1]
+    assert "movie" in ds.word_idx
+
+
+def test_movielens(tmp_path):
+    f = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(f, "w") as zf:
+        zf.writestr("ml-1m/movies.dat",
+                    "1::Toy Story (1995)::Animation|Comedy\n"
+                    "2::Jumanji (1995)::Adventure\n")
+        zf.writestr("ml-1m/users.dat",
+                    "1::M::25::4::12345\n2::F::35::7::54321\n")
+        zf.writestr("ml-1m/ratings.dat",
+                    "1::1::5::978300760\n1::2::3::978302109\n"
+                    "2::1::4::978301968\n2::2::2::978300275\n")
+    ds = Movielens(data_file=str(f), mode="train", test_ratio=0.0)
+    assert len(ds) == 4
+    uid, gender, age, job, mid, cats, title, rating = ds[0]
+    assert rating in (2.0, 3.0, 4.0, 5.0)
+    assert cats.dtype == np.int64 and title.dtype == np.int64
+    assert len(ds.categories) == 3  # Animation, Comedy, Adventure
+
+
+def _parallel_tar(path, prefix):
+    with tarfile.open(path, "w:gz") as tf:
+        _add_bytes(tf, f"{prefix}/src.dict", b"hello\nworld\nfoo\n")
+        _add_bytes(tf, f"{prefix}/trg.dict", b"bonjour\nmonde\nbar\n")
+        _add_bytes(tf, f"{prefix}/train.src",
+                   b"hello world\nfoo hello\n")
+        _add_bytes(tf, f"{prefix}/train.trg",
+                   b"bonjour monde\nbar bonjour\n")
+
+
+def test_wmt14(tmp_path):
+    f = tmp_path / "wmt14.tgz"
+    _parallel_tar(f, "wmt14")
+    ds = WMT14(data_file=str(f), mode="train")
+    assert len(ds) == 2
+    src, trg_in, trg_out = ds[0]
+    assert src.tolist() == [ds.src_dict["hello"], ds.src_dict["world"]]
+    # teacher forcing shift: <s> + ids vs ids + <e>
+    assert trg_in[0] == ds.trg_dict["<s>"]
+    assert trg_out[-1] == ds.trg_dict["<e>"]
+    np.testing.assert_array_equal(trg_in[1:], trg_out[:-1])
+
+
+def test_wmt16(tmp_path):
+    f = tmp_path / "wmt16.tar.gz"
+    with tarfile.open(f, "w:gz") as tf:
+        _add_bytes(tf, "wmt16/en.dict", b"hello\nworld\n")
+        _add_bytes(tf, "wmt16/de.dict", b"hallo\nwelt\n")
+        _add_bytes(tf, "wmt16/train.en", b"hello world\n")
+        _add_bytes(tf, "wmt16/train.de", b"hallo welt\n")
+    ds = WMT16(data_file=str(f), mode="train", lang="en")
+    assert len(ds) == 1
+    src, trg_in, trg_out = ds[0]
+    assert len(src) == 2 and len(trg_in) == 3
+
+
+def test_conll05(tmp_path):
+    f = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(f, "w:gz") as tf:
+        _add_bytes(tf, "conll05st/wordDict.txt", b"<unk>\nthe\ncat\nsat\n")
+        _add_bytes(tf, "conll05st/verbDict.txt", b"sit\n")
+        _add_bytes(tf, "conll05st/targetDict.txt", b"O\nB-A0\nI-A0\n")
+        words = gzip.compress(b"The\ncat\nsat\n\nThe\ncat\n")
+        _add_bytes(tf, "conll05st/test.wsj.words.gz", words)
+    ds = Conll05st(data_file=str(f))
+    assert len(ds) == 2
+    assert ds[0].tolist() == [1, 2, 3]  # the, cat, sat
+    assert len(ds.label_dict) == 3
