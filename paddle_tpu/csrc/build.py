@@ -19,6 +19,7 @@ _BUILD_DIR = os.path.join(_SRC_DIR, "_build")
 _LIBS = {
     "pt_store": ["tcp_store.cc"],
     "pt_data": ["token_dataset.cc"],
+    "pt_shm": ["shm_ring.cc"],
 }
 _loaded: dict[str, ctypes.CDLL] = {}
 _lock = threading.Lock()

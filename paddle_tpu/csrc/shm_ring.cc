@@ -1,0 +1,243 @@
+// Shared-memory ring buffer for DataLoader worker->parent transfer.
+//
+// Parity: the reference's dataloader moves worker tensors through shared
+// memory (python/paddle/io/dataloader/dataloader_iter.py
+// `use_shared_memory` + `paddle/fluid/memory/allocation/mmap_allocator.cc`).
+// This is the TPU-build equivalent: a POSIX shm segment holding a
+// variable-record MPSC ring, synchronized with process-shared pthread
+// mutex/condvars so numpy batch payloads never cross a pipe or pickle
+// socket.
+//
+// C ABI (ctypes-bound from paddle_tpu/io/shm_channel.py):
+//   shm_ring_create(name, capacity)  -> handle (parent, owns unlink)
+//   shm_ring_open(name)              -> handle (workers)
+//   shm_ring_write(h, buf, len, timeout_ms) -> 0 ok, -1 timeout, -2 err
+//   shm_ring_read_len(h, timeout_ms)        -> next record len, -1/-2
+//   shm_ring_read(h, buf, maxlen)           -> record len, -2 err
+//   shm_ring_close(h), shm_ring_unlink(name)
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+struct RingHeader {
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t capacity;  // data bytes
+  uint64_t head;      // read offset  (absolute, monotonically increasing)
+  uint64_t tail;      // write offset (absolute)
+  uint32_t magic;
+};
+
+constexpr uint32_t kMagic = 0x52494e47;  // "RING"
+
+struct Handle {
+  RingHeader* hdr;
+  uint8_t* data;
+  size_t map_len;
+  bool owner;
+  char name[256];
+};
+
+void timeout_to_abs(long timeout_ms, timespec* ts) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+uint64_t used(const RingHeader* h) { return h->tail - h->head; }
+
+void copy_in(Handle* h, uint64_t at, const uint8_t* src, uint64_t n) {
+  uint64_t cap = h->hdr->capacity;
+  uint64_t off = at % cap;
+  uint64_t first = n < cap - off ? n : cap - off;
+  memcpy(h->data + off, src, first);
+  if (n > first) memcpy(h->data, src + first, n - first);
+}
+
+void copy_out(Handle* h, uint64_t at, uint8_t* dst, uint64_t n) {
+  uint64_t cap = h->hdr->capacity;
+  uint64_t off = at % cap;
+  uint64_t first = n < cap - off ? n : cap - off;
+  memcpy(dst, h->data + off, first);
+  if (n > first) memcpy(dst + first, h->data, n - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shm_ring_create(const char* name, uint64_t capacity) {
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t total = sizeof(RingHeader) + capacity;
+  if (ftruncate(fd, (off_t)total) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd,
+                   0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = reinterpret_cast<RingHeader*>(mem);
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&hdr->not_empty, &ca);
+  pthread_cond_init(&hdr->not_full, &ca);
+  hdr->capacity = capacity;
+  hdr->head = 0;
+  hdr->tail = 0;
+  hdr->magic = kMagic;
+  auto* h = new Handle;
+  h->hdr = hdr;
+  h->data = reinterpret_cast<uint8_t*>(mem) + sizeof(RingHeader);
+  h->map_len = total;
+  h->owner = true;
+  strncpy(h->name, name, sizeof(h->name) - 1);
+  h->name[sizeof(h->name) - 1] = 0;
+  return h;
+}
+
+void* shm_ring_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem =
+      mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* hdr = reinterpret_cast<RingHeader*>(mem);
+  if (hdr->magic != kMagic) {
+    munmap(mem, st.st_size);
+    return nullptr;
+  }
+  auto* h = new Handle;
+  h->hdr = hdr;
+  h->data = reinterpret_cast<uint8_t*>(mem) + sizeof(RingHeader);
+  h->map_len = st.st_size;
+  h->owner = false;
+  strncpy(h->name, name, sizeof(h->name) - 1);
+  h->name[sizeof(h->name) - 1] = 0;
+  return h;
+}
+
+static int lock_robust(RingHeader* hdr) {
+  int rc = pthread_mutex_lock(&hdr->mu);
+  if (rc == EOWNERDEAD) {  // a worker died holding the lock
+    pthread_mutex_consistent(&hdr->mu);
+    return 0;
+  }
+  return rc;
+}
+
+int shm_ring_write(void* handle, const uint8_t* buf, uint64_t len,
+                   long timeout_ms) {
+  auto* h = reinterpret_cast<Handle*>(handle);
+  RingHeader* hdr = h->hdr;
+  uint64_t need = len + 8;
+  if (need > hdr->capacity) return -2;
+  timespec ts;
+  timeout_to_abs(timeout_ms, &ts);
+  if (lock_robust(hdr) != 0) return -2;
+  while (hdr->capacity - used(hdr) < need) {
+    int rc = pthread_cond_timedwait(&hdr->not_full, &hdr->mu, &ts);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -1;
+    }
+    if (rc != 0 && rc != EOWNERDEAD) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -2;
+    }
+  }
+  uint64_t lenle = len;
+  copy_in(h, hdr->tail, reinterpret_cast<uint8_t*>(&lenle), 8);
+  copy_in(h, hdr->tail + 8, buf, len);
+  hdr->tail += need;
+  pthread_cond_signal(&hdr->not_empty);
+  pthread_mutex_unlock(&hdr->mu);
+  return 0;
+}
+
+long long shm_ring_read_len(void* handle, long timeout_ms) {
+  auto* h = reinterpret_cast<Handle*>(handle);
+  RingHeader* hdr = h->hdr;
+  timespec ts;
+  timeout_to_abs(timeout_ms, &ts);
+  if (lock_robust(hdr) != 0) return -2;
+  while (used(hdr) < 8) {
+    int rc = pthread_cond_timedwait(&hdr->not_empty, &hdr->mu, &ts);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -1;
+    }
+    if (rc != 0 && rc != EOWNERDEAD) {
+      pthread_mutex_unlock(&hdr->mu);
+      return -2;
+    }
+  }
+  uint64_t len = 0;
+  copy_out(h, hdr->head, reinterpret_cast<uint8_t*>(&len), 8);
+  pthread_mutex_unlock(&hdr->mu);
+  return (long long)len;
+}
+
+long long shm_ring_read(void* handle, uint8_t* buf, uint64_t maxlen) {
+  auto* h = reinterpret_cast<Handle*>(handle);
+  RingHeader* hdr = h->hdr;
+  if (lock_robust(hdr) != 0) return -2;
+  if (used(hdr) < 8) {
+    pthread_mutex_unlock(&hdr->mu);
+    return -2;
+  }
+  uint64_t len = 0;
+  copy_out(h, hdr->head, reinterpret_cast<uint8_t*>(&len), 8);
+  if (len > maxlen || used(hdr) < 8 + len) {
+    pthread_mutex_unlock(&hdr->mu);
+    return -2;
+  }
+  copy_out(h, hdr->head + 8, buf, len);
+  hdr->head += 8 + len;
+  pthread_cond_signal(&hdr->not_full);
+  pthread_mutex_unlock(&hdr->mu);
+  return (long long)len;
+}
+
+void shm_ring_close(void* handle) {
+  auto* h = reinterpret_cast<Handle*>(handle);
+  munmap(h->hdr, h->map_len);
+  delete h;
+}
+
+void shm_ring_unlink(const char* name) { shm_unlink(name); }
+
+}  // extern "C"

@@ -313,6 +313,7 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
+        self.use_shared_memory = use_shared_memory
         # process workers (reference behavior) by default; threads remain
         # as an explicit opt-out for un-forkable setups
         self.use_process_workers = use_process_workers
@@ -412,31 +413,55 @@ class DataLoader:
             return {k: DataLoader._tensor_leaves(v) for k, v in obj.items()}
         return obj
 
-    def _worker_loop(self, wid, index_q, result_q):
+    def _worker_loop(self, wid, index_q, result_q, shm_name):
         _mp_worker_info[0] = _WorkerInfo(wid, self.num_workers,
                                          self.dataset)
         if self.worker_init_fn is not None:
             self.worker_init_fn(wid)
+        chan = None
+        if shm_name is not None:
+            from .shm_channel import ShmChannel
+            chan = ShmChannel(name=shm_name, create=False)
         collate = self.collate_fn
+
+        def emit(msg):
+            if chan is not None:
+                chan.put(msg)
+            else:
+                result_q.put(msg)
+
         while True:
             job = index_q.get()
             if job is None:
-                result_q.put(("done", wid, None))
+                emit(("done", wid, None))
                 return
             seq, indices = job
             try:
                 batch = collate([self.dataset[i] for i in indices])
-                result_q.put(("ok", seq, self._np_leaves(batch)))
+                emit(("ok", seq, self._np_leaves(batch)))
             except Exception:
-                result_q.put(("error", seq, traceback.format_exc()))
+                emit(("error", seq, traceback.format_exc()))
                 return
 
     def _iter_multiprocess(self):
         ctx = mp.get_context("fork")
         index_q = ctx.Queue()
         result_q = ctx.Queue()
+        chan = None
+        shm_name = None
+        if self.use_shared_memory:
+            # worker batches travel through the native shm ring
+            # (csrc/shm_ring.cc) instead of the pickle pipe — the
+            # reference's mmap_allocator shared-memory path
+            try:
+                from .shm_channel import ShmChannel
+                chan = ShmChannel()
+                shm_name = chan.name
+            except Exception:
+                chan = None
         procs = [ctx.Process(target=self._worker_loop,
-                             args=(w, index_q, result_q), daemon=True)
+                             args=(w, index_q, result_q, shm_name),
+                             daemon=True)
                  for w in range(self.num_workers)]
         for p in procs:
             p.start()
@@ -447,11 +472,17 @@ class DataLoader:
         for _ in procs:
             index_q.put(None)
         timeout = self.timeout or None
+
+        def fetch():
+            if chan is not None:
+                return chan.get(timeout_ms=int((timeout or 600) * 1000))
+            return result_q.get(timeout=timeout)
+
         try:
             done, next_seq, hold = 0, 0, {}
             received = 0
             while received < n_batches and done < self.num_workers:
-                kind, seq, payload = result_q.get(timeout=timeout)
+                kind, seq, payload = fetch()
                 if kind == "done":
                     done += 1
                     continue
@@ -472,6 +503,8 @@ class DataLoader:
                     p.terminate()
             for p in procs:
                 p.join(timeout=5)
+            if chan is not None:
+                chan.close()
 
     def __iter__(self):
         if self._iterable:

@@ -1,0 +1,162 @@
+"""Shared-memory channel over the native ring (csrc/shm_ring.cc).
+
+The reference dataloader's `use_shared_memory` path
+(dataloader_iter.py + mmap_allocator.cc): worker batches travel through
+one shm segment instead of a pickle pipe. Records are a pickled tree
+with ndarray leaves replaced by placeholders + the raw buffers
+concatenated after it — arrays are never pickled.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..csrc.build import load_library
+
+__all__ = ["ShmChannel", "available"]
+
+
+def _lib():
+    lib = load_library("pt_shm")
+    lib.shm_ring_create.restype = ctypes.c_void_p
+    lib.shm_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shm_ring_open.restype = ctypes.c_void_p
+    lib.shm_ring_open.argtypes = [ctypes.c_char_p]
+    lib.shm_ring_write.restype = ctypes.c_int
+    lib.shm_ring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64, ctypes.c_long]
+    lib.shm_ring_read_len.restype = ctypes.c_longlong
+    lib.shm_ring_read_len.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    lib.shm_ring_read.restype = ctypes.c_longlong
+    lib.shm_ring_read.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_uint64]
+    lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+    lib.shm_ring_unlink.argtypes = [ctypes.c_char_p]
+    return lib
+
+
+def available():
+    try:
+        _lib()
+        return True
+    except Exception:
+        return False
+
+
+_ARRAY = "__pt_shm_ndarray__"
+
+
+def _encode(obj):
+    """(pickled-tree bytes, [raw buffers]) with arrays hoisted out."""
+    buffers = []
+
+    def strip(o):
+        if isinstance(o, np.ndarray):
+            a = np.ascontiguousarray(o)
+            buffers.append(a)
+            return (_ARRAY, len(buffers) - 1, a.dtype.str, a.shape)
+        if isinstance(o, tuple):
+            return tuple(strip(x) for x in o)
+        if isinstance(o, list):
+            return [strip(x) for x in o]
+        if isinstance(o, dict):
+            return {k: strip(v) for k, v in o.items()}
+        return o
+
+    tree = pickle.dumps(strip(obj))
+    parts = [struct.pack("<II", len(tree), len(buffers)), tree]
+    for a in buffers:
+        raw = a.tobytes()
+        parts.append(struct.pack("<Q", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def _decode(payload):
+    tlen, nbuf = struct.unpack_from("<II", payload, 0)
+    off = 8
+    tree = pickle.loads(payload[off:off + tlen])
+    off += tlen
+    buffers = []
+    for _ in range(nbuf):
+        (blen,) = struct.unpack_from("<Q", payload, off)
+        off += 8
+        buffers.append(payload[off:off + blen])
+        off += blen
+
+    def rebuild(o):
+        if isinstance(o, tuple) and len(o) == 4 and o[0] == _ARRAY:
+            _, i, dtype, shape = o
+            return np.frombuffer(buffers[i], dtype=dtype).reshape(shape)
+        if isinstance(o, tuple):
+            return tuple(rebuild(x) for x in o)
+        if isinstance(o, list):
+            return [rebuild(x) for x in o]
+        if isinstance(o, dict):
+            return {k: rebuild(v) for k, v in o.items()}
+        return o
+
+    return rebuild(tree)
+
+
+class ShmChannel:
+    """MPSC channel: many writer processes, one reader (the parent)."""
+
+    def __init__(self, capacity=64 << 20, name=None, create=True):
+        self.name = name or f"/pt_shm_{os.getpid()}_{id(self)}"
+        self._lib = _lib()
+        if create:
+            self._h = self._lib.shm_ring_create(self.name.encode(),
+                                                capacity)
+        else:
+            self._h = self._lib.shm_ring_open(self.name.encode())
+        if not self._h:
+            raise OSError(f"shm ring {'create' if create else 'open'} "
+                          f"failed for {self.name}")
+        self._owner = create
+
+    def attach(self):
+        """Re-open in a child process (fork inherits the handle safely,
+        but an explicit open keeps lifetimes independent)."""
+        return ShmChannel(name=self.name, create=False)
+
+    def put(self, obj, timeout_ms=60_000):
+        payload = _encode(obj)
+        rc = self._lib.shm_ring_write(self._h, payload, len(payload),
+                                      timeout_ms)
+        if rc == -1:
+            raise TimeoutError("shm ring full")
+        if rc != 0:
+            raise OSError(f"shm ring write failed (record "
+                          f"{len(payload)} bytes)")
+
+    def get(self, timeout_ms=60_000):
+        n = self._lib.shm_ring_read_len(self._h, timeout_ms)
+        if n == -1:
+            raise TimeoutError("shm ring empty")
+        if n < 0:
+            raise OSError("shm ring read_len failed")
+        buf = ctypes.create_string_buffer(int(n))
+        got = self._lib.shm_ring_read(self._h, buf, int(n))
+        if got < 0:
+            raise OSError("shm ring read failed")
+        return _decode(buf.raw[:got])
+
+    def close(self):
+        if self._h:
+            self._lib.shm_ring_close(self._h)
+            self._h = None
+        if self._owner:
+            self._lib.shm_ring_unlink(self.name.encode())
+            self._owner = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,89 @@
+"""Native shared-memory ring (csrc/shm_ring.cc + io/shm_channel.py)."""
+
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io.shm_channel import ShmChannel, available
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="native shm ring unavailable")
+
+
+def test_roundtrip_structured():
+    ch = ShmChannel(capacity=1 << 20)
+    try:
+        msg = ("tag", 7, [np.arange(6).reshape(2, 3),
+                          {"w": np.ones((4,), np.float32)}], None)
+        ch.put(msg)
+        tag, n, (a, d), none = ch.get()
+        assert tag == "tag" and n == 7 and none is None
+        np.testing.assert_array_equal(a, np.arange(6).reshape(2, 3))
+        assert d["w"].dtype == np.float32
+    finally:
+        ch.close()
+
+
+def test_many_records_wrap_around():
+    """Records larger than capacity/2 force ring wrap-around."""
+    ch = ShmChannel(capacity=1 << 16)
+    try:
+        for i in range(50):
+            ch.put(np.full((1000,), i, np.int32))
+            out = ch.get()
+            assert out[0] == i and out.shape == (1000,)
+    finally:
+        ch.close()
+
+
+def test_multiple_producers():
+    ch = ShmChannel(capacity=4 << 20)
+
+    def producer(name, wid):
+        c = ShmChannel(name=name, create=False)
+        for i in range(20):
+            c.put((wid, i, np.full((64,), wid * 100 + i, np.int64)))
+
+    try:
+        procs = [mp.get_context("fork").Process(
+            target=producer, args=(ch.name, w)) for w in range(3)]
+        for p in procs:
+            p.start()
+        seen = set()
+        for _ in range(60):
+            wid, i, arr = ch.get()
+            assert arr[0] == wid * 100 + i
+            seen.add((wid, i))
+        assert len(seen) == 60
+        for p in procs:
+            p.join()
+    finally:
+        ch.close()
+
+
+def test_timeout_on_empty():
+    ch = ShmChannel(capacity=1 << 16)
+    try:
+        with pytest.raises(TimeoutError):
+            ch.get(timeout_ms=100)
+    finally:
+        ch.close()
+
+
+class _Ds(Dataset):
+    def __getitem__(self, i):
+        return np.full((128,), i, np.float32)
+
+    def __len__(self):
+        return 16
+
+
+def test_dataloader_shm_vs_pipe_identical():
+    a = [b.numpy() for b in DataLoader(_Ds(), batch_size=4, num_workers=2,
+                                       use_shared_memory=True)]
+    b = [x.numpy() for x in DataLoader(_Ds(), batch_size=4, num_workers=2,
+                                       use_shared_memory=False)]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
