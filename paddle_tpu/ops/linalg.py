@@ -16,7 +16,9 @@ __all__ = [
     "eig", "eigh", "eigvals", "eigvalsh", "inv", "pinv", "solve",
     "triangular_solve", "lstsq", "matrix_power", "det", "slogdet",
     "multi_dot", "matrix_rank", "cov", "corrcoef", "histogram",
-    "histogramdd", "lu", "lu_unpack", "trace", "cond",
+    "histogramdd", "lu", "lu_unpack", "trace", "cond", "matrix_exp",
+    "cholesky_inverse", "householder_product", "ormqr", "pca_lowrank",
+    "svd_lowrank", "fp8_fp8_half_gemm_fused",
 ]
 
 
@@ -292,3 +294,40 @@ def trace(x, offset=0, axis1=0, axis2=1, name=None):
 
 def cond(x, p=None, name=None):
     return apply(lambda a: jnp.linalg.cond(a, p=p), x, name="cond")
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (reference paddle.linalg.matrix_exp)."""
+    import jax
+
+    from ..core.dispatch import apply as _apply
+    return _apply(lambda a: jax.scipy.linalg.expm(a), x, name="matrix_exp")
+
+
+# long-tail entries shared with paddle.* (ops/special.py)
+from .special import (  # noqa: E402,F401
+    cholesky_inverse, householder_product, ormqr, pca_lowrank, svd_lowrank,
+)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="bfloat16", name=None):
+    """fp8 x fp8 -> bf16 GEMM (reference fusion/fp8_gemm cutlass kernel).
+    TPU path: fp8 operands feed dot_general with bf16 accumulation —
+    the MXU consumes fp8 natively on v5p+/v6; elsewhere XLA upconverts."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply as _apply
+
+    def fn(a, b, *mb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a, b, preferred_element_type=jnp.float32) * scale
+        if mb:
+            out = out + mb[0].astype(out.dtype)
+        return out.astype(output_dtype)
+    args = [x, y] + ([bias] if bias is not None else [])
+    return _apply(fn, *args, name="fp8_gemm")

@@ -18,7 +18,12 @@ from ..core.tensor import Tensor
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "SparseCsrTensor", "add", "matmul", "masked_matmul", "mv",
-           "relu", "to_dense", "is_same_shape", "nn", "transpose"]
+           "relu", "to_dense", "is_same_shape", "nn", "transpose",
+           "sin", "sinh", "asin", "asinh", "tan", "tanh", "atan", "atanh",
+           "sqrt", "square", "log1p", "expm1", "abs", "neg", "deg2rad",
+           "rad2deg", "isnan", "pow", "cast", "coalesce", "subtract",
+           "multiply", "divide", "sum", "reshape", "slice", "mask_as",
+           "pca_lowrank"]
 
 
 class SparseCooTensor:
@@ -204,3 +209,118 @@ class _SparseNN:
 
 
 nn = _SparseNN()
+
+
+# ---------------------------------------------------------------------------
+# elementwise value ops (reference python/paddle/sparse/unary.py /
+# binary.py: each applies to the stored values, preserving sparsity)
+# ---------------------------------------------------------------------------
+
+def _unary_valueop(fn, name):
+    def op(x, *args, **kwargs):
+        c = _coo(x)
+        return SparseCooTensor(
+            jsparse.BCOO((fn(c._bcoo.data, *args, **kwargs),
+                          c._bcoo.indices), shape=tuple(c._shape)),
+            c._shape)
+    op.__name__ = name
+    return op
+
+
+sin = _unary_valueop(jnp.sin, "sin")
+sinh = _unary_valueop(jnp.sinh, "sinh")
+asin = _unary_valueop(jnp.arcsin, "asin")
+asinh = _unary_valueop(jnp.arcsinh, "asinh")
+tan = _unary_valueop(jnp.tan, "tan")
+tanh = _unary_valueop(jnp.tanh, "tanh")
+atan = _unary_valueop(jnp.arctan, "atan")
+atanh = _unary_valueop(jnp.arctanh, "atanh")
+sqrt = _unary_valueop(jnp.sqrt, "sqrt")
+square = _unary_valueop(jnp.square, "square")
+log1p = _unary_valueop(jnp.log1p, "log1p")
+expm1 = _unary_valueop(jnp.expm1, "expm1")
+abs = _unary_valueop(jnp.abs, "abs")  # noqa: A001
+neg = _unary_valueop(jnp.negative, "neg")
+deg2rad = _unary_valueop(jnp.deg2rad, "deg2rad")
+rad2deg = _unary_valueop(jnp.rad2deg, "rad2deg")
+isnan = _unary_valueop(jnp.isnan, "isnan")
+
+
+def pow(x, factor):  # noqa: A001
+    return _unary_valueop(lambda v: jnp.power(v, factor), "pow")(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    c = _coo(x)
+    data = c._bcoo.data if value_dtype is None else \
+        c._bcoo.data.astype(value_dtype)
+    idx = c._bcoo.indices if index_dtype is None else \
+        c._bcoo.indices.astype(index_dtype)
+    return SparseCooTensor(jsparse.BCOO((data, idx),
+                                        shape=tuple(c._shape)), c._shape)
+
+
+def coalesce(x):
+    return _coo(x).coalesce()
+
+
+def _binary_valueop(fn, name):
+    def op(x, y):
+        a = _coo(x).coalesce()
+        b = _coo(y).coalesce()
+        # dense-side combine keeps semantics exact for mismatched patterns
+        dense = fn(a._bcoo.todense(), b._bcoo.todense())
+        return SparseCooTensor(jsparse.BCOO.fromdense(dense),
+                               list(dense.shape))
+    op.__name__ = name
+    return op
+
+
+subtract = _binary_valueop(jnp.subtract, "subtract")
+multiply = _binary_valueop(jnp.multiply, "multiply")
+divide = _binary_valueop(jnp.divide, "divide")
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    c = _coo(x)
+    out = jnp.sum(c._bcoo.todense(), axis=axis, dtype=dtype,
+                  keepdims=keepdim)
+    return Tensor(out)
+
+
+def reshape(x, shape):
+    c = _coo(x).coalesce()
+    dense = c._bcoo.todense().reshape(shape)
+    return SparseCooTensor(jsparse.BCOO.fromdense(dense),
+                           list(dense.shape))
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    c = _coo(x).coalesce()
+    dense = c._bcoo.todense()
+    import builtins
+    idx = [builtins.slice(None)] * dense.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(int(st), int(en))
+    out = dense[tuple(idx)]
+    return SparseCooTensor(jsparse.BCOO.fromdense(out), list(out.shape))
+
+
+def mask_as(x, mask):
+    """Keep x's dense values at mask's sparsity pattern (reference
+    sparse/multiary.py mask_as)."""
+    m = _coo(mask).coalesce()
+    dense = unwrap(x) if not isinstance(x, SparseCooTensor) else \
+        x._bcoo.todense()
+    vals = dense[tuple(m._bcoo.indices.T)]
+    return SparseCooTensor(jsparse.BCOO((vals, m._bcoo.indices),
+                                        shape=tuple(m._shape)), m._shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """PCA on a sparse matrix (reference paddle.sparse.pca_lowrank):
+    densify + the shared lowrank path."""
+    from ..ops.special import pca_lowrank as _dense_pca
+    dense = Tensor(_coo(x)._bcoo.todense()) \
+        if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+    return _dense_pca(dense, q=q, center=center, niter=niter)

@@ -1,0 +1,73 @@
+"""Namespace-completeness guards: paddle.linalg / paddle.sparse surface
+vs the reference exports (beyond the tensor-API audit)."""
+
+import re
+
+import numpy as np
+import pytest
+import scipy.linalg
+
+import paddle_tpu as paddle
+
+REF = "/root/reference/python/paddle"
+
+
+def _ref_names(path):
+    src = open(path).read()
+    return set(re.findall(r"^\s+([a-z_][a-z0-9_]*),?\s*(?:#.*)?$", src,
+                          re.M))
+
+
+def test_linalg_surface_complete():
+    names = _ref_names(f"{REF}/linalg.py")
+    missing = sorted(n for n in names if not hasattr(paddle.linalg, n))
+    assert missing == [], missing
+
+
+def test_sparse_surface_complete():
+    names = _ref_names(f"{REF}/sparse/__init__.py")
+    # nn is a submodule surface; drop parse artifacts that aren't exports
+    missing = sorted(n for n in names if not hasattr(paddle.sparse, n))
+    assert missing == [], missing
+
+
+def test_matrix_exp_matches_scipy():
+    a = np.random.default_rng(0).standard_normal((5, 5)).astype(
+        "float32") * 0.3
+    out = paddle.linalg.matrix_exp(paddle.to_tensor(a))
+    np.testing.assert_allclose(out.numpy(), scipy.linalg.expm(a),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_fp8_gemm():
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((8, 16)).astype(
+        "float32")).astype("float8_e4m3fn")
+    y = paddle.to_tensor(rng.standard_normal((16, 8)).astype(
+        "float32")).astype("float8_e4m3fn")
+    out = paddle.linalg.fp8_fp8_half_gemm_fused(x, y)
+    assert str(out.dtype) == "bfloat16" and out.shape == [8, 8]
+    ref = x.numpy().astype(np.float32) @ y.numpy().astype(np.float32)
+    assert np.abs(out.numpy().astype(np.float32) - ref).max() < 1.0
+
+
+def test_sparse_elementwise_and_structural():
+    sp = paddle.sparse.sparse_coo_tensor([[0, 1, 1], [1, 0, 1]],
+                                         [2.0, 3.0, -1.0], [2, 2])
+    sq = paddle.sparse.square(sp)
+    np.testing.assert_allclose(paddle.sparse.to_dense(sq).numpy(),
+                               [[0, 4], [9, 1]])
+    assert float(paddle.sparse.sum(sp)) == 4.0
+    prod = paddle.sparse.multiply(sp, sp)
+    np.testing.assert_allclose(paddle.sparse.to_dense(prod).numpy(),
+                               [[0, 4], [9, 1]])
+    sl = paddle.sparse.slice(sp, [0], [1], [2])
+    np.testing.assert_allclose(paddle.sparse.to_dense(sl).numpy(),
+                               [[3.0, -1.0]])
+    r = paddle.sparse.reshape(sp, [4, 1])
+    assert list(r.shape) == [4, 1]
+    dense = paddle.to_tensor(np.arange(4, dtype="float32").reshape(2, 2))
+    masked = paddle.sparse.mask_as(dense, sp)
+    np.testing.assert_allclose(paddle.sparse.to_dense(masked).numpy(),
+                               [[0, 1], [2, 3]] * np.asarray(
+                                   [[0, 1], [1, 1]], "float32"))
