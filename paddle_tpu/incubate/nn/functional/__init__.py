@@ -25,6 +25,10 @@ __all__ = [
     "fused_multi_head_attention", "fused_feedforward",
     "variable_length_memory_efficient_attention",
     "masked_multihead_attention", "fused_dropout_add",
+    "fused_matmul_bias", "fused_bias_dropout_residual_layer_norm",
+    "fused_dot_product_attention", "cudnn_flash_attention",
+    "block_multihead_attention", "block_multihead_attention_xpu",
+    "fused_multi_transformer",
 ]
 
 
@@ -290,3 +294,198 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
 
     out, new_cache = apply(fn, x, cache_kv, name="masked_mha")
     return out, new_cache
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """Reference fused_matmul_bias (cublasLt epilogue): one matmul with
+    the bias add fused by XLA."""
+    def fn(a, b, *mb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if mb:
+            out = out + mb[0]
+        return out
+    args = [x, y] + ([bias] if bias is not None else [])
+    return apply(fn, *args, name="fused_matmul_bias")
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """Reference fused_bias_dropout_residual_layer_norm: out =
+    layer_norm(residual + dropout(x + bias))."""
+    from ....nn import functional as F
+
+    y = x if bias is None else x + bias
+    y = F.dropout(y, p=dropout_rate, training=training, mode=mode)
+    y = residual + y
+    d = y.shape[-1]
+    return F.layer_norm(y, [d], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_dot_product_attention(
+        q, k, v, bias=None, cu_seqlen_q=None, cu_seqlen_kv=None,
+        scaling_factor=None, dropout_prob=0.0, training=True,
+        is_causal_masking=False, name=None):
+    """Reference fused_dot_product_attention (cuDNN FMHA): [b, s, h, d]
+    SDPA routed to the Pallas flash kernel."""
+    from ....nn import functional as F
+
+    return F.scaled_dot_product_attention(
+        q, k, v, attn_mask=bias, dropout_p=dropout_prob,
+        is_causal=is_causal_masking, training=training)
+
+
+# CUDA-library alias: on TPU both land on the Pallas flash kernel
+cudnn_flash_attention = fused_dot_product_attention
+
+
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, padding_offsets, cum_offsets, cu_seqlens_q,
+        cu_seqlens_k, block_tables, pre_key_cache=None,
+        pre_value_cache=None, cache_k_quant_scales=None,
+        cache_v_quant_scales=None, cache_k_dequant_scales=None,
+        cache_v_dequant_scales=None, qkv_out_scale=None, qkv_bias=None,
+        out_shift=None, out_smooth=None, max_enc_len_this_time=None,
+        max_dec_len_this_time=None, rope_emb=None, mask=None,
+        tgt_mask=None, max_seq_len=-1, block_size=64,
+        use_neox_style=False, use_dynamic_cachekv_quant=False,
+        quant_round_type=1, quant_max_bound=127.0,
+        quant_min_bound=-127.0, out_scale=-1, compute_dtype="default",
+        name=None):
+    """Paged (block-table) attention, decode mode (reference
+    block_multi_head_attention_kernel.cu surface; the full serving
+    engine lives in inference/paged.py — this functional form covers the
+    one-token-per-sequence decode step over an external block pool).
+
+    qkv: [tokens, 3*h*d] packed (tokens == batch in decode mode);
+    key/value_cache: [num_blocks, block_size, kv_heads, head_dim];
+    block_tables: [batch, max_blocks]; seq_lens_decoder: current lengths
+    (the new token writes at that position). Returns (out, qkv, updated
+    key_cache, updated value_cache) like the reference.
+    """
+    from ....inference.paged import (paged_decode_attention,
+                                     paged_decode_write)
+
+    assert cache_k_quant_scales is None and qkv_out_scale is None, \
+        "cache quantization not supported in this build"
+
+    def fn(qkv_a, kc, vc, lens, tables, *maybe_bias):
+        nb, bs, hk, hd = kc.shape
+        b = tables.shape[0]
+        if maybe_bias:
+            qkv_a = qkv_a + maybe_bias[0]
+        total_h = qkv_a.shape[-1] // hd
+        hq = total_h - 2 * hk
+        qkv3 = qkv_a.reshape(b, total_h, hd)
+        qh = qkv3[:, :hq]
+        kh = qkv3[:, hq:hq + hk]
+        vh = qkv3[:, hq + hk:]
+        lens32 = lens.reshape(-1).astype(jnp.int32)
+        active = lens32 >= 0
+        kc2, vc2 = paged_decode_write(kc, vc, tables.astype(jnp.int32),
+                                      jnp.maximum(lens32, 0), kh, vh,
+                                      active)
+        out = paged_decode_attention(
+            qh, kc2, vc2, tables.astype(jnp.int32),
+            jnp.where(active, lens32 + 1, 0))
+        return out.reshape(b, hq * hd), kc2, vc2
+
+    args = [qkv, key_cache, value_cache, seq_lens_decoder, block_tables]
+    if qkv_bias is not None:
+        args.append(qkv_bias)
+    out, kc2, vc2 = apply(fn, *args, name="block_multihead_attention")
+    return out, qkv, kc2, vc2
+
+
+def block_multihead_attention_xpu(*args, **kwargs):
+    return block_multihead_attention(*args, **kwargs)
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, pre_caches=None, rotary_embs=None,
+        time_step=None, attn_mask=None, dropout_rate=0.0,
+        activation="gelu", training=False, mode="upscale_in_train",
+        trans_qkvw=True, ring_id=-1, name=None):
+    """Functional fused_multi_transformer (reference
+    fused_multi_transformer_op.cu surface): a stack of pre-LN decoder
+    layers driven by weight lists; one jit-traceable composition."""
+    from ....nn import functional as F
+
+    out = x
+    new_caches = [] if cache_kvs is not None else None
+    for i in range(len(qkv_weights)):
+        residual = out
+        h = F.layer_norm(out, [out.shape[-1]], weight=ln_scales[i],
+                         bias=ln_biases[i], epsilon=epsilon) \
+            if pre_layer_norm else out
+        attn_out = fused_multi_head_attention_block(
+            h, qkv_weights[i], qkv_biases[i] if qkv_biases else None,
+            linear_weights[i],
+            linear_biases[i] if linear_biases else None,
+            trans_qkvw=trans_qkvw, attn_mask=attn_mask)
+        out = residual + attn_out
+        residual = out
+        h = F.layer_norm(out, [out.shape[-1]], weight=ffn_ln_scales[i],
+                         bias=ffn_ln_biases[i], epsilon=epsilon) \
+            if pre_layer_norm else out
+        act = F.gelu if activation == "gelu" else F.relu
+        h = fused_linear(h, ffn1_weights[i],
+                         ffn1_biases[i] if ffn1_biases else None)
+        h = act(h)
+        h = fused_linear(h, ffn2_weights[i],
+                         ffn2_biases[i] if ffn2_biases else None)
+        out = residual + h
+    if cache_kvs is not None:
+        return out, cache_kvs
+    return out
+
+
+def fused_multi_head_attention_block(x, qkv_weight, qkv_bias,
+                                     linear_weight, linear_bias,
+                                     trans_qkvw=True, attn_mask=None,
+                                     num_heads=None):
+    """One attention sublayer over packed qkv weights (helper for
+    fused_multi_transformer). qkv_weight: [3, h, hd, d] when trans_qkvw
+    (the reference's layout) else [d, 3*h*hd]."""
+    from ....nn import functional as F
+
+    b, s, d = x.shape
+    if trans_qkvw:
+        n_heads = qkv_weight.shape[1]
+        head_dim = qkv_weight.shape[2]
+    else:
+        assert num_heads is not None, "num_heads needed for [d, 3hd] qkv"
+        n_heads = num_heads
+        head_dim = qkv_weight.shape[-1] // (3 * n_heads)
+
+    def fn(xa, wqkv, *rest):
+        w = wqkv
+        if trans_qkvw:
+            w = jnp.transpose(w.reshape(3 * n_heads * head_dim, d))
+        qkv_a = xa @ w
+        if rest:
+            qkv_a = qkv_a + rest[0].reshape(-1)
+        return qkv_a
+
+    qkv = apply(fn, x, qkv_weight,
+                *([qkv_bias] if qkv_bias is not None else []),
+                name="fmt_qkv")
+    total = n_heads * head_dim
+    q = qkv[:, :, :total].reshape([b, s, n_heads, head_dim])
+    k = qkv[:, :, total:2 * total].reshape([b, s, n_heads, head_dim])
+    v = qkv[:, :, 2 * total:].reshape([b, s, n_heads, head_dim])
+    o = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                       is_causal=attn_mask is None)
+    o = o.reshape([b, s, total])
+    return fused_linear(o, linear_weight, linear_bias)

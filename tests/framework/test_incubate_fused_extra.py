@@ -1,0 +1,96 @@
+"""New incubate fused functionals (reference incubate/nn/functional/):
+fused_matmul_bias, fused_bias_dropout_residual_layer_norm,
+fused_dot_product_attention, block_multihead_attention,
+fused_multi_transformer."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import functional as IF
+
+
+def _t(rng, *shape, scale=1.0):
+    return paddle.to_tensor(
+        (rng.standard_normal(shape) * scale).astype("float32"))
+
+
+def test_fused_matmul_bias():
+    rng = np.random.default_rng(0)
+    x, w, b = _t(rng, 2, 8), _t(rng, 8, 4), _t(rng, 4)
+    out = IF.fused_matmul_bias(x, w, b)
+    np.testing.assert_allclose(out.numpy(),
+                               x.numpy() @ w.numpy() + b.numpy(),
+                               rtol=1e-5)
+    out_t = IF.fused_matmul_bias(x, paddle.to_tensor(w.numpy().T),
+                                 b, transpose_y=True)
+    np.testing.assert_allclose(out_t.numpy(), out.numpy(), rtol=1e-5)
+
+
+def test_fused_bias_dropout_residual_layer_norm():
+    rng = np.random.default_rng(1)
+    x, res, b = _t(rng, 3, 8), _t(rng, 3, 8), _t(rng, 8)
+    out = IF.fused_bias_dropout_residual_layer_norm(
+        x, res, bias=b, dropout_rate=0.0, training=False)
+    y = x.numpy() + b.numpy() + res.numpy()
+    mu = y.mean(-1, keepdims=True)
+    var = y.var(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), (y - mu) / np.sqrt(var + 1e-5),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fused_dot_product_attention_matches_sdpa():
+    rng = np.random.default_rng(2)
+    q, k, v = (_t(rng, 2, 16, 4, 8) for _ in range(3))
+    a = IF.fused_dot_product_attention(q, k, v, is_causal_masking=True)
+    b = paddle.nn.functional.scaled_dot_product_attention(
+        q, k, v, is_causal=True)
+    np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-5, atol=1e-6)
+    c = IF.cudnn_flash_attention(q, k, v, is_causal_masking=True)
+    np.testing.assert_allclose(c.numpy(), b.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_block_multihead_attention_decode():
+    """Functional paged decode == dense attention over the written KV."""
+    rng = np.random.default_rng(3)
+    B, HQ, HK, HD = 2, 4, 2, 16
+    nb, bs = 8, 4
+    kc = paddle.to_tensor(np.zeros((nb, bs, HK, HD), "float32"))
+    vc = paddle.to_tensor(np.zeros((nb, bs, HK, HD), "float32"))
+    tables = paddle.to_tensor(np.array([[1, 2], [3, 4]], "int32"))
+    lens = paddle.to_tensor(np.array([0, 0], "int32"))
+    qkv_np = rng.standard_normal((B, (HQ + 2 * HK) * HD)).astype(
+        "float32")
+    out, _, kc2, vc2 = IF.block_multihead_attention(
+        paddle.to_tensor(qkv_np), kc, vc, None, lens, None, None, None,
+        None, None, tables)
+    # first token: attends only itself -> out == v of the new token
+    q3 = qkv_np.reshape(B, HQ + 2 * HK, HD)
+    v_new = q3[:, HQ + HK:]
+    rep = np.repeat(v_new, HQ // HK, axis=1)
+    np.testing.assert_allclose(out.numpy().reshape(B, HQ, HD), rep,
+                               rtol=1e-4, atol=1e-5)
+    # kv landed in the right blocks (block 1 slot 0 for seq 0)
+    np.testing.assert_allclose(np.asarray(kc2._data)[1, 0],
+                               q3[0, HQ:HQ + HK], rtol=1e-6)
+
+
+def test_fused_multi_transformer_functional():
+    rng = np.random.default_rng(4)
+    d, h, L = 16, 2, 2
+    x = _t(rng, 2, 6, d, scale=0.1)
+
+    def mk(*shape):
+        return _t(rng, *shape, scale=0.1)
+
+    out = IF.fused_multi_transformer(
+        x,
+        [mk(d) for _ in range(L)], [mk(d) for _ in range(L)],
+        [mk(3, h, d // h, d) for _ in range(L)],
+        [mk(3 * d) for _ in range(L)],
+        [mk(d, d) for _ in range(L)], [mk(d) for _ in range(L)],
+        [mk(d) for _ in range(L)], [mk(d) for _ in range(L)],
+        [mk(d, 4 * d) for _ in range(L)], [mk(4 * d) for _ in range(L)],
+        [mk(4 * d, d) for _ in range(L)], [mk(d) for _ in range(L)])
+    assert out.shape == [2, 6, d]
+    assert np.isfinite(out.numpy()).all()
