@@ -212,9 +212,52 @@ def _try(fn, *args, **kwargs):
                 "skipped": f"{type(e).__name__}: {e}"[:300]}
 
 
+def _backend_or_cpu_fallback(timeout_s=180):
+    """Resolve the backend with a timeout: a wedged TPU tunnel must not
+    hang the driver's bench run forever. On timeout, force the CPU
+    backend so a parseable (clearly-marked) smoke line still prints."""
+    import threading
+
+    result = {}
+
+    def probe():
+        try:
+            result["backend"] = jax.default_backend()
+        except Exception as e:
+            result["error"] = str(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "backend" in result:
+        return result["backend"], None
+    note = result.get("error", f"backend init exceeded {timeout_s}s "
+                               "(TPU tunnel unreachable)")
+    # the probe thread may be stuck inside backend init; a clean CPU
+    # fallback needs a fresh process
+    import subprocess
+    import sys
+    env = dict(__import__("os").environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TPU_BENCH_NOTE"] = note
+    env.pop("PJRT_LIBRARY_PATH", None)
+    code = ("import jax; jax.config.update('jax_platforms','cpu'); "
+            "import bench; bench.main()")
+    rc = subprocess.run([sys.executable, "-c", code], env=env,
+                        cwd=__import__("os").path.dirname(
+                            __import__("os").path.abspath(__file__)))
+    raise SystemExit(rc.returncode)
+
+
 def main():
+    import os
+
     from paddle_tpu.models import GPTConfig, LlamaConfig
     from paddle_tpu.vision.models import vit_l_16
+
+    if os.environ.get("JAX_PLATFORMS") != "cpu" and \
+            "PADDLE_TPU_BENCH_NOTE" not in os.environ:
+        _backend_or_cpu_fallback()
 
     on_tpu = jax.default_backend() != "cpu"
     ladder = {}
@@ -238,7 +281,7 @@ def main():
             "llama_tiny_decode", dtype="float32")
         ladder["eager"] = _try(bench_eager)
 
-    print(json.dumps({
+    out = {
         "metric": "gpt2_345m_pretrain_tokens_per_sec_per_chip",
         "value": head["tokens_per_s"],
         "unit": "tokens/s/chip",
@@ -250,7 +293,11 @@ def main():
         "batch": head["batch"], "seq": head["seq"],
         "params": head["params"],
         "ladder": ladder,
-    }))
+    }
+    note = os.environ.get("PADDLE_TPU_BENCH_NOTE")
+    if note:
+        out["note"] = f"CPU smoke fallback — NOT a TPU number: {note}"
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
