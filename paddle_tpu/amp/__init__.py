@@ -260,3 +260,16 @@ def is_bfloat16_supported(device=None):
 
 def is_float16_supported(device=None):
     return True
+
+
+class OptimizerState:
+    """Reference amp/grad_scaler.py OptimizerState enum."""
+
+    INIT = 0
+    UNSCALED = 1
+    STEPPED = 2
+
+
+# legacy-name aliases (reference amp/__init__.py re-exports)
+amp_guard = auto_cast
+amp_decorate = decorate

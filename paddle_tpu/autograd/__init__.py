@@ -7,3 +7,26 @@ from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401
 
 def is_checkpoint_valid():
     return True
+
+
+from ..core.autograd import is_grad_enabled  # noqa: F401,E402
+
+
+class set_grad_enabled:
+    """Context manager / function toggling grad recording (reference
+    autograd/__init__.py set_grad_enabled)."""
+
+    def __init__(self, mode):
+        from ..core import autograd as _ag
+
+        self._prev = _ag.is_grad_enabled()
+        _ag._set_grad_enabled(bool(mode))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import autograd as _ag
+
+        _ag._set_grad_enabled(self._prev)
+        return False

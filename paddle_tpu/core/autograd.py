@@ -32,6 +32,10 @@ def is_grad_enabled() -> bool:
     return _grad_state.enabled
 
 
+def _set_grad_enabled(mode: bool) -> None:
+    _grad_state.enabled = bool(mode)
+
+
 class no_grad:
     """Context manager / decorator disabling gradient recording.
 

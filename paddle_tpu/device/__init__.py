@@ -181,3 +181,27 @@ class _CudaShim:
 
 
 cuda = _CudaShim()
+
+
+def is_compiled_with_cinn():
+    """XLA fills the CINN role in this build (SURVEY §7)."""
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+class _XpuNamespace:
+    """paddle.device.xpu surface (no XPU in a TPU build)."""
+
+    @staticmethod
+    def synchronize(device=None):
+        return None
+
+    @staticmethod
+    def device_count():
+        return 0
+
+
+xpu = _XpuNamespace()
