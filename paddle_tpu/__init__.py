@@ -35,6 +35,7 @@ from .core import (  # noqa: F401
     set_default_dtype, set_device, uint8,
 )
 from .core.dtype import bool_ as bool  # noqa: F401
+from .compat_toplevel import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from . import ops
 from . import nn  # noqa: F401
@@ -92,3 +93,7 @@ def enable_static(*args, **kwargs):
 
 def in_dynamic_mode():
     return True
+from .nn import ParamAttr  # noqa: F401,E402
+from .autograd import set_grad_enabled  # noqa: F401,E402
+import numpy as _np  # noqa: E402
+dtype = _np.dtype  # paddle.dtype: dtype objects ARE numpy dtypes here

@@ -1,0 +1,208 @@
+"""Top-level export fills (reference python/paddle/__init__.py names not
+covered by the ops/ modules): place classes, dtype info, RNG state,
+printoptions, misc helpers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .core import random as random_mod
+from .core.place import Place
+from .core.tensor import Parameter, Tensor
+
+__all__ = [
+    "CPUPlace", "CUDAPlace", "CUDAPinnedPlace", "XPUPlace", "TPUPlace",
+    "LazyGuard", "batch", "check_shape", "create_parameter",
+    "disable_signal_handler", "finfo", "iinfo", "pdist", "reverse",
+    "set_printoptions", "get_rng_state", "set_rng_state",
+    "get_cuda_rng_state", "set_cuda_rng_state",
+]
+
+
+def CPUPlace():
+    return Place("cpu")
+
+
+def CUDAPlace(device_id=0):
+    """Accelerator place (reference CUDAPlace; the accelerator here is
+    the TPU)."""
+    return Place("tpu", device_id)
+
+
+def CUDAPinnedPlace():
+    return Place("cpu")
+
+
+def XPUPlace(device_id=0):
+    return Place("tpu", device_id)
+
+
+def TPUPlace(device_id=0):
+    return Place("tpu", device_id)
+
+
+class LazyGuard:
+    """Reference paddle.LazyGuard: delays parameter materialization. XLA
+    initializes parameters through compiled programs already, so eager
+    init cost is one fused program — the guard is a compatibility
+    context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy paddle.batch: wrap a sample reader into a batch reader."""
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batch_reader
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference static check_shape)."""
+    if isinstance(shape, (list, tuple)):
+        for s in shape:
+            if not isinstance(s, (int, np.integer)) and s is not None:
+                raise TypeError(f"shape element {s!r} is not an int")
+            if isinstance(s, (int, np.integer)) and s < -1:
+                raise ValueError(f"shape element {s} < -1")
+        return True
+    raise TypeError("shape must be a list/tuple")
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference paddle.create_parameter."""
+    from . import nn
+
+    init = default_initializer or (
+        attr.initializer if attr is not None and
+        getattr(attr, "initializer", None) is not None else
+        (nn.initializer.Constant(0.0) if is_bias
+         else nn.initializer.XavierNormal()))
+    from .core.dtype import convert_dtype
+    p = Parameter(init(list(shape), convert_dtype(dtype)))
+    if name:
+        p.name = name
+    return p
+
+
+def disable_signal_handler():
+    """Reference disable_signal_handler (the C++ core installs fault
+    handlers; this build leaves Python's handlers alone)."""
+    return None
+
+
+class _DTypeInfo:
+    def __repr__(self):
+        fields = ", ".join(f"{k}={v}" for k, v in self.__dict__.items())
+        return f"{type(self).__name__}({fields})"
+
+
+def finfo(dtype):
+    from .core.dtype import convert_dtype
+    import jax.numpy as jnp
+
+    fi = jnp.finfo(convert_dtype(dtype))
+    out = _DTypeInfo()
+    out.bits = fi.bits
+    out.eps = float(fi.eps)
+    out.min = float(fi.min)
+    out.max = float(fi.max)
+    out.tiny = float(fi.tiny)
+    out.smallest_normal = float(fi.tiny)
+    out.resolution = float(fi.resolution)
+    out.dtype = str(fi.dtype)
+    return out
+
+
+def iinfo(dtype):
+    from .core.dtype import convert_dtype
+    import jax.numpy as jnp
+
+    ii = jnp.iinfo(convert_dtype(dtype))
+    out = _DTypeInfo()
+    out.bits = ii.bits
+    out.min = int(ii.min)
+    out.max = int(ii.max)
+    out.dtype = str(ii.dtype)
+    return out
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances (reference paddle.pdist): upper
+    triangle of cdist(x, x)."""
+    from .ops.special import cdist
+
+    full = cdist(x, x, p=p)
+    n = x.shape[0]
+    iu = np.triu_indices(n, k=1)
+    return _take_triu(full, iu)
+
+
+def _take_triu(full, iu):
+    import jax.numpy as jnp
+
+    from .core.dispatch import apply
+    rows = jnp.asarray(iu[0], jnp.int32)
+    cols = jnp.asarray(iu[1], jnp.int32)
+    return apply(lambda a: a[rows, cols], full, name="pdist_gather")
+
+
+def reverse(x, axis, name=None):
+    """Legacy paddle.reverse == flip."""
+    from .ops.manipulation import flip
+
+    return flip(x, axis)
+
+
+_PRINTOPTIONS = {}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference paddle.set_printoptions — numpy rendering backs Tensor
+    repr, so the options pass through."""
+    kwargs = {}
+    if precision is not None:
+        kwargs["precision"] = precision
+    if threshold is not None:
+        kwargs["threshold"] = threshold
+    if edgeitems is not None:
+        kwargs["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kwargs["linewidth"] = linewidth
+    if sci_mode is not None:
+        kwargs["suppress"] = not sci_mode
+    _PRINTOPTIONS.update(kwargs)
+    np.set_printoptions(**kwargs)
+
+
+def get_rng_state(device=None):
+    """RNG state as a list of generator states (reference returns one
+    per device; the key-splitting Generator is global here)."""
+    return [random_mod.default_generator().get_state()]
+
+
+def set_rng_state(state_list, device=None):
+    random_mod.default_generator().set_state(state_list[0])
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state_list):
+    set_rng_state(state_list)

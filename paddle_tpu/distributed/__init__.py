@@ -30,3 +30,16 @@ from .pipeline import PipelineDecoderLM  # noqa: F401
 from .watchdog import (  # noqa: F401
     CollectiveWatchdog, FlightRecorder, get_watchdog, watch_step,
 )
+from .compat import (  # noqa: F401,E402
+    CountFilterEntry, DistAttr, DistModel, InMemoryDataset, ParallelEnv,
+    ParallelMode, ProbabilityEntry, QueueDataset, ReduceType,
+    ShardingStage1, ShardingStage2, ShardingStage3, ShowClickEntry,
+    Strategy, alltoall_single, broadcast_object_list,
+    destroy_process_group, get_backend, get_group, gloo_barrier,
+    gloo_init_parallel_env, gloo_release, irecv, is_available, isend,
+    load_state_dict, save_state_dict, scatter_object_list,
+    shard_dataloader, shard_optimizer, shard_scaler, split, to_static,
+    unshard_dtensor, wait,
+)
+from . import launch  # noqa: F401,E402
+from . import io  # noqa: F401,E402

@@ -1,0 +1,533 @@
+"""Distributed-namespace export fills (reference
+python/paddle/distributed/__init__.py names beyond the core surface):
+env/introspection classes, dtensor sharding stages + shard_optimizer,
+object collectives, p2p handles, dataloader sharding, DistModel/Strategy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import pickle
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from .placement import Replicate, Shard, named_sharding
+
+__all__ = [
+    "ParallelEnv", "ParallelMode", "ReduceType", "get_backend",
+    "is_available", "destroy_process_group", "get_group", "wait",
+    "isend", "irecv", "alltoall_single", "broadcast_object_list",
+    "scatter_object_list", "split", "unshard_dtensor", "shard_optimizer",
+    "shard_scaler", "shard_dataloader", "ShardingStage1",
+    "ShardingStage2", "ShardingStage3", "Strategy", "DistAttr",
+    "DistModel", "to_static", "load_state_dict", "save_state_dict",
+    "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+    "ProbabilityEntry", "ShowClickEntry", "gloo_init_parallel_env",
+    "gloo_barrier", "gloo_release",
+]
+
+
+class ParallelMode:
+    """Reference fleet base/topology ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType(enum.IntEnum):
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+
+
+class ParallelEnv:
+    """Reference parallel.py ParallelEnv: process-level env view."""
+
+    @property
+    def rank(self):
+        from .env import get_rank
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        import os
+        return int(os.environ.get("PADDLE_LOCAL_RANK", "0"))
+
+    @property
+    def world_size(self):
+        from .env import get_world_size
+        return get_world_size()
+
+    nranks = world_size
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def device_type(self):
+        return jax.default_backend()
+
+    @property
+    def current_endpoint(self):
+        import os
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        r = self.rank
+        return eps[r] if r < len(eps) and eps[r] else f"127.0.0.1:{r}"
+
+    @property
+    def trainer_endpoints(self):
+        import os
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+def get_backend(group=None):
+    """The comm backend (reference returns NCCL/GLOO; here XLA's
+    collectives over the active platform)."""
+    return "XLA:" + jax.default_backend().upper()
+
+
+def is_available():
+    return True
+
+
+def destroy_process_group(group=None):
+    """Reference destroy_process_group: the coordination service owns
+    comm lifetime here; dropping the handle is enough."""
+    return None
+
+
+def get_group(gid=0):
+    from .collective import _group
+    return _group(None)
+
+
+class _Work:
+    """Completed-work handle (XLA collectives are synchronous at the
+    python boundary — by the time the call returns, the async dispatch
+    is enqueued and ordering is guaranteed)."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Reference stream-sync: host-sync the value."""
+    jax.block_until_ready(tensor._data if isinstance(tensor, Tensor)
+                          else tensor)
+    return None
+
+
+def isend(tensor, dst, group=None):
+    from .collective import send
+    send(tensor, dst, group=group)
+    return _Work()
+
+
+def irecv(tensor, src=None, group=None):
+    from .collective import recv
+    recv(tensor, src, group=group)
+    return _Work()
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all (reference communication/all_to_all.py):
+    equal splits over the group axis."""
+    from .collective import _group, _in_shard_map
+    from ..core.dispatch import apply
+    from ..ops import _inplace_from
+
+    g = _group(group)
+    if _in_shard_map(g.axis_name):
+        import jax.numpy as jnp
+        from jax import lax
+
+        def fn(a):
+            parts = jnp.reshape(a, (g.nranks, -1) + a.shape[1:])
+            return lax.all_to_all(parts, g.axis_name, 0, 0,
+                                  tiled=False).reshape(a.shape)
+        out = apply(fn, in_tensor, name="alltoall_single")
+        return _inplace_from(out_tensor, out)
+    return _inplace_from(out_tensor, in_tensor)
+
+
+def _obj_store():
+    from .env import get_world_size
+    if get_world_size() <= 1:
+        return None
+    from .store import TCPStore
+    return None  # multi-process object exchange rides the jax KV (below)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Reference broadcast_object_list. Multi-process: the coordination
+    service KV carries the pickled payload; single process: identity."""
+    from .env import get_rank, get_world_size
+
+    if get_world_size() <= 1:
+        return object_list
+    from jax._src import distributed as jdist
+
+    client = jdist.global_state.client
+    key = f"pt_bcast_obj/{_obj_seq()}"
+    if get_rank() == src:
+        client.key_value_set(key, pickle.dumps(object_list).hex())
+    raw = client.blocking_key_value_get(key, 60_000)
+    got = pickle.loads(bytes.fromhex(raw))
+    object_list[:] = got
+    return object_list
+
+
+_SEQ = [0]
+
+
+def _obj_seq():
+    _SEQ[0] += 1
+    return _SEQ[0]
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    from .env import get_rank, get_world_size
+
+    ws = get_world_size()
+    if ws <= 1:
+        out_object_list[:] = [in_object_list[0]] if in_object_list else []
+        return out_object_list
+    from jax._src import distributed as jdist
+
+    client = jdist.global_state.client
+    seq = _obj_seq()
+    if get_rank() == src:
+        for r in range(ws):
+            client.key_value_set(
+                f"pt_scatter_obj/{seq}/{r}",
+                pickle.dumps(in_object_list[r]).hex())
+    raw = client.blocking_key_value_get(
+        f"pt_scatter_obj/{seq}/{get_rank()}", 60_000)
+    out_object_list[:] = [pickle.loads(bytes.fromhex(raw))]
+    return out_object_list
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Reference distributed.split (model-parallel linear/embedding).
+    The mesh-placement system supersedes it: build the mpu layer."""
+    from .fleet import mp_layers
+
+    if operation == "linear":
+        layer = (mp_layers.ColumnParallelLinear if axis == 1 else
+                 mp_layers.RowParallelLinear)(
+            size[0], size[1], weight_attr=weight_attr,
+            has_bias=bias_attr is not False, gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        layer = mp_layers.VocabParallelEmbedding(
+            size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation!r}")
+
+
+def unshard_dtensor(dist_tensor):
+    """Reference unshard_dtensor: back to a replicated dense tensor."""
+    from .api import reshard
+    from .mesh import get_mesh
+
+    mesh = None
+    if dist_tensor._dist_attr is not None:
+        mesh = dist_tensor._dist_attr[0]
+    mesh = mesh or get_mesh()
+    return reshard(dist_tensor, mesh,
+                   [Replicate()] * mesh.ndim)
+
+
+# -- dtensor sharding stages (reference auto_parallel/api.py:1154-1301) --
+
+@dataclasses.dataclass
+class ShardingStage1:
+    """Optimizer-state sharding over the data axis."""
+
+    mesh_dim: str = "dp"
+    stage: int = 1
+
+
+@dataclasses.dataclass
+class ShardingStage2(ShardingStage1):
+    stage: int = 2
+
+
+@dataclasses.dataclass
+class ShardingStage3(ShardingStage1):
+    stage: int = 3
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Reference shard_optimizer: mark the optimizer for ZeRO placement.
+    ShardedTrainStep reads the tag and shards slots (stage 1/2) or relies
+    on param placements (stage 3)."""
+    if shard_fn is None:
+        shard_fn = ShardingStage1()
+    optimizer._sharding_stage = getattr(shard_fn, "stage", 1)
+    optimizer._sharding_axis = getattr(shard_fn, "mesh_dim", "dp")
+    return optimizer
+
+
+def shard_scaler(scaler):
+    """Reference shard_scaler: found_inf is already a global reduction
+    inside the compiled step, so the scaler works unchanged."""
+    return scaler
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims=None,
+                     input_keys=None):
+    """Reference ShardDataloader: yield batches placed on the mesh with
+    the given dims sharded (default: batch dim over the first axis)."""
+    from .mesh import get_mesh
+
+    mesh = meshes if meshes is not None and not isinstance(meshes, list) \
+        else (meshes[0] if meshes else get_mesh())
+
+    class _Sharded:
+        def __init__(self, dl):
+            self._dl = dl
+
+        def __len__(self):
+            return len(self._dl)
+
+        def __iter__(self):
+            for batch in self._dl:
+                yield jax.tree.map(
+                    lambda t: _place(t, mesh, shard_dims),
+                    batch,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+    return _Sharded(dataloader)
+
+
+def _place(t, mesh, shard_dims):
+    if not isinstance(t, Tensor):
+        return t
+    dim = 0 if shard_dims is None else shard_dims
+    placements = [Replicate()] * mesh.ndim
+    placements[0] = Shard(dim if isinstance(dim, int) else 0)
+    sh = named_sharding(mesh, placements, t.ndim)
+    return Tensor(jax.device_put(t._data, sh))
+
+
+# -- semi-auto static engine facade (reference DistModel/Strategy) ------
+
+class Strategy:
+    """Reference auto_parallel Strategy: knob container."""
+
+    def __init__(self, config=None):
+        self.sharding = _Knob(enable=False, stage=1, degree=8)
+        self.fused_passes = _Knob(enable=False)
+        self.gradient_merge = _Knob(enable=False, k_steps=1)
+        self.pipeline = _Knob(enable=False, schedule_mode="1F1B",
+                              micro_batch_size=1, accumulate_steps=1)
+        self.amp = _Knob(enable=False, dtype="bfloat16", level="O2")
+        if config:
+            for k, v in config.items():
+                setattr(self, k, v)
+
+
+class _Knob:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+DistAttr = tuple  # (mesh, placements) — the dist attr IS this pair here
+
+
+class DistModel:
+    """Reference DistModel (engine.py to_static product): train/eval/
+    predict steps compiled over the mesh."""
+
+    def __init__(self, layer, loader, loss=None, optimizer=None,
+                 strategy=None, metrics=None, mesh=None):
+        from .mesh import get_mesh, init_mesh, set_mesh
+        from .sharded_step import ShardedTrainStep
+
+        self._layer = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train"
+        mesh = mesh or get_mesh()
+        if mesh is None:  # default: pure DP over every visible device
+            mesh = set_mesh(init_mesh([-1], ["dp"]))
+        opt_axis = None
+        if optimizer is not None and \
+                getattr(optimizer, "_sharding_stage", None):
+            opt_axis = optimizer._sharding_axis
+        if optimizer is not None and loss is not None:
+            self._step = ShardedTrainStep(
+                layer, optimizer,
+                lambda m, *xs: loss(m(*xs[:-1]), xs[-1]),
+                mesh=mesh, shard_optimizer_axis=opt_axis)
+        else:
+            self._step = None
+
+    def train(self):
+        self._mode = "train"
+        self._layer.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self._layer.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self._layer.eval()
+
+    def __call__(self, *args):
+        if self._mode == "train" and self._step is not None:
+            return self._step(*args)
+        from ..core.autograd import no_grad
+
+        with no_grad():
+            out = self._layer(*args[:-1] if self._loss else args)
+            if self._mode == "eval" and self._loss is not None:
+                return self._loss(out, args[-1])
+            return out
+
+    def state_dict(self, mode="all"):
+        return self._layer.state_dict()
+
+    def dist_main_program(self, mode=None):
+        return None  # programs are XLA executables here
+
+    dist_startup_program = dist_main_program
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy=None, input_spec=None):
+    """Reference paddle.distributed.to_static -> DistModel."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+# -- checkpoint aliases (reference exposes them at namespace root) ------
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None, async_save=False):
+    from . import checkpoint
+    return checkpoint.save_state_dict(state_dict, path, process_group,
+                                      coordinator_rank)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None,
+                    offload=False):
+    from . import checkpoint
+    return checkpoint.load_state_dict(state_dict, path, process_group,
+                                      coordinator_rank)
+
+
+# -- PS-style datasets + embedding entries (reference fleet/dataset) ----
+
+class InMemoryDataset:
+    """Reference InMemoryDataset: file-list dataset loaded into memory,
+    line-oriented, with shuffle."""
+
+    def __init__(self):
+        self._files = []
+        self._lines = []
+        self._parser = None
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             **kwargs):
+        self.batch_size = batch_size
+        return self
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        self._lines = []
+        for f in self._files:
+            with open(f) as fh:
+                self._lines.extend(fh.read().splitlines())
+
+    def local_shuffle(self):
+        import random
+        random.shuffle(self._lines)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._lines)
+
+    def release_memory(self):
+        self._lines = []
+
+    def __iter__(self):
+        return iter(self._lines)
+
+
+class QueueDataset(InMemoryDataset):
+    """Reference QueueDataset: streaming variant (same surface; files
+    stream lazily)."""
+
+    def load_into_memory(self):  # streaming: defer to iteration
+        return None
+
+    def __iter__(self):
+        for f in self._files:
+            with open(f) as fh:
+                yield from fh.read().splitlines()
+
+
+@dataclasses.dataclass
+class CountFilterEntry:
+    """Sparse-embedding admission rule (reference entry_attr)."""
+
+    count: int = 1
+
+    def to_string(self):
+        return f"count_filter_entry:{self.count}"
+
+
+@dataclasses.dataclass
+class ProbabilityEntry:
+    probability: float = 1.0
+
+    def to_string(self):
+        return f"probability_entry:{self.probability}"
+
+
+@dataclasses.dataclass
+class ShowClickEntry:
+    show_name: str = "show"
+    click_name: str = "click"
+
+    def to_string(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"
+
+
+# -- gloo single-host helpers (reference gloo_* trio) -------------------
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    from .env import init_parallel_env
+    init_parallel_env()
+
+
+def gloo_barrier():
+    from .collective import barrier
+    barrier()
+
+
+def gloo_release():
+    return None

@@ -1,0 +1,22 @@
+"""`paddle.distributed.io` (reference distributed/io.py: persistables
+save/load for distributed programs — here the sharded checkpoint)."""
+
+from __future__ import annotations
+
+from .checkpoint import (  # noqa: F401
+    async_save_state_dict, load_state_dict, save_state_dict,
+)
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    raise NotImplementedError(
+        "static programs do not exist in this build; use "
+        "paddle_tpu.distributed.checkpoint.save_state_dict")
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    raise NotImplementedError(
+        "static programs do not exist in this build; use "
+        "paddle_tpu.distributed.checkpoint.load_state_dict")

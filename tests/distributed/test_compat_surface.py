@@ -1,0 +1,105 @@
+"""Distributed-namespace compat surface (reference
+distributed/__init__.py __all__): behavior checks for the fills."""
+
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distributed as dist
+from paddle_tpu import nn, optimizer
+
+
+def test_all_reference_exports_present():
+    src = open("/root/reference/python/paddle/distributed/__init__.py"
+               ).read()
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
+    names = re.findall(r'"([^"]+)"', m.group(1))
+    missing = sorted(n for n in names if not hasattr(dist, n))
+    assert missing == [], missing
+
+
+def test_dist_model_trains_and_evals():
+    mesh = dist.set_mesh(dist.init_mesh([8], ["dp"]))
+    paddle.seed(0)
+    net = nn.Linear(8, 8)
+    opt = dist.shard_optimizer(
+        optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters()),
+        dist.ShardingStage2())
+    assert opt._sharding_stage == 2
+    dm = dist.DistModel(
+        net, None, lambda out, y: paddle.nn.functional.mse_loss(out, y),
+        opt, mesh=mesh)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 8)).astype("float32"))
+    y = paddle.to_tensor(rng.standard_normal((8, 8)).astype("float32"))
+    losses = [float(dm(x, y)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    dm.eval()
+    assert np.isfinite(float(dm(x, y)))
+
+
+def test_parallel_env_and_introspection():
+    env = dist.ParallelEnv()
+    assert env.world_size >= 1 and env.rank == 0
+    assert dist.is_available()
+    assert dist.get_backend().startswith("XLA")
+    assert dist.destroy_process_group() is None
+    assert dist.ReduceType.kRedSum == 0
+    assert dist.ParallelMode.PIPELINE_PARALLEL == 2
+
+
+def test_object_collectives_single_process():
+    objs = [{"a": 1}, None]
+    dist.broadcast_object_list(objs, src=0)
+    assert objs[0] == {"a": 1}
+    out = [None]
+    dist.scatter_object_list(out, [np.int64(7)], src=0)
+    assert out[0] == 7
+
+
+def test_unshard_dtensor_replicates():
+    mesh = dist.init_mesh([8], ["dp"])
+    w = dist.shard_tensor(np.arange(64, dtype="float32").reshape(8, 8),
+                          mesh, [dist.Shard(0)])
+    r = dist.unshard_dtensor(w)
+    assert r._data.sharding.is_fully_replicated
+    np.testing.assert_allclose(np.asarray(r._data).reshape(-1),
+                               np.arange(64, dtype="float32"))
+
+
+def test_shard_dataloader_places_batches():
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    mesh = dist.set_mesh(dist.init_mesh([8], ["dp"]))
+    xs = paddle.to_tensor(np.arange(64, dtype="float32").reshape(16, 4))
+    dl = DataLoader(TensorDataset([xs]), batch_size=8)
+    sharded = dist.shard_dataloader(dl, meshes=mesh)
+    batches = list(sharded)
+    assert len(batches) == len(dl)
+    b0 = batches[0][0] if isinstance(batches[0], list) else batches[0]
+    assert "dp" in str(b0._data.sharding.spec)
+
+
+def test_in_memory_and_queue_dataset(tmp_path):
+    f1 = tmp_path / "a.txt"
+    f1.write_text("1 2\n3 4\n")
+    f2 = tmp_path / "b.txt"
+    f2.write_text("5 6\n")
+    ds = dist.InMemoryDataset()
+    ds.init(batch_size=2)
+    ds.set_filelist([str(f1), str(f2)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+    ds.local_shuffle()
+    assert sorted(list(ds)) == ["1 2", "3 4", "5 6"]
+    q = dist.QueueDataset()
+    q.set_filelist([str(f1), str(f2)])
+    assert list(q) == ["1 2", "3 4", "5 6"]
+
+
+def test_entries_to_string():
+    assert dist.CountFilterEntry(5).to_string() == "count_filter_entry:5"
+    assert "probability" in dist.ProbabilityEntry(0.5).to_string()
+    assert "show" in dist.ShowClickEntry().to_string()
