@@ -79,3 +79,10 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
                         clip_coef).astype(p.grad.dtype))
     return Tensor(total)
 from . import quant  # noqa: F401
+from .layer.extra import (  # noqa: F401
+    AdaptiveLogSoftmaxWithLoss, BeamSearchDecoder, FeatureAlphaDropout,
+    FractionalMaxPool2D, FractionalMaxPool3D, HSigmoidLoss, LPPool1D,
+    LPPool2D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D, MultiMarginLoss,
+    RNNTLoss, Silu, Softmax2D, TripletMarginWithDistanceLoss, ZeroPad1D,
+    ZeroPad3D, dynamic_decode,
+)

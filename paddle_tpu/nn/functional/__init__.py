@@ -13,3 +13,4 @@ from .pooling import (  # noqa: F401
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
     avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d, max_pool2d, max_pool3d,
 )
+from .extra import *  # noqa: F401,F403,E402

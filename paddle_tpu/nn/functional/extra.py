@@ -1,0 +1,728 @@
+"""nn.functional long tail (reference python/paddle/nn/functional/):
+pooling variants, sampling grids, losses, beam-search utilities, packed
+flash-attention entry points, inplace activations.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply, as_index, unwrap
+from ...core.random import next_key
+from ...core.tensor import Tensor
+
+__all__ = [
+    "one_hot", "elu_", "hardtanh_", "leaky_relu_",
+    "feature_alpha_dropout", "dice_loss", "npair_loss",
+    "multi_margin_loss", "hsigmoid_loss", "adaptive_log_softmax_with_loss",
+    "margin_cross_entropy", "class_center_sample", "gather_tree",
+    "grid_sample", "affine_grid", "lp_pool1d", "lp_pool2d",
+    "fractional_max_pool2d", "fractional_max_pool3d", "max_unpool1d",
+    "max_unpool2d", "max_unpool3d", "flash_attn_qkvpacked",
+    "flash_attn_varlen_qkvpacked", "flash_attention_with_sparse_mask",
+    "rnnt_loss", "relu_", "softmax_", "tanh_", "thresholded_relu_",
+    "sequence_mask", "sparse_attention", "temporal_shift",
+    "triplet_margin_with_distance_loss", "zeropad2d",
+]
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda a: jax.nn.one_hot(as_index(a), num_classes),
+                 x, name="one_hot")
+
+
+def _inplace(fn):
+    def wrapped(x, *args, **kwargs):
+        from ...ops import _inplace_from
+        return _inplace_from(x, fn(x, *args, **kwargs))
+    return wrapped
+
+
+def elu_(x, alpha=1.0, name=None):
+    from .activation import elu
+    return _inplace(elu)(x, alpha)
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    from .activation import hardtanh
+    return _inplace(hardtanh)(x, min, max)
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    from .activation import leaky_relu
+    return _inplace(leaky_relu)(x, negative_slope)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channels (reference
+    feature_alpha_dropout): keeps SELU self-normalizing stats."""
+    if not training or p == 0.0:
+        return x
+    key = next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(a):
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * (1 - q)) ** -0.5
+        b_coef = -a_coef * alpha_p * (1 - q)
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+    return apply(fn, x, name="feature_alpha_dropout")
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Reference dice_loss: 1 - 2|X∩Y|/(|X|+|Y|) per sample; input is
+    class probs [N, ..., C], label int [N, ..., 1]."""
+    lbl = as_index(unwrap(label))
+
+    def fn(a):
+        oh = jax.nn.one_hot(lbl.squeeze(-1), a.shape[-1], dtype=a.dtype)
+        dims = tuple(range(1, a.ndim))
+        inter = jnp.sum(a * oh, axis=dims)
+        union = jnp.sum(a, axis=dims) + jnp.sum(oh, axis=dims)
+        return jnp.mean(1 - 2 * inter / (union + epsilon))
+    return apply(fn, input, name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Reference npair_loss (improved triplet)."""
+    lbl = unwrap(labels)
+
+    def fn(a, p):
+        sim = a @ p.T  # [n, n]
+        eq = (lbl.reshape(-1, 1) == lbl.reshape(1, -1)).astype(a.dtype)
+        tgt = eq / jnp.sum(eq, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))
+        return ce + l2_reg * reg * 0.25
+    return apply(fn, anchor, positive, name="npair_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    lbl = as_index(unwrap(label))
+    w = unwrap(weight)
+
+    def fn(a):
+        n, c = a.shape
+        rows = jnp.arange(n)
+        correct = a[rows, lbl][:, None]
+        m = jnp.maximum(0.0, margin - correct + a)
+        if p == 2:
+            m = m * m
+        if w is not None:
+            m = m * w[lbl][:, None]
+        mask = jax.lax.broadcasted_iota(jnp.int32, (n, c), 1) != \
+            lbl[:, None]
+        per = jnp.sum(jnp.where(mask, m, 0.0), axis=1) / c
+        if reduction == "mean":
+            return jnp.mean(per)
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+    return apply(fn, input, name="multi_margin_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference hsigmoid_loss), default
+    complete binary tree over classes."""
+    lbl = as_index(unwrap(label)).reshape(-1)
+
+    if path_table is not None:
+        pt = as_index(unwrap(path_table))
+        pc = unwrap(path_code).astype(jnp.float32)
+
+        def fn(x, w, *mb):
+            logits = jnp.einsum("nd,nkd->nk", x, w[pt])
+            if mb:
+                logits = logits + mb[0][pt]
+            valid = pt >= 0
+            sg = jax.nn.log_sigmoid(jnp.where(pc > 0, logits, -logits))
+            return -jnp.mean(jnp.sum(jnp.where(valid, sg, 0.0), axis=1))
+        args = [input, weight] + ([bias] if bias is not None else [])
+        return apply(fn, *args, name="hsigmoid_loss")
+
+    # default tree: internal nodes of a complete binary tree
+    depth = max(1, int(math.ceil(math.log2(max(num_classes, 2)))))
+    codes = []
+    tables = []
+    for c in range(num_classes):
+        node = c + num_classes  # leaves occupy [num_classes, 2*num_classes)
+        path, code = [], []
+        while node > 1:
+            parent = node // 2
+            code.append(float(node % 2))
+            path.append(parent - 1)  # internal nodes 1-indexed -> 0-based
+            node = parent
+        path = path[::-1][:depth] + [-1] * max(0, depth - len(path))
+        code = code[::-1][:depth] + [0.0] * max(0, depth - len(code))
+        tables.append(path[:depth])
+        codes.append(code[:depth])
+    pt_np = np.asarray(tables, np.int32)
+    pc_np = np.asarray(codes, np.float32)
+
+    def fn(x, w, *mb):
+        pt = jnp.asarray(pt_np)[lbl]
+        pc = jnp.asarray(pc_np)[lbl]
+        safe_pt = jnp.maximum(pt, 0)
+        logits = jnp.einsum("nd,nkd->nk", x, w[safe_pt])
+        if mb:
+            logits = logits + mb[0][safe_pt]
+        sg = jax.nn.log_sigmoid(jnp.where(pc > 0, logits, -logits))
+        return -jnp.mean(jnp.sum(jnp.where(pt >= 0, sg, 0.0), axis=1))
+    args = [input, weight] + ([bias] if bias is not None else [])
+    return apply(fn, *args, name="hsigmoid_loss")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Reference adaptive_log_softmax_with_loss (Grave et al. efficient
+    softmax). ``cutoffs`` includes the final n_classes; cluster i covers
+    labels [cutoffs[i], cutoffs[i+1]). Returns (per-sample logprob of the
+    target, scalar NLL loss)."""
+    lbl = as_index(unwrap(label)).reshape(-1)
+    cutoffs = list(cutoffs)
+    shortlist = cutoffs[0]
+    n_clusters = len(cutoffs) - 1
+
+    def fn(x, hw, *rest):
+        if head_bias is not None:
+            hb = rest[-1]
+            tws = rest[:-1]
+        else:
+            hb = None
+            tws = rest
+        head = x @ hw
+        if hb is not None:
+            head = head + hb
+        head_lp = jax.nn.log_softmax(head, -1)  # [n, shortlist+clusters]
+        rows = jnp.arange(x.shape[0])
+        out = head_lp[rows, jnp.clip(lbl, 0, shortlist - 1)]
+        for ci in range(n_clusters):
+            lo, hi = cutoffs[ci], cutoffs[ci + 1]
+            sel = (lbl >= lo) & (lbl < hi)
+            tail_lp = jax.nn.log_softmax(x @ tws[ci], -1)
+            idx = jnp.clip(lbl - lo, 0, tail_lp.shape[-1] - 1)
+            full_lp = head_lp[:, shortlist + ci] + tail_lp[rows, idx]
+            out = jnp.where(sel, full_lp, out)
+        return out, -jnp.mean(out)
+    args = [input, head_weight] + list(tail_weights) + \
+        ([head_bias] if head_bias is not None else [])
+    return apply(fn, *args, name="adaptive_log_softmax_with_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """ArcFace/CosFace-style margin softmax (reference
+    margin_cross_entropy: cos(m1*theta + m2) - m3)."""
+    lbl = as_index(unwrap(label)).reshape(-1)
+
+    def fn(lg):
+        n, c = lg.shape
+        rows = jnp.arange(n)
+        cos_t = jnp.clip(lg[rows, lbl], -1.0, 1.0)
+        theta = jnp.arccos(cos_t)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        adj = lg.at[rows, lbl].set(target) * scale
+        logp = jax.nn.log_softmax(adj, -1)
+        per = -logp[rows, lbl]
+        loss = jnp.mean(per) if reduction == "mean" else (
+            jnp.sum(per) if reduction == "sum" else per)
+        if return_softmax:
+            return loss, jax.nn.softmax(adj, -1)
+        return loss
+    return apply(fn, logits, name="margin_cross_entropy")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Reference class_center_sample: sample negative class centers +
+    remap labels (partial-FC training)."""
+    lbl = as_index(unwrap(label)).reshape(-1)
+    key = next_key()
+
+    pos = jnp.unique(lbl, size=min(int(lbl.shape[0]), num_classes),
+                     fill_value=-1)
+    pos_mask = jnp.zeros(num_classes, bool).at[
+        jnp.maximum(pos, 0)].set(pos >= 0)
+    noise = jax.random.uniform(key, (num_classes,))
+    # positives first (score 2), then random negatives
+    score = jnp.where(pos_mask, 2.0 + noise, noise)
+    order = jnp.argsort(-score)
+    sampled = order[:num_samples]
+    # remap: position of each label inside `sampled`
+    inv = jnp.full(num_classes, -1, jnp.int64).at[sampled].set(
+        jnp.arange(num_samples, dtype=jnp.int64))
+    return Tensor(inv[lbl]), Tensor(sampled.astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+def gather_tree(ids, parents):
+    """Back-trace beam parents to full sequences (reference gather_tree
+    op). ids/parents: [T, batch, beam]."""
+    def fn(idv, par):
+        t = idv.shape[0]
+
+        def body(carry, xs):
+            beams = carry  # [batch, beam] current beam index
+            step_ids, step_parents = xs
+            out = jnp.take_along_axis(step_ids, beams, axis=1)
+            prev = jnp.take_along_axis(step_parents, beams, axis=1)
+            return prev, out
+        init = jnp.broadcast_to(
+            jnp.arange(idv.shape[2])[None, :],
+            idv.shape[1:]).astype(as_index(par).dtype)
+        _, outs = jax.lax.scan(body, init, (idv[::-1], par[::-1]))
+        return outs[::-1]
+    return apply(lambda a, b: fn(a, as_index(b)), ids, parents,
+                 name="gather_tree")
+
+
+# ---------------------------------------------------------------------------
+# spatial sampling
+# ---------------------------------------------------------------------------
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D affine sampling grid (reference affine_grid)."""
+    def fn(th):
+        n, _, h, w = [int(v) for v in out_shape] if len(out_shape) == 4 \
+            else (int(out_shape[0]), 0, int(out_shape[2]),
+                  int(out_shape[3]))
+        if align_corners:
+            xs = jnp.linspace(-1, 1, w)
+            ys = jnp.linspace(-1, 1, h)
+        else:
+            xs = (jnp.arange(w) + 0.5) * 2 / w - 1
+            ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], -1).reshape(-1, 3)  # [h*w, 3]
+        out = jnp.einsum("nij,pj->npi", th, base)  # [n, h*w, 2]
+        return out.reshape(th.shape[0], h, w, 2)
+    return apply(fn, theta, name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """2D grid sampling (reference grid_sample): x [N,C,H,W], grid
+    [N,Hg,Wg,2] in [-1,1] xy order."""
+
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def clip_or_reflect(v, size):
+            if padding_mode == "border":
+                return jnp.clip(v, 0, size - 1), None
+            if padding_mode == "reflection":
+                span = 2 * (size - 1) if align_corners else 2 * size
+                v = jnp.abs(jnp.mod(v, span))
+                v = jnp.minimum(v, span - v)
+                return jnp.clip(v, 0, size - 1), None
+            valid = (v >= 0) & (v <= size - 1)
+            return v, valid
+
+        fx, vx = clip_or_reflect(fx, w)
+        fy, vy = clip_or_reflect(fy, h)
+        valid = None
+        if vx is not None:
+            valid = vx & vy
+
+        if mode == "nearest":
+            ix = jnp.clip(jnp.round(fx).astype(jnp.int32), 0, w - 1)
+            iy = jnp.clip(jnp.round(fy).astype(jnp.int32), 0, h - 1)
+            bidx = jnp.arange(n)[:, None, None]
+            out = a[bidx, :, iy, ix]
+            out = jnp.moveaxis(out, -1, 1)
+            if valid is not None:
+                out = out * valid[:, None].astype(out.dtype)
+            return out
+
+        x0 = jnp.clip(jnp.floor(fx).astype(jnp.int32), 0, w - 1)
+        y0 = jnp.clip(jnp.floor(fy).astype(jnp.int32), 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        wx = fx - jnp.floor(fx)
+        wy = fy - jnp.floor(fy)
+        bidx = jnp.arange(n)[:, None, None]
+
+        def gather(iy, ix):
+            return a[bidx, :, iy, ix]  # [n, hg, wg, c]
+        v00 = gather(y0, x0)
+        v01 = gather(y0, x1)
+        v10 = gather(y1, x0)
+        v11 = gather(y1, x1)
+        wx_ = wx[..., None]
+        wy_ = wy[..., None]
+        out = (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_) +
+               v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+        out = jnp.moveaxis(out, -1, 1)  # [n, c, hg, wg]
+        if valid is not None:
+            out = out * valid[:, None].astype(out.dtype)
+        return out
+    return apply(fn, x, grid, name="grid_sample")
+
+
+# ---------------------------------------------------------------------------
+# pooling variants
+# ---------------------------------------------------------------------------
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, name=None):
+    stride = stride or kernel_size
+
+    def fn(a):
+        p = float(norm_type)
+        powed = jnp.abs(a) ** p if p != math.inf else a
+        if padding:
+            powed = jnp.pad(powed, ((0, 0), (0, 0), (padding, padding)))
+        from jax import lax
+        s = lax.reduce_window(powed, jnp.asarray(0, a.dtype), lax.add,
+                              (1, 1, kernel_size), (1, 1, stride),
+                              "VALID")
+        return s ** (1.0 / p)
+    return apply(fn, x, name="lp_pool1d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+
+    def fn(a):
+        p = float(norm_type)
+        powed = jnp.abs(a) ** p
+        if padding:
+            pad = padding if isinstance(padding, (list, tuple)) else \
+                (padding, padding)
+            powed = jnp.pad(powed, ((0, 0), (0, 0), (pad[0], pad[0]),
+                                    (pad[1], pad[1])))
+        from jax import lax
+        s = lax.reduce_window(powed, jnp.asarray(0, a.dtype), lax.add,
+                              (1, 1) + tuple(kernel_size),
+                              (1, 1) + tuple(stride), "VALID")
+        return s ** (1.0 / p)
+    return apply(fn, x, name="lp_pool2d")
+
+
+def _fractional_pool(x, output_size, nd, return_mask, kernel_size=None,
+                     random_u=None):
+    def fn(a):
+        spatial = a.shape[2:]
+        outs = output_size if isinstance(output_size, (list, tuple)) \
+            else (output_size,) * nd
+        res = a
+        for d in range(nd):
+            size = res.shape[2 + d]
+            o = outs[d]
+            # pseudo-random sequence (reference uses u in (0,1)); the
+            # deterministic midpoint keeps tests reproducible
+            u = random_u if random_u is not None else 0.5
+            alpha = size / o
+            starts = [min(int((i + u) * alpha) - int(u * alpha), size - 1)
+                      for i in range(o)]
+            ends = [min(int((i + 1 + u) * alpha) - int(u * alpha), size)
+                    for i in range(o)]
+            pieces = [jnp.max(jax.lax.slice_in_dim(res, st, max(en, st + 1),
+                                                   axis=2 + d),
+                              axis=2 + d, keepdims=True)
+                      for st, en in zip(starts, ends)]
+            res = jnp.concatenate(pieces, axis=2 + d)
+        return res
+    return apply(fn, x, name="fractional_max_pool")
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    return _fractional_pool(x, output_size, 2, return_mask, kernel_size,
+                            random_u)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    return _fractional_pool(x, output_size, 3, return_mask, kernel_size,
+                            random_u)
+
+
+def _max_unpool(x, indices, nd, kernel_size, stride, padding,
+                output_size, name):
+    idx = as_index(unwrap(indices))
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * nd
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+
+    def fn(a):
+        lead = a.shape[:2]
+        spatial_in = a.shape[2:]
+        if output_size is not None:
+            spatial_out = tuple(int(s) for s in output_size[-nd:])
+        else:
+            spatial_out = tuple(
+                (si - 1) * st + k - 2 * (padding if isinstance(
+                    padding, int) else 0)
+                for si, st, k in zip(spatial_in, stride, kernel_size))
+        flat_sp = int(np.prod(spatial_out))
+        out = jnp.zeros(lead + (flat_sp,), a.dtype)
+        flat_x = a.reshape(lead + (-1,))
+        flat_i = idx.reshape(lead + (-1,))
+        out = jax.vmap(jax.vmap(
+            lambda o, xi, ii: o.at[ii].set(xi)))(out, flat_x, flat_i)
+        return out.reshape(lead + spatial_out)
+    return apply(fn, x, name=name)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, "max_unpool3d")
+
+
+# ---------------------------------------------------------------------------
+# packed flash-attention entry points (wrap the Pallas kernel)
+# ---------------------------------------------------------------------------
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """qkv: [b, s, 3, h, d] packed (reference flash_attn_qkvpacked)."""
+    from . import scaled_dot_product_attention
+
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out = scaled_dot_product_attention(q, k, v, dropout_p=dropout,
+                                       is_causal=causal,
+                                       training=training)
+    return out, None
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale,
+                                dropout=0.0, causal=False,
+                                return_softmax=False,
+                                fixed_seed_offset=None, rng_name="",
+                                varlen_padded=True, training=True,
+                                name=None):
+    """qkv: [total, 3, h, d] packed varlen."""
+    from ...kernels.flash_attention import flash_attn_unpadded
+
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale,
+                               dropout=dropout, causal=causal,
+                               training=training)
+
+
+def flash_attention_with_sparse_mask(query, key, value,
+                                     attn_mask_start_row_indices=None,
+                                     attn_mask_start_row=0,
+                                     dropout_p=0.0, is_causal=True,
+                                     training=True, name=None):
+    """Row-sparse attention mask (reference
+    flash_attention_with_sparse_mask): start_row_indices [b, h, s] gives,
+    per score-matrix COLUMN j, the row where masking begins — rows
+    i >= start[j] are masked (on top of causal when is_causal)."""
+    starts = as_index(unwrap(attn_mask_start_row_indices))
+
+    def fn(q, k, v):
+        from ...kernels.flash_attention import sdpa_xla
+        s_len = q.shape[1]
+        pos = jnp.arange(s_len)
+        keep = pos[:, None] < starts[:, :, None, :]  # [b, h, s_q, s_k]
+        if is_causal:
+            keep = keep & (pos[None, None, :, None] * 0 +
+                           (pos[None, :] <= pos[:, None])[None, None])
+        bias = jnp.where(keep, 0.0, -jnp.inf)
+        return sdpa_xla(q, k, v, bias=bias)
+    return apply(fn, query, key, value,
+                 name="flash_attention_with_sparse_mask")
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-T transducer loss (reference warprnnt integration): exact
+    alpha-recursion over the [T, U+1] lattice in log space.
+    logits: [B, T, U+1, V]; labels: [B, U] int."""
+    lbl = as_index(unwrap(labels))
+    tlen = as_index(unwrap(logit_lengths))
+    ulen = as_index(unwrap(label_lengths))
+
+    def fn(lg):
+        b, t_max, u_max1, _ = lg.shape
+        logp = jax.nn.log_softmax(lg.astype(jnp.float32), -1)
+
+        def one(lp, y, t_n, u_n):
+            blank_lp = lp[:, :, blank]                    # [T, U+1]
+            rows = jnp.arange(u_max1 - 1)
+            y_lp = lp[:, rows, y[rows]]                   # [T, U]
+
+            # t = 0 row: label-only transitions alpha[0, u]
+            def label_only(carry, uu):
+                cur = carry + y_lp[0, uu - 1]
+                return cur, cur
+            _, row0_rest = jax.lax.scan(label_only, jnp.float32(0.0),
+                                        jnp.arange(1, u_max1))
+            alpha0 = jnp.concatenate([jnp.zeros(1, jnp.float32),
+                                      row0_rest])
+
+            def tstep(alpha, tt):
+                from_blank = alpha + blank_lp[tt - 1]     # [U+1]
+
+                def label_scan(prev, uu):
+                    cur = jnp.logaddexp(from_blank[uu],
+                                        prev + y_lp[tt, uu - 1])
+                    return cur, cur
+                first = from_blank[0]
+                _, rest = jax.lax.scan(label_scan, first,
+                                       jnp.arange(1, u_max1))
+                new = jnp.concatenate([first[None], rest])
+                return new, new
+            _, hist = jax.lax.scan(tstep, alpha0, jnp.arange(1, t_max))
+            all_alphas = jnp.concatenate([alpha0[None], hist], 0)
+            a_fin = all_alphas[t_n - 1, u_n]
+            return -(a_fin + blank_lp[t_n - 1, u_n])
+
+        losses = jax.vmap(one)(logp, lbl, tlen, ulen)
+        if reduction == "mean":
+            return jnp.mean(losses)
+        if reduction == "sum":
+            return jnp.sum(losses)
+        return losses
+    return apply(fn, logits, name="rnnt_loss")
+
+
+def relu_(x, name=None):
+    from .activation import relu
+    return _inplace(relu)(x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from .activation import softmax
+    return _inplace(softmax)(x, axis)
+
+
+def tanh_(x, name=None):
+    from ...ops import tanh
+    return _inplace(tanh)(x)
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    from .activation import thresholded_relu
+    return _inplace(thresholded_relu)(x, threshold)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths -> boolean/int mask [..., maxlen] (reference
+    sequence_mask)."""
+    lens = as_index(unwrap(x))
+    m = int(maxlen) if maxlen is not None else int(np.asarray(lens).max())
+
+    from ...core.dtype import convert_dtype
+
+    def fn():
+        pos = jnp.arange(m, dtype=jnp.int32)
+        return (pos[None, :] < lens[..., None]).astype(convert_dtype(dtype))
+    return Tensor(fn())
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention by CSR pattern (reference sparse_attention
+    op). Dense-masked implementation: positions outside the CSR pattern
+    are -inf."""
+    offs = as_index(unwrap(sparse_csr_offset))
+    cols = as_index(unwrap(sparse_csr_columns))
+
+    def fn(q, k, v):
+        b, h, s, d = q.shape
+        logits = jnp.einsum("bhsd,bhtd->bhst", q, k) / jnp.sqrt(
+            jnp.float32(d)).astype(q.dtype)
+        row = jnp.repeat(jnp.arange(s), jnp.diff(offs[0, 0]),
+                         total_repeat_length=cols.shape[-1])
+        mask = jnp.zeros((s, s), bool).at[row, cols[0, 0]].set(True)
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        p_attn = jax.nn.softmax(logits, -1)
+        p_attn = jnp.where(mask[None, None], p_attn, 0.0)
+        return jnp.einsum("bhst,bhtd->bhsd", p_attn, v)
+    return apply(fn, query, key, value, name="sparse_attention")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift (reference temporal_shift op): shift a channel
+    slice one step along time within each segment."""
+
+    def fn(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        fwd = jnp.roll(v[:, :, :c1], 1, axis=1).at[:, 0, :].set(0.0)
+        bwd = jnp.roll(v[:, :, c1:c2], -1, axis=1).at[:, -1, :].set(0.0)
+        keep = v[:, :, c2:]
+        return jnp.concatenate([fwd, bwd, keep], 2).reshape(nt, c, h, w)
+    return apply(fn, x, name="temporal_shift")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    from ..layer.extra import TripletMarginWithDistanceLoss
+
+    return TripletMarginWithDistanceLoss(
+        distance_function, margin, swap, reduction)(
+        input, positive, negative)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as pad_fn
+
+    return pad_fn(x, list(padding), mode="constant", value=0.0,
+                  data_format=data_format)

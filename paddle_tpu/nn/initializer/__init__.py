@@ -15,6 +15,7 @@ from ...core.random import next_key
 from .attr import ParamAttr  # noqa: F401
 
 __all__ = [
+    "Bilinear", "set_global_initializer",
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
     "Assign", "Orthogonal", "Dirac", "calculate_gain", "ParamAttr",
@@ -200,3 +201,36 @@ def calculate_gain(nonlinearity, param=None):
     if nonlinearity == "selu":
         return 3.0 / 4.0
     raise ValueError(f"unknown nonlinearity {nonlinearity}")
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init (reference initializer/Bilinear:
+    transposed-conv weights for learnable upsampling)."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+
+        w = np.zeros(shape, np.float32)
+        if len(shape) != 4:
+            raise ValueError("Bilinear expects 4-D conv weights")
+        f = int(np.ceil(shape[-1] / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape)):
+            x = i % shape[-1]
+            y = (i // shape[-1]) % shape[-2]
+            w.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        import jax.numpy as jnp
+        return jnp.asarray(w, dtype)
+
+
+_global_initializer = [None]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference set_global_initializer: default initializers applied to
+    subsequently created parameters that do not specify their own."""
+    _global_initializer[0] = (weight_init, bias_init)
+
+
+def _get_global_initializer():
+    return _global_initializer[0]

@@ -1,0 +1,361 @@
+"""nn layer long tail (reference python/paddle/nn/layer/): wrappers over
+nn.functional.extra + beam-search decoding.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import as_index, unwrap
+from ...core.tensor import Tensor
+from .. import functional as F
+from .layers import Layer
+
+__all__ = [
+    "Silu", "Softmax2D", "ZeroPad1D", "ZeroPad3D", "LPPool1D", "LPPool2D",
+    "FractionalMaxPool2D", "FractionalMaxPool3D", "MaxUnPool1D",
+    "MaxUnPool2D", "MaxUnPool3D", "MultiMarginLoss", "HSigmoidLoss",
+    "AdaptiveLogSoftmaxWithLoss", "RNNTLoss",
+    "TripletMarginWithDistanceLoss", "FeatureAlphaDropout",
+    "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+class Silu(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = padding if isinstance(padding, (list, tuple)) \
+            else [padding, padding]
+
+    def forward(self, x):
+        from ...ops import pad
+        return pad(x, list(self.padding), mode="constant", value=0.0)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        p = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 6
+        self.padding = list(p)
+
+    def forward(self, x):
+        from ...ops import pad
+        return pad(x, self.padding, mode="constant", value=0.0)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, *self.args)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, *self.args)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size,
+                                       random_u=self.random_u)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size,
+                                       random_u=self.random_u)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding)
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, *self.args,
+                              output_size=self.output_size)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding)
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, *self.args,
+                              output_size=self.output_size)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding)
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, *self.args,
+                              output_size=self.output_size)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.args = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, *self.args)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.num_classes = num_classes
+        n_nodes = num_classes - 1 if num_classes > 1 else 1
+        self.weight = self.create_parameter(
+            [n_nodes if not is_custom else num_classes, feature_size],
+            attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0 / feature_size ** 0.5))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                [n_nodes if not is_custom else num_classes],
+                attr=bias_attr, is_bias=True,
+                default_initializer=I.Constant(0.0))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight, bias=self.bias,
+                               path_table=path_table,
+                               path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.cutoffs = list(cutoffs) + [n_classes]
+        self.shortlist = self.cutoffs[0]
+        n_clusters = len(self.cutoffs) - 1
+        self.head_weight = self.create_parameter(
+            [in_features, self.shortlist + n_clusters],
+            default_initializer=I.XavierNormal())
+        self.head_bias = None
+        if head_bias:
+            self.head_bias = self.create_parameter(
+                [self.shortlist + n_clusters], is_bias=True,
+                default_initializer=I.Constant(0.0))
+        self.tail_weights = []
+        for i in range(n_clusters):
+            sz = self.cutoffs[i + 1] - self.cutoffs[i]
+            w = self.create_parameter([in_features, sz],
+                                      default_initializer=I.XavierNormal())
+            self.tail_weights.append(w)
+            setattr(self, f"tail_w_{i}", w)
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, head_bias=self.head_bias)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.args = (blank, fastemit_lambda, reduction)
+
+    def forward(self, logits, labels, logit_lengths, label_lengths):
+        return F.rnnt_loss(logits, labels, logit_lengths, label_lengths,
+                           blank=self.args[0],
+                           fastemit_lambda=self.args[1],
+                           reduction=self.args[2])
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0,
+                 swap=False, reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, anchor, positive, negative):
+        from ...ops import maximum, mean, norm
+        dist = self.distance_function or (
+            lambda a, b: ((a - b) * (a - b)).sum(-1).sqrt())
+        dp = dist(anchor, positive)
+        dn = dist(anchor, negative)
+        if self.swap:
+            from ...ops import minimum
+            dn = minimum(dn, dist(positive, negative))
+        loss = maximum(dp - dn + self.margin,
+                       Tensor(jnp.zeros_like(unwrap(dp))))
+        if self.reduction == "mean":
+            return loss.mean()
+        if self.reduction == "sum":
+            return loss.sum()
+        return loss
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, training=self.training)
+
+
+# ---------------------------------------------------------------------------
+# beam search (reference nn/decode.py BeamSearchDecoder + dynamic_decode)
+# ---------------------------------------------------------------------------
+
+class BeamSearchDecoder:
+    """Reference BeamSearchDecoder: wraps an RNN cell + output fn into a
+    beam-stepping decoder driven by dynamic_decode."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        import jax
+
+        states = initial_cell_states
+        # tile cell states across the beam: [b, ...] -> [b*beam, ...]
+        def tile(t):
+            a = unwrap(t)
+            a = jnp.repeat(a, self.beam_size, axis=0)
+            return Tensor(a)
+        states = jax.tree.map(tile, states,
+                              is_leaf=lambda x: isinstance(x, Tensor))
+        batch = None
+        leaf = jax.tree.leaves(
+            states, is_leaf=lambda x: isinstance(x, Tensor))[0]
+        batch = leaf.shape[0] // self.beam_size
+        ids = Tensor(jnp.full((batch * self.beam_size,),
+                              self.start_token, jnp.int64))
+        # log-probs: first beam 0, others -inf so step 1 is deterministic
+        lp = jnp.tile(jnp.asarray(
+            [0.0] + [-1e9] * (self.beam_size - 1), jnp.float32), (batch,))
+        finished = jnp.zeros((batch * self.beam_size,), bool)
+        return ids, (states, Tensor(lp), Tensor(finished))
+
+    def step(self, time, inputs, states):
+        cell_states, log_probs, finished = states
+        emb = self.embedding_fn(inputs) if self.embedding_fn else inputs
+        out, new_cell = self.cell(emb, cell_states)
+        logits = self.output_fn(out) if self.output_fn else out
+        lg = unwrap(logits).astype(jnp.float32)
+        vocab = lg.shape[-1]
+        beam = self.beam_size
+        batch = lg.shape[0] // beam
+        step_lp = jax.nn.log_softmax(lg, -1)
+        # finished beams only extend with end_token at zero cost
+        fin = unwrap(finished)
+        keep = jnp.full((vocab,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(fin[:, None], keep[None, :], step_lp)
+        total = unwrap(log_probs)[:, None] + step_lp
+        total = total.reshape(batch, beam * vocab)
+        top_lp, top_idx = jax.lax.top_k(total, beam)
+        src_beam = top_idx // vocab  # [batch, beam]
+        tok = top_idx % vocab
+        flat_src = (jnp.arange(batch)[:, None] * beam +
+                    src_beam).reshape(-1)
+
+        def regather(t):
+            return Tensor(unwrap(t)[flat_src])
+        import jax as _jax
+        new_cell = _jax.tree.map(regather, new_cell,
+                                 is_leaf=lambda x: isinstance(x, Tensor))
+        new_fin = fin[flat_src] | (tok.reshape(-1) == self.end_token)
+        ids = Tensor(tok.reshape(-1).astype(jnp.int64))
+        return ids, (new_cell, Tensor(top_lp.reshape(-1)),
+                     Tensor(new_fin)), Tensor(flat_src)
+
+    def finished(self, states):
+        return bool(np.asarray(unwrap(states[2])).all())
+
+
+import jax  # noqa: E402  (used by decoder internals above)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Reference dynamic_decode: run decoder.initialize/step until all
+    beams finish or max_step_num; back-trace with gather_tree."""
+    ids, states = decoder.initialize(inits)
+    all_ids = []
+    all_parents = []
+    steps = 0
+    for t in range(max_step_num):
+        ids, states, parents = decoder.step(t, ids, states)
+        all_ids.append(unwrap(ids))
+        all_parents.append(unwrap(parents))
+        steps += 1
+        if decoder.finished(states):
+            break
+    beam = decoder.beam_size
+    batch = all_ids[0].shape[0] // beam
+    ids_t = jnp.stack(all_ids).reshape(steps, batch, beam)
+    par_t = jnp.stack(all_parents).reshape(steps, batch, beam) % beam
+    from ..functional.extra import gather_tree
+    seqs = gather_tree(Tensor(ids_t), Tensor(par_t))
+    out = seqs if output_time_major else Tensor(
+        jnp.transpose(unwrap(seqs), (1, 2, 0)))
+    if return_length:
+        lens = Tensor(jnp.full((batch, beam), steps, jnp.int64))
+        return out, lens
+    return out
