@@ -17,7 +17,10 @@ from ..core.dispatch import apply, unwrap
 from ..core.tensor import Tensor
 
 __all__ = ["nms", "roi_align", "roi_pool", "deform_conv2d", "box_coder",
-           "DeformConv2D", "box_area", "box_iou"]
+           "DeformConv2D", "box_area", "box_iou", "RoIAlign", "RoIPool",
+           "PSRoIPool", "psroi_pool", "read_file", "decode_jpeg",
+           "prior_box", "yolo_box", "yolo_loss", "matrix_nms",
+           "distribute_fpn_proposals", "generate_proposals"]
 
 
 def box_area(boxes):
@@ -317,3 +320,448 @@ def box_coder(prior_box, prior_box_var, target_box,
 
     return apply(fn, prior_box, prior_box_var, target_box,
                  name="box_coder")
+
+
+class RoIAlign:
+    """Layer form of roi_align (reference vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference psroi_pool): input
+    channels C = out_c * oh * ow; each output bin pools its own channel
+    group."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply, as_index, unwrap
+
+    oh = ow = output_size if isinstance(output_size, int) else None
+    if oh is None:
+        oh, ow = output_size
+    bxs = unwrap(boxes)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        out_c = c // (oh * ow)
+        pooled = roi_align(
+            Tensor(a.reshape(n, c, h, w)), Tensor(bxs),
+            boxes_num, (oh, ow), spatial_scale, sampling_ratio=1,
+            aligned=False)
+        p = unwrap(pooled)  # [nb, c, oh, ow]
+        nb = p.shape[0]
+        p = p.reshape(nb, out_c, oh, ow, oh, ow)
+        # select the (i, j)-th channel plane for output bin (i, j)
+        ii = jnp.arange(oh)
+        jj = jnp.arange(ow)
+        return p[:, :, ii[:, None], jj[None, :], ii[:, None], jj[None, :]]
+    return apply(fn, x, name="psroi_pool")
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference read_file)."""
+    import numpy as np
+
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(data)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG decode (reference decode_jpeg, nvjpeg-backed). Host-side
+    decode through Pillow/torchvision when available."""
+    import io
+
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+
+    raw = bytes(np.asarray(unwrap(x), np.uint8))
+    try:
+        from PIL import Image
+
+        img = np.asarray(Image.open(io.BytesIO(raw)))
+    except ImportError:
+        try:
+            import torchvision.io as tvio
+            import torch
+
+            img = tvio.decode_jpeg(
+                torch.frombuffer(bytearray(raw), dtype=torch.uint8)
+            ).numpy().transpose(1, 2, 0)
+        except Exception as e:  # pragma: no cover
+            raise RuntimeError(
+                "decode_jpeg needs Pillow or torchvision") from e
+    if img.ndim == 2:
+        img = img[None]
+    else:
+        img = img.transpose(2, 0, 1)
+    return Tensor(img.copy())
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) boxes (reference prior_box op)."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+
+    fh, fw = unwrap(input).shape[2:]
+    ih, iw = unwrap(image).shape[2:]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if ar != 1.0:
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    vars_ = []
+    for y in range(fh):
+        for x in range(fw):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            cell = []
+            for si, ms in enumerate(min_sizes):
+                for ar in ars:
+                    bw = ms * np.sqrt(ar) / 2
+                    bh = ms / np.sqrt(ar) / 2
+                    cell.append([(cx - bw) / iw, (cy - bh) / ih,
+                                 (cx + bw) / iw, (cy + bh) / ih])
+                if max_sizes:
+                    ms2 = np.sqrt(ms * max_sizes[si])
+                    cell.append([(cx - ms2 / 2) / iw, (cy - ms2 / 2) / ih,
+                                 (cx + ms2 / 2) / iw, (cy + ms2 / 2) / ih])
+            boxes.append(cell)
+            vars_.append([list(variance)] * len(cell))
+    out = np.asarray(boxes, "float32").reshape(fh, fw, -1, 4)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.asarray(vars_, "float32").reshape(fh, fw, -1, 4)
+    return Tensor(out), Tensor(var)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """YOLOv3 head decode (reference yolo_box op): raw feature map ->
+    (boxes [N, hwa, 4], scores [N, hwa, class_num])."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply, unwrap
+
+    anchors = np.asarray(anchors, "float32").reshape(-1, 2)
+    na = anchors.shape[0]
+    imgs = unwrap(img_size)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)
+        gy = jnp.arange(h, dtype=jnp.float32)
+        bx = (jax.nn.sigmoid(a[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx[None, None, None, :]) / w
+        by = (jax.nn.sigmoid(a[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy[None, None, :, None]) / h
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+        bw = jnp.exp(a[:, :, 2]) * anchors[None, :, 0, None, None] / in_w
+        bh = jnp.exp(a[:, :, 3]) * anchors[None, :, 1, None, None] / in_h
+        conf = jax.nn.sigmoid(a[:, :, 4])
+        probs = jax.nn.sigmoid(a[:, :, 5:]) * conf[:, :, None]
+        probs = jnp.where(conf[:, :, None] < conf_thresh, 0.0, probs)
+        ih = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        iw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x0 = (bx - bw / 2) * iw
+        y0 = (by - bh / 2) * ih
+        x1 = (bx + bw / 2) * iw
+        y1 = (by + bh / 2) * ih
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0, iw - 1)
+            y0 = jnp.clip(y0, 0, ih - 1)
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], -1).reshape(n, -1, 4)
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(
+            n, -1, class_num)
+        return boxes, scores
+    return apply(fn, x, name="yolo_box")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 training loss (reference yolo3_loss op): coordinate +
+    objectness + class terms over assigned anchors."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply, as_index, unwrap
+
+    anchors_np = np.asarray(anchors, "float32").reshape(-1, 2)
+    mask = list(anchor_mask)
+    na = len(mask)
+    gtb = unwrap(gt_box).astype(jnp.float32)   # [n, b, 4] cx cy w h (0-1)
+    gtl = as_index(unwrap(gt_label))           # [n, b]
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, na, 5 + class_num, h, w)
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+
+        tx = jax.nn.sigmoid(a[:, :, 0])
+        ty = jax.nn.sigmoid(a[:, :, 1])
+        tw = a[:, :, 2]
+        th = a[:, :, 3]
+        tobj = a[:, :, 4]
+        tcls = a[:, :, 5:]
+
+        # build targets per gt: which cell + which anchor (best iou by wh)
+        gx = gtb[..., 0] * w
+        gy = gtb[..., 1] * h
+        gi = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+        gw = gtb[..., 2] * in_w
+        gh_ = gtb[..., 3] * in_h
+        wh = jnp.stack([gw, gh_], -1)[:, :, None, :]     # [n,b,1,2]
+        aw = jnp.asarray(anchors_np)[None, None, mask]   # [1,1,na,2]
+        inter = jnp.minimum(wh, aw).prod(-1)
+        union = wh.prod(-1) + aw.prod(-1) - inter
+        iou = inter / jnp.maximum(union, 1e-9)
+        best = jnp.argmax(iou, -1)                        # [n, b]
+        valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)
+
+        batch = jnp.arange(n)[:, None]
+        tgt_x = gx - gi
+        tgt_y = gy - gj
+        aw_sel = jnp.asarray(anchors_np)[jnp.asarray(mask)[best]]
+        tgt_w = jnp.log(jnp.maximum(gw / aw_sel[..., 0], 1e-9))
+        tgt_h = jnp.log(jnp.maximum(gh_ / aw_sel[..., 1], 1e-9))
+        scale = 2.0 - gtb[..., 2] * gtb[..., 3]
+
+        def pick(t):
+            return t[batch, best, gj, gi]
+        l_x = jnp.where(valid, scale * (pick(tx) - tgt_x) ** 2, 0.0)
+        l_y = jnp.where(valid, scale * (pick(ty) - tgt_y) ** 2, 0.0)
+        l_w = jnp.where(valid, scale * (pick(tw) - tgt_w) ** 2, 0.0)
+        l_h = jnp.where(valid, scale * (pick(th) - tgt_h) ** 2, 0.0)
+
+        obj_target = jnp.zeros((n, na, h, w)).at[
+            batch, best, gj, gi].max(valid.astype(jnp.float32))
+        bce = jnp.maximum(tobj, 0) - tobj * obj_target + \
+            jnp.log1p(jnp.exp(-jnp.abs(tobj)))
+        l_obj = jnp.sum(bce, axis=(1, 2, 3))
+
+        smooth = 1.0 / class_num if use_label_smooth else 0.0
+        cls_target = jax.nn.one_hot(gtl, class_num) * (1 - 2 * smooth) \
+            + smooth
+        cls_logit = tcls[batch, best, :, gj, gi]
+        cbce = jnp.maximum(cls_logit, 0) - cls_logit * cls_target + \
+            jnp.log1p(jnp.exp(-jnp.abs(cls_logit)))
+        l_cls = jnp.where(valid[..., None], cbce, 0.0).sum((-1, -2))
+
+        per = (l_x + l_y + l_w + l_h).sum(-1) + l_obj + l_cls
+        return per
+    return apply(fn, x, name="yolo_loss")
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; reference matrix_nms op): soft decay by
+    pairwise IoU instead of hard suppression."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+
+    bx = np.asarray(unwrap(bboxes))
+    sc = np.asarray(unwrap(scores))
+    outs = []
+    idxs = []
+    nums = []
+    for n in range(bx.shape[0]):
+        cls_best = sc[n].max(0)
+        cls_id = sc[n].argmax(0)
+        keep = np.where(cls_best > score_threshold)[0]
+        if keep.size == 0:
+            nums.append(0)
+            continue
+        order = keep[np.argsort(-cls_best[keep])][:nms_top_k]
+        b = bx[n][order]
+        s = cls_best[order]
+        x0 = np.maximum(b[:, None, 0], b[None, :, 0])
+        y0 = np.maximum(b[:, None, 1], b[None, :, 1])
+        x1 = np.minimum(b[:, None, 2], b[None, :, 2])
+        y1 = np.minimum(b[:, None, 3], b[None, :, 3])
+        inter = np.clip(x1 - x0, 0, None) * np.clip(y1 - y0, 0, None)
+        area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        iou = inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                 1e-9)
+        iou = np.triu(iou, 1)
+        # max_iou[i]: the suppressor i's own worst overlap with anything
+        # ranked above it — the compensation term is indexed by the
+        # SUPPRESSOR (rows), not the suppressed box (columns)
+        max_iou = iou.max(0)
+        if use_gaussian:
+            decay = np.exp(-(iou ** 2 - max_iou[:, None] ** 2)
+                           / gaussian_sigma).min(0)
+        else:
+            decay = ((1 - iou) / np.maximum(1 - max_iou[:, None], 1e-9)
+                     ).min(0)
+        s2 = s * decay
+        keep2 = np.where(s2 > post_threshold)[0][:keep_top_k]
+        rows = np.stack([cls_id[order][keep2].astype("float32"),
+                         s2[keep2]], 1)
+        outs.append(np.concatenate([rows, b[keep2]], 1))
+        idxs.append(order[keep2] + n * bx.shape[1])
+        nums.append(len(keep2))
+    out = np.concatenate(outs, 0) if outs else np.zeros((0, 6), "float32")
+    result = [Tensor(out)]
+    if return_index:
+        result.append(Tensor(np.concatenate(idxs).astype("int64")
+                             if idxs else np.zeros((0,), "int64")))
+    if return_rois_num:
+        result.append(Tensor(np.asarray(nums, "int64")))
+    return tuple(result) if len(result) > 1 else result[0]
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference
+    distribute_fpn_proposals)."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+
+    rois = np.asarray(unwrap(fpn_rois))
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(np.maximum(w * h, 1e-9))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    multi = []
+    restore = []
+    nums = []
+    for l in range(min_level, max_level + 1):
+        sel = np.where(lvl == l)[0]
+        multi.append(Tensor(rois[sel]))
+        restore.append(sel)
+        nums.append(Tensor(np.asarray([len(sel)], "int32")))
+    order = np.concatenate(restore) if restore else np.zeros(0, int)
+    inv = np.empty_like(order)
+    inv[order] = np.arange(order.size)
+    return multi, Tensor(inv.astype("int32")[:, None]), nums
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference generate_proposals): decode
+    deltas at anchors, clip, filter small, NMS."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+
+    sc = np.asarray(unwrap(scores))        # [n, a, h, w]
+    bd = np.asarray(unwrap(bbox_deltas))   # [n, 4a, h, w]
+    ims = np.asarray(unwrap(img_size))     # [n, 2] (h, w)
+    anc = np.asarray(unwrap(anchors)).reshape(-1, 4)
+    var = np.asarray(unwrap(variances)).reshape(-1, 4)
+
+    all_rois = []
+    nums = []
+    for n in range(sc.shape[0]):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = bd[n].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s = s[order]
+        d = d[order]
+        a = anc[order % anc.shape[0]] if anc.shape[0] != d.shape[0] \
+            else anc[order]
+        v = var[order % var.shape[0]] if var.shape[0] != d.shape[0] \
+            else var[order]
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        bw = np.exp(np.minimum(v[:, 2] * d[:, 2], 10)) * aw
+        bh = np.exp(np.minimum(v[:, 3] * d[:, 3], 10)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2,
+                          cy + bh / 2], 1)
+        ih, iw = ims[n]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - 1)
+        keep = np.where((boxes[:, 2] - boxes[:, 0] >= min_size) &
+                        (boxes[:, 3] - boxes[:, 1] >= min_size))[0]
+        boxes = boxes[keep]
+        s = s[keep]
+        # greedy nms
+        order2 = np.argsort(-s)
+        picked = []
+        while order2.size and len(picked) < post_nms_top_n:
+            i = order2[0]
+            picked.append(i)
+            if order2.size == 1:
+                break
+            rest = order2[1:]
+            xx0 = np.maximum(boxes[i, 0], boxes[rest, 0])
+            yy0 = np.maximum(boxes[i, 1], boxes[rest, 1])
+            xx1 = np.minimum(boxes[i, 2], boxes[rest, 2])
+            yy1 = np.minimum(boxes[i, 3], boxes[rest, 3])
+            inter = np.clip(xx1 - xx0, 0, None) * np.clip(yy1 - yy0, 0,
+                                                          None)
+            ai = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            ar = (boxes[rest, 2] - boxes[rest, 0]) * \
+                (boxes[rest, 3] - boxes[rest, 1])
+            iou = inter / np.maximum(ai + ar - inter, 1e-9)
+            order2 = rest[iou <= nms_thresh]
+        all_rois.append(boxes[picked])
+        nums.append(len(picked))
+    rois = np.concatenate(all_rois, 0) if all_rois else \
+        np.zeros((0, 4), "float32")
+    out = (Tensor(rois.astype("float32")),
+           Tensor(np.concatenate([np.full(k, i) for i, k in
+                                  enumerate(nums)]).astype("float32")
+                  if nums else np.zeros(0, "float32")))
+    if return_rois_num:
+        return out + (Tensor(np.asarray(nums, "int32")),)
+    return out

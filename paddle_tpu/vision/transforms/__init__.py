@@ -3,8 +3,14 @@ transforms/)."""
 
 from .transforms import (  # noqa: F401
     BaseTransform, BrightnessTransform, CenterCrop, ColorJitter, Compose,
-    ContrastTransform, Grayscale, Normalize, Pad, RandomCrop,
-    RandomHorizontalFlip, RandomResizedCrop, RandomRotation,
-    RandomVerticalFlip, Resize, ToTensor, Transpose,
+    ContrastTransform, Grayscale, HueTransform, Normalize, Pad,
+    RandomAffine, RandomCrop, RandomErasing, RandomHorizontalFlip,
+    RandomPerspective, RandomResizedCrop, RandomRotation,
+    RandomVerticalFlip, Resize, SaturationTransform, ToTensor, Transpose,
+)
+from .functional import (  # noqa: F401
+    adjust_brightness, adjust_contrast, adjust_hue, adjust_saturation,
+    affine, center_crop, crop, erase, hflip, normalize, pad, perspective,
+    resize, rotate, to_grayscale, to_tensor, vflip,
 )
 from . import functional  # noqa: F401

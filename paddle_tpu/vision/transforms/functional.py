@@ -167,3 +167,144 @@ def to_grayscale(img, num_output_channels=1):
     if num_output_channels == 3:
         g = np.repeat(g, 3, axis=-1)
     return g.astype(_to_numpy(img).dtype)
+
+
+def adjust_saturation(img, factor):
+    """Blend with the grayscale image (reference adjust_saturation)."""
+    arr = _to_numpy(img).astype("float32")
+    g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+    out = arr * factor + g[..., None] * (1 - factor)
+    return np.clip(out, 0, 255).astype("uint8") \
+        if _to_numpy(img).dtype == np.uint8 else out
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue in HSV space by hue_factor (in [-0.5, 0.5]; reference
+    adjust_hue)."""
+    arr = _to_numpy(img).astype("float32")
+    was_uint8 = _to_numpy(img).dtype == np.uint8
+    x = arr / 255.0 if was_uint8 else arr
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    maxc = np.maximum(np.maximum(r, g), b)
+    minc = np.minimum(np.minimum(r, g), b)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    h = np.where(
+        maxc == r, ((g - b) / dz) % 6,
+        np.where(maxc == g, (b - r) / dz + 2, (r - g) / dz + 4)) / 6.0
+    h = np.where(delta == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    # hsv -> rgb
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = (i.astype(int) % 6)[..., None]
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    if was_uint8:
+        return np.clip(out * 255.0, 0, 255).astype("uint8")
+    return out
+
+
+def _sample_affine(arr, matrix, interpolation="nearest", fill=0):
+    """Inverse-warp sampling with a 2x3 (or 3x3) matrix mapping OUTPUT
+    pixel coords to INPUT coords."""
+    h, w = arr.shape[:2]
+    m = np.asarray(matrix, "float64").reshape(-1)
+    if m.size == 6:
+        m = np.concatenate([m, [0, 0, 1]])
+    m = m.reshape(3, 3)
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1).astype("float64")
+    src = m @ coords
+    sx = src[0] / np.maximum(np.abs(src[2]), 1e-12) * np.sign(src[2])
+    sy = src[1] / np.maximum(np.abs(src[2]), 1e-12) * np.sign(src[2])
+    eps = 1e-4  # numerical slack so exact borders stay inside
+    valid = (sx >= -eps) & (sx <= w - 1 + eps) & \
+        (sy >= -eps) & (sy <= h - 1 + eps)
+    sx = np.clip(sx, 0, w - 1)
+    sy = np.clip(sy, 0, h - 1)
+    if interpolation == "bilinear":
+        x0 = np.clip(np.floor(sx).astype(int), 0, w - 1)
+        y0 = np.clip(np.floor(sy).astype(int), 0, h - 1)
+        x1 = np.clip(x0 + 1, 0, w - 1)
+        y1 = np.clip(y0 + 1, 0, h - 1)
+        wx = (sx - x0)[..., None]
+        wy = (sy - y0)[..., None]
+        a2 = arr.reshape(h, w, -1).astype("float32")
+        out = (a2[y0, x0] * (1 - wx) * (1 - wy) + a2[y0, x1] * wx * (1 - wy)
+               + a2[y1, x0] * (1 - wx) * wy + a2[y1, x1] * wx * wy)
+    else:
+        ix = np.clip(np.round(sx).astype(int), 0, w - 1)
+        iy = np.clip(np.round(sy).astype(int), 0, h - 1)
+        out = arr.reshape(h, w, -1)[iy, ix].astype("float32")
+    out = np.where(valid[:, None], out, np.float32(fill))
+    out = out.reshape(h, w, *arr.shape[2:])
+    return np.clip(out, 0, 255).astype("uint8") \
+        if arr.dtype == np.uint8 else out.astype(arr.dtype)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    """Affine warp (reference transforms.functional.affine)."""
+    arr = _to_numpy(img)
+    h, w = arr.shape[:2]
+    cx, cy = center if center is not None else ((w - 1) / 2, (h - 1) / 2)
+    rot = np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in (
+        shear if isinstance(shear, (list, tuple)) else (shear, 0.0))]
+    # forward matrix: T(center) R S Shear T(-center) T(translate)
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    fwd = np.array([[a * scale, b * scale, 0],
+                    [c * scale, d * scale, 0],
+                    [0, 0, 1]], "float32")
+    t_c = np.array([[1, 0, cx + translate[0]], [0, 1, cy + translate[1]],
+                    [0, 0, 1]], "float32")
+    t_nc = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], "float32")
+    m = t_c @ fwd @ t_nc
+    inv = np.linalg.inv(m)
+    return _sample_affine(arr, inv, interpolation, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective warp mapping startpoints -> endpoints (reference
+    transforms.functional.perspective)."""
+    arr = _to_numpy(img)
+    src = np.asarray(startpoints, "float32")
+    dst = np.asarray(endpoints, "float32")
+    # solve homography dst -> src (inverse warp)
+    A = []
+    for (xs, ys), (xd, yd) in zip(src, dst):
+        A.append([xd, yd, 1, 0, 0, 0, -xs * xd, -xs * yd, -xs])
+        A.append([0, 0, 0, xd, yd, 1, -ys * xd, -ys * yd, -ys])
+    A = np.asarray(A, "float64")
+    _, _, vt = np.linalg.svd(A)
+    m = vt[-1].reshape(3, 3)
+    m = m / m[2, 2]
+    return _sample_affine(arr, m, interpolation, fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase a region with value v (reference functional.erase)."""
+    if isinstance(img, Tensor):
+        arr = img.numpy().copy()
+        arr[..., i:i + h, j:j + w] = v
+        return Tensor(arr)
+    arr = _to_numpy(img).copy()
+    if arr.ndim == 3:  # HWC
+        arr[i:i + h, j:j + w, :] = v
+    else:
+        arr[i:i + h, j:j + w] = v
+    return arr

@@ -11,6 +11,9 @@ import numpy as np
 from . import functional as F
 
 
+from . import functional as functional_mod
+
+
 class BaseTransform:
     def __init__(self, keys=None):
         self.keys = keys
@@ -235,3 +238,113 @@ class Grayscale(BaseTransform):
 
     def _apply_image(self, img):
         return F.to_grayscale(img, self.num_output_channels)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__()
+        self.value = value
+
+    def _apply_image(self, img):
+        import random
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return functional_mod.adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__()
+        self.value = min(max(value, 0.0), 0.5)
+
+    def _apply_image(self, img):
+        import random
+        f = random.uniform(-self.value, self.value)
+        return functional_mod.adjust_hue(img, f)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__()
+        self.degrees = degrees if isinstance(degrees, (list, tuple)) \
+            else (-degrees, degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        import random
+        angle = random.uniform(*self.degrees)
+        h, w = functional_mod._to_numpy(img).shape[:2]
+        tx = ty = 0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        sh = (random.uniform(-self.shear, self.shear)
+              if isinstance(self.shear, (int, float)) and self.shear
+              else 0.0)
+        return functional_mod.affine(
+            img, angle=angle, translate=(tx, ty), scale=sc,
+            shear=(sh, 0.0), interpolation=self.interpolation,
+            fill=self.fill, center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__()
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        import random
+        if random.random() >= self.prob:
+            return img
+        h, w = functional_mod._to_numpy(img).shape[:2]
+        d = self.distortion_scale
+        hw = int(w * d / 2)
+        hh = int(h * d / 2)
+
+        def jig(x, y):
+            return (x + random.randint(-hw, hw) if hw else x,
+                    y + random.randint(-hh, hh) if hh else y)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [jig(*p) for p in start]
+        return functional_mod.perspective(img, start, end,
+                                          self.interpolation, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__()
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        import math
+        import random
+        if random.random() >= self.prob:
+            return img
+        arr = functional_mod._to_numpy(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = math.exp(random.uniform(math.log(self.ratio[0]),
+                                         math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target * ar)))
+            ew = int(round(math.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                return functional_mod.erase(img, i, j, eh, ew, self.value)
+        return img

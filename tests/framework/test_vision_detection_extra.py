@@ -1,0 +1,157 @@
+"""Detection-op and transform long tail (reference vision/ops.py +
+vision/transforms/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+V = paddle.vision.ops
+T = paddle.vision.transforms
+
+
+def _img():
+    return (np.random.default_rng(0).random((16, 16, 3)) * 255).astype(
+        "uint8")
+
+
+def test_affine_identity_and_rotation():
+    img = _img()
+    np.testing.assert_array_equal(T.affine(img, angle=0.0), img)
+    # 90-degree rotation about the center is a permutation of pixels
+    r = T.affine(img, angle=90.0)
+    assert r.shape == img.shape
+    assert not np.array_equal(r, img)
+    r4 = img
+    for _ in range(4):
+        r4 = T.affine(r4, angle=90.0)
+    # four quarter turns land back on the original (nearest sampling)
+    assert (r4 == img).mean() > 0.95
+
+
+def test_perspective_identity_and_warp():
+    img = _img()
+    corners = [(0, 0), (15, 0), (15, 15), (0, 15)]
+    np.testing.assert_array_equal(
+        T.perspective(img, corners, corners), img)
+    warped = T.perspective(img, corners,
+                           [(1, 1), (14, 0), (15, 15), (0, 14)])
+    assert warped.shape == img.shape
+
+
+def test_hue_saturation_roundtrip():
+    img = _img()
+    assert np.abs(T.adjust_hue(img, 0.0).astype(int) -
+                  img.astype(int)).max() <= 2
+    assert np.abs(T.adjust_saturation(img, 1.0).astype(int) -
+                  img.astype(int)).max() <= 1
+    gray = T.adjust_saturation(img, 0.0)
+    # zero saturation -> channels equal
+    assert np.abs(gray[..., 0].astype(int) -
+                  gray[..., 1].astype(int)).max() <= 1
+
+
+def test_erase_and_random_transforms():
+    img = _img()
+    e = T.erase(img, 2, 3, 4, 5, 9)
+    assert (e[2:6, 3:8] == 9).all()
+    assert (e[:2] == img[:2]).all()
+    for t in [T.HueTransform(0.2), T.SaturationTransform(0.3),
+              T.RandomAffine(15), T.RandomPerspective(1.0),
+              T.RandomErasing(1.0)]:
+        assert t(img).shape == img.shape
+
+
+def test_prior_box_geometry():
+    feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), "float32"))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 32), "float32"))
+    boxes, var = V.prior_box(feat, img, min_sizes=[8.0],
+                             aspect_ratios=[1.0, 2.0], flip=True,
+                             clip=True)
+    b = boxes.numpy()
+    assert b.shape == (4, 4, 3, 4)
+    assert b.min() >= 0.0 and b.max() <= 1.0
+    # ar=1 prior at cell (0,0): 8x8 box centered at (4,4) of a 32px image
+    np.testing.assert_allclose(b[0, 0, 0], [0, 0, 0.25, 0.25], atol=1e-6)
+
+
+def test_yolo_box_decode():
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(np.zeros((1, 3 * 7, 2, 2), "float32"))
+    boxes, scores = V.yolo_box(
+        x, paddle.to_tensor(np.array([[64, 64]])),
+        [10, 13, 16, 30, 33, 23], 2, conf_thresh=0.0)
+    b = boxes.numpy()
+    assert b.shape == (1, 12, 4)
+    # zero logits: sigmoid=0.5 -> center of each cell, anchor-sized boxes
+    cx = (b[0, 0, 0] + b[0, 0, 2]) / 2
+    assert abs(cx - 16.0) < 1.0  # cell 0 center = 0.25 * 64
+    s = scores.numpy()
+    np.testing.assert_allclose(s, 0.25, atol=1e-5)  # 0.5 * 0.5
+
+
+def test_yolo_loss_decreases_on_fit_target():
+    """Loss at the exact target parametrization < loss at random."""
+    rng = np.random.default_rng(2)
+    gtb = paddle.to_tensor(np.array([[[0.5, 0.5, 0.25, 0.25]]], "float32"))
+    gtl = paddle.to_tensor(np.array([[1]]))
+    anchors = [10, 13, 16, 30, 33, 23]
+    rand = paddle.to_tensor(
+        rng.standard_normal((1, 21, 4, 4)).astype("float32") * 3)
+    l_rand = float(V.yolo_loss(rand, gtb, gtl, anchors, [0, 1, 2], 2,
+                               0.7, 32).sum())
+    l_zero = float(V.yolo_loss(
+        paddle.to_tensor(np.zeros((1, 21, 4, 4), "float32")), gtb, gtl,
+        anchors, [0, 1, 2], 2, 0.7, 32).sum())
+    assert np.isfinite(l_rand) and np.isfinite(l_zero)
+    assert l_zero < l_rand
+
+
+def test_matrix_nms_decays_overlaps():
+    bx = paddle.to_tensor(np.array(
+        [[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]], "float32"))
+    sc = paddle.to_tensor(np.array([[[0.9, 0.85, 0.8]]], "float32"))
+    out, nums = V.matrix_nms(bx, sc, 0.1)
+    o = out.numpy()
+    assert int(nums.numpy()[0]) == 3
+    # overlapping box decayed below its raw score; distant box untouched
+    assert o[1, 1] < 0.85 and abs(o[2, 1] - 0.8) < 1e-5
+
+
+def test_distribute_fpn_and_proposals():
+    rois = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [0, 0, 200, 200], [0, 0, 220, 230]], "float32"))
+    multi, restore, nums = V.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    sizes = [m.shape[0] for m in multi]
+    assert sum(sizes) == 3
+    assert sizes[0] == 1  # the small roi lands on the lowest level
+    rng = np.random.default_rng(3)
+    scores = paddle.to_tensor(rng.random((1, 3, 4, 4)).astype("float32"))
+    deltas = paddle.to_tensor(
+        rng.standard_normal((1, 12, 4, 4)).astype("float32") * 0.1)
+    anchors = paddle.to_tensor(rng.random((48, 4)).astype("float32") * 20)
+    var = paddle.to_tensor(np.ones((48, 4), "float32"))
+    r, _, n = V.generate_proposals(
+        scores, deltas, paddle.to_tensor(np.array([[32, 32]], "float32")),
+        anchors, var, post_nms_top_n=5, return_rois_num=True)
+    assert r.shape[0] <= 5 and int(n.numpy()[0]) == r.shape[0]
+
+
+def test_read_file_and_roi_layers(tmp_path):
+    f = tmp_path / "blob.bin"
+    f.write_bytes(bytes(range(10)))
+    t = V.read_file(str(f))
+    assert t.numpy().tolist() == list(range(10))
+    x = paddle.to_tensor(
+        np.random.default_rng(4).standard_normal((1, 4, 8, 8)).astype(
+            "float32"))
+    boxes = paddle.to_tensor(np.array([[0, 0, 7, 7]], "float32"))
+    bn = paddle.to_tensor(np.array([1], "int32"))
+    out = V.RoIAlign(2)(x, boxes, bn)
+    assert out.shape == [1, 4, 2, 2]
+    out = V.RoIPool(2)(x, boxes, bn)
+    assert out.shape == [1, 4, 2, 2]
+    xp = paddle.to_tensor(np.random.default_rng(5).standard_normal(
+        (1, 2 * 4, 8, 8)).astype("float32"))
+    out = V.PSRoIPool(2)(xp, boxes, bn)
+    assert out.shape == [1, 2, 2, 2]
