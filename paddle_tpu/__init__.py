@@ -67,6 +67,7 @@ from . import vision  # noqa: F401
 from . import text  # noqa: F401
 from . import geometric  # noqa: F401
 from . import fft  # noqa: F401
+from . import onnx  # noqa: F401
 from . import signal  # noqa: F401
 # the reference re-exports stft/istft at top level from paddle.signal
 from .signal import istft, stft  # noqa: F401

@@ -3,3 +3,6 @@ functional: Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC)."""
 
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import info, load, save  # noqa: F401

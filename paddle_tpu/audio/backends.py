@@ -1,0 +1,88 @@
+"""`paddle.audio.backends` (reference audio/backends/wave_backend.py):
+WAV load/save/info over the stdlib wave module."""
+
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(),
+                         f.getnchannels(), f.getsampwidth() * 8)
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (waveform Tensor [channels, samples] when channels_first,
+    sample_rate)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype).reshape(-1, ch)
+    if normalize:
+        if width == 1:
+            wav = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            wav = data.astype(np.float32) / float(2 ** (width * 8 - 1))
+    else:
+        wav = data.astype(np.float32)
+    if channels_first:
+        wav = wav.T
+    return Tensor(np.ascontiguousarray(wav)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    wav = np.asarray(unwrap(src))
+    if channels_first:
+        wav = wav.T  # -> [samples, channels]
+    if wav.ndim == 1:
+        wav = wav[:, None]
+    width = bits_per_sample // 8
+    scale = float(2 ** (bits_per_sample - 1) - 1)
+    data = np.clip(wav, -1.0, 1.0) * scale
+    dtype = {2: np.int16, 4: np.int32}[width]
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(wav.shape[1])
+        f.setsampwidth(width)
+        f.setframerate(int(sample_rate))
+        f.writeframes(data.astype(dtype).tobytes())
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name not in ("wave_backend",):
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable; only the stdlib "
+            "wave backend ships in this build")

@@ -1,0 +1,101 @@
+"""`paddle.audio.datasets` (reference audio/datasets/: TESS, ESC50).
+Local-file parsers like the text/vision datasets (archives of wav files;
+labels from filenames/metadata)."""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from ..io import Dataset
+from . import backends
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _AudioFeatureDataset(Dataset):
+    def __init__(self, feat_type="raw", sample_rate=None, **feat_kwargs):
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+
+    def _featurize(self, wav, sr):
+        if self.feat_type == "raw":
+            return wav
+        from .features import (LogMelSpectrogram, MFCC, MelSpectrogram,
+                               Spectrogram)
+        cls = {"spectrogram": Spectrogram,
+               "melspectrogram": MelSpectrogram,
+               "logmelspectrogram": LogMelSpectrogram,
+               "mfcc": MFCC}[self.feat_type]
+        ext = cls(sr=sr, **self.feat_kwargs) if "sr" in \
+            cls.__init__.__code__.co_varnames else cls(**self.feat_kwargs)
+        return ext(wav)
+
+
+class TESS(_AudioFeatureDataset):
+    """Toronto emotional speech set (reference tess.py): wav files named
+    <talker>_<word>_<emotion>.wav under per-speaker folders."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad"]
+
+    def __init__(self, data_dir=None, mode="train", n_folds=5,
+                 split=1, feat_type="raw", archive=None, **kwargs):
+        super().__init__(feat_type, **kwargs)
+        assert data_dir, "pass data_dir= pointing at the extracted TESS"
+        files = []
+        for root, _, names in os.walk(data_dir):
+            files += [os.path.join(root, n) for n in names
+                      if n.lower().endswith(".wav")]
+        files.sort()
+        self.files = []
+        self.labels = []
+        for i, f in enumerate(files):
+            emotion = os.path.splitext(os.path.basename(f))[0].split(
+                "_")[-1].lower()
+            if emotion not in self.EMOTIONS:
+                continue
+            fold = i % n_folds + 1
+            keep = (fold != split) if mode == "train" else (fold == split)
+            if keep:
+                self.files.append(f)
+                self.labels.append(self.EMOTIONS.index(emotion))
+
+    def __getitem__(self, idx):
+        wav, sr = backends.load(self.files[idx])
+        return self._featurize(wav, sr), np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(_AudioFeatureDataset):
+    """ESC-50 environmental sounds (reference esc50.py): audio/ dir +
+    meta/esc50.csv with filename,fold,target columns."""
+
+    def __init__(self, data_dir=None, mode="train", split=1,
+                 feat_type="raw", archive=None, **kwargs):
+        super().__init__(feat_type, **kwargs)
+        assert data_dir, "pass data_dir= pointing at the extracted ESC-50"
+        meta = os.path.join(data_dir, "meta", "esc50.csv")
+        audio_dir = os.path.join(data_dir, "audio")
+        self.files = []
+        self.labels = []
+        with open(meta) as f:
+            for row in csv.DictReader(f):
+                fold = int(row["fold"])
+                keep = (fold != split) if mode == "train" \
+                    else (fold == split)
+                if keep:
+                    self.files.append(
+                        os.path.join(audio_dir, row["filename"]))
+                    self.labels.append(int(row["target"]))
+
+    def __getitem__(self, idx):
+        wav, sr = backends.load(self.files[idx])
+        return self._featurize(wav, sr), np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)

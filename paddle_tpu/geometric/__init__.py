@@ -10,7 +10,9 @@ import jax.numpy as jnp
 from ..core.dispatch import apply, unwrap
 
 __all__ = ["send_u_recv", "send_ue_recv", "segment_sum", "segment_mean",
-           "segment_max", "segment_min"]
+           "segment_max", "segment_min",
+           "reindex_graph", "reindex_heter_graph", "sample_neighbors",
+           "weighted_sample_neighbors", "send_uv"]
 
 
 def _out_size(dst, out_size):
@@ -110,3 +112,126 @@ def segment_max(x, segment_ids, name=None):
 
 def segment_min(x, segment_ids, name=None):
     return _segment(x, segment_ids, "min")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """Compact global node ids to local ids (reference reindex_graph):
+    returns (reindexed src, reindexed dst, out_nodes)."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    from ..core.tensor import Tensor
+
+    xs = np.asarray(unwrap(x)).reshape(-1)
+    nb = np.asarray(unwrap(neighbors)).reshape(-1)
+    cnt = np.asarray(unwrap(count)).reshape(-1)
+    out_nodes = list(xs)
+    seen = {int(v): i for i, v in enumerate(xs)}
+    for v in nb:
+        v = int(v)
+        if v not in seen:
+            seen[v] = len(out_nodes)
+            out_nodes.append(v)
+    reindex_src = np.asarray([seen[int(v)] for v in nb], np.int64)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    return (Tensor(reindex_src), Tensor(reindex_dst),
+            Tensor(np.asarray(out_nodes, np.int64)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are lists per edge type."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    from ..core.tensor import Tensor
+
+    xs = np.asarray(unwrap(x)).reshape(-1)
+    seen = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    srcs, dsts = [], []
+    for nb_t, cnt_t in zip(neighbors, count):
+        nb = np.asarray(unwrap(nb_t)).reshape(-1)
+        cnt = np.asarray(unwrap(cnt_t)).reshape(-1)
+        for v in nb:
+            v = int(v)
+            if v not in seen:
+                seen[v] = len(out_nodes)
+                out_nodes.append(v)
+        srcs.append(np.asarray([seen[int(v)] for v in nb], np.int64))
+        dsts.append(np.repeat(np.arange(len(xs), dtype=np.int64), cnt))
+    return (Tensor(np.concatenate(srcs)), Tensor(np.concatenate(dsts)),
+            Tensor(np.asarray(out_nodes, np.int64)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """CSC neighbor sampling (reference geometric.sample_neighbors)."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    from ..core.tensor import Tensor
+
+    r = np.asarray(unwrap(row)).reshape(-1)
+    cp = np.asarray(unwrap(colptr)).reshape(-1)
+    nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
+    out, counts = [], []
+    for v in nodes:
+        lo, hi = int(cp[int(v)]), int(cp[int(v) + 1])
+        neigh = r[lo:hi]
+        if 0 <= sample_size < len(neigh):
+            neigh = np.random.choice(neigh, sample_size, replace=False)
+        out.append(neigh)
+        counts.append(len(neigh))
+    return (Tensor(np.concatenate(out).astype(np.int64) if out
+                   else np.zeros(0, np.int64)),
+            Tensor(np.asarray(counts, np.int64)))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None,
+                              return_eids=False, name=None):
+    """Weight-proportional neighbor sampling."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    from ..core.tensor import Tensor
+
+    r = np.asarray(unwrap(row)).reshape(-1)
+    cp = np.asarray(unwrap(colptr)).reshape(-1)
+    w = np.asarray(unwrap(edge_weight)).reshape(-1)
+    nodes = np.asarray(unwrap(input_nodes)).reshape(-1)
+    out, counts = [], []
+    for v in nodes:
+        lo, hi = int(cp[int(v)]), int(cp[int(v) + 1])
+        neigh = r[lo:hi]
+        wv = w[lo:hi]
+        if 0 <= sample_size < len(neigh):
+            pvals = wv / wv.sum()
+            neigh = np.random.choice(neigh, sample_size, replace=False,
+                                     p=pvals)
+        out.append(neigh)
+        counts.append(len(neigh))
+    return (Tensor(np.concatenate(out).astype(np.int64) if out
+                   else np.zeros(0, np.int64)),
+            Tensor(np.asarray(counts, np.int64)))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from src node features x and dst node features y
+    (reference send_uv)."""
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply, as_index, unwrap
+
+    src = as_index(unwrap(src_index))
+    dst = as_index(unwrap(dst_index))
+    ops_map = {"add": jnp.add, "sub": jnp.subtract,
+               "mul": jnp.multiply, "div": jnp.divide}
+    op = ops_map[message_op]
+
+    def fn(a, b):
+        return op(a[src], b[dst])
+    return apply(fn, x, y, name="send_uv")

@@ -5,3 +5,81 @@ from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from ..core.autograd import no_grad  # noqa: F401
+from ..geometric import (  # noqa: F401,E402
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from ..geometric import (  # noqa: F401,E402
+    reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+    send_u_recv as graph_send_recv,
+)
+from .. import inference  # noqa: F401,E402
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference incubate
+    graph_khop_sampler): chain of per-hop sample_neighbors + reindex."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+    from ..core.tensor import Tensor
+    from ..geometric import reindex_graph, sample_neighbors
+
+    nodes = input_nodes
+    all_src, all_dst = [], []
+    frontier = nodes
+    for k in sample_sizes:
+        nb, cnt = sample_neighbors(row, colptr, frontier, sample_size=k)
+        rs, rd, out_nodes = reindex_graph(frontier, nb, cnt)
+        all_src.append(np.asarray(unwrap(nb)))
+        all_dst.append(np.repeat(
+            np.asarray(unwrap(frontier)).reshape(-1),
+            np.asarray(unwrap(cnt))))
+        frontier = out_nodes
+    edge_src = Tensor(np.concatenate(all_src).astype(np.int64)
+                      if all_src else np.zeros(0, np.int64))
+    edge_dst = Tensor(np.concatenate(all_dst).astype(np.int64)
+                      if all_dst else np.zeros(0, np.int64))
+    # compact the union of touched nodes
+    rs, rd, sample_index = reindex_graph(input_nodes, edge_src,
+                                         Tensor(np.asarray(
+                                             [len(np.asarray(
+                                                 unwrap(edge_src)))],
+                                             np.int64)))
+    return edge_src, edge_dst, sample_index, None
+
+
+def identity_loss(x, reduction="none"):
+    """Reference incubate.identity_loss (IPU training marker): the value
+    passes through (with optional reduction)."""
+    if reduction in (0, "sum"):
+        return x.sum()
+    if reduction in (1, "mean"):
+        return x.mean()
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (reference incubate.softmax_mask_fuse)."""
+    import jax
+
+    from ..core.dispatch import apply
+
+    return apply(lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask,
+                 name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Fused causal-masked softmax (reference
+    softmax_mask_fuse_upper_triangle)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import apply
+
+    def fn(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
+    return apply(fn, x, name="softmax_mask_fuse_upper_triangle")

@@ -29,6 +29,7 @@ __all__ = [
     "ChainDataset", "Subset", "ConcatDataset", "random_split", "Sampler",
     "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "BatchSampler", "DistributedBatchSampler", "DataLoader",
+    "SubsetRandomSampler",
     "get_worker_info", "default_collate_fn",
 ]
 
@@ -169,6 +170,21 @@ class RandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """Random order over a fixed index subset (reference
+    io/SubsetRandomSampler)."""
+
+    def __init__(self, indices, generator=None):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        return iter(np.random.permutation(
+            np.asarray(self.indices)).tolist())
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class WeightedRandomSampler(Sampler):

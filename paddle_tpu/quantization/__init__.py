@@ -16,6 +16,7 @@ from ..core.dispatch import apply
 from ..core.tensor import Tensor
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
+           "BaseObserver", "BaseQuanter", "quanter",
            "AbsmaxObserver", "quanted_linear"]
 
 
@@ -176,3 +177,35 @@ def quanted_linear(x, w_int8, scale, bias=None):
 def _clone(model):
     import copy
     return copy.deepcopy(model)
+
+
+class BaseObserver(nn.Layer):
+    """Observer base (reference quantization/factory.py BaseObserver):
+    collects statistics in forward, yields scales for quantization."""
+
+    def forward(self, x):
+        return x
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
+class BaseQuanter(BaseObserver):
+    """Quanter base (reference BaseQuanter): fake-quantizes in forward."""
+
+
+def quanter(name):
+    """Class decorator registering a quanter under a config name
+    (reference quantization/factory.py quanter)."""
+    registry = _QUANTER_REGISTRY
+
+    def wrap(cls):
+        registry[name] = cls
+        return cls
+    return wrap
+
+
+_QUANTER_REGISTRY = {}

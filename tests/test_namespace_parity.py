@@ -71,3 +71,42 @@ def test_sparse_elementwise_and_structural():
     np.testing.assert_allclose(paddle.sparse.to_dense(masked).numpy(),
                                [[0, 1], [2, 3]] * np.asarray(
                                    [[0, 1], [1, 1]], "float32"))
+
+
+NAMESPACES = [
+    ("", "__init__.py"),
+    ("nn", "nn/__init__.py"),
+    ("nn.functional", "nn/functional/__init__.py"),
+    ("nn.initializer", "nn/initializer/__init__.py"),
+    ("vision.ops", "vision/ops.py"),
+    ("vision.transforms", "vision/transforms/__init__.py"),
+    ("distributed", "distributed/__init__.py"),
+    ("io", "io/__init__.py"),
+    ("metric", "metric/__init__.py"),
+    ("profiler", "profiler/__init__.py"),
+    ("onnx", "onnx/__init__.py"),
+    ("incubate", "incubate/__init__.py"),
+    ("quantization", "quantization/__init__.py"),
+    ("static", "static/__init__.py"),
+    ("geometric", "geometric/__init__.py"),
+    ("audio", "audio/__init__.py"),
+    ("signal", "signal.py"),
+    ("amp", "amp/__init__.py"),
+]
+
+
+@pytest.mark.parametrize("ns,relpath", NAMESPACES,
+                         ids=[n or "paddle" for n, _ in NAMESPACES])
+def test_namespace_complete(ns, relpath):
+    """Every name in the reference namespace __all__ exists here."""
+    src = open(f"{REF}/{relpath}").read()
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
+    if m is None:
+        pytest.skip("reference file has no __all__")
+    names = re.findall(r"'([^']+)'", m.group(1)) + \
+        re.findall(r'"([^"]+)"', m.group(1))
+    obj = paddle
+    for part in (ns.split(".") if ns else []):
+        obj = getattr(obj, part)
+    missing = sorted(n for n in set(names) if not hasattr(obj, n))
+    assert missing == [], f"{ns or 'paddle'}: {missing}"
