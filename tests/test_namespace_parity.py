@@ -110,3 +110,27 @@ def test_namespace_complete(ns, relpath):
         obj = getattr(obj, part)
     missing = sorted(n for n in set(names) if not hasattr(obj, n))
     assert missing == [], f"{ns or 'paddle'}: {missing}"
+
+
+def test_tensor_method_surface_complete():
+    """Every reference tensor_method_func name binds as a Tensor
+    method."""
+    src = open(f"{REF}/tensor/__init__.py").read()
+    m = re.search(r"tensor_method_func\s*=\s*\[(.*?)\]", src, re.S)
+    names = re.findall(r"['\"]([^'\"]+)['\"]", m.group(1))
+    missing = sorted(n for n in set(names)
+                     if not hasattr(paddle.Tensor, n))
+    assert missing == [], missing
+
+
+def test_tensor_methods_actually_callable():
+    t = paddle.to_tensor(np.array([[4.0, 1.0], [2.0, 3.0]], "float32"))
+    assert t.addmm(t, t).shape == [2, 2]
+    assert t.cdist(t).shape == [2, 2]
+    assert t.logaddexp(t).shape == [2, 2]
+    m, e = t.frexp()
+    assert m.shape == [2, 2]
+    assert paddle.to_tensor([1, 2, 3]).isin(
+        paddle.to_tensor([2])).numpy().tolist() == [False, True, False]
+    assert t.is_floating_point()
+    assert t.is_tensor()
