@@ -3,7 +3,10 @@ SparseCooTensor/SparseCsrTensor, paddle/phi/core/sparse_coo_tensor.h).
 
 TPU-first: COO tensors wrap `jax.experimental.sparse.BCOO` — XLA lowers
 scatter/gather/spmm natively; CSR keeps (crows, cols, values) and
-converts through COO for compute.
+converts through COO for compute. The stored values additionally travel
+as an eager-tape `Tensor` (`_vt`), so sparse conv/norm/activation chains
+backpropagate end-to-end (reference: sparse grad kernels under
+paddle/phi/kernels/sparse/).
 """
 
 from __future__ import annotations
@@ -17,8 +20,8 @@ from ..core.dispatch import unwrap
 from ..core.tensor import Tensor
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "SparseCsrTensor", "add", "matmul", "masked_matmul", "mv",
-           "relu", "to_dense", "is_same_shape", "nn", "transpose",
+           "SparseCsrTensor", "add", "addmm", "matmul", "masked_matmul",
+           "mv", "relu", "to_dense", "is_same_shape", "nn", "transpose",
            "sin", "sinh", "asin", "asinh", "tan", "tanh", "atan", "atanh",
            "sqrt", "square", "log1p", "expm1", "abs", "neg", "deg2rad",
            "rad2deg", "isnan", "pow", "cast", "coalesce", "subtract",
@@ -27,9 +30,12 @@ __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
 
 
 class SparseCooTensor:
-    def __init__(self, bcoo, shape=None):
+    def __init__(self, bcoo, shape=None, values_tensor=None):
         self._bcoo = bcoo
         self._shape = list(shape or bcoo.shape)
+        # tape-linked view of the stored values (grads flow through it)
+        self._vt = values_tensor if values_tensor is not None \
+            else Tensor(bcoo.data)
 
     @property
     def shape(self):
@@ -39,7 +45,7 @@ class SparseCooTensor:
         return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
 
     def values(self):
-        return Tensor(self._bcoo.data)
+        return self._vt
 
     @property
     def dtype(self):
@@ -49,7 +55,15 @@ class SparseCooTensor:
         return int(self._bcoo.nse)
 
     def to_dense(self):
-        return Tensor(self._bcoo.todense())
+        from ..core.dispatch import apply
+        idx = self._bcoo.indices
+        shape = tuple(self._shape)
+
+        def scatter(v):
+            dense = jnp.zeros(shape, v.dtype)
+            return dense.at[tuple(idx.T)].add(v)
+
+        return apply(scatter, self._vt, name="sparse_to_dense")
 
     def to_sparse_csr(self):
         coo = self._bcoo.sum_duplicates()
@@ -65,7 +79,20 @@ class SparseCooTensor:
                                self._shape)
 
     def coalesce(self):
-        return SparseCooTensor(self._bcoo.sum_duplicates(), self._shape)
+        """Sum duplicate coordinates; keeps the values' tape link (the
+        duplicate reduction is a recorded segment_sum, and the no-dup case
+        returns self unchanged)."""
+        idx = np.asarray(jax.device_get(self._bcoo.indices))
+        uniq, inv = np.unique(idx, axis=0, return_inverse=True)
+        if uniq.shape[0] == idx.shape[0]:
+            return self
+        from ..core.dispatch import apply
+        seg = jnp.asarray(inv.reshape(-1), jnp.int32)
+        n = uniq.shape[0]
+        vt = apply(lambda v: jax.ops.segment_sum(v, seg, num_segments=n),
+                   self._vt, name="sparse_coalesce")
+        return _make_coo(vt, jnp.asarray(uniq, self._bcoo.indices.dtype),
+                         self._shape)
 
     def __repr__(self):
         return (f"SparseCooTensor(shape={self._shape}, "
@@ -73,10 +100,12 @@ class SparseCooTensor:
 
 
 class SparseCsrTensor:
-    def __init__(self, crows, cols, values, shape):
+    def __init__(self, crows, cols, values, shape, _values_tensor=None):
         self.crows_arr = jnp.asarray(unwrap(crows), jnp.int32)
         self.cols_arr = jnp.asarray(unwrap(cols), jnp.int32)
         self.values_arr = jnp.asarray(unwrap(values))
+        self._vt = _values_tensor if _values_tensor is not None \
+            else Tensor(self.values_arr)
         self._shape = list(shape)
 
     @property
@@ -90,7 +119,7 @@ class SparseCsrTensor:
         return Tensor(self.cols_arr)
 
     def values(self):
-        return Tensor(self.values_arr)
+        return self._vt
 
     def nnz(self):
         return int(self.values_arr.shape[0])
@@ -110,7 +139,7 @@ class SparseCsrTensor:
                           total_repeat_length=self.nnz())
         idx = jnp.stack([rows, self.cols_arr], axis=1)
         bcoo = jsparse.BCOO((self.values_arr, idx), shape=tuple(self._shape))
-        return SparseCooTensor(bcoo)
+        return SparseCooTensor(bcoo, values_tensor=self._vt)
 
     def __repr__(self):
         return f"SparseCsrTensor(shape={self._shape}, nnz={self.nnz()})"
@@ -119,15 +148,23 @@ class SparseCsrTensor:
 def sparse_coo_tensor(indices, values, shape=None, dtype=None,
                       place=None, stop_gradient=True):
     idx = jnp.asarray(unwrap(indices), jnp.int32)
+    vt = values if isinstance(values, Tensor) else None
     vals = jnp.asarray(unwrap(values))
     if dtype is not None:
         from ..core import dtype as dtype_mod
-        vals = vals.astype(dtype_mod.convert_dtype(dtype))
+        target = dtype_mod.convert_dtype(dtype)
+        if vals.dtype != target:
+            if vt is not None:
+                from .. import ops
+                vt = ops.cast(vt, dtype)
+                vals = vt._data
+            else:
+                vals = vals.astype(target)
     if shape is None:
         shape = tuple(int(i) + 1 for i in idx.max(axis=1))
     bcoo = jsparse.BCOO((vals, jnp.swapaxes(idx, 0, 1)),
                         shape=tuple(shape))
-    return SparseCooTensor(bcoo, shape)
+    return SparseCooTensor(bcoo, shape, values_tensor=vt)
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
@@ -139,6 +176,12 @@ def _coo(x):
     if isinstance(x, SparseCsrTensor):
         return x.to_sparse_coo()
     return x
+
+
+def _make_coo(values_tensor, indices, shape):
+    """Build a SparseCooTensor whose values keep their tape link."""
+    bcoo = jsparse.BCOO((values_tensor._data, indices), shape=tuple(shape))
+    return SparseCooTensor(bcoo, list(shape), values_tensor=values_tensor)
 
 
 def to_dense(x):
@@ -159,32 +202,77 @@ def add(x, y):
 
 def matmul(x, y):
     """sparse @ dense (reference paddle.sparse.matmul)."""
+    from ..core.dispatch import apply
     x = _coo(x)
-    y_arr = unwrap(y)
     if isinstance(x, SparseCooTensor):
-        return Tensor(x._bcoo @ y_arr)
-    return Tensor(unwrap(x) @ y_arr)
+        idx, shape = x._bcoo.indices, tuple(x._shape)
+        yt = y if isinstance(y, Tensor) else Tensor(jnp.asarray(unwrap(y)))
+        return apply(
+            lambda v, ya: jsparse.BCOO((v, idx), shape=shape) @ ya,
+            x.values(), yt, name="sparse_matmul")
+    return Tensor(unwrap(x) @ unwrap(y))
 
 
 def mv(x, vec):
     return matmul(x, vec)
 
 
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    """beta * input + alpha * (x @ y) (reference
+    python/paddle/sparse/multiary.py:29). Two layouts, like the
+    reference: dense input + sparse x + dense y -> dense; all-sparse
+    (COO or CSR) -> sparse of the same format."""
+    from .. import ops
+    from ..core.dispatch import apply
+    if not isinstance(input, (SparseCooTensor, SparseCsrTensor)):
+        prod = matmul(x, y)
+        inp = input if isinstance(input, Tensor) else Tensor(
+            jnp.asarray(unwrap(input)))
+        return ops.add(ops.scale(inp, beta), ops.scale(prod, alpha))
+
+    want_csr = isinstance(input, SparseCsrTensor)
+    ic, xc, yc = _coo(input).coalesce(), _coo(x).coalesce(), \
+        _coo(y).coalesce()
+    i_idx, x_idx, y_idx = (t._bcoo.indices for t in (ic, xc, yc))
+    shape = tuple(ic._shape)
+    xshape, yshape = tuple(xc._shape), tuple(yc._shape)
+
+    def dense_out(iv, xv, yv):
+        di = jnp.zeros(shape, iv.dtype).at[tuple(i_idx.T)].add(iv)
+        dx = jnp.zeros(xshape, xv.dtype).at[tuple(x_idx.T)].add(xv)
+        dy = jnp.zeros(yshape, yv.dtype).at[tuple(y_idx.T)].add(yv)
+        return beta * di + alpha * (dx @ dy)
+
+    eager = np.asarray(jax.device_get(
+        dense_out(ic._vt._data, xc._vt._data, yc._vt._data)))
+    nz = np.argwhere(eager != 0)  # lexicographic = CSR row-major order
+    idx = jnp.asarray(nz, jnp.int32)
+    vt = apply(lambda iv, xv, yv: dense_out(iv, xv, yv)[tuple(idx.T)],
+               ic._vt, xc._vt, yc._vt, name="sparse_addmm")
+    if not want_csr:
+        return _make_coo(vt, idx, list(shape))
+    counts = np.zeros(shape[0] + 1, np.int64)
+    np.add.at(counts, nz[:, 0] + 1, 1)
+    return SparseCsrTensor(np.cumsum(counts).astype(np.int32),
+                           nz[:, 1].astype(np.int32), vt._data,
+                           list(shape), _values_tensor=vt)
+
+
 def masked_matmul(x, y, mask):
     """dense @ dense evaluated only at mask's sparsity pattern."""
-    out = unwrap(x) @ unwrap(y)
+    from ..core.dispatch import apply
     m = _coo(mask)
     idx = m._bcoo.indices
-    vals = out[idx[:, 0], idx[:, 1]]
-    return SparseCooTensor(jsparse.BCOO((vals, idx),
-                                        shape=tuple(m._shape)), m._shape)
+    xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(unwrap(x)))
+    yt = y if isinstance(y, Tensor) else Tensor(jnp.asarray(unwrap(y)))
+    vt = apply(lambda xa, ya: (xa @ ya)[idx[:, 0], idx[:, 1]], xt, yt,
+               name="sparse_masked_matmul")
+    return _make_coo(vt, idx, m._shape)
 
 
 def relu(x):
-    x = _coo(x)
-    return SparseCooTensor(
-        jsparse.BCOO((jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
-                     shape=tuple(x._shape)), x._shape)
+    from .nn import functional as _F
+    return _F.relu(x)
 
 
 def transpose(x, perm):
@@ -199,18 +287,6 @@ def is_same_shape(x, y):
     return list(x.shape) == list(y.shape)
 
 
-class _SparseNN:
-    @staticmethod
-    def ReLU():
-        class _R:
-            def __call__(self, x):
-                return relu(x)
-        return _R()
-
-
-nn = _SparseNN()
-
-
 # ---------------------------------------------------------------------------
 # elementwise value ops (reference python/paddle/sparse/unary.py /
 # binary.py: each applies to the stored values, preserving sparsity)
@@ -218,11 +294,11 @@ nn = _SparseNN()
 
 def _unary_valueop(fn, name):
     def op(x, *args, **kwargs):
+        from ..core.dispatch import apply
         c = _coo(x)
-        return SparseCooTensor(
-            jsparse.BCOO((fn(c._bcoo.data, *args, **kwargs),
-                          c._bcoo.indices), shape=tuple(c._shape)),
-            c._shape)
+        vt = apply(lambda v: fn(v, *args, **kwargs), c.values(),
+                   name=f"sparse_{name}")
+        return _make_coo(vt, c._bcoo.indices, c._shape)
     op.__name__ = name
     return op
 
@@ -309,12 +385,15 @@ def slice(x, axes, starts, ends):  # noqa: A001
 def mask_as(x, mask):
     """Keep x's dense values at mask's sparsity pattern (reference
     sparse/multiary.py mask_as)."""
+    from ..core.dispatch import apply
     m = _coo(mask).coalesce()
-    dense = unwrap(x) if not isinstance(x, SparseCooTensor) else \
-        x._bcoo.todense()
-    vals = dense[tuple(m._bcoo.indices.T)]
-    return SparseCooTensor(jsparse.BCOO((vals, m._bcoo.indices),
-                                        shape=tuple(m._shape)), m._shape)
+    idx = m._bcoo.indices
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        xd = to_dense(x)
+    else:
+        xd = x if isinstance(x, Tensor) else Tensor(jnp.asarray(unwrap(x)))
+    vt = apply(lambda d: d[tuple(idx.T)], xd, name="sparse_mask_as")
+    return _make_coo(vt, idx, m._shape)
 
 
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):
@@ -324,3 +403,6 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     dense = Tensor(_coo(x)._bcoo.todense()) \
         if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
     return _dense_pca(dense, q=q, center=center, niter=niter)
+
+
+from . import nn  # noqa: E402  (layer/functional subpackage)

@@ -1,0 +1,210 @@
+"""`paddle.sparse.nn` — layer wrappers over the sparse functionals.
+
+Reference surface: python/paddle/sparse/nn/__init__.py (ReLU, ReLU6,
+LeakyReLU, Softmax, BatchNorm, SyncBatchNorm, Conv2D, Conv3D, SubmConv2D,
+SubmConv3D, MaxPool3D) with layer definitions in sparse/nn/layer/.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn as dense_nn
+from . import functional  # noqa: F401
+from . import functional as F
+
+__all__ = [
+    "ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm", "SyncBatchNorm",
+    "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D", "MaxPool3D",
+]
+
+
+class ReLU(dense_nn.Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(dense_nn.Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(dense_nn.Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(dense_nn.Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class _Conv(dense_nn.Layer):
+    """Shared sparse-conv layer body (reference
+    python/paddle/sparse/nn/layer/conv.py:46)."""
+
+    def __init__(self, nd, in_channels, out_channels, kernel_size, stride,
+                 padding, dilation, groups, subm, key, padding_mode,
+                 weight_attr, bias_attr, data_format, backend):
+        super().__init__()
+        assert padding_mode == "zeros", padding_mode
+        assert backend in (None, "igemm"), backend
+        self._nd = nd
+        self._subm = subm
+        self._key = key
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = F._norm_tuple(kernel_size, nd, "kernel_size")
+        self.stride = F._norm_tuple(stride, nd, "stride")
+        self.padding = padding
+        self.dilation = F._norm_tuple(dilation, nd, "dilation")
+        self.groups = groups
+        self.data_format = data_format
+        fan_in = in_channels * int(np.prod(self.kernel_size))
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            shape=[*self.kernel_size, in_channels // groups, out_channels],
+            attr=weight_attr,
+            default_initializer=dense_nn.initializer.Uniform(-bound, bound))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                shape=[out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=dense_nn.initializer.Uniform(
+                    -bound, bound))
+
+    def forward(self, x):
+        fn = {
+            (2, False): F.conv2d, (2, True): F.subm_conv2d,
+            (3, False): F.conv3d, (3, True): F.subm_conv3d,
+        }[(self._nd, self._subm)]
+        kwargs = {} if not self._subm else {"key": self._key}
+        return fn(x, self.weight, self.bias, stride=self.stride,
+                  padding=self.padding, dilation=self.dilation,
+                  groups=self.groups, data_format=self.data_format,
+                  **kwargs)
+
+    def extra_repr(self):
+        s = (f"{self.in_channels}, {self.out_channels}, "
+             f"kernel_size={self.kernel_size}, stride={self.stride}")
+        if self._subm:
+            s += ", subm=True"
+        return s
+
+
+class Conv3D(_Conv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 backend=None):
+        super().__init__(3, in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, False, None,
+                         padding_mode, weight_attr, bias_attr, data_format,
+                         backend)
+
+
+class SubmConv3D(_Conv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, key=None,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format="NDHWC", backend=None):
+        super().__init__(3, in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, True, key, padding_mode,
+                         weight_attr, bias_attr, data_format, backend)
+
+
+class Conv2D(_Conv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC",
+                 backend=None):
+        super().__init__(2, in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, False, None,
+                         padding_mode, weight_attr, bias_attr, data_format,
+                         backend)
+
+
+class SubmConv2D(_Conv):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, key=None,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format="NHWC", backend=None):
+        super().__init__(2, in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, True, key, padding_mode,
+                         weight_attr, bias_attr, data_format, backend)
+
+
+class MaxPool3D(dense_nn.Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        assert not return_mask, "return_mask not supported"
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            self.ceil_mode, self.data_format)
+
+
+class BatchNorm(dense_nn.BatchNorm1D):
+    """BatchNorm over the active values of a SparseCooTensor (reference
+    python/paddle/sparse/nn/layer/norm.py:35 — subclasses the dense
+    BatchNorm1D and applies it to the (nnz, C) values)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum=momentum, epsilon=epsilon,
+                         weight_attr=weight_attr, bias_attr=bias_attr,
+                         data_format="NC",
+                         use_global_stats=use_global_stats, name=name)
+        self._sparse_data_format = data_format
+
+    def forward(self, x):
+        from .. import _make_coo, _coo
+        c = _coo(x)
+        vt = super().forward(c.values())
+        return _make_coo(vt, c._bcoo.indices, c.shape)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BatchNorm (reference sparse/nn/layer/norm.py
+    SyncBatchNorm). Under SPMD the batch statistics of the compiled step
+    are already global (GSPMD inserts the cross-replica reduction for the
+    mean/var reductions); eager single-process semantics equal BatchNorm.
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, BatchNorm) and not isinstance(
+                layer, SyncBatchNorm):
+            out = SyncBatchNorm(
+                layer._num_features, momentum=layer._momentum,
+                epsilon=layer._epsilon,
+                data_format=layer._sparse_data_format,
+                use_global_stats=layer._use_global_stats)
+            if layer.weight is not None:
+                out.weight.set_value(layer.weight)
+            if layer.bias is not None:
+                out.bias.set_value(layer.bias)
+            out._mean.set_value(layer._mean)
+            out._variance.set_value(layer._variance)
+        for name, sub in layer.named_children():
+            new = cls.convert_sync_batchnorm(sub)
+            if new is not sub:
+                setattr(out, name, new)
+        return out

@@ -1,0 +1,386 @@
+"""paddle.sparse.nn: conv / pooling / norm / softmax / attention.
+
+Reference test model: test/legacy_test/test_sparse_conv_op.py,
+test_sparse_pooling_op.py, test_sparse_norm_op.py,
+test_sparse_softmax_op.py, test_sparse_fused_attention_op.py — each
+checks the sparse op against a dense oracle on small shapes.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _random_sparse_voxels(rng, N=2, D=5, H=6, W=7, C=3, nnz=20):
+    dense = np.zeros((N, D, H, W, C), np.float32)
+    coords = set()
+    while len(coords) < nnz:
+        coords.add((int(rng.integers(N)), int(rng.integers(D)),
+                    int(rng.integers(H)), int(rng.integers(W))))
+    coords = sorted(coords)
+    for c in coords:
+        dense[c] = rng.standard_normal(C)
+    idx = np.array(coords).T
+    vals = np.array([dense[c] for c in coords], np.float32)
+    x = sparse.sparse_coo_tensor(idx, vals, shape=[N, D, H, W, C])
+    return x, dense, coords
+
+
+def test_subm_conv3d_matches_dense_oracle():
+    rng = np.random.default_rng(0)
+    x, dense, coords = _random_sparse_voxels(rng)
+    N, D, H, W, C = dense.shape
+    Cout = 4
+    conv = sparse.nn.SubmConv3D(C, Cout, 3, padding=1)
+    y = conv(x)
+    assert y.shape == [N, D, H, W, Cout]
+    w = conv.weight.numpy()
+    b = conv.bias.numpy()
+    active = set(coords)
+    out_ref = np.zeros((N, D, H, W, Cout), np.float32)
+    for (n, d, h, wd) in coords:
+        acc = b.copy()
+        for kd in range(3):
+            for kh in range(3):
+                for kw in range(3):
+                    s = (n, d + kd - 1, h + kh - 1, wd + kw - 1)
+                    if s in active:
+                        acc = acc + dense[s] @ w[kd, kh, kw]
+        out_ref[n, d, h, wd] = acc
+    np.testing.assert_allclose(y.to_dense().numpy(), out_ref, atol=1e-4)
+
+
+def test_conv3d_stride_matches_dense_oracle():
+    rng = np.random.default_rng(1)
+    x, dense, coords = _random_sparse_voxels(rng)
+    N, D, H, W, C = dense.shape
+    Cout = 2
+    conv = sparse.nn.Conv3D(C, Cout, 3, stride=2, padding=1, bias_attr=False)
+    y = conv(x)
+    w = conv.weight.numpy()
+    # dense conv oracle, then keep only sites with >=1 active contributor
+    Do, Ho, Wo = (D + 1) // 2, (H + 1) // 2, (W + 1) // 2
+    out_ref = np.zeros((N, Do, Ho, Wo, Cout), np.float32)
+    hit = np.zeros((N, Do, Ho, Wo), bool)
+    active = set(coords)
+    for n in range(N):
+        for od in range(Do):
+            for oh in range(Ho):
+                for ow in range(Wo):
+                    acc = np.zeros(Cout, np.float32)
+                    any_hit = False
+                    for kd in range(3):
+                        for kh in range(3):
+                            for kw in range(3):
+                                sd = od * 2 - 1 + kd
+                                sh = oh * 2 - 1 + kh
+                                sw = ow * 2 - 1 + kw
+                                if (n, sd, sh, sw) in active:
+                                    any_hit = True
+                                    acc += dense[n, sd, sh, sw] @ w[kd, kh, kw]
+                    out_ref[n, od, oh, ow] = acc
+                    hit[n, od, oh, ow] = any_hit
+    assert y.shape == [N, Do, Ho, Wo, Cout]
+    assert y.nnz() == int(hit.sum())
+    np.testing.assert_allclose(y.to_dense().numpy(), out_ref, atol=1e-4)
+
+
+def test_subm_conv2d_shape_and_pattern():
+    rng = np.random.default_rng(2)
+    idx = np.array([[0, 0, 0, 1], [0, 1, 3, 2], [1, 2, 0, 3]])
+    vals = rng.standard_normal((4, 3)).astype(np.float32)
+    x = sparse.sparse_coo_tensor(idx, vals, shape=[2, 4, 5, 3])
+    conv = sparse.nn.SubmConv2D(3, 6, 3, padding=1)
+    y = conv(x)
+    assert y.shape == [2, 4, 5, 6]
+    assert y.nnz() == 4
+    np.testing.assert_array_equal(
+        np.sort(y.indices().numpy(), axis=1),
+        np.sort(idx, axis=1))
+
+
+def test_igemm_aliases_match():
+    rng = np.random.default_rng(3)
+    x, dense, coords = _random_sparse_voxels(rng, nnz=10)
+    conv = sparse.nn.SubmConv3D(3, 2, 3, padding=1)
+    y1 = sparse.nn.functional.subm_conv3d(x, conv.weight, conv.bias,
+                                          padding=1)
+    y2 = sparse.nn.functional.subm_conv3d_igemm(x, conv.weight, conv.bias,
+                                                padding=1)
+    np.testing.assert_allclose(y1.values().numpy(), y2.values().numpy())
+
+
+def test_sparse_conv_grad_chain():
+    """Weight grads flow through conv -> relu -> conv -> values loss."""
+    rng = np.random.default_rng(4)
+    x, _, _ = _random_sparse_voxels(rng, nnz=12)
+    c1 = sparse.nn.SubmConv3D(3, 4, 3, padding=1)
+    c2 = sparse.nn.SubmConv3D(4, 2, 3, padding=1)
+    z = c2(sparse.nn.functional.relu(c1(x)))
+    loss = paddle.sum(z.values())
+    loss.backward()
+    for p in (c1.weight, c1.bias, c2.weight, c2.bias):
+        assert p.grad is not None
+    assert float(np.abs(c1.weight.grad.numpy()).max()) > 0
+
+
+def test_sparse_conv_weight_grad_matches_fd():
+    """Finite-difference check on one weight element."""
+    rng = np.random.default_rng(5)
+    x, _, _ = _random_sparse_voxels(rng, N=1, D=4, H=4, W=4, C=2, nnz=8)
+    conv = sparse.nn.SubmConv3D(2, 3, 3, padding=1, bias_attr=False)
+
+    def loss_for(w):
+        y = sparse.nn.functional.subm_conv3d(x, w, None, padding=1)
+        return float((y.values() * y.values()).sum().numpy())
+
+    y = conv(x)
+    loss = (y.values() * y.values()).sum()
+    loss.backward()
+    g = conv.weight.grad.numpy()
+    eps = 1e-3
+    w0 = conv.weight.numpy()
+    for (i, j, k, a, b) in [(1, 1, 1, 0, 0), (0, 2, 1, 1, 2)]:
+        wp = w0.copy()
+        wp[i, j, k, a, b] += eps
+        wm = w0.copy()
+        wm[i, j, k, a, b] -= eps
+        fd = (loss_for(paddle.to_tensor(wp)) -
+              loss_for(paddle.to_tensor(wm))) / (2 * eps)
+        np.testing.assert_allclose(g[i, j, k, a, b], fd, rtol=2e-2)
+
+
+def test_max_pool3d():
+    rng = np.random.default_rng(6)
+    x, dense, coords = _random_sparse_voxels(rng, D=4, H=4, W=4, nnz=16)
+    y = sparse.nn.MaxPool3D(2, 2)(x)
+    assert y.shape == [2, 2, 2, 2, 3]
+    yd = y.to_dense().numpy()
+    active = set(coords)
+    for n in range(2):
+        for od in range(2):
+            for oh in range(2):
+                for ow in range(2):
+                    vals = [dense[n, od * 2 + a, oh * 2 + b, ow * 2 + c]
+                            for a in range(2) for b in range(2)
+                            for c in range(2)
+                            if (n, od * 2 + a, oh * 2 + b, ow * 2 + c)
+                            in active]
+                    if vals:
+                        np.testing.assert_allclose(
+                            yd[n, od, oh, ow], np.max(vals, axis=0),
+                            atol=1e-6)
+
+
+def test_sparse_batchnorm_normalizes_values():
+    rng = np.random.default_rng(7)
+    x, _, _ = _random_sparse_voxels(rng)
+    bn = sparse.nn.BatchNorm(3)
+    bn.train()
+    y = bn(x)
+    v = y.values().numpy()
+    np.testing.assert_allclose(v.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(v.std(axis=0), 1.0, atol=1e-2)
+
+
+def test_sparse_sync_batchnorm_convert():
+    net = paddle.nn.Sequential()
+    layer = sparse.nn.BatchNorm(4)
+    out = sparse.nn.SyncBatchNorm.convert_sync_batchnorm(layer)
+    assert isinstance(out, sparse.nn.SyncBatchNorm)
+
+
+def test_sparse_activations():
+    idx = np.array([[0, 0, 1], [0, 1, 1]])
+    vals = np.array([-2.0, 7.0, 3.0], np.float32)
+    x = sparse.sparse_coo_tensor(idx, vals, shape=[2, 2])
+    np.testing.assert_allclose(
+        sparse.nn.functional.relu(x).values().numpy(), [0.0, 7.0, 3.0])
+    np.testing.assert_allclose(
+        sparse.nn.functional.relu6(x).values().numpy(), [0.0, 6.0, 3.0])
+    np.testing.assert_allclose(
+        sparse.nn.functional.leaky_relu(x, 0.1).values().numpy(),
+        [-0.2, 7.0, 3.0])
+    np.testing.assert_allclose(
+        sparse.nn.LeakyReLU(0.1)(x).values().numpy(), [-0.2, 7.0, 3.0])
+
+
+def test_sparse_softmax_csr_and_coo():
+    crows = np.array([0, 2, 3, 3], np.int32)
+    cols = np.array([0, 2, 1], np.int32)
+    vals = np.array([1.0, 2.0, 0.5], np.float32)
+    m = sparse.sparse_csr_tensor(crows, cols, vals, [3, 3])
+    sv = sparse.nn.functional.softmax(m).values().numpy()
+    e = np.exp([1.0 - 2.0, 0.0])
+    np.testing.assert_allclose(sv[:2], e / e.sum(), rtol=1e-6)
+    np.testing.assert_allclose(sv[2], 1.0)
+
+    idx = np.array([[0, 0, 1], [0, 1, 0]])
+    coo = sparse.sparse_coo_tensor(idx, np.array([1.0, 2.0, 5.0],
+                                                 np.float32), shape=[2, 2])
+    sv2 = sparse.nn.Softmax()(coo).values().numpy()
+    np.testing.assert_allclose(sv2[:2], e / e.sum(), rtol=1e-6)
+    np.testing.assert_allclose(sv2[2], 1.0)
+
+
+def test_sparse_attention_full_mask_equals_dense():
+    rng = np.random.default_rng(8)
+    b, h, s, d = 2, 2, 4, 8
+    q, k, v = (paddle.to_tensor(
+        rng.standard_normal((b, h, s, d)).astype(np.float32))
+        for _ in range(3))
+    bh = b * h
+    crows = np.concatenate(
+        [np.arange(0, s * s + 1, s) for _ in range(bh)])
+    cols = np.tile(np.arange(s), bh * s)
+    mask = sparse.sparse_csr_tensor(
+        crows, cols, np.ones(bh * s * s, np.float32), [bh, s, s])
+    out = sparse.nn.functional.attention(q, k, v, mask).numpy()
+    qa, ka, va = q.numpy(), k.numpy(), v.numpy()
+    sc = np.einsum("bhqd,bhkd->bhqk", qa, ka) / np.sqrt(d)
+    p = np.exp(sc - sc.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, va)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_sparse_attention_banded_mask_and_grads():
+    rng = np.random.default_rng(9)
+    b, h, s, d = 1, 2, 6, 4
+    q, k, v = (paddle.to_tensor(
+        rng.standard_normal((b, h, s, d)).astype(np.float32),
+        stop_gradient=False) for _ in range(3))
+    # causal banded mask (width 2), same nnz per batch
+    rows_cols = [(r, c) for r in range(s) for c in range(max(0, r - 1), r + 1)]
+    bh = b * h
+    crows_one = np.zeros(s + 1, np.int64)
+    for r, _ in rows_cols:
+        crows_one[r + 1] += 1
+    crows_one = np.cumsum(crows_one)
+    cols_one = np.array([c for _, c in rows_cols])
+    crows = np.concatenate([crows_one for _ in range(bh)])
+    cols = np.tile(cols_one, bh)
+    mask = sparse.sparse_csr_tensor(
+        crows, cols, np.ones(bh * len(rows_cols), np.float32), [bh, s, s])
+    out = sparse.nn.functional.attention(q, k, v, mask)
+    # oracle: dense with -inf outside the band
+    qa, ka, va = q.numpy(), k.numpy(), v.numpy()
+    sc = np.einsum("bhqd,bhkd->bhqk", qa, ka) / np.sqrt(d)
+    m = np.full((s, s), -np.inf)
+    for r, c in rows_cols:
+        m[r, c] = 0.0
+    sc = sc + m
+    p = np.exp(sc - sc.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, va)
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+    paddle.sum(out * out).backward()
+    for t in (q, k, v):
+        assert t.grad is not None
+        assert float(np.abs(t.grad.numpy()).max()) > 0
+
+
+def test_coo_softmax_keeps_grad_chain():
+    """Regression: coalesce() inside softmax must not sever the tape."""
+    rng = np.random.default_rng(10)
+    idx = np.array([[0, 0, 1, 1], [0, 1, 0, 1]])
+    x = paddle.to_tensor(rng.standard_normal(4).astype(np.float32),
+                         stop_gradient=False)
+    coo = sparse.sparse_coo_tensor(idx, x, shape=[2, 2])
+    y = sparse.nn.functional.softmax(coo)
+    paddle.sum(y.values() * y.values()).backward()
+    assert x.grad is not None
+    assert float(np.abs(x.grad.numpy()).max()) > 0
+
+
+def test_addmm_all_sparse():
+    """Reference layout: sparse input + sparse x + sparse y -> sparse."""
+    a = sparse.sparse_coo_tensor(np.array([[0, 1], [0, 1]]),
+                                 np.array([1.0, 1.0], np.float32),
+                                 shape=[2, 2])
+    xs = sparse.sparse_coo_tensor(np.array([[0, 1], [1, 0]]),
+                                  np.array([2.0, 3.0], np.float32),
+                                  shape=[2, 2])
+    ys = sparse.sparse_coo_tensor(np.array([[0, 1], [0, 1]]),
+                                  np.array([4.0, 5.0], np.float32),
+                                  shape=[2, 2])
+    out = sparse.addmm(a, xs, ys, beta=2.0, alpha=1.0)
+    assert isinstance(out, sparse.SparseCooTensor)
+    ref = 2.0 * np.array([[1, 0], [0, 1.0]]) + \
+        np.array([[0, 2.0], [3.0, 0]]) @ np.array([[4.0, 0], [0, 5.0]])
+    np.testing.assert_allclose(out.to_dense().numpy(), ref)
+    # CSR in -> CSR out
+    ac = sparse.sparse_csr_tensor(np.array([0, 1, 2]), np.array([0, 1]),
+                                  np.array([1.0, 1.0], np.float32), [2, 2])
+    xc = sparse.sparse_csr_tensor(np.array([0, 1, 2]), np.array([1, 0]),
+                                  np.array([2.0, 3.0], np.float32), [2, 2])
+    yc = sparse.sparse_csr_tensor(np.array([0, 1, 2]), np.array([0, 1]),
+                                  np.array([4.0, 5.0], np.float32), [2, 2])
+    outc = sparse.addmm(ac, xc, yc, beta=2.0, alpha=1.0)
+    assert isinstance(outc, sparse.SparseCsrTensor)
+    np.testing.assert_allclose(outc.to_dense().numpy(), ref)
+
+
+def test_rulebook_cache_reused():
+    from paddle_tpu.sparse.nn import functional as F
+    F._RULEBOOK_CACHE.clear()
+    rng = np.random.default_rng(11)
+    x, _, _ = _random_sparse_voxels(rng, nnz=10)
+    conv = sparse.nn.SubmConv3D(3, 2, 3, padding=1)
+    conv(x)
+    assert len(F._RULEBOOK_CACHE) == 1
+    conv(x)  # same coords + geometry -> cache hit, no new entry
+    assert len(F._RULEBOOK_CACHE) == 1
+    conv2 = sparse.nn.SubmConv3D(3, 2, 3, padding=1, dilation=2)
+    conv2(x)  # different geometry -> new entry
+    assert len(F._RULEBOOK_CACHE) == 2
+
+
+def test_coalesce_sums_duplicates_with_grad():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 4.0], np.float32),
+                         stop_gradient=False)
+    coo = sparse.sparse_coo_tensor(np.array([[0, 0, 1], [1, 1, 0]]), x,
+                                   shape=[2, 2])
+    c = coo.coalesce()
+    assert c.nnz() == 2
+    np.testing.assert_allclose(sorted(c.values().numpy().tolist()),
+                               [3.0, 4.0])
+    paddle.sum(c.values() * c.values()).backward()
+    assert x.grad is not None
+
+
+def test_addmm_and_tape_to_dense():
+    xs = sparse.sparse_coo_tensor(np.array([[0, 1], [1, 0]]),
+                                  np.array([2.0, 3.0], np.float32),
+                                  shape=[2, 2])
+    y = sparse.addmm(paddle.ones([2, 2]), xs, paddle.ones([2, 2]),
+                     beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(y.numpy(),
+                               0.5 + 2.0 * np.array([[2.0, 2.0],
+                                                     [3.0, 3.0]]))
+
+
+def test_sparse_namespace_parity():
+    """Every name the reference exports under paddle.sparse(.nn) exists."""
+    ref_top = ['sparse_coo_tensor', 'sparse_csr_tensor', 'sin', 'tan',
+               'asin', 'atan', 'sinh', 'tanh', 'asinh', 'atanh', 'sqrt',
+               'square', 'log1p', 'abs', 'pow', 'pca_lowrank', 'cast',
+               'neg', 'deg2rad', 'rad2deg', 'expm1', 'mv', 'matmul',
+               'mask_as', 'masked_matmul', 'addmm', 'add', 'subtract',
+               'transpose', 'sum', 'multiply', 'divide', 'coalesce',
+               'is_same_shape', 'reshape', 'isnan', 'slice']
+    for n in ref_top:
+        assert hasattr(sparse, n), f"paddle.sparse.{n} missing"
+    ref_nn = ['ReLU', 'ReLU6', 'LeakyReLU', 'Softmax', 'BatchNorm',
+              'SyncBatchNorm', 'Conv2D', 'Conv3D', 'SubmConv2D',
+              'SubmConv3D', 'MaxPool3D']
+    for n in ref_nn:
+        assert hasattr(sparse.nn, n), f"paddle.sparse.nn.{n} missing"
+    ref_fn = ['conv2d', 'conv3d', 'subm_conv2d', 'subm_conv2d_igemm',
+              'subm_conv3d', 'subm_conv3d_igemm', 'max_pool3d', 'relu',
+              'relu6', 'leaky_relu', 'softmax', 'attention']
+    for n in ref_fn:
+        assert hasattr(sparse.nn.functional, n), \
+            f"paddle.sparse.nn.functional.{n} missing"
