@@ -43,3 +43,4 @@ from .compat import (  # noqa: F401,E402
 )
 from . import launch  # noqa: F401,E402
 from . import io  # noqa: F401,E402
+from . import rpc  # noqa: F401,E402

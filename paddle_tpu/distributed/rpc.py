@@ -1,0 +1,278 @@
+"""`paddle.distributed.rpc` — worker-to-worker remote function calls.
+
+Reference surface: python/paddle/distributed/rpc/rpc.py (init_rpc:73,
+rpc_sync:143, rpc_async:183, shutdown:276, get_worker_info:307,
+get_all_worker_infos:337, get_current_worker_info:364), which runs on a
+C++ brpc RpcAgent.
+
+TPU-native redesign: TPU pods have no brpc; the control plane is plain
+TCP. Each worker runs a small threaded socket server; calls are
+length-prefixed pickle frames (fn, args, kwargs) executed in a worker
+thread pool; rendezvous and the never-timeout barrier ride the native
+TCPStore (csrc/tcp_store.cc), the same store the collective bootstrap
+uses. Semantics match the reference: named workers, sync/async calls
+returning pickled results, exceptions re-raised at the caller, global
+barrier in init_rpc and shutdown.
+
+Only use in a trusted network: like the reference, the wire format is
+pickle (reference rpc.py carries the same warning).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+_DEFAULT_RPC_TIMEOUT = 180.0
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _Agent:
+    """Per-process RPC agent: a listening socket + executor threads."""
+
+    def __init__(self, name, rank, world_size, store):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self.workers = {}          # name -> WorkerInfo
+        # separate pools: blocked outgoing calls must never starve the
+        # server side (peers issuing 8+ mutual rpc_async would deadlock
+        # on a shared pool)
+        self._pool = ThreadPoolExecutor(max_workers=8,
+                                        thread_name_prefix="rpc-serve")
+        self._client_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="rpc-call")
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("0.0.0.0", 0))
+        self._server.listen(128)
+        self.port = self._server.getsockname()[1]
+        self.ip = _local_ip()
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="rpc-accept", daemon=True)
+        self._accept_thread.start()
+
+    # -- wire helpers -------------------------------------------------
+    @staticmethod
+    def _send_frame(sock, obj):
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        sock.sendall(struct.pack("!Q", len(payload)) + payload)
+
+    @staticmethod
+    def _recv_frame(sock):
+        hdr = _recv_exact(sock, 8)
+        (n,) = struct.unpack("!Q", hdr)
+        return pickle.loads(_recv_exact(sock, n))
+
+    # -- server side --------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            self._pool.submit(self._serve_one, conn)
+
+    def _serve_one(self, conn):
+        try:
+            req = self._recv_frame(conn)
+            if req.get("op") == "ping":
+                self._send_frame(conn, {"ok": True})
+                return
+            fn = req["fn"]
+            try:
+                result = fn(*req.get("args", ()), **req.get("kwargs", {}))
+                self._send_frame(conn, {"ok": True, "result": result})
+            except BaseException as e:  # noqa: BLE001 — re-raised remotely
+                try:
+                    self._send_frame(conn, {"ok": False, "error": e})
+                except Exception:
+                    # unpicklable exception (or result mid-failure):
+                    # degrade to a picklable summary instead of slamming
+                    # the connection shut (caller would see bare EOFError)
+                    import traceback
+                    self._send_frame(conn, {
+                        "ok": False,
+                        "error": RuntimeError(
+                            "remote raised unpicklable exception:\n" +
+                            "".join(traceback.format_exception(e)))})
+        except (OSError, EOFError):
+            pass
+        finally:
+            conn.close()
+
+    # -- client side --------------------------------------------------
+    def call(self, to, fn, args, kwargs, timeout):
+        info = self.workers.get(to)
+        if info is None:
+            raise ValueError(f"unknown rpc worker {to!r}; known: "
+                             f"{sorted(self.workers)}")
+        with socket.create_connection((info.ip, info.port),
+                                      timeout=timeout or None) as sock:
+            if timeout and timeout > 0:
+                sock.settimeout(timeout)
+            self._send_frame(sock, {"fn": fn, "args": tuple(args or ()),
+                                    "kwargs": dict(kwargs or {})})
+            resp = self._recv_frame(sock)
+        if resp["ok"]:
+            return resp.get("result")
+        raise resp["error"]
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+        self._client_pool.shutdown(wait=False)
+
+
+_agent: _Agent | None = None
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError("rpc peer closed connection")
+        buf += chunk
+    return buf
+
+
+def _local_ip():
+    host = os.environ.get("POD_IP")
+    if host:
+        return host
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def _barrier(store, rank, world_size, phase):
+    """Never-timeout barrier over the TCPStore (reference
+    rpc.py:_barrier_never_timeout — store add + poll)."""
+    key = f"rpc/barrier/{phase}"
+    store.add(key, 1)
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        if int(store.add(key, 0)) >= world_size:
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"rpc barrier {phase} timed out")
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this process's RPC agent and rendezvous with the others
+    (reference rpc.py:73). rank / world_size / master_endpoint default
+    from PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER."""
+    global _agent
+    if _agent is not None:
+        raise RuntimeError("init_rpc already called")
+    from .store import TCPStore
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) \
+        if rank is None else int(rank)
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else int(world_size)
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:8711")
+    host, port = master_endpoint.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    agent = _Agent(name, rank, world_size, store)
+    store.set(f"rpc/worker/{rank}",
+              pickle.dumps((name, rank, agent.ip, agent.port)))
+    store.wait([f"rpc/worker/{r}" for r in range(world_size)])
+    for r in range(world_size):
+        wname, wrank, ip, wport = pickle.loads(
+            store.get(f"rpc/worker/{r}"))
+        agent.workers[wname] = WorkerInfo(wname, wrank, ip, wport)
+    if len(agent.workers) != world_size:
+        raise RuntimeError("duplicate rpc worker names")
+    _agent = agent
+    _barrier(store, rank, world_size, "init")
+
+
+class _Future:
+    """Async call handle (reference returns a C++ FutureWrapper with
+    .wait())."""
+
+    def __init__(self, fut):
+        self._fut = fut
+
+    def wait(self, timeout=None):
+        return self._fut.result(timeout=timeout)
+
+    def done(self):
+        return self._fut.done()
+
+
+def _require_agent():
+    if _agent is None:
+        raise RuntimeError("rpc is not initialized; call init_rpc first")
+    return _agent
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Blocking remote call of ``fn`` on worker ``to`` (reference
+    rpc.py:143)."""
+    return _require_agent().call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Non-blocking remote call; returns a future with .wait()
+    (reference rpc.py:183)."""
+    agent = _require_agent()
+    return _Future(agent._client_pool.submit(
+        agent.call, to, fn, args, kwargs, timeout))
+
+
+def shutdown():
+    """Barrier with all workers, then stop the agent (reference
+    rpc.py:276)."""
+    global _agent
+    if _agent is None:
+        return
+    _barrier(_agent.store, _agent.rank, _agent.world_size, "shutdown")
+    _agent.close()
+    _agent = None
+
+
+def get_worker_info(name):
+    """WorkerInfo by name (reference rpc.py:307)."""
+    return _require_agent().workers[name]
+
+
+def get_all_worker_infos():
+    """All WorkerInfos, rank order (reference rpc.py:337)."""
+    return sorted(_require_agent().workers.values(),
+                  key=lambda w: w.rank)
+
+
+def get_current_worker_info():
+    """This process's WorkerInfo (reference rpc.py:364)."""
+    agent = _require_agent()
+    return agent.workers[agent.name]

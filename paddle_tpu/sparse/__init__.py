@@ -125,20 +125,43 @@ class SparseCsrTensor:
         return int(self.values_arr.shape[0])
 
     def to_dense(self):
-        n_rows = self._shape[0]
-        counts = self.crows_arr[1:] - self.crows_arr[:-1]
-        rows = jnp.repeat(jnp.arange(n_rows), counts,
-                          total_repeat_length=self.nnz())
-        dense = jnp.zeros(self._shape, self.values_arr.dtype)
-        return Tensor(dense.at[rows, self.cols_arr].add(self.values_arr))
+        return self.to_sparse_coo().to_dense()
 
     def to_sparse_coo(self, sparse_dim=2):
-        n_rows = self._shape[0]
-        counts = self.crows_arr[1:] - self.crows_arr[:-1]
-        rows = jnp.repeat(jnp.arange(n_rows), counts,
-                          total_repeat_length=self.nnz())
-        idx = jnp.stack([rows, self.cols_arr], axis=1)
-        bcoo = jsparse.BCOO((self.values_arr, idx), shape=tuple(self._shape))
+        shape = tuple(self._shape)
+        crows = np.asarray(jax.device_get(self.crows_arr)).reshape(-1)
+        n_rows = shape[-2]
+        if len(shape) == 2:
+            counts = crows[1:] - crows[:-1]
+            rows = np.repeat(np.arange(n_rows), counts)
+            idx = np.stack([rows, np.asarray(
+                jax.device_get(self.cols_arr))], axis=1)
+        else:
+            # batched CSR: crows is nbatch blocks of (rows+1) pointers
+            nbatch = int(np.prod(shape[:-2]))
+            if crows.shape[0] != nbatch * (n_rows + 1):
+                raise ValueError(
+                    f"batched CSR crows must have {nbatch}*({n_rows}+1) "
+                    f"entries, got {crows.shape[0]}")
+            rows_l, batch_l = [], []
+            for b in range(nbatch):
+                seg = crows[b * (n_rows + 1):(b + 1) * (n_rows + 1)]
+                cnt = seg[1:] - seg[:-1]
+                rows_l.append(np.repeat(np.arange(n_rows), cnt))
+                batch_l.append(np.full(int(seg[-1] - seg[0]), b, np.int64))
+            rows = np.concatenate(rows_l)
+            batches = np.concatenate(batch_l)
+            bcols = []
+            rem = batches.copy()
+            for dim in reversed(shape[:-2]):
+                bcols.append(rem % dim)
+                rem //= dim
+            idx = np.stack([*reversed(bcols), rows, np.asarray(
+                jax.device_get(self.cols_arr)).reshape(-1)], axis=1)
+        if idx.shape[0] != self.nnz():
+            raise ValueError("CSR crows/cols disagree on nnz")
+        bcoo = jsparse.BCOO((self.values_arr,
+                             jnp.asarray(idx, jnp.int32)), shape=shape)
         return SparseCooTensor(bcoo, values_tensor=self._vt)
 
     def __repr__(self):
@@ -169,7 +192,8 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
-    return SparseCsrTensor(crows, cols, values, shape)
+    vt = values if isinstance(values, Tensor) else None
+    return SparseCsrTensor(crows, cols, values, shape, _values_tensor=vt)
 
 
 def _coo(x):
@@ -233,28 +257,71 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
     want_csr = isinstance(input, SparseCsrTensor)
     ic, xc, yc = _coo(input).coalesce(), _coo(x).coalesce(), \
         _coo(y).coalesce()
-    i_idx, x_idx, y_idx = (t._bcoo.indices for t in (ic, xc, yc))
     shape = tuple(ic._shape)
-    xshape, yshape = tuple(xc._shape), tuple(yc._shape)
+    ndb = len(shape) - 2  # leading batch dims (2D or batched 3D)
+    i_idx = np.asarray(jax.device_get(ic._bcoo.indices))
+    x_idx = np.asarray(jax.device_get(xc._bcoo.indices))
+    y_idx = np.asarray(jax.device_get(yc._bcoo.indices))
 
-    def dense_out(iv, xv, yv):
-        di = jnp.zeros(shape, iv.dtype).at[tuple(i_idx.T)].add(iv)
-        dx = jnp.zeros(xshape, xv.dtype).at[tuple(x_idx.T)].add(xv)
-        dy = jnp.zeros(yshape, yv.dtype).at[tuple(y_idx.T)].add(yv)
-        return beta * di + alpha * (dx @ dy)
+    # structural sparse-sparse matmul: join x's contraction column with
+    # y's row, per batch — O(pairs), never densified. (The reference's
+    # addmm_coo_coo kernel is the cuSPARSE SpGEMM analogue.)
+    def lin(a, cols):
+        out = np.zeros(a.shape[0], np.int64)
+        for c in range(cols.shape[0]):
+            out = out * cols[c] + a[:, c]
+        return out
 
-    eager = np.asarray(jax.device_get(
-        dense_out(ic._vt._data, xc._vt._data, yc._vt._data)))
-    nz = np.argwhere(eager != 0)  # lexicographic = CSR row-major order
-    idx = jnp.asarray(nz, jnp.int32)
-    vt = apply(lambda iv, xv, yv: dense_out(iv, xv, yv)[tuple(idx.T)],
-               ic._vt, xc._vt, yc._vt, name="sparse_addmm")
+    dims_k = np.array([*shape[:ndb], xc._shape[-1]], np.int64)
+    xk = lin(np.concatenate([x_idx[:, :ndb], x_idx[:, -1:]], axis=1),
+             dims_k)
+    yk = lin(np.concatenate([y_idx[:, :ndb], y_idx[:, -2:-1]], axis=1),
+             dims_k)
+    order_y = np.argsort(yk, kind="stable")
+    yk_sorted = yk[order_y]
+    lo = np.searchsorted(yk_sorted, xk, side="left")
+    hi = np.searchsorted(yk_sorted, xk, side="right")
+    reps = (hi - lo).astype(np.int64)
+    xi = np.repeat(np.arange(x_idx.shape[0]), reps)
+    within = np.arange(reps.sum()) - np.repeat(np.cumsum(reps) - reps,
+                                               reps)
+    yi = order_y[np.repeat(lo, reps) + within]
+    prod_coords = np.concatenate(
+        [x_idx[xi, :ndb], x_idx[xi, -2:-1], y_idx[yi, -1:]], axis=1)
+
+    # output pattern = union of input's pattern and the product pattern
+    dims_out = np.array(shape, np.int64)
+    lin_prod = lin(prod_coords, dims_out)
+    lin_in = lin(i_idx, dims_out)
+    uniq = np.unique(np.concatenate([lin_prod, lin_in]))
+    seg_prod = jnp.asarray(np.searchsorted(uniq, lin_prod), jnp.int32)
+    seg_in = jnp.asarray(np.searchsorted(uniq, lin_in), jnp.int32)
+    n_out = uniq.shape[0]
+    out_coords = np.empty((n_out, len(shape)), np.int64)
+    rem = uniq.copy()
+    for c in range(len(shape) - 1, -1, -1):
+        out_coords[:, c] = rem % dims_out[c]
+        rem //= dims_out[c]
+    xi_j, yi_j = jnp.asarray(xi, jnp.int32), jnp.asarray(yi, jnp.int32)
+
+    def fwd(iv, xv, yv):
+        contrib = jnp.take(xv, xi_j) * jnp.take(yv, yi_j)
+        out = alpha * jax.ops.segment_sum(contrib, seg_prod,
+                                          num_segments=n_out)
+        return out.astype(iv.dtype).at[seg_in].add(beta * iv)
+
+    vt = apply(fwd, ic._vt, xc._vt, yc._vt, name="sparse_addmm")
+    idx = jnp.asarray(out_coords, jnp.int32)
     if not want_csr:
         return _make_coo(vt, idx, list(shape))
-    counts = np.zeros(shape[0] + 1, np.int64)
-    np.add.at(counts, nz[:, 0] + 1, 1)
-    return SparseCsrTensor(np.cumsum(counts).astype(np.int32),
-                           nz[:, 1].astype(np.int32), vt._data,
+    nbatch = int(np.prod(shape[:ndb], dtype=np.int64)) if ndb else 1
+    counts = np.zeros(nbatch * (shape[-2] + 1), np.int64)
+    brow = (lin(out_coords[:, :ndb], dims_out[:ndb]) if ndb
+            else np.zeros(n_out, np.int64))
+    np.add.at(counts, brow * (shape[-2] + 1) + out_coords[:, -2] + 1, 1)
+    crows = counts.reshape(nbatch, shape[-2] + 1).cumsum(axis=1).reshape(-1)
+    return SparseCsrTensor(crows.astype(np.int32),
+                           out_coords[:, -1].astype(np.int32), vt._data,
                            list(shape), _values_tensor=vt)
 
 

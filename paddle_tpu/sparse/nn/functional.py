@@ -359,9 +359,12 @@ def leaky_relu(x, negative_slope=0.01, name=None):
 
 
 def _segment_softmax(vals, seg_ids, n_seg):
-    """Numerically-stable softmax within each segment; empty segments
-    contribute nothing and zero denominators are guarded."""
+    """Numerically-stable softmax within each segment. Guards both empty
+    segments and all--inf segments (a fully key-padded attention row):
+    a non-finite segment max is replaced by 0 so exp(-inf - 0) = 0, and
+    zero denominators yield 0, not NaN."""
     m = jax.ops.segment_max(vals, seg_ids, num_segments=n_seg)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
     p = jnp.exp(vals - m[seg_ids])
     denom = jax.ops.segment_sum(p, seg_ids, num_segments=n_seg)
     return p / jnp.where(denom == 0, 1.0, denom)[seg_ids]
@@ -396,13 +399,9 @@ def softmax(x, axis=-1, name=None):
     c = x.coalesce() if isinstance(x, SparseCooTensor) else x
     idx = np.asarray(jax.device_get(c._bcoo.indices))
     # group by all coords except the last sparse dim
-    keys = [tuple(int(v) for v in idx[i, :-1]) for i in range(idx.shape[0])]
-    uniq = {}
-    rows = np.empty(idx.shape[0], np.int64)
-    for i, k in enumerate(keys):
-        rows[i] = uniq.setdefault(k, len(uniq))
-    seg_ids = jnp.asarray(rows, jnp.int32)
-    n_seg = len(uniq)
+    uniq, rows = np.unique(idx[:, :-1], axis=0, return_inverse=True)
+    seg_ids = jnp.asarray(rows.reshape(-1), jnp.int32)
+    n_seg = uniq.shape[0]
     vt = apply(lambda v: _segment_softmax(v, seg_ids, n_seg),
                c.values(), name="sparse_softmax")
     return _make_coo(vt, c._bcoo.indices, c.shape)

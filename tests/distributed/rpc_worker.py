@@ -1,0 +1,74 @@
+"""RPC E2E worker: 2 OS processes exchange remote calls.
+
+Run by test_rpc.py with PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_MASTER set per rank (the reference's rpc tests do the same:
+test/rpc/test_rpc_base.py).
+"""
+
+import os
+import sys
+
+
+def add(a, b):
+    return a + b
+
+
+def whoami():
+    from paddle_tpu.distributed import rpc
+    return rpc.get_current_worker_info().name
+
+
+def boom():
+    raise ValueError("remote failure")
+
+
+def boom_unpicklable():
+    import threading
+    e = ValueError("has a lock")
+    e.lock = threading.Lock()  # not picklable
+    raise e
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.distributed import rpc
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    name = f"worker{rank}"
+    rpc.init_rpc(name)
+    peer = f"worker{1 - rank}"
+
+    # sync call
+    assert rpc.rpc_sync(peer, add, args=(2, 3)) == 5
+    # async call
+    fut = rpc.rpc_async(peer, whoami)
+    assert fut.wait(timeout=60) == peer
+    # remote exception propagates
+    try:
+        rpc.rpc_sync(peer, boom)
+    except ValueError as e:
+        assert "remote failure" in str(e)
+    else:
+        raise AssertionError("expected remote ValueError")
+    # unpicklable remote exception degrades to a readable RuntimeError
+    try:
+        rpc.rpc_sync(peer, boom_unpicklable)
+    except RuntimeError as e:
+        assert "has a lock" in str(e)
+    else:
+        raise AssertionError("expected RuntimeError for unpicklable")
+    # worker info surface
+    infos = rpc.get_all_worker_infos()
+    assert [w.name for w in infos] == ["worker0", "worker1"]
+    assert rpc.get_worker_info(peer).rank == 1 - rank
+    rpc.shutdown()
+
+    out = sys.argv[1]
+    with open(os.path.join(out, f"rpc_ok.{rank}"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -351,6 +351,71 @@ def test_coalesce_sums_duplicates_with_grad():
     assert x.grad is not None
 
 
+def test_csr_values_keep_tape():
+    """Regression: sparse_csr_tensor must thread a Tensor values arg."""
+    x = paddle.to_tensor(np.array([1.0, 2.0, 0.5], np.float32),
+                         stop_gradient=False)
+    m = sparse.sparse_csr_tensor(np.array([0, 2, 3, 3]),
+                                 np.array([0, 2, 1]), x, [3, 3])
+    y = sparse.nn.functional.softmax(m)
+    paddle.sum(y.values() * y.values()).backward()
+    assert x.grad is not None
+    assert float(np.abs(x.grad.numpy()).max()) > 0
+
+
+def test_addmm_cancellation_keeps_pattern():
+    """Regression: output pattern is structural (union), not value-based;
+    exact cancellations stay in the pattern with correct gradients."""
+    iv = paddle.to_tensor(np.array([2.0], np.float32),
+                          stop_gradient=False)
+    inp = sparse.sparse_coo_tensor(np.array([[0], [1]]), iv, shape=[2, 2])
+    xs = sparse.sparse_coo_tensor(np.array([[0], [0]]),
+                                  np.array([2.0], np.float32),
+                                  shape=[2, 2])
+    ys = sparse.sparse_coo_tensor(np.array([[0], [1]]),
+                                  np.array([1.0], np.float32),
+                                  shape=[2, 2])
+    # beta*input[0,1] = 2, alpha*(x@y)[0,1] = -2 -> exact zero value
+    out = sparse.addmm(inp, xs, ys, beta=1.0, alpha=-1.0)
+    assert out.nnz() == 1  # the cancelled entry remains in the pattern
+    np.testing.assert_allclose(out.values().numpy(), [0.0])
+    paddle.sum(out.values()).backward()
+    np.testing.assert_allclose(iv.grad.numpy(), [1.0])  # d(out)/d(iv)=beta
+
+
+def test_batched_csr_roundtrip():
+    """3D (batched) CSR -> COO -> dense agrees with manual dense."""
+    crows = np.array([0, 1, 2, 0, 0, 2])  # 2 batches, 2 rows each
+    cols = np.array([1, 0, 0, 2])
+    vals = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    m = sparse.sparse_csr_tensor(crows, cols, vals, [2, 2, 3])
+    ref = np.zeros((2, 2, 3), np.float32)
+    ref[0, 0, 1] = 1.0
+    ref[0, 1, 0] = 2.0
+    ref[1, 1, 0] = 3.0
+    ref[1, 1, 2] = 4.0
+    np.testing.assert_allclose(m.to_dense().numpy(), ref)
+    coo = m.to_sparse_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(), ref)
+
+
+def test_attention_fully_masked_row_is_zero_not_nan():
+    rng = np.random.default_rng(12)
+    b, h, s, d = 1, 1, 3, 4
+    q, k, v = (paddle.to_tensor(
+        rng.standard_normal((b, h, s, d)).astype(np.float32))
+        for _ in range(3))
+    crows = np.array([0, 3, 6, 9])
+    cols = np.tile(np.arange(3), 3)
+    mask = sparse.sparse_csr_tensor(crows, cols,
+                                    np.ones(9, np.float32), [1, 3, 3])
+    kp = paddle.to_tensor(np.full((1, 3), -np.inf, np.float32))
+    out = sparse.nn.functional.attention(q, k, v, mask,
+                                         key_padding_mask=kp).numpy()
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, 0.0)
+
+
 def test_addmm_and_tape_to_dense():
     xs = sparse.sparse_coo_tensor(np.array([[0, 1], [1, 0]]),
                                   np.array([2.0, 3.0], np.float32),
