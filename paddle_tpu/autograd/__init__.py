@@ -3,6 +3,7 @@
 from ..core.autograd import backward, grad, no_grad, enable_grad  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401
+from .functional import jacobian, hessian  # noqa: F401
 
 
 def is_checkpoint_valid():

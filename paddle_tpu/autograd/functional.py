@@ -1,0 +1,141 @@
+"""`paddle.autograd.jacobian` / `hessian` (reference:
+python/paddle/autograd/autograd.py:450,544 — lazy Jacobian/Hessian
+objects over double-grad).
+
+TPU-first: two entry forms.
+- ``jacobian(ys, xs)`` with computed Tensors walks the eager tape with
+  one-hot cotangents (a row per output element) — exact, first-order.
+- ``jacobian(func, xs)`` / ``hessian(func, xs)`` with a CALLABLE traces
+  the pure function with jax.jacrev / jax.hessian — the XLA-native way
+  to get higher-order derivatives (the reference builds a double-grad
+  graph; under JAX, composition of transforms replaces graph surgery).
+Tensor-form ``hessian`` needs grad-of-grad on the tape, which the eager
+tape deliberately does not record (see core/autograd.grad) — it raises
+with a pointer to the callable form.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import grad as _tape_grad
+from ..core.tensor import Tensor
+
+__all__ = ["jacobian", "hessian"]
+
+
+class _Matrix:
+    """Lazy matrix facade (reference returns Jacobian/Hessian objects
+    that compute on indexing; here the matrix is materialized eagerly
+    and indexing/slicing just views it)."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def __getitem__(self, item):
+        return Tensor(self._arr[item])
+
+    @property
+    def shape(self):
+        return list(self._arr.shape)
+
+    def numpy(self):
+        return np.asarray(self._arr)
+
+    def __repr__(self):
+        return f"Jacobian(shape={list(self._arr.shape)})"
+
+
+def _as_tuple(x):
+    return tuple(x) if isinstance(x, (tuple, list)) else (x,)
+
+
+def _tape_jacobian_single(y, x, batch_axis):
+    rows = []
+    if batch_axis is None:
+        y_flat_len = int(np.prod(y.shape)) if y.shape else 1
+        for i in range(y_flat_len):
+            seed = np.zeros(y.shape or (1,), np.float32)
+            seed.reshape(-1)[i] = 1.0
+            (g,) = _tape_grad([y], [x],
+                              grad_outputs=[Tensor(seed.reshape(
+                                  y.shape or ()))],
+                              retain_graph=True, allow_unused=True)
+            rows.append(np.zeros(x.shape, np.float32)
+                        if g is None else np.asarray(g.numpy()))
+        arr = np.stack([r.reshape(-1) for r in rows], 0)
+        return _Matrix(arr)
+    # batch form: xs [B, N], ys [B, M] -> [B, M, N]
+    B = y.shape[batch_axis]
+    M = int(np.prod(y.shape)) // B
+    out = []
+    for i in range(M):
+        seed = np.zeros((B, M), np.float32)
+        seed[:, i] = 1.0
+        (g,) = _tape_grad([y], [x],
+                          grad_outputs=[Tensor(seed.reshape(y.shape))],
+                          retain_graph=True, allow_unused=True)
+        out.append(np.zeros(x.shape, np.float32)
+                   if g is None else np.asarray(g.numpy()))
+    arr = np.stack([r.reshape(B, -1) for r in out], 1)  # [B, M, N]
+    return _Matrix(arr)
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """d(ys)/d(xs) (reference autograd.py:450). ``ys`` may be computed
+    Tensors (tape walk) or a callable (jax.jacrev on the pure fn)."""
+    if callable(ys) and not isinstance(ys, Tensor):
+        func = ys
+        xs_t = _as_tuple(xs)
+        arrs = [jnp.asarray(x._data if isinstance(x, Tensor) else x)
+                for x in xs_t]
+
+        def pure(*a):
+            out = func(*[Tensor(v) for v in a])
+            return out._data if isinstance(out, Tensor) else out
+
+        jac = jax.jacrev(pure, argnums=tuple(range(len(arrs))))(*arrs)
+        mats = tuple(_Matrix(np.asarray(j)) for j in jac)
+        return mats if isinstance(xs, (tuple, list)) else mats[0]
+
+    ys_t, xs_t = _as_tuple(ys), _as_tuple(xs)
+    out = tuple(tuple(_tape_jacobian_single(y, x, batch_axis)
+                      for x in xs_t) for y in ys_t)
+    if not isinstance(ys, (tuple, list)):
+        out = out[0]
+        if not isinstance(xs, (tuple, list)):
+            return out[0]
+        return out
+    if not isinstance(xs, (tuple, list)):
+        return tuple(row[0] for row in out)
+    return out
+
+
+def hessian(ys, xs, batch_axis=None):
+    """d²(ys)/d(xs)² (reference autograd.py:544). Pass a CALLABLE to get
+    the exact Hessian via jax.hessian; Tensor-form would need the tape to
+    record grad-of-grad, which the eager tape does not (raises)."""
+    if callable(ys) and not isinstance(ys, Tensor):
+        func = ys
+        xs_t = _as_tuple(xs)
+        arrs = [jnp.asarray(x._data if isinstance(x, Tensor) else x)
+                for x in xs_t]
+
+        def pure(*a):
+            out = func(*[Tensor(v) for v in a])
+            out = out._data if isinstance(out, Tensor) else out
+            return jnp.sum(out)
+
+        hes = jax.hessian(pure, argnums=tuple(range(len(arrs))))(*arrs)
+        if isinstance(xs, (tuple, list)):
+            return tuple(tuple(_Matrix(np.asarray(hes[i][j]))
+                               for j in range(len(arrs)))
+                         for i in range(len(arrs)))
+        return _Matrix(np.asarray(hes[0][0]))
+    raise NotImplementedError(
+        "hessian(ys, xs) on computed Tensors needs double-backward, which "
+        "the eager tape does not record; pass the function instead: "
+        "paddle.autograd.hessian(func, xs) (jax.hessian under the hood)")

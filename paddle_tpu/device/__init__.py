@@ -15,10 +15,59 @@ from ..core import place as place_mod
 
 __all__ = ["set_device", "get_device", "get_all_custom_device_type",
            "is_compiled_with_cuda", "is_compiled_with_xpu",
-           "is_compiled_with_custom_device", "device_count", "synchronize",
+           "is_compiled_with_ipu", "is_compiled_with_custom_device",
+           "device_count", "synchronize",
            "Stream", "Event", "current_stream", "stream_guard", "cuda",
            "max_memory_allocated", "max_memory_reserved",
-           "memory_allocated", "memory_reserved", "empty_cache"]
+           "memory_allocated", "memory_reserved", "empty_cache",
+           "XPUPlace", "IPUPlace", "get_available_device",
+           "get_available_custom_device", "get_cudnn_version",
+           "set_stream"]
+
+
+def get_available_device():
+    """List every device string usable with set_device (reference
+    device/__init__.py get_available_device)."""
+    out = ["cpu"]
+    for i, d in enumerate(jax.devices()):
+        if d.platform != "cpu":
+            out.append(f"{d.platform}:{i}")
+    return out
+
+
+def get_available_custom_device():
+    """Custom (plugin) devices; PJRT plugins register as first-class jax
+    platforms here, so this mirrors get_available_device sans cpu."""
+    return [d for d in get_available_device() if not d.startswith("cpu")]
+
+
+def get_cudnn_version():
+    """No cuDNN in a TPU stack (reference returns the dynloaded cuDNN
+    version)."""
+    return None
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def XPUPlace(device_id=0):
+    from ..core.place import Place
+    return Place("tpu", device_id)
+
+
+def IPUPlace():
+    raise RuntimeError("IPU is not a supported backend in paddle_tpu")
+
+
+def set_stream(stream=None):
+    """Bind the 'current stream' (reference device.set_stream). XLA owns
+    scheduling; the Stream object is bookkeeping for API parity."""
+    global _current
+    prev = current_stream()
+    if stream is not None:
+        _current = stream
+    return prev
 
 
 def set_device(device):

@@ -12,9 +12,10 @@ from ..core.dispatch import unwrap
 from ..core.random import next_key
 from ..core.tensor import Tensor
 
-__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+__all__ = ["Distribution", "ExponentialFamily", "Normal", "Uniform",
+           "Categorical", "Bernoulli",
            "Beta", "Dirichlet", "Exponential", "Gamma", "Laplace",
-           "Multinomial", "kl_divergence",
+           "Multinomial", "kl_divergence", "register_kl",
            # long tail (distribution/extra.py)
            "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli",
            "Geometric", "Gumbel", "Independent", "LKJCholesky",
@@ -62,6 +63,40 @@ class Distribution:
 
     def kl_divergence(self, other):
         return kl_divergence(self, other)
+
+
+class ExponentialFamily(Distribution):
+    """Base for distributions of the exponential family (reference
+    python/paddle/distribution/exponential_family.py): entropy is derived
+    from the log-normalizer via the Bregman-divergence identity
+    H = F(θ) - <θ, ∇F(θ)> - E[carrier], with ∇F from jax.grad instead of
+    the reference's double-backward graph."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        nparams = [jnp.asarray(unwrap(p), jnp.float32)
+                   for p in self._natural_parameters]
+
+        def log_norm_sum(*ps):
+            return jnp.sum(self._log_normalizer(*ps))
+
+        grads = jax.grad(log_norm_sum, argnums=tuple(range(len(nparams))))(
+            *nparams)
+        ent = -self._mean_carrier_measure
+        ent = ent + self._log_normalizer(*nparams)
+        for p, g in zip(nparams, grads):
+            ent = ent - p * g
+        return _t(ent)
 
 
 class Normal(Distribution):
@@ -289,26 +324,62 @@ class Multinomial(Distribution):
         return _t(jnp.sum(onehot, axis=len(shape)))
 
 
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a pairwise KL implementation (reference
+    python/paddle/distribution/kl.py register_kl): the most specific
+    registered (type(p), type(q)) pair by MRO distance is dispatched."""
+
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return decorator
+
+
 def kl_divergence(p, q):
-    if isinstance(p, Normal) and isinstance(q, Normal):
-        var_p, var_q = p.scale ** 2, q.scale ** 2
-        return _t(jnp.log(q.scale / p.scale) +
-                  (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
-    if isinstance(p, Categorical) and isinstance(q, Categorical):
-        pp, qq = p.probs_arr, q.probs_arr
-        return _t(jnp.sum(pp * (jnp.log(jnp.maximum(pp, 1e-38)) -
-                                jnp.log(jnp.maximum(qq, 1e-38))), -1))
-    if isinstance(p, Uniform) and isinstance(q, Uniform):
-        return _t(jnp.log((q.high - q.low) / (p.high - p.low)))
-    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
-        a, b = p.probs_arr, q.probs_arr
-        eps = 1e-38
-        return _t(a * (jnp.log(jnp.maximum(a, eps)) -
-                       jnp.log(jnp.maximum(b, eps))) +
-                  (1 - a) * (jnp.log(jnp.maximum(1 - a, eps)) -
-                             jnp.log(jnp.maximum(1 - b, eps))))
-    raise NotImplementedError(
-        f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    best, best_fn = None, None
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            score = (type(p).__mro__.index(cp) +
+                     type(q).__mro__.index(cq))
+            if best is None or score < best:
+                best, best_fn = score, fn
+    if best_fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    return best_fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    return _t(jnp.log(q.scale / p.scale) +
+              (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    pp, qq = p.probs_arr, q.probs_arr
+    return _t(jnp.sum(pp * (jnp.log(jnp.maximum(pp, 1e-38)) -
+                            jnp.log(jnp.maximum(qq, 1e-38))), -1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return _t(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    a, b = p.probs_arr, q.probs_arr
+    eps = 1e-38
+    return _t(a * (jnp.log(jnp.maximum(a, eps)) -
+                   jnp.log(jnp.maximum(b, eps))) +
+              (1 - a) * (jnp.log(jnp.maximum(1 - a, eps)) -
+                         jnp.log(jnp.maximum(1 - b, eps))))
 
 
 from .extra import *  # noqa: F401,F403,E402

@@ -8,7 +8,8 @@ import jax.numpy as jnp
 from .core.dispatch import apply
 
 __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2",
-           "ifft2", "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2", "fftn", "ifftn",
+           "rfftn", "irfftn", "hfftn", "ihfftn",
            "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
 
 
@@ -55,6 +56,49 @@ fftn = _wrapn(jnp.fft.fftn)
 ifftn = _wrapn(jnp.fft.ifftn)
 rfftn = _wrapn(jnp.fft.rfftn)
 irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def _hfamily_n(hermitian_1d, nd_fn, hermitian_first):
+    """hfftn/ihfftn by separability (reference fft.py:827 fft_c2r/r2c
+    kernels): the Hermitian axis is the last one — transform it with the
+    1-D hermitian op and the remaining axes with the ordinary (i)fftn.
+    Order matters for the real-typed side: ihfft (r2c) must see the REAL
+    input, so it runs first; hfft (c2r) runs last."""
+
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        def impl(a):
+            ax = list(axes) if axes is not None else (
+                list(range(a.ndim)) if s is None
+                else list(range(a.ndim - len(s), a.ndim)))
+            ss = list(s) if s is not None else [None] * len(ax)
+            nrm = _norm(norm)
+            s_rest = ss[:-1] if s is not None else None
+            if hermitian_first:
+                a = hermitian_1d(a, n=ss[-1], axis=ax[-1], norm=nrm)
+                if len(ax) > 1:
+                    a = nd_fn(a, s=s_rest, axes=ax[:-1], norm=nrm)
+                return a
+            if len(ax) > 1:
+                a = nd_fn(a, s=s_rest, axes=ax[:-1], norm=nrm)
+            return hermitian_1d(a, n=ss[-1], axis=ax[-1], norm=nrm)
+
+        return apply(impl, x, name=name or "hfft_n")
+
+    return op
+
+
+hfftn = _hfamily_n(jnp.fft.hfft, jnp.fft.fftn, hermitian_first=False)
+ihfftn = _hfamily_n(jnp.fft.ihfft, jnp.fft.ifftn, hermitian_first=True)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D FFT of a Hermitian-symmetric signal (reference fft.py hfft2 =
+    hfftn over two axes)."""
+    return hfftn(x, s=s, axes=axes, norm=norm, name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm, name="ihfft2")
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

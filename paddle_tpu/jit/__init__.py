@@ -22,7 +22,9 @@ from ..core import random as random_mod
 from ..core.autograd import backward as tape_backward
 from ..core.tensor import Parameter, Tensor
 
-__all__ = ["to_static", "TrainStep", "save", "load", "no_retrace"]
+__all__ = ["to_static", "TrainStep", "save", "load", "no_retrace",
+           "TranslatedLayer", "enable_to_static", "ignore_module",
+           "set_code_level", "set_verbosity"]
 
 
 def _tree_wrap(x):
@@ -83,6 +85,12 @@ class _StaticFunction:
         return list(self._layer.named_buffers()) if self._layer else []
 
     def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED:
+            # reference enable_to_static(False): run the original eager
+            # code (debuggable — no tracers, python control flow works)
+            if self._layer is not None:
+                return self._fn(self._layer, *args, **kwargs)
+            return self._fn(*args, **kwargs)
         params = [p._data for _, p in self._param_items]
         buffers = [b._data for _, b in self._buffer_items]
         tree_args = jax.tree.map(_tree_unwrap, args,
@@ -107,6 +115,7 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             # Layer: return the layer with a compiled __call__ shim
             layer = fn
             layer._static_function = sf
+            layer._input_spec = input_spec  # jit.save uses it
             orig_class_call = type(layer).__call__
 
             def compiled_call(*args, **kw):
@@ -118,6 +127,7 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             layer.forward = compiled_call
             return layer
         functools.wraps(fn)(sf)
+        sf._input_spec = input_spec
         return sf
     if function is None:
         return wrap
@@ -283,15 +293,198 @@ def no_retrace(fn):
 not_to_static = no_retrace
 
 
+def _specs_to_structs(input_spec):
+    """static.InputSpec / Tensor / shape-list specs ->
+    jax.ShapeDtypeStructs; -1/None dims become export symbolic dims
+    (dynamic batch), all created in ONE scope as jax.export requires."""
+    from jax import export as jexport
+
+    from ..core import dtype as dtype_mod
+    shapes, dtypes, n_dyn = [], [], 0
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            shapes.append(list(spec._data.shape))
+            dtypes.append(spec._data.dtype)
+            continue
+        if hasattr(spec, "shape"):
+            shapes.append(list(spec.shape))
+            dtypes.append(dtype_mod.convert_dtype(
+                getattr(spec, "dtype", "float32")))
+        else:
+            shapes.append(list(spec))
+            dtypes.append(jnp.float32)
+        n_dyn += sum(1 for d in shapes[-1]
+                     if d is None or (isinstance(d, int) and d < 0))
+    syms = iter(jexport.symbolic_shape(
+        ", ".join(f"d{i}" for i in range(n_dyn))) if n_dyn else ())
+    structs = []
+    for shape, dtype in zip(shapes, dtypes):
+        dims = tuple(next(syms) if d is None or
+                     (isinstance(d, int) and d < 0) else int(d)
+                     for d in shape)
+        structs.append(jax.ShapeDtypeStruct(dims, dtype))
+    return structs
+
+
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save parity: persists state_dict (+ a marker). Full
-    serialized-program export (TranslatedLayer) is deferred to the
-    inference module."""
+    """paddle.jit.save (reference python/paddle/jit/api.py jit.save →
+    TranslatedLayer): persists the state_dict AND, when ``input_spec`` is
+    given (or recorded by to_static), the traced forward as serialized
+    StableHLO (jax.export) — the TPU-native serialized program, loadable
+    without the model class."""
+    import pickle
+
+    from jax import export as jexport
+
     from .. import framework
     framework.io.save(layer.state_dict(), path + ".pdparams")
+    if input_spec is None:
+        input_spec = getattr(layer, "_input_spec", None)
+    if input_spec is None:
+        return
+    items = list(layer.state_dict().items())
+    names = [n for n, _ in items]
+    arrs = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
+            for _, t in items]
+
+    def pure(params, *inputs):
+        from ..nn.layer.layers import Layer
+        assert isinstance(layer, Layer)
+        state = dict(zip(names, params))
+        restore = []
+        for n, t in layer.named_parameters():
+            if n in state:
+                restore.append((t, t._data))
+                t._data = state[n]
+        for n, t in layer.named_buffers():
+            if n in state:
+                restore.append((t, t._data))
+                t._data = state[n]
+        global _TO_STATIC_ENABLED
+        prev_ts = _TO_STATIC_ENABLED
+        try:
+            # trace the original eager forward — routing through the
+            # to_static jit shim here would nest jit inside the export
+            # trace and leak its RNG-key side channel
+            _TO_STATIC_ENABLED = False
+            was_training = getattr(layer, "training", False)
+            if hasattr(layer, "eval"):
+                layer.eval()
+            out = layer(*[Tensor(x) for x in inputs])
+            if was_training:
+                layer.train()
+            return out._data if isinstance(out, Tensor) else \
+                jax.tree.map(lambda t: t._data if isinstance(t, Tensor)
+                             else t, out)
+        finally:
+            _TO_STATIC_ENABLED = prev_ts
+            for t, d in restore:
+                t._data = d
+
+    structs = _specs_to_structs(input_spec)
+    exported = jexport.export(jax.jit(pure))(tuple(arrs), *structs)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump({"stablehlo": exported.serialize(),
+                     "param_names": names}, f)
+
+
+class TranslatedLayer:
+    """A layer rebuilt from a serialized program (reference
+    python/paddle/jit/translated_layer.py): forward = the deserialized
+    StableHLO executable, no Python model class needed."""
+
+    def __init__(self, exported, names, state):
+        self._exported = exported
+        self._names = names
+        self._params = tuple(
+            state[n]._data if isinstance(state[n], Tensor)
+            else jnp.asarray(state[n]) for n in names)
+        self.training = False
+
+    def __call__(self, *inputs):
+        return self.forward(*inputs)
+
+    def forward(self, *inputs):
+        args = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in inputs]
+        out = self._exported.call(self._params, *args)
+        return jax.tree.map(_tree_wrap, out)
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "TranslatedLayer is an inference program (the reference's "
+            "TranslatedLayer supports fine-tune via program grads; here "
+            "re-instantiate the Python model and load the .pdparams)")
+
+    def state_dict(self):
+        return {n: Tensor(p) for n, p in zip(self._names, self._params)}
 
 
 def load(path, **configs):
-    raise NotImplementedError(
-        "paddle_tpu.jit.load: use paddle_tpu.load + Layer.set_state_dict "
-        "(TranslatedLayer import lands with the inference module)")
+    """paddle.jit.load (reference jit/api.py load → TranslatedLayer):
+    deserializes the StableHLO program saved by jit.save."""
+    import os
+    import pickle
+
+    from jax import export as jexport
+
+    from .. import framework
+    if not os.path.exists(path + ".pdmodel"):
+        raise FileNotFoundError(
+            f"{path}.pdmodel not found — jit.save with input_spec writes "
+            "it; without a serialized program use paddle_tpu.load + "
+            "Layer.set_state_dict")
+    with open(path + ".pdmodel", "rb") as f:
+        blob = pickle.load(f)
+    exported = jexport.deserialize(blob["stablehlo"])
+    state = framework.io.load(path + ".pdparams")
+    return TranslatedLayer(exported, blob["param_names"], state)
+
+
+_TO_STATIC_ENABLED = True
+
+
+def enable_to_static(flag):
+    """Globally toggle to_static compilation (reference
+    jit/api.py enable_to_static); disabled => traced wrappers run eagerly.
+    """
+    global _TO_STATIC_ENABLED
+    _TO_STATIC_ENABLED = bool(flag)
+
+
+_IGNORED_MODULES = []
+
+
+def ignore_module(modules):
+    """Register modules the bytecode translator must skip (reference
+    jit/sot: paddle.jit.ignore_module). Retrace-based to_static has no
+    bytecode pass, so this only records them for API compat."""
+    _IGNORED_MODULES.extend(modules if isinstance(modules, (list, tuple))
+                            else [modules])
+
+
+_CODE_LEVEL = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Log translated code at ``level`` (reference jit/logging_utils).
+    Retrace-based to_static has no generated code; the setting is
+    recorded and the jit logger verbosity follows it."""
+    import logging
+    global _CODE_LEVEL
+    _CODE_LEVEL = level
+    logger = logging.getLogger("paddle_tpu.jit")
+    logger.setLevel(logging.DEBUG if level > 0 else logging.WARNING)
+    if also_to_stdout and not logger.handlers:
+        logger.addHandler(logging.StreamHandler())
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Set to_static logging verbosity (reference jit/logging_utils)."""
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)

@@ -16,8 +16,8 @@ import numpy as np
 
 from ...io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
-           "DatasetFolder", "ImageFolder"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers",
+           "VOC2012", "FakeData", "DatasetFolder", "ImageFolder"]
 
 _HOME = os.path.expanduser(os.environ.get(
     "PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
@@ -158,6 +158,101 @@ class Cifar100(Cifar10):
             d = pickle.load(f, encoding="bytes")
         self.images = d[b"data"].reshape(-1, 3, 32, 32)
         self.labels = np.asarray(d[b"fine_labels"], "int64")
+
+
+class Flowers(Dataset):
+    """Flowers102 (reference python/paddle/vision/datasets/flowers.py:54):
+    102flowers.tgz images + imagelabels.mat / setid.mat splits."""
+
+    DATA_URL = "http://paddlemodels.bj.bcebos.com/flowers/102flowers.tgz"
+    LABEL_URL = "http://paddlemodels.bj.bcebos.com/flowers/imagelabels.mat"
+    SETID_URL = "http://paddlemodels.bj.bcebos.com/flowers/setid.mat"
+    # the reference swaps train/test on purpose (flowers.py:48-51: the
+    # official tstid split is larger, so it serves as training data)
+    _FLAG = {"train": "tstid", "valid": "valid", "test": "trnid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        assert mode in self._FLAG, mode
+        self.transform = transform
+        root = os.path.join(_HOME, "flowers")
+        data_file = data_file or os.path.join(root, "102flowers.tgz")
+        label_file = label_file or os.path.join(root, "imagelabels.mat")
+        setid_file = setid_file or os.path.join(root, "setid.mat")
+        if download:
+            for url, path in ((self.DATA_URL, data_file),
+                              (self.LABEL_URL, label_file),
+                              (self.SETID_URL, setid_file)):
+                if not os.path.exists(path):
+                    _fetch(url, path)
+        import scipy.io as scio
+        self._tar = tarfile.open(data_file)
+        self._names = {os.path.basename(n): n
+                       for n in self._tar.getnames()
+                       if n.endswith(".jpg")}
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[self._FLAG[mode]][0]
+
+    def __getitem__(self, idx):
+        index = int(self.indexes[idx])
+        label = np.array([int(self.labels[index - 1])], dtype="int64")
+        fname = "image_%05d.jpg" % index
+        data = self._tar.extractfile(self._names[fname]).read()
+        import io as _io
+
+        from PIL import Image
+        img = np.asarray(Image.open(_io.BytesIO(data)).convert("RGB"))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs (reference
+    python/paddle/vision/datasets/voc2012.py:54): (image, label-mask)
+    from VOCtrainval_11-May-2012.tar."""
+
+    VOC_URL = ("https://dataset.bj.bcebos.com/voc/"
+               "VOCtrainval_11-May-2012.tar")
+    # reference MODE_FLAG_MAP (voc2012.py:51): train->trainval,
+    # test->train, valid->val
+    _SETS = {"train": "trainval.txt", "valid": "val.txt",
+             "test": "train.txt"}
+    _PREFIX = "VOCdevkit/VOC2012"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        assert mode in self._SETS, mode
+        self.transform = transform
+        data_file = data_file or os.path.join(
+            _HOME, "voc2012", "VOCtrainval_11-May-2012.tar")
+        if download and not os.path.exists(data_file):
+            _fetch(self.VOC_URL, data_file)
+        self._tar = tarfile.open(data_file)
+        lst = self._tar.extractfile(
+            f"{self._PREFIX}/ImageSets/Segmentation/"
+            f"{self._SETS[mode]}").read().decode()
+        self.keys = [k for k in lst.split() if k]
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+        key = self.keys[idx]
+        img = np.asarray(Image.open(_io.BytesIO(self._tar.extractfile(
+            f"{self._PREFIX}/JPEGImages/{key}.jpg").read()))
+            .convert("RGB"))
+        label = np.asarray(Image.open(_io.BytesIO(self._tar.extractfile(
+            f"{self._PREFIX}/SegmentationClass/{key}.png").read())))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.keys)
 
 
 _IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".npy")

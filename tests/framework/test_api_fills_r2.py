@@ -1,0 +1,319 @@
+"""Round-2 API fills: hfft family, register_kl/ExponentialFamily,
+autograd.jacobian/hessian, jit.save/load (TranslatedLayer over
+serialized StableHLO), device helpers, Flowers/VOC2012 datasets.
+"""
+
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# --- fft ------------------------------------------------------------------
+
+def test_hfft_family_matches_numpy_composition():
+    rng = np.random.default_rng(0)
+    x = (rng.standard_normal((4, 5)) +
+         1j * rng.standard_normal((4, 5))).astype(np.complex64)
+    out = paddle.fft.hfftn(paddle.to_tensor(x)).numpy()
+    # separable oracle: fft along axis 0, hfft along last axis
+    ref = np.fft.hfft(np.fft.fft(x, axis=0), axis=-1)
+    np.testing.assert_allclose(out, ref.astype(np.float32), atol=1e-3)
+    out2 = paddle.fft.hfft2(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out2, ref.astype(np.float32), atol=1e-3)
+
+    r = rng.standard_normal((4, 8)).astype(np.float32)
+    inv = paddle.fft.ihfftn(paddle.to_tensor(r)).numpy()
+    ref_inv = np.fft.ifft(np.fft.ihfft(r, axis=-1), axis=0)
+    np.testing.assert_allclose(inv, ref_inv.astype(np.complex64),
+                               atol=1e-4)
+    assert paddle.fft.ihfft2(paddle.to_tensor(r)).shape == [4, 5]
+
+
+def test_hfftn_roundtrip():
+    """hfftn inverts ihfftn on the Hermitian subspace: start from a real
+    signal (the reference doc's `ihfftn(hfftn(x, s)) == x` family)."""
+    rng = np.random.default_rng(1)
+    r = rng.standard_normal((3, 8)).astype(np.float32)
+    half = paddle.fft.ihfftn(paddle.to_tensor(r))
+    assert half.shape == [3, 5]
+    back = paddle.fft.hfftn(half, s=[3, 8]).numpy()
+    np.testing.assert_allclose(back, r, atol=1e-3)
+
+
+# --- distribution ---------------------------------------------------------
+
+def test_register_kl_dispatch():
+    from paddle_tpu import distribution as D
+
+    class MyNormal(D.Normal):
+        pass
+
+    # subclass falls back to the (Normal, Normal) registration
+    p = MyNormal(0.0, 1.0)
+    q = D.Normal(1.0, 2.0)
+    kl1 = float(D.kl_divergence(p, q).numpy())
+    kl_ref = float(D.kl_divergence(D.Normal(0.0, 1.0), q).numpy())
+    np.testing.assert_allclose(kl1, kl_ref, rtol=1e-6)
+
+    # a more specific registration wins
+    @D.register_kl(MyNormal, D.Normal)
+    def _custom(p, q):  # noqa: ARG001
+        return paddle.to_tensor(42.0)
+
+    assert float(D.kl_divergence(p, q).numpy()) == 42.0
+    del D._KL_REGISTRY[(MyNormal, D.Normal)]
+
+
+def test_exponential_family_entropy_bregman():
+    """Normal written as an exponential family reproduces the closed-form
+    entropy through the Bregman identity."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import distribution as D
+
+    class EFNormal(D.ExponentialFamily):
+        def __init__(self, loc, scale):
+            self.loc = jnp.asarray(loc, jnp.float32)
+            self.scale = jnp.asarray(scale, jnp.float32)
+            super().__init__((), ())
+
+        @property
+        def _natural_parameters(self):
+            return (self.loc / self.scale ** 2,
+                    -0.5 / self.scale ** 2)
+
+        def _log_normalizer(self, n1, n2):
+            return -(n1 ** 2) / (4 * n2) - 0.5 * jnp.log(-2 * n2)
+
+        @property
+        def _mean_carrier_measure(self):
+            return -0.5 * float(np.log(2 * np.pi))
+
+    d = EFNormal(1.3, 0.7)
+    ref = 0.5 * np.log(2 * np.pi * np.e * 0.7 ** 2)
+    np.testing.assert_allclose(float(d.entropy().numpy()), ref, rtol=1e-5)
+
+
+# --- autograd.jacobian / hessian -----------------------------------------
+
+def test_jacobian_tensor_form():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = x * x  # diag(2x)
+    J = paddle.autograd.jacobian(y, x)
+    np.testing.assert_allclose(J[:].numpy(), np.diag([2.0, 4.0, 6.0]),
+                               atol=1e-6)
+
+
+def test_jacobian_batch_axis():
+    x = paddle.to_tensor(
+        np.arange(6, dtype=np.float32).reshape(2, 3), stop_gradient=False)
+    y = x * 3.0
+    J = paddle.autograd.jacobian(y, x, batch_axis=0)
+    assert J.shape == [2, 3, 3]
+    for b in range(2):
+        np.testing.assert_allclose(J[b].numpy(), 3.0 * np.eye(3),
+                                   atol=1e-6)
+
+
+def test_jacobian_and_hessian_callable_form():
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    H = paddle.autograd.hessian(f, x)
+    np.testing.assert_allclose(H[:].numpy(), 2.0 * np.eye(2), atol=1e-6)
+
+    def g(x):
+        return x * x
+
+    J = paddle.autograd.jacobian(g, x)
+    np.testing.assert_allclose(J[:].numpy(), np.diag([2.0, 4.0]),
+                               atol=1e-6)
+
+
+def test_hessian_tensor_form_raises_with_migration():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    with pytest.raises(NotImplementedError, match="jax.hessian"):
+        paddle.autograd.hessian(y, x)
+
+
+# --- jit.save / jit.load --------------------------------------------------
+
+def test_jit_save_load_translated_layer(tmp_path):
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.GELU(),
+                               paddle.nn.Linear(8, 2))
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (3, 4)).astype("float32"))
+    ref = net(x).numpy()
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.static.InputSpec([-1, 4],
+                                                        "float32")])
+    assert os.path.exists(prefix + ".pdmodel")
+    loaded = paddle.jit.load(prefix)
+    assert isinstance(loaded, paddle.jit.TranslatedLayer)
+    np.testing.assert_allclose(loaded(x).numpy(), ref, atol=1e-5)
+    # dynamic batch dim really is dynamic
+    x2 = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+        (9, 4)).astype("float32"))
+    np.testing.assert_allclose(loaded(x2).numpy(), net(x2).numpy(),
+                               atol=1e-5)
+    # state_dict round-trips
+    sd = loaded.state_dict()
+    assert set(sd) == set(net.state_dict())
+    with pytest.raises(RuntimeError, match="inference"):
+        loaded.train()
+
+
+def test_jit_misc_api():
+    paddle.jit.enable_to_static(False)
+    paddle.jit.enable_to_static(True)
+    paddle.jit.ignore_module([np])
+    paddle.jit.set_verbosity(0)
+    paddle.jit.set_code_level(50)
+    paddle.jit.set_code_level(0)
+
+
+def test_enable_to_static_false_runs_eager():
+    """Regression: enable_to_static(False) must run the original python
+    forward (side effects visible per call, not per trace)."""
+    calls = []
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(2, 2)
+
+        def forward(self, x):
+            calls.append(1)
+            return self.fc(x)
+
+    m = paddle.jit.to_static(M())
+    x = paddle.to_tensor(np.ones((1, 2), np.float32))
+    try:
+        paddle.jit.enable_to_static(False)
+        n0 = len(calls)
+        m(x)
+        m(x)
+        assert len(calls) == n0 + 2  # eager: side effect every call
+    finally:
+        paddle.jit.enable_to_static(True)
+    n1 = len(calls)
+    m(x)
+    m(x)
+    assert len(calls) <= n1 + 1  # traced: at most the one trace call
+
+
+def test_jit_save_uses_to_static_recorded_spec(tmp_path):
+    """Regression: input_spec given to to_static is honored by
+    jit.save without re-passing it."""
+    net = paddle.jit.to_static(
+        paddle.nn.Sequential(paddle.nn.Linear(3, 2)),
+        input_spec=[paddle.static.InputSpec([-1, 3], "float32")])
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(net, prefix)
+    assert os.path.exists(prefix + ".pdmodel")
+    loaded = paddle.jit.load(prefix)
+    x = paddle.to_tensor(np.ones((4, 3), np.float32))
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                               atol=1e-5)
+
+
+# --- device helpers -------------------------------------------------------
+
+def test_device_helpers():
+    devs = paddle.device.get_available_device()
+    assert "cpu" in devs
+    assert paddle.device.get_cudnn_version() is None
+    assert not paddle.device.is_compiled_with_ipu()
+    assert isinstance(paddle.device.get_available_custom_device(), list)
+    s = paddle.device.Stream()
+    prev = paddle.device.set_stream(s)
+    assert paddle.device.current_stream() is s
+    paddle.device.set_stream(prev)
+    with pytest.raises(RuntimeError):
+        paddle.device.IPUPlace()
+    assert str(paddle.device.XPUPlace(0))
+
+
+# --- datasets -------------------------------------------------------------
+
+def _png_bytes(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _jpg_bytes(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def test_flowers_dataset_local_fixture(tmp_path):
+    import scipy.io as scio
+    rng = np.random.default_rng(0)
+    data_file = tmp_path / "102flowers.tgz"
+    with tarfile.open(data_file, "w:gz") as tar:
+        for i in range(1, 5):
+            raw = _jpg_bytes(rng.integers(
+                0, 255, (8, 8, 3)).astype("uint8"))
+            ti = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+            ti.size = len(raw)
+            tar.addfile(ti, io.BytesIO(raw))
+    label_file = tmp_path / "imagelabels.mat"
+    scio.savemat(label_file, {"labels": np.array([[1, 2, 1, 2]])})
+    setid_file = tmp_path / "setid.mat"
+    scio.savemat(setid_file, {"trnid": np.array([[1, 3]]),
+                              "tstid": np.array([[2, 4]]),
+                              "valid": np.array([[2]])})
+    # reference semantics: mode='train' reads the (larger) tstid split
+    ds = paddle.vision.datasets.Flowers(
+        data_file=str(data_file), label_file=str(label_file),
+        setid_file=str(setid_file), mode="train", download=False)
+    assert len(ds) == 2
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3)
+    assert label.tolist() == [2]  # image 2's label
+    ds_test = paddle.vision.datasets.Flowers(
+        data_file=str(data_file), label_file=str(label_file),
+        setid_file=str(setid_file), mode="test", download=False)
+    assert [int(i) for i in ds_test.indexes] == [1, 3]
+
+
+def test_voc2012_dataset_local_fixture(tmp_path):
+    rng = np.random.default_rng(1)
+    data_file = tmp_path / "voc.tar"
+    pref = "VOCdevkit/VOC2012"
+    with tarfile.open(data_file, "w") as tar:
+        def add(name, raw):
+            ti = tarfile.TarInfo(name)
+            ti.size = len(raw)
+            tar.addfile(ti, io.BytesIO(raw))
+        add(f"{pref}/ImageSets/Segmentation/train.txt", b"a1\n")
+        add(f"{pref}/ImageSets/Segmentation/val.txt", b"a2\n")
+        add(f"{pref}/ImageSets/Segmentation/trainval.txt", b"a1\na2\n")
+        for key in ("a1", "a2"):
+            add(f"{pref}/JPEGImages/{key}.jpg", _jpg_bytes(
+                rng.integers(0, 255, (6, 6, 3)).astype("uint8")))
+            add(f"{pref}/SegmentationClass/{key}.png", _png_bytes(
+                rng.integers(0, 20, (6, 6)).astype("uint8")))
+    # reference MODE_FLAG_MAP: train reads trainval, test reads train
+    ds = paddle.vision.datasets.VOC2012(data_file=str(data_file),
+                                        mode="train", download=False)
+    assert len(ds) == 2
+    img, label = ds[0]
+    assert img.shape == (6, 6, 3)
+    assert label.shape == (6, 6)
+    ds_test = paddle.vision.datasets.VOC2012(data_file=str(data_file),
+                                             mode="test", download=False)
+    assert ds_test.keys == ["a1"]

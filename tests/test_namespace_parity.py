@@ -92,6 +92,19 @@ NAMESPACES = [
     ("audio", "audio/__init__.py"),
     ("signal", "signal.py"),
     ("amp", "amp/__init__.py"),
+    ("fft", "fft.py"),
+    ("distribution", "distribution/__init__.py"),
+    ("autograd", "autograd/__init__.py"),
+    ("device", "device/__init__.py"),
+    ("jit", "jit/__init__.py"),
+    ("vision.datasets", "vision/datasets/__init__.py"),
+    ("vision.models", "vision/models/__init__.py"),
+    ("optimizer", "optimizer/__init__.py"),
+    ("optimizer.lr", "optimizer/lr.py"),
+    ("linalg", "linalg.py"),
+    ("sparse.nn", "sparse/nn/__init__.py"),
+    ("sparse.nn.functional", "sparse/nn/functional/__init__.py"),
+    ("text", "text/__init__.py"),
 ]
 
 

@@ -1,10 +1,18 @@
-"""Op-coverage audit: paddle_tpu exports vs the reference tensor API.
+"""Op/namespace coverage audit: paddle_tpu exports vs the reference.
 
-Diffs our public surface against the reference's
-`python/paddle/tensor/__init__.py` (the ~700 tensor-op wrappers; see
-SURVEY.md §2.2) and reports coverage to OPS_AUDIT.md. Run:
+The audit-able single source of truth standing in for the reference's op
+YAML (`paddle/phi/ops/yaml/ops.yaml`, ~790 defs — SURVEY.md §2.1): every
+public name the reference exports, per namespace, diffed against this
+package. Run:
 
     python tools/ops_audit.py [--write]
+
+Surfaces audited:
+- the tensor API (`python/paddle/tensor/__init__.py`, ~700 wrappers)
+- `Tensor` method bindings (`tensor_method_func`)
+- every reference namespace `__all__` (paddle, nn, nn.functional, ...,
+  sparse.nn.functional) — the same list the namespace-parity tests
+  enforce (tests/test_namespace_parity.py).
 """
 
 from __future__ import annotations
@@ -16,7 +24,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-REF = Path("/root/reference/python/paddle/tensor/__init__.py")
+REF = Path("/root/reference/python/paddle")
 OUT = Path(__file__).resolve().parent.parent / "OPS_AUDIT.md"
 
 # Reference names that are static-graph/fluid-only machinery, not tensor
@@ -26,10 +34,55 @@ EXCLUDED = {
     "fill_constant", "create_tensor", "create_parameter",  # static builders
 }
 
+# (attr path under paddle_tpu, reference file with __all__)
+NAMESPACES = [
+    ("", "__init__.py"),
+    ("nn", "nn/__init__.py"),
+    ("nn.functional", "nn/functional/__init__.py"),
+    ("nn.initializer", "nn/initializer/__init__.py"),
+    ("linalg", "linalg.py"),
+    ("fft", "fft.py"),
+    ("signal", "signal.py"),
+    ("sparse", "sparse/__init__.py"),
+    ("sparse.nn", "sparse/nn/__init__.py"),
+    ("sparse.nn.functional", "sparse/nn/functional/__init__.py"),
+    ("distribution", "distribution/__init__.py"),
+    ("metric", "metric/__init__.py"),
+    ("amp", "amp/__init__.py"),
+    ("autograd", "autograd/__init__.py"),
+    ("device", "device/__init__.py"),
+    ("distributed", "distributed/__init__.py"),
+    ("io", "io/__init__.py"),
+    ("jit", "jit/__init__.py"),
+    ("optimizer", "optimizer/__init__.py"),
+    ("optimizer.lr", "optimizer/lr.py"),
+    ("profiler", "profiler/__init__.py"),
+    ("static", "static/__init__.py"),
+    ("incubate", "incubate/__init__.py"),
+    ("vision.ops", "vision/ops.py"),
+    ("vision.transforms", "vision/transforms/__init__.py"),
+    ("vision.models", "vision/models/__init__.py"),
+    ("vision.datasets", "vision/datasets/__init__.py"),
+    ("audio", "audio/__init__.py"),
+    ("text", "text/__init__.py"),
+    ("quantization", "quantization/__init__.py"),
+    ("geometric", "geometric/__init__.py"),
+    ("onnx", "onnx/__init__.py"),
+]
 
-def reference_names() -> list[str]:
-    src = REF.read_text()
-    # names inside "from .x import (...)" blocks
+
+def _all_names(path: Path) -> list[str]:
+    src = path.read_text()
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
+    if m is None:
+        return []
+    names = re.findall(r"'([^']+)'", m.group(1)) + \
+        re.findall(r'"([^"]+)"', m.group(1))
+    return sorted(set(names) - EXCLUDED)
+
+
+def tensor_api_names() -> list[str]:
+    src = (REF / "tensor/__init__.py").read_text()
     names = []
     for block in re.findall(r"from [.\w]+ import \(([^)]*)\)", src):
         for line in block.splitlines():
@@ -40,10 +93,18 @@ def reference_names() -> list[str]:
     return sorted(set(names) - EXCLUDED)
 
 
+def tensor_method_names() -> list[str]:
+    src = (REF / "tensor/__init__.py").read_text()
+    m = re.search(r"tensor_method_func\s*=\s*\[(.*?)\]", src, re.S)
+    return sorted(set(re.findall(r"['\"]([^'\"]+)['\"]", m.group(1))))
+
+
 def audit():
     import paddle_tpu as paddle
 
-    ref = reference_names()
+    rows = []  # (label, total, have, missing list)
+
+    ref = tensor_api_names()
     have, missing = [], []
     for n in ref:
         if hasattr(paddle, n) or hasattr(paddle.Tensor, n) \
@@ -51,7 +112,35 @@ def audit():
             have.append(n)
         else:
             missing.append(n)
-    return ref, have, missing
+    rows.append(("tensor API (`python/paddle/tensor`)", len(ref),
+                 len(have), missing))
+
+    meth = tensor_method_names()
+    m_missing = [n for n in meth if not hasattr(paddle.Tensor, n)]
+    rows.append(("Tensor methods (`tensor_method_func`)", len(meth),
+                 len(meth) - len(m_missing), m_missing))
+
+    for ns, rel in NAMESPACES:
+        path = REF / rel
+        if not path.exists():
+            continue
+        names = _all_names(path)
+        if not names:
+            continue
+        obj = paddle
+        ok = True
+        for part in (ns.split(".") if ns else []):
+            obj = getattr(obj, part, None)
+            if obj is None:
+                ok = False
+                break
+        if not ok:
+            rows.append((f"paddle.{ns}", len(names), 0, names))
+            continue
+        missing = sorted(n for n in names if not hasattr(obj, n))
+        rows.append((f"paddle.{ns}" if ns else "paddle (top level)",
+                     len(names), len(names) - len(missing), missing))
+    return rows
 
 
 def main():
@@ -59,29 +148,31 @@ def main():
     ap.add_argument("--write", action="store_true",
                     help="write OPS_AUDIT.md")
     args = ap.parse_args()
-    ref, have, missing = audit()
-    pct = 100.0 * len(have) / len(ref)
-    print(f"reference tensor API: {len(ref)} names")
-    print(f"implemented: {len(have)} ({pct:.1f}%)")
-    print(f"missing: {len(missing)}")
-    for n in missing:
-        print("  -", n)
+    rows = audit()
+    total = sum(r[1] for r in rows)
+    have = sum(r[2] for r in rows)
+    lines = [
+        "# OPS_AUDIT — paddle_tpu coverage of the reference public API",
+        "",
+        "Generated by `python tools/ops_audit.py --write` (enforced in CI "
+        "by tests/test_namespace_parity.py). The audit-able stand-in for "
+        "the reference's op YAML single source of truth "
+        "(`paddle/phi/ops/yaml/ops.yaml`). Static-graph-only machinery "
+        f"excluded as non-goals: {sorted(EXCLUDED)}.",
+        "",
+        f"**Total: {have}/{total} = {100.0 * have / total:.1f}%**",
+        "",
+        "| surface | reference names | implemented | missing |",
+        "|---|---|---|---|",
+    ]
+    for label, t, h, missing in rows:
+        miss = ", ".join(f"`{m}`" for m in missing) if missing else "—"
+        lines.append(f"| {label} | {t} | {h} | {miss} |")
+        print(f"{label:55s} {h:4d}/{t:<4d}"
+              + ("  MISSING: " + " ".join(missing) if missing else ""))
+    lines.append("")
+    print(f"TOTAL {have}/{total} = {100.0 * have / total:.1f}%")
     if args.write:
-        lines = [
-            "# OPS_AUDIT — paddle_tpu coverage of the reference tensor API",
-            "",
-            f"Generated by `python tools/ops_audit.py --write`. Reference"
-            f" surface: `python/paddle/tensor/__init__.py` ({len(ref)}"
-            " public names after excluding static-graph-only machinery:"
-            f" {sorted(EXCLUDED)}).",
-            "",
-            f"**Coverage: {len(have)}/{len(ref)} = {pct:.1f}%**",
-            "",
-            "## Missing",
-            "",
-        ]
-        lines += [f"- `{n}`" for n in missing]
-        lines += [""]
         OUT.write_text("\n".join(lines))
         print(f"wrote {OUT}")
     return 0
@@ -89,3 +180,5 @@ def main():
 
 if __name__ == "__main__":
     sys.exit(main())
+
+
