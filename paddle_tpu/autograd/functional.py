@@ -87,6 +87,10 @@ def _tape_jacobian_single(y, x, batch_axis):
 def jacobian(ys, xs, batch_axis=None):
     """d(ys)/d(xs) (reference autograd.py:450). ``ys`` may be computed
     Tensors (tape walk) or a callable (jax.jacrev on the pure fn)."""
+    if batch_axis not in (None, 0):
+        raise ValueError(
+            f"batch_axis must be None or 0 (the reference supports only "
+            f"leading batch), got {batch_axis}")
     if callable(ys) and not isinstance(ys, Tensor):
         func = ys
         xs_t = _as_tuple(xs)
@@ -127,6 +131,11 @@ def hessian(ys, xs, batch_axis=None):
         def pure(*a):
             out = func(*[Tensor(v) for v in a])
             out = out._data if isinstance(out, Tensor) else out
+            if out.size != 1:
+                raise ValueError(
+                    f"hessian expects a scalar-output function (the "
+                    f"reference requires a 1-element ys), got output "
+                    f"shape {tuple(out.shape)}")
             return jnp.sum(out)
 
         hes = jax.hessian(pure, argnums=tuple(range(len(arrs))))(*arrs)

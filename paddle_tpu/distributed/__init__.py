@@ -44,3 +44,5 @@ from .compat import (  # noqa: F401,E402
 from . import launch  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import rpc  # noqa: F401,E402
+from . import communication  # noqa: F401,E402
+from .communication import stream  # noqa: F401,E402

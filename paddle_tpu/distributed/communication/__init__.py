@@ -1,0 +1,17 @@
+"""`paddle.distributed.communication` subpackage path (reference:
+python/paddle/distributed/communication/ — group/collectives + the
+`stream` explicit-stream variants).
+
+The functional collectives live in `paddle_tpu.distributed.collective`
+(one implementation over lax collectives); this package re-exports them
+under the reference's module layout so `paddle.distributed.
+communication.*` and `paddle.distributed.stream.*` imports resolve.
+"""
+
+from ..collective import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_gather_object, all_reduce,
+    all_to_all, barrier, broadcast, gather, get_rank, get_world_size,
+    new_group, recv, reduce, reduce_scatter, scatter, send,
+)
+from . import stream  # noqa: F401
+from . import group  # noqa: F401

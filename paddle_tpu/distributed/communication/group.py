@@ -1,0 +1,22 @@
+"""`paddle.distributed.communication.group` (reference group.py: the
+Group object + helpers)."""
+
+from ..collective import Group, new_group, get_rank, get_world_size  # noqa: F401
+
+
+def is_initialized():
+    """Whether the parallel env is up (reference group.py
+    is_initialized)."""
+    from ..env import is_initialized as _is_init
+    return _is_init()
+
+
+def destroy_process_group(group=None):
+    """Release process-group state (reference group.py). Mesh axes are
+    compile-time constructs here; nothing to tear down per group."""
+    return None
+
+
+def get_group(gid=0):
+    from ..collective import _group
+    return _group(None)

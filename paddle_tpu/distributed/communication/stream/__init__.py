@@ -1,0 +1,104 @@
+"""`paddle.distributed.communication.stream` — explicit-stream collective
+variants (reference: python/paddle/distributed/communication/stream/,
+each op taking sync_op / use_calc_stream).
+
+TPU-native: XLA owns stream scheduling — collectives compile into the
+program and the runtime overlaps them with compute (the hand-placed
+comm-stream machinery the reference exposes is the compiler's job
+here). The variants therefore delegate to the one implementation in
+`distributed/collective.py` and return a completed task handle.
+"""
+
+from __future__ import annotations
+
+from ... import collective as _c
+from ...compat import alltoall_single as _alltoall_single
+
+__all__ = ["all_gather", "all_reduce", "alltoall", "alltoall_single",
+           "broadcast", "reduce", "reduce_scatter", "recv", "scatter",
+           "send", "gather"]
+
+
+class _DoneTask:
+    """Completed-communication handle (reference returns a
+    core.task / Work object)."""
+
+    def wait(self):
+        return None
+
+    def is_completed(self):
+        return True
+
+
+def _task(_result=None):
+    return _DoneTask()
+
+
+def all_reduce(tensor, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    _c.all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+    return _task()
+
+
+def all_gather(tensor_or_tensor_list, tensor, group=None, sync_op=True,
+               use_calc_stream=False):
+    _c.all_gather(tensor_or_tensor_list, tensor, group=group,
+                  sync_op=sync_op)
+    return _task()
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True,
+             use_calc_stream=False):
+    _c.all_to_all(out_tensor_list, in_tensor_list, group=group,
+                  sync_op=sync_op)
+    return _task()
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True,
+                    use_calc_stream=False):
+    _alltoall_single(out_tensor, in_tensor, in_split_sizes,
+                     out_split_sizes, group=group, sync_op=sync_op)
+    return _task()
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True,
+              use_calc_stream=False):
+    _c.broadcast(tensor, src=src, group=group, sync_op=sync_op)
+    return _task()
+
+
+def reduce(tensor, dst=0, op=_c.ReduceOp.SUM, group=None, sync_op=True,
+           use_calc_stream=False):
+    _c.reduce(tensor, dst=dst, op=op, group=group, sync_op=sync_op)
+    return _task()
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=_c.ReduceOp.SUM,
+                   group=None, sync_op=True, use_calc_stream=False):
+    _c.reduce_scatter(tensor, tensor_or_tensor_list, op=op, group=group,
+                      sync_op=sync_op)
+    return _task()
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True, use_calc_stream=False):
+    _c.scatter(tensor, tensor_or_tensor_list, src=src, group=group,
+               sync_op=sync_op)
+    return _task()
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True,
+           use_calc_stream=False):
+    _c.gather(tensor, gather_list, dst=dst, group=group, sync_op=sync_op)
+    return _task()
+
+
+def send(tensor, dst=0, group=None, sync_op=True, use_calc_stream=False):
+    _c.send(tensor, dst=dst, group=group, sync_op=sync_op)
+    return _task()
+
+
+def recv(tensor, src=0, group=None, sync_op=True, use_calc_stream=False):
+    _c.recv(tensor, src=src, group=group, sync_op=sync_op)
+    return _task()

@@ -2,6 +2,7 @@
 
 from . import nn  # noqa: F401
 from . import asp  # noqa: F401
+from . import autotune  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from ..core.autograd import no_grad  # noqa: F401

@@ -1,0 +1,53 @@
+"""`paddle.incubate.autotune` (reference:
+python/paddle/incubate/autotune.py set_config — kernel / layout /
+dataloader auto-tuning switches).
+
+TPU mapping:
+- kernel: enables the Pallas flash-attention block-size sweep
+  (kernels/pallas/flash_attention._AUTOTUNE) — the exhaustive-search
+  analogue of the reference's cuDNN algorithm cache.
+- layout: XLA's layout assignment already auto-tunes layouts per target;
+  the switch is recorded for API parity.
+- dataloader: recorded; the multiprocess DataLoader sizes its worker
+  pool from num_workers directly.
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["set_config"]
+
+_CONFIG = {"kernel": {"enable": False},
+           "layout": {"enable": False},
+           "dataloader": {"enable": False}}
+
+
+def set_config(config=None):
+    """Configure auto-tuning (reference incubate/autotune.py:47). Accepts
+    a dict, a path to a JSON file, or None (enable everything)."""
+    from ..kernels.pallas import flash_attention as _fa
+    if config is None:
+        cfg = {k: {"enable": True} for k in _CONFIG}
+    elif isinstance(config, str):
+        with open(config) as f:
+            cfg = json.load(f)
+    elif isinstance(config, dict):
+        cfg = config
+    else:
+        raise TypeError(
+            f"set_config expects dict, json path or None, got "
+            f"{type(config).__name__}")
+    for key, val in cfg.items():
+        if key not in _CONFIG:
+            raise ValueError(f"unknown autotune domain {key!r}; "
+                             f"expected one of {sorted(_CONFIG)}")
+        if isinstance(val, dict):
+            _CONFIG[key].update(val)
+        else:
+            _CONFIG[key]["enable"] = bool(val)
+    _fa._AUTOTUNE["enable"] = bool(_CONFIG["kernel"].get("enable"))
+
+
+def get_config():
+    return {k: dict(v) for k, v in _CONFIG.items()}

@@ -337,47 +337,43 @@ def save(layer, path, input_spec=None, **configs):
     from jax import export as jexport
 
     from .. import framework
-    framework.io.save(layer.state_dict(), path + ".pdparams")
+    state = layer.state_dict() if hasattr(layer, "state_dict") else {}
+    framework.io.save(state, path + ".pdparams")
     if input_spec is None:
         input_spec = getattr(layer, "_input_spec", None)
     if input_spec is None:
         return
-    items = list(layer.state_dict().items())
+    items = list(state.items())
     names = [n for n, _ in items]
     arrs = [t._data if isinstance(t, Tensor) else jnp.asarray(t)
             for _, t in items]
 
     def pure(params, *inputs):
-        from ..nn.layer.layers import Layer
-        assert isinstance(layer, Layer)
-        state = dict(zip(names, params))
+        bound = dict(zip(names, params))
         restore = []
-        for n, t in layer.named_parameters():
-            if n in state:
-                restore.append((t, t._data))
-                t._data = state[n]
-        for n, t in layer.named_buffers():
-            if n in state:
-                restore.append((t, t._data))
-                t._data = state[n]
+        for kind in ("named_parameters", "named_buffers"):
+            for n, t in getattr(layer, kind, lambda: ())():
+                if n in bound:
+                    restore.append((t, t._data))
+                    t._data = bound[n]
         global _TO_STATIC_ENABLED
         prev_ts = _TO_STATIC_ENABLED
+        was_training = getattr(layer, "training", False)
         try:
             # trace the original eager forward — routing through the
             # to_static jit shim here would nest jit inside the export
             # trace and leak its RNG-key side channel
             _TO_STATIC_ENABLED = False
-            was_training = getattr(layer, "training", False)
             if hasattr(layer, "eval"):
                 layer.eval()
             out = layer(*[Tensor(x) for x in inputs])
-            if was_training:
-                layer.train()
             return out._data if isinstance(out, Tensor) else \
                 jax.tree.map(lambda t: t._data if isinstance(t, Tensor)
                              else t, out)
         finally:
             _TO_STATIC_ENABLED = prev_ts
+            if was_training and hasattr(layer, "train"):
+                layer.train()
             for t, d in restore:
                 t._data = d
 

@@ -67,6 +67,43 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+# runtime block-size autotune (paddle.incubate.autotune.set_config
+# {"kernel": {"enable": True}} turns it on — the reference's exhaustive
+# kernel search, applied to the Pallas grid): first call per shape times
+# the candidate grid on-device and caches the winner.
+_AUTOTUNE = {"enable": False, "cache": {}}
+
+_SWEEP_BQ = (128, 256, 512, 1024)
+_SWEEP_BK = (256, 512, 1024)
+
+
+def _sweep_blocks(q, k, v, causal, scale, sq, sk, group):
+    import time as _time
+    best, best_t = None, float("inf")
+    for bq in _SWEEP_BQ:
+        if bq > _round_up(sq, 128):
+            continue
+        for bk in _SWEEP_BK:
+            if bk > _round_up(sk, 128):
+                continue
+            try:
+                out = flash_attention(q, k, v, causal=causal, scale=scale,
+                                      block_q=bq, block_k=bk)
+                out.block_until_ready()
+                t0 = _time.perf_counter()
+                for _ in range(3):
+                    out = flash_attention(q, k, v, causal=causal,
+                                          scale=scale, block_q=bq,
+                                          block_k=bk)
+                out.block_until_ready()
+                dt = _time.perf_counter() - t0
+            except Exception:  # noqa: BLE001 — e.g. VMEM overflow
+                continue
+            if dt < best_t:
+                best, best_t = (bq, bk), dt
+    return best or default_block_sizes(sq, sk, group)
+
+
 def default_block_sizes(sq: int, sk: int, group: int):
     """Per-shape block table (swept on v5e; see BASELINE.md kernel notes).
     Rows of the q operand are group*block_q, so larger GQA groups take a
@@ -496,6 +533,18 @@ def flash_attention(q, k, v, causal=False, scale=None,
 
     has_seg = q_segment_ids is not None
     bq, bk = default_block_sizes(Sq, Sk, G)
+    if _AUTOTUNE["enable"] and block_q is None and block_k is None \
+            and not has_seg and not _interpret():
+        tkey = (B, Sq, Sk, Hq, Hk, D, causal, str(q.dtype))
+        tuned = _AUTOTUNE["cache"].get(tkey)
+        if tuned is None and not isinstance(q, jax.core.Tracer):
+            # sweep only on concrete arrays — under a jit trace the
+            # timings are meaningless and caching here would pin the
+            # defaults for this shape forever
+            tuned = _sweep_blocks(q, k, v, causal, scale, Sq, Sk, G)
+            _AUTOTUNE["cache"][tkey] = tuned
+        if tuned is not None:
+            bq, bk = tuned
     if block_q:
         bq = min(block_q, _round_up(Sq, 128))
     if block_k:

@@ -137,6 +137,32 @@ def test_jacobian_and_hessian_callable_form():
                                atol=1e-6)
 
 
+def test_jacobian_rejects_nonzero_batch_axis():
+    x = paddle.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+    y = x * 2.0
+    with pytest.raises(ValueError, match="batch_axis"):
+        paddle.autograd.jacobian(y, x, batch_axis=1)
+
+
+def test_hessian_rejects_vector_output():
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    with pytest.raises(ValueError, match="scalar-output"):
+        paddle.autograd.hessian(lambda t: t * t, x)
+
+
+def test_jit_save_plain_function(tmp_path):
+    """Regression: jit.save on a to_static-decorated FUNCTION works."""
+    f = paddle.jit.to_static(
+        lambda x: x * 2.0 + 1.0,
+        input_spec=[paddle.static.InputSpec([-1, 3], "float32")])
+    prefix = str(tmp_path / "fn")
+    paddle.jit.save(f, prefix)
+    loaded = paddle.jit.load(prefix)
+    x = paddle.to_tensor(np.ones((2, 3), np.float32))
+    np.testing.assert_allclose(loaded(x).numpy(), 3.0 * np.ones((2, 3)),
+                               atol=1e-6)
+
+
 def test_hessian_tensor_form_raises_with_migration():
     x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
     y = (x * x).sum()

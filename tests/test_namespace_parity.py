@@ -73,51 +73,23 @@ def test_sparse_elementwise_and_structural():
                                    [[0, 1], [1, 1]], "float32"))
 
 
-NAMESPACES = [
-    ("", "__init__.py"),
-    ("nn", "nn/__init__.py"),
-    ("nn.functional", "nn/functional/__init__.py"),
-    ("nn.initializer", "nn/initializer/__init__.py"),
-    ("vision.ops", "vision/ops.py"),
-    ("vision.transforms", "vision/transforms/__init__.py"),
-    ("distributed", "distributed/__init__.py"),
-    ("io", "io/__init__.py"),
-    ("metric", "metric/__init__.py"),
-    ("profiler", "profiler/__init__.py"),
-    ("onnx", "onnx/__init__.py"),
-    ("incubate", "incubate/__init__.py"),
-    ("quantization", "quantization/__init__.py"),
-    ("static", "static/__init__.py"),
-    ("geometric", "geometric/__init__.py"),
-    ("audio", "audio/__init__.py"),
-    ("signal", "signal.py"),
-    ("amp", "amp/__init__.py"),
-    ("fft", "fft.py"),
-    ("distribution", "distribution/__init__.py"),
-    ("autograd", "autograd/__init__.py"),
-    ("device", "device/__init__.py"),
-    ("jit", "jit/__init__.py"),
-    ("vision.datasets", "vision/datasets/__init__.py"),
-    ("vision.models", "vision/models/__init__.py"),
-    ("optimizer", "optimizer/__init__.py"),
-    ("optimizer.lr", "optimizer/lr.py"),
-    ("linalg", "linalg.py"),
-    ("sparse.nn", "sparse/nn/__init__.py"),
-    ("sparse.nn.functional", "sparse/nn/functional/__init__.py"),
-    ("text", "text/__init__.py"),
-]
+# single source of truth: the audit tool's table (tools/ops_audit.py) —
+# the test enforces exactly what OPS_AUDIT.md reports
+import sys as _sys  # noqa: E402
+from pathlib import Path as _Path  # noqa: E402
+
+_sys.path.insert(0, str(_Path(__file__).resolve().parent.parent))
+from tools.ops_audit import NAMESPACES, _all_names  # noqa: E402
 
 
 @pytest.mark.parametrize("ns,relpath", NAMESPACES,
                          ids=[n or "paddle" for n, _ in NAMESPACES])
 def test_namespace_complete(ns, relpath):
     """Every name in the reference namespace __all__ exists here."""
-    src = open(f"{REF}/{relpath}").read()
-    m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
-    if m is None:
+    from pathlib import Path
+    names = _all_names(Path(REF) / relpath)
+    if not names:
         pytest.skip("reference file has no __all__")
-    names = re.findall(r"'([^']+)'", m.group(1)) + \
-        re.findall(r'"([^"]+)"', m.group(1))
     obj = paddle
     for part in (ns.split(".") if ns else []):
         obj = getattr(obj, part)
