@@ -23,6 +23,7 @@
 #include <map>
 #include <mutex>
 #include <string>
+#include <algorithm>
 #include <thread>
 #include <vector>
 
@@ -102,8 +103,25 @@ class StoreServer {
       listen_fd_ = -1;
     }
     if (accept_thread_.joinable()) accept_thread_.join();
-    std::lock_guard<std::mutex> lk(conn_mu_);
-    for (auto& t : handlers_)
+    // handler threads block in recv()/cv-wait on live peer connections
+    // (other processes' clients); force them out so join cannot hang
+    {
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    // take mu_ once so a WAIT handler that read running_==true has
+    // entered its wait before the notify (otherwise the wakeup is lost
+    // and the join below blocks for the client's full wait timeout)
+    { std::lock_guard<std::mutex> lk(mu_); }
+    cv_.notify_all();
+    // join without holding conn_mu_ — exiting handlers take it to
+    // deregister their fd
+    std::vector<std::thread> hs;
+    {
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      hs.swap(handlers_);
+    }
+    for (auto& t : hs)
       if (t.joinable()) t.join();
   }
 
@@ -122,11 +140,20 @@ class StoreServer {
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       std::lock_guard<std::mutex> lk(conn_mu_);
+      conn_fds_.push_back(fd);
       handlers_.emplace_back([this, fd] { serve(fd); });
     }
   }
 
   void serve(int fd) {
+    serve_loop(fd);
+    ::close(fd);
+    std::lock_guard<std::mutex> lk(conn_mu_);
+    conn_fds_.erase(std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
+                    conn_fds_.end());
+  }
+
+  void serve_loop(int fd) {
     while (true) {
       uint8_t cmd;
       if (!recv_all(fd, &cmd, 1)) break;
@@ -135,7 +162,7 @@ class StoreServer {
       switch (static_cast<Command>(cmd)) {
         case Command::SET: {
           std::string value;
-          if (!recv_bytes(fd, &value)) { ::close(fd); return; }
+          if (!recv_bytes(fd, &value)) return;
           {
             std::lock_guard<std::mutex> lk(mu_);
             data_[key] = value;
@@ -157,7 +184,7 @@ class StoreServer {
         }
         case Command::ADD: {
           int64_t delta;
-          if (!recv_all(fd, &delta, 8)) { ::close(fd); return; }
+          if (!recv_all(fd, &delta, 8)) return;
           int64_t result;
           {
             std::lock_guard<std::mutex> lk(mu_);
@@ -174,11 +201,12 @@ class StoreServer {
         }
         case Command::WAIT: {
           int64_t timeout_ms;
-          if (!recv_all(fd, &timeout_ms, 8)) { ::close(fd); return; }
+          if (!recv_all(fd, &timeout_ms, 8)) return;
           std::unique_lock<std::mutex> lk(mu_);
-          bool ok = cv_.wait_for(
+          cv_.wait_for(
               lk, std::chrono::milliseconds(timeout_ms),
-              [&] { return data_.count(key) > 0; });
+              [&] { return data_.count(key) > 0 || !running_.load(); });
+          bool ok = data_.count(key) > 0;  // stop-wakeup is not success
           lk.unlock();
           uint8_t r = ok ? 1 : 0;
           send_all(fd, &r, 1);
@@ -202,7 +230,6 @@ class StoreServer {
         }
       }
     }
-    ::close(fd);
   }
 
   int port_;
@@ -210,6 +237,7 @@ class StoreServer {
   std::atomic<bool> running_{false};
   std::thread accept_thread_;
   std::mutex conn_mu_;
+  std::vector<int> conn_fds_;
   std::vector<std::thread> handlers_;
   std::mutex mu_;
   std::condition_variable cv_;
