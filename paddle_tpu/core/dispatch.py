@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+import numpy as np
 
 from . import dtype as dtype_mod
 from .autograd import Node, is_grad_enabled
@@ -21,6 +22,154 @@ from .tensor import Tensor
 
 def _differentiable(dt) -> bool:
     return dtype_mod.is_floating_point(dt) or dtype_mod.is_complex(dt)
+
+
+# ---------------------------------------------------------------------------
+# cached lazy backward (the dygraph hot path)
+#
+# jax.vjp at op-record time costs a full python linearize trace (~0.8ms/op),
+# 28x the no-grad dispatch — the reference avoids the analogue with
+# generated per-op GradNodes. Here: for cacheable op fns the forward runs
+# plainly (no trace) and the pullback is a jax.jit'd function built ONCE
+# per (fn, arg structure) that re-runs jax.vjp INSIDE jit at backward time
+# (jit's aval cache amortizes it; under the compiled TrainStep retrace XLA
+# CSEs the recomputed forward against the original, so no extra FLOPs).
+#
+# Cacheable = fn has no closure cells (excludes RNG-capturing closures like
+# dropout — recompute must be deterministic) and kwargs/static args hash.
+# ---------------------------------------------------------------------------
+
+_LAZY_BWD_CACHE: dict = {}
+_LAZY_BWD_CACHE_MAX = 2048
+_EAGER_ONLY = object()  # negative entry: op rejected from the lazy path
+
+
+def _freeze(v):
+    if isinstance(v, Tensor) or hasattr(v, "_data"):
+        # a Tensor in a static arg/kwarg would hash by identity and bake
+        # its current value into the cached jit — stale after rebind
+        raise TypeError("tensor in static op argument")
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(e) for e in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+class _LazyVjp:
+    """Pullback handle: defers tracing to the first backward, through a
+    per-structure jitted function."""
+
+    __slots__ = ("_bwd", "_arrs")
+
+    def __init__(self, bwd, arrs):
+        self._bwd = bwd
+        self._arrs = tuple(arrs)
+
+    def __call__(self, cts):
+        return self._bwd(self._arrs, tuple(cts))
+
+
+def _lazy_bwd_for(key, fn, n_payloads, diff_idx, arr_pos, statics,
+                  kwargs, was_tuple):
+    entry = _LAZY_BWD_CACHE.get(key)
+    if entry is not None and entry is not _EAGER_ONLY:
+        return entry
+    statics_d = dict(statics)
+    diff_idx = tuple(diff_idx)
+    arr_pos = tuple(arr_pos)
+
+    @jax.jit
+    def bwd(arrs, cts):
+        full = [None] * n_payloads
+        for pos, a in zip(arr_pos, arrs):
+            full[pos] = a
+        for pos, s in statics_d.items():
+            full[pos] = s
+
+        def pure(*diff_vals):
+            f2 = list(full)
+            for pos, v in zip(diff_idx, diff_vals):
+                f2[pos] = v
+            out = fn(*f2, **kwargs)
+            if was_tuple:
+                return tuple(out)
+            return (out,)
+
+        _, vjp_fn = jax.vjp(pure, *[full[i] for i in diff_idx])
+        return vjp_fn(cts)
+
+    if len(_LAZY_BWD_CACHE) >= _LAZY_BWD_CACHE_MAX:
+        _LAZY_BWD_CACHE.pop(next(iter(_LAZY_BWD_CACHE)))
+    _LAZY_BWD_CACHE[key] = bwd
+    return bwd
+
+
+def _fn_key(fn):
+    """Identity of fn's BEHAVIOR, not its object: per-call lambdas (the
+    dominant op-wrapper pattern) share their code object, so keying on
+    (code, defaults, closure cell values) makes them cache-hit. Closure
+    cells holding arrays (e.g. dropout's RNG key) are unhashable and
+    reject the op to the eager-vjp path — exactly the impure cases where
+    backward recompute would be wrong."""
+    if getattr(fn, "__self__", None) is not None:
+        # bound methods: per-instance state isn't visible in
+        # code/defaults/closure — don't risk cross-instance reuse
+        raise TypeError("bound method")
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return fn  # builtin / PjitFunction / ufunc: stable identity
+    cells = getattr(fn, "__closure__", None) or ()
+    vals = []
+    for c in cells:
+        v = c.cell_contents
+        if isinstance(v, Tensor) or hasattr(v, "_data"):
+            # a captured Tensor could be rebound between calls — its
+            # value would be baked stale into the cached jit
+            raise TypeError("tensor closure")
+        if callable(v) and getattr(v, "__code__", None) is not None:
+            # per-call inner lambdas (e.g. an activation built each
+            # forward) share code — recurse instead of id-hashing, or
+            # every call would be a fresh cache entry + XLA compile
+            vals.append(_fn_key(v))
+        else:
+            vals.append(v)
+    return (code, fn.__defaults__, tuple(vals))
+
+
+def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf):
+    """Fast diff path: plain eager forward + cached lazy pullback.
+    Returns wrapped outputs, or None when the op is not cacheable."""
+    arr_pos, arrs, statics = [], [], []
+    for i, p in enumerate(payloads):
+        if isinstance(p, (jax.Array, np.ndarray)):
+            arr_pos.append(i)
+            arrs.append(p)
+        else:
+            statics.append((i, p))
+    try:
+        key = (_fn_key(fn), tuple(diff_idx), tuple(arr_pos),
+               _freeze(tuple(statics)), _freeze(kwargs))
+        hash(key)
+    except (TypeError, ValueError):
+        return None
+    if _LAZY_BWD_CACHE.get(key) is _EAGER_ONLY:
+        return None  # known non-diff-output op: skip the probe forward
+
+    out = fn(*payloads, **kwargs)
+    was_tuple = isinstance(out, (tuple, list))
+    out_tuple = tuple(out) if was_tuple else (out,)
+    # float0 cotangents (non-float outputs) don't pass through jit args;
+    # keep those ops on the eager-vjp path (memoized so later calls don't
+    # pay a doubled forward)
+    if not all(hasattr(o, "dtype") and _differentiable(o.dtype)
+               for o in out_tuple):
+        _LAZY_BWD_CACHE[key] = _EAGER_ONLY
+        return None
+    _post_op_hooks(name, out_tuple, check_naninf)
+    bwd = _lazy_bwd_for(key, fn, len(payloads), diff_idx, arr_pos,
+                        statics, kwargs, was_tuple)
+    return out_tuple, _LazyVjp(bwd, arrs), was_tuple
 
 
 def apply(fn: Callable, *args, name: str = None, **kwargs):
@@ -59,21 +208,27 @@ def apply(fn: Callable, *args, name: str = None, **kwargs):
             return [Tensor(o) for o in out]
         return Tensor(out)
 
-    diff_args = [payloads[i] for i in diff_idx]
-    was_tuple = [False]
+    lazy = _try_lazy_apply(fn, payloads, diff_idx, kwargs, name,
+                           check_naninf)
+    if lazy is not None:
+        out_tuple, vjp_fn, was_tuple_v = lazy
+        was_tuple = [was_tuple_v]
+    else:
+        diff_args = [payloads[i] for i in diff_idx]
+        was_tuple = [False]
 
-    def pure(*diff_vals):
-        full = list(payloads)
-        for pos, v in zip(diff_idx, diff_vals):
-            full[pos] = v
-        out = fn(*full, **kwargs)
-        if isinstance(out, (tuple, list)):
-            was_tuple[0] = True
-            return tuple(out)
-        return (out,)
+        def pure(*diff_vals):
+            full = list(payloads)
+            for pos, v in zip(diff_idx, diff_vals):
+                full[pos] = v
+            out = fn(*full, **kwargs)
+            if isinstance(out, (tuple, list)):
+                was_tuple[0] = True
+                return tuple(out)
+            return (out,)
 
-    out_tuple, vjp_fn = jax.vjp(pure, *diff_args)
-    _post_op_hooks(name, out_tuple, check_naninf)
+        out_tuple, vjp_fn = jax.vjp(pure, *diff_args)
+        _post_op_hooks(name, out_tuple, check_naninf)
     out_meta = [(o.shape, o.dtype) for o in out_tuple]
     node = Node(vjp_fn, [args[i] for i in diff_idx], out_meta, name=name)
 

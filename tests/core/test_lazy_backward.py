@@ -1,0 +1,162 @@
+"""The cached lazy-backward dispatch path (core/dispatch._try_lazy_apply).
+
+Eager ops with grad recording defer pullback tracing to backward time
+through a per-structure jitted function. These tests pin the semantics
+that must not drift from the eager-vjp path.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core import dispatch
+
+
+def test_lazy_path_taken_for_plain_binop():
+    dispatch._LAZY_BWD_CACHE.clear()
+    x = paddle.to_tensor(np.ones((4, 4), np.float32), stop_gradient=False)
+    y = paddle.to_tensor(np.full((4, 4), 2.0, np.float32))
+    z = x * y
+    assert isinstance(z._node.vjp_fn, dispatch._LazyVjp)
+    assert len(dispatch._LAZY_BWD_CACHE) == 1
+    z2 = x * y  # same structure -> cache hit
+    assert len(dispatch._LAZY_BWD_CACHE) == 1
+    paddle.sum(z).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2.0 * np.ones((4, 4)))
+
+
+def test_closure_ops_fall_back_to_eager_vjp():
+    """Dropout's fn captures the RNG key in a closure; it must NOT take
+    the recompute path (a recomputed mask would differ)."""
+    paddle.seed(7)
+    x = paddle.to_tensor(np.ones((64, 64), np.float32),
+                         stop_gradient=False)
+    out = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    assert not isinstance(out._node.vjp_fn, dispatch._LazyVjp)
+    paddle.sum(out).backward()
+    g = x.grad.numpy()
+    o = out.numpy()
+    # grad of upscale_in_train dropout is the same mask/scale as forward
+    np.testing.assert_allclose(g, (o != 0) * 2.0)
+
+
+def test_retain_graph_double_backward_through_lazy_node():
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = x * x
+    loss = paddle.sum(y)
+    loss.backward(retain_graph=True)
+    g1 = x.grad.numpy().copy()
+    x.clear_grad()
+    loss.backward()
+    np.testing.assert_allclose(g1, x.grad.numpy())
+    np.testing.assert_allclose(g1, [6.0])
+
+
+def test_inplace_rebind_after_record_uses_recorded_values():
+    """Backward must see the values at record time, matching residual
+    semantics of the eager-vjp path."""
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    w = paddle.to_tensor(np.array([5.0], np.float32))
+    z = x * w                       # dz/dx should be 5
+    w.set_value(paddle.to_tensor(np.array([100.0], np.float32)))
+    paddle.sum(z).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_kwargs_and_static_args_key_the_cache():
+    dispatch._LAZY_BWD_CACHE.clear()
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (3, 4)).astype("float32"), stop_gradient=False)
+    a = paddle.sum(x, axis=0)
+    b = paddle.sum(x, axis=1)
+    assert a.shape == [4] and b.shape == [3]
+    loss = paddle.sum(a) + 2.0 * paddle.sum(b)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3.0 * np.ones((3, 4)),
+                               atol=1e-6)
+
+
+def test_tuple_output_op_through_lazy_path():
+    x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+        (6,)).astype("float32"), stop_gradient=False)
+    top, idx = paddle.topk(x, k=2)
+    paddle.sum(top * top).backward()
+    g = x.grad.numpy()
+    xv = x.numpy()
+    order = np.argsort(-xv)[:2]
+    expect = np.zeros(6, np.float32)
+    expect[order] = 2 * xv[order]
+    np.testing.assert_allclose(g, expect, atol=1e-6)
+
+
+def test_lazy_cache_is_bounded():
+    assert len(dispatch._LAZY_BWD_CACHE) <= dispatch._LAZY_BWD_CACHE_MAX
+
+
+def test_per_call_lambdas_share_cache_entries():
+    """Regression: nn.functional.linear builds a fresh lambda per call;
+    keying on the code object (not fn identity) must make a train loop
+    reuse entries instead of compiling every step."""
+    dispatch._LAZY_BWD_CACHE.clear()
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 8), paddle.nn.GELU(),
+                               paddle.nn.Linear(8, 8))
+    opt = paddle.optimizer.SGD(learning_rate=1e-3,
+                               parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    for _ in range(3):
+        loss = net(x).square().mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    n = len(dispatch._LAZY_BWD_CACHE)
+    for _ in range(5):
+        loss = net(x).square().mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert len(dispatch._LAZY_BWD_CACHE) == n, "cache churn per step"
+
+
+def test_inner_lambda_closures_share_cache():
+    """Regression: an op fn capturing a per-call inner lambda (e.g. an
+    activation rebuilt each forward) must key by code, not identity."""
+    dispatch._LAZY_BWD_CACHE.clear()
+    cell = paddle.nn.SimpleRNNCell(8, 8, activation="relu")
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    h = paddle.to_tensor(np.zeros((2, 8), np.float32))
+    for _ in range(3):
+        out, _ = cell(x, h)
+        paddle.sum(out).backward()
+        cell.clear_gradients() if hasattr(cell, "clear_gradients") else None
+    n = len(dispatch._LAZY_BWD_CACHE)
+    for _ in range(4):
+        out, _ = cell(x, h)
+        paddle.sum(out).backward()
+    assert len(dispatch._LAZY_BWD_CACHE) == n, "cache churn per call"
+
+
+def test_nondiff_output_op_memoized_to_eager():
+    """argmax-style ops are rejected once, then skip the probe forward."""
+    dispatch._LAZY_BWD_CACHE.clear()
+    x = paddle.to_tensor(np.random.default_rng(2).standard_normal(
+        (5,)).astype("float32"), stop_gradient=False)
+    paddle.argmax(x)
+    n_neg = sum(1 for v in dispatch._LAZY_BWD_CACHE.values()
+                if v is dispatch._EAGER_ONLY)
+    assert n_neg >= 1
+    paddle.argmax(x)  # second call: negative entry reused, no new keys
+    assert sum(1 for v in dispatch._LAZY_BWD_CACHE.values()
+               if v is dispatch._EAGER_ONLY) == n_neg
+
+
+def test_tensor_capturing_closure_excluded():
+    """A fn closing over a Tensor must not be cached (rebind would bake
+    stale values into the jit)."""
+    from paddle_tpu.core.dispatch import apply
+
+    w = paddle.to_tensor(np.array([5.0], np.float32))
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    out = apply(lambda a: a * w._data, x, name="cap")
+
+    assert not isinstance(out._node.vjp_fn, dispatch._LazyVjp)
+    paddle.sum(out).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
