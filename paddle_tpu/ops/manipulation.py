@@ -317,10 +317,15 @@ def index_put(x, indices, value, accumulate=False, name=None):
 
 def masked_select(x, mask, name=None):
     # Dynamic output shape: eager-only (documented; same restriction the
-    # reference has under CINN/static shape inference).
+    # reference has under CINN/static shape inference). The selection
+    # indices are computed host-side from the concrete mask; the gather
+    # itself is a recorded op so gradients scatter back into x.
     a = unwrap(x)
-    m = np.asarray(unwrap(mask))
-    return Tensor(jnp.asarray(np.asarray(a)[m]))
+    m = np.asarray(jax.device_get(unwrap(mask))).astype(bool)
+    m = np.broadcast_to(m, a.shape)
+    flat_idx = jnp.asarray(np.nonzero(m.reshape(-1))[0], jnp.int32)
+    return apply(lambda v: jnp.take(v.reshape(-1), flat_idx), x,
+                 name="masked_select")
 
 
 def masked_fill(x, mask, value, name=None):

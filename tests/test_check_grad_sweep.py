@@ -120,3 +120,97 @@ def test_norm_layer_grads():
     w, b = _pos(6), _any(6)
     check_grad(lambda x, w, b: F.layer_norm(x, [6], w, b), [x, w, b],
                atol=2e-2, rtol=2e-2)
+
+
+def test_loss_grads():
+    F = paddle.nn.functional
+    logits = _any(4, 5)
+    labels = np.random.default_rng(3).integers(0, 5, (4,))
+    check_grad(lambda x: F.cross_entropy(
+        x, paddle.to_tensor(labels.astype("int64"))), [logits])
+    # targets use a different seed than inputs — at x == t these losses
+    # sit on non-differentiable points and the FD check degenerates
+    t = np.random.default_rng(9).standard_normal((4, 5)).astype("float32")
+    check_grad(lambda x: F.mse_loss(x, paddle.to_tensor(t)),
+               [_any(4, 5)])
+    check_grad(lambda x: F.l1_loss(x, paddle.to_tensor(t)),
+               [_any(4, 5)], atol=2e-2, rtol=2e-2)
+    check_grad(lambda x: F.smooth_l1_loss(
+        x, paddle.to_tensor(t)), [_any(4, 5)])
+    check_grad(lambda x: F.kl_div(
+        paddle.log(paddle.nn.functional.softmax(x, axis=-1)),
+        paddle.nn.functional.softmax(paddle.to_tensor(t), axis=-1)),
+        [_any(4, 5)])
+    check_grad(lambda x: F.binary_cross_entropy_with_logits(
+        x, paddle.to_tensor((_pos(4, 5) > 1.0).astype("float32"))),
+        [_any(4, 5)])
+    check_grad(lambda x: F.nll_loss(
+        F.log_softmax(x, axis=-1),
+        paddle.to_tensor(labels.astype("int64"))), [logits])
+
+
+def test_conv_pool_grads():
+    F = paddle.nn.functional
+    x = _any(1, 2, 8, 8)   # NCHW
+    w = _any(3, 2, 3, 3) * 0.2
+    check_grad(lambda x, w: F.conv2d(x, w, padding=1), [x, w],
+               atol=2e-2, rtol=2e-2)
+    check_grad(lambda x: F.max_pool2d(x, 2, 2), [x],
+               atol=2e-2, rtol=2e-2)
+    check_grad(lambda x: F.avg_pool2d(x, 2, 2), [x],
+               atol=2e-2, rtol=2e-2)
+    check_grad(lambda x: F.adaptive_avg_pool2d(x, 2), [x],
+               atol=2e-2, rtol=2e-2)
+    check_grad(lambda x, w: F.conv1d(x, w, padding=1),
+               [_any(1, 2, 9), _any(3, 2, 3) * 0.2], atol=2e-2, rtol=2e-2)
+
+
+def test_index_gather_grads():
+    idx = paddle.to_tensor(np.array([2, 0, 1], "int64"))
+    check_grad(lambda x: paddle.gather(x, idx, axis=0), [_any(4, 3)])
+    check_grad(lambda x: paddle.index_select(x, idx, axis=1),
+               [_any(2, 4)])
+    check_grad(lambda x: paddle.take_along_axis(
+        x, paddle.to_tensor(np.array([[0], [1], [2]], "int64")), 1),
+        [_any(3, 4)])
+    check_grad(lambda x: paddle.masked_select(
+        x, paddle.to_tensor(np.array([[True, False, True, True]] * 3))),
+        [_any(3, 4)])
+    check_grad(lambda x: x[1:, ::2], [_any(4, 6)])
+
+
+def test_cumulative_grads():
+    check_grad(lambda x: paddle.cumsum(x, axis=0), [_any(3, 4)])
+    check_grad(lambda x: paddle.cumprod(x, dim=1), [_pos(3, 4)],
+               atol=2e-2, rtol=2e-2)
+    check_grad(lambda x: paddle.logcumsumexp(x, axis=1), [_any(3, 4)],
+               atol=2e-2, rtol=2e-2)
+    check_grad(paddle.trace, [_any(4, 4)])
+    check_grad(lambda x: paddle.diff(x, axis=0), [_any(4, 3)])
+
+
+def test_linalg_grads():
+    spd = _any(4, 4) * 0.3
+    spd = spd @ spd.T + 3.0 * np.eye(4, dtype=np.float32)
+    check_grad(paddle.linalg.inv, [spd], atol=2e-2, rtol=2e-2)
+    check_grad(lambda a: paddle.linalg.solve(
+        a, paddle.to_tensor(_any(4, 2))), [spd], atol=2e-2, rtol=2e-2)
+    check_grad(paddle.linalg.det, [spd], atol=3e-2, rtol=3e-2)
+    check_grad(lambda a: paddle.linalg.slogdet(a)[1], [spd],
+               atol=2e-2, rtol=2e-2)
+    check_grad(paddle.linalg.cholesky, [spd], atol=2e-2, rtol=2e-2)
+    check_grad(lambda a: paddle.linalg.triangular_solve(
+        paddle.tril(a) + 2.0 * paddle.eye(4),
+        paddle.to_tensor(_any(4, 2)), upper=False),
+        [spd], atol=2e-2, rtol=2e-2)
+
+
+def test_where_clip_sort_grads():
+    cond = paddle.to_tensor(np.array([[True, False, True, False]] * 3))
+    check_grad(lambda x, y: paddle.where(cond, x, y),
+               [_any(3, 4), _any(3, 4)])
+    check_grad(lambda x: paddle.clip(x, -0.5, 0.5), [_any(3, 4)])
+    check_grad(lambda x: paddle.sort(x, axis=1), [_any(3, 4)])
+    check_grad(lambda x: paddle.kthvalue(x, 2, axis=1)[0], [_any(3, 4)])
+    check_grad(lambda x: paddle.lerp(
+        x, paddle.to_tensor(_any(3, 4)), 0.3), [_any(3, 4)])
