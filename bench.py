@@ -61,6 +61,20 @@ def bench_gpt_train(config, batch, seq, steps, tag):
     on_tpu = jax.default_backend() != "cpu"
     if on_tpu:
         model.to(dtype="bfloat16")  # params bf16; AdamW keeps fp32 masters
+        # pre-tune flash block sizes eagerly for this model's attention
+        # shape: the jitted train step then picks the tuned entry from
+        # the autotune cache (incubate.autotune + kernels/pallas sweep)
+        try:
+            paddle.incubate.autotune.set_config({"kernel": {"enable": True}})
+            from paddle_tpu.nn import functional as F
+            h, hd = config.num_heads, config.hidden_size // config.num_heads
+            qkv = [paddle.to_tensor(np.random.default_rng(1).standard_normal(
+                (batch, seq, h, hd)).astype(np.float32)).astype("bfloat16")
+                for _ in range(3)]
+            with paddle.no_grad():
+                F.scaled_dot_product_attention(*qkv, is_causal=True)
+        except Exception as e:  # pragma: no cover — never fail the bench
+            print(f"flash pre-tune skipped: {e}", file=__import__("sys").stderr)
     opt = optimizer.AdamW(learning_rate=3e-4,
                           parameters=model.parameters(),
                           grad_clip=nn.ClipGradByGlobalNorm(1.0))
