@@ -89,13 +89,16 @@ def _sweep_blocks(q, k, v, causal, scale, sq, sk, group):
             try:
                 out = flash_attention(q, k, v, causal=causal, scale=scale,
                                       block_q=bq, block_k=bk)
-                out.block_until_ready()
+                # host fetch, not block_until_ready: on remote-relay
+                # backends the latter can return before execution
+                # finishes, making every candidate time the same
+                np.asarray(jax.device_get(out[0, 0, 0]))
                 t0 = _time.perf_counter()
                 for _ in range(3):
                     out = flash_attention(q, k, v, causal=causal,
                                           scale=scale, block_q=bq,
                                           block_k=bk)
-                out.block_until_ready()
+                np.asarray(jax.device_get(out[0, 0, 0]))
                 dt = _time.perf_counter() - t0
             except Exception:  # noqa: BLE001 — e.g. VMEM overflow
                 continue
