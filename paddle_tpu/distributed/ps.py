@@ -1,134 +1,275 @@
-"""Parameter-server training (workflow parity).
+"""Parameter-server training.
 
 Parity target: reference `paddle/fluid/distributed/ps/` + python
 `distributed/ps/` + `fleet/runtime/the_one_ps.py` — brpc dense/sparse
-tables with async push/pull for CPU-cluster recommendation workloads.
+tables with pluggable accessors (server-side optimizer rules) and async
+push/pull for CPU-cluster recommendation workloads.
 
 TPU scope note: PS-style async training targets CPU parameter clusters;
 on a TPU pod the same models train synchronously with mesh-sharded
-embeddings. This module keeps the WORKFLOW (server hosting dense/sparse
-tables, workers pulling params and pushing grads, async SGD apply) over
-the native TCPStore transport so reference PS call sites have a
-functional home.
+embeddings. This module keeps the WORKFLOW: a server process hosting
+dense/sparse tables with SGD/Adagrad/Adam accessors (reference
+ps/table/ sparse_sgd_rule.h family), workers pulling params and pushing
+grads sync or async. Transport: in-process direct calls (tests/single
+host) or `paddle_tpu.distributed.rpc` (the brpc service analogue) for
+real multi-process clusters.
 """
 
 from __future__ import annotations
-
-import pickle
 
 import numpy as np
 
 from .store import TCPStore
 
-__all__ = ["PSServer", "PSWorker", "DenseTable", "SparseTable"]
+__all__ = ["PSServer", "PSWorker", "DenseTable", "SparseTable",
+           "SGDRule", "AdagradRule", "AdamRule"]
+
+
+# ---------------------------------------------------------------------------
+# accessors (reference paddle/fluid/distributed/ps/table/sparse_sgd_rule.h:
+# naive/adagrad/adam rules applied ON THE SERVER per push)
+# ---------------------------------------------------------------------------
+
+class SGDRule:
+    def __init__(self, lr=0.01):
+        self.lr = lr
+
+    def init_state(self, shape):
+        return {}
+
+    def apply(self, value, grad, state):
+        return value - self.lr * grad
+
+
+class AdagradRule:
+    def __init__(self, lr=0.01, epsilon=1e-8):
+        self.lr = lr
+        self.epsilon = epsilon
+
+    def init_state(self, shape):
+        return {"g2": np.zeros(shape, np.float32)}
+
+    def apply(self, value, grad, state):
+        state["g2"] += grad * grad
+        return value - self.lr * grad / (np.sqrt(state["g2"]) +
+                                         self.epsilon)
+
+
+class AdamRule:
+    def __init__(self, lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.lr = lr
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def init_state(self, shape):
+        return {"m": np.zeros(shape, np.float32),
+                "v": np.zeros(shape, np.float32), "t": 0}
+
+    def apply(self, value, grad, state):
+        state["t"] += 1
+        state["m"] = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        state["v"] = self.beta2 * state["v"] + \
+            (1 - self.beta2) * grad * grad
+        mhat = state["m"] / (1 - self.beta1 ** state["t"])
+        vhat = state["v"] / (1 - self.beta2 ** state["t"])
+        return value - self.lr * mhat / (np.sqrt(vhat) + self.epsilon)
+
+
+def _make_rule(accessor, lr):
+    if not isinstance(accessor, str):
+        return accessor
+    return {"sgd": SGDRule, "adagrad": AdagradRule,
+            "adam": AdamRule}[accessor](lr)
 
 
 class DenseTable:
-    def __init__(self, name, shape, lr=0.01):
+    def __init__(self, name, shape, lr=0.01, accessor="sgd"):
         self.name = name
         self.value = np.zeros(shape, np.float32)
-        self.lr = lr
+        self.rule = _make_rule(accessor, lr)
+        self.state = self.rule.init_state(shape)
 
     def pull(self):
         return self.value
 
     def push_grad(self, grad):
-        self.value = self.value - self.lr * grad
+        self.value = self.rule.apply(self.value, grad, self.state)
 
 
 class SparseTable:
     """Row-sparse embedding table (reference ps/table/ sparse tables):
-    rows materialize on first access (the reference's lazy init)."""
+    rows materialize on first access (the reference's lazy init); each
+    row carries its own accessor state."""
 
-    def __init__(self, name, dim, lr=0.01, initializer=None):
+    def __init__(self, name, dim, lr=0.01, initializer=None,
+                 accessor="sgd"):
         self.name = name
         self.dim = dim
-        self.lr = lr
+        self.rule = _make_rule(accessor, lr)
         self.rows: dict[int, np.ndarray] = {}
+        self.states: dict[int, dict] = {}
         self.initializer = initializer or (
             lambda: np.random.uniform(-0.01, 0.01, dim).astype(np.float32))
 
+    def _row(self, i):
+        i = int(i)
+        if i not in self.rows:
+            self.rows[i] = self.initializer()
+            self.states[i] = self.rule.init_state((self.dim,))
+        return self.rows[i]
+
     def pull(self, ids):
-        return np.stack([
-            self.rows.setdefault(int(i), self.initializer()) for i in ids])
+        return np.stack([self._row(i) for i in ids])
 
     def push_grad(self, ids, grads):
         for i, g in zip(ids, grads):
             i = int(i)
-            row = self.rows.setdefault(i, self.initializer())
-            self.rows[i] = row - self.lr * g
+            row = self._row(i)
+            self.rows[i] = self.rule.apply(row, g, self.states[i])
+
+
+# the server process's live instance, addressed by remote workers
+# through module-level functions (picklable by reference)
+_SERVER: "PSServer | None" = None
+
+
+def _serve(msg):
+    if _SERVER is None:
+        raise RuntimeError("no PSServer running in this process")
+    return _SERVER._handle(msg)
 
 
 class PSServer:
-    """Hosts tables; serves pull/push via the TCPStore KV (each request is
-    a serialized message under a sequenced key — the brpc service
-    analogue, minus brpc)."""
+    """Hosts tables. Two service modes:
 
-    def __init__(self, host="127.0.0.1", port=0):
-        self.store = TCPStore(host, port, is_master=True)
-        self.port = self.store.port
+    - in-process (tests / single host): workers call _handle directly;
+    - cross-process: `serve_rpc(name, ...)` joins the rpc world and
+      workers address the tables with rpc_sync/rpc_async (the brpc
+      service analogue).
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, use_store=True):
+        import threading
+        self.store = TCPStore(host, port, is_master=True) if use_store \
+            else None
+        self.port = self.store.port if self.store else None
         self.tables: dict[str, object] = {}
+        # rpc serves requests from a thread pool; table updates are
+        # read-modify-write — serialize them (the reference shards by
+        # key across brpc threads; one coarse lock is the honest
+        # single-host equivalent)
+        self._mu = threading.Lock()
 
-    def add_dense_table(self, name, shape, lr=0.01):
-        self.tables[name] = DenseTable(name, shape, lr)
+    def add_dense_table(self, name, shape, lr=0.01, accessor="sgd"):
+        self.tables[name] = DenseTable(name, shape, lr, accessor)
 
-    def add_sparse_table(self, name, dim, lr=0.01):
-        self.tables[name] = SparseTable(name, dim, lr)
+    def add_sparse_table(self, name, dim, lr=0.01, accessor="sgd"):
+        self.tables[name] = SparseTable(name, dim, lr, accessor=accessor)
 
-    def handle_once(self, req_key):
-        """Process one serialized request (in-process server loop body)."""
-        req = pickle.loads(self.store.get(req_key))
+    def _handle(self, req):
         table = self.tables[req["table"]]
         kind = req["op"]
-        if kind == "pull_dense":
-            resp = table.pull()
-        elif kind == "push_dense":
-            table.push_grad(req["grad"])
-            resp = b"ok"
-        elif kind == "pull_sparse":
-            resp = table.pull(req["ids"])
-        elif kind == "push_sparse":
-            table.push_grad(req["ids"], req["grads"])
-            resp = b"ok"
-        else:
-            raise ValueError(kind)
+        with self._mu:
+            if kind == "pull_dense":
+                return table.pull().copy()
+            if kind == "push_dense":
+                table.push_grad(req["grad"])
+                return b"ok"
+            if kind == "pull_sparse":
+                return table.pull(req["ids"])
+            if kind == "push_sparse":
+                table.push_grad(req["ids"], req["grads"])
+                return b"ok"
+        raise ValueError(kind)
+
+    # -- cross-process service over distributed.rpc -------------------
+    def serve_rpc(self, name="ps0", rank=None, world_size=None,
+                  master_endpoint=None):
+        """Join the rpc world as ``name`` and expose the tables; returns
+        after rendezvous (requests are served by the rpc agent's
+        threads). Call `paddle_tpu.distributed.rpc.shutdown()` to stop.
+        """
+        global _SERVER
+        from . import rpc
+        _SERVER = self
+        rpc.init_rpc(name, rank=rank, world_size=world_size,
+                     master_endpoint=master_endpoint)
+
+    # legacy store-keyed request path (kept for API compat)
+    def handle_once(self, req_key):
+        import pickle
+        if self.store is None:
+            raise RuntimeError(
+                "handle_once needs the TCPStore transport; this server "
+                "was built with use_store=False (rpc mode)")
+        req = pickle.loads(self.store.get(req_key))
+        resp = self._handle(req)
         self.store.set(req_key + "/resp", pickle.dumps(resp))
 
 
 class PSWorker:
-    def __init__(self, server: PSServer = None, host=None, port=None):
-        # in-process mode (tests / single host): direct server reference
+    """Pull/push client. Modes: direct (in-process `server=`), or rpc
+    (`ps_name=` after the worker's own `rpc.init_rpc`)."""
+
+    def __init__(self, server: PSServer = None, host=None, port=None,
+                 ps_name=None):
         self.server = server
+        self.ps_name = ps_name
         self._seq = 0
-        if server is None:
+        if server is None and ps_name is None:
             self.store = TCPStore(host, port, is_master=False)
-        else:
+        elif server is not None:
             self.store = server.store
 
     def _rpc(self, msg):
+        if self.server is not None:
+            return self.server._handle(msg)
+        if self.ps_name is not None:
+            from . import rpc
+            return rpc.rpc_sync(self.ps_name, _serve, args=(msg,))
+        import pickle
         self._seq += 1
         key = f"psreq/{id(self)}/{self._seq}"
         self.store.set(key, pickle.dumps(msg))
-        if self.server is not None:
-            self.server.handle_once(key)
         self.store.wait([key + "/resp"], timeout=30)
         resp = pickle.loads(self.store.get(key + "/resp"))
         self.store.delete_key(key)
         self.store.delete_key(key + "/resp")
         return resp
 
+    def _rpc_async(self, msg):
+        """Async push (reference async/geo-SGD mode): returns a future.
+        In direct in-process mode the push is applied immediately and a
+        completed future is returned (same contract, no thread)."""
+        from . import rpc
+        if self.ps_name is not None:
+            return rpc.rpc_async(self.ps_name, _serve, args=(msg,))
+        result = self._rpc(msg)
+
+        class _Done:
+            def wait(self, timeout=None):
+                return result
+
+            def done(self):
+                return True
+
+        return _Done()
+
     def pull_dense(self, table):
         return self._rpc({"op": "pull_dense", "table": table})
 
-    def push_dense_grad(self, table, grad):
-        return self._rpc({"op": "push_dense", "table": table,
-                          "grad": np.asarray(grad, np.float32)})
+    def push_dense_grad(self, table, grad, sync=True):
+        msg = {"op": "push_dense", "table": table,
+               "grad": np.asarray(grad, np.float32)}
+        return self._rpc(msg) if sync else self._rpc_async(msg)
 
     def pull_sparse(self, table, ids):
         return self._rpc({"op": "pull_sparse", "table": table,
                           "ids": list(map(int, ids))})
 
-    def push_sparse_grad(self, table, ids, grads):
-        return self._rpc({"op": "push_sparse", "table": table,
-                          "ids": list(map(int, ids)),
-                          "grads": np.asarray(grads, np.float32)})
+    def push_sparse_grad(self, table, ids, grads, sync=True):
+        msg = {"op": "push_sparse", "table": table,
+               "ids": list(map(int, ids)),
+               "grads": np.asarray(grads, np.float32)}
+        return self._rpc(msg) if sync else self._rpc_async(msg)

@@ -1,0 +1,56 @@
+"""Cross-process PS E2E: rank 0 = server hosting tables over rpc,
+ranks 1..2 = workers training a shared embedding (reference PS async
+workflow, test_dist_base-style subprocess cluster)."""
+
+import os
+import sys
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.distributed.ps import PSServer, PSWorker
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    out = sys.argv[1]
+
+    if rank == 0:
+        server = PSServer(use_store=False)
+        server.add_dense_table("w", (4,), lr=0.1, accessor="sgd")
+        server.add_sparse_table("emb", 3, lr=0.5, accessor="adagrad")
+        server.serve_rpc("ps0")          # blocks until rendezvous
+        rpc.shutdown()                   # barrier: workers done
+        # after shutdown barrier, check the tables absorbed pushes
+        assert server.tables["w"].value.sum() != 0.0
+        assert len(server.tables["emb"].rows) >= 2
+        with open(os.path.join(out, "ps_ok.server"), "w") as f:
+            f.write("ok")
+        return
+
+    rpc.init_rpc(f"trainer{rank}")
+    w = PSWorker(ps_name="ps0")
+    # dense: pull, push grad, pull again -> value moved by -lr*grad
+    v0 = w.pull_dense("w")
+    w.push_dense_grad("w", np.ones(4, np.float32))
+    # async push (future) then sync barrier via a pull
+    fut = w.push_dense_grad("w", np.ones(4, np.float32), sync=False)
+    fut.wait(30)
+    v1 = w.pull_dense("w")
+    assert v1.sum() < v0.sum()
+    # sparse: each worker trains its own rows + one shared row
+    ids = [rank, 100]
+    e0 = w.pull_sparse("emb", ids)
+    w.push_sparse_grad("emb", ids, np.ones((2, 3), np.float32))
+    e1 = w.pull_sparse("emb", ids)
+    assert (e1 <= e0 + 1e-6).all()
+    rpc.shutdown()
+    with open(os.path.join(out, f"ps_ok.{rank}"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main()
