@@ -120,4 +120,197 @@ def barrier_worker():
     _dist.barrier()
 
 
-utils = None
+# ---------------------------------------------------------------------------
+# role makers (reference fleet/base/role_maker.py: PaddleCloudRoleMaker
+# env parsing :542, UserDefinedRoleMaker) and the Fleet object facade
+# ---------------------------------------------------------------------------
+
+class Role:
+    """Reference fleet/base/role_maker.py Role enum."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Role from the PADDLE_* env contract (reference role_maker.py:542:
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / TRAINING_ROLE)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        import os
+        self._is_collective = is_collective
+        self._role = Role.SERVER if os.environ.get(
+            "TRAINING_ROLE", "TRAINER").upper() == "PSERVER" else \
+            Role.WORKER
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    def _worker_index(self):
+        return self._rank
+
+    def _worker_num(self):
+        return self._size
+
+    def _role_id(self):
+        return self._rank
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit role assignment (reference role_maker.py
+    UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=True, current_id=0, role=Role.WORKER,
+                 worker_num=1, server_endpoints=None, **kwargs):
+        self._is_collective = is_collective
+        self._role = role
+        self._rank = current_id
+        self._size = worker_num
+        self._server_endpoints = server_endpoints or []
+
+
+class UtilBase:
+    """Cross-worker utilities (reference fleet/base/util_factory.py):
+    barrier / all_gather over the collective backend."""
+
+    def barrier(self, comm_world="worker"):
+        _dist.barrier()
+
+    def all_gather(self, obj, comm_world="worker"):
+        out = []
+        _dist.all_gather_object(out, obj)
+        return out
+
+    def get_file_shard(self, files):
+        """Contiguous-block file split in the user-given order (reference
+        util_factory.get_file_shard:231: [a,b,c,d,e] over 2 trainers ->
+        [a,b,c] and [d,e]). Worker identity follows the PADDLE_TRAINER_*
+        env contract, falling back to the collective world."""
+        import os
+        size = max(int(os.environ.get(
+            "PADDLE_TRAINERS_NUM", max(_dist.get_world_size(), 1))), 1)
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", _dist.get_rank()))
+        if rank >= size:
+            return []
+        base, rem = divmod(len(files), size)
+        start = rank * base + min(rank, rem)
+        return list(files[start:start + base + (1 if rank < rem else 0)])
+
+
+class MultiSlotDataGenerator:
+    """PS data-generator protocol (reference
+    distributed/fleet/data_generator/data_generator.py MultiSlot
+    variants): subclass overrides generate_sample; run_from_stdin /
+    run_from_memory emit the MultiSlotDataFeed wire format — per slot
+    `N v1 v2 ...`, slots space-joined (e.g.
+    [("words", [1926, 8]), ("label", [1])] -> "2 1926 8 1 1")."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclass MultiSlotDataGenerator and implement "
+            "generate_sample(line) returning a callable (or iterator) "
+            "that yields [(slot_name, [values...]), ...] samples")
+
+    def _gen_str(self, sample):
+        if isinstance(sample, zip):
+            sample = list(sample)
+        if not isinstance(sample, (list, tuple)):
+            raise ValueError(
+                "the output of generate_sample() must yield list/tuple "
+                "samples, e.g. [('words', ['1926', '08']), "
+                "('label', ['1'])]")
+        parts = []
+        for _name, elements in sample:
+            vals = elements if isinstance(elements, (list, tuple)) else \
+                [elements]
+            parts.append(str(len(vals)) + (" " if vals else "") +
+                         " ".join(str(v) for v in vals))
+        return " ".join(parts)
+
+    def _samples(self, line):
+        r = self.generate_sample(line)
+        it = r() if callable(r) else r
+        for sample in it:
+            if sample is None:  # reference protocol: None filters the line
+                continue
+            yield sample
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            for sample in self._samples(line):
+                out.append(self._gen_str(sample))
+        return out
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            for sample in self._samples(line):
+                sys.stdout.write(self._gen_str(sample) + "\n")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-slot variant (values emitted verbatim; same wire format)."""
+
+
+class Fleet:
+    """The object behind the module-level facade (reference fleet.py:99
+    `Fleet`; the reference exposes a singleton `fleet = Fleet()` whose
+    methods this module mirrors as functions)."""
+
+    def __init__(self):
+        self._role_maker = None
+
+    def init(self, role_maker=None, is_collective=False, strategy=None,
+             log_level=2):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        return init(role_maker=role_maker, is_collective=is_collective,
+                    strategy=strategy, log_level=log_level)
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_worker(self):
+        rm = self._role_maker or PaddleCloudRoleMaker()
+        return rm._is_worker()
+
+    def is_server(self):
+        rm = self._role_maker or PaddleCloudRoleMaker()
+        return rm._is_server()
+
+    def barrier_worker(self):
+        barrier_worker()
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy=strategy)
+
+    def get_hybrid_communicate_group(self):
+        return get_hybrid_communicate_group()
+
+    @property
+    def util(self):
+        return utils
+
+
+utils = UtilBase()

@@ -122,3 +122,78 @@ def test_rng_state_tracker():
         b = paddle.randn([4]).numpy()
     # state advances across uses
     assert not np.allclose(a, b)
+
+
+def test_role_makers_and_fleet_object(monkeypatch):
+    from paddle_tpu.distributed import fleet as F
+
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "8")
+    rm = F.PaddleCloudRoleMaker()
+    assert rm._is_worker() and not rm._is_server()
+    assert rm._worker_index() == 3 and rm._worker_num() == 8
+
+    monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+    assert F.PaddleCloudRoleMaker()._is_server()
+    monkeypatch.delenv("TRAINING_ROLE")
+
+    urm = F.UserDefinedRoleMaker(current_id=1, role=F.Role.SERVER,
+                                 worker_num=4)
+    assert urm._is_server() and urm._role_id() == 1
+
+    fl = F.Fleet()
+    assert fl.util is F.utils
+
+
+def test_utilbase_file_shard_and_allgather():
+    from paddle_tpu.distributed import fleet as F
+
+    files = [f"part-{i}" for i in range(10)]
+    # single process: one contiguous block = everything
+    assert F.utils.get_file_shard(files) == files
+    got = F.utils.all_gather(42)
+    assert got and all(v == 42 for v in got)
+    F.utils.barrier()
+
+
+def test_file_shard_reference_blocks(monkeypatch):
+    from paddle_tpu.distributed import fleet as F
+
+    files = list("abcde")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    assert F.utils.get_file_shard(files) == ["a", "b", "c"]
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    assert F.utils.get_file_shard(files) == ["d", "e"]
+    # reference example 2: 2 files over 3 trainers
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "3")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    assert F.utils.get_file_shard(["a", "b"]) == []
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "0")  # guarded
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    assert F.utils.get_file_shard(["a"]) == ["a"]
+
+
+def test_multislot_data_generator():
+    from paddle_tpu.distributed import fleet as F
+
+    class G(F.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                toks = line.strip().split()
+                if len(toks) != 2:
+                    yield None  # reference filter-bad-line protocol
+                    return
+                a, b = toks
+                yield [("ids", [int(a), int(b)]), ("label", [int(a) % 2])]
+            return gen
+
+    out = G().run_from_memory(["3 7\n", "bad\n", "4 9\n"])
+    # MultiSlotDataFeed wire format: N v1 v2 per slot, space-joined
+    assert out == ["2 3 7 1 1", "2 4 9 1 0"]
+
+    class G2(F.MultiSlotStringDataGenerator):
+        def generate_sample(self, line):  # iterator form also accepted
+            yield [("words", line.split()), ("label", ["1"])]
+
+    assert G2().run_from_memory(["w1 w2"]) == ["2 w1 w2 1 1"]

@@ -52,6 +52,7 @@ NAMESPACES = [
     ("autograd", "autograd/__init__.py"),
     ("device", "device/__init__.py"),
     ("distributed", "distributed/__init__.py"),
+    ("distributed.fleet", "distributed/fleet/__init__.py"),
     ("io", "io/__init__.py"),
     ("jit", "jit/__init__.py"),
     ("optimizer", "optimizer/__init__.py"),
