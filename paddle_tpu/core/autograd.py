@@ -77,9 +77,17 @@ class Node:
     ``vjp_fn`` maps a tuple of output cotangents (one per op output, in
     op-output order) to a tuple of input cotangents (one per entry of
     ``inputs``).
+
+    ``parents`` snapshots each input's (producer node, out index) AT
+    RECORD TIME — the eager analogue of the reference's TensorWrapper
+    graph edges (paddle/fluid/eager/grad_node_info.h SetGradOutMeta):
+    if an input tensor is later rebound by an in-place op, backward
+    still routes cotangents through the graph as it stood when this op
+    consumed the value, not through the mutation.
     """
 
-    __slots__ = ("vjp_fn", "inputs", "out_meta", "name", "__weakref__")
+    __slots__ = ("vjp_fn", "inputs", "parents", "out_meta", "name",
+                 "__weakref__")
 
     def __init__(
         self,
@@ -90,6 +98,7 @@ class Node:
     ):
         self.vjp_fn = vjp_fn
         self.inputs = tuple(inputs)  # Tensors, vjp arg order
+        self.parents = tuple((t._node, t._out_idx) for t in self.inputs)
         self.out_meta = tuple(out_meta)  # (shape, dtype) per op output
         self.name = name
 
@@ -121,8 +130,7 @@ def _topo_order(root_nodes):
             continue
         state[id(node)] = 0
         stack.append((node, True))
-        for t in node.inputs:
-            prod = t._node
+        for prod, _ in node.parents:
             if prod is not None and id(prod) not in state:
                 stack.append((prod, False))
     order.reverse()  # produce consumers-first order
@@ -206,10 +214,9 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _into=None):
             for ct, (shape, dt) in zip(cts, node.out_meta)
         )
         in_grads = node.vjp_fn(full)
-        for t, g in zip(node.inputs, in_grads):
+        for t, (prod, idx), g in zip(node.inputs, node.parents, in_grads):
             if t.stop_gradient:
                 continue
-            prod = t._node
             if prod is None:
                 key = id(t)
                 leaf_by_id[key] = t
@@ -222,7 +229,6 @@ def backward(tensors, grad_tensors=None, retain_graph=False, _into=None):
                     pending[pid] = [None] * len(prod.out_meta)
                     node_by_id[pid] = prod
                 slot = pending[pid]
-                idx = t._out_idx
                 slot[idx] = g if slot[idx] is None else slot[idx] + g
         pending[nid] = None  # free cotangents early
 
@@ -256,11 +262,12 @@ def _release_graph(root):
         if id(n) in seen:
             continue
         seen.add(id(n))
-        for t in n.inputs:
-            if t._node is not None:
-                stack.append(t._node)
+        for prod, _ in n.parents:
+            if prod is not None:
+                stack.append(prod)
         n.vjp_fn = _dead_vjp
         n.inputs = ()
+        n.parents = ()
 
 
 def _dead_vjp(*_):

@@ -37,7 +37,13 @@ from . import special
 
 def _inplace_from(t: Tensor, out: Tensor) -> Tensor:
     """Give ``t`` the value (and tape position) of ``out`` — the functional
-    realization of the reference's inplace ops (`x.add_(y)` etc.)."""
+    realization of the reference's inplace ops (`x.add_(y)` etc.).
+
+    Rebinding is safe for the tape because every Node snapshots its
+    parents' (producer, out_idx) at record time (core/autograd.Node —
+    the eager analogue of the reference's TensorWrapper inplace-version
+    snapshot): backward routes through the graph as it stood when the
+    value was consumed, not through this mutation."""
     if t.is_leaf and not t.stop_gradient and t._node is None and \
             out._node is not None:
         raise RuntimeError(

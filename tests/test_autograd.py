@@ -153,3 +153,43 @@ class TestFunctionalGrad:
         z = paddle.to_tensor([1.0], stop_gradient=False)
         with pytest.raises(RuntimeError):
             paddle.grad(x * 2, [z])
+
+
+def test_setitem_grad_zero_at_overwritten_positions():
+    """Regression: in-place rebind must not make the setitem node its
+    own ancestor (grads used to vanish silently)."""
+    y = paddle.to_tensor(np.ones((2, 2), "float32"), stop_gradient=False)
+    z = y * 2
+    z[0, 0] = 7.0
+    paddle.sum(z).backward()
+    np.testing.assert_allclose(y.grad.numpy(), [[0.0, 2.0], [2.0, 2.0]])
+
+
+def test_inplace_op_on_nonleaf_keeps_chain():
+    a = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+    b = a * 3
+    b.add_(paddle.to_tensor(np.ones(3, "float32")))
+    paddle.sum(b * b).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [24.0] * 3)  # 2*(3a+1)*3
+
+
+def test_setitem_tensor_value_gets_grad():
+    v = paddle.to_tensor(np.array([5.0], "float32"), stop_gradient=False)
+    w = paddle.to_tensor(np.zeros(3, "float32"), stop_gradient=False)
+    q = w * 2
+    q[1] = v[0] * 3
+    paddle.sum(q).backward()
+    np.testing.assert_allclose(v.grad.numpy(), [3.0])
+    np.testing.assert_allclose(w.grad.numpy(), [2.0, 0.0, 2.0])
+
+
+def test_inplace_after_consumption_routes_through_recorded_graph():
+    """Regression: a node records its parents at op time; mutating an
+    input tensor in place afterwards must not reroute backward through
+    the mutation (grads used to be silently wrong)."""
+    a = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+    b = a * 3
+    c = b * b
+    b.multiply_(paddle.to_tensor(np.full(3, 2.0, "float32")))
+    paddle.sum(c).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [18.0] * 3)
