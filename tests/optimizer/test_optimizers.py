@@ -204,3 +204,61 @@ def test_lookahead_first_sync_pulls_toward_init():
     fast = model2.weight.numpy()
     np.testing.assert_allclose(w_fast_would_be, w0 + 0.5 * (fast - w0),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_optimizer_resume_equivalence():
+    """Snapshot mid-training and resume: loss trajectory must be
+    bit-identical to continuing (reference checkpoint/resume contract,
+    SURVEY §5.4)."""
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((32, 4)).astype("float32")
+    Y = rng.standard_normal((32, 1)).astype("float32")
+
+    def make():
+        paddle.seed(9)
+        m = paddle.nn.Linear(4, 1)
+        o = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                   parameters=m.parameters(),
+                                   weight_decay=0.01)
+        return m, o
+
+    def step(m, o):
+        loss = paddle.nn.functional.mse_loss(
+            m(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return float(loss.numpy())
+
+    m1, o1 = make()
+    for _ in range(5):
+        step(m1, o1)
+    msd = {k: v.numpy().copy() for k, v in m1.state_dict().items()}
+    osd = o1.state_dict()
+    ref = [step(m1, o1) for _ in range(5)]
+
+    m2, o2 = make()
+    m2.set_state_dict({k: paddle.to_tensor(v) for k, v in msd.items()})
+    o2.set_state_dict(osd)
+    res = [step(m2, o2) for _ in range(5)]
+    np.testing.assert_allclose(ref, res, rtol=1e-6)
+
+
+def test_lr_scheduler_resume_equivalence():
+    sched = paddle.optimizer.lr.CosineAnnealingDecay(learning_rate=0.1,
+                                                     T_max=10)
+    for _ in range(4):
+        sched.step()
+    sd = sched.state_dict()
+    ref = []
+    for _ in range(3):
+        sched.step()
+        ref.append(sched.get_lr())
+    s2 = paddle.optimizer.lr.CosineAnnealingDecay(learning_rate=0.1,
+                                                  T_max=10)
+    s2.set_state_dict(sd)
+    res = []
+    for _ in range(3):
+        s2.step()
+        res.append(s2.get_lr())
+    np.testing.assert_allclose(ref, res)
