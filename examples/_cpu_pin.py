@@ -1,0 +1,18 @@
+"""Shared CPU pin for the examples (mirrors tests/conftest.py).
+
+A preloaded PJRT plugin registers the real TPU and overrides the
+JAX_PLATFORMS env var; `jax.config.update` before first backend use is
+the only reliable pin, and the plugin path is dropped for good measure.
+"""
+
+import os
+
+
+def pin_cpu_if_requested():
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        return
+    os.environ.pop("PJRT_LIBRARY_PATH", None)
+    os.environ.pop("TPU_LIBRARY_PATH", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

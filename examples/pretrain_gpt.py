@@ -11,8 +11,11 @@ import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-import os
 import time
+
+from examples._cpu_pin import pin_cpu_if_requested
+
+pin_cpu_if_requested()
 
 import numpy as np
 

@@ -18,6 +18,10 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from examples._cpu_pin import pin_cpu_if_requested
+
+pin_cpu_if_requested()
+
 import numpy as np
 
 
@@ -30,8 +34,6 @@ def main():
     args = ap.parse_args()
 
     import jax
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
     import paddle_tpu as paddle
     from paddle_tpu.inference.paged import ContinuousBatchingEngine
     from paddle_tpu.models import Llama, LlamaConfig
