@@ -54,17 +54,21 @@ def _as_tuple(x):
 
 
 def _tape_jacobian_single(y, x, batch_axis):
+    # Cotangent seeds must match y's dtype (float64 under x64, bf16 under
+    # autocast); hardcoding float32 would hand jax.vjp a mismatched seed.
+    y_dt = np.dtype(jnp.asarray(y._data).dtype)
+    x_dt = np.dtype(jnp.asarray(x._data).dtype)
     rows = []
     if batch_axis is None:
         y_flat_len = int(np.prod(y.shape)) if y.shape else 1
         for i in range(y_flat_len):
-            seed = np.zeros(y.shape or (1,), np.float32)
+            seed = np.zeros(y.shape or (1,), y_dt)
             seed.reshape(-1)[i] = 1.0
             (g,) = _tape_grad([y], [x],
                               grad_outputs=[Tensor(seed.reshape(
-                                  y.shape or ()))],
+                                  y.shape or ()), dtype=y_dt)],
                               retain_graph=True, allow_unused=True)
-            rows.append(np.zeros(x.shape, np.float32)
+            rows.append(np.zeros(x.shape, x_dt)
                         if g is None else np.asarray(g.numpy()))
         arr = np.stack([r.reshape(-1) for r in rows], 0)
         return _Matrix(arr)
@@ -73,12 +77,13 @@ def _tape_jacobian_single(y, x, batch_axis):
     M = int(np.prod(y.shape)) // B
     out = []
     for i in range(M):
-        seed = np.zeros((B, M), np.float32)
+        seed = np.zeros((B, M), y_dt)
         seed[:, i] = 1.0
         (g,) = _tape_grad([y], [x],
-                          grad_outputs=[Tensor(seed.reshape(y.shape))],
+                          grad_outputs=[Tensor(seed.reshape(y.shape),
+                                               dtype=y_dt)],
                           retain_graph=True, allow_unused=True)
-        out.append(np.zeros(x.shape, np.float32)
+        out.append(np.zeros(x.shape, x_dt)
                    if g is None else np.asarray(g.numpy()))
     arr = np.stack([r.reshape(B, -1) for r in out], 1)  # [B, M, N]
     return _Matrix(arr)

@@ -123,18 +123,72 @@ def _fn_key(fn):
     vals = []
     for c in cells:
         v = c.cell_contents
-        if isinstance(v, Tensor) or hasattr(v, "_data"):
-            # a captured Tensor could be rebound between calls — its
-            # value would be baked stale into the cached jit
-            raise TypeError("tensor closure")
         if callable(v) and getattr(v, "__code__", None) is not None:
             # per-call inner lambdas (e.g. an activation built each
             # forward) share code — recurse instead of id-hashing, or
             # every call would be a fresh cache entry + XLA compile
             vals.append(_fn_key(v))
         else:
-            vals.append(v)
+            # whitelist, not blacklist: a hashable custom object would be
+            # keyed by identity while the first-seen fn gets baked into
+            # the cached jitted backward — if it held tensor data
+            # internally, backward would silently recompute stale values
+            vals.append(_cell_key(v))
     return (code, fn.__defaults__, tuple(vals))
+
+
+_STABLE_CALLABLE_TYPES = None
+
+
+def _stable_callable_types():
+    global _STABLE_CALLABLE_TYPES
+    if _STABLE_CALLABLE_TYPES is None:
+        import types
+        kinds = [types.BuiltinFunctionType, np.ufunc,
+                 jax.custom_jvp, jax.custom_vjp]
+        kinds.append(type(jax.jit(lambda: 0)))  # PjitFunction
+        _STABLE_CALLABLE_TYPES = tuple(kinds)
+    return _STABLE_CALLABLE_TYPES
+
+
+def _cell_key(v):
+    """Key for a closure-cell value: only value-semantics immutables and
+    stable-identity callables are admitted; everything else rejects the
+    op to the eager-vjp path."""
+    if v is None or isinstance(v, (bool, int, float, complex, str, bytes)):
+        return v
+    if isinstance(v, np.dtype):
+        return v
+    if isinstance(v, type) and issubclass(v, (np.generic, bool, int,
+                                              float, complex)):
+        # dtype-like classes only (jnp.float32 etc). An arbitrary class
+        # would be keyed by identity while its MUTABLE class attributes
+        # get baked into the cached jitted backward — stale after edits.
+        return v
+    if isinstance(v, tuple):
+        return tuple(_cell_key(e) for e in v)
+    if isinstance(v, frozenset):
+        return frozenset(_cell_key(e) for e in v)
+    import functools
+    if isinstance(v, functools.partial):
+        return ("partial", _cell_key_fn(v.func),
+                tuple(_cell_key(a) for a in v.args),
+                tuple(sorted((k, _cell_key(x))
+                             for k, x in v.keywords.items())))
+    if isinstance(v, _stable_callable_types()):
+        # module-level stable identities (jnp builtins, jitted fns,
+        # custom_jvp/vjp wrappers); rebinding the cell changes identity
+        # and therefore the key
+        return v
+    raise TypeError(f"unsafe closure cell type {type(v).__name__}")
+
+
+def _cell_key_fn(v):
+    """Key a callable that may be a plain function or a stable builtin."""
+    if getattr(v, "__code__", None) is not None \
+            and getattr(v, "__self__", None) is None:
+        return _fn_key(v)
+    return _cell_key(v)
 
 
 def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf):

@@ -147,10 +147,15 @@ class StoreServer {
 
   void serve(int fd) {
     serve_loop(fd);
+    // Deregister BEFORE close: once closed, the fd number can be reused by
+    // another thread, and a concurrent stop() iterating conn_fds_ would
+    // shutdown() an unrelated descriptor.
+    {
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      conn_fds_.erase(std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
+                      conn_fds_.end());
+    }
     ::close(fd);
-    std::lock_guard<std::mutex> lk(conn_mu_);
-    conn_fds_.erase(std::remove(conn_fds_.begin(), conn_fds_.end(), fd),
-                    conn_fds_.end());
   }
 
   void serve_loop(int fd) {

@@ -52,6 +52,7 @@ class _Agent:
         self.rank = rank
         self.world_size = world_size
         self.store = store
+        self.epoch = 0
         self.workers = {}          # name -> WorkerInfo
         # separate pools: blocked outgoing calls must never starve the
         # server side (peers issuing 8+ mutual rpc_async would deadlock
@@ -172,10 +173,21 @@ def _local_ip():
         return "127.0.0.1"
 
 
-def _barrier(store, rank, world_size, phase):
+def _ping(ip, port, timeout=3.0):
+    """True iff a live rpc agent answers at (ip, port)."""
+    try:
+        with socket.create_connection((ip, port), timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            _Agent._send_frame(sock, {"op": "ping"})
+            return bool(_Agent._recv_frame(sock).get("ok"))
+    except (OSError, EOFError, pickle.UnpicklingError):
+        return False
+
+
+def _barrier(store, rank, world_size, phase, epoch=0):
     """Never-timeout barrier over the TCPStore (reference
     rpc.py:_barrier_never_timeout — store add + poll)."""
-    key = f"rpc/barrier/{phase}"
+    key = f"rpc/{epoch}/barrier/{phase}"
     store.add(key, 1)
     deadline = time.time() + 600
     while time.time() < deadline:
@@ -200,20 +212,82 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     master_endpoint = master_endpoint or os.environ.get(
         "PADDLE_MASTER", "127.0.0.1:8711")
     host, port = master_endpoint.rsplit(":", 1)
-    store = TCPStore(host, int(port), is_master=(rank == 0),
-                     world_size=world_size)
+    try:
+        store = TCPStore(host, int(port), is_master=(rank == 0),
+                         world_size=world_size)
+        joined_live_master = False
+    except RuntimeError:
+        if rank != 0:
+            raise
+        # a master already serves this endpoint (e.g. the launcher's
+        # long-lived store across an elastic restart) — join as client
+        store = TCPStore(host, int(port), is_master=False,
+                         world_size=world_size)
+        joined_live_master = True
     agent = _Agent(name, rank, world_size, store)
-    store.set(f"rpc/worker/{rank}",
-              pickle.dumps((name, rank, agent.ip, agent.port)))
-    store.wait([f"rpc/worker/{r}" for r in range(world_size)])
+    # Epoch-namespace every key: a second rpc life against a still-live
+    # master store (elastic restart) must not observe a previous life's
+    # worker endpoints or pre-counted barriers. Rank 0 is authoritative:
+    # it mints a fresh epoch (monotonic counter — robust to crashed
+    # half-initialized lives and world-size changes) and publishes it;
+    # other ranks join the published epoch, retrying if they raced a
+    # stale value. Keys inside a fresh epoch can only come from this
+    # life, since no earlier life ever observed that epoch number.
+    if rank == 0:
+        if joined_live_master and store.check("rpc/world_size"):
+            prev_ws = int(store.get("rpc/world_size"))
+            if prev_ws != world_size and store.check("rpc/cur_epoch"):
+                # distinguish an elastic resize from a *different job*
+                # accidentally sharing the endpoint: only proceed if the
+                # latest epoch announced a clean shutdown
+                last_sd = int(store.get("rpc/last_shutdown")) \
+                    if store.check("rpc/last_shutdown") else -1
+                if last_sd < int(store.get("rpc/cur_epoch")):
+                    raise RuntimeError(
+                        f"rpc master at {master_endpoint} already serves "
+                        f"a live job with world_size={prev_ws}; refusing "
+                        f"to join with world_size={world_size}")
+        store.set("rpc/world_size", str(world_size))
+        epoch = int(store.add("rpc/epoch", 1))
+        store.set(f"rpc/{epoch}/worker/0",
+                  pickle.dumps((name, rank, agent.ip, agent.port)))
+        store.set("rpc/cur_epoch", str(epoch))
+    else:
+        deadline = time.time() + 600
+        while True:
+            store.wait(["rpc/cur_epoch"])
+            epoch = int(store.get("rpc/cur_epoch"))
+            store.set(f"rpc/{epoch}/worker/{rank}",
+                      pickle.dumps((name, rank, agent.ip, agent.port)))
+            try:
+                store.wait([f"rpc/{epoch}/worker/{r}"
+                            for r in range(world_size)], timeout=10)
+            except TimeoutError:
+                # raced a stale partially-registered epoch; re-read
+                if time.time() > deadline:
+                    raise
+                if int(store.get("rpc/cur_epoch")) == epoch:
+                    continue  # epoch is current; peers just slow — rewait
+                continue
+            # a FULLY-registered stale epoch (previous life crashed after
+            # init) also passes the wait — confirm its rank 0 is alive
+            _, _, ip0, port0 = pickle.loads(
+                store.get(f"rpc/{epoch}/worker/0"))
+            if _ping(ip0, port0):
+                break
+            if time.time() > deadline:
+                raise TimeoutError("rpc init: no live epoch published")
+            time.sleep(0.2)
+    agent.epoch = epoch
+    store.wait([f"rpc/{epoch}/worker/{r}" for r in range(world_size)])
     for r in range(world_size):
         wname, wrank, ip, wport = pickle.loads(
-            store.get(f"rpc/worker/{r}"))
+            store.get(f"rpc/{epoch}/worker/{r}"))
         agent.workers[wname] = WorkerInfo(wname, wrank, ip, wport)
     if len(agent.workers) != world_size:
         raise RuntimeError("duplicate rpc worker names")
     _agent = agent
-    _barrier(store, rank, world_size, "init")
+    _barrier(store, rank, world_size, "init", epoch)
 
 
 class _Future:
@@ -256,7 +330,14 @@ def shutdown():
     global _agent
     if _agent is None:
         return
-    _barrier(_agent.store, _agent.rank, _agent.world_size, "shutdown")
+    _barrier(_agent.store, _agent.rank, _agent.world_size, "shutdown",
+             getattr(_agent, "epoch", 0))
+    if _agent.rank == 0:
+        try:  # mark a clean end of life (enables elastic world resize)
+            _agent.store.set("rpc/last_shutdown",
+                             str(getattr(_agent, "epoch", 0)))
+        except RuntimeError:
+            pass
     _agent.close()
     _agent = None
 
