@@ -9,9 +9,9 @@ TPU-first: two entry forms.
   the pure function with jax.jacrev / jax.hessian — the XLA-native way
   to get higher-order derivatives (the reference builds a double-grad
   graph; under JAX, composition of transforms replaces graph surgery).
-Tensor-form ``hessian`` needs grad-of-grad on the tape, which the eager
-tape deliberately does not record (see core/autograd.grad) — it raises
-with a pointer to the callable form.
+Tensor-form ``hessian`` runs grad-of-grad on the tape:
+``grad(create_graph=True)`` records the first backward differentiably,
+then one-hot tape jacobians over the grads build the Hessian blocks.
 """
 
 from __future__ import annotations
@@ -149,7 +149,30 @@ def hessian(ys, xs, batch_axis=None):
                                for j in range(len(arrs)))
                          for i in range(len(arrs)))
         return _Matrix(np.asarray(hes[0][0]))
-    raise NotImplementedError(
-        "hessian(ys, xs) on computed Tensors needs double-backward, which "
-        "the eager tape does not record; pass the function instead: "
-        "paddle.autograd.hessian(func, xs) (jax.hessian under the hood)")
+
+    # Tensor form: double-backward on the eager tape —
+    # grad(create_graph=True) records the first backward differentiably,
+    # then a one-hot tape jacobian over each grad gives the Hessian rows
+    # (reference: GeneralGrad double-grad, fluid/eager/backward.cc:439).
+    y = ys[0] if isinstance(ys, (tuple, list)) else ys
+    if int(np.prod(y.shape)) != 1:
+        raise ValueError(
+            f"hessian expects a scalar (1-element) ys, got shape {y.shape}")
+    xs_t = _as_tuple(xs)
+    grads = _tape_grad([y], list(xs_t), create_graph=True,
+                       retain_graph=True, allow_unused=True)
+    rows = []
+    for gi, xi in zip(grads, xs_t):
+        row = []
+        for xj in xs_t:
+            if gi is None:
+                ni = int(np.prod(xi.shape)) if xi.shape else 1
+                nj = int(np.prod(xj.shape)) if xj.shape else 1
+                row.append(_Matrix(np.zeros((ni, nj),
+                                            np.dtype(xj._data.dtype))))
+            else:
+                row.append(_tape_jacobian_single(gi, xj, batch_axis))
+        rows.append(tuple(row))
+    if isinstance(xs, (tuple, list)):
+        return tuple(rows)
+    return rows[0][0]

@@ -82,7 +82,17 @@ class PyLayer(metaclass=PyLayerMeta):
                     jnp.zeros(t._data.shape, t._data.dtype) if a is None
                     else a for t, a in zip(tensor_inputs, arrays))
 
-            node = Node(vjp_fn, tensor_inputs, out_meta, name=cls.__name__)
+            def tensor_vjp(ct_tensors):
+                # create_graph path: run the user's backward with recording
+                # ON — differentiable iff the backward is built from
+                # differentiable Tensor ops (reference composite-VJP rule)
+                in_grads = cls.backward(ctx, *ct_tensors)
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = (in_grads,)
+                return list(in_grads)
+
+            node = Node(vjp_fn, tensor_inputs, out_meta, name=cls.__name__,
+                        tensor_vjp=tensor_vjp)
             idx = 0
             for o in out_list:
                 if isinstance(o, Tensor):

@@ -284,7 +284,20 @@ def apply(fn: Callable, *args, name: str = None, **kwargs):
         out_tuple, vjp_fn = jax.vjp(pure, *diff_args)
         _post_op_hooks(name, out_tuple, check_naninf)
     out_meta = [(o.shape, o.dtype) for o in out_tuple]
-    node = Node(vjp_fn, [args[i] for i in diff_idx], out_meta, name=name)
+    # fwd_fn: the node's pure forward over its diff inputs — what lets
+    # create_graph=True re-record this op's backward differentiably
+    tuple_flag = was_tuple[0]
+
+    def fwd_fn(*diff_vals):
+        full = list(payloads)
+        for pos, v in zip(diff_idx, diff_vals):
+            full[pos] = v
+        out = fn(*full, **kwargs)
+        return tuple(out) if tuple_flag else (out,)
+
+    node = Node(vjp_fn, [args[i] for i in diff_idx], out_meta, name=name,
+                fwd_fn=fwd_fn,
+                primals=[payloads[i] for i in diff_idx])
 
     outs = []
     any_diff_out = False

@@ -2,18 +2,27 @@
 
 Parity: reference `python/paddle/distributed/auto_tuner/` (tuner.py:21 —
 grid/prune search over dp/mp/pp/sharding/micro-batch driven by
-`launch --auto_tuner_json`, with history + cost model). Here the search
-enumerates valid mesh factorizations, prunes infeasible ones (divisibility,
-memory heuristic), and measures each candidate with a user-supplied
-`trial_fn(config) -> cost` (step time); `history()` returns all results.
+`launch --auto_tuner_json`, with history + cost model). Two entry forms:
+
+- Library: `AutoTuner.tune(trial_fn)` measures each candidate with a
+  user-supplied `trial_fn(config) -> cost`.
+- Launch-integrated (the reference's workflow):
+  `python -m paddle_tpu.distributed.launch --auto_tuner_json cfg.json
+  train.py` — each trial runs `train.py` as a subprocess with the
+  candidate exported as `PADDLE_AUTO_TUNER_CONFIG` (json env); the
+  script reports its cost by writing a float to the path in
+  `PADDLE_AUTO_TUNER_RESULT`. History persists to disk after EVERY
+  trial; a restarted search resumes, skipping configs already tried.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import os
 
-__all__ = ["AutoTuner", "default_candidates"]
+__all__ = ["AutoTuner", "default_candidates", "launch_tune",
+           "report_cost", "current_trial_config"]
 
 
 def default_candidates(num_devices, num_layers=None, max_mp=8, max_pp=8):
@@ -84,3 +93,88 @@ class AutoTuner:
     def save_history(self, path):
         with open(path, "w") as f:
             json.dump(self._history, f, indent=2)
+
+    def load_history(self, path):
+        """Resume support: load prior trials so tune() skips configs
+        already measured (reference tuner.py history resume)."""
+        if os.path.exists(path):
+            with open(path) as f:
+                self._history = json.load(f)
+        return self._history
+
+    def tried_configs(self):
+        return [h["config"] for h in self._history]
+
+
+# ---------------------------------------------------------------------------
+# launch integration (reference: launch --auto_tuner_json, tuner.py:21)
+# ---------------------------------------------------------------------------
+
+def current_trial_config():
+    """Inside a training script under the tuner: the candidate config
+    dict (dp_degree/mp_degree/pp_degree/micro_batches/...), or None."""
+    raw = os.environ.get("PADDLE_AUTO_TUNER_CONFIG")
+    return json.loads(raw) if raw else None
+
+
+def report_cost(cost):
+    """Inside a training script under the tuner: report this trial's
+    cost (e.g. step time — lower is better)."""
+    path = os.environ.get("PADDLE_AUTO_TUNER_RESULT")
+    if path:
+        with open(path, "w") as f:
+            f.write(repr(float(cost)))
+
+
+def launch_tune(tuner_json_path, spawn_trial, log=print):
+    """Drive the search for the launcher.
+
+    ``spawn_trial(config, result_path) -> (returncode)`` runs one trial
+    subprocess with the candidate exported. Reads/writes the history
+    file after every trial so an interrupted search resumes. Returns the
+    best config (also written next to the history as best_cfg.json).
+    """
+    with open(tuner_json_path) as f:
+        spec = json.load(f)
+    hist_path = spec.get("history_path", tuner_json_path + ".history.json")
+    best_path = spec.get("best_path", tuner_json_path + ".best.json")
+    max_trials = spec.get("max_trials")
+    cands = spec.get("candidates") or default_candidates(
+        spec.get("num_devices", 8), spec.get("num_layers"))
+    tuner = AutoTuner(candidates=cands,
+                      memory_limit_gb=spec.get("memory_limit_gb"),
+                      model_params=spec.get("model_params"))
+    tuner.prune()
+    tuner.load_history(hist_path)
+    tried = {json.dumps(c, sort_keys=True) for c in tuner.tried_configs()}
+    n_run = 0
+    for cfg in tuner.candidates:
+        key = json.dumps(cfg, sort_keys=True)
+        if key in tried:
+            continue  # resume: already measured in a previous life
+        if max_trials is not None and n_run >= max_trials:
+            break
+        n_run += 1
+        result_path = hist_path + ".trial_result"
+        if os.path.exists(result_path):
+            os.remove(result_path)
+        log(f"auto_tuner: trial {n_run}: {cfg}")
+        rc = spawn_trial(cfg, result_path)
+        entry = {"config": cfg}
+        if rc == 0 and os.path.exists(result_path):
+            with open(result_path) as f:
+                entry["cost"] = float(f.read().strip())
+        else:
+            entry["error"] = f"returncode={rc}"
+        tuner._history.append(entry)
+        tuner.save_history(hist_path)  # persist after EVERY trial
+    ok = [h for h in tuner._history if "cost" in h]
+    if not ok:
+        log("auto_tuner: no successful trials")
+        return None
+    best = min(ok, key=lambda h: h["cost"])
+    with open(best_path, "w") as f:
+        json.dump(best, f, indent=2)
+    log(f"auto_tuner: best config {best['config']} "
+        f"(cost {best['cost']:.4g}) -> {best_path}")
+    return best["config"]

@@ -43,12 +43,28 @@ def _parse_args(argv):
     p.add_argument("--max_restart", type=int, default=3)
     p.add_argument("--run_mode", default="collective",
                    choices=["collective", "ps"])
+    p.add_argument("--elastic_np", default=None,
+                   help="'min:max' process-elastic world-size range: a "
+                        "worker exiting with code 75 LEAVES the job "
+                        "(scale-in to the survivors); a join request on "
+                        "the control store scales back out; other "
+                        "failures restart at the same size (fault "
+                        "tolerance). Workers resume from their "
+                        "distributed checkpoint. Reference "
+                        "fleet/elastic/manager.py:456,483,506")
+    p.add_argument("--auto_tuner_json", default=None,
+                   help="search-spec json: run the auto-tuner over "
+                        "parallel configs (reference launch "
+                        "--auto_tuner_json); each trial launches the "
+                        "script once with PADDLE_AUTO_TUNER_CONFIG set, "
+                        "history persists/resumes, then the best config "
+                        "runs for real")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
-def _spawn_worker(rank, world_size, master, args, log_dir):
+def _spawn_worker(rank, world_size, master, args, log_dir, extra_env=None):
     env = dict(os.environ)
     env.update({
         "PADDLE_TRAINER_ID": str(rank),
@@ -62,6 +78,7 @@ def _spawn_worker(rank, world_size, master, args, log_dir):
         "NUM_PROCESSES": str(world_size),
         "PROCESS_ID": str(rank),
     })
+    env.update(extra_env or {})
     os.makedirs(log_dir, exist_ok=True)
     log_path = os.path.join(log_dir, f"workerlog.{rank}")
     logf = open(log_path, "a")
@@ -75,6 +92,11 @@ def launch(argv=None):
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     nnodes = int(str(args.nnodes).split(":")[0])
     world = nnodes * args.nproc_per_node
+
+    if args.auto_tuner_json:
+        return _launch_auto_tune(args, world)
+    if args.elastic_np:
+        return _launch_elastic(args)
 
     # rendezvous master: start the native TCPStore on this (rank-0) node
     store = None
@@ -121,6 +143,188 @@ def launch(argv=None):
             if p.poll() is None:
                 p.terminate()
         time.sleep(1)
+
+
+LEAVE_RC = 75  # worker exit code meaning "scale me out of the job"
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch_elastic(args):
+    """Process-elastic launch loop (reference ElasticManager semantics,
+    fleet/elastic/manager.py): the launcher owns a control TCPStore
+    (join requests + worker heartbeats); each *job epoch* spawns the
+    current world on a FRESH coordinator port. Classification:
+      - all workers exit 0                -> job complete
+      - a worker exits LEAVE_RC (75)     -> scale-in to the survivors
+      - join requests on the store       -> scale-out (up to max)
+      - any other failure                -> fault-tolerant restart, same np
+      - heartbeat lease expired (worker  -> treated as a fault (hang
+        opted in via PADDLE_ELASTIC_HB)     detection)
+    Workers re-form the mesh from the new PADDLE_TRAINERS_NUM and resume
+    from their distributed checkpoint (cross-world reshard on load).
+    """
+    lo, _, hi = str(args.elastic_np).partition(":")
+    min_np, max_np = int(lo), int(hi or lo)
+    from ..store import TCPStore
+
+    control = TCPStore("127.0.0.1", 0, is_master=True, world_size=max_np)
+    os.makedirs(args.log_dir, exist_ok=True)
+    np_cur = max_np
+    epoch = 0
+    restarts = 0
+    joins_consumed = 0
+    hb_ttl = float(os.environ.get("PADDLE_ELASTIC_HB_TTL", "10"))
+    while True:
+        epoch += 1
+        master = f"127.0.0.1:{_free_port()}"
+        extra = {
+            "PADDLE_RESTART_EPOCH": str(epoch),
+            "PADDLE_ELASTIC_STORE": f"127.0.0.1:{control.port}",
+        }
+        procs = [_spawn_worker(i, np_cur, master, args, args.log_dir,
+                               extra)
+                 for i in range(np_cur)]
+        print(f"launch[elastic]: epoch {epoch} world={np_cur} "
+              f"master={master}", flush=True)
+        action = None  # (kind, new_np)
+        while action is None:
+            time.sleep(0.3)
+            rcs = [p.poll() for p, _ in procs]
+            if all(rc == 0 for rc in rcs):
+                for _, f in procs:
+                    f.close()
+                print(f"launch[elastic]: all {np_cur} workers completed")
+                return 0
+            if any(rc is not None and rc != 0 for rc in rcs):
+                # grace window: a leaver's peers may crash moments later
+                # (wedged collectives); re-poll before classifying so a
+                # near-simultaneous leave+fault reads as the leave
+                time.sleep(2.0)
+                rcs = [p.poll() for p, _ in procs]
+                print(f"launch[elastic]: epoch {epoch} rcs={rcs}",
+                      flush=True)
+            leavers = sum(1 for rc in rcs if rc == LEAVE_RC)
+            faults = sum(1 for rc in rcs
+                         if rc is not None and rc not in (0, LEAVE_RC))
+            # heartbeat-lease hang detection (workers that registered)
+            now = time.time()
+            for i, (p, _f) in enumerate(procs):
+                if p.poll() is not None:
+                    continue
+                try:
+                    ts = float(control.get(f"hb/{epoch}/{i}"))
+                except (KeyError, ValueError):
+                    continue
+                if now - ts > hb_ttl:
+                    print(f"launch[elastic]: rank {i} lease expired "
+                          f"({now - ts:.1f}s) — treating as fault",
+                          flush=True)
+                    p.terminate()
+                    faults += 1
+            try:
+                joins = int(control.get("elastic/join"))
+            except (KeyError, ValueError):
+                joins = 0
+            new_joins = joins - joins_consumed
+            if os.environ.get("PADDLE_ELASTIC_DEBUG"):
+                print(f"launch[elastic]: poll t={time.time():.1f} "
+                      f"rcs={rcs} joins={joins}", flush=True)
+            if leavers:
+                nxt = np_cur - leavers
+                if nxt < min_np:
+                    print(f"launch[elastic]: world would drop to {nxt} "
+                          f"< min {min_np}; giving up", file=sys.stderr)
+                    action = ("exit", 1)
+                else:
+                    action = ("scale_in", nxt)
+            elif faults:
+                restarts += 1
+                if restarts > args.max_restart:
+                    print("launch[elastic]: too many faults; giving up",
+                          file=sys.stderr)
+                    action = ("exit", 1)
+                else:
+                    action = ("fault_restart", np_cur)
+            elif new_joins and np_cur < max_np:
+                joins_consumed = joins
+                action = ("scale_out", min(max_np, np_cur + new_joins))
+        for p, f in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p, f in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+            f.close()
+        kind, nxt = action
+        if kind == "exit":
+            return nxt
+        print(f"launch[elastic]: {kind} -> world {nxt}", flush=True)
+        np_cur = nxt
+        time.sleep(0.5)
+
+
+def _launch_auto_tune(args, world):
+    """`--auto_tuner_json`: search trials (script subprocesses with the
+    candidate in PADDLE_AUTO_TUNER_CONFIG), persistent/resumable history,
+    then one real run with the winner (reference auto_tuner/tuner.py:21)."""
+    import json
+
+    from ..auto_tuner import launch_tune
+
+    def spawn_trial(cfg, result_path):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_AUTO_TUNER_CONFIG": json.dumps(cfg),
+            "PADDLE_AUTO_TUNER_RESULT": result_path,
+            "PADDLE_TRAINER_ID": "0",
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_JOB_ID": args.job_id,
+        })
+        cmd = [sys.executable, args.training_script] + \
+            args.training_script_args
+        try:
+            return subprocess.run(
+                cmd, env=env, timeout=int(os.environ.get(
+                    "PADDLE_AUTO_TUNER_TRIAL_TIMEOUT", "600")),
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL).returncode
+        except subprocess.TimeoutExpired:
+            return -9
+
+    best = launch_tune(args.auto_tuner_json, spawn_trial)
+    if best is None:
+        return 1
+    # the real run, winner exported (script reads current_trial_config())
+    os.environ["PADDLE_AUTO_TUNER_CONFIG"] = json.dumps(best)
+    os.environ.pop("PADDLE_AUTO_TUNER_RESULT", None)
+    args.auto_tuner_json = None
+    return launch_from_args(args)
+
+
+def launch_from_args(args):
+    """Re-enter launch() with already-parsed args (tuner final run)."""
+    argv = []
+    if args.master:
+        argv += ["--master", args.master]
+    argv += ["--nnodes", str(args.nnodes),
+             "--node_rank", str(args.node_rank),
+             "--nproc_per_node", str(args.nproc_per_node),
+             "--job_id", args.job_id, "--log_dir", args.log_dir,
+             "--max_restart", str(args.max_restart),
+             "--run_mode", args.run_mode,
+             args.training_script] + args.training_script_args
+    return launch(argv)
 
 
 def main():

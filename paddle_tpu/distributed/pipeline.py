@@ -156,7 +156,9 @@ class PipelineDecoderLM(nn.Layer):
             assert self._n_layers % self._pp == 0, \
                 "layer count must divide pp degree"
         else:
-            assert schedule in ("fthenb", "1f1b", "interleave"), schedule
+            assert schedule in ("fthenb", "1f1b", "interleave",
+                                "1f1b_packed", "interleave_packed",
+                                "zb"), schedule
             self._sched = build_schedule(self._pp, self._vpp,
                                          self._n_micro, schedule)
 
@@ -421,10 +423,14 @@ class PipelineDecoderLM(nn.Layer):
         lab_micro = lab.reshape(M, mb, *lab.shape[1:])
 
         # dense schedule tables as device-indexed constants
+        has_wgrad = sched.has_wgrad  # zb: deferred weight-grad phase
         tabs = dict(
             fchunk=jnp.asarray(sched.fchunk), fmb=jnp.asarray(sched.fmb),
             bchunk=jnp.asarray(sched.bchunk), bmb=jnp.asarray(sched.bmb),
             rcvf=jnp.asarray(sched.rcvf), rcvb=jnp.asarray(sched.rcvb))
+        if has_wgrad:
+            tabs["wchunk"] = jnp.asarray(sched.wchunk)
+            tabs["wmb"] = jnp.asarray(sched.wmb)
         mask_rows = jnp.asarray(self._layer_mask)  # [Lpad] over all devices
 
         perm_fwd = [(i, (i + 1) % Pdeg) for i in range(Pdeg)]
@@ -498,7 +504,10 @@ class PipelineDecoderLM(nn.Layer):
                     stash, cots, fmsg, bmsg, loss_acc, ge, gh, gb = carry
                 else:
                     stash, fmsg, loss_acc = carry
-                fc, fm, bc, bm, rf, rb = xs
+                if has_wgrad and with_backward:
+                    fc, fm, bc, bm, rf, rb, wc, wm = xs
+                else:
+                    fc, fm, bc, bm, rf, rb = xs[:6]
 
                 # --- receive (messages sent at the end of tick t-1) ---
                 f_in = jnp.where(jnp.equal(d, 0),
@@ -544,6 +553,35 @@ class PipelineDecoderLM(nn.Layer):
                 new_bmsg = []
                 for c in range(V):
                     rows = [leaf[c * Lc:(c + 1) * Lc] for leaf in b_local]
+
+                    if has_wgrad:
+                        # zb: B is ACTIVATION-grad only (the critical-path
+                        # half); params are constants here, their grads
+                        # come from the deferred W phase below
+                        def bd_fire(args, c=c, rows=rows):
+                            stash_, cots_, b_ = args
+                            x_in = stash_[c, jnp.mod(b_, K)]
+                            fn = lambda x: chunk_fwd(c, x, b_, e_p, h_p,
+                                                     rows)
+                            outs, vjp = jax.vjp(fn, x_in)
+                            h_out, _ = outs
+                            is_final = jnp.logical_and(
+                                jnp.equal(d, Pdeg - 1), c == V - 1)
+                            cot_h = jnp.where(
+                                is_final, jnp.zeros(hshape, hdtype),
+                                cots_[c, jnp.mod(b_, K2)].astype(hdtype))
+                            cot_l = jnp.where(is_final, 1.0, 0.0).astype(
+                                jnp.float32)
+                            (d_x,) = vjp((cot_h, cot_l))
+                            return d_x
+
+                        def bd_skip(args, c=c):
+                            return jnp.zeros(hshape, hdtype)
+
+                        d_x = lax.cond(jnp.equal(bc, c), bd_fire, bd_skip,
+                                       (stash, cots, bm))
+                        new_bmsg.append(d_x)
+                        continue
 
                     def b_fire(args, c=c, rows=rows):
                         stash_, cots_, b_ = args
@@ -599,6 +637,57 @@ class PipelineDecoderLM(nn.Layer):
                     gh = jax.tree.map(jnp.add, gh, d_h)
                 bmsg = jnp.stack(new_bmsg, 0)
 
+                # --- deferred weight-grad compute (zb only) ---
+                if has_wgrad:
+                    for c in range(V):
+                        rows = [leaf[c * Lc:(c + 1) * Lc]
+                                for leaf in b_local]
+
+                        def w_fire(args, c=c, rows=rows):
+                            stash_, cots_, w_ = args
+                            x_in = stash_[c, jnp.mod(w_, K)]
+                            if c == 0 and c == V - 1:
+                                fn = lambda r, e_, h_: chunk_fwd(
+                                    c, x_in, w_, e_, h_, r)
+                                outs, vjp = jax.vjp(
+                                    fn, rows, tuple(e_p), tuple(h_p))
+                            elif c == 0:
+                                fn = lambda r, e_: chunk_fwd(
+                                    c, x_in, w_, e_, h_p, r)
+                                outs, vjp = jax.vjp(fn, rows, tuple(e_p))
+                            elif c == V - 1:
+                                fn = lambda r, h_: chunk_fwd(
+                                    c, x_in, w_, e_p, h_, r)
+                                outs, vjp = jax.vjp(fn, rows, tuple(h_p))
+                            else:
+                                fn = lambda r: chunk_fwd(c, x_in, w_,
+                                                         e_p, h_p, r)
+                                outs, vjp = jax.vjp(fn, rows)
+                            is_final = jnp.logical_and(
+                                jnp.equal(d, Pdeg - 1), c == V - 1)
+                            cot_h = jnp.where(
+                                is_final, jnp.zeros(hshape, hdtype),
+                                cots_[c, jnp.mod(w_, K2)].astype(hdtype))
+                            cot_l = jnp.where(is_final, 1.0, 0.0).astype(
+                                jnp.float32)
+                            cot = vjp((cot_h, cot_l))
+                            d_rows = cot[0]
+                            d_e = cot[1] if c == 0 else zero_e
+                            d_h = (cot[-1] if c == V - 1 else zero_h)
+                            return d_rows, d_e, d_h
+
+                        def w_skip(args, c=c, rows=rows):
+                            return (jax.tree.map(jnp.zeros_like, rows),
+                                    zero_e, zero_h)
+
+                        d_rows, d_e, d_h = lax.cond(
+                            jnp.equal(wc, c), w_fire, w_skip,
+                            (stash, cots, wm))
+                        gb = [acc.at[c * Lc:(c + 1) * Lc].add(dr)
+                              for acc, dr in zip(gb, d_rows)]
+                        ge = jax.tree.map(jnp.add, ge, d_e)
+                        gh = jax.tree.map(jnp.add, gh, d_h)
+
                 # --- ring messages (unconditional) ---
                 fmsg = lax.ppermute(fmsg, pp_axis, perm_fwd)
                 bmsg = lax.ppermute(bmsg, pp_axis, perm_bwd)
@@ -613,10 +702,12 @@ class PipelineDecoderLM(nn.Layer):
             gh0 = jax.tree.map(jnp.zeros_like, tuple(h_p))
             gb0 = [jnp.zeros_like(leaf) for leaf in b_local]
 
+            tab_keys = ("fchunk", "fmb", "bchunk", "bmb", "rcvf", "rcvb")
+            if has_wgrad and with_backward:
+                tab_keys = tab_keys + ("wchunk", "wmb")
             d_tabs = [lax.dynamic_index_in_dim(tabs[k], d, 0,
                                                keepdims=False)
-                      for k in ("fchunk", "fmb", "bchunk", "bmb",
-                                "rcvf", "rcvb")]
+                      for k in tab_keys]
             if with_backward:
                 carry0 = (stash0, cots0, fmsg0, bmsg0,
                           jnp.zeros((), jnp.float32), ge0, gh0, gb0)

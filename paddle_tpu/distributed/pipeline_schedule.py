@@ -16,6 +16,20 @@ reproduces the reference schedules' dependency structure:
               1F1B profile (~P, not M, stashed activations)
 - interleave: V virtual chunks per device on a circular ring (device d
               owns virtual stages {d, d+P, ...}); cap (V-1)*P + (P-d)
+- 1f1b_packed / interleave_packed: same dependency structure, but a
+  device may fire an F AND a B in the SAME tick. The fused lockstep
+  scan traces both phases into every tick anyway (their cost is paid
+  whether or not they fire), so packing ~halves the tick count in
+  steady state — the lockstep-XLA analogue of what zero-bubble
+  scheduling buys an async executor.
+- zb (ZB-H1): backward split into B (activation grad — on the critical
+  path) and W (weight grad — deferred to fill bubbles), after the
+  reference's pipeline_zero_bubble.py (ZB-H1). One op per device/tick,
+  priority B > F > W; activation stash is released at W time. Carried
+  for measurement: in the lockstep scan a W split adds a third traced
+  phase to every tick, which the tick-count model and hardware numbers
+  in PARITY.md show is strictly worse than packing — see
+  `schedule_cost_report`.
 
 Virtual stage g (0..P*V-1) lives on device g % P, local chunk g // P;
 activations travel the +1 ring (the chunk boundary from device P-1 wraps
@@ -32,7 +46,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-__all__ = ["Schedule", "build_schedule"]
+__all__ = ["Schedule", "build_schedule", "schedule_cost_report"]
 
 
 @dataclass
@@ -53,6 +67,14 @@ class Schedule:
     rcvb: np.ndarray    # [P, T, V]
     stash_depth: int    # fwd-input stash slots needed per chunk
     cot_depth: int      # cotangent stash slots needed per chunk
+    # zero-bubble only: deferred weight-grad ops (-1 none; B means
+    # activation-grad-only when these are present)
+    wchunk: np.ndarray = None  # [P, T]
+    wmb: np.ndarray = None     # [P, T]
+
+    @property
+    def has_wgrad(self):
+        return self.wchunk is not None
 
     @property
     def num_virtual_stages(self):
@@ -71,29 +93,33 @@ def build_schedule(P: int, V: int, M: int, style: str = "1f1b") -> Schedule:
     """
     if style == "gpipe":
         style = "fthenb"
-    assert style in ("fthenb", "1f1b", "interleave"), style
+    assert style in ("fthenb", "1f1b", "interleave", "1f1b_packed",
+                     "interleave_packed", "zb"), style
+    packed = style.endswith("_packed")
+    base = style[:-7] if packed else style
     N = P * V
-    if style == "1f1b":
-        assert V == 1, "1f1b is the V=1 schedule; use interleave for V>1"
-        assert M >= P, f"1F1B needs microbatches >= pp degree ({M} < {P})"
-    if style == "interleave":
+    if base in ("1f1b", "zb"):
+        assert V == 1, f"{base} is the V=1 schedule; use interleave for V>1"
+        assert M >= P, f"{base} needs microbatches >= pp degree ({M} < {P})"
+    if base == "interleave":
         assert V > 1, "interleave needs num_virtual_stages V > 1"
         assert M % P == 0, \
             f"interleave needs microbatches % pp == 0 ({M} % {P})"
-    if style == "fthenb" and V > 1:
+    if base == "fthenb" and V > 1:
         assert M % P == 0, \
             f"fthenb with virtual stages needs microbatches % pp == 0 " \
             f"({M} % {P})"
 
-    if style == "fthenb":
+    if base == "fthenb":
         cap = [M * V + 1] * P
         b_priority = False
-    elif style == "1f1b":
+    elif base in ("1f1b", "zb"):
         cap = [P - d for d in range(P)]
         b_priority = True
     else:  # interleave (Megatron-style warmup depth)
         cap = [(V - 1) * P + (P - d) for d in range(P)]
         b_priority = True
+    split_w = base == "zb"
 
     def f_order(d):
         """Per-device forward issue order: groups of P microbatches cycle
@@ -125,39 +151,72 @@ def build_schedule(P: int, V: int, M: int, style: str = "1f1b") -> Schedule:
     border = [b_order(d) for d in range(P)]
     fptr = [0] * P
     bptr = [0] * P
+    wptr = [0] * P
     fdone = {}  # (g, f) -> tick
     bdone = {}
+    wdone = {}
     fire_f = []  # (t, g, f)
     fire_b = []
+    fire_w = []
     t = 0
-    max_t = 8 * (M * V + N) + 64
-    while sum(bptr) < P * V * M:
+    max_t = 8 * (M * V * (3 if split_w else 1) + N) + 64
+    target = P * V * M
+
+    def _b_ready(d):
+        if bptr[d] >= V * M:
+            return False
+        c, b = border[d][bptr[d]]
+        g = c * P + d
+        if g == N - 1:
+            return fdone.get((g, b), max_t) < t
+        return bdone.get((g + 1, b), max_t) < t
+
+    def _f_ready(d):
+        if fptr[d] >= V * M or fptr[d] - bptr[d] >= cap[d]:
+            return False
+        if split_w and fptr[d] - wptr[d] >= cap[d] + 1:
+            # ZB-H1 memory bound: the stash lives [wptr, fptr) (the W
+            # pass remats from the stashed chunk input), so deferring W
+            # unboundedly would grow activation memory to M; cap the
+            # window at the 1F1B depth + 1 slack
+            return False
+        c, f = forder[d][fptr[d]]
+        g = c * P + d
+        return g == 0 or fdone.get((g - 1, f), max_t) < t
+
+    def _w_ready(d):
+        # W(g, b) after its own B(g, b); same order as B
+        if not split_w or wptr[d] >= V * M:
+            return False
+        c, w = border[d][wptr[d]]
+        g = c * P + d
+        return bdone.get((g, w), max_t) < t
+
+    while (sum(wptr) if split_w else sum(bptr)) < target:
         assert t < max_t, f"pipeline scheduler did not converge ({style})"
         for d in range(P):
-            b_ready = f_ready = False
-            if bptr[d] < V * M:
-                c, b = border[d][bptr[d]]
-                g = c * P + d
-                if g == N - 1:
-                    b_ready = fdone.get((g, b), max_t) < t
-                else:
-                    b_ready = bdone.get((g + 1, b), max_t) < t
-            if fptr[d] < V * M and fptr[d] - bptr[d] < cap[d]:
-                c, f = forder[d][fptr[d]]
-                g = c * P + d
-                f_ready = g == 0 or fdone.get((g - 1, f), max_t) < t
-            if b_ready and (b_priority or not f_ready):
+            fired = False
+            if _b_ready(d) and (b_priority or not _f_ready(d)):
                 c, b = border[d][bptr[d]]
                 g = c * P + d
                 fire_b.append((t, g, b))
                 bdone[(g, b)] = t
                 bptr[d] += 1
-            elif f_ready:
+                fired = True
+            if _f_ready(d) and (packed or not fired):
                 c, f = forder[d][fptr[d]]
                 g = c * P + d
                 fire_f.append((t, g, f))
                 fdone[(g, f)] = t
                 fptr[d] += 1
+                fired = True
+            if _w_ready(d) and not fired:
+                # ZB-H1: weight grads fill ticks with no F/B to run
+                c, w = border[d][wptr[d]]
+                g = c * P + d
+                fire_w.append((t, g, w))
+                wdone[(g, w)] = t
+                wptr[d] += 1
         t += 1
     T = t
 
@@ -182,16 +241,29 @@ def build_schedule(P: int, V: int, M: int, style: str = "1f1b") -> Schedule:
             pd, pc = (g - 1) % P, (g - 1) // P
             rcvb[pd, tick + 1, pc] = b
 
+    wchunk = wmb = None
+    if split_w:
+        wchunk = np.full((P, T), -1, np.int32)
+        wmb = np.full((P, T), -1, np.int32)
+        for tick, g, w in fire_w:
+            d, c = g % P, g // P
+            wchunk[d, tick] = c
+            wmb[d, tick] = w
+
     # exact stash depths: max simultaneously-live entries per chunk.
     # fwd input of (g, f) lives from its arrival tick through B(g, f)'s
-    # tick (the remat backward re-reads it); chunk 0's stage-0 input is
-    # the ids array itself (no stash).
+    # tick (the remat backward re-reads it) — or through W(g, f) when
+    # weight grads are deferred (zb); chunk 0's stage-0 input is the ids
+    # array itself (no stash).
+    def _rel(g, f):
+        return (wdone[(g, f)] if split_w else bdone[(g, f)]) + 1
+
     stash_depth = 1
     for g in range(1, N):
         events = []
         for f in range(M):
             arrive = fdone[(g - 1, f)] + 1
-            release = bdone[(g, f)] + 1
+            release = _rel(g, f)
             events.append((arrive, 1))
             events.append((release, -1))
         stash_depth = max(stash_depth, _max_overlap(events))
@@ -200,14 +272,15 @@ def build_schedule(P: int, V: int, M: int, style: str = "1f1b") -> Schedule:
         events = []
         for b in range(M):
             arrive = bdone[(g + 1, b)] + 1
-            release = bdone[(g, b)] + 1
+            release = _rel(g, b)  # zb: the W pass re-reads the cotangent
             events.append((arrive, 1))
             events.append((release, -1))
         cot_depth = max(cot_depth, _max_overlap(events))
 
     return Schedule(P=P, V=V, M=M, T=T, style=style, fchunk=fchunk,
                     fmb=fmb, bchunk=bchunk, bmb=bmb, rcvf=rcvf, rcvb=rcvb,
-                    stash_depth=stash_depth, cot_depth=cot_depth)
+                    stash_depth=stash_depth, cot_depth=cot_depth,
+                    wchunk=wchunk, wmb=wmb)
 
 
 def _max_overlap(events):
@@ -216,3 +289,53 @@ def _max_overlap(events):
         cur += delta
         peak = max(peak, cur)
     return peak
+
+
+# op costs in forward-chunk units for the lockstep scan engine
+# (pipeline.py): a combined backward traces remat-forward + full
+# backward (~1 + 2); the zb split pays the remat TWICE (once in the
+# activation-grad pass, once in the weight-grad pass)
+_COST = {"F": 1.0, "B": 3.0, "Bd": 2.0, "W": 2.0}
+
+
+def schedule_cost_report(P, M, V=1):
+    """Tick tables + lockstep cost model for every schedule style at
+    (P, M[, V]) — the measurement VERDICT r2 asked for (reference
+    pipeline_zero_bubble.py ZB-H1). Per tick, every device executes the
+    ops its tables fire; the wall-clock of a lockstep tick is the MAX
+    over devices of its fired-op cost (devices synchronize on the ring
+    ppermute each tick). Returns {style: {ticks, cost, stash, ...}}."""
+    styles = ["fthenb", "1f1b", "1f1b_packed", "zb"]
+    if V > 1:
+        styles = ["fthenb", "interleave", "interleave_packed"]
+    out = {}
+    for style in styles:
+        v = V if "interleave" in style or style == "fthenb" else 1
+        try:
+            s = build_schedule(P, v, M, style)
+        except AssertionError:
+            continue
+        cost = 0.0
+        busy = 0.0
+        for t in range(s.T):
+            tick_max = 0.0
+            for d in range(P):
+                c = 0.0
+                if s.fmb[d, t] >= 0:
+                    c += _COST["F"]
+                if s.bmb[d, t] >= 0:
+                    c += _COST["Bd"] if s.has_wgrad else _COST["B"]
+                if s.has_wgrad and s.wmb[d, t] >= 0:
+                    c += _COST["W"]
+                busy += c
+                tick_max = max(tick_max, c)
+            cost += tick_max
+        useful = P * v * M * (_COST["F"] + _COST["B"])  # total real work
+        out[style] = {
+            "ticks": s.T,
+            "lockstep_cost": cost,
+            "efficiency": useful / (cost * P) if cost else 0.0,
+            "stash_depth": s.stash_depth,
+            "cot_depth": s.cot_depth,
+        }
+    return out

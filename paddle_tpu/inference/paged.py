@@ -37,7 +37,7 @@ import numpy as np
 from ..core.tensor import Tensor
 
 __all__ = ["PagedKVCache", "paged_prefill_write", "paged_decode_attention",
-           "ContinuousBatchingEngine"]
+           "paged_decode_attention_dense", "ContinuousBatchingEngine"]
 
 
 class PagedKVCache:
@@ -162,13 +162,36 @@ def paged_decode_write(k_pool, v_pool, block_tables, positions, k_new,
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens,
-                           scale=None):
+                           scale=None, use_kernel=None):
     """Masked decode attention over the paged cache.
 
     q [B, Hq, D] (one query token per slot); returns [B, Hq, D].
-    Gathers each slot's blocks, masks positions >= seq_len, GQA
-    group-folded (no KV expansion).
+    On TPU routes to the fused Pallas kernel (`kernels/pallas/
+    paged_attention.py` — in-kernel page gathers, no materialized
+    gathered KV); on CPU defaults to the dense XLA reference path below
+    (gather + masked softmax), which the kernel is tested against
+    (tests/kernels/test_paged_attention.py runs the kernel in interpret
+    mode one-vs-other).
     """
+    if use_kernel is None:
+        try:
+            use_kernel = jax.default_backend() != "cpu"
+        except RuntimeError:  # pragma: no cover
+            use_kernel = False
+    if use_kernel:
+        from ..kernels.pallas.paged_attention import (
+            paged_decode_attention_kernel)
+        return paged_decode_attention_kernel(
+            q, k_pool, v_pool, block_tables, seq_lens, scale=scale)
+    return paged_decode_attention_dense(q, k_pool, v_pool, block_tables,
+                                        seq_lens, scale=scale)
+
+
+def paged_decode_attention_dense(q, k_pool, v_pool, block_tables, seq_lens,
+                                 scale=None):
+    """Dense XLA reference for `paged_decode_attention`: gathers each
+    slot's blocks (materializing [B, S_max, Hk, D]), masks positions
+    >= seq_len, GQA group-folded (no KV expansion)."""
     b, hq, d = q.shape
     nb_pool, bs, hk, _ = k_pool.shape
     g = hq // hk

@@ -31,6 +31,15 @@ def _tree_wrap(x):
     return Tensor(x) if isinstance(x, (jax.Array, jax.core.Tracer)) else x
 
 
+# tracer-leak errors that mean "python branched on a tensor value"
+_GRAPH_BREAK_ERRORS = (
+    jax.errors.TracerBoolConversionError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerIntegerConversionError,
+    jax.errors.ConcretizationTypeError,
+)
+
+
 def _tree_unwrap(x):
     return x._data if isinstance(x, Tensor) else x
 
@@ -43,6 +52,7 @@ class _StaticFunction:
     def __init__(self, fn, static_argnums=(), donate_argnums=()):
         self._fn = fn
         self._layer = None
+        self._graph_broken = False
         if hasattr(fn, "forward") and hasattr(fn, "parameters"):
             self._layer = fn
             self._fn = type(fn).forward
@@ -84,13 +94,17 @@ class _StaticFunction:
     def _buffer_items(self):
         return list(self._layer.named_buffers()) if self._layer else []
 
+    def _eager_call(self, *args, **kwargs):
+        if self._layer is not None:
+            return self._fn(self._layer, *args, **kwargs)
+        return self._fn(*args, **kwargs)
+
     def __call__(self, *args, **kwargs):
-        if not _TO_STATIC_ENABLED:
-            # reference enable_to_static(False): run the original eager
-            # code (debuggable — no tracers, python control flow works)
-            if self._layer is not None:
-                return self._fn(self._layer, *args, **kwargs)
-            return self._fn(*args, **kwargs)
+        if not _TO_STATIC_ENABLED or self._graph_broken:
+            # reference enable_to_static(False) / SOT graph-break
+            # fallback: run the original eager code (no tracers, python
+            # control flow works)
+            return self._eager_call(*args, **kwargs)
         params = [p._data for _, p in self._param_items]
         buffers = [b._data for _, b in self._buffer_items]
         tree_args = jax.tree.map(_tree_unwrap, args,
@@ -98,8 +112,28 @@ class _StaticFunction:
         tree_kwargs = jax.tree.map(_tree_unwrap, kwargs,
                                    is_leaf=lambda x: isinstance(x, Tensor))
         key = random_mod.next_key()
-        out, new_buffers = self._jitted(params, buffers, key, tree_args,
-                                        tree_kwargs)
+        try:
+            out, new_buffers = self._jitted(params, buffers, key,
+                                            tree_args, tree_kwargs)
+        except _GRAPH_BREAK_ERRORS as e:
+            # Graph break: the traced function branched on a tensor VALUE
+            # (data-dependent python control flow). The reference's SOT
+            # translator falls back per-op on breaks (sot/opcode_translator/
+            # executor/opcode_executor.py:1594); the retrace design falls
+            # back to eager for THIS function, once, with a warning —
+            # the user's program keeps running instead of dying.
+            import warnings
+
+            name = getattr(self._fn, "__qualname__",
+                           getattr(self._fn, "__name__", "<fn>"))
+            warnings.warn(
+                f"to_static: graph break in {name!r} "
+                f"(data-dependent control flow: {type(e).__name__}); "
+                f"falling back to eager execution for this function. "
+                f"Rewrite with paddle.where / lax.cond-style ops to keep "
+                f"it compiled.", RuntimeWarning, stacklevel=2)
+            self._graph_broken = True
+            return self._eager_call(*args, **kwargs)
         for (_, b), arr in zip(self._buffer_items, new_buffers):
             b._rebind(arr)
         return jax.tree.map(_tree_wrap, out)

@@ -24,9 +24,14 @@ from ..core.random import next_key
 
 
 def _use_pallas(q) -> bool:
+    import os
+
+    force = os.environ.get("PADDLE_FLASH_FORCE")  # A/B switch: pallas|xla
+    if force == "xla":
+        return False
     try:
         if jax.default_backend() == "cpu":
-            return False
+            return force == "pallas"
     except RuntimeError:
         return False
     # MXU-friendly: head_dim multiple of 128 handled by kernel padding; seq

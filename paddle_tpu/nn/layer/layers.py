@@ -46,13 +46,19 @@ class Layer:
             if params is None:
                 raise RuntimeError(
                     "call super().__init__() before assigning parameters")
-            _strip(self, name)
+            if name not in params:  # in-place keeps OrderedDict position
+                _strip(self, name)
             params[name] = value
         elif isinstance(value, Layer):
             if layers is None:
                 raise RuntimeError(
                     "call super().__init__() before assigning sublayers")
-            _strip(self, name)
+            # replacing an existing child (e.g. QAT swapping a Conv2D for
+            # its fake-quant form inside a Sequential) must keep its
+            # POSITION — strip+reinsert would move it to the end and
+            # scramble the container's forward order
+            if name not in layers:
+                _strip(self, name)
             layers[name] = value
         elif params is not None and name in params:
             if value is None:

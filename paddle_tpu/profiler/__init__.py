@@ -150,10 +150,28 @@ def export_chrome_tracing(dir_name, worker_name=None):
 
 
 def export_protobuf(dir_name, worker_name=None):
-    return export_chrome_tracing(dir_name, worker_name)
+    """on_trace_ready handler writing a REAL protobuf dump (reference
+    exports chrome JSON and a protobuf node tree —
+    paddle/fluid/platform/profiler/dump/; schema here is
+    profiler_trace.proto, loadable via `load_profiler_result`)."""
+
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_t{prof._export_count}.pb")
+        prof._export_protobuf(path, name)
+
+    return handler
 
 
 def load_profiler_result(path):
+    """Load an exported trace: .pb (protobuf TraceProto) or chrome .json."""
+    if path.endswith(".pb"):
+        from . import profiler_trace_pb2 as pb
+        t = pb.TraceProto()
+        with open(path, "rb") as f:
+            t.ParseFromString(f.read())
+        return t
     with open(path) as f:
         return json.load(f)
 
@@ -253,9 +271,28 @@ class Profiler:
             json.dump({"traceEvents": trace,
                        "xplane_dir": self._device_trace_dir}, f)
 
+    def _export_protobuf(self, path, worker_name=""):
+        self._export_count += 1
+        from . import profiler_trace_pb2 as pb
+        t = pb.TraceProto(pid=os.getpid(), worker_name=worker_name,
+                          xplane_dir=self._device_trace_dir or "",
+                          export_index=self._export_count)
+        for e in self._events:
+            ev = t.events.add()
+            ev.name = e["name"]
+            ev.type = e["type"]
+            ev.start_us = float(e["ts"])
+            ev.dur_us = float(e["dur"])
+            ev.tid = int(e["tid"])
+        with open(path, "wb") as f:
+            f.write(t.SerializeToString())
+
     def export(self, path, format="json"):
         self._collect()
-        self._export_chrome(path)
+        if format in ("pb", "protobuf") or path.endswith(".pb"):
+            self._export_protobuf(path)
+        else:
+            self._export_chrome(path)
 
     def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
                 thread_sep=False, time_unit="ms", views=None):

@@ -1,13 +1,23 @@
 """`paddle.quantization` (reference: python/paddle/quantization/ — QAT/PTQ
-framework: QuantConfig, fake quanters, observers, QAT.quantize/convert).
+framework: QuantConfig, fake quanters, observers, QAT.quantize/convert,
+PTQ calibration — qat.py, ptq.py, factory.py, observers/, quanters/).
 
-TPU-first: int8 fake-quant simulates on-device quantization; the real
-int8 path on TPU is XLA's native int8 matmul (v5e doubles int8 peak), so
-`convert` keeps weights int8 + scale and dequantizes at the op edge.
+TPU-first: int8 fake-quant simulates on-device quantization with a
+straight-through estimator; the real int8 path on TPU is XLA's native
+int8 matmul (v5e doubles int8 peak), so `convert` keeps weights int8
+(per-channel scales) and dequantizes at the op edge.
+
+Flows (mirroring the reference drivers):
+- QAT:  q = QAT(cfg); qm = q.quantize(model)  -> fake-quant training
+        dm = q.convert(qm)                    -> int8 deployment form
+- PTQ:  p = PTQ(cfg); om = p.quantize(model)  -> observers inserted
+        run calibration batches through om
+        dm = p.convert(om)                    -> int8 deployment form
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -17,18 +27,116 @@ from ..core.tensor import Tensor
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
            "BaseObserver", "BaseQuanter", "quanter",
-           "AbsmaxObserver", "quanted_linear"]
+           "AbsmaxObserver", "AbsMaxChannelWiseWeightObserver",
+           "PercentileObserver", "quanted_linear"]
 
 
-class FakeQuanterWithAbsMaxObserver(nn.Layer):
+# ---------------------------------------------------------------------------
+# observers / quanters
+# ---------------------------------------------------------------------------
+
+class BaseObserver(nn.Layer):
+    """Observer base (reference quantization/factory.py BaseObserver):
+    collects statistics in forward, yields scales for quantization."""
+
+    quant_bits = 8
+
+    def forward(self, x):
+        return x
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+    @property
+    def qmax(self):
+        return float(2 ** (self.quant_bits - 1) - 1)
+
+
+class BaseQuanter(BaseObserver):
+    """Quanter base (reference BaseQuanter): fake-quantizes in forward."""
+
+
+class AbsmaxObserver(BaseObserver):
+    """PTQ activation observer collecting absmax over calibration batches
+    (reference observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.absmax = 0.0
+
+    def forward(self, x):
+        self.absmax = max(self.absmax, float(jnp.max(jnp.abs(x._data))))
+        return x
+
+    def scales(self):
+        return max(self.absmax, 1e-9) / self.qmax
+
+    scale = scales  # round-2 compat alias
+
+
+class PercentileObserver(BaseObserver):
+    """Percentile activation observer (reference observers/hist.py-style
+    clipping): keeps a sample of |x| and clips at the q-th percentile,
+    robust to outlier activations."""
+
+    def __init__(self, quant_bits=8, percentile=99.9, sample_size=4096):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.percentile = percentile
+        self.sample_size = sample_size
+        self._samples = []
+
+    def forward(self, x):
+        a = np.abs(np.asarray(x._data, np.float32)).reshape(-1)
+        if a.size > self.sample_size:
+            idx = np.random.default_rng(0).choice(a.size, self.sample_size,
+                                                  replace=False)
+            a = a[idx]
+        self._samples.append(a)
+        return x
+
+    def scales(self):
+        if not self._samples:
+            return 1.0 / self.qmax
+        allv = np.concatenate(self._samples)
+        return max(float(np.percentile(allv, self.percentile)),
+                   1e-9) / self.qmax
+
+
+class AbsMaxChannelWiseWeightObserver(BaseObserver):
+    """Per-output-channel weight scales (reference
+    observers/channel_wise_abs_max.py) — int8 weights keep one scale per
+    output channel, the accuracy-critical choice for conv/linear."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scales = None
+
+    def observe_weight(self, w, channel_axis):
+        axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+        s = jnp.max(jnp.abs(w), axis=axes) / self.qmax
+        self._scales = jnp.maximum(s, 1e-9)
+        return self._scales
+
+    def scales(self):
+        return self._scales
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
     """Fake quantization with a moving-average absmax observer (reference
-    fake_quanter.py)."""
+    quanters/abs_max.py FakeQuanterWithAbsMaxObserver)."""
 
     def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32",
                  name=None):
         super().__init__()
         self.moving_rate = moving_rate
         self.bit_length = bit_length
+        self.quant_bits = bit_length
         self.register_buffer("scale",
                              Tensor(jnp.ones([], jnp.float32)))
         self._initialized = False
@@ -45,7 +153,6 @@ class FakeQuanterWithAbsMaxObserver(nn.Layer):
                              (1 - self.moving_rate) * cur)
             self.scale._rebind(jnp.asarray(new_scale, jnp.float32))
         s = jnp.maximum(jnp.asarray(float(self.scale._data)), 1e-9)
-        import jax
 
         def fq_ste(a):
             # straight-through estimator: rounding is identity in grad
@@ -55,36 +162,53 @@ class FakeQuanterWithAbsMaxObserver(nn.Layer):
 
         return apply(fq_ste, x, name="fake_quant")
 
+    def scales(self):
+        return float(self.scale._data) / self.qmax
 
-class AbsmaxObserver(nn.Layer):
-    """PTQ observer collecting absmax over calibration batches."""
 
-    def __init__(self, quant_bits=8):
-        super().__init__()
-        self.quant_bits = quant_bits
-        self.absmax = 0.0
+_QUANTER_REGISTRY = {}
 
-    def forward(self, x):
-        self.absmax = max(self.absmax, float(jnp.max(jnp.abs(x._data))))
-        return x
 
-    def scale(self):
-        return self.absmax / (2 ** (self.quant_bits - 1) - 1)
+def quanter(name):
+    """Class decorator registering a quanter under a config name
+    (reference quantization/factory.py quanter)."""
 
+    def wrap(cls):
+        _QUANTER_REGISTRY[name] = cls
+        return cls
+    return wrap
+
+
+quanter("FakeQuanterWithAbsMaxObserver")(FakeQuanterWithAbsMaxObserver)
+quanter("AbsmaxObserver")(AbsmaxObserver)
+quanter("PercentileObserver")(PercentileObserver)
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
 
 class QuantConfig:
     """reference config.py QuantConfig: maps layer types/instances to
-    quanter factories."""
+    quanter factories. Factories may be classes, callables, or registered
+    names (strings)."""
 
     def __init__(self, activation=None, weight=None):
-        self.activation = activation
-        self.weight = weight
+        self.activation = self._resolve(activation)
+        self.weight = self._resolve(weight)
         self._type_configs = {}
+
+    @staticmethod
+    def _resolve(q):
+        if isinstance(q, str):
+            return _QUANTER_REGISTRY[q]
+        return q
 
     def add_type_config(self, layer_type, activation=None, weight=None):
         for t in (layer_type if isinstance(layer_type, (list, tuple))
                   else [layer_type]):
-            self._type_configs[t] = (activation, weight)
+            self._type_configs[t] = (self._resolve(activation),
+                                     self._resolve(weight))
 
     def _quanters_for(self, layer):
         for t, (a, w) in self._type_configs.items():
@@ -93,15 +217,24 @@ class QuantConfig:
         return self.activation, self.weight
 
 
+# ---------------------------------------------------------------------------
+# QAT forms (fake-quant training)
+# ---------------------------------------------------------------------------
+
+def _instantiate(q):
+    return q() if callable(q) and not isinstance(q, nn.Layer) else q
+
+
 class QuantedLinear(nn.Layer):
-    """Linear with fake-quantized activations and weights (QAT form)."""
+    """Linear with fake-quantized activations and weights (QAT form,
+    reference nn/quant_layers QuantizedLinear)."""
 
     def __init__(self, linear, a_quanter, w_quanter):
         super().__init__()
         self.weight = linear.weight
         self.bias = linear.bias
-        self.a_quanter = a_quanter() if callable(a_quanter) else a_quanter
-        self.w_quanter = w_quanter() if callable(w_quanter) else w_quanter
+        self.a_quanter = _instantiate(a_quanter)
+        self.w_quanter = _instantiate(w_quanter)
 
     def forward(self, x):
         if self.a_quanter is not None:
@@ -112,26 +245,137 @@ class QuantedLinear(nn.Layer):
         return nn.functional.linear(x, w, self.bias)
 
 
-class ConvertedInt8Linear(nn.Layer):
-    """Deployment form: int8 weight + fp scale."""
+class QuantedConv2D(nn.Layer):
+    """Conv2D with fake-quantized activations and weights (QAT form,
+    reference nn/quant_layers QuantizedConv2D)."""
 
-    def __init__(self, qlinear):
+    def __init__(self, conv, a_quanter, w_quanter):
         super().__init__()
-        qmax = 127.0
-        w = qlinear.weight._data
-        scale = float(jnp.max(jnp.abs(w))) / qmax
-        self.register_buffer("w_int8", Tensor(
-            jnp.clip(jnp.round(w / scale), -qmax, qmax).astype(jnp.int8)))
-        self.scale = scale
-        self.bias = qlinear.bias
+        self._conv = conv
+        self.weight = conv.weight
+        self.bias = conv.bias
+        self.a_quanter = _instantiate(a_quanter)
+        self.w_quanter = _instantiate(w_quanter)
 
     def forward(self, x):
-        w = Tensor(self.w_int8._data.astype(jnp.float32) * self.scale)
+        if self.a_quanter is not None:
+            x = self.a_quanter(x)
+        w = self.weight
+        if self.w_quanter is not None:
+            w = self.w_quanter(w)
+        return nn.functional.conv2d(
+            x, w, self.bias, stride=self._conv._stride,
+            padding=self._conv._padding, dilation=self._conv._dilation,
+            groups=self._conv._groups)
+
+
+# ---------------------------------------------------------------------------
+# PTQ forms (observer calibration)
+# ---------------------------------------------------------------------------
+
+class ObservedLinear(nn.Layer):
+    def __init__(self, linear, a_observer):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self.a_observer = _instantiate(a_observer) or AbsmaxObserver()
+
+    def forward(self, x):
+        x = self.a_observer(x)
+        return nn.functional.linear(x, self.weight, self.bias)
+
+
+class ObservedConv2D(nn.Layer):
+    def __init__(self, conv, a_observer):
+        super().__init__()
+        self._conv = conv
+        self.weight = conv.weight
+        self.bias = conv.bias
+        self.a_observer = _instantiate(a_observer) or AbsmaxObserver()
+
+    def forward(self, x):
+        x = self.a_observer(x)
+        return nn.functional.conv2d(
+            x, self.weight, self.bias, stride=self._conv._stride,
+            padding=self._conv._padding, dilation=self._conv._dilation,
+            groups=self._conv._groups)
+
+
+# ---------------------------------------------------------------------------
+# deployment forms: int8 weights (per-channel), fp compute at the edge
+# ---------------------------------------------------------------------------
+
+def _quantize_weight(w, channel_axis):
+    """-> (int8 weights, per-channel fp32 scales)"""
+    obs = AbsMaxChannelWiseWeightObserver()
+    scales = obs.observe_weight(w, channel_axis)
+    shape = [1] * w.ndim
+    shape[channel_axis] = -1
+    s = scales.reshape(shape)
+    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    return q, scales
+
+
+class ConvertedInt8Linear(nn.Layer):
+    """Deployment form: per-out-channel int8 weight + fp scales; optional
+    static activation scale from the PTQ observer."""
+
+    def __init__(self, src, act_scale=None):
+        super().__init__()
+        w = src.weight._data  # [in, out]
+        q, scales = _quantize_weight(w, channel_axis=1)
+        self.register_buffer("w_int8", Tensor(q))
+        self.register_buffer("w_scales", Tensor(scales))
+        self.bias = src.bias
+        self.act_scale = act_scale
+
+    def forward(self, x):
+        if self.act_scale is not None:  # simulate static input quant
+            s = self.act_scale
+
+            def act_q(a):
+                return jnp.clip(jnp.round(a / s), -127, 127) * s
+            x = apply(act_q, x, name="act_quant")
+        w = Tensor(self.w_int8._data.astype(jnp.float32) *
+                   self.w_scales._data[None, :])
         return nn.functional.linear(x, w, self.bias)
 
 
+class ConvertedInt8Conv2D(nn.Layer):
+    def __init__(self, src, act_scale=None):
+        super().__init__()
+        conv = src._conv
+        w = src.weight._data  # [out, in, kh, kw]
+        q, scales = _quantize_weight(w, channel_axis=0)
+        self.register_buffer("w_int8", Tensor(q))
+        self.register_buffer("w_scales", Tensor(scales))
+        self.bias = src.bias
+        self._conv = conv
+        self.act_scale = act_scale
+
+    def forward(self, x):
+        if self.act_scale is not None:
+            s = self.act_scale
+
+            def act_q(a):
+                return jnp.clip(jnp.round(a / s), -127, 127) * s
+            x = apply(act_q, x, name="act_quant")
+        w = Tensor(self.w_int8._data.astype(jnp.float32) *
+                   self.w_scales._data[:, None, None, None])
+        return nn.functional.conv2d(
+            x, w, self.bias, stride=self._conv._stride,
+            padding=self._conv._padding, dilation=self._conv._dilation,
+            groups=self._conv._groups)
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
 class QAT:
-    """Quantization-aware training driver (reference qat.py)."""
+    """Quantization-aware training driver (reference qat.py): quantize()
+    swaps Linear/Conv2D for fake-quant forms; train; convert() emits the
+    int8 deployment model."""
 
     def __init__(self, config: QuantConfig):
         self.config = config
@@ -143,10 +387,11 @@ class QAT:
 
     def _swap(self, layer):
         for name, sub in list(layer.named_children()):
-            if isinstance(sub, nn.Linear):
-                a, w = self.config._quanters_for(sub)
-                if a is not None or w is not None:
-                    setattr(layer, name, QuantedLinear(sub, a, w))
+            a, w = self.config._quanters_for(sub)
+            if isinstance(sub, nn.Linear) and (a or w):
+                setattr(layer, name, QuantedLinear(sub, a, w))
+            elif isinstance(sub, nn.Conv2D) and (a or w):
+                setattr(layer, name, QuantedConv2D(sub, a, w))
             else:
                 self._swap(sub)
 
@@ -155,18 +400,47 @@ class QAT:
         self._convert(target)
         return target
 
+    @staticmethod
+    def _act_scale(sub):
+        q = getattr(sub, "a_quanter", None) or getattr(
+            sub, "a_observer", None)
+        if isinstance(q, BaseObserver):
+            try:
+                s = q.scales()
+                return float(s) if s is not None else None
+            except (NotImplementedError, TypeError):
+                return None
+        return None
+
     def _convert(self, layer):
         for name, sub in list(layer.named_children()):
-            if isinstance(sub, QuantedLinear):
-                setattr(layer, name, ConvertedInt8Linear(sub))
+            if isinstance(sub, (QuantedLinear, ObservedLinear)):
+                setattr(layer, name,
+                        ConvertedInt8Linear(sub, self._act_scale(sub)))
+            elif isinstance(sub, (QuantedConv2D, ObservedConv2D)):
+                setattr(layer, name,
+                        ConvertedInt8Conv2D(sub, self._act_scale(sub)))
             else:
                 self._convert(sub)
 
 
 class PTQ(QAT):
-    """Post-training quantization: observers instead of fake quanters."""
+    """Post-training quantization (reference ptq.py): quantize() inserts
+    OBSERVERS (model still fp32); run calibration batches; convert()
+    quantizes weights per-channel and freezes observed act scales."""
 
-    pass
+    def _swap(self, layer):
+        for name, sub in list(layer.named_children()):
+            a, w = self.config._quanters_for(sub)
+            # honor the config gating exactly like QAT._swap: a layer the
+            # config never opted in must NOT get an observer (and must
+            # not be int8-converted later)
+            if isinstance(sub, nn.Linear) and (a or w):
+                setattr(layer, name, ObservedLinear(sub, a))
+            elif isinstance(sub, nn.Conv2D) and (a or w):
+                setattr(layer, name, ObservedConv2D(sub, a))
+            else:
+                self._swap(sub)
 
 
 def quanted_linear(x, w_int8, scale, bias=None):
@@ -177,35 +451,3 @@ def quanted_linear(x, w_int8, scale, bias=None):
 def _clone(model):
     import copy
     return copy.deepcopy(model)
-
-
-class BaseObserver(nn.Layer):
-    """Observer base (reference quantization/factory.py BaseObserver):
-    collects statistics in forward, yields scales for quantization."""
-
-    def forward(self, x):
-        return x
-
-    def scales(self):
-        raise NotImplementedError
-
-    def zero_points(self):
-        return None
-
-
-class BaseQuanter(BaseObserver):
-    """Quanter base (reference BaseQuanter): fake-quantizes in forward."""
-
-
-def quanter(name):
-    """Class decorator registering a quanter under a config name
-    (reference quantization/factory.py quanter)."""
-    registry = _QUANTER_REGISTRY
-
-    def wrap(cls):
-        registry[name] = cls
-        return cls
-    return wrap
-
-
-_QUANTER_REGISTRY = {}

@@ -1,0 +1,113 @@
+"""Launch-integrated auto-tuner E2E (reference
+python/paddle/distributed/auto_tuner/tuner.py:21 — `launch
+--auto_tuner_json`: trial subprocesses, persistent history, resume)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+TRIAL_SCRIPT = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    from paddle_tpu.distributed.auto_tuner import (current_trial_config,
+                                                   report_cost)
+    cfg = current_trial_config()
+    if os.environ.get("PADDLE_AUTO_TUNER_RESULT"):
+        # trial run: fake cost model — dp-heavy configs are 'fastest'
+        cost = 10.0 / cfg["dp_degree"] + cfg["micro_batches"] * 0.01
+        report_cost(cost)
+    else:
+        # final run with the winner exported
+        with open(os.environ["FINAL_OUT"], "w") as f:
+            json.dump(cfg, f)
+""")
+
+
+def _run_launch(tmp_path, spec_path, extra_env=None):
+    script = tmp_path / "trial.py"
+    script.write_text(TRIAL_SCRIPT.format(repo=REPO))
+    env = dict(os.environ, FINAL_OUT=str(tmp_path / "final.json"),
+               JAX_PLATFORMS="cpu")
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--auto_tuner_json", str(spec_path),
+         "--log_dir", str(tmp_path / "log"), str(script)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=300)
+
+
+def test_tuner_picks_best_and_runs_final(tmp_path):
+    spec = {
+        "candidates": [
+            {"dp_degree": 1, "mp_degree": 8, "pp_degree": 1,
+             "micro_batches": 1},
+            {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+             "micro_batches": 1},
+            {"dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+             "micro_batches": 2},
+        ],
+        "history_path": str(tmp_path / "hist.json"),
+        "best_path": str(tmp_path / "best.json"),
+    }
+    spec_path = tmp_path / "tuner.json"
+    spec_path.write_text(json.dumps(spec))
+    r = _run_launch(tmp_path, spec_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    hist = json.loads((tmp_path / "hist.json").read_text())
+    assert len(hist) == 3 and all("cost" in h for h in hist)
+    best = json.loads((tmp_path / "best.json").read_text())
+    assert best["config"]["dp_degree"] == 8  # fake cost model's winner
+    # the final (real) run received the winning config
+    final = json.loads((tmp_path / "final.json").read_text())
+    assert final["dp_degree"] == 8
+
+
+def test_tuner_history_resume(tmp_path):
+    """A history file from an interrupted search is honored: tried
+    configs are skipped, only the remainder runs."""
+    c1 = {"dp_degree": 1, "mp_degree": 8, "pp_degree": 1,
+          "micro_batches": 1}
+    c2 = {"dp_degree": 8, "mp_degree": 1, "pp_degree": 1,
+          "micro_batches": 1}
+    spec = {
+        "candidates": [c1, c2],
+        "history_path": str(tmp_path / "hist.json"),
+        "best_path": str(tmp_path / "best.json"),
+    }
+    # pre-seed: c1 already measured (with a sentinel cost we can detect)
+    (tmp_path / "hist.json").write_text(json.dumps(
+        [{"config": c1, "cost": 123.456}]))
+    spec_path = tmp_path / "tuner.json"
+    spec_path.write_text(json.dumps(spec))
+    r = _run_launch(tmp_path, spec_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    hist = json.loads((tmp_path / "hist.json").read_text())
+    assert len(hist) == 2
+    # c1's entry is the UNTOUCHED pre-seeded one (it was not re-run)
+    assert hist[0]["cost"] == 123.456
+    assert hist[1]["config"] == c2 and "cost" in hist[1]
+
+
+def test_tuner_records_failed_trials(tmp_path):
+    bad = {"dp_degree": 0, "mp_degree": 1, "pp_degree": 1,
+           "micro_batches": 1}  # div-by-zero in the trial script
+    good = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 1,
+            "micro_batches": 1}
+    spec = {"candidates": [bad, good],
+            "history_path": str(tmp_path / "hist.json"),
+            "best_path": str(tmp_path / "best.json")}
+    spec_path = tmp_path / "tuner.json"
+    spec_path.write_text(json.dumps(spec))
+    r = _run_launch(tmp_path, spec_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    hist = json.loads((tmp_path / "hist.json").read_text())
+    assert "error" in hist[0] and "cost" in hist[1]
+    best = json.loads((tmp_path / "best.json").read_text())
+    assert best["config"] == good

@@ -63,6 +63,29 @@ def test_launch_single_node_two_procs(tmp_path):
     assert (tmp_path / "ok.1").exists(), _dump_logs(log_dir)
 
 
+def test_launch_hybrid_2proc_x_4dev(tmp_path):
+    """dp x mp train step on a PROCESS-SPANNING mesh: 2 launcher-spawned
+    processes x 4 virtual devices each = an 8-device mesh whose dp axis
+    crosses the process (DCN) boundary — the scale topology the
+    single-process dryrun cannot prove (VERDICT r2 #10)."""
+    port = _free_port()
+    log_dir = tmp_path / "logs"
+    worker = Path(__file__).resolve().parent / "hybrid_worker.py"
+    cmd = [
+        sys.executable, "-m", "paddle_tpu.distributed.launch",
+        "--master", f"127.0.0.1:{port}",
+        "--nnodes", "1", "--nproc_per_node", "2",
+        "--log_dir", str(log_dir), "--max_restart", "0",
+        str(worker), str(tmp_path),
+    ]
+    r = subprocess.run(cmd, env=_clean_env(log_dir), cwd=str(REPO),
+                       capture_output=True, text=True, timeout=900)
+    assert r.returncode == 0, (r.stdout, r.stderr, _dump_logs(log_dir))
+    l0 = (tmp_path / "hybrid_loss.0").read_text()
+    l1 = (tmp_path / "hybrid_loss.1").read_text()
+    assert l0 == l1, (l0, l1)  # replicated loss identical across procs
+
+
 def test_launch_two_nodes_rendezvous(tmp_path):
     """nnodes=2: two launcher invocations (one per 'node') rendezvous on
     the shared master endpoint."""

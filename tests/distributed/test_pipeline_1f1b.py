@@ -56,7 +56,9 @@ def _ids(cfg=CFG, batch=8):
 # ---------------------------------------------------------------- scheduler
 
 def test_schedule_dependencies_respected():
-    for style, V in [("fthenb", 1), ("1f1b", 1), ("interleave", 2)]:
+    for style, V in [("fthenb", 1), ("1f1b", 1), ("interleave", 2),
+                     ("1f1b_packed", 1), ("interleave_packed", 2),
+                     ("zb", 1)]:
         s = build_schedule(4, V, 8, style)
         N = 4 * V
         fdone, bdone = {}, {}
@@ -157,6 +159,29 @@ def test_interleave_acc_align_with_padding(gpipe_ref):
     """V=2 over pp=4 -> 8 virtual stages from 4 real layers: exercises
     identity-masked pad rows + round-robin chunk placement."""
     _check_align(_make(gpipe_ref["mesh"], "interleave", 8, V=2), gpipe_ref)
+
+
+def test_1f1b_packed_acc_align(gpipe_ref):
+    """Packed: a device may fire F and B in the same tick."""
+    _check_align(_make(gpipe_ref["mesh"], "1f1b_packed", 4), gpipe_ref)
+
+
+def test_zb_acc_align(gpipe_ref):
+    """ZB-H1: backward split into activation-grad (B) and deferred
+    weight-grad (W) ops — gradients must still match GPipe exactly."""
+    _check_align(_make(gpipe_ref["mesh"], "zb", 4), gpipe_ref)
+
+
+def test_zb_w_after_b_and_memory_capped():
+    s = build_schedule(4, 1, 16, "zb")
+    for d in range(4):
+        bt = {int(m): t for t, m in enumerate(s.bmb[d]) if m >= 0}
+        wt = {int(m): t for t, m in enumerate(s.wmb[d]) if m >= 0}
+        assert set(bt) == set(wt) == set(range(16))
+        for m in range(16):
+            assert wt[m] > bt[m]
+    # ZB-H1 memory bound: stash stays ~P as M grows (not M)
+    assert build_schedule(4, 1, 64, "zb").stash_depth <= 4 + 1
 
 
 # ----------------------------------------------------------- train step

@@ -1,0 +1,80 @@
+"""to_static graph-break fallback.
+
+Reference capability: SOT falls back per-op on data-dependent control
+flow (python/paddle/jit/sot/opcode_translator/executor/
+opcode_executor.py:1594 graph breaks). The retrace-based to_static
+cannot partially compile, so a break falls back to eager for that
+function — with a one-time warning — instead of crashing the program.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class _Gated(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.a = nn.Linear(4, 4)
+        self.b = nn.Linear(4, 4)
+
+    def forward(self, x):
+        if float(x.sum().numpy()) > 0:  # tensor-dependent python branch
+            return self.a(x)
+        return self.b(x)
+
+
+def test_graph_break_warns_once_and_runs_eagerly():
+    net = paddle.jit.to_static(_Gated())
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        out = net(x)
+    msgs = [str(r.message) for r in rec
+            if issubclass(r.category, RuntimeWarning)]
+    assert any("graph break" in m for m in msgs), msgs
+    assert out.shape == [2, 4]
+    with warnings.catch_warnings(record=True) as rec2:
+        warnings.simplefilter("always")
+        out2 = net(-x)  # second call: silent eager, other branch taken
+    assert not any("graph break" in str(r.message) for r in rec2)
+    assert out2.shape == [2, 4]
+    # branches actually differ (different Linear weights)
+    assert not np.allclose(out.numpy(), -out2.numpy())
+
+
+def test_training_continues_after_break():
+    net = paddle.jit.to_static(_Gated())
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    opt = paddle.optimizer.SGD(learning_rate=0.2,
+                               parameters=net.parameters())
+    losses = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(10):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_clean_function_stays_compiled():
+    calls = [0]
+
+    @paddle.jit.to_static
+    def clean(t):
+        calls[0] += 1
+        return t * 2
+
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    r1 = clean(x)
+    r2 = clean(x)
+    assert calls[0] == 1  # traced once; second call is the cached jit
+    np.testing.assert_allclose(r1.numpy(), 2.0)
+    np.testing.assert_allclose(r2.numpy(), 2.0)

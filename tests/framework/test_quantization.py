@@ -1,0 +1,163 @@
+"""QAT/PTQ framework round trips (reference python/paddle/quantization/
+qat.py, ptq.py, observers/, quanters/ — test/quantization/ test style:
+quantize -> train/calibrate -> convert, accuracy within tolerance of
+fp32).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    QAT, PTQ, QuantConfig, FakeQuanterWithAbsMaxObserver, AbsmaxObserver,
+    PercentileObserver, AbsMaxChannelWiseWeightObserver)
+
+
+_CENTERS = np.random.RandomState(42).randn(4, 1, 8, 8).astype(
+    "float32") * 2
+
+
+def _toy_data(n=256, seed=0):
+    """4-class blobs on an 8x8 'image' (shared centers, per-split noise)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 1, 8, 8).astype("float32")
+    y = rng.randint(0, 4, n)
+    X += _CENTERS[y]
+    return X, y.astype("int64")
+
+
+class LeNetish(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2D(1, 8, 3, padding=1)
+        self.act = nn.ReLU()
+        self.pool = nn.MaxPool2D(2, 2)
+        self.fc1 = nn.Linear(8 * 4 * 4, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        h = self.pool(self.act(self.conv1(x)))
+        h = h.reshape([h.shape[0], -1])
+        return self.fc2(self.act(self.fc1(h)))
+
+
+def _train(model, X, y, epochs=60, lr=1e-2):
+    opt = paddle.optimizer.Adam(learning_rate=lr,
+                                parameters=model.parameters())
+    for _ in range(epochs):
+        logits = model(paddle.to_tensor(X))
+        loss = nn.functional.cross_entropy(logits, paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(loss.numpy())
+
+
+def _acc(model, X, y):
+    model.eval()
+    logits = model(paddle.to_tensor(X))
+    pred = np.asarray(logits.numpy()).argmax(-1)
+    model.train()
+    return float((pred == y).mean())
+
+
+@pytest.fixture(scope="module")
+def fp32_model_and_data():
+    paddle.seed(0)
+    X, y = _toy_data()
+    Xt, yt = _toy_data(128, seed=1)
+    model = LeNetish()
+    _train(model, X, y)
+    acc = _acc(model, Xt, yt)
+    assert acc > 0.8, f"fp32 baseline failed to train ({acc})"
+    return model, X, y, Xt, yt, acc
+
+
+def test_qat_round_trip_accuracy(fp32_model_and_data):
+    model, X, y, Xt, yt, fp32_acc = fp32_model_and_data
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                      weight=FakeQuanterWithAbsMaxObserver)
+    q = QAT(cfg)
+    qmodel = q.quantize(model)
+    # conv AND linear got fake-quant wrappers
+    from paddle_tpu.quantization import QuantedConv2D, QuantedLinear
+    kinds = [type(m).__name__ for _, m in qmodel.named_children()]
+    assert any(isinstance(m, QuantedConv2D)
+               for _, m in qmodel.named_children())
+    assert any(isinstance(m, QuantedLinear)
+               for _, m in qmodel.named_children())
+    # fine-tune with fake quant in the loop (STE gradients)
+    _train(qmodel, X, y, epochs=6, lr=1e-3)
+    qat_acc = _acc(qmodel, Xt, yt)
+    assert qat_acc >= fp32_acc - 0.1, (qat_acc, fp32_acc)
+    # convert to int8 deployment form
+    dmodel = q.convert(qmodel)
+    from paddle_tpu.quantization import (ConvertedInt8Conv2D,
+                                         ConvertedInt8Linear)
+    assert any(isinstance(m, ConvertedInt8Conv2D)
+               for _, m in dmodel.named_children())
+    assert dmodel.fc1.w_int8.numpy().dtype == np.int8
+    int8_acc = _acc(dmodel, Xt, yt)
+    assert int8_acc >= fp32_acc - 0.1, (int8_acc, fp32_acc)
+
+
+def test_ptq_calibrate_convert(fp32_model_and_data):
+    model, X, y, Xt, yt, fp32_acc = fp32_model_and_data
+    cfg = QuantConfig(activation=AbsmaxObserver)
+    p = PTQ(cfg)
+    om = p.quantize(model)
+    # fp32 behavior unchanged while observing
+    np.testing.assert_allclose(
+        np.asarray(om(paddle.to_tensor(Xt)).numpy()),
+        np.asarray(model(paddle.to_tensor(Xt)).numpy()), atol=1e-5)
+    # calibration: observers see a few batches
+    for i in range(0, 128, 32):
+        om(paddle.to_tensor(X[i:i + 32]))
+    assert om.fc1.a_observer.absmax > 0
+    dm = p.convert(om)
+    int8_acc = _acc(dm, Xt, yt)
+    assert int8_acc >= fp32_acc - 0.12, (int8_acc, fp32_acc)
+
+
+def test_ptq_percentile_observer(fp32_model_and_data):
+    model, X, y, Xt, yt, fp32_acc = fp32_model_and_data
+    cfg = QuantConfig(activation=PercentileObserver)
+    p = PTQ(cfg)
+    om = p.quantize(model)
+    for i in range(0, 128, 32):
+        om(paddle.to_tensor(X[i:i + 32]))
+    dm = p.convert(om)
+    int8_acc = _acc(dm, Xt, yt)
+    assert int8_acc >= fp32_acc - 0.12, (int8_acc, fp32_acc)
+
+
+def test_channel_wise_weight_observer():
+    import jax.numpy as jnp
+    w = np.zeros((4, 3), np.float32)
+    w[:, 0] = 1.0
+    w[:, 1] = 10.0
+    w[:, 2] = 0.1
+    obs = AbsMaxChannelWiseWeightObserver()
+    s = obs.observe_weight(jnp.asarray(w), channel_axis=1)
+    np.testing.assert_allclose(np.asarray(s) * 127.0, [1.0, 10.0, 0.1],
+                               rtol=1e-6)
+
+
+def test_quanter_registry_by_name():
+    cfg = QuantConfig(activation="FakeQuanterWithAbsMaxObserver",
+                      weight="FakeQuanterWithAbsMaxObserver")
+    assert cfg.activation is FakeQuanterWithAbsMaxObserver
+
+
+def test_int8_weights_close_to_fp32(fp32_model_and_data):
+    """Per-channel dequantized weights reconstruct fp32 within int8 step."""
+    model, *_ = fp32_model_and_data
+    q = QAT(QuantConfig(activation=None,
+                        weight=FakeQuanterWithAbsMaxObserver))
+    dm = q.convert(q.quantize(model))
+    w_fp = model.fc1.weight.numpy()
+    w_dq = (dm.fc1.w_int8.numpy().astype(np.float32) *
+            dm.fc1.w_scales.numpy()[None, :])
+    step = dm.fc1.w_scales.numpy().max()
+    assert np.abs(w_fp - w_dq).max() <= step * 0.51 + 1e-7

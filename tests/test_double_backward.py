@@ -1,0 +1,190 @@
+"""Double-backward (create_graph=True) on the eager tape.
+
+Reference capability: `paddle.grad(..., create_graph=True)` via
+egr::Backward + GeneralGrad (paddle/fluid/eager/backward.cc:439) and the
+composite VJP rules (paddle/fluid/primitive/). Here the tape re-records
+each node's pullback as a differentiable op, so grad graphs nest to any
+order.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.autograd import grad
+
+
+def test_grad_of_grad_polynomial():
+    x = paddle.to_tensor(np.array([2.0, -1.5], "float32"),
+                         stop_gradient=False)
+    y = (x * x * x).sum()
+    (g1,) = grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), 3 * x.numpy() ** 2, rtol=1e-6)
+    assert g1._node is not None and not g1.stop_gradient
+    (g2,) = grad(g1.sum(), [x])
+    np.testing.assert_allclose(g2.numpy(), 6 * x.numpy(), rtol=1e-6)
+
+
+def test_third_order():
+    x = paddle.to_tensor(np.array([1.5], "float32"), stop_gradient=False)
+    y = (x ** 4).sum()
+    (d1,) = grad(y, [x], create_graph=True)
+    (d2,) = grad(d1.sum(), [x], create_graph=True)
+    (d3,) = grad(d2.sum(), [x])
+    np.testing.assert_allclose(d3.numpy(), 24 * x.numpy(), rtol=1e-6)
+
+
+def test_matches_jax_grad_of_grad():
+    """Mixed-path second order (through primals AND cotangents) must match
+    jax.grad∘jax.grad on the same function."""
+    rng = np.random.RandomState(7)
+    W0 = rng.randn(3, 3).astype("float32")
+    x0 = rng.randn(2, 3).astype("float32")
+
+    def f_jax(xv, Wv):
+        return jnp.sum(jnp.tanh(xv @ Wv) ** 2)
+
+    gg_jax = jax.grad(
+        lambda xv, Wv: jnp.sum(jax.grad(f_jax, argnums=0)(xv, Wv) ** 2),
+        argnums=1)(x0, W0)
+
+    xt = paddle.to_tensor(x0, stop_gradient=False)
+    Wt = paddle.to_tensor(W0, stop_gradient=False)
+    ft = (paddle.tanh(paddle.matmul(xt, Wt)) ** 2).sum()
+    (gx,) = grad(ft, [xt], create_graph=True)
+    (gW,) = grad((gx ** 2).sum(), [Wt])
+    np.testing.assert_allclose(gW.numpy(), np.asarray(gg_jax), atol=1e-4)
+
+
+def test_gradient_penalty_training():
+    """WGAN-GP-style: the penalty (||grad_x D(x)|| - 1)^2 trains through
+    the optimizer (second-order path into the critic's parameters)."""
+    np.random.seed(0)
+    D = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=D.parameters())
+    losses = []
+    for _ in range(25):
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"),
+                             stop_gradient=False)
+        out = D(x).sum()
+        (gx,) = grad(out, [x], create_graph=True)
+        gnorm = ((gx ** 2).sum(axis=1) + 1e-12) ** 0.5
+        gp = ((gnorm - 1.0) ** 2).mean()
+        gp.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(gp.numpy()))
+    assert losses[-1] < losses[0] * 0.6, losses
+
+
+def test_backward_create_graph_into_dot_grad():
+    """backward(create_graph=True) leaves differentiable .grad tensors."""
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    y = (x ** 3).sum()
+    from paddle_tpu.core.autograd import backward
+    backward(y, create_graph=True)
+    g = x.grad
+    np.testing.assert_allclose(g.numpy(), 12.0, rtol=1e-6)
+    assert g._node is not None
+    (g2,) = grad(g.sum(), [x])
+    np.testing.assert_allclose(g2.numpy(), 12.0, rtol=1e-6)
+
+
+def test_hessian_tensor_form():
+    from paddle_tpu.autograd import hessian
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"),
+                         stop_gradient=False)
+    y = (x ** 3).sum()
+    H = hessian(y, x)
+    np.testing.assert_allclose(H.numpy(), np.diag(6 * x.numpy()),
+                               rtol=1e-6)
+
+
+def test_hessian_tensor_form_cross_terms():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"),
+                         stop_gradient=False)
+    y = (x[0] * x[1] ** 2).sum()
+    from paddle_tpu.autograd import hessian
+    H = hessian(y, x)
+    x0, x1 = x.numpy()
+    expect = np.array([[0.0, 2 * x1], [2 * x1, 2 * x0]], "float32")
+    np.testing.assert_allclose(H.numpy(), expect, rtol=1e-5)
+
+
+def test_pylayer_double_backward():
+    from paddle_tpu.autograd import PyLayer
+
+    class Square(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 2.0 * x
+
+    xp = paddle.to_tensor(np.array([3.0], "float32"), stop_gradient=False)
+    yp = Square.apply(xp).sum()
+    (g1,) = grad(yp, [xp], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), 6.0, rtol=1e-6)
+    (g2,) = grad(g1.sum(), [xp])
+    np.testing.assert_allclose(g2.numpy(), 2.0, rtol=1e-6)
+
+
+def test_first_order_semantics_unchanged():
+    """create_graph=False still releases the graph and raises on reuse."""
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    y = (x ** 2).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_create_graph_uses_record_time_values_after_inplace():
+    """An in-place rebind of a NON-LEAF between forward and backward
+    must not change create_graph gradients (the value analogue of the
+    record-time parent-edge snapshot; caught by review in round 3)."""
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    h = x * 1.0
+    y = (h * h).sum()
+    h._rebind((h + 1.0)._data)  # in-place mutation after consumption
+    (g_plain,) = grad(y, [x], retain_graph=True)
+    x2 = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    h2 = x2 * 1.0
+    y2 = (h2 * h2).sum()
+    h2._rebind((h2 + 1.0)._data)
+    (g_cg,) = grad(y2, [x2], create_graph=True)
+    np.testing.assert_allclose(g_plain.numpy(), 4.0, rtol=1e-6)
+    np.testing.assert_allclose(g_cg.numpy(), g_plain.numpy(), rtol=1e-6)
+
+
+def test_create_graph_grad_accumulation_keeps_tape():
+    """Two backward passes accumulating into .grad: the accumulated grad
+    must still carry its tape (review finding: the accumulation branch
+    used to detach)."""
+    from paddle_tpu.core.autograd import backward
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    y1 = (x ** 3).sum()
+    y2 = (x ** 2).sum()
+    backward(y1, create_graph=True)
+    backward(y2, create_graph=True)
+    np.testing.assert_allclose(x.grad.numpy(), 12.0 + 4.0, rtol=1e-6)
+    assert x.grad._node is not None  # still differentiable
+    (gg,) = grad(x.grad.sum(), [x])
+    np.testing.assert_allclose(gg.numpy(), 12.0 + 2.0, rtol=1e-6)
+
+
+def test_unused_input_allow_unused():
+    x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    z = paddle.to_tensor(np.array([1.0], "float32"), stop_gradient=False)
+    y = (x ** 2).sum()
+    gx, gz = grad(y, [x, z], create_graph=True, allow_unused=True)
+    assert gz is None
+    np.testing.assert_allclose(gx.numpy(), 4.0, rtol=1e-6)
