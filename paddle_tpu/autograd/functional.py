@@ -155,12 +155,28 @@ def hessian(ys, xs, batch_axis=None):
     # then a one-hot tape jacobian over each grad gives the Hessian rows
     # (reference: GeneralGrad double-grad, fluid/eager/backward.cc:439).
     y = ys[0] if isinstance(ys, (tuple, list)) else ys
-    if int(np.prod(y.shape)) != 1:
-        raise ValueError(
-            f"hessian expects a scalar (1-element) ys, got shape {y.shape}")
+    if batch_axis is None:
+        if int(np.prod(y.shape)) != 1:
+            raise ValueError(
+                f"hessian expects a scalar (1-element) ys, got shape "
+                f"{y.shape}; for per-sample scalars pass batch_axis=0")
+    else:
+        n_per = int(np.prod(y.shape)) // y.shape[batch_axis] \
+            if y.shape else 1
+        if n_per != 1:
+            raise ValueError(
+                f"batched hessian expects per-sample SCALAR ys "
+                f"([B] or [B, 1]), got shape {y.shape}")
     xs_t = _as_tuple(xs)
-    grads = _tape_grad([y], list(xs_t), create_graph=True,
-                       retain_graph=True, allow_unused=True)
+    seed = None
+    if batch_axis is not None and int(np.prod(y.shape)) != 1:
+        # per-sample scalars: ones seed (samples are independent, so the
+        # batched Hessian blocks are exact)
+        seed = [Tensor(np.ones(y.shape,
+                               np.dtype(jnp.asarray(y._data).dtype)))]
+    grads = _tape_grad([y], list(xs_t), grad_outputs=seed,
+                       create_graph=True, retain_graph=True,
+                       allow_unused=True)
     rows = []
     for gi, xi in zip(grads, xs_t):
         row = []

@@ -313,10 +313,16 @@ def _launch_auto_tune(args, world):
 
 
 def launch_from_args(args):
-    """Re-enter launch() with already-parsed args (tuner final run)."""
+    """Re-enter launch() with already-parsed args (tuner final run).
+    Forwards EVERY launch option — dropping one here would silently
+    change the final run's behavior (e.g. losing elasticity)."""
     argv = []
     if args.master:
         argv += ["--master", args.master]
+    if args.elastic_np:
+        argv += ["--elastic_np", str(args.elastic_np)]
+    if args.devices:
+        argv += ["--devices", str(args.devices)]
     argv += ["--nnodes", str(args.nnodes),
              "--node_rank", str(args.node_rank),
              "--nproc_per_node", str(args.nproc_per_node),

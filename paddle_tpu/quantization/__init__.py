@@ -73,7 +73,11 @@ class AbsmaxObserver(BaseObserver):
         return x
 
     def scales(self):
-        return max(self.absmax, 1e-9) / self.qmax
+        if self.absmax == 0.0:
+            raise RuntimeError(
+                "AbsmaxObserver never saw data: run calibration batches "
+                "through the PTQ-quantized model before convert()")
+        return self.absmax / self.qmax
 
     scale = scales  # round-2 compat alias
 
@@ -101,7 +105,9 @@ class PercentileObserver(BaseObserver):
 
     def scales(self):
         if not self._samples:
-            return 1.0 / self.qmax
+            raise RuntimeError(
+                "PercentileObserver never saw data: run calibration "
+                "batches through the PTQ-quantized model before convert()")
         allv = np.concatenate(self._samples)
         return max(float(np.percentile(allv, self.percentile)),
                    1e-9) / self.qmax
@@ -305,6 +311,16 @@ class ObservedConv2D(nn.Layer):
 # deployment forms: int8 weights (per-channel), fp compute at the edge
 # ---------------------------------------------------------------------------
 
+def _act_fake_quant(x, scale):
+    """Static input quantization at the deployed op edge (one shared
+    definition so linear and conv deployment numerics cannot diverge)."""
+
+    def act_q(a):
+        return jnp.clip(jnp.round(a / scale), -127, 127) * scale
+
+    return apply(act_q, x, name="act_quant")
+
+
 def _quantize_weight(w, channel_axis):
     """-> (int8 weights, per-channel fp32 scales)"""
     obs = AbsMaxChannelWiseWeightObserver()
@@ -331,11 +347,7 @@ class ConvertedInt8Linear(nn.Layer):
 
     def forward(self, x):
         if self.act_scale is not None:  # simulate static input quant
-            s = self.act_scale
-
-            def act_q(a):
-                return jnp.clip(jnp.round(a / s), -127, 127) * s
-            x = apply(act_q, x, name="act_quant")
+            x = _act_fake_quant(x, self.act_scale)
         w = Tensor(self.w_int8._data.astype(jnp.float32) *
                    self.w_scales._data[None, :])
         return nn.functional.linear(x, w, self.bias)
@@ -355,11 +367,7 @@ class ConvertedInt8Conv2D(nn.Layer):
 
     def forward(self, x):
         if self.act_scale is not None:
-            s = self.act_scale
-
-            def act_q(a):
-                return jnp.clip(jnp.round(a / s), -127, 127) * s
-            x = apply(act_q, x, name="act_quant")
+            x = _act_fake_quant(x, self.act_scale)
         w = Tensor(self.w_int8._data.astype(jnp.float32) *
                    self.w_scales._data[:, None, None, None])
         return nn.functional.conv2d(

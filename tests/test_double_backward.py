@@ -116,6 +116,17 @@ def test_hessian_tensor_form_cross_terms():
     np.testing.assert_allclose(H.numpy(), expect, rtol=1e-5)
 
 
+def test_hessian_tensor_form_batched():
+    """Per-sample scalar ys with batch_axis=0 -> [B, N, N] blocks."""
+    from paddle_tpu.autograd import hessian
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 2).astype(
+        "float32"), stop_gradient=False)
+    y = (x ** 3).sum(axis=1)
+    H = hessian(y, x, batch_axis=0)
+    expect = np.stack([np.diag(6 * x.numpy()[b]) for b in range(3)])
+    np.testing.assert_allclose(H.numpy(), expect, rtol=1e-5)
+
+
 def test_pylayer_double_backward():
     from paddle_tpu.autograd import PyLayer
 

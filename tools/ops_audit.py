@@ -136,19 +136,34 @@ def _raises_by_design(obj) -> bool:
 _TESTED_CACHE = None
 
 
+_PADDLE_ROOTS = (
+    "paddle", "F", "nn", "dist", "linalg", "fft", "signal", "sparse",
+    "incubate", "profiler", "optimizer", "quantization", "amp",
+    "autograd", "jit", "io", "vision", "audio", "text", "metric",
+    "distribution", "geometric", "onnx", "static", "functional",
+    "Tensor",
+)
+
+
 def _tested_names() -> set[str]:
-    """Names exercised by the test suite: referenced as an attribute call
-    (`paddle.foo(`, `F.foo(`, `x.foo(`) or bound method anywhere under
-    tests/. This is usage-level evidence, weaker than a per-op oracle
-    check but honest about which names a test has actually touched."""
+    """Names exercised by the test suite as calls on a PADDLE receiver:
+    `paddle.foo(`, `F.foo(`, `paddle.linalg.foo(` etc. — dotted chains
+    whose ROOT is a paddle namespace alias. Bare `x.foo(` matches are
+    deliberately NOT counted (they would credit numpy/stdlib method
+    calls to same-named paddle ops). Usage-level evidence, weaker than
+    the per-op oracle sweep, but it cannot be inflated by cross-library
+    name collisions."""
     global _TESTED_CACHE
     if _TESTED_CACHE is None:
         import re as _re
         tests = Path(__file__).resolve().parent.parent / "tests"
+        roots = "|".join(_PADDLE_ROOTS)
+        pat = _re.compile(
+            rf"\b(?:{roots})(?:\.[A-Za-z_][A-Za-z0-9_]*)*"
+            rf"\.([A-Za-z_][A-Za-z0-9_]*)\s*\(")
         refs = set()
         for f in tests.rglob("*.py"):
-            for m in _re.finditer(r"\.([A-Za-z_][A-Za-z0-9_]*)\s*\(",
-                                  f.read_text()):
+            for m in pat.finditer(f.read_text()):
                 refs.add(m.group(1))
         _TESTED_CACHE = refs
     return _TESTED_CACHE
