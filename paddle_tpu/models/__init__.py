@@ -4,3 +4,5 @@
 from .gpt import GPT, GPTConfig  # noqa: F401
 from .llama import Llama, LlamaConfig  # noqa: F401
 from .mixtral import Mixtral, MixtralConfig  # noqa: F401
+from .ppocr import (DBNet, CRNNRecognizer, PPOCRSystem,  # noqa: F401
+                    db_loss)

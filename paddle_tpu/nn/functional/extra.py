@@ -402,7 +402,7 @@ def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
         if padding:
             powed = jnp.pad(powed, ((0, 0), (0, 0), (padding, padding)))
         from jax import lax
-        s = lax.reduce_window(powed, jnp.asarray(0, a.dtype), lax.add,
+        s = lax.reduce_window(powed, 0.0, lax.add,
                               (1, 1, kernel_size), (1, 1, stride),
                               "VALID")
         return s ** (1.0 / p)
@@ -426,7 +426,7 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
             powed = jnp.pad(powed, ((0, 0), (0, 0), (pad[0], pad[0]),
                                     (pad[1], pad[1])))
         from jax import lax
-        s = lax.reduce_window(powed, jnp.asarray(0, a.dtype), lax.add,
+        s = lax.reduce_window(powed, 0.0, lax.add,
                               (1, 1) + tuple(kernel_size),
                               (1, 1) + tuple(stride), "VALID")
         return s ** (1.0 / p)

@@ -36,8 +36,12 @@ def _pool_nd(n, x, kernel_size, stride, padding, reducer, init, data_format,
         padcfg = [(0, 0), (0, 0)] + pads
 
     def fwd(a):
-        out = lax.reduce_window(a, jnp.asarray(init, a.dtype), reducer,
-                                window, strides, padcfg)
+        # init must stay a PYTHON scalar: an asarray() init becomes a
+        # tracer under jit, which defeats lax.reduce_window's monoid
+        # pattern-match (max/add) and drops to the generic primitive
+        # with no reverse-mode rule ("Linearization failed")
+        out = lax.reduce_window(a, np.asarray(init, a.dtype).item(),
+                                reducer, window, strides, padcfg)
         if average:
             if count_include_pad:
                 denom = np.prod(kernel).astype(np.float32)
@@ -45,8 +49,8 @@ def _pool_nd(n, x, kernel_size, stride, padding, reducer, init, data_format,
             else:
                 ones = jnp.ones(a.shape, a.dtype)
                 counts = lax.reduce_window(
-                    ones, jnp.asarray(0, a.dtype), lax.add, window, strides,
-                    padcfg)
+                    ones, 0.0 if jnp.issubdtype(a.dtype, jnp.floating)
+                    else 0, lax.add, window, strides, padcfg)
                 out = out / counts
         return out
 

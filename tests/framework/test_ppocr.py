@@ -1,0 +1,138 @@
+"""PP-OCR-style detection + recognition models (the driver config
+ladder's PP-OCRv4 rung; reference: PaddleOCR det_db / rec_crnn over
+paddle's warpctc + vision ops).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.models import CRNNRecognizer, DBNet, PPOCRSystem
+
+
+def _det_sample(n=2, size=64, seed=0):
+    """Images with one bright rectangle; gt prob map marks it."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 3, size, size).astype("float32") * 0.1
+    gt = np.zeros((n, 1, size, size), np.float32)
+    for i in range(n):
+        x0, y0 = rng.randint(4, size // 2, 2)
+        w, h = rng.randint(12, 24, 2)
+        x[i, :, y0:y0 + h, x0:x0 + w] += 0.9
+        gt[i, 0, y0:y0 + h, x0:x0 + w] = 1.0
+    return x, gt
+
+
+def test_dbnet_trains_on_synthetic_boxes():
+    paddle.seed(0)
+    det = DBNet()
+    x_np, gt_np = _det_sample()
+    opt = paddle.optimizer.Adam(learning_rate=3e-3,
+                                parameters=det.parameters())
+    x = paddle.to_tensor(x_np)
+    gt = paddle.to_tensor(gt_np)
+    losses = []
+    for _ in range(12):
+        loss = det.loss(x, gt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7, losses
+    # prob map responds to the bright region more than background
+    det.eval()
+    p, t, b = det(x)
+    pm = p.numpy()[0, 0]
+    assert pm[gt_np[0, 0] > 0].mean() > pm[gt_np[0, 0] == 0].mean()
+
+
+def _font_strip(classes, width=100, height=32, seed=0):
+    """A trivial synthetic 'font': class c paints columns with intensity
+    patterns unique to c; glyphs laid out left to right."""
+    rng = np.random.RandomState(seed)
+    img = rng.rand(3, height, width).astype("float32") * 0.05
+    glyph_w = 12
+    for pos, c in enumerate(classes):
+        x0 = 4 + pos * (glyph_w + 4)
+        if x0 + glyph_w >= width:
+            break
+        img[:, :, x0:x0 + glyph_w] += 0.2
+        img[c % 3, c // 3 * 8:(c // 3 + 1) * 8, x0:x0 + glyph_w] += 0.7
+    return img
+
+
+def test_crnn_learns_synthetic_font():
+    paddle.seed(1)
+    NCLS = 7  # classes 1..6 + blank 0
+    rec = CRNNRecognizer(num_classes=NCLS)
+    rng = np.random.RandomState(5)
+    seqs = [list(rng.randint(1, NCLS, rng.randint(2, 5)))
+            for _ in range(16)]
+    imgs = np.stack([_font_strip(s, seed=i) for i, s in enumerate(seqs)])
+    maxlen = max(len(s) for s in seqs)
+    labels = np.zeros((len(seqs), maxlen), np.int64)
+    for i, s in enumerate(seqs):
+        labels[i, :len(s)] = s
+    lens = np.array([len(s) for s in seqs], np.int64)
+
+    opt = paddle.optimizer.Adam(learning_rate=3e-3,
+                                parameters=rec.parameters())
+    x = paddle.to_tensor(imgs)
+    lab = paddle.to_tensor(labels)
+    ll = paddle.to_tensor(lens)
+    losses = []
+    for _ in range(60):
+        loss = rec.loss(x, lab, ll)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.35, (losses[0], losses[-1])
+    rec.eval()
+    decoded = rec.decode(x)
+    exact = sum(d == s for d, s in zip(decoded, seqs))
+    assert exact >= len(seqs) // 2, (exact, decoded[:4], seqs[:4])
+
+
+def test_ctc_loss_under_train_step():
+    """The rec model compiles under jit.TrainStep (static shapes)."""
+    paddle.seed(2)
+    rec = CRNNRecognizer(num_classes=5)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=rec.parameters())
+    step = paddle.jit.TrainStep(
+        rec, opt, lambda m, x, lab, ll: m.loss(x, lab, ll))
+    x = paddle.to_tensor(np.random.randn(2, 3, 32, 64).astype("float32"))
+    lab = paddle.to_tensor(np.array([[1, 2], [3, 0]], "int64"))
+    ll = paddle.to_tensor(np.array([2, 1], "int64"))
+    l1 = float(step(x, lab, ll))
+    l2 = float(step(x, lab, ll))
+    assert np.isfinite(l1) and np.isfinite(l2) and l2 < l1
+
+
+def test_ppocr_system_pipeline():
+    """det -> crop -> rec end-to-end inference runs and returns boxes."""
+    paddle.seed(3)
+    det = DBNet()
+    rec = CRNNRecognizer(num_classes=5)
+    det.eval()
+    rec.eval()
+    sys_ = PPOCRSystem(det, rec, det_thresh=0.5)
+    img = np.random.rand(3, 64, 64).astype("float32") * 0.1
+    img[:, 20:36, 8:40] += 0.9
+    results = sys_(img)
+    for box, seq in results:
+        x0, y0, x1, y1 = box
+        assert 0 <= x0 < x1 <= 64 and 0 <= y0 < y1 <= 64
+        assert isinstance(seq, list)
+
+
+def test_boxes_from_prob_connected_components():
+    pm = np.zeros((20, 20), np.float32)
+    pm[2:6, 3:9] = 0.9
+    pm[12:17, 10:15] = 0.8
+    boxes = DBNet.boxes_from_prob(pm, thresh=0.5)
+    assert boxes.shape == (2, 4)
+    assert (boxes[0] == [3, 2, 9, 6]).all(), boxes
+    assert (boxes[1] == [10, 12, 15, 17]).all(), boxes
