@@ -506,6 +506,7 @@ def _release_graph(root):
         n.parents = ()
         n.fwd_fn = None
         n.tensor_vjp = None
+        n.primals = None
 
 
 def _dead_vjp(*_):

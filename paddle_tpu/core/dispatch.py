@@ -105,13 +105,14 @@ def _lazy_bwd_for(key, fn, n_payloads, diff_idx, arr_pos, statics,
     return bwd
 
 
-def _fn_key(fn):
+def _fn_key(fn, _seen=None):
     """Identity of fn's BEHAVIOR, not its object: per-call lambdas (the
     dominant op-wrapper pattern) share their code object, so keying on
-    (code, defaults, closure cell values) makes them cache-hit. Closure
-    cells holding arrays (e.g. dropout's RNG key) are unhashable and
-    reject the op to the eager-vjp path — exactly the impure cases where
-    backward recompute would be wrong."""
+    (code, defaults, closure cell values, referenced-global values) makes
+    them cache-hit. Closure cells or globals holding arrays (e.g.
+    dropout's RNG key) are unhashable and reject the op to the eager-vjp
+    path — exactly the impure cases where backward recompute would be
+    wrong."""
     if getattr(fn, "__self__", None) is not None:
         # bound methods: per-instance state isn't visible in
         # code/defaults/closure — don't risk cross-instance reuse
@@ -119,22 +120,85 @@ def _fn_key(fn):
     code = getattr(fn, "__code__", None)
     if code is None:
         return fn  # builtin / PjitFunction / ufunc: stable identity
+    if _seen is None:
+        _seen = set()
+    if id(fn) in _seen:
+        return ("cycle", code)
+    _seen.add(id(fn))
     cells = getattr(fn, "__closure__", None) or ()
     vals = []
     for c in cells:
         v = c.cell_contents
-        if callable(v) and getattr(v, "__code__", None) is not None:
+        if callable(v) and getattr(v, "__code__", None) is not None \
+                and getattr(v, "__self__", None) is None:
             # per-call inner lambdas (e.g. an activation built each
             # forward) share code — recurse instead of id-hashing, or
             # every call would be a fresh cache entry + XLA compile
-            vals.append(_fn_key(v))
+            vals.append(_fn_key(v, _seen))
         else:
             # whitelist, not blacklist: a hashable custom object would be
             # keyed by identity while the first-seen fn gets baked into
             # the cached jitted backward — if it held tensor data
             # internally, backward would silently recompute stale values
-            vals.append(_cell_key(v))
-    return (code, fn.__defaults__, tuple(vals))
+            vals.append(_cell_key(v, _seen))
+    # Globals are free variables too: same-code lambdas referencing a
+    # rebindable module-level name (`m = inst.mul; lambda a: m(a)`) would
+    # otherwise collide and replay the first binding's cached backward.
+    # Same whitelist as cells: modules by identity, plain functions
+    # recursed (their own globals/cells are part of the behavior),
+    # values through _cell_key, everything else rejects to eager-vjp.
+    gvals = []
+    fglobals = getattr(fn, "__globals__", None)
+    if fglobals is not None:
+        import types as _types
+        for nm in _global_load_names(code):
+            if nm not in fglobals:
+                continue  # resolves in builtins: stable
+            v = fglobals[nm]
+            if isinstance(v, _types.ModuleType):
+                gvals.append((nm, v))  # identity; rebind changes the key
+            elif callable(v) and getattr(v, "__code__", None) is not None \
+                    and getattr(v, "__self__", None) is None:
+                gvals.append((nm, _fn_key(v, _seen)))
+            else:
+                gvals.append((nm, _cell_key(v, _seen)))
+    kwdefs = getattr(fn, "__kwdefaults__", None)
+    if kwdefs:
+        # keyword-only defaults are behavior too: same-code wrappers
+        # differing only in `*, scale=s` would otherwise collide
+        kwkey = tuple(sorted((k, _cell_key(v, _seen))
+                             for k, v in kwdefs.items()))
+    else:
+        kwkey = None
+    return (code, fn.__defaults__, kwkey, tuple(vals), tuple(gvals))
+
+
+_CODE_GLOBAL_NAMES: dict = {}
+
+
+def _global_load_names(code):
+    """Names a code object truly loads as globals (LOAD_GLOBAL targets,
+    recursively through nested code consts) — co_names would also list
+    attribute names, and a collision with an unrelated module global
+    (`obj.params` vs a module-level `params`) would wrongly key or even
+    reject the op. Cached per code object: bytecode never changes."""
+    names = _CODE_GLOBAL_NAMES.get(code)
+    if names is None:
+        import dis
+        import types as _types
+        found = set()
+        stack = [code]
+        while stack:
+            c = stack.pop()
+            for ins in dis.get_instructions(c):
+                if ins.opname in ("LOAD_GLOBAL", "LOAD_NAME"):
+                    found.add(ins.argval)
+            for const in c.co_consts:
+                if isinstance(const, _types.CodeType):
+                    stack.append(const)
+        names = tuple(sorted(found))
+        _CODE_GLOBAL_NAMES[code] = names
+    return names
 
 
 _STABLE_CALLABLE_TYPES = None
@@ -151,7 +215,7 @@ def _stable_callable_types():
     return _STABLE_CALLABLE_TYPES
 
 
-def _cell_key(v):
+def _cell_key(v, _seen=None):
     """Key for a closure-cell value: only value-semantics immutables and
     stable-identity callables are admitted; everything else rejects the
     op to the eager-vjp path."""
@@ -166,14 +230,14 @@ def _cell_key(v):
         # get baked into the cached jitted backward — stale after edits.
         return v
     if isinstance(v, tuple):
-        return tuple(_cell_key(e) for e in v)
+        return tuple(_cell_key(e, _seen) for e in v)
     if isinstance(v, frozenset):
-        return frozenset(_cell_key(e) for e in v)
+        return frozenset(_cell_key(e, _seen) for e in v)
     import functools
     if isinstance(v, functools.partial):
-        return ("partial", _cell_key_fn(v.func),
-                tuple(_cell_key(a) for a in v.args),
-                tuple(sorted((k, _cell_key(x))
+        return ("partial", _cell_key_fn(v.func, _seen),
+                tuple(_cell_key(a, _seen) for a in v.args),
+                tuple(sorted((k, _cell_key(x, _seen))
                              for k, x in v.keywords.items())))
     if isinstance(v, _stable_callable_types()):
         # module-level stable identities (jnp builtins, jitted fns,
@@ -183,12 +247,12 @@ def _cell_key(v):
     raise TypeError(f"unsafe closure cell type {type(v).__name__}")
 
 
-def _cell_key_fn(v):
+def _cell_key_fn(v, _seen=None):
     """Key a callable that may be a plain function or a stable builtin."""
     if getattr(v, "__code__", None) is not None \
             and getattr(v, "__self__", None) is None:
-        return _fn_key(v)
-    return _cell_key(v)
+        return _fn_key(v, _seen)
+    return _cell_key(v, _seen)
 
 
 def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf):

@@ -145,7 +145,19 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
         self.quant_bits = bit_length
         self.register_buffer("scale",
                              Tensor(jnp.ones([], jnp.float32)))
-        self._initialized = False
+        # persisted as a buffer: a QAT model restored from a checkpoint
+        # must keep its trained scale valid for convert() without having
+        # to run another batch first
+        self.register_buffer("accum_state",
+                             Tensor(jnp.zeros([], jnp.float32)))
+
+    @property
+    def _initialized(self):
+        return bool(float(self.accum_state._data) != 0.0)
+
+    @_initialized.setter
+    def _initialized(self, v):
+        self.accum_state._rebind(jnp.asarray(1.0 if v else 0.0, jnp.float32))
 
     def forward(self, x):
         qmax = float(2 ** (self.bit_length - 1) - 1)
@@ -169,6 +181,12 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
         return apply(fq_ste, x, name="fake_quant")
 
     def scales(self):
+        if not self._initialized:
+            # Never saw data: the init value 1.0 is not a real scale. Return
+            # None so QAT.convert() skips static activation quant instead of
+            # baking act_scale=1/qmax (which would clip deployed activations
+            # to roughly [-1, 1]).
+            return None
         return float(self.scale._data) / self.qmax
 
 
