@@ -57,6 +57,11 @@ _ONE = np.float32(1.0)
 
 
 def _interpret() -> bool:
+    import os
+    if os.environ.get("PADDLE_PALLAS_FORCE_COMPILE"):
+        # cross-lowering gate (tools/tpu_lowering_gate.py): run the real
+        # Mosaic pipeline even on a CPU host so legalization is proven
+        return False
     try:
         return jax.default_backend() == "cpu"
     except RuntimeError:  # pragma: no cover

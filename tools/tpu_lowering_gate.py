@@ -1,0 +1,330 @@
+"""Mosaic/TPU cross-lowering gate.
+
+Proves — on a CPU host, no TPU needed — that every Pallas kernel and the
+jitted train steps legalize for TPU: ``jax.export.export(jax.jit(fn),
+platforms=['tpu'])`` runs the full StableHLO lowering INCLUDING the
+Pallas→Mosaic pipeline (kernel dtype legality, Mosaic op verification,
+vector layout checks), the exact class of failure interpret-mode tests
+cannot catch. The reference's analogue is compiling its .cu kernels:
+until a kernel passes the device compiler, correctness tests in a CPU
+emulator prove nothing about the device build
+(`/root/reference/paddle/phi/kernels/fusion/gpu/flash_attn_kernel.cu:128`).
+
+Run:  PADDLE_PALLAS_FORCE_COMPILE=1 PADDLE_FLASH_FORCE=pallas \
+      python tools/tpu_lowering_gate.py
+Writes MOSAIC_LOWERING.md (per-gate custom-call summary + module sizes).
+CI subset: tests/kernels/test_tpu_lowering.py runs the kernel gates.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import time
+
+os.environ.setdefault("PADDLE_PALLAS_FORCE_COMPILE", "1")
+os.environ.setdefault("PADDLE_FLASH_FORCE", "pallas")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import export  # noqa: E402
+
+
+def summarize(exp) -> dict:
+    txt = exp.mlir_module()
+    calls = sorted(set(re.findall(r"stablehlo\.custom_call @(\w+)", txt)))
+    return {
+        "custom_calls": calls,
+        "module_bytes": len(txt),
+        "n_tpu_custom_calls": len(
+            re.findall(r"stablehlo\.custom_call @tpu_custom_call", txt)),
+        "platforms": list(exp.platforms),
+    }
+
+
+RESULTS: list[tuple[str, dict | str]] = []
+
+
+def gate(name: str, fn, *args, expect_tpu_calls: bool = True,
+         scope=None) -> bool:
+    t0 = time.time()
+    try:
+        exp = export.export(jax.jit(fn), platforms=["tpu"])(*args)
+        info = summarize(exp)
+        info["seconds"] = round(time.time() - t0, 1)
+        if expect_tpu_calls and info["n_tpu_custom_calls"] == 0:
+            info["WARNING"] = ("no tpu_custom_call in module — Pallas "
+                               "kernel was not routed")
+            RESULTS.append((name, info))
+            print(f"[gate] {name}: LOWERED BUT NO PALLAS CALL {info}")
+            return False
+        RESULTS.append((name, info))
+        print(f"[gate] {name}: OK {info}")
+        return True
+    except Exception as e:  # noqa: BLE001
+        msg = f"{type(e).__name__}: {e}"
+        RESULTS.append((name, msg[:2000]))
+        print(f"[gate] {name}: FAIL {msg[:600]}")
+        return False
+
+
+def abstract(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# 1. flash attention kernels
+# ---------------------------------------------------------------------------
+
+def gate_flash() -> bool:
+    from paddle_tpu.kernels.pallas.flash_attention import (
+        flash_attention, flash_attn_varlen)
+
+    ok = True
+    B, S, H, D = 2, 2048, 16, 128
+    q = abstract((B, S, H, D), jnp.bfloat16)
+    ok &= gate("flash_fwd_bf16_causal",
+               lambda q, k, v: flash_attention(q, k, v, causal=True),
+               q, q, q)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True).astype(jnp.float32))
+    ok &= gate("flash_bwd_bf16_causal", jax.grad(loss, argnums=(0, 1, 2)),
+               q, q, q)
+
+    kg = abstract((B, S, 4, D), jnp.bfloat16)
+    ok &= gate("flash_fwd_gqa4", lambda q, k, v: flash_attention(
+        q, k, v, causal=True), q, kg, kg)
+
+    def loss_g(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True).astype(jnp.float32))
+    ok &= gate("flash_bwd_gqa4", jax.grad(loss_g, argnums=(0, 1, 2)),
+               q, kg, kg)
+
+    qf = abstract((B, 1024, H, D), jnp.float32)
+    ok &= gate("flash_fwd_f32_noncausal",
+               lambda q, k, v: flash_attention(q, k, v, causal=False),
+               qf, qf, qf)
+
+    total = 4096
+    qv = abstract((total, H, D), jnp.bfloat16)
+    cu = jnp.array([0, 1000, 2048, 4096], jnp.int32)
+    ok &= gate("flash_varlen_bf16",
+               lambda q, k, v: flash_attn_varlen(q, k, v, cu, cu,
+                                                 causal=True),
+               qv, qv, qv)
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# 2. paged-decode kernel
+# ---------------------------------------------------------------------------
+
+def gate_paged() -> bool:
+    from paddle_tpu.kernels.pallas.paged_attention import (
+        paged_decode_attention_kernel)
+
+    ok = True
+    B, HQ, HK, D, BS, NB, MBPS = 8, 32, 32, 128, 16, 256, 128
+    q = abstract((B, HQ, D), jnp.bfloat16)
+    kp = abstract((NB, BS, HK, D), jnp.bfloat16)
+    tbl = abstract((B, MBPS), jnp.int32)
+    lens = abstract((B,), jnp.int32)
+    ok &= gate("paged_decode_bf16",
+               lambda q, k, v, t, l: paged_decode_attention_kernel(
+                   q, k, v, t, l, interpret=False),
+               q, kp, kp, tbl, lens)
+
+    qg = abstract((B, 32, D), jnp.bfloat16)
+    kg = abstract((NB, BS, 8, D), jnp.bfloat16)
+    ok &= gate("paged_decode_gqa4",
+               lambda q, k, v, t, l: paged_decode_attention_kernel(
+                   q, k, v, t, l, interpret=False),
+               qg, kg, kg, tbl, lens)
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# 3. GPT-2 345M jitted train step (fwd + tape bwd + AdamW, flash inside)
+# ---------------------------------------------------------------------------
+
+def gate_train_step() -> bool:
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import GPT, GPTConfig
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(0)
+    cfg = GPTConfig.gpt2_medium()
+    model = GPT(cfg)
+    # bf16 params: the deployment dtype on TPU (master weights live in
+    # the AdamW slots)
+    for _, p in model.named_parameters():
+        if p._data.dtype == jnp.float32:
+            p._data = p._data.astype(jnp.bfloat16)
+    opt = optimizer.AdamW(learning_rate=3e-4, parameters=model.parameters(),
+                          multi_precision=True,
+                          grad_clip=nn.ClipGradByGlobalNorm(1.0))
+
+    def step_fn(m, ids):
+        logits = m(ids)
+        return F.cross_entropy(logits[:, :-1, :], ids[:, 1:])
+
+    ts = TrainStep(model, opt, step_fn)
+    ts._build()
+
+    # abstract example args mirroring TrainStep.__call__
+    param_objs = [p for _, p in ts._params]
+    slot_states = [opt._slots_for(p) for p in param_objs]
+    param_avals = [abstract(p._data.shape, p._data.dtype)
+                   for p in param_objs]
+    slot_avals = jax.tree.map(
+        lambda a: abstract(a.shape, a.dtype), slot_states)
+    buffer_avals = [abstract(b._data.shape, b._data.dtype)
+                    for _, b in ts._buffers]
+    t = abstract((), jnp.float32)
+    lr = abstract((), jnp.float32)
+    key = jax.random.key(0)
+    key_aval = abstract(key.shape, key.dtype)
+    ids = abstract((4, 1024), jnp.int32)
+
+    return gate("gpt2_345m_train_step_bf16", ts._pure,
+                param_avals, slot_avals, buffer_avals, t, lr, key_aval,
+                (ids,))
+
+
+# ---------------------------------------------------------------------------
+# 4. hybrid dp x pp x tp sharded train step (the dryrun_multichip program)
+# ---------------------------------------------------------------------------
+
+def gate_hybrid_step() -> bool:
+    import numpy as _np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed.pipeline import PipelineDecoderLM
+    from paddle_tpu.models import Llama, LlamaConfig
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(0)
+    dp, pp, tp = 2, 2, 2
+    mesh = dist.init_mesh([dp, pp, tp], ["dp", "pp", "tp"])
+    config = LlamaConfig.tiny()
+    model = Llama(config)
+    dist.apply_placement_rules(model, Llama.tp_placement_rules(mesh), mesh)
+
+    class Head(nn.Layer):
+        def __init__(self, norm, lm_head):
+            super().__init__()
+            self.norm = norm
+            self.lm_head = lm_head
+
+        def forward(self, x):
+            return self.lm_head(self.norm(x))
+
+    pipe = PipelineDecoderLM(
+        model.embed_tokens, model.layers, Head(model.norm, model.lm_head),
+        lambda logits, labels: F.cross_entropy(logits[:, :-1, :],
+                                               labels[:, 1:]),
+        mesh, pp_axis="pp", num_microbatches=4, schedule="1f1b")
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=pipe.parameters(),
+                          grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    step = dist.ShardedTrainStep(
+        pipe, opt, lambda m, ids: m.loss(ids, ids), mesh=mesh,
+        data_placements=[dist.Shard(0), dist.Replicate(), dist.Shard(1)],
+        shard_optimizer_axis="dp")
+
+    ids = paddle.to_tensor(
+        _np.random.default_rng(0).integers(
+            0, config.vocab_size,
+            (8, config.max_position_embeddings)).astype("int64"))
+    # mirror ShardedTrainStep.__call__ state assembly, then export the
+    # jitted pure step with the concrete placed args (tiny model)
+    import jax.numpy as _jnp
+
+    from paddle_tpu.core import random as random_mod
+    from paddle_tpu.distributed.api import named_sharding
+
+    for _, p in step._params:
+        if p._dist_attr is not None:
+            step._place_slots(p)
+    sharding = named_sharding(step._mesh, step._data_placements, ids.ndim)
+    placed = jax.device_put(ids._data, sharding)
+    param_objs = [p for _, p in step._params]
+    slot_states = [opt._slots_for(p) for p in param_objs]
+    param_arrays = [p._data for p in param_objs]
+    buffer_arrays = [b._data for _, b in step._buffers]
+    t = _jnp.asarray(1.0, _jnp.float32)
+    lr = _jnp.asarray(1e-3, _jnp.float32)
+    key = random_mod.next_key()
+    with step._mesh.jax_mesh:
+        step._build()
+        return gate("hybrid_dp2pp2tp2_train_step", step._jitted,
+                    param_arrays, slot_states, buffer_arrays, t, lr, key,
+                    (placed,), expect_tpu_calls=False)
+
+
+# ---------------------------------------------------------------------------
+
+def write_report(path="MOSAIC_LOWERING.md"):
+    lines = [
+        "# Mosaic/TPU cross-lowering evidence",
+        "",
+        "Produced by `tools/tpu_lowering_gate.py` on a CPU host: each gate",
+        "runs `jax.export.export(jax.jit(fn), platforms=['tpu'])`, which",
+        "executes the full TPU lowering pipeline including Pallas→Mosaic",
+        "legalization (kernel dtype legality, Mosaic op verification).",
+        "`tpu_custom_call` in the emitted StableHLO is the serialized",
+        "Mosaic kernel; a gate failing raises at lowering time.",
+        "",
+        f"jax {jax.__version__}; generated "
+        f"{time.strftime('%Y-%m-%d %H:%M:%S')}",
+        "",
+        "| gate | status | tpu_custom_calls | custom calls | module bytes "
+        "| lowering s |",
+        "|---|---|---|---|---|---|",
+    ]
+    n_fail = 0
+    for name, info in RESULTS:
+        if isinstance(info, str):
+            n_fail += 1
+            lines.append(f"| {name} | **FAIL** | — | `{info[:120]}` | — "
+                         "| — |")
+        else:
+            status = "ok" if "WARNING" not in info else "**no-pallas**"
+            lines.append(
+                f"| {name} | {status} | {info['n_tpu_custom_calls']} | "
+                f"{', '.join(info['custom_calls'])} | "
+                f"{info['module_bytes']} | {info['seconds']} |")
+    lines.append("")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {path} ({len(RESULTS)} gates, {n_fail} failures)")
+    return n_fail
+
+
+def main():
+    ok = True
+    ok &= gate_flash()
+    ok &= gate_paged()
+    ok &= gate_train_step()
+    ok &= gate_hybrid_step()
+    n_fail = write_report()
+    sys.exit(1 if (n_fail or not ok) else 0)
+
+
+if __name__ == "__main__":
+    main()
