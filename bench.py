@@ -379,7 +379,11 @@ def main():
         head = bench_gpt_train(GPTConfig.gpt2_medium(), 8, 1024, 20,
                                "gpt2_345m")
         _persist({"head": head})
+        fp8_cfg = GPTConfig.gpt2_medium()
+        fp8_cfg.use_fp8 = True
         for name, fn, args in [
+            ("gpt_345m_fp8_train", bench_gpt_train,
+             (fp8_cfg, 8, 1024, 10, "gpt2_345m_fp8")),
             ("gpt_770m_train", bench_gpt_train,
              (GPTConfig.gpt2_large(), 4, 1024, 10, "gpt2_770m")),
             ("llama7b_decode", bench_llama_decode,
@@ -397,6 +401,10 @@ def main():
         ladder["llama_decode_smoke"] = _try(
             bench_llama_decode, LlamaConfig.tiny(), 2, 8, 8,
             "llama_tiny_decode", dtype="float32")
+        fp8_cfg = GPTConfig.tiny()
+        fp8_cfg.use_fp8 = True
+        ladder["gpt_fp8_smoke"] = _try(
+            bench_gpt_train, fp8_cfg, 2, 64, 3, "gpt_tiny_fp8")
         ladder["eager"] = _try(bench_eager)
 
     if on_tpu:

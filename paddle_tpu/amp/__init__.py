@@ -20,7 +20,15 @@ from ..core import dtype as dtype_mod
 from ..core.tensor import Tensor
 
 __all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "AmpScaler",
-           "amp_state", "white_list", "black_list"]
+           "amp_state", "white_list", "black_list", "fp8"]
+
+
+def __getattr__(name):  # lazy: fp8 pulls in nn at first use, not at init
+    if name == "fp8":
+        import importlib
+        return importlib.import_module(".fp8", __name__)
+    raise AttributeError(f"module 'paddle_tpu.amp' has no attribute "
+                         f"{name!r}")
 
 # op-name lists (reference amp_lists.py): ops routed to low precision vs
 # kept in fp32. Consulted by core.dispatch during auto_cast.

@@ -33,6 +33,7 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
+    use_fp8: bool = False  # fp8 block linears (amp.fp8 delayed scaling)
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -138,6 +139,11 @@ class GPT(nn.Layer):
                                      bias_attr=False)
         else:
             self.lm_head = None
+        if config.use_fp8:
+            # block linears in fp8; the LM head stays bf16 (loss fidelity,
+            # the standard fp8-transformer recipe)
+            from ..amp.fp8 import convert_to_fp8
+            convert_to_fp8(self, exclude=("lm_head",))
 
     def forward(self, input_ids):
         from .. import ops

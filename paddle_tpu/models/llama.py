@@ -35,6 +35,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
+    use_fp8: bool = False  # fp8 block linears (amp.fp8 delayed scaling)
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -230,6 +231,9 @@ class Llama(nn.Layer):
                                      bias_attr=False)
         else:
             self.lm_head = None
+        if config.use_fp8:
+            from ..amp.fp8 import convert_to_fp8
+            convert_to_fp8(self, exclude=("lm_head",))
 
     def forward(self, input_ids, caches=None, position_offset=0,
                 kv_sink=None):

@@ -39,8 +39,7 @@ import numpy as np  # noqa: E402
 from jax import export  # noqa: E402
 
 
-def summarize(exp) -> dict:
-    txt = exp.mlir_module()
+def summarize_text(txt: str, exp) -> dict:
     calls = sorted(set(re.findall(r"stablehlo\.custom_call @(\w+)", txt)))
     return {
         "custom_calls": calls,
@@ -51,15 +50,39 @@ def summarize(exp) -> dict:
     }
 
 
+def trainstep_avals(ts, opt, ids_shape, ids_dtype=jnp.int32):
+    """Abstract example args mirroring TrainStep.__call__'s signature."""
+    param_objs = [p for _, p in ts._params]
+    slot_states = [opt._slots_for(p) for p in param_objs]
+    param_avals = [abstract(p._data.shape, p._data.dtype)
+                   for p in param_objs]
+    slot_avals = jax.tree.map(
+        lambda a: abstract(a.shape, a.dtype), slot_states)
+    buffer_avals = [abstract(b._data.shape, b._data.dtype)
+                    for _, b in ts._buffers]
+    key = jax.random.key(0)
+    return (param_avals, slot_avals, buffer_avals,
+            abstract((), jnp.float32), abstract((), jnp.float32),
+            abstract(key.shape, key.dtype),
+            (abstract(ids_shape, ids_dtype),))
+
+
 RESULTS: list[tuple[str, dict | str]] = []
 
 
 def gate(name: str, fn, *args, expect_tpu_calls: bool = True,
-         scope=None) -> bool:
+         extra_check=None) -> bool:
+    """extra_check(mlir_text) may raise to fail the gate or return a dict
+    merged into the report row."""
     t0 = time.time()
     try:
         exp = export.export(jax.jit(fn), platforms=["tpu"])(*args)
-        info = summarize(exp)
+        txt = exp.mlir_module()
+        info = summarize_text(txt, exp)
+        if extra_check is not None:
+            extra = extra_check(txt)
+            if extra:
+                info.update(extra)
         info["seconds"] = round(time.time() - t0, 1)
         if expect_tpu_calls and info["n_tpu_custom_calls"] == 0:
             info["WARNING"] = ("no tpu_custom_call in module — Pallas "
@@ -184,25 +207,37 @@ def gate_train_step() -> bool:
 
     ts = TrainStep(model, opt, step_fn)
     ts._build()
-
-    # abstract example args mirroring TrainStep.__call__
-    param_objs = [p for _, p in ts._params]
-    slot_states = [opt._slots_for(p) for p in param_objs]
-    param_avals = [abstract(p._data.shape, p._data.dtype)
-                   for p in param_objs]
-    slot_avals = jax.tree.map(
-        lambda a: abstract(a.shape, a.dtype), slot_states)
-    buffer_avals = [abstract(b._data.shape, b._data.dtype)
-                    for _, b in ts._buffers]
-    t = abstract((), jnp.float32)
-    lr = abstract((), jnp.float32)
-    key = jax.random.key(0)
-    key_aval = abstract(key.shape, key.dtype)
-    ids = abstract((4, 1024), jnp.int32)
-
     return gate("gpt2_345m_train_step_bf16", ts._pure,
-                param_avals, slot_avals, buffer_avals, t, lr, key_aval,
-                (ids,))
+                *trainstep_avals(ts, opt, (4, 1024)))
+
+
+# ---------------------------------------------------------------------------
+# 3b. fp8 GPT train step (scaled e4m3 matmuls + e5m2 grads + amax state)
+# ---------------------------------------------------------------------------
+
+def gate_fp8_step() -> bool:
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import GPT, GPTConfig
+
+    paddle.seed(0)
+    cfg = GPTConfig.tiny()
+    cfg.use_fp8 = True
+    model = GPT(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+    ts = TrainStep(model, opt, lambda m, ids: m.loss(ids, ids))
+    ts._build()
+
+    def check_fp8(txt):
+        assert "f8E4M3FN" in txt, "no e4m3 in fp8 step"
+        assert "f8E5M2" in txt, "no e5m2 grads in fp8 step"
+        return {"fp8": "e4m3 fwd + e5m2 grads in module"}
+
+    return gate("gpt_fp8_train_step", ts._pure,
+                *trainstep_avals(ts, opt, (2, 64)),
+                extra_check=check_fp8)
 
 
 # ---------------------------------------------------------------------------
@@ -321,6 +356,7 @@ def main():
     ok &= gate_flash()
     ok &= gate_paged()
     ok &= gate_train_step()
+    ok &= gate_fp8_step()
     ok &= gate_hybrid_step()
     n_fail = write_report()
     sys.exit(1 if (n_fail or not ok) else 0)
