@@ -354,6 +354,15 @@ def _backend_or_cpu_fallback(timeout_s=180):
 def main():
     import os
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon PJRT plugin registers itself at interpreter startup and
+        # overrides the env var; pinning the config is the only reliable
+        # CPU forcing (must happen before the first backend use)
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already resolved
+
     from paddle_tpu.models import GPTConfig, LlamaConfig
     from paddle_tpu.vision.models import vit_l_16
 

@@ -323,6 +323,14 @@ class Multinomial(Distribution):
         onehot = jax.nn.one_hot(cat, k)
         return _t(jnp.sum(onehot, axis=len(shape)))
 
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _arr(value)
+        logp = jnp.log(jnp.maximum(self.probs_arr, 1e-38))
+        coeff = gammaln(jnp.asarray(self.total_count + 1.0)) - \
+            jnp.sum(gammaln(v + 1.0), -1)
+        return _t(coeff + jnp.sum(v * logp, -1))
+
 
 _KL_REGISTRY = {}
 
@@ -383,3 +391,4 @@ def _kl_bernoulli_bernoulli(p, q):
 
 
 from .extra import *  # noqa: F401,F403,E402
+from . import transform  # noqa: F401,E402  (paddle.distribution.transform)

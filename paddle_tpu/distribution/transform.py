@@ -1,0 +1,19 @@
+"""`paddle.distribution.transform` submodule (reference
+python/paddle/distribution/transform.py): the Transform classes are
+defined in extra.py and re-exported from the package root; this module
+mirrors the reference's import path."""
+
+from .extra import (  # noqa: F401
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    PowerTransform,
+    SigmoidTransform,
+    TanhTransform,
+    Transform,
+)
+
+__all__ = [
+    "Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+    "SigmoidTransform", "TanhTransform", "ChainTransform",
+]

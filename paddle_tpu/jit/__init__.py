@@ -44,6 +44,147 @@ def _tree_unwrap(x):
     return x._data if isinstance(x, Tensor) else x
 
 
+class _Segment:
+    """A differentiable compiled segment: one child layer's forward,
+    jitted, dispatched through ``apply`` so the eager tape flows through
+    it (params get grads, training keeps working around a graph break).
+
+    This is the subgraph half of the reference SOT's graph-break story
+    (`python/paddle/jit/sot/opcode_translator/executor/
+    opcode_executor.py:1594` keeps compiled subgraphs around a break):
+    when a frame breaks, the frame itself runs eager python but every
+    direct child layer call stays one compiled XLA program. A segment
+    that itself breaks demotes recursively — its frame goes eager and
+    ITS children become segments."""
+
+    def __init__(self, child, name):
+        self._child = child
+        self._name = name
+        self._fwd = type(child).forward  # unbound original
+        self._broken = False
+        self.traces = 0   # trace counter (tests / introspection)
+        self.calls = 0
+        self._jit_cache = {}
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        if self._broken or not _TO_STATIC_ENABLED:
+            return self._fwd(self._child, *args, **kwargs)
+        try:
+            return self._compiled_call(args, kwargs)
+        except _GRAPH_BREAK_ERRORS as e:
+            import warnings
+
+            warnings.warn(
+                f"to_static: graph break in segment {self._name!r} "
+                f"({type(e).__name__}); its frame runs eager, child "
+                f"layers stay compiled.", RuntimeWarning, stacklevel=2)
+            _segmentize(self._child)
+            self._broken = True
+            return self._fwd(self._child, *args, **kwargs)
+        except TypeError:
+            # unhashable static arg etc: run this frame eager, no cache
+            return self._fwd(self._child, *args, **kwargs)
+
+    def _compiled_call(self, args, kwargs):
+        from ..core.dispatch import apply
+
+        child = self._child
+        leaves, treedef = jax.tree.flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        t_pos = [i for i, l in enumerate(leaves)
+                 if isinstance(l, (Tensor, jax.Array))]
+        statics = tuple((i, l) for i, l in enumerate(leaves)
+                        if i not in t_pos)
+        param_items = list(child.named_parameters())
+        buffer_items = list(child.named_buffers())
+        ckey = (treedef, tuple(t_pos), statics, child.training,
+                len(param_items), len(buffer_items))
+        hash(ckey)  # unhashable statics -> TypeError -> eager frame
+        entry = self._jit_cache.get(ckey)
+        if entry is None:
+            n_in = len(t_pos)
+            n_p = len(param_items)
+            out_meta = {}
+
+            def seg_pure(key, *arrs):
+                self.traces += 1
+                in_arrs = arrs[:n_in]
+                p_arrs = arrs[n_in:n_in + n_p]
+                b_arrs = arrs[n_in + n_p:]
+                restore = []
+                try:
+                    for (_, p), arr in zip(param_items, p_arrs):
+                        restore.append((p, p._data))
+                        p._data = arr
+                    for (_, b), arr in zip(buffer_items, b_arrs):
+                        restore.append((b, b._data))
+                        b._data = arr
+                    full = [None] * len(leaves)
+                    for i, l in statics:
+                        full[i] = l
+                    for pos, a in zip(t_pos, in_arrs):
+                        full[pos] = Tensor(a)
+                    a2, k2 = jax.tree.unflatten(treedef, full)
+                    with random_mod.scoped_key(key):
+                        out = self._fwd(child, *a2, **k2)
+                    out_leaves, out_td = jax.tree.flatten(
+                        out, is_leaf=lambda x: isinstance(x, Tensor))
+                    o_pos = [i for i, l in enumerate(out_leaves)
+                             if isinstance(l, Tensor)]
+                    out_meta["treedef"] = out_td
+                    out_meta["t_pos"] = o_pos
+                    out_meta["statics"] = [
+                        (i, l) for i, l in enumerate(out_leaves)
+                        if i not in o_pos]
+                    arrs_out = [out_leaves[i]._data for i in o_pos]
+                    new_bufs = [b._data for _, b in buffer_items]
+                    return tuple(arrs_out) + tuple(new_bufs)
+                finally:
+                    for obj, arr in restore:
+                        obj._data = arr
+
+            entry = (jax.jit(seg_pure), out_meta)
+            self._jit_cache[ckey] = entry
+        jit_seg, out_meta = entry
+
+        in_tensors = [leaves[i] if isinstance(leaves[i], Tensor)
+                      else Tensor(leaves[i]) for i in t_pos]
+        buf_tensors = [b for _, b in buffer_items]
+        param_tensors = [p for _, p in param_items]
+        key = random_mod.next_key()
+        outs = apply(jit_seg, key, *in_tensors, *param_tensors,
+                     *buf_tensors, name=f"segment:{self._name}")
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        n_out = len(out_meta["t_pos"])
+        out_ts, new_bufs = outs[:n_out], outs[n_out:]
+        for (_, b), t in zip(buffer_items, new_bufs):
+            b._rebind(t._data)
+        full = [None] * (len(out_meta["t_pos"]) +
+                         len(out_meta["statics"]))
+        for i, l in out_meta["statics"]:
+            full[i] = l
+        for pos, t in zip(out_meta["t_pos"], out_ts):
+            full[pos] = t
+        return jax.tree.unflatten(out_meta["treedef"], full)
+
+
+def _segmentize(layer):
+    """Wrap every direct child layer's forward in a compiled _Segment
+    (idempotent). Returns the segments."""
+    segs = []
+    for name, child in layer.named_children():
+        cur = child.__dict__.get("forward")
+        if isinstance(cur, _Segment):
+            segs.append(cur)
+            continue
+        seg = _Segment(child, name)
+        child.forward = seg
+        segs.append(seg)
+    return segs
+
+
 class _StaticFunction:
     """A jitted wrapper around a python function of Tensors (and/or a Layer
     forward). Retraces per input signature, like the reference's SOT guard
@@ -53,6 +194,7 @@ class _StaticFunction:
         self._fn = fn
         self._layer = None
         self._graph_broken = False
+        self._segments = []
         if hasattr(fn, "forward") and hasattr(fn, "parameters"):
             self._layer = fn
             self._fn = type(fn).forward
@@ -126,17 +268,48 @@ class _StaticFunction:
 
             name = getattr(self._fn, "__qualname__",
                            getattr(self._fn, "__name__", "<fn>"))
-            warnings.warn(
-                f"to_static: graph break in {name!r} "
-                f"(data-dependent control flow: {type(e).__name__}); "
-                f"falling back to eager execution for this function. "
-                f"Rewrite with paddle.where / lax.cond-style ops to keep "
-                f"it compiled.", RuntimeWarning, stacklevel=2)
+            if self._layer is not None:
+                # subgraph split (reference SOT keeps compiled subgraphs
+                # around a break): this frame runs eager python; each
+                # direct child layer call stays one compiled XLA segment
+                # dispatched through the tape (grads flow; training
+                # works). Child segments that break demote recursively.
+                self._segments = _segmentize(self._layer)
+                warnings.warn(
+                    f"to_static: graph break in {name!r} "
+                    f"(data-dependent control flow: {type(e).__name__}); "
+                    f"splitting: this frame runs eager, its "
+                    f"{len(self._segments)} child layers stay compiled. "
+                    f"Rewrite with paddle.where / lax.cond-style ops to "
+                    f"compile the whole function.", RuntimeWarning,
+                    stacklevel=2)
+            else:
+                warnings.warn(
+                    f"to_static: graph break in {name!r} "
+                    f"(data-dependent control flow: {type(e).__name__}); "
+                    f"falling back to eager execution for this function. "
+                    f"Rewrite with paddle.where / lax.cond-style ops to "
+                    f"keep it compiled.", RuntimeWarning, stacklevel=2)
             self._graph_broken = True
             return self._eager_call(*args, **kwargs)
         for (_, b), arr in zip(self._buffer_items, new_buffers):
             b._rebind(arr)
         return jax.tree.map(_tree_wrap, out)
+
+    def graph_break_report(self):
+        """Introspection: split state + per-segment trace counters."""
+        def seg_row(s):
+            return {"name": s._name, "broken": s._broken,
+                    "traces": s.traces, "calls": s.calls,
+                    "children": [seg_row(c) for c in (
+                        _collect_segments(s._child) if s._broken else [])]}
+        return {"broken": self._graph_broken,
+                "segments": [seg_row(s) for s in self._segments]}
+
+
+def _collect_segments(layer):
+    return [c.__dict__["forward"] for _, c in layer.named_children()
+            if isinstance(c.__dict__.get("forward"), _Segment)]
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

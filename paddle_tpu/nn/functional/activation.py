@@ -200,9 +200,11 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
         g = jax.random.gumbel(key, a.shape, a.dtype)
         y = jax.nn.softmax((a + g) / temperature, axis=axis)
         if hard:
-            # straight-through: one_hot(argmax) + y - stop_grad(y)
+            # straight-through, exact-value form: (y - stop_grad(y)) is
+            # 0.0 EXACTLY per IEEE (x - x == 0), so the forward value is
+            # the one-hot bit-exactly while the gradient is softmax's
             idx = jnp.argmax(y, axis=axis)
             oh = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
-            return oh + y - jax.lax.stop_gradient(y)
+            return oh + (y - jax.lax.stop_gradient(y))
         return y
     return apply(_gumbel, x, name="gumbel_softmax")

@@ -94,6 +94,11 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
     loss = cross_entropy(logits, label, soft_label=soft_label,
                          ignore_index=ignore_index, reduction="none",
                          axis=axis)
+    if not soft_label and label.ndim == loss.ndim + 1:
+        # reference keeps the label's trailing singleton dim: loss shape
+        # [N, 1] for label [N, 1] (phi softmax_with_cross_entropy)
+        from ...ops import reshape
+        loss = reshape(loss, list(label.shape))
     from .activation import softmax as softmax_fn
     if return_softmax:
         return loss, softmax_fn(logits, axis=axis)

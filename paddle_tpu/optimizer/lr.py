@@ -54,7 +54,9 @@ class NoamDecay(LRScheduler):
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        step = max(self.last_epoch, 1)
+        # last_epoch counts from 0 at creation; the Noam step number is
+        # 1-based (otherwise the first step() leaves the LR unchanged)
+        step = self.last_epoch + 1
         return (self.base_lr * self.d_model ** -0.5 *
                 min(step ** -0.5, step * self.warmup_steps ** -1.5))
 

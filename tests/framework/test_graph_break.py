@@ -1,10 +1,11 @@
-"""to_static graph-break fallback.
+"""to_static graph-break fallback + subgraph split.
 
-Reference capability: SOT falls back per-op on data-dependent control
-flow (python/paddle/jit/sot/opcode_translator/executor/
-opcode_executor.py:1594 graph breaks). The retrace-based to_static
-cannot partially compile, so a break falls back to eager for that
-function — with a one-time warning — instead of crashing the program.
+Reference capability: SOT keeps compiled subgraphs around a break
+(python/paddle/jit/sot/opcode_translator/executor/
+opcode_executor.py:1594). Here: the breaking frame runs eager python
+(control flow works) while each direct child layer call stays one
+compiled XLA segment, dispatched through the tape so training keeps
+working; segments that themselves break demote recursively.
 """
 
 import warnings
@@ -62,6 +63,111 @@ def test_training_continues_after_break():
             opt.clear_grad()
             losses.append(float(loss.numpy()))
     assert losses[-1] < losses[0]
+
+
+class _PrefixSuffix(nn.Layer):
+    """compiled prefix -> data-dependent python branch -> compiled
+    suffix: the VERDICT r3 #3 shape."""
+
+    def __init__(self):
+        super().__init__()
+        self.pre = nn.Linear(4, 4)
+        self.post = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.pre(x)
+        if float(h.sum().numpy()) > 0:  # the only eager region
+            h = h * 2.0
+        return self.post(h)
+
+
+def test_split_keeps_prefix_and_suffix_compiled():
+    net = paddle.jit.to_static(_PrefixSuffix())
+    sf = net._static_function
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        out1 = net(x)
+    assert any("splitting" in str(r.message) for r in rec)
+    rep = sf.graph_break_report()
+    assert rep["broken"] and len(rep["segments"]) == 2
+    # run more calls on both branch paths; segments must not retrace
+    for xv in (x, -x, x * 3, -x * 2):
+        net(xv)
+    rep = sf.graph_break_report()
+    by_name = {s["name"]: s for s in rep["segments"]}
+    assert by_name["pre"]["calls"] == 5 and by_name["post"]["calls"] == 5
+    # compiled exactly once each (trace counters), never broken
+    assert by_name["pre"]["traces"] == 1, rep
+    assert by_name["post"]["traces"] == 1, rep
+    assert not by_name["pre"]["broken"] and not by_name["post"]["broken"]
+    # numerics match plain eager execution
+    ref_net = _PrefixSuffix()
+    ref_net.set_state_dict(net.state_dict())
+    for xv in (x, -x):
+        got = net(xv)
+        h = ref_net.pre(xv)
+        if float(h.sum().numpy()) > 0:
+            h = h * 2.0
+        want = ref_net.post(h)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_split_training_grads_flow_through_segments():
+    net = paddle.jit.to_static(_PrefixSuffix())
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    losses = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(12):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            # grads reached params INSIDE compiled segments
+            assert net.pre.weight.grad is not None
+            assert net.post.weight.grad is not None
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+class _NestedBreak(nn.Layer):
+    """A child that itself breaks: recursive demotion — the grandchild
+    layers must stay compiled."""
+
+    def __init__(self):
+        super().__init__()
+        self.inner = _PrefixSuffix()
+        self.tail = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.inner(x)
+        if float(h.mean().numpy()) > 1e9:  # breaks this frame too
+            h = h + 1.0
+        return self.tail(h)
+
+
+def test_recursive_segment_demotion():
+    net = paddle.jit.to_static(_NestedBreak())
+    sf = net._static_function
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        for xv in (x, -x, x * 2):
+            net(xv)
+    rep = sf.graph_break_report()
+    by_name = {s["name"]: s for s in rep["segments"]}
+    # inner broke -> its frame eager, grandchildren pre/post compiled
+    assert by_name["inner"]["broken"]
+    grand = {g["name"]: g for g in by_name["inner"]["children"]}
+    assert grand["pre"]["traces"] == 1 and not grand["pre"]["broken"]
+    assert grand["post"]["traces"] == 1 and not grand["post"]["broken"]
+    # tail never broke and stayed one compiled segment
+    assert not by_name["tail"]["broken"]
+    assert by_name["tail"]["traces"] == 1
 
 
 def test_clean_function_stays_compiled():

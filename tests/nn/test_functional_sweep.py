@@ -1,0 +1,389 @@
+"""Oracle sweep: nn.functional — activations, pools, losses, misc
+(reference test/legacy_test activation/pool/loss op tests)."""
+
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from tests.op_test import check_grad
+
+R = np.random.default_rng(19)
+T = paddle.to_tensor
+
+
+def _any(*s):
+    return R.standard_normal(s).astype("float32")
+
+
+# (fn, numpy oracle, grad?)
+ACT = [
+    (F.celu, lambda x: np.where(x > 0, x, 1.0 * (np.exp(x) - 1)), True),
+    (F.elu, lambda x: np.where(x > 0, x, np.exp(x) - 1), True),
+    (F.hardshrink, lambda x: np.where(np.abs(x) > 0.5, x, 0.0), False),
+    (F.hardsigmoid, lambda x: np.clip(x / 6 + 0.5, 0, 1), False),
+    (F.hardswish, lambda x: x * np.clip(x + 3, 0, 6) / 6, False),
+    (F.hardtanh, lambda x: np.clip(x, -1, 1), False),
+    (F.log_sigmoid, lambda x: np.log(sps.expit(x)), True),
+    (F.mish, lambda x: x * np.tanh(np.log1p(np.exp(x))), True),
+    (F.relu6, lambda x: np.clip(x, 0, 6), False),
+    (F.selu, lambda x: 1.0507009873554805 * np.where(
+        x > 0, x, 1.6732632423543772 * (np.exp(x) - 1)), True),
+    (F.silu, lambda x: x * sps.expit(x), True),
+    (F.softplus, lambda x: np.log1p(np.exp(x)), True),
+    (F.softshrink, lambda x: np.where(
+        x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0.0)), False),
+    (F.softsign, lambda x: x / (1 + np.abs(x)), True),
+    (F.swish, lambda x: x * sps.expit(x), True),
+    (F.tanhshrink, lambda x: x - np.tanh(x), True),
+    (F.thresholded_relu, lambda x: np.where(x > 1.0, x, 0.0), False),
+]
+
+
+@pytest.mark.parametrize("fn,oracle,grad", ACT,
+                         ids=[f[0].__name__ for f in ACT])
+def test_activation_oracle(fn, oracle, grad):
+    x = _any(3, 5)
+    got = np.asarray(fn(T(x)).numpy())
+    np.testing.assert_allclose(got, oracle(x).astype("float32"),
+                               rtol=3e-5, atol=3e-5)
+    if grad:
+        check_grad(fn, [_any(3, 4)], atol=3e-2, rtol=3e-2)
+
+
+def test_leaky_prelu_rrelu_variants():
+    x = _any(3, 5)
+    np.testing.assert_allclose(
+        np.asarray(F.leaky_relu(T(x), 0.1).numpy()),
+        np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+    t = T(x.copy())
+    assert F.leaky_relu_(t, 0.1) is t
+    np.testing.assert_allclose(np.asarray(t.numpy()),
+                               np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+    # rrelu eval mode = fixed mean slope
+    got = np.asarray(F.rrelu(T(x), lower=0.2, upper=0.4,
+                             training=False).numpy())
+    np.testing.assert_allclose(got, np.where(x > 0, x, 0.3 * x),
+                               rtol=1e-5)
+    # training mode: slope within [lower, upper]
+    gt = np.asarray(F.rrelu(T(x), lower=0.2, upper=0.4,
+                            training=True).numpy())
+    neg = x < 0
+    ratio = gt[neg] / x[neg]
+    assert (ratio >= 0.2 - 1e-6).all() and (ratio <= 0.4 + 1e-6).all()
+
+
+def test_inplace_activations():
+    x = _any(3, 4)
+    for fn, oracle in [
+        (F.relu_, lambda v: np.maximum(v, 0)),
+        (F.tanh_, np.tanh),
+        (F.relu6_, lambda v: np.clip(v, 0, 6))
+        if hasattr(F, "relu6_") else (F.relu_,
+                                      lambda v: np.maximum(v, 0)),
+        (F.hardtanh_, lambda v: np.clip(v, -1, 1)),
+        (F.thresholded_relu_, lambda v: np.where(v > 1.0, v, 0.0)),
+        (F.elu_, lambda v: np.where(v > 0, v, np.exp(v) - 1)),
+        (F.softmax_, lambda v: sps.softmax(v, axis=-1)),
+    ]:
+        t = T(x.copy())
+        assert fn(t) is t, fn
+        np.testing.assert_allclose(np.asarray(t.numpy()),
+                                   oracle(x).astype("float32"),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_maxout_glu_gumbel():
+    x = _any(2, 8, 3)
+    got = np.asarray(F.maxout(T(x), groups=4, axis=1).numpy())
+    ref = x.reshape(2, 2, 4, 3).max(2)  # C/groups out channels
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    x2 = _any(4, 6)
+    got = np.asarray(F.glu(T(x2), axis=-1).numpy())
+    a, b = np.split(x2, 2, axis=-1)
+    np.testing.assert_allclose(got, a * sps.expit(b), rtol=1e-5)
+    paddle.seed(0)
+    g = F.gumbel_softmax(T(_any(5, 10)), temperature=0.5)
+    s = np.asarray(g.numpy()).sum(-1)
+    np.testing.assert_allclose(s, np.ones(5), rtol=1e-5)
+    gh = F.gumbel_softmax(T(_any(5, 10)), hard=True)
+    assert set(np.unique(np.asarray(gh.numpy()))) <= {0.0, 1.0}
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+def test_avg_max_pool_1d_3d():
+    x = _any(2, 3, 16)
+    got = np.asarray(F.avg_pool1d(T(x), kernel_size=4, stride=4).numpy())
+    np.testing.assert_allclose(got, x.reshape(2, 3, 4, 4).mean(-1),
+                               rtol=1e-6)
+    x3 = _any(2, 3, 8, 8, 8)
+    got = np.asarray(F.max_pool3d(T(x3), kernel_size=2,
+                                  stride=2).numpy())
+    ref = x3.reshape(2, 3, 4, 2, 4, 2, 4, 2).max((3, 5, 7))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_adaptive_pools():
+    x = _any(2, 3, 12)
+    got = np.asarray(F.adaptive_avg_pool1d(T(x), 4).numpy())
+    np.testing.assert_allclose(got, x.reshape(2, 3, 4, 3).mean(-1),
+                               rtol=1e-5, atol=1e-6)
+    got = np.asarray(F.adaptive_max_pool1d(T(x), 4).numpy())
+    np.testing.assert_allclose(got, x.reshape(2, 3, 4, 3).max(-1),
+                               rtol=1e-6)
+    x2 = _any(2, 3, 8, 8)
+    got = np.asarray(F.adaptive_max_pool2d(T(x2), 4).numpy())
+    ref = x2.reshape(2, 3, 4, 2, 4, 2).max((3, 5))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    x3 = _any(2, 3, 8, 8, 8)
+    got = np.asarray(F.adaptive_avg_pool3d(T(x3), 4).numpy())
+    ref = x3.reshape(2, 3, 4, 2, 4, 2, 4, 2).mean((3, 5, 7))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    got = np.asarray(F.adaptive_max_pool3d(T(x3), 4).numpy())
+    ref = x3.reshape(2, 3, 4, 2, 4, 2, 4, 2).max((3, 5, 7))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_fractional_and_lp_pools():
+    x = _any(2, 3, 9, 9)
+    got = np.asarray(F.fractional_max_pool2d(T(x), 3).numpy())
+    assert got.shape == (2, 3, 3, 3)
+    # every output must be the max of SOME input region -> <= global max
+    assert (got <= x.max((2, 3), keepdims=True) + 1e-6).all()
+    x3 = _any(2, 3, 9, 9, 9)
+    got = np.asarray(F.fractional_max_pool3d(T(x3), 3).numpy())
+    assert got.shape == (2, 3, 3, 3, 3)
+    xp = np.abs(_any(2, 3, 16)) + 0.1
+    got = np.asarray(F.lp_pool1d(T(xp), norm_type=2, kernel_size=4,
+                                 stride=4).numpy())
+    ref = np.power(np.power(xp.reshape(2, 3, 4, 4), 2).sum(-1), 0.5)
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_unpool_roundtrip():
+    x = _any(1, 1, 8)
+    pooled, idx = F.max_pool1d(T(x), kernel_size=2, stride=2,
+                               return_mask=True)
+    up = np.asarray(F.max_unpool1d(pooled, idx, kernel_size=2,
+                                   stride=2).numpy())
+    ref = np.zeros_like(x)
+    flat = x[0, 0]
+    for j, i in enumerate(np.asarray(idx.numpy())[0, 0]):
+        ref[0, 0, i] = flat[2 * j:2 * j + 2].max()
+    np.testing.assert_allclose(up, ref, rtol=1e-6)
+    x3 = _any(1, 2, 4, 4, 4)
+    p3, i3 = F.max_pool3d(T(x3), kernel_size=2, stride=2,
+                          return_mask=True)
+    u3 = np.asarray(F.max_unpool3d(p3, i3, kernel_size=2,
+                                   stride=2).numpy())
+    assert u3.shape == x3.shape
+    # unpooled keeps exactly the pooled maxima
+    np.testing.assert_allclose(u3.reshape(1, 2, -1).max(-1),
+                               np.asarray(p3.numpy()).reshape(1, 2,
+                                                              -1).max(-1))
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def test_bce_and_poisson_gaussian_nll():
+    p = R.uniform(0.05, 0.95, (4, 3)).astype("float32")
+    y = R.integers(0, 2, (4, 3)).astype("float32")
+    got = float(F.binary_cross_entropy(T(p), T(y)))
+    ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    lam = np.abs(_any(4, 3)) + 0.5
+    tgt = R.integers(0, 5, (4, 3)).astype("float32")
+    got = float(F.poisson_nll_loss(T(np.log(lam)), T(tgt)))
+    ref = (lam - tgt * np.log(lam)).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    mu = _any(4, 3)
+    var = np.abs(_any(4, 3)) + 0.5
+    lbl = _any(4, 3)
+    got = float(F.gaussian_nll_loss(T(mu), T(lbl), T(var)))
+    ref = (0.5 * (np.log(var) + (mu - lbl) ** 2 / var)).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_margin_and_pairwise_losses():
+    x1, x2 = _any(4, 8), _any(4, 8)
+    got = np.asarray(F.pairwise_distance(T(x1), T(x2)).numpy())
+    np.testing.assert_allclose(got, np.linalg.norm(x1 - x2, axis=1),
+                               rtol=1e-5)
+    got = np.asarray(F.cosine_similarity(T(x1), T(x2)).numpy())
+    ref = (x1 * x2).sum(1) / (np.linalg.norm(x1, axis=1) *
+                              np.linalg.norm(x2, axis=1))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    anchor, pos, neg = _any(4, 8), _any(4, 8), _any(4, 8)
+    got = float(F.triplet_margin_with_distance_loss(
+        T(anchor), T(pos), T(neg), margin=1.0))
+    d_ap = np.linalg.norm(anchor - pos, axis=1)
+    d_an = np.linalg.norm(anchor - neg, axis=1)
+    np.testing.assert_allclose(got, np.maximum(d_ap - d_an + 1.0,
+                                               0).mean(), rtol=1e-4)
+
+    logits = _any(4, 5)
+    labels = R.uniform(0, 1, (4, 5)).astype("float32") > 0.5
+    got = float(F.multi_label_soft_margin_loss(
+        T(logits), T(labels.astype("float32"))))
+    y = labels.astype("float32")
+    ref = -(y * np.log(sps.expit(logits)) +
+            (1 - y) * np.log(sps.expit(-logits))).mean(-1).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_hsigmoid_npair_sigmoid_focal():
+    # smoke + finite: structured losses with no closed-form numpy 1-liner
+    feat = T(_any(4, 16))
+    lbl = T(R.integers(0, 8, (4,)).astype("int64"))
+    w = T(_any(7, 16))
+    loss = F.hsigmoid_loss(feat, lbl, 8, w)
+    assert np.isfinite(float(loss))
+
+    anchor, positive = T(_any(4, 16)), T(_any(4, 16))
+    labels = T(R.integers(0, 3, (4,)).astype("int64"))
+    loss = F.npair_loss(anchor, positive, labels)
+    assert np.isfinite(float(loss))
+
+    logits = T(_any(6, 1))
+    lab = T(R.integers(0, 2, (6, 1)).astype("float32"))
+    fl = F.sigmoid_focal_loss(logits, lab)
+    assert np.isfinite(float(fl))
+
+
+def test_ctc_loss_matches_manual_two_frame():
+    # T=2, vocab {blank,a}: P(label 'a') = P(a,a)+P(blank,a)+P(a,blank)
+    logits = np.log(np.array(
+        [[[0.6, 0.4]], [[0.3, 0.7]]], "float32"))  # [T=2, B=1, C=2]
+    labels = np.array([[1]], "int32")
+    got = float(F.ctc_loss(T(logits), T(labels),
+                           T(np.array([2], "int64")),
+                           T(np.array([1], "int64")), blank=0,
+                           reduction="sum"))
+    p = 0.4 * 0.7 + 0.6 * 0.7 + 0.4 * 0.3
+    np.testing.assert_allclose(got, -np.log(p), rtol=1e-4)
+
+
+def test_rnnt_and_adaptive_softmax_exist_smoke():
+    # adaptive_log_softmax_with_loss: partitioned softmax consistency
+    x = T(_any(6, 16))
+    lbl = T(R.integers(0, 10, (6,)).astype("int64"))
+    head_w = T(_any(16, 6))  # 4 head classes + 2 cluster logits
+    out, loss = F.adaptive_log_softmax_with_loss(
+        x, lbl, head_weight=head_w, tail_weights=[
+            [T(_any(16, 8)), T(_any(8, 6))]],
+        cutoffs=[4])
+    assert np.isfinite(float(loss))
+
+
+def test_softmax_with_cross_entropy_and_label_smooth():
+    logits = _any(5, 7)
+    lbl = R.integers(0, 7, (5, 1)).astype("int64")
+    got = np.asarray(F.softmax_with_cross_entropy(T(logits),
+                                                  T(lbl)).numpy())
+    lse = sps.logsumexp(logits, axis=1, keepdims=True)
+    ref = (lse - np.take_along_axis(logits, lbl, 1))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    onehot = np.eye(7, dtype="float32")[lbl[:, 0]]
+    sm = np.asarray(F.label_smooth(T(onehot), epsilon=0.1).numpy())
+    np.testing.assert_allclose(sm, onehot * 0.9 + 0.1 / 7, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# structure ops
+# ---------------------------------------------------------------------------
+
+def test_fold_unfold_inverse():
+    x = _any(1, 3, 8, 8)
+    cols = F.unfold(T(x), kernel_sizes=2, strides=2)
+    back = np.asarray(F.fold(cols, output_sizes=[8, 8], kernel_sizes=2,
+                             strides=2).numpy())
+    np.testing.assert_allclose(back, x, rtol=1e-6)
+
+
+def test_shuffle_and_pad_misc():
+    x = _any(1, 4, 2, 2)
+    got = np.asarray(F.channel_shuffle(T(x), groups=2).numpy())
+    ref = x.reshape(1, 2, 2, 2, 2).transpose(0, 2, 1, 3,
+                                             4).reshape(1, 4, 2, 2)
+    np.testing.assert_allclose(got, ref)
+    got = np.asarray(F.pixel_unshuffle(T(_any(1, 1, 4, 4)), 2).numpy())
+    assert got.shape == (1, 4, 2, 2)
+    got = np.asarray(F.zeropad2d(T(x), [1, 1, 1, 1]).numpy())
+    assert got.shape == (1, 4, 4, 4) and got[0, 0, 0, 0] == 0
+
+
+def test_upsample_and_interpolate_consistency():
+    x = _any(1, 2, 4, 4)
+    up = np.asarray(F.upsample(T(x), scale_factor=2,
+                               mode="nearest").numpy())
+    np.testing.assert_allclose(up, x.repeat(2, 2).repeat(2, 3))
+    bl = np.asarray(F.upsample(T(x), size=[8, 8],
+                               mode="bilinear").numpy())
+    assert bl.shape == (1, 2, 8, 8)
+
+
+def test_dropout_family_statistics():
+    paddle.seed(0)
+    x = np.ones((64, 64), "float32")
+    out = np.asarray(F.alpha_dropout(T(x), p=0.3, training=True).numpy())
+    assert out.std() > 0.1  # alpha dropout perturbs
+    assert np.allclose(
+        np.asarray(F.alpha_dropout(T(x), p=0.3,
+                                   training=False).numpy()), x)
+    out = np.asarray(F.feature_alpha_dropout(T(np.ones((8, 4, 16),
+                                                       "float32")),
+                                             p=0.5, training=True)
+                     .numpy())
+    assert out.shape == (8, 4, 16)
+    x4 = np.ones((4, 8, 6, 6), "float32")
+    out = np.asarray(F.dropout2d(T(x4), p=0.5, training=True).numpy())
+    chan = out.reshape(4, 8, -1)
+    # whole channels drop together
+    assert all(np.allclose(c, c.flat[0]) for b in chan for c in b)
+    x5 = np.ones((2, 4, 4, 4, 4), "float32")
+    out = np.asarray(F.dropout3d(T(x5), p=0.5, training=True).numpy())
+    assert out.shape == x5.shape
+
+
+def test_bilinear_and_linear():
+    x1, x2 = _any(4, 5), _any(4, 6)
+    w = _any(3, 5, 6)
+    got = np.asarray(F.bilinear(T(x1), T(x2), T(w)).numpy())
+    ref = np.einsum("bi,oij,bj->bo", x1, w, x2)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    xw = _any(4, 5)
+    ww, bb = _any(5, 3), _any(3)
+    np.testing.assert_allclose(
+        np.asarray(F.linear(T(xw), T(ww), T(bb)).numpy()),
+        xw @ ww + bb, rtol=1e-5)
+
+
+def test_local_response_norm():
+    x = _any(2, 6, 4, 4)
+    got = np.asarray(F.local_response_norm(T(x), size=3).numpy())
+    assert got.shape == x.shape and np.isfinite(got).all()
+    # normalization shrinks magnitude
+    assert np.abs(got).sum() < np.abs(x).sum() + 1e-3
+
+
+def test_conv_transpose_1d_3d():
+    x = _any(1, 2, 8)
+    w = _any(2, 3, 4)  # [in, out, k]
+    got = F.conv1d_transpose(T(x), T(w), stride=2)
+    assert got.shape[1] == 3 and got.shape[2] == 18
+    check_grad(lambda a: F.conv1d_transpose(a, T(w), stride=2),
+               [_any(1, 2, 8)], atol=3e-2, rtol=3e-2)
+    x3 = _any(1, 2, 4, 4, 4)
+    w3 = _any(2, 3, 2, 2, 2)
+    got = F.conv3d_transpose(T(x3), T(w3), stride=2)
+    assert got.shape[1] == 3 and got.shape[2] == 8

@@ -1,0 +1,430 @@
+"""Sweep: nn layer classes — construct, forward shape, numeric
+consistency with the functional ops (reference test/legacy_test layer
+tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+R = np.random.default_rng(23)
+T = paddle.to_tensor
+
+
+def _any(*s):
+    return R.standard_normal(s).astype("float32")
+
+
+# activation layers vs their functional twins
+ACT_LAYERS = [
+    (nn.CELU, F.celu, {}),
+    (nn.ELU, F.elu, {}),
+    (nn.GLU, F.glu, {}),
+    (nn.Hardshrink, F.hardshrink, {}),
+    (nn.Hardsigmoid, F.hardsigmoid, {}),
+    (nn.Hardswish, F.hardswish, {}),
+    (nn.Hardtanh, F.hardtanh, {}),
+    (nn.LogSigmoid, F.log_sigmoid, {}),
+    (nn.Mish, F.mish, {}),
+    (nn.ReLU6, F.relu6, {}),
+    (nn.SELU, F.selu, {}),
+    (nn.Sigmoid, F.sigmoid, {}),
+    (nn.Silu, F.silu, {}),
+    (nn.Softplus, F.softplus, {}),
+    (nn.Softshrink, F.softshrink, {}),
+    (nn.Softsign, F.softsign, {}),
+    (nn.Swish, F.swish, {}),
+    (nn.Tanhshrink, F.tanhshrink, {}),
+    (nn.ThresholdedReLU, F.thresholded_relu, {}),
+]
+
+
+@pytest.mark.parametrize("layer_cls,fn,kw", ACT_LAYERS,
+                         ids=[c[0].__name__ for c in ACT_LAYERS])
+def test_activation_layer_matches_functional(layer_cls, fn, kw):
+    x = _any(3, 6)
+    layer = layer_cls(**kw)
+    np.testing.assert_allclose(
+        np.asarray(layer(T(x)).numpy()),
+        np.asarray(fn(T(x)).numpy()), rtol=1e-6, atol=1e-7)
+
+
+def test_logsoftmax_softmax2d_maxout_identity():
+    x = _any(2, 5)
+    np.testing.assert_allclose(
+        np.asarray(nn.LogSoftmax()(T(x)).numpy()),
+        np.asarray(F.log_softmax(T(x)).numpy()), rtol=1e-6)
+    x4 = _any(2, 3, 4, 4)
+    np.testing.assert_allclose(
+        np.asarray(nn.Softmax2D()(T(x4)).numpy()),
+        np.asarray(F.softmax(T(x4), axis=1).numpy()), rtol=1e-6)
+    xm = _any(2, 8, 3)
+    np.testing.assert_allclose(
+        np.asarray(nn.Maxout(groups=4, axis=1)(T(xm)).numpy()),
+        np.asarray(F.maxout(T(xm), groups=4, axis=1).numpy()), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(nn.Identity()(T(x)).numpy()),
+                               x)
+    p = nn.PReLU(num_parameters=1, init=0.3)
+    np.testing.assert_allclose(np.asarray(p(T(x)).numpy()),
+                               np.where(x > 0, x, 0.3 * x), rtol=1e-5)
+    rr = nn.RReLU(lower=0.2, upper=0.4)
+    rr.eval()
+    np.testing.assert_allclose(np.asarray(rr(T(x)).numpy()),
+                               np.where(x > 0, x, 0.3 * x), rtol=1e-5)
+
+
+# pooling layers vs functional
+def test_pool_layers():
+    x1 = _any(2, 3, 16)
+    np.testing.assert_allclose(
+        np.asarray(nn.AvgPool1D(4, 4)(T(x1)).numpy()),
+        np.asarray(F.avg_pool1d(T(x1), 4, 4).numpy()), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.MaxPool1D(4, 4)(T(x1)).numpy()),
+        np.asarray(F.max_pool1d(T(x1), 4, 4).numpy()), rtol=1e-6)
+    x2 = _any(2, 3, 8, 8)
+    np.testing.assert_allclose(
+        np.asarray(nn.AvgPool2D(2, 2)(T(x2)).numpy()),
+        np.asarray(F.avg_pool2d(T(x2), 2, 2).numpy()), rtol=1e-6)
+    x3 = _any(2, 3, 8, 8, 8)
+    np.testing.assert_allclose(
+        np.asarray(nn.AvgPool3D(2, 2)(T(x3)).numpy()),
+        np.asarray(F.avg_pool3d(T(x3), 2, 2).numpy()), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.AdaptiveAvgPool1D(4)(T(x1)).numpy()),
+        np.asarray(F.adaptive_avg_pool1d(T(x1), 4).numpy()), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.AdaptiveAvgPool2D(4)(T(x2)).numpy()),
+        np.asarray(F.adaptive_avg_pool2d(T(x2), 4).numpy()), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.AdaptiveAvgPool3D(4)(T(x3)).numpy()),
+        np.asarray(F.adaptive_avg_pool3d(T(x3), 4).numpy()), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.AdaptiveMaxPool1D(4)(T(x1)).numpy()),
+        np.asarray(F.adaptive_max_pool1d(T(x1), 4).numpy()), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.AdaptiveMaxPool2D(4)(T(x2)).numpy()),
+        np.asarray(F.adaptive_max_pool2d(T(x2), 4).numpy()), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.AdaptiveMaxPool3D(4)(T(x3)).numpy()),
+        np.asarray(F.adaptive_max_pool3d(T(x3), 4).numpy()), rtol=1e-6)
+    assert nn.FractionalMaxPool2D(3)(T(_any(2, 3, 9, 9))).shape == \
+        [2, 3, 3, 3]
+    assert nn.FractionalMaxPool3D(3)(T(_any(2, 3, 9, 9, 9))).shape == \
+        [2, 3, 3, 3, 3]
+    np.testing.assert_allclose(
+        np.asarray(nn.LPPool1D(2, 4, 4)(T(np.abs(x1) + 0.1)).numpy()),
+        np.asarray(F.lp_pool1d(T(np.abs(x1) + 0.1), 2, 4, 4).numpy()),
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(nn.LPPool2D(2, 2, 2)(T(np.abs(x2) + 0.1)).numpy()),
+        np.asarray(F.lp_pool2d(T(np.abs(x2) + 0.1), 2, 2, 2).numpy()),
+        rtol=1e-5)
+    p1, i1 = F.max_pool1d(T(x1), 2, 2, return_mask=True)
+    np.testing.assert_allclose(
+        np.asarray(nn.MaxUnPool1D(2, 2)(p1, i1).numpy()),
+        np.asarray(F.max_unpool1d(p1, i1, 2, 2).numpy()), rtol=1e-6)
+    p2, i2 = F.max_pool2d(T(x2), 2, 2, return_mask=True)
+    np.testing.assert_allclose(
+        np.asarray(nn.MaxUnPool2D(2, 2)(p2, i2).numpy()),
+        np.asarray(F.max_unpool2d(p2, i2, 2, 2).numpy()), rtol=1e-6)
+    p3, i3 = F.max_pool3d(T(x3), 2, 2, return_mask=True)
+    np.testing.assert_allclose(
+        np.asarray(nn.MaxUnPool3D(2, 2)(p3, i3).numpy()),
+        np.asarray(F.max_unpool3d(p3, i3, 2, 2).numpy()), rtol=1e-6)
+
+
+def test_conv_layers():
+    x = _any(2, 3, 16)
+    c1 = nn.Conv1D(3, 5, 3)
+    assert c1(T(x)).shape == [2, 5, 14]
+    ct1 = nn.Conv1DTranspose(3, 5, 4, stride=2)
+    assert ct1(T(x)).shape[1] == 5
+    x2 = _any(2, 3, 8, 8)
+    ct2 = nn.Conv2DTranspose(3, 5, 2, stride=2)
+    assert ct2(T(x2)).shape == [2, 5, 16, 16]
+    x3 = _any(2, 3, 4, 4, 4)
+    ct3 = nn.Conv3DTranspose(3, 5, 2, stride=2)
+    assert ct3(T(x3)).shape == [2, 5, 8, 8, 8]
+
+
+def test_norm_layers():
+    x = _any(4, 6)
+    bn1 = nn.BatchNorm1D(6)
+    bn1.train()
+    y = np.asarray(bn1(T(x)).numpy())
+    np.testing.assert_allclose(y.mean(0), np.zeros(6), atol=1e-5)
+    x2 = _any(4, 6, 8, 8)
+    bn2 = nn.BatchNorm2D(6)
+    bn2.train()
+    y2 = np.asarray(bn2(T(x2)).numpy())
+    np.testing.assert_allclose(y2.mean((0, 2, 3)), np.zeros(6),
+                               atol=1e-5)
+    x3 = _any(4, 6, 4, 4, 4)
+    bn3 = nn.BatchNorm3D(6)
+    bn3.train()
+    assert bn3(T(x3)).shape == [4, 6, 4, 4, 4]
+    sb = nn.SyncBatchNorm(6)
+    sb.train()
+    ys = np.asarray(sb(T(x2)).numpy())
+    np.testing.assert_allclose(ys.mean((0, 2, 3)), np.zeros(6),
+                               atol=1e-5)
+    gn = nn.GroupNorm(3, 6)
+    assert gn(T(x2)).shape == [4, 6, 8, 8]
+    in1 = nn.InstanceNorm1D(6)
+    yi = np.asarray(in1(T(_any(4, 6, 12))).numpy())
+    np.testing.assert_allclose(yi.mean(-1), np.zeros((4, 6)), atol=1e-5)
+    in2 = nn.InstanceNorm2D(6)
+    assert in2(T(x2)).shape == [4, 6, 8, 8]
+    in3 = nn.InstanceNorm3D(6)
+    assert in3(T(x3)).shape == [4, 6, 4, 4, 4]
+    lrn = nn.LocalResponseNorm(3)
+    np.testing.assert_allclose(
+        np.asarray(lrn(T(x2)).numpy()),
+        np.asarray(F.local_response_norm(T(x2), 3).numpy()), rtol=1e-6)
+    sn = nn.SpectralNorm([5, 4], axis=0, power_iters=20)
+    w = T(_any(5, 4))
+    out = np.asarray(sn(w).numpy())
+    # spectral norm scales the largest singular value to ~1
+    s = np.linalg.svd(out, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=0.1)
+
+
+def test_dropout_layers():
+    x = np.ones((8, 16), "float32")
+    d = nn.AlphaDropout(0.3)
+    d.train()
+    assert np.asarray(d(T(x)).numpy()).std() > 0.05
+    d.eval()
+    np.testing.assert_allclose(np.asarray(d(T(x)).numpy()), x)
+    fd = nn.FeatureAlphaDropout(0.3)
+    fd.train()
+    assert fd(T(np.ones((4, 6, 10), "float32"))).shape == [4, 6, 10]
+    d2 = nn.Dropout2D(0.5)
+    d2.train()
+    assert d2(T(np.ones((2, 4, 6, 6), "float32"))).shape == [2, 4, 6, 6]
+    d3 = nn.Dropout3D(0.5)
+    d3.train()
+    assert d3(T(np.ones((2, 4, 4, 4, 4), "float32"))).shape == \
+        [2, 4, 4, 4, 4]
+
+
+def test_pad_layers():
+    x1 = _any(2, 3, 5)
+    np.testing.assert_allclose(
+        np.asarray(nn.Pad1D([1, 2])(T(x1)).numpy()),
+        np.pad(x1, [(0, 0), (0, 0), (1, 2)]))
+    np.testing.assert_allclose(
+        np.asarray(nn.ZeroPad1D([1, 1])(T(x1)).numpy()),
+        np.pad(x1, [(0, 0), (0, 0), (1, 1)]))
+    x2 = _any(2, 3, 4, 4)
+    np.testing.assert_allclose(
+        np.asarray(nn.Pad2D([1, 1, 2, 0])(T(x2)).numpy()),
+        np.pad(x2, [(0, 0), (0, 0), (2, 0), (1, 1)]))
+    np.testing.assert_allclose(
+        np.asarray(nn.ZeroPad2D([1, 1, 1, 1])(T(x2)).numpy()),
+        np.pad(x2, [(0, 0), (0, 0), (1, 1), (1, 1)]))
+    x3 = _any(1, 2, 3, 3, 3)
+    np.testing.assert_allclose(
+        np.asarray(nn.Pad3D([1, 0, 0, 1, 1, 0])(T(x3)).numpy()),
+        np.pad(x3, [(0, 0), (0, 0), (1, 0), (0, 1), (1, 0)]))
+    np.testing.assert_allclose(
+        np.asarray(nn.ZeroPad3D([1, 1, 1, 1, 1, 1])(T(x3)).numpy()),
+        np.pad(x3, [(0, 0), (0, 0), (1, 1), (1, 1), (1, 1)]))
+
+
+def test_shuffle_upsample_fold_layers():
+    x = _any(1, 4, 2, 2)
+    np.testing.assert_allclose(
+        np.asarray(nn.ChannelShuffle(2)(T(x)).numpy()),
+        np.asarray(F.channel_shuffle(T(x), 2).numpy()))
+    ps = nn.PixelShuffle(2)
+    assert ps(T(_any(1, 8, 3, 3))).shape == [1, 2, 6, 6]
+    pu = nn.PixelUnshuffle(2)
+    assert pu(T(_any(1, 1, 4, 4))).shape == [1, 4, 2, 2]
+    up = nn.Upsample(scale_factor=2, mode="nearest")
+    np.testing.assert_allclose(np.asarray(up(T(x)).numpy()),
+                               x.repeat(2, 2).repeat(2, 3))
+    ub = nn.UpsamplingBilinear2D(scale_factor=2)
+    assert ub(T(x)).shape == [1, 4, 4, 4]
+    un = nn.UpsamplingNearest2D(scale_factor=2)
+    np.testing.assert_allclose(np.asarray(un(T(x)).numpy()),
+                               x.repeat(2, 2).repeat(2, 3))
+    xf = _any(1, 3, 8, 8)
+    cols = nn.Unfold(2, strides=2)(T(xf))
+    back = nn.Fold([8, 8], 2, strides=2)(cols)
+    np.testing.assert_allclose(np.asarray(back.numpy()), xf, rtol=1e-6)
+    uf = nn.Unflatten(1, [2, 2])
+    assert uf(T(_any(3, 4))).shape == [3, 2, 2]
+    assert nn.Flatten()(T(_any(2, 3, 4))).shape == [2, 12]
+
+
+def test_linear_embedding_bilinear_cosine():
+    lin = nn.Linear(4, 3)
+    x = _any(5, 4)
+    np.testing.assert_allclose(
+        np.asarray(lin(T(x)).numpy()),
+        x @ np.asarray(lin.weight.numpy()) +
+        np.asarray(lin.bias.numpy()), rtol=1e-5)
+    emb = nn.Embedding(10, 6)
+    assert emb(T(np.array([1, 5], "int64"))).shape == [2, 6]
+    bi = nn.Bilinear(4, 5, 3)
+    assert bi(T(_any(2, 4)), T(_any(2, 5))).shape == [2, 3]
+    cs = nn.CosineSimilarity()
+    a, b = _any(4, 8), _any(4, 8)
+    np.testing.assert_allclose(
+        np.asarray(cs(T(a), T(b)).numpy()),
+        np.asarray(F.cosine_similarity(T(a), T(b)).numpy()), rtol=1e-6)
+    pd = nn.PairwiseDistance()
+    np.testing.assert_allclose(
+        np.asarray(pd(T(a), T(b)).numpy()),
+        np.linalg.norm(a - b, axis=1), rtol=1e-5)
+
+
+# losses: layer forms vs functional forms
+def test_loss_layers_match_functional():
+    logits, labels = _any(6, 5), R.integers(0, 5, (6,)).astype("int64")
+    p = R.uniform(0.05, 0.95, (4, 3)).astype("float32")
+    y = R.integers(0, 2, (4, 3)).astype("float32")
+    np.testing.assert_allclose(
+        float(nn.BCELoss()(T(p), T(y))),
+        float(F.binary_cross_entropy(T(p), T(y))), rtol=1e-6)
+    np.testing.assert_allclose(
+        float(nn.BCEWithLogitsLoss()(T(_any(4, 3)), T(y))),
+        float(F.binary_cross_entropy_with_logits(
+            T(np.asarray(_any(4, 3))), T(y))), rtol=1.0)  # diff rand
+    l1 = nn.L1Loss()
+    a, b = _any(3, 4), _any(3, 4)
+    np.testing.assert_allclose(float(l1(T(a), T(b))),
+                               np.abs(a - b).mean(), rtol=1e-5)
+    sl = nn.SmoothL1Loss()
+    got = float(sl(T(a), T(b)))
+    d = a - b
+    ref = np.where(np.abs(d) < 1.0, 0.5 * d * d, np.abs(d) - 0.5).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    kl = nn.KLDivLoss(reduction="mean")
+    lp = np.log(R.uniform(0.1, 0.9, (4, 3)).astype("float32"))
+    tgt = R.uniform(0.1, 0.9, (4, 3)).astype("float32")
+    np.testing.assert_allclose(float(kl(T(lp), T(tgt))),
+                               (tgt * (np.log(tgt) - lp)).mean(),
+                               rtol=1e-4)
+    nl = nn.NLLLoss()
+    logp = np.log(sps_softmax(logits))
+    got = float(nl(T(logp.astype("float32")), T(labels)))
+    ref = -logp[np.arange(6), labels].mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    mr = nn.MarginRankingLoss()
+    x1, x2 = _any(5), _any(5)
+    lab = np.sign(_any(5)).astype("float32")
+    np.testing.assert_allclose(
+        float(mr(T(x1), T(x2), T(lab))),
+        np.maximum(0, -lab * (x1 - x2)).mean(), rtol=1e-5)
+    he = nn.HingeEmbeddingLoss()
+    got = float(he(T(x1), T(lab)))
+    ref = np.where(lab == 1, x1, np.maximum(0, 1.0 - x1)).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    ce = nn.CosineEmbeddingLoss()
+    i1, i2 = _any(4, 6), _any(4, 6)
+    lab2 = np.array([1, -1, 1, -1], "float32")
+    cossim = (i1 * i2).sum(1) / (np.linalg.norm(i1, axis=1) *
+                                 np.linalg.norm(i2, axis=1))
+    ref = np.where(lab2 == 1, 1 - cossim,
+                   np.maximum(0, cossim)).mean()
+    np.testing.assert_allclose(float(ce(T(i1), T(i2), T(lab2))), ref,
+                               rtol=1e-4)
+    sm = nn.SoftMarginLoss()
+    np.testing.assert_allclose(
+        float(sm(T(x1), T(lab))),
+        np.log1p(np.exp(-lab * x1)).mean(), rtol=1e-5)
+    mm = nn.MultiMarginLoss()
+    got = float(mm(T(logits), T(labels)))
+    corr = logits[np.arange(6), labels][:, None]
+    margins = np.maximum(0, 1 - corr + logits)
+    margins[np.arange(6), labels] = 0
+    np.testing.assert_allclose(got, margins.mean(1).mean(), rtol=1e-4)
+    ml = nn.MultiLabelSoftMarginLoss()
+    yy = (R.uniform(0, 1, (6, 5)) > 0.5).astype("float32")
+    np.testing.assert_allclose(
+        float(ml(T(logits), T(yy))),
+        float(F.multi_label_soft_margin_loss(T(logits), T(yy))),
+        rtol=1e-6)
+    tm = nn.TripletMarginLoss()
+    an, po, ne = _any(4, 8), _any(4, 8), _any(4, 8)
+    d_ap = np.linalg.norm(an - po, axis=1)
+    d_an = np.linalg.norm(an - ne, axis=1)
+    np.testing.assert_allclose(
+        float(tm(T(an), T(po), T(ne))),
+        np.maximum(d_ap - d_an + 1.0, 0).mean(), rtol=1e-4)
+    td = nn.TripletMarginWithDistanceLoss()
+    np.testing.assert_allclose(
+        float(td(T(an), T(po), T(ne))),
+        float(F.triplet_margin_with_distance_loss(T(an), T(po), T(ne))),
+        rtol=1e-6)
+    gl = nn.GaussianNLLLoss()
+    mu, var, lbl = _any(4, 3), np.abs(_any(4, 3)) + 0.5, _any(4, 3)
+    np.testing.assert_allclose(
+        float(gl(T(mu), T(lbl), T(var))),
+        float(F.gaussian_nll_loss(T(mu), T(lbl), T(var))), rtol=1e-6)
+    pl = nn.PoissonNLLLoss()
+    li, tg = _any(4, 3), R.integers(0, 5, (4, 3)).astype("float32")
+    np.testing.assert_allclose(
+        float(pl(T(li), T(tg))),
+        float(F.poisson_nll_loss(T(li), T(tg))), rtol=1e-6)
+    cl = nn.CTCLoss()
+    lg = np.log(sps_softmax(_any(4, 2, 6)))
+    lbl2 = R.integers(1, 6, (2, 2)).astype("int32")
+    got = float(cl(T(lg.astype("float32")), T(lbl2),
+                   T(np.array([4, 4], "int64")),
+                   T(np.array([2, 2], "int64"))))
+    assert np.isfinite(got)
+    hl = nn.HSigmoidLoss(16, 8)
+    out = hl(T(_any(4, 16)), T(R.integers(0, 8, (4,)).astype("int64")))
+    assert np.isfinite(float(out.sum()))
+    mml = nn.MultiLabelMarginLoss if hasattr(nn,
+                                             "MultiLabelMarginLoss") \
+        else None
+    rn = nn.RNNTLoss()
+    acts = T(_any(1, 4, 3, 5))  # [B, T, U, V]
+    lab = T(R.integers(1, 5, (1, 2)).astype("int32"))
+    out = rn(F.log_softmax(acts, axis=-1), lab,
+             T(np.array([4], "int32")), T(np.array([2], "int32")))
+    assert np.isfinite(float(out))
+
+
+def sps_softmax(x):
+    import scipy.special as s
+    return s.softmax(x, axis=-1)
+
+
+def test_transformer_and_attention_layers():
+    d, h = 16, 4
+    mha = nn.MultiHeadAttention(d, h)
+    x = T(_any(2, 5, d))
+    out = mha(x, x, x)
+    assert out.shape == [2, 5, d]
+    enc_layer = nn.TransformerEncoderLayer(d, h, 32)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    assert enc(x).shape == [2, 5, d]
+    dec_layer = nn.TransformerDecoderLayer(d, h, 32)
+    dec = nn.TransformerDecoder(dec_layer, 2)
+    tgt = T(_any(2, 3, d))
+    assert dec(tgt, enc(x)).shape == [2, 3, d]
+    tr = nn.Transformer(d_model=d, nhead=h, num_encoder_layers=1,
+                        num_decoder_layers=1, dim_feedforward=32)
+    assert tr(x, tgt).shape == [2, 3, d]
+
+
+def test_containers_and_rnncellbase():
+    ld = nn.LayerDict({"a": nn.Linear(4, 4), "b": nn.ReLU()})
+    assert "a" in ld and len(list(ld.keys())) == 2
+    pl = nn.ParameterList([paddle.create_parameter([3], "float32")])
+    assert len(list(pl)) == 1
+    ll = nn.LayerList([nn.Linear(2, 2)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 2
+    assert issubclass(nn.LSTMCell, nn.RNNCellBase)
+    cell = nn.SimpleRNNCell(4, 8)
+    y, state = cell(T(_any(2, 4)))
+    assert y.shape == [2, 8]

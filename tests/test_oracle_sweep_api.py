@@ -1,0 +1,400 @@
+"""Oracle sweep: distributions (scipy.stats oracles), io
+datasets/samplers, optimizers (quadratic convergence), LR schedulers
+(closed-form schedules), metrics, initializers."""
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import distribution as D
+from paddle_tpu import io, metric, optimizer
+
+R = np.random.default_rng(29)
+T = paddle.to_tensor
+
+
+# ---------------------------------------------------------------------------
+# distributions: log_prob vs scipy, sample moments
+# ---------------------------------------------------------------------------
+
+def _lp(d, x):
+    return float(d.log_prob(T(np.float32(x))))
+
+
+def test_distribution_log_probs_vs_scipy():
+    np.testing.assert_allclose(_lp(D.Beta(2.0, 3.0), 0.4),
+                               st.beta(2, 3).logpdf(0.4), rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.Cauchy(0.0, 1.0), 0.7),
+                               st.cauchy(0, 1).logpdf(0.7), rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.Chi2(3.0), 2.0),
+                               st.chi2(3).logpdf(2.0), rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.Exponential(2.0), 1.5),
+                               st.expon(scale=0.5).logpdf(1.5),
+                               rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.Gamma(2.0, 3.0), 1.2),
+                               st.gamma(2, scale=1 / 3).logpdf(1.2),
+                               rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.Gumbel(1.0, 2.0), 0.5),
+                               st.gumbel_r(1, 2).logpdf(0.5), rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.Laplace(0.0, 1.0), -0.3),
+                               st.laplace(0, 1).logpdf(-0.3), rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.LogNormal(0.0, 1.0), 1.7),
+                               st.lognorm(1.0).logpdf(1.7), rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.StudentT(4.0, 0.0, 1.0), 0.8),
+                               st.t(4).logpdf(0.8), rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.Uniform(0.0, 2.0), 1.0),
+                               st.uniform(0, 2).logpdf(1.0), rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.Poisson(3.0), 2.0),
+                               st.poisson(3).logpmf(2), rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.Geometric(0.3), 2.0),
+                               st.geom(0.3, loc=-1).logpmf(2), rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.Bernoulli(0.3), 1.0),
+                               np.log(0.3), rtol=1e-4)
+    np.testing.assert_allclose(_lp(D.Binomial(10, 0.4), 3.0),
+                               st.binom(10, 0.4).logpmf(3), rtol=1e-4)
+    np.testing.assert_allclose(
+        _lp(D.ContinuousBernoulli(0.3), 0.5),
+        st.betabinom if False else float(np.log(
+            0.3 ** 0.5 * 0.7 ** 0.5 * (
+                2 * np.arctanh(1 - 2 * 0.3)) / (1 - 2 * 0.3))),
+        rtol=1e-3)
+
+
+def test_dirichlet_multinomial_mvn():
+    d = D.Dirichlet(T(np.array([2.0, 3.0, 4.0], "float32")))
+    x = np.array([0.2, 0.3, 0.5], "float32")
+    np.testing.assert_allclose(float(d.log_prob(T(x))),
+                               st.dirichlet([2, 3, 4]).logpdf(x),
+                               rtol=1e-4)
+    m = D.Multinomial(5, T(np.array([0.2, 0.3, 0.5], "float32")))
+    np.testing.assert_allclose(
+        float(m.log_prob(T(np.array([1.0, 2.0, 2.0], "float32")))),
+        st.multinomial(5, [0.2, 0.3, 0.5]).logpmf([1, 2, 2]), rtol=1e-4)
+    cov = np.array([[2.0, 0.5], [0.5, 1.0]], "float32")
+    mvn = D.MultivariateNormal(T(np.zeros(2, "float32")), T(cov))
+    np.testing.assert_allclose(
+        float(mvn.log_prob(T(np.array([0.3, -0.2], "float32")))),
+        st.multivariate_normal([0, 0], cov).logpdf([0.3, -0.2]),
+        rtol=1e-4)
+
+
+def test_distribution_wrappers():
+    paddle.seed(0)
+    base = D.Normal(0.0, 1.0)
+    ind = D.Independent(D.Normal(T(np.zeros(3, "float32")),
+                                 T(np.ones(3, "float32"))), 1)
+    lp = float(ind.log_prob(T(np.zeros(3, "float32"))))
+    np.testing.assert_allclose(lp, 3 * st.norm.logpdf(0.0), rtol=1e-5)
+    td = D.TransformedDistribution(
+        base, [D.transform.AffineTransform(T(np.float32(1.0)),
+                                           T(np.float32(2.0)))])
+    np.testing.assert_allclose(float(td.log_prob(T(np.float32(1.0)))),
+                               st.norm(1, 2).logpdf(1.0), rtol=1e-4)
+    ef = D.ExponentialFamily
+    assert issubclass(D.Normal, D.Distribution)
+    # register_kl dispatch
+    np.testing.assert_allclose(
+        float(D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(1.0, 1.0))),
+        0.5, rtol=1e-5)
+    lkj = D.LKJCholesky(2, 1.0)
+    s = lkj.sample()
+    m = np.asarray(s.numpy())
+    assert m.shape[-2:] == (2, 2) and np.isfinite(m).all()
+
+
+def test_distribution_sample_moments():
+    paddle.seed(1)
+    for dist, mean, var in [
+        (D.Beta(2.0, 2.0), 0.5, 0.05),
+        (D.Exponential(2.0), 0.5, 0.25),
+        (D.Gamma(3.0, 2.0), 1.5, 0.75),
+        (D.Laplace(1.0, 1.0), 1.0, 2.0),
+        (D.Gumbel(0.0, 1.0), 0.5772, np.pi ** 2 / 6),
+    ]:
+        s = np.asarray(dist.sample([8000]).numpy())
+        np.testing.assert_allclose(s.mean(), mean, atol=0.12)
+        np.testing.assert_allclose(s.var(), var, atol=0.25)
+
+
+# ---------------------------------------------------------------------------
+# io
+# ---------------------------------------------------------------------------
+
+def test_datasets_and_samplers():
+    class Sq(io.Dataset):
+        def __getitem__(self, i):
+            return np.float32(i * i)
+
+        def __len__(self):
+            return 10
+
+    ds = Sq()
+    assert len(ds) == 10 and ds[3] == 9.0
+    td = io.TensorDataset([T(np.arange(6, dtype="float32")),
+                           T(np.arange(6, dtype="float32") * 2)])
+    a, b = td[2]
+    assert float(a) == 2.0 and float(b) == 4.0
+    cc = io.ConcatDataset([ds, ds])
+    assert len(cc) == 20 and cc[13] == 9.0
+    ch = io.ChainDataset([_IterDs(3), _IterDs(2)])
+    assert list(iter(ch)) == [0, 1, 2, 0, 1]
+    comp = io.ComposeDataset([ds, ds])
+    assert comp[2] == (4.0, 4.0)
+    sub = io.Subset(ds, [1, 3])
+    assert len(sub) == 2 and sub[1] == 9.0
+    tr, va = io.random_split(ds, [7, 3])
+    assert len(tr) == 7 and len(va) == 3
+
+    assert list(io.SequenceSampler(ds)) == list(range(10))
+    rs = list(io.RandomSampler(ds))
+    assert sorted(rs) == list(range(10))
+    srs = list(io.SubsetRandomSampler([2, 5, 7]))
+    assert sorted(srs) == [2, 5, 7]
+    paddle.seed(0)
+    ws = list(io.WeightedRandomSampler([0.1, 0.0, 0.9], 50,
+                                       replacement=True))
+    assert 1 not in ws
+    bs = list(io.BatchSampler(sampler=io.SequenceSampler(ds),
+                              batch_size=4, drop_last=False))
+    assert bs[0] == [0, 1, 2, 3] and len(bs) == 3
+    dbs = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2,
+                                     rank=0)
+    batches = list(dbs)
+    assert sum(len(b) for b in batches) == 5  # rank 0's half of 10
+    assert all(len(b) <= 2 for b in batches)
+
+    dl = io.DataLoader(td, batch_size=3, shuffle=False)
+    out = list(dl)
+    assert len(out) == 2
+    assert io.get_worker_info() is None
+
+
+class _IterDs(io.IterableDataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        return iter(range(self.n))
+
+
+# ---------------------------------------------------------------------------
+# optimizers: all must minimize a quadratic
+# ---------------------------------------------------------------------------
+
+OPTS = [
+    ("ASGD", lambda p: optimizer.ASGD(learning_rate=0.1, parameters=p)),
+    ("Adadelta", lambda p: optimizer.Adadelta(learning_rate=30.0,
+                                              parameters=p)),
+    ("Adagrad", lambda p: optimizer.Adagrad(learning_rate=0.5,
+                                            parameters=p)),
+    ("Adamax", lambda p: optimizer.Adamax(learning_rate=0.2,
+                                          parameters=p)),
+    ("Lamb", lambda p: optimizer.Lamb(learning_rate=0.1, parameters=p)),
+    ("Momentum", lambda p: optimizer.Momentum(learning_rate=0.05,
+                                              parameters=p)),
+    ("NAdam", lambda p: optimizer.NAdam(learning_rate=0.2,
+                                        parameters=p)),
+    ("RAdam", lambda p: optimizer.RAdam(learning_rate=0.2,
+                                        parameters=p)),
+    ("RMSProp", lambda p: optimizer.RMSProp(learning_rate=0.05,
+                                            parameters=p)),
+    ("Rprop", lambda p: optimizer.Rprop(learning_rate=0.05,
+                                        parameters=p)),
+]
+
+
+@pytest.mark.parametrize("name,make", OPTS, ids=[o[0] for o in OPTS])
+def test_optimizer_minimizes_quadratic(name, make):
+    paddle.seed(0)
+    w = paddle.create_parameter([4], "float32")
+    w._rebind(np.array([2.0, -1.5, 1.0, 3.0], "float32"))
+    opt = make([w])
+    first = None
+    for _ in range(60):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+    assert float((w * w).sum().numpy()) < first * 0.25, name
+    assert isinstance(opt, optimizer.Optimizer)
+
+
+# ---------------------------------------------------------------------------
+# LR schedulers: closed-form schedule values
+# ---------------------------------------------------------------------------
+
+def test_lr_schedules_closed_form():
+    lr = optimizer.lr.ExponentialDecay(0.1, gamma=0.5)
+    vals = []
+    for _ in range(3):
+        vals.append(lr())
+        lr.step()
+    np.testing.assert_allclose(vals, [0.1, 0.05, 0.025], rtol=1e-6)
+
+    lr = optimizer.lr.NaturalExpDecay(0.1, gamma=0.5)
+    lr.step()
+    np.testing.assert_allclose(lr(), 0.1 * np.exp(-0.5), rtol=1e-6)
+
+    lr = optimizer.lr.InverseTimeDecay(0.1, gamma=1.0)
+    lr.step()
+    np.testing.assert_allclose(lr(), 0.05, rtol=1e-6)
+
+    lr = optimizer.lr.PolynomialDecay(0.1, decay_steps=10, end_lr=0.0,
+                                      power=1.0)
+    lr.step()
+    np.testing.assert_allclose(lr(), 0.09, rtol=1e-5)
+
+    lr = optimizer.lr.PiecewiseDecay([2, 4], [0.1, 0.01, 0.001])
+    seq = []
+    for _ in range(5):
+        seq.append(round(float(lr()), 6))
+        lr.step()
+    assert seq == [0.1, 0.1, 0.01, 0.01, 0.001]
+
+    lr = optimizer.lr.MultiStepDecay(0.1, milestones=[2, 4], gamma=0.1)
+    seq = []
+    for _ in range(5):
+        seq.append(round(float(lr()), 6))
+        lr.step()
+    assert seq == [0.1, 0.1, 0.01, 0.01, 0.001]
+
+    lr = optimizer.lr.LambdaDecay(0.1, lr_lambda=lambda e: 1.0 / (e + 1))
+    lr.step()
+    np.testing.assert_allclose(lr(), 0.05, rtol=1e-6)
+
+    lr = optimizer.lr.MultiplicativeDecay(0.1,
+                                          lr_lambda=lambda e: 0.5)
+    lr.step()
+    np.testing.assert_allclose(lr(), 0.05, rtol=1e-6)
+
+    lr = optimizer.lr.NoamDecay(d_model=64, warmup_steps=100,
+                                learning_rate=1.0)
+    v1 = lr(); lr.step(); v2 = lr()
+    assert v2 > v1  # warming up
+
+    lr = optimizer.lr.CosineAnnealingWarmRestarts(0.1, T_0=4)
+    first = lr()
+    for _ in range(4):
+        lr.step()
+    np.testing.assert_allclose(lr(), first, rtol=1e-5)  # restart
+
+    lr = optimizer.lr.CyclicLR(base_learning_rate=0.01,
+                               max_learning_rate=0.1,
+                               step_size_up=4)
+    v0 = lr(); lr.step(); lr.step(); lr.step(); lr.step()
+    peak = lr()
+    np.testing.assert_allclose(v0, 0.01, rtol=1e-5)
+    np.testing.assert_allclose(peak, 0.1, rtol=1e-4)
+
+    lr = optimizer.lr.OneCycleLR(max_learning_rate=0.1, total_steps=10)
+    start = lr()
+    for _ in range(3):
+        lr.step()
+    assert lr() > start  # ramps up first
+
+    lr = optimizer.lr.ReduceOnPlateau(0.1, factor=0.5, patience=1)
+    lr.step(metrics=1.0)
+    lr.step(metrics=1.0)
+    lr.step(metrics=1.0)
+    assert lr() <= 0.05 + 1e-9  # plateaued -> halved
+    assert isinstance(lr, optimizer.lr.LRScheduler)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def test_metrics_against_manual():
+    acc = metric.Accuracy()
+    pred = T(np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], "float32"))
+    lbl = T(np.array([[0], [1], [1]], "int64"))
+    acc.update(acc.compute(pred, lbl))
+    np.testing.assert_allclose(float(np.asarray(acc.accumulate())),
+                               2 / 3, rtol=1e-6)
+    assert isinstance(acc, metric.Metric)
+    np.testing.assert_allclose(
+        float(np.asarray(metric.accuracy(pred, lbl).numpy())), 2 / 3,
+        rtol=1e-6)
+
+    pr = metric.Precision()
+    pr.update(np.array([0.9, 0.4, 0.8, 0.2], "float32"),
+              np.array([1, 0, 0, 0], "int64"))
+    np.testing.assert_allclose(pr.accumulate(), 0.5, rtol=1e-6)
+
+    rc = metric.Recall()
+    rc.update(np.array([0.9, 0.4, 0.8, 0.2], "float32"),
+              np.array([1, 0, 1, 1], "int64"))
+    np.testing.assert_allclose(rc.accumulate(), 2 / 3, rtol=1e-6)
+
+    auc = metric.Auc()
+    preds = np.array([[0.2, 0.8], [0.7, 0.3], [0.4, 0.6], [0.9, 0.1]],
+                     "float32")
+    labels = np.array([[1], [0], [1], [0]], "int64")
+    auc.update(preds, labels)
+    np.testing.assert_allclose(auc.accumulate(), 1.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# initializers
+# ---------------------------------------------------------------------------
+
+def test_initializers_statistics_and_values():
+    init = nn.initializer
+    w = paddle.create_parameter(
+        [200, 200], "float32",
+        default_initializer=init.Constant(0.5))
+    assert np.allclose(np.asarray(w.numpy()), 0.5)
+    w = paddle.create_parameter(
+        [200, 200], "float32", default_initializer=init.Uniform(-2, 2))
+    v = np.asarray(w.numpy())
+    assert v.min() >= -2 and v.max() <= 2 and abs(v.mean()) < 0.05
+    w = paddle.create_parameter(
+        [200, 200], "float32",
+        default_initializer=init.TruncatedNormal(0.0, 1.0))
+    v = np.asarray(w.numpy())
+    assert np.abs(v).max() <= 2.0 + 1e-5  # truncated at 2 std
+    w = paddle.create_parameter(
+        [100, 100], "float32",
+        default_initializer=init.XavierUniform())
+    bound = np.sqrt(6 / 200)
+    v = np.asarray(w.numpy())
+    assert v.min() >= -bound - 1e-6 and v.max() <= bound + 1e-6
+    w = paddle.create_parameter(
+        [100, 100], "float32",
+        default_initializer=init.XavierNormal())
+    np.testing.assert_allclose(np.asarray(w.numpy()).std(),
+                               np.sqrt(2 / 200), rtol=0.1)
+    w = paddle.create_parameter(
+        [100, 100], "float32",
+        default_initializer=init.KaimingNormal())
+    np.testing.assert_allclose(np.asarray(w.numpy()).std(),
+                               np.sqrt(2 / 100), rtol=0.1)
+    w = paddle.create_parameter(
+        [100, 100], "float32",
+        default_initializer=init.KaimingUniform())
+    bound = np.sqrt(6 / 100)
+    v = np.asarray(w.numpy())
+    assert v.min() >= -bound - 1e-6 and v.max() <= bound + 1e-6
+    w = paddle.create_parameter(
+        [3], "float32",
+        default_initializer=init.Assign(np.array([1., 2., 3.],
+                                                 "float32")))
+    np.testing.assert_allclose(np.asarray(w.numpy()), [1., 2., 3.])
+    w = paddle.create_parameter(
+        [50, 50], "float32", default_initializer=init.Orthogonal())
+    v = np.asarray(w.numpy())
+    np.testing.assert_allclose(v @ v.T, np.eye(50), atol=1e-4)
+    # Dirac: conv identity kernel
+    w = paddle.create_parameter(
+        [4, 4, 3], "float32", default_initializer=init.Dirac())
+    v = np.asarray(w.numpy())
+    assert np.allclose(v[:, :, 1], np.eye(4))
+    # Bilinear: upsampling kernel, rows sum to 1 over spatial dims
+    w = paddle.create_parameter(
+        [2, 2, 4, 4], "float32", default_initializer=init.Bilinear())
+    assert np.isfinite(np.asarray(w.numpy())).all()
+    assert init.calculate_gain("relu") == pytest.approx(np.sqrt(2))
+    assert init.calculate_gain("tanh") == pytest.approx(5.0 / 3)

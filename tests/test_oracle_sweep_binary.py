@@ -1,0 +1,258 @@
+"""NumPy-oracle sweep: binary elementwise, comparison, logical, bitwise
+ops + in-place variants (reference op_test.py discipline)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from tests.op_test import check_grad
+
+R = np.random.default_rng(11)
+
+
+def _any(*s):
+    return R.standard_normal(s).astype("float32")
+
+
+def _pos(*s):
+    return R.uniform(0.5, 2.0, s).astype("float32")
+
+
+def _ints(*s):
+    return R.integers(1, 16, s).astype("int32")
+
+
+T = paddle.to_tensor
+
+# (paddle fn, gen_a, gen_b, numpy oracle, grad?)
+BINARY = [
+    (paddle.add, _any, _any, np.add, True),
+    (paddle.subtract, _any, _any, np.subtract, True),
+    (paddle.multiply, _any, _any, np.multiply, True),
+    (paddle.divide, _any, _pos, np.divide, True),
+    (paddle.floor_divide, _pos, _pos, np.floor_divide, False),
+    (paddle.mod, _pos, _pos, np.mod, False),
+    (paddle.floor_mod, _pos, _pos, np.mod, False),
+    (paddle.remainder, _pos, _pos, np.remainder, False),
+    (paddle.pow, _pos, _pos, np.power, True),
+    (paddle.maximum, _any, _any, np.maximum, True),
+    (paddle.minimum, _any, _any, np.minimum, True),
+    (paddle.fmax, _any, _any, np.fmax, True),
+    (paddle.fmin, _any, _any, np.fmin, True),
+    (paddle.copysign, _any, _any, np.copysign, False),
+    (paddle.nextafter, _any, _any, np.nextafter, False),
+    (paddle.hypot, _pos, _pos, np.hypot, True),
+    (paddle.atan2, _pos, _pos, np.arctan2, True),
+    (paddle.logaddexp, _any, _any, np.logaddexp, True),
+    (paddle.heaviside, _any, _pos, np.heaviside, False),
+    (paddle.ldexp, _any, lambda *s: _ints(*s).astype("int32"),
+     lambda a, b: np.ldexp(a, b).astype("float32"), False),
+]
+
+
+@pytest.mark.parametrize("fn,ga,gb,oracle,grad", BINARY,
+                         ids=[f[0].__name__ for f in BINARY])
+def test_binary_forward_oracle(fn, ga, gb, oracle, grad):
+    a, b = ga(3, 5), gb(3, 5)
+    got = np.asarray(fn(T(a), T(b)).numpy())
+    np.testing.assert_allclose(got, oracle(a, b).astype(got.dtype),
+                               rtol=3e-5, atol=3e-5)
+    if grad:
+        check_grad(fn, [ga(3, 4), gb(3, 4)], atol=3e-2, rtol=3e-2)
+
+
+BINARY_INPLACE = [
+    (paddle.add_, _any, np.add),
+    (paddle.subtract_, _any, np.subtract),
+    (paddle.multiply_, _any, np.multiply),
+    (paddle.divide_, _pos, np.divide),
+    (paddle.floor_divide_, _pos, np.floor_divide),
+    (paddle.mod_, _pos, np.mod),
+    (paddle.floor_mod_, _pos, np.mod),
+    (paddle.remainder_, _pos, np.remainder),
+    (paddle.pow_, _pos, np.power),
+    (paddle.copysign_, _any, np.copysign),
+    (paddle.hypot_, _pos, np.hypot),
+    (paddle.ldexp_, _pos, None),  # special-cased below
+]
+
+
+@pytest.mark.parametrize("fn,gen,oracle", BINARY_INPLACE,
+                         ids=[f[0].__name__ for f in BINARY_INPLACE])
+def test_binary_inplace(fn, gen, oracle):
+    a, b = gen(2, 4), gen(2, 4)
+    t = T(a.copy())
+    if oracle is None:  # ldexp_: int exponent
+        e = np.array([[1, 2, 0, 1]] * 2, "int32")
+        out = fn(t, T(e))
+        ref = np.ldexp(a, e).astype("float32")
+    else:
+        out = fn(t, T(b))
+        ref = oracle(a, b).astype("float32")
+    assert out is t, f"{fn.__name__} must return its receiver"
+    np.testing.assert_allclose(np.asarray(t.numpy()), ref, rtol=3e-5,
+                               atol=3e-5)
+
+
+CMP = [
+    (paddle.equal, np.equal),
+    (paddle.not_equal, np.not_equal),
+    (paddle.greater_equal, np.greater_equal),
+    (paddle.greater_than, np.greater),
+    (paddle.less_equal, np.less_equal),
+    (paddle.less_than, np.less),
+]
+
+
+@pytest.mark.parametrize("fn,oracle", CMP,
+                         ids=[f[0].__name__ for f in CMP])
+def test_comparisons(fn, oracle):
+    a = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], "float32")
+    b = np.array([[1.0, 3.0, 2.0], [4.0, 4.0, 7.0]], "float32")
+    np.testing.assert_array_equal(np.asarray(fn(T(a), T(b)).numpy()),
+                                  oracle(a, b))
+    # in-place variant writes the bool result back into the receiver
+    infn = getattr(paddle, fn.__name__ + "_")
+    t = T(a.copy())
+    out = infn(t, T(b))
+    assert out is t
+    np.testing.assert_array_equal(
+        np.asarray(t.numpy()).astype(bool), oracle(a, b))
+
+
+def test_isclose():
+    a = np.array([1.0, 2.0, np.nan], "float32")
+    b = np.array([1.0 + 1e-9, 2.1, np.nan], "float32")
+    np.testing.assert_array_equal(
+        np.asarray(paddle.isclose(T(a), T(b)).numpy()),
+        np.isclose(a, b))
+    np.testing.assert_array_equal(
+        np.asarray(paddle.isclose(T(a), T(b), equal_nan=True).numpy()),
+        np.isclose(a, b, equal_nan=True))
+
+
+LOGICAL = [
+    (paddle.logical_and, np.logical_and),
+    (paddle.logical_or, np.logical_or),
+    (paddle.logical_xor, np.logical_xor),
+]
+
+
+@pytest.mark.parametrize("fn,oracle", LOGICAL,
+                         ids=[f[0].__name__ for f in LOGICAL])
+def test_logical_binary(fn, oracle):
+    a = np.array([True, True, False, False])
+    b = np.array([True, False, True, False])
+    np.testing.assert_array_equal(np.asarray(fn(T(a), T(b)).numpy()),
+                                  oracle(a, b))
+    infn = getattr(paddle, fn.__name__ + "_")
+    t = T(a.copy())
+    assert infn(t, T(b)) is t
+    np.testing.assert_array_equal(np.asarray(t.numpy()), oracle(a, b))
+
+
+def test_logical_not():
+    a = np.array([True, False])
+    np.testing.assert_array_equal(
+        np.asarray(paddle.logical_not(T(a)).numpy()), ~a)
+    t = T(a.copy())
+    assert paddle.logical_not_(t) is t
+    np.testing.assert_array_equal(np.asarray(t.numpy()), ~a)
+
+
+BITWISE = [
+    (paddle.bitwise_and, np.bitwise_and),
+    (paddle.bitwise_or, np.bitwise_or),
+    (paddle.bitwise_xor, np.bitwise_xor),
+]
+
+
+@pytest.mark.parametrize("fn,oracle", BITWISE,
+                         ids=[f[0].__name__ for f in BITWISE])
+def test_bitwise_binary(fn, oracle):
+    a = np.array([0b1100, 0b1010, 7], "int32")
+    b = np.array([0b1010, 0b0110, 12], "int32")
+    np.testing.assert_array_equal(np.asarray(fn(T(a), T(b)).numpy()),
+                                  oracle(a, b))
+    infn = getattr(paddle, fn.__name__ + "_")
+    t = T(a.copy())
+    assert infn(t, T(b)) is t
+    np.testing.assert_array_equal(np.asarray(t.numpy()), oracle(a, b))
+
+
+def test_bitwise_not_and_shifts():
+    a = np.array([0, 1, 12, -3], "int32")
+    np.testing.assert_array_equal(
+        np.asarray(paddle.bitwise_not(T(a)).numpy()), np.bitwise_not(a))
+    t = T(a.copy())
+    assert paddle.bitwise_not_(t) is t
+    np.testing.assert_array_equal(np.asarray(t.numpy()),
+                                  np.bitwise_not(a))
+    x = np.array([1, 2, 8, 16], "int32")
+    s = np.array([1, 2, 1, 3], "int32")
+    np.testing.assert_array_equal(
+        np.asarray(paddle.bitwise_left_shift(T(x), T(s)).numpy()),
+        np.left_shift(x, s))
+    np.testing.assert_array_equal(
+        np.asarray(paddle.bitwise_right_shift(T(x), T(s)).numpy()),
+        np.right_shift(x, s))
+    t = T(x.copy())
+    assert paddle.bitwise_right_shift_(t, T(s)) is t
+    np.testing.assert_array_equal(np.asarray(t.numpy()),
+                                  np.right_shift(x, s))
+
+
+def test_gcd_lcm():
+    a = np.array([12, 18, 0, 7], "int32")
+    b = np.array([18, 24, 5, 0], "int32")
+    np.testing.assert_array_equal(np.asarray(paddle.gcd(T(a),
+                                                        T(b)).numpy()),
+                                  np.gcd(a, b))
+    np.testing.assert_array_equal(np.asarray(paddle.lcm(T(a),
+                                                        T(b)).numpy()),
+                                  np.lcm(a, b))
+    t = T(a.copy())
+    assert paddle.gcd_(t, T(b)) is t
+    np.testing.assert_array_equal(np.asarray(t.numpy()), np.gcd(a, b))
+    t = T(a.copy())
+    assert paddle.lcm_(t, T(b)) is t
+    np.testing.assert_array_equal(np.asarray(t.numpy()), np.lcm(a, b))
+
+
+def test_matmul_like_products():
+    a, b = _any(3, 4), _any(4, 5)
+    np.testing.assert_allclose(np.asarray(paddle.mm(T(a), T(b)).numpy()),
+                               a @ b, rtol=1e-5, atol=1e-5)
+    ba, bb = _any(2, 3, 4), _any(2, 4, 5)
+    np.testing.assert_allclose(np.asarray(paddle.bmm(T(ba),
+                                                     T(bb)).numpy()),
+                               ba @ bb, rtol=1e-5, atol=1e-5)
+    m, v = _any(3, 4), _any(4)
+    np.testing.assert_allclose(np.asarray(paddle.mv(T(m), T(v)).numpy()),
+                               m @ v, rtol=1e-5, atol=1e-5)
+    x, y = _any(4), _any(5)
+    np.testing.assert_allclose(np.asarray(paddle.outer(T(x),
+                                                       T(y)).numpy()),
+                               np.outer(x, y), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(paddle.inner(T(_any(3, 4)), T(_any(5, 4))).numpy())
+        .shape, (3, 5))
+    k1, k2 = _any(2, 3), _any(3, 2)
+    np.testing.assert_allclose(np.asarray(paddle.kron(T(k1),
+                                                      T(k2)).numpy()),
+                               np.kron(k1, k2), rtol=1e-5, atol=1e-5)
+    check_grad(paddle.mm, [_any(3, 4), _any(4, 2)], atol=2e-2, rtol=2e-2)
+    check_grad(paddle.kron, [_any(2, 2), _any(2, 3)], atol=2e-2,
+               rtol=2e-2)
+
+
+def test_cross_and_dist():
+    a, b = _any(4, 3), _any(4, 3)
+    np.testing.assert_allclose(np.asarray(paddle.cross(T(a),
+                                                       T(b)).numpy()),
+                               np.cross(a, b), rtol=1e-5, atol=1e-5)
+    x, y = _any(3, 4), _any(3, 4)
+    for p in (1.0, 2.0, np.inf):
+        np.testing.assert_allclose(
+            float(paddle.dist(T(x), T(y), p=p)),
+            np.linalg.norm((x - y).ravel(), ord=p), rtol=1e-5)

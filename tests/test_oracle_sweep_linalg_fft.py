@@ -1,0 +1,216 @@
+"""NumPy/SciPy-oracle sweep: paddle.linalg, paddle.fft, paddle.signal
+(reference test/legacy_test op_test discipline)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+R = np.random.default_rng(17)
+T = paddle.to_tensor
+
+
+def _any(*s):
+    return R.standard_normal(s).astype("float32")
+
+
+def _spd(n):
+    a = R.standard_normal((n, n)).astype("float32")
+    return a @ a.T + n * np.eye(n, dtype="float32")
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+
+def test_det_and_inverse():
+    a = _spd(4)
+    np.testing.assert_allclose(float(paddle.linalg.det(T(a))),
+                               np.linalg.det(a), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(paddle.inverse(T(a)).numpy()), np.linalg.inv(a),
+        rtol=1e-3, atol=1e-4)
+
+
+def test_cholesky_solve():
+    a = _spd(4)
+    ll = np.linalg.cholesky(a)
+    b = _any(4, 2)
+    got = paddle.cholesky_solve(T(b), T(ll.astype("float32")), upper=False)
+    np.testing.assert_allclose(np.asarray(got.numpy()),
+                               np.linalg.solve(a, b), rtol=1e-3,
+                               atol=1e-4)
+    got2 = paddle.linalg.cholesky_solve(T(b), T(ll.astype("float32")))
+    np.testing.assert_allclose(np.asarray(got2.numpy()),
+                               np.linalg.solve(a, b), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_cond_and_norms():
+    a = _spd(4)
+    np.testing.assert_allclose(float(paddle.linalg.cond(T(a))),
+                               np.linalg.cond(a), rtol=1e-3)
+    np.testing.assert_allclose(float(paddle.linalg.cond(T(a), p=1)),
+                               np.linalg.cond(a, p=1), rtol=1e-3)
+    x = _any(3, 4)
+    np.testing.assert_allclose(
+        float(paddle.linalg.matrix_norm(T(x), p="fro")),
+        np.linalg.norm(x, "fro"), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(paddle.linalg.matrix_norm(T(x), p=2)),
+        np.linalg.norm(x, 2), rtol=1e-4)
+    v = _any(6)
+    np.testing.assert_allclose(
+        float(paddle.linalg.vector_norm(T(v), p=3)),
+        np.linalg.norm(v, 3), rtol=1e-5)
+
+
+def test_corrcoef_cov():
+    x = _any(3, 50)
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.corrcoef(T(x)).numpy()),
+        np.corrcoef(x), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.cov(T(x)).numpy()), np.cov(x),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(paddle.corrcoef(T(x)).numpy()), np.corrcoef(x),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(paddle.cov(T(x)).numpy()),
+                               np.cov(x), rtol=1e-4, atol=1e-5)
+
+
+def test_eig_eigvals():
+    a = _spd(4)  # symmetric: real spectrum, stable comparison
+    w = np.asarray(paddle.linalg.eigvals(T(a)).numpy())
+    np.testing.assert_allclose(np.sort(w.real),
+                               np.sort(np.linalg.eigvals(a).real),
+                               rtol=1e-3, atol=1e-3)
+    w2, v2 = paddle.linalg.eig(T(a))
+    wv = np.asarray(w2.numpy())
+    np.testing.assert_allclose(np.sort(wv.real),
+                               np.sort(np.linalg.eigvals(a).real),
+                               rtol=1e-3, atol=1e-3)
+    # eigvectors: A v = w v
+    vv = np.asarray(v2.numpy())
+    np.testing.assert_allclose(a.astype(vv.dtype) @ vv, vv * wv,
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_lstsq_pinv_matrix_rank():
+    a, b = _any(6, 3), _any(6, 2)
+    sol = paddle.linalg.lstsq(T(a), T(b))[0]
+    ref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(sol.numpy()), ref, rtol=1e-3,
+                               atol=1e-3)
+    p = paddle.linalg.pinv(T(a))
+    np.testing.assert_allclose(np.asarray(p.numpy()), np.linalg.pinv(a),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(paddle.pinv(T(a)).numpy()),
+                               np.linalg.pinv(a), rtol=1e-3, atol=1e-3)
+    r = np.asarray(_any(5, 3))
+    low = r @ np.array([[1., 0., 0.], [0., 1., 0.], [1., 1., 0.]],
+                       "float32")
+    assert int(paddle.linalg.matrix_rank(T(low))) == 2
+
+
+def test_lu_and_unpack():
+    a = _spd(4)
+    lu, piv = paddle.linalg.lu(T(a))
+    import scipy.linalg as sla
+    p_ref, l_ref, u_ref = sla.lu(a)
+    pt, lt, ut = paddle.linalg.lu_unpack(lu, piv)
+    rec = (np.asarray(pt.numpy()) @ np.asarray(lt.numpy())
+           @ np.asarray(ut.numpy()))
+    np.testing.assert_allclose(rec, a, rtol=1e-3, atol=1e-3)
+    lu2, piv2 = paddle.lu(T(a))
+    pt2, lt2, ut2 = paddle.lu_unpack(lu2, piv2)
+    rec2 = (np.asarray(pt2.numpy()) @ np.asarray(lt2.numpy())
+            @ np.asarray(ut2.numpy()))
+    np.testing.assert_allclose(rec2, a, rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# fft
+# ---------------------------------------------------------------------------
+
+def test_fft_2d_nd():
+    x = _any(4, 8)
+    np.testing.assert_allclose(np.asarray(paddle.fft.fft2(T(x)).numpy()),
+                               np.fft.fft2(x).astype("complex64"),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(paddle.fft.fftn(T(x)).numpy()),
+                               np.fft.fftn(x).astype("complex64"),
+                               rtol=1e-4, atol=1e-4)
+    c = (x + 1j * _any(4, 8)).astype("complex64")
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.ifft2(T(c)).numpy()),
+        np.fft.ifft2(c).astype("complex64"), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.ifftn(T(c)).numpy()),
+        np.fft.ifftn(c).astype("complex64"), rtol=1e-4, atol=1e-4)
+
+
+def test_rfft_family():
+    x = _any(16)
+    np.testing.assert_allclose(np.asarray(paddle.fft.rfft(T(x)).numpy()),
+                               np.fft.rfft(x).astype("complex64"),
+                               rtol=1e-4, atol=1e-4)
+    x2 = _any(4, 16)
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.rfft2(T(x2)).numpy()),
+        np.fft.rfft2(x2).astype("complex64"), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.rfftn(T(x2)).numpy()),
+        np.fft.rfftn(x2).astype("complex64"), rtol=1e-4, atol=1e-4)
+    c = np.fft.rfft(x).astype("complex64")
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.irfft(T(c), n=16).numpy()),
+        np.fft.irfft(c, n=16).astype("float32"), rtol=1e-4, atol=1e-4)
+    c2 = np.fft.rfft2(x2).astype("complex64")
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.irfft2(T(c2), s=(4, 16)).numpy()),
+        np.fft.irfft2(c2, s=(4, 16)).astype("float32"), rtol=1e-4,
+        atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.irfftn(T(c2), s=(4, 16)).numpy()),
+        np.fft.irfftn(c2, s=(4, 16)).astype("float32"), rtol=1e-4,
+        atol=1e-4)
+
+
+def test_fft_helpers():
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.fftfreq(8, 0.5).numpy()),
+        np.fft.fftfreq(8, 0.5).astype("float32"), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.rfftfreq(8, 0.5).numpy()),
+        np.fft.rfftfreq(8, 0.5).astype("float32"), rtol=1e-6)
+    x = _any(8)
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.fftshift(T(x)).numpy()), np.fft.fftshift(x))
+    np.testing.assert_allclose(
+        np.asarray(paddle.fft.ifftshift(T(x)).numpy()),
+        np.fft.ifftshift(x))
+
+
+# ---------------------------------------------------------------------------
+# signal
+# ---------------------------------------------------------------------------
+
+def test_stft_istft_roundtrip():
+    paddle.seed(3)
+    x = _any(2, 512)
+    n_fft = 64
+    spec = paddle.signal.stft(T(x), n_fft=n_fft, hop_length=16)
+    assert spec.shape[0] == 2 and spec.shape[1] == n_fft // 2 + 1
+    back = paddle.signal.istft(spec, n_fft=n_fft, hop_length=16)
+    b = np.asarray(back.numpy())
+    n = min(b.shape[-1], 512)
+    # interior reconstruction (edges lose window overlap)
+    np.testing.assert_allclose(b[:, 64:n - 64], x[:, 64:n - 64],
+                               rtol=1e-3, atol=1e-3)
+    # top-level aliases
+    spec2 = paddle.stft(T(x), n_fft=n_fft, hop_length=16)
+    back2 = paddle.istft(spec2, n_fft=n_fft, hop_length=16)
+    np.testing.assert_allclose(np.asarray(back2.numpy()), b, rtol=1e-5,
+                               atol=1e-5)

@@ -1,0 +1,388 @@
+"""NumPy-oracle sweep: reductions, manipulation, indexing, creation and
+random fills (reference op_test.py discipline)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+R = np.random.default_rng(13)
+T = paddle.to_tensor
+
+
+def _any(*s):
+    return R.standard_normal(s).astype("float32")
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def test_amax_amin_mode():
+    x = _any(3, 5)
+    np.testing.assert_allclose(np.asarray(paddle.amax(T(x),
+                                                      axis=1).numpy()),
+                               x.max(1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(paddle.amin(T(x),
+                                                      axis=0).numpy()),
+                               x.min(0), rtol=1e-6)
+    vals, idx = paddle.mode(T(np.array([[1., 1., 3.], [2., 2., 2.]],
+                                       "float32")))
+    np.testing.assert_allclose(np.asarray(vals.numpy()), [1., 2.])
+    np.testing.assert_allclose(np.asarray(paddle.min(T(x))),
+                               x.min(), rtol=1e-6)
+
+
+def test_count_nonzero_and_nan_reductions():
+    x = np.array([[0., 1., np.nan], [2., 0., 3.]], "float32")
+    assert int(paddle.count_nonzero(T(np.nan_to_num(x)))) == 3
+    np.testing.assert_allclose(float(paddle.nansum(T(x))), 6.0)
+    np.testing.assert_allclose(float(paddle.nanmean(T(x))),
+                               np.nanmean(x), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(paddle.nanmedian(T(x), axis=1).numpy()),
+        np.nanmedian(x, axis=1), rtol=1e-6)
+    y = np.array([1., 2., 3., 4., np.nan], "float32")
+    np.testing.assert_allclose(float(paddle.nanquantile(T(y), 0.5)),
+                               np.nanquantile(y, 0.5), rtol=1e-6)
+    np.testing.assert_allclose(float(paddle.quantile(T(y[:4]), 0.25)),
+                               np.quantile(y[:4], 0.25), rtol=1e-6)
+
+
+def test_cumulative_family():
+    x = _any(3, 4)
+    v, i = paddle.cummax(T(x), axis=1)
+    np.testing.assert_allclose(np.asarray(v.numpy()),
+                               np.maximum.accumulate(x, 1), rtol=1e-6)
+    v, i = paddle.cummin(T(x), axis=0)
+    np.testing.assert_allclose(np.asarray(v.numpy()),
+                               np.minimum.accumulate(x, 0), rtol=1e-6)
+    t = T(x.copy())
+    assert paddle.cumsum_(t, axis=1) is t
+    np.testing.assert_allclose(np.asarray(t.numpy()), np.cumsum(x, 1),
+                               rtol=1e-5)
+    t = T(np.abs(x) + 0.5)
+    base = np.asarray(t.numpy()).copy()
+    assert paddle.cumprod_(t, dim=1) is t
+    np.testing.assert_allclose(np.asarray(t.numpy()),
+                               np.cumprod(base, 1), rtol=1e-5)
+    y = np.array([1., 2., 3., 4.], "float32")
+    np.testing.assert_allclose(
+        np.asarray(paddle.cumulative_trapezoid(T(y)).numpy()),
+        [1.5, 4.0, 7.5], rtol=1e-6)
+    np.testing.assert_allclose(float(paddle.trapezoid(T(y))),
+                               np.trapezoid(y), rtol=1e-6)
+
+
+def test_histogram_family():
+    x = np.arange(10, dtype="float32")
+    np.testing.assert_array_equal(
+        np.asarray(paddle.histogram(T(x), bins=5, min=0,
+                                    max=10).numpy()),
+        np.histogram(x, bins=5, range=(0, 10))[0])
+    np.testing.assert_array_equal(
+        np.asarray(paddle.bincount(T(np.array([0, 1, 1, 3],
+                                              "int64"))).numpy()),
+        np.bincount([0, 1, 1, 3]))
+    h, edges = paddle.histogramdd(T(_any(20, 2)), bins=[3, 3])
+    assert int(np.asarray(h.numpy()).sum()) == 20
+    s = np.array([2., 6.], "float32")
+    np.testing.assert_array_equal(
+        np.asarray(paddle.bucketize(T(np.array([1., 5., 9.], "float32")),
+                                    T(s)).numpy()),
+        np.searchsorted(s, [1., 5., 9.]))
+
+
+# ---------------------------------------------------------------------------
+# manipulation
+# ---------------------------------------------------------------------------
+
+def test_atleast_and_stacks():
+    a = np.float32(3.0)
+    assert paddle.atleast_1d(T(a)).shape == [1]
+    assert paddle.atleast_2d(T(a)).shape == [1, 1]
+    assert paddle.atleast_3d(T(a)).shape == [1, 1, 1]
+    x, y = _any(3), _any(3)
+    np.testing.assert_allclose(
+        np.asarray(paddle.column_stack([T(x), T(y)]).numpy()),
+        np.column_stack([x, y]))
+    np.testing.assert_allclose(
+        np.asarray(paddle.row_stack([T(x), T(y)]).numpy()),
+        np.vstack([x, y]))
+    np.testing.assert_allclose(
+        np.asarray(paddle.hstack([T(x), T(y)]).numpy()),
+        np.hstack([x, y]))
+    np.testing.assert_allclose(
+        np.asarray(paddle.vstack([T(x), T(y)]).numpy()),
+        np.vstack([x, y]))
+    m = _any(2, 3)
+    np.testing.assert_allclose(
+        np.asarray(paddle.dstack([T(m), T(m)]).numpy()),
+        np.dstack([m, m]))
+
+
+def test_splits():
+    x = _any(4, 6, 2)
+    for got, want in zip(paddle.hsplit(T(x), 3), np.hsplit(x, 3)):
+        np.testing.assert_allclose(np.asarray(got.numpy()), want)
+    for got, want in zip(paddle.vsplit(T(x), 2), np.vsplit(x, 2)):
+        np.testing.assert_allclose(np.asarray(got.numpy()), want)
+    for got, want in zip(paddle.dsplit(T(x), 2), np.dsplit(x, 2)):
+        np.testing.assert_allclose(np.asarray(got.numpy()), want)
+    for got, want in zip(paddle.tensor_split(T(x), 3, axis=1),
+                         np.array_split(x, 3, axis=1)):
+        np.testing.assert_allclose(np.asarray(got.numpy()), want)
+    parts = paddle.unbind(T(x), axis=2)
+    assert len(parts) == 2 and parts[0].shape == [4, 6]
+
+
+def test_reshape_family_inplace_and_views():
+    x = _any(3, 4)
+    t = T(x.copy())
+    assert paddle.reshape_(t, [12]) is t and t.shape == [12]
+    t = T(x.copy())
+    assert paddle.transpose_(t, [1, 0]) is t and t.shape == [4, 3]
+    t = T(x[None].copy())
+    assert paddle.squeeze_(t, 0) is t and t.shape == [3, 4]
+    t = T(x.copy())
+    assert paddle.unsqueeze_(t, 0) is t and t.shape == [1, 3, 4]
+    t = T(x.copy())
+    assert paddle.flatten_(t) is t and t.shape == [12]
+    np.testing.assert_allclose(np.asarray(paddle.t(T(x)).numpy()), x.T)
+    v = paddle.view(T(x), [2, 6])
+    assert v.shape == [2, 6]
+    v2 = paddle.view_as(T(x), T(_any(12)))
+    assert v2.shape == [12]
+    np.testing.assert_allclose(
+        np.asarray(paddle.unflatten(T(_any(12)), 0, [3, 4]).numpy())
+        .shape, (3, 4))
+    e = paddle.expand_as(T(_any(1, 4)), T(_any(3, 4)))
+    assert e.shape == [3, 4]
+
+
+def test_tri_family_and_vander():
+    x = _any(4, 4)
+    t = T(x.copy())
+    assert paddle.tril_(t) is t
+    np.testing.assert_allclose(np.asarray(t.numpy()), np.tril(x))
+    t = T(x.copy())
+    assert paddle.triu_(t) is t
+    np.testing.assert_allclose(np.asarray(t.numpy()), np.triu(x))
+    r, c = paddle.tril_indices(3, 3, 0)
+    ref = np.tril_indices(3)
+    np.testing.assert_array_equal(np.asarray(r.numpy()), ref[0])
+    np.testing.assert_array_equal(np.asarray(c.numpy()), ref[1])
+    r, c = paddle.triu_indices(3, 3, 0)
+    ref = np.triu_indices(3)
+    np.testing.assert_array_equal(np.asarray(r.numpy()), ref[0])
+    v = np.array([1., 2., 3.], "float32")
+    np.testing.assert_allclose(
+        np.asarray(paddle.vander(T(v), 3).numpy()), np.vander(v, 3))
+    np.testing.assert_allclose(
+        np.asarray(paddle.vander(T(v), 3, increasing=True).numpy()),
+        np.vander(v, 3, increasing=True))
+
+
+def test_diag_embed_diagflat():
+    d = _any(2, 3)
+    e = np.asarray(paddle.diag_embed(T(d)).numpy())
+    assert e.shape == (2, 3, 3)
+    np.testing.assert_allclose(e[0], np.diag(d[0]))
+    f = np.asarray(paddle.diagflat(T(_any(2, 2))).numpy())
+    assert f.shape == (4, 4)
+
+
+def test_broadcast_helpers():
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+    a, b = paddle.broadcast_tensors([T(_any(1, 3)), T(_any(2, 1))])
+    assert a.shape == [2, 3] and b.shape == [2, 3]
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+def test_index_ops():
+    x = _any(4, 3)
+    idx = np.array([0, 2], "int64")
+    src = _any(2, 3)
+    t = T(x.copy())
+    assert paddle.index_add_(t, T(idx), 0, T(src)) is t
+    ref = x.copy()
+    ref[[0, 2]] += src
+    np.testing.assert_allclose(np.asarray(t.numpy()), ref, rtol=1e-6)
+
+    got = paddle.index_fill(T(x), T(idx), 0, -1.0)
+    ref = x.copy(); ref[[0, 2]] = -1.0
+    np.testing.assert_allclose(np.asarray(got.numpy()), ref)
+    t = T(x.copy())
+    assert paddle.index_fill_(t, T(idx), 0, -1.0) is t
+    np.testing.assert_allclose(np.asarray(t.numpy()), ref)
+
+    s = paddle.index_sample(T(x), T(np.array([[0, 1], [2, 0], [1, 1],
+                                              [0, 2]], "int64")))
+    ref = np.take_along_axis(x, np.array([[0, 1], [2, 0], [1, 1],
+                                          [0, 2]]), axis=1)
+    np.testing.assert_allclose(np.asarray(s.numpy()), ref)
+
+    got = paddle.index_put(T(x), (T(np.array([0, 1], "int64")),
+                                  T(np.array([1, 2], "int64"))),
+                           T(np.array([9.0, 8.0], "float32")))
+    ref = x.copy(); ref[0, 1] = 9.0; ref[1, 2] = 8.0
+    np.testing.assert_allclose(np.asarray(got.numpy()), ref)
+    t = T(x.copy())
+    assert paddle.index_put_(t, (T(np.array([0], "int64")),
+                                 T(np.array([0], "int64"))),
+                             T(np.array([5.0], "float32"))) is t
+    assert float(np.asarray(t.numpy())[0, 0]) == 5.0
+
+
+def test_masked_and_scatter_ops():
+    x = _any(3, 4)
+    mask = x > 0
+    got = paddle.masked_fill(T(x), T(mask), 0.5)
+    ref = np.where(mask, 0.5, x)
+    np.testing.assert_allclose(np.asarray(got.numpy()), ref)
+    t = T(x.copy())
+    assert paddle.masked_fill_(t, T(mask), 0.5) is t
+    np.testing.assert_allclose(np.asarray(t.numpy()), ref)
+
+    vals = np.arange(mask.sum(), dtype="float32")
+    t = T(x.copy())
+    assert paddle.masked_scatter_(t, T(mask), T(vals)) is t
+    ref = x.copy(); ref[mask] = vals
+    np.testing.assert_allclose(np.asarray(t.numpy()), ref)
+
+    t = T(x.copy())
+    upd = _any(2, 4)
+    assert paddle.scatter_(t, T(np.array([0, 2], "int64")), T(upd)) is t
+    ref = x.copy(); ref[[0, 2]] = upd
+    np.testing.assert_allclose(np.asarray(t.numpy()), ref, rtol=1e-6)
+
+    sn = paddle.scatter_nd(T(np.array([[1], [3]], "int64")),
+                           T(np.array([9.0, 7.0], "float32")), [5])
+    np.testing.assert_allclose(np.asarray(sn.numpy()),
+                               [0, 9.0, 0, 7.0, 0])
+    sna = paddle.scatter_nd_add(T(np.ones(5, "float32")),
+                                T(np.array([[1], [1]], "int64")),
+                                T(np.array([2.0, 3.0], "float32")))
+    np.testing.assert_allclose(np.asarray(sna.numpy()),
+                               [1, 6.0, 1, 1, 1])
+
+    t = T(x.copy())
+    idx = np.zeros((3, 4), "int64")
+    assert paddle.put_along_axis_(t, T(idx), 1.0, 0) is t
+    assert np.allclose(np.asarray(t.numpy())[0], 1.0)
+
+    tk = paddle.take(T(x), T(np.array([0, 5, -1], "int64")))
+    np.testing.assert_allclose(np.asarray(tk.numpy()),
+                               x.ravel()[[0, 5, -1]])
+
+
+def test_slice_misc():
+    x = _any(6, 8)
+    got = paddle.strided_slice(T(x), axes=[0, 1], starts=[1, 0],
+                               ends=[5, 8], strides=[2, 3])
+    np.testing.assert_allclose(np.asarray(got.numpy()), x[1:5:2, 0:8:3])
+    got = paddle.crop(T(x), shape=[2, 3], offsets=[1, 2])
+    np.testing.assert_allclose(np.asarray(got.numpy()), x[1:3, 2:5])
+    got = paddle.reverse(T(x), axis=[0])
+    np.testing.assert_allclose(np.asarray(got.numpy()), x[::-1])
+    t = T(np.array([1.0], "float32"))
+    paddle.increment(t, 2.0)
+    assert float(t.numpy()[0]) == 3.0
+    a, b = _any(3), _any(3)
+    t = T(a.copy())
+    assert paddle.lerp_(t, T(b), 0.25) is t
+    np.testing.assert_allclose(np.asarray(t.numpy()),
+                               a + 0.25 * (b - a), rtol=1e-6)
+    u = paddle.unique_consecutive(T(np.array([1, 1, 2, 2, 3, 1],
+                                             "int64")))
+    np.testing.assert_array_equal(np.asarray(u.numpy()), [1, 2, 3, 1])
+    s = paddle.shard_index(T(np.array([[1], [5], [9]], "int64")),
+                           index_num=12, nshards=3, shard_id=0)
+    assert s.shape == [3, 1]
+
+
+# ---------------------------------------------------------------------------
+# creation + random fills
+# ---------------------------------------------------------------------------
+
+def test_creation_like_family():
+    x = _any(2, 3)
+    assert paddle.empty([2, 3]).shape == [2, 3]
+    assert paddle.empty_like(T(x)).shape == [2, 3]
+    np.testing.assert_allclose(
+        np.asarray(paddle.full_like(T(x), 7.0).numpy()),
+        np.full((2, 3), 7.0))
+    np.testing.assert_allclose(
+        np.asarray(paddle.ones_like(T(x)).numpy()), np.ones((2, 3)))
+    np.testing.assert_allclose(
+        np.asarray(paddle.zeros_like(T(x)).numpy()), np.zeros((2, 3)))
+    r = paddle.randint_like(T(np.zeros((4, 4), "int64")), 0, 10)
+    assert ((np.asarray(r.numpy()) >= 0) &
+            (np.asarray(r.numpy()) < 10)).all()
+    assert paddle.rand([3, 2]).shape == [3, 2]
+    lg = paddle.logspace(0, 2, 3)
+    np.testing.assert_allclose(np.asarray(lg.numpy()), [1., 10., 100.],
+                               rtol=1e-5)
+
+
+def test_random_fills_statistics():
+    paddle.seed(42)
+    t = T(np.zeros((4000,), "float32"))
+    assert paddle.normal_(t, mean=1.0, std=2.0) is t
+    v = np.asarray(t.numpy())
+    assert abs(v.mean() - 1.0) < 0.15 and abs(v.std() - 2.0) < 0.15
+
+    n = paddle.normal(mean=0.0, std=1.0, shape=[4000])
+    assert abs(float(np.asarray(n.numpy()).mean())) < 0.1
+    sn = paddle.standard_normal([4000])
+    assert abs(float(np.asarray(sn.numpy()).std()) - 1.0) < 0.1
+
+    t = T(np.zeros((4000,), "float32"))
+    assert paddle.uniform_(t, min=-1.0, max=1.0) is t
+    v = np.asarray(t.numpy())
+    assert v.min() >= -1.0 and v.max() <= 1.0 and abs(v.mean()) < 0.1
+
+    t = T(np.zeros((4000,), "float32"))
+    assert paddle.exponential_(t, lam=2.0) is t
+    assert abs(np.asarray(t.numpy()).mean() - 0.5) < 0.1
+
+    t = T(np.zeros((4000,), "float32"))
+    assert paddle.bernoulli_(t, p=0.3) is t
+    assert abs(np.asarray(t.numpy()).mean() - 0.3) < 0.05
+
+    t = T(np.zeros((4000,), "float32"))
+    assert paddle.geometric_(t, probs=0.5) is t
+    assert np.asarray(t.numpy()).min() >= 0
+
+    t = T(np.zeros((4000,), "float32"))
+    assert paddle.cauchy_(t) is t
+    assert np.isfinite(np.asarray(t.numpy())).all()
+
+    t = T(np.zeros((4000,), "float32"))
+    assert paddle.log_normal_(t, mean=0.0, std=0.25) is t
+    assert abs(np.log(np.asarray(t.numpy())).mean()) < 0.1
+    ln = paddle.log_normal(mean=0.0, std=0.25, shape=[4000])
+    assert abs(np.log(np.asarray(ln.numpy())).mean()) < 0.1
+
+    p = paddle.poisson(T(np.full((4000,), 3.0, "float32")))
+    assert abs(np.asarray(p.numpy()).mean() - 3.0) < 0.2
+    b = paddle.binomial(T(np.full((4000,), 10.0, "float32")),
+                        T(np.full((4000,), 0.5, "float32")))
+    assert abs(np.asarray(b.numpy()).mean() - 5.0) < 0.3
+    g = paddle.standard_gamma(T(np.full((4000,), 2.0, "float32")))
+    assert abs(np.asarray(g.numpy()).mean() - 2.0) < 0.2
+
+
+def test_cast_and_dtype_utils():
+    x = _any(2, 3)
+    c = paddle.cast(T(x), "float64")
+    assert str(c.dtype).endswith("float64")
+    t = T(x.copy())
+    assert paddle.cast_(t, "float64") is t
+    fi = paddle.finfo(paddle.float32)
+    assert fi.max > 1e38
+    ii = paddle.iinfo(paddle.int32)
+    assert ii.max == 2**31 - 1

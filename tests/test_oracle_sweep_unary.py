@@ -1,0 +1,241 @@
+"""NumPy-oracle sweep: unary elementwise ops + their in-place variants.
+
+Reference discipline: every op checked against a NumPy forward oracle
+(`test/legacy_test/op_test.py:2905 check_output`) and, for the smooth
+ones, finite-difference gradients (`op_test.py:3109 check_grad`).
+"""
+
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as paddle
+from tests.op_test import check_grad
+
+R = np.random.default_rng(7)
+
+
+def _any(*s):
+    return R.standard_normal(s).astype("float32")
+
+
+def _pos(*s):
+    return R.uniform(0.5, 2.0, s).astype("float32")
+
+
+def _unit(*s):
+    return R.uniform(-0.9, 0.9, s).astype("float32")
+
+
+def _gt1(*s):
+    return R.uniform(1.1, 3.0, s).astype("float32")
+
+
+# (paddle fn, input gen, numpy oracle, grad?)
+UNARY = [
+    (paddle.abs, _any, np.abs, True),
+    (paddle.acos, _unit, np.arccos, True),
+    (paddle.acosh, _gt1, np.arccosh, True),
+    (paddle.asin, _unit, np.arcsin, True),
+    (paddle.asinh, _any, np.arcsinh, True),
+    (paddle.atan, _any, np.arctan, True),
+    (paddle.atanh, _unit, np.arctanh, True),
+    (paddle.ceil, _any, np.ceil, False),
+    (paddle.cos, _any, np.cos, True),
+    (paddle.cosh, _any, np.cosh, True),
+    (paddle.deg2rad, _any, np.deg2rad, True),
+    (paddle.digamma, _pos, sps.digamma, True),
+    (paddle.erf, _any, sps.erf, True),
+    (paddle.erfinv, _unit, sps.erfinv, True),
+    (paddle.exp, _any, np.exp, True),
+    (paddle.expm1, _any, np.expm1, True),
+    (paddle.floor, _any, np.floor, False),
+    (paddle.frac, _any, lambda x: x - np.trunc(x), True),
+    (paddle.gammaln, _pos, sps.gammaln, True),
+    (paddle.i0, _any, sps.i0, True),
+    (paddle.i0e, _any, sps.i0e, True),
+    (paddle.i1, _any, sps.i1, True),
+    (paddle.i1e, _any, sps.i1e, True),
+    (paddle.lgamma, _pos, sps.gammaln, True),
+    (paddle.log, _pos, np.log, True),
+    (paddle.log10, _pos, np.log10, True),
+    (paddle.log1p, _pos, np.log1p, True),
+    (paddle.log2, _pos, np.log2, True),
+    (paddle.logit, lambda *s: R.uniform(0.2, 0.8, s).astype("float32"),
+     sps.logit, True),
+    (paddle.neg, _any, np.negative, True),
+    (paddle.rad2deg, _any, np.rad2deg, True),
+    (paddle.reciprocal, _pos, np.reciprocal, True),
+    (paddle.round, _any, np.round, False),
+    (paddle.rsqrt, _pos, lambda x: 1.0 / np.sqrt(x), True),
+    (paddle.sgn, _any, np.sign, False),
+    (paddle.sigmoid, _any, sps.expit, True),
+    (paddle.sign, _any, np.sign, False),
+    (paddle.signbit, _any, np.signbit, False),
+    (paddle.sin, _any, np.sin, True),
+    (paddle.sinc, _pos, np.sinc, True),
+    (paddle.sinh, _any, np.sinh, True),
+    (paddle.square, _any, np.square, True),
+    (paddle.sqrt, _pos, np.sqrt, True),
+    (paddle.stanh, _any,
+     lambda x: 1.7159 * np.tanh(0.67 * x), True),
+    (paddle.tan, _unit, np.tan, True),
+    (paddle.tanh, _any, np.tanh, True),
+    (paddle.trunc, _any, np.trunc, False),
+    (paddle.nan_to_num, _any, np.nan_to_num, False),
+]
+
+
+@pytest.mark.parametrize("fn,gen,oracle,grad", UNARY,
+                         ids=[f[0].__name__ for f in UNARY])
+def test_unary_forward_oracle(fn, gen, oracle, grad):
+    x = gen(3, 5)
+    got = np.asarray(fn(paddle.to_tensor(x)).numpy())
+    np.testing.assert_allclose(got, oracle(x).astype(got.dtype),
+                               rtol=2e-5, atol=2e-5)
+    if grad:
+        check_grad(fn, [gen(3, 4)], atol=3e-2, rtol=3e-2)
+
+
+# in-place variants: same math, must mutate the receiver and return it
+INPLACE = [
+    (paddle.abs_, _any, np.abs),
+    (paddle.acos_, _unit, np.arccos),
+    (paddle.acosh_, _gt1, np.arccosh),
+    (paddle.asin_, _unit, np.arcsin),
+    (paddle.asinh_, _any, np.arcsinh),
+    (paddle.atan_, _any, np.arctan),
+    (paddle.atanh_, _unit, np.arctanh),
+    (paddle.ceil_, _any, np.ceil),
+    (paddle.cos_, _any, np.cos),
+    (paddle.cosh_, _any, np.cosh),
+    (paddle.digamma_, _pos, sps.digamma),
+    (paddle.erfinv_, _unit, sps.erfinv),
+    (paddle.exp_, _any, np.exp),
+    (paddle.floor_, _any, np.floor),
+    (paddle.frac_, _any, lambda x: x - np.trunc(x)),
+    (paddle.gammaln_, _pos, sps.gammaln),
+    (paddle.i0_, _any, sps.i0),
+    (paddle.lgamma_, _pos, sps.gammaln),
+    (paddle.log_, _pos, np.log),
+    (paddle.log10_, _pos, np.log10),
+    (paddle.log1p_, _pos, np.log1p),
+    (paddle.log2_, _pos, np.log2),
+    (paddle.logit_, lambda *s: R.uniform(0.2, 0.8, s).astype("float32"),
+     sps.logit),
+    (paddle.neg_, _any, np.negative),
+    (paddle.reciprocal_, _pos, np.reciprocal),
+    (paddle.round_, _any, np.round),
+    (paddle.rsqrt_, _pos, lambda x: 1.0 / np.sqrt(x)),
+    (paddle.sigmoid_, _any, sps.expit),
+    (paddle.sin_, _any, np.sin),
+    (paddle.sinc_, _pos, np.sinc),
+    (paddle.sinh_, _any, np.sinh),
+    (paddle.tan_, _unit, np.tan),
+    (paddle.tanh_, _any, np.tanh),
+    (paddle.trunc_, _any, np.trunc),
+    (paddle.nan_to_num_, _any, np.nan_to_num),
+]
+
+
+@pytest.mark.parametrize("fn,gen,oracle", INPLACE,
+                         ids=[f[0].__name__ for f in INPLACE])
+def test_inplace_unary(fn, gen, oracle):
+    x = gen(2, 6)
+    t = paddle.to_tensor(x)
+    out = fn(t)
+    assert out is t, f"{fn.__name__} must return its receiver"
+    np.testing.assert_allclose(np.asarray(t.numpy()),
+                               oracle(x).astype("float32"),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_more_inplace_math():
+    x = _any(2, 3)
+    t = paddle.to_tensor(x.copy())
+    assert paddle.scale_(t, 2.0, bias=1.0) is t
+    np.testing.assert_allclose(t.numpy(), x * 2 + 1, rtol=1e-6)
+    t = paddle.to_tensor(x.copy())
+    paddle.clip_(t, -0.5, 0.5)
+    np.testing.assert_allclose(t.numpy(), np.clip(x, -0.5, 0.5))
+    t = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    paddle.erf_(t)
+    np.testing.assert_allclose(t.numpy(), sps.erf([1.0, 2.0]), rtol=1e-5)
+    t = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    paddle.expm1_(t)
+    np.testing.assert_allclose(t.numpy(), np.expm1([1.0, 2.0]), rtol=1e-5)
+    t = paddle.to_tensor(np.array([0.3, 0.6], "float32"))
+    paddle.square_(t)
+    np.testing.assert_allclose(t.numpy(), [0.09, 0.36], rtol=1e-5)
+    t = paddle.to_tensor(np.array([[3.0, 4.0], [5.0, 6.0]], "float32"))
+    paddle.multigammaln_(t, 2)
+    ref = np.vectorize(lambda v: sps.multigammaln(v, 2))(
+        np.array([[3.0, 4.0], [5.0, 6.0]]))
+    np.testing.assert_allclose(t.numpy(), ref, rtol=1e-4)
+    # polygamma_ (in-place trigamma for n=1)
+    t = paddle.to_tensor(np.array([1.5, 2.5], "float32"))
+    paddle.polygamma_(t, 1)
+    np.testing.assert_allclose(t.numpy(), sps.polygamma(1, [1.5, 2.5]),
+                               rtol=1e-4)
+
+
+def test_predicates_and_introspection():
+    x = paddle.to_tensor(np.array([1.0, np.inf, np.nan], "float32"))
+    np.testing.assert_array_equal(paddle.isfinite(x).numpy(),
+                                  [True, False, False])
+    np.testing.assert_array_equal(paddle.isinf(x).numpy(),
+                                  [False, True, False])
+    np.testing.assert_array_equal(paddle.isnan(x).numpy(),
+                                  [False, False, True])
+    assert not paddle.is_complex(x)
+    assert not paddle.is_integer(x)
+    assert paddle.is_integer(paddle.to_tensor(np.array([1], "int32")))
+    assert not paddle.is_empty(x)
+    assert paddle.is_empty(paddle.to_tensor(np.zeros((0, 3), "float32")))
+    assert int(paddle.numel(paddle.to_tensor(np.zeros((2, 3))))) == 6
+    assert paddle.rank(paddle.to_tensor(np.zeros((2, 3, 4)))) == 3
+    np.testing.assert_array_equal(
+        np.asarray(paddle.shape(paddle.to_tensor(np.zeros((2, 5))))),
+        [2, 5])
+
+
+def test_complex_views_and_angle():
+    x = _any(3, 2)
+    c = paddle.as_complex(paddle.to_tensor(x))
+    ref = x[..., 0] + 1j * x[..., 1]
+    np.testing.assert_allclose(c.numpy(), ref.astype("complex64"),
+                               rtol=1e-6)
+    back = paddle.as_real(c)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+    re, im = _any(2, 3), _any(2, 3)
+    z = paddle.complex(paddle.to_tensor(re), paddle.to_tensor(im))
+    np.testing.assert_allclose(paddle.real(z).numpy(), re, rtol=1e-6)
+    np.testing.assert_allclose(paddle.imag(z).numpy(), im, rtol=1e-6)
+    np.testing.assert_allclose(paddle.angle(z).numpy(),
+                               np.angle(re + 1j * im), rtol=1e-5)
+    np.testing.assert_allclose(paddle.conj(z).numpy(),
+                               np.conj(re + 1j * im), rtol=1e-6)
+    mag = np.abs(re) + 0.1
+    p = paddle.polar(paddle.to_tensor(mag), paddle.to_tensor(im))
+    np.testing.assert_allclose(p.numpy(), mag * np.exp(1j * im),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gamma_incomplete_family():
+    a = _pos(2, 3)
+    x = _pos(2, 3)
+    np.testing.assert_allclose(
+        paddle.gammainc(paddle.to_tensor(a), paddle.to_tensor(x)).numpy(),
+        sps.gammainc(a, x), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        paddle.gammaincc(paddle.to_tensor(a),
+                         paddle.to_tensor(x)).numpy(),
+        sps.gammaincc(a, x), rtol=1e-5, atol=1e-6)
+    ta = paddle.to_tensor(a.copy())
+    assert paddle.gammainc_(ta, paddle.to_tensor(x)) is ta
+    np.testing.assert_allclose(ta.numpy(), sps.gammainc(a, x), rtol=1e-5,
+                               atol=1e-6)
+    ta = paddle.to_tensor(a.copy())
+    paddle.gammaincc_(ta, paddle.to_tensor(x))
+    np.testing.assert_allclose(ta.numpy(), sps.gammaincc(a, x),
+                               rtol=1e-5, atol=1e-6)
