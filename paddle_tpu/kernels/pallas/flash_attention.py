@@ -382,6 +382,56 @@ def _sem(n):
         dimension_semantics=("parallel",) * 3 + ("arbitrary",) * (n - 3))
 
 
+def _gspmd_wrap(fn, rule, repl):
+    """GSPMD sharding rule for a Pallas-calling function — the TPU
+    equivalent of the reference's flash-attention SPMD rule
+    (`paddle/phi/infermeta/spmd_rules/flash_attention.cc`): batch (dim 0)
+    and kv-head (dim 1) may be sharded (DP / Megatron-TP head split);
+    sequence, group, and depth are declared need-replication, so GSPMD
+    reshards them instead of failing with "Mosaic kernels cannot be
+    automatically partitioned". Each shard runs the same kernel on its
+    local [b_loc, h_loc, ...] block — no cross-shard reduction exists in
+    any of the three kernels (softmax rows live entirely on one shard).
+    """
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    cp = custom_partitioning(fn)
+
+    def part(mesh, arg_shapes, result_shape):
+        b_ax = h_ax = None
+        for a in arg_shapes:
+            if len(a.shape) >= 4:
+                spec = list(a.sharding.spec)
+                spec += [None] * (len(a.shape) - len(spec))
+                b_ax = b_ax if b_ax is not None else spec[0]
+                h_ax = h_ax if h_ax is not None else spec[1]
+
+        def sh_for(a):
+            nd = len(a.shape)
+            spec = [None] * nd
+            spec[0] = b_ax
+            if nd >= 4:
+                spec[1] = h_ax
+            return NamedSharding(mesh, PartitionSpec(*spec))
+
+        arg_sh = tuple(sh_for(a) for a in arg_shapes)
+        out_sh = jax.tree.map(sh_for, result_shape)
+        return mesh, fn, out_sh, arg_sh
+
+    # Shardy requires special-factor indices sorted by first appearance
+    # in the rule string
+    order = []
+    import re as _re
+    for tok in _re.findall(r"[a-z][a-z0-9]*", rule):
+        if tok not in order:
+            order.append(tok)
+    repl = tuple(sorted(repl, key=order.index))
+    cp.def_partition(partition=part, sharding_rule=rule,
+                     need_replication_factors=repl)
+    return cp
+
+
 @functools.lru_cache(maxsize=64)
 def _make_flash(causal, scale, bq, bk, has_seg, sk_true, off):
     """Build the custom-vjp flash attention for static (causal, scale,
@@ -393,7 +443,13 @@ def _make_flash(causal, scale, bq, bk, has_seg, sk_true, off):
     Returns (out5, lse [B, Hk, G, Sqp] f32).
     """
 
-    def fwd_call(q5, k4, v4, qseg, kseg, qpos, kpos):
+    # seg/pos args share the b/sq/sk factors with q5/k4
+    seg_rule = "b sq, b sk, b sq, b sk, " if has_seg else ""
+    seg_repl = ()
+
+    def fwd_core(*args):
+        # args: [qseg, kseg, qpos, kpos,] q5, k4, v4  (pallas order)
+        q5, k4, v4 = args[-3:]
         B, Hk, G, Sq, Dp = q5.shape
         Sk = k4.shape[2]
         nq, nk = Sq // bq, Sk // bk
@@ -402,17 +458,11 @@ def _make_flash(causal, scale, bq, bk, has_seg, sk_true, off):
             _fwd_kernel, group=G, bq=bq, bk=bk, nk=nk, sk=sk_true,
             off=off, scale=np.float32(scale), causal=causal,
             has_seg=has_seg)
-        in_specs = []
-        args = []
-        if has_seg:
-            in_specs += _seg_specs(bq, bk)
-            args += [qseg, kseg, qpos, kpos]
-        in_specs += [
+        in_specs = (_seg_specs(bq, bk) if has_seg else []) + [
             pl.BlockSpec((1, 1, G, bq, Dp), lambda b, h, i, j: (b, h, _I0, i, _I0)),
             pl.BlockSpec((1, 1, bk, Dp), lambda b, h, i, j: (b, h, j, _I0)),
             pl.BlockSpec((1, 1, bk, Dp), lambda b, h, i, j: (b, h, j, _I0)),
         ]
-        args += [q5, k4, v4]
         out, lse = pl.pallas_call(
             kernel,
             grid=(B, Hk, nq, nk),
@@ -437,6 +487,17 @@ def _make_flash(causal, scale, bq, bk, has_seg, sk_true, off):
         )(*args)
         return out, lse
 
+    fwd_sharded = _gspmd_wrap(
+        fwd_core,
+        seg_rule + "b h g sq d, b h sk d, b h sk d "
+        "-> b h g sq d, b h g sq u",
+        ("g", "sq", "sk", "d", "u") + seg_repl)
+
+    def fwd_call(q5, k4, v4, qseg, kseg, qpos, kpos):
+        args = ([qseg, kseg, qpos, kpos] if has_seg else []) + \
+            [q5, k4, v4]
+        return fwd_sharded(*args)
+
     @jax.custom_vjp
     def flash(q5, k4, v4, qseg, kseg, qpos, kpos):
         return fwd_call(q5, k4, v4, qseg, kseg, qpos, kpos)
@@ -445,34 +506,21 @@ def _make_flash(causal, scale, bq, bk, has_seg, sk_true, off):
         out, lse = fwd_call(q5, k4, v4, qseg, kseg, qpos, kpos)
         return (out, lse), (q5, k4, v4, qseg, kseg, qpos, kpos, out, lse)
 
-    def flash_bwd(res, cts):
-        q5, k4, v4, qseg, kseg, qpos, kpos, out, lse = res
-        do5, dlse = cts
-        do5 = do5.astype(q5.dtype)
+    def dq_core(*args):
+        q5, k4, v4, do5, lse, delta = args[-6:]
         B, Hk, G, Sq, Dp = q5.shape
         Sk = k4.shape[2]
         nq, nk = Sq // bq, Sk // bk
         rows = G * bq
-        # delta = rowsum(dO * O), f32, same layout as lse. A cotangent on
-        # the lse output folds straight in: dL/ds_ij picks up
-        # glse_i * p_ij, and the kernels compute ds = p * (dp - delta),
-        # so delta_eff = delta - glse carries it with no kernel change.
-        delta = jnp.sum(do5.astype(jnp.float32) * out.astype(jnp.float32),
-                        axis=-1, keepdims=True)
-        if dlse is not None:
-            delta = delta - dlse.astype(jnp.float32)
-
         common = dict(group=G, bq=bq, bk=bk, sk=sk_true, off=off,
                       scale=np.float32(scale), causal=causal,
                       has_seg=has_seg)
-        seg_args = [qseg, kseg, qpos, kpos] if has_seg else []
-
         q_spec = pl.BlockSpec((1, 1, G, bq, Dp),
                               lambda b, h, i, j: (b, h, _I0, i, _I0))
         kv_spec = pl.BlockSpec((1, 1, bk, Dp), lambda b, h, i, j: (b, h, j, _I0))
         lse_spec = pl.BlockSpec((1, 1, G, bq, 1),
                                 lambda b, h, i, j: (b, h, _I0, i, _I0))
-        dq = pl.pallas_call(
+        return pl.pallas_call(
             functools.partial(_dq_kernel, nk=nk, **common),
             grid=(B, Hk, nq, nk),
             in_specs=(_seg_specs(bq, bk) if has_seg else [])
@@ -482,8 +530,16 @@ def _make_flash(causal, scale, bq, bk, has_seg, sk_true, off):
             scratch_shapes=[pltpu.VMEM((rows, Dp), jnp.float32)],
             compiler_params=_sem(4),
             interpret=_interpret(),
-        )(*seg_args, q5, k4, v4, do5, lse, delta)
+        )(*args)
 
+    def dkv_core(*args):
+        q5, k4, v4, do5, lse, delta = args[-6:]
+        B, Hk, G, Sq, Dp = q5.shape
+        Sk = k4.shape[2]
+        nq, nk = Sq // bq, Sk // bk
+        common = dict(group=G, bq=bq, bk=bk, sk=sk_true, off=off,
+                      scale=np.float32(scale), causal=causal,
+                      has_seg=has_seg)
         # kv-major grid for dk/dv
         q_spec2 = pl.BlockSpec((1, 1, G, bq, Dp),
                                lambda b, h, j, i: (b, h, _I0, i, _I0))
@@ -491,7 +547,7 @@ def _make_flash(causal, scale, bq, bk, has_seg, sk_true, off):
                                 lambda b, h, j, i: (b, h, j, _I0))
         lse_spec2 = pl.BlockSpec((1, 1, G, bq, 1),
                                  lambda b, h, j, i: (b, h, _I0, i, _I0))
-        dk, dv = pl.pallas_call(
+        return pl.pallas_call(
             functools.partial(_dkv_kernel, nq=nq, **common),
             grid=(B, Hk, nk, nq),
             in_specs=(_seg_specs_kvmajor(bq, bk) if has_seg else [])
@@ -507,7 +563,32 @@ def _make_flash(causal, scale, bq, bk, has_seg, sk_true, off):
             ],
             compiler_params=_sem(4),
             interpret=_interpret(),
-        )(*seg_args, q5, k4, v4, do5, lse, delta)
+        )(*args)
+
+    bwd_in_rule = (seg_rule + "b h g sq d, b h sk d, b h sk d, "
+                   "b h g sq d, b h g sq u, b h g sq u")
+    dq_sharded = _gspmd_wrap(dq_core, bwd_in_rule + " -> b h g sq d",
+                             ("g", "sq", "sk", "d", "u") + seg_repl)
+    dkv_sharded = _gspmd_wrap(
+        dkv_core, bwd_in_rule + " -> b h sk d, b h sk d",
+        ("g", "sq", "sk", "d", "u") + seg_repl)
+
+    def flash_bwd(res, cts):
+        q5, k4, v4, qseg, kseg, qpos, kpos, out, lse = res
+        do5, dlse = cts
+        do5 = do5.astype(q5.dtype)
+        # delta = rowsum(dO * O), f32, same layout as lse. A cotangent on
+        # the lse output folds straight in: dL/ds_ij picks up
+        # glse_i * p_ij, and the kernels compute ds = p * (dp - delta),
+        # so delta_eff = delta - glse carries it with no kernel change.
+        delta = jnp.sum(do5.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        if dlse is not None:
+            delta = delta - dlse.astype(jnp.float32)
+
+        seg_args = [qseg, kseg, qpos, kpos] if has_seg else []
+        dq = dq_sharded(*seg_args, q5, k4, v4, do5, lse, delta)
+        dk, dv = dkv_sharded(*seg_args, q5, k4, v4, do5, lse, delta)
         if has_seg:
             # integer inputs take float0 cotangents
             zct = lambda x: np.zeros(x.shape, jax.dtypes.float0)
