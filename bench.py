@@ -217,9 +217,106 @@ def bench_eager(tag="eager"):
         opt.clear_grad()
     _sync(loss)
     dt = time.perf_counter() - t0
-    return {
+
+    out = {
         "tag": tag, "eager_elementwise_ops_per_s": round(ops_per_s, 1),
         "eager_train_steps_per_s": round(steps / dt, 2),
+    }
+    out["dispatch_breakdown_us"] = _dispatch_breakdown()
+    out.update(_eager_vs_jit_budget())
+    return out
+
+
+def _dispatch_breakdown(n=2000):
+    """Per-dispatch overhead split (VERDICT r3 #5): where a single eager
+    op's wall time goes — python arg handling in apply(), the cache-key
+    build, tape-node recording, and the raw jax/PJRT call underneath."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.dispatch import _fn_key, apply
+
+    x = paddle.to_tensor(np.ones((256, 256), np.float32))
+    xa = x._data
+    fn = jnp.tanh
+
+    def timeit(f, k=n):
+        f()  # warm
+        t0 = time.perf_counter()
+        for _ in range(k):
+            f()
+        return (time.perf_counter() - t0) / k * 1e6
+
+    # raw jax call: the PJRT async dispatch floor
+    raw = timeit(lambda: fn(xa))
+    # no-grad apply: + python arg handling / amp+flags checks / wrapping
+    with paddle.no_grad():
+        nograd = timeit(lambda: apply(fn, x, name="tanh"))
+    # recording apply (cache hit): + key build + tape node + lazy-vjp
+    x.stop_gradient = False
+    rec = timeit(lambda: apply(fn, x, name="tanh"))
+    # the cache key build alone
+    key = timeit(lambda: _fn_key(fn), k=max(n, 5000))
+    return {
+        "raw_jax_call": round(raw, 2),
+        "apply_nograd": round(nograd, 2),
+        "apply_recording": round(rec, 2),
+        "arg_handling": round(max(nograd - raw, 0.0), 2),
+        "record_overhead": round(max(rec - nograd, 0.0), 2),
+        "fn_key_build": round(key, 2),
+    }
+
+
+# the documented eager budget (VERDICT r3 #5): an eager tiny-GPT train
+# step must cost at most 3x its fully-jitted TrainStep equivalent
+EAGER_BUDGET_RATIO = 3.0
+
+
+def _eager_vs_jit_budget(steps=8):
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import GPT, GPTConfig
+
+    def mk():
+        paddle.seed(0)
+        cfg = GPTConfig.tiny()
+        m = GPT(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=m.parameters())
+        ids = paddle.to_tensor(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (4, 64)).astype("int64"))
+        return m, opt, ids
+
+    m, opt, ids = mk()
+    for _ in range(2):
+        loss = m.loss(ids, ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = m.loss(ids, ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    _sync(loss)
+    eager_ms = (time.perf_counter() - t0) / steps * 1e3
+
+    m, opt, ids = mk()
+    step = paddle.jit.TrainStep(m, opt, lambda mm, i: mm.loss(i, i))
+    step(ids); step(ids)  # compile + settle
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids)
+    _sync(loss)
+    jit_ms = (time.perf_counter() - t0) / steps * 1e3
+    ratio = eager_ms / jit_ms if jit_ms > 0 else float("inf")
+    return {
+        "eager_tiny_gpt_step_ms": round(eager_ms, 2),
+        "jitted_tiny_gpt_step_ms": round(jit_ms, 2),
+        "eager_over_jit_ratio": round(ratio, 2),
+        "eager_budget_ratio": EAGER_BUDGET_RATIO,
+        "eager_budget_pass": bool(ratio <= EAGER_BUDGET_RATIO),
     }
 
 

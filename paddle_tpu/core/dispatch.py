@@ -40,8 +40,94 @@ def _differentiable(dt) -> bool:
 # ---------------------------------------------------------------------------
 
 _LAZY_BWD_CACHE: dict = {}
+_LAZY_FWD_CACHE: dict = {}
 _LAZY_BWD_CACHE_MAX = 2048
 _EAGER_ONLY = object()  # negative entry: op rejected from the lazy path
+
+
+def _make_lazy_fwd(fn, n_payloads, arr_pos, statics, kwargs, was_tuple):
+    statics_d = dict(statics)
+
+    @jax.jit
+    def fwd(*arrs):
+        full = [None] * n_payloads
+        for pos, a in zip(arr_pos, arrs):
+            full[pos] = a
+        for pos, s in statics_d.items():
+            full[pos] = s
+        out = fn(*full, **kwargs)
+        if was_tuple:
+            return tuple(out)
+        return out
+
+    return fwd
+
+
+_NOT_CACHED = object()
+
+
+def _fwd_cached_call(fn, payloads, kwargs):
+    """No-grad/inference fast path: composite ops run through the same
+    cached jitted forward the recording path uses (keyed with an empty
+    diff set), instead of per-primitive eager dispatch. Returns
+    _NOT_CACHED when the op is not (yet) eligible — the caller then runs
+    the plain eager forward, and the second call onward hits the cache."""
+    arr_pos, arrs, statics = [], [], []
+    for i, p in enumerate(payloads):
+        if isinstance(p, (jax.Array, np.ndarray)):
+            arr_pos.append(i)
+            arrs.append(p)
+        else:
+            statics.append((i, p))
+    try:
+        key = (_fn_key(fn), (), tuple(arr_pos),
+               _freeze(tuple(statics)), _freeze(kwargs))
+        hash(key)
+    except (TypeError, ValueError):
+        return _NOT_CACHED
+    fwd = _LAZY_FWD_CACHE.get(key)
+    if fwd is None:
+        # probe on the first call (outside any timing-critical loop)
+        out = fn(*payloads, **kwargs)
+        _populate_fwd_cache(key, fn, len(payloads), tuple(arr_pos),
+                            tuple(statics), kwargs,
+                            isinstance(out, (tuple, list)), arrs)
+        return out
+    if fwd is _EAGER_ONLY:
+        return _NOT_CACHED
+    return fwd(*arrs)
+
+
+def _populate_fwd_cache(key, fn, n_payloads, arr_pos, statics, kwargs,
+                        was_tuple, arrs):
+    """Decide once per key whether the forward gets a cached jit: only
+    COMPOSITE fns (>= 3 primitives) — one jit call costs about one eager
+    op dispatch, so fusing pays from ~3 primitives up; single-primitive
+    wrappers stay on the raw eager call. The probe binds statics exactly
+    like _make_lazy_fwd so static payloads never reach the tracer."""
+    if key in _LAZY_FWD_CACHE:
+        return
+    if len(_LAZY_FWD_CACHE) >= _LAZY_BWD_CACHE_MAX:
+        _LAZY_FWD_CACHE.pop(next(iter(_LAZY_FWD_CACHE)))
+    statics_d = dict(statics)
+
+    def bound(*a):
+        full = [None] * n_payloads
+        for pos, arr in zip(arr_pos, a):
+            full[pos] = arr
+        for pos, s in statics_d.items():
+            full[pos] = s
+        return fn(*full, **kwargs)
+
+    try:
+        n_eqns = len(jax.make_jaxpr(bound)(*arrs).jaxpr.eqns)
+    except Exception:  # noqa: BLE001 — non-traceable: stay eager
+        n_eqns = 0
+    if n_eqns >= 3:
+        _LAZY_FWD_CACHE[key] = _make_lazy_fwd(
+            fn, n_payloads, arr_pos, statics, kwargs, was_tuple)
+    else:
+        _LAZY_FWD_CACHE[key] = _EAGER_ONLY
 
 
 def _freeze(v):
@@ -233,6 +319,9 @@ def _cell_key(v, _seen=None):
         return tuple(_cell_key(e, _seen) for e in v)
     if isinstance(v, frozenset):
         return frozenset(_cell_key(e, _seen) for e in v)
+    if isinstance(v, slice):
+        return ("slice", _cell_key(v.start, _seen),
+                _cell_key(v.stop, _seen), _cell_key(v.step, _seen))
     import functools
     if isinstance(v, functools.partial):
         return ("partial", _cell_key_fn(v.func, _seen),
@@ -274,6 +363,21 @@ def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf):
     if _LAZY_BWD_CACHE.get(key) is _EAGER_ONLY:
         return None  # known non-diff-output op: skip the probe forward
 
+    fwd = _LAZY_FWD_CACHE.get(key)
+    if fwd is not None and fwd is not _EAGER_ONLY:
+        # cached JITTED forward: a composite op (sdpa, layer_norm, ...)
+        # runs as ONE fused XLA executable instead of op-by-op jax eager
+        # dispatch — the eager-mode answer to the reference's fused
+        # per-op kernels (phi/kernels/fusion). Same cacheability rules
+        # as the lazy backward, so semantics are unchanged.
+        out = fwd(*arrs)
+        was_tuple = isinstance(out, (tuple, list))
+        out_tuple = tuple(out) if was_tuple else (out,)
+        _post_op_hooks(name, out_tuple, check_naninf)
+        bwd = _lazy_bwd_for(key, fn, len(payloads), diff_idx, arr_pos,
+                            statics, kwargs, was_tuple)
+        return out_tuple, _LazyVjp(bwd, arrs), was_tuple
+
     out = fn(*payloads, **kwargs)
     was_tuple = isinstance(out, (tuple, list))
     out_tuple = tuple(out) if was_tuple else (out,)
@@ -284,6 +388,8 @@ def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf):
                for o in out_tuple):
         _LAZY_BWD_CACHE[key] = _EAGER_ONLY
         return None
+    _populate_fwd_cache(key, fn, len(payloads), tuple(arr_pos),
+                        tuple(statics), kwargs, was_tuple, arrs)
     _post_op_hooks(name, out_tuple, check_naninf)
     bwd = _lazy_bwd_for(key, fn, len(payloads), diff_idx, arr_pos,
                         statics, kwargs, was_tuple)
@@ -319,7 +425,9 @@ def apply(fn: Callable, *args, name: str = None, **kwargs):
             payloads.append(a)
 
     if not diff_idx:
-        out = fn(*payloads, **kwargs)
+        out = _fwd_cached_call(fn, payloads, kwargs)
+        if out is _NOT_CACHED:
+            out = fn(*payloads, **kwargs)
         _post_op_hooks(name, out if isinstance(out, (tuple, list))
                        else (out,), check_naninf)
         if isinstance(out, (tuple, list)):

@@ -406,6 +406,11 @@ def _gspmd_wrap(fn, rule, repl):
                 spec += [None] * (len(a.shape) - len(spec))
                 b_ax = b_ax if b_ax is not None else spec[0]
                 h_ax = h_ax if h_ax is not None else spec[1]
+        if h_ax == b_ax:
+            # distinct args can propose the same mesh axis for batch and
+            # head; a PartitionSpec naming one axis twice is invalid —
+            # keep it on batch, replicate heads (GSPMD reshards)
+            h_ax = None
 
         def sh_for(a):
             nd = len(a.shape)

@@ -42,13 +42,15 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     from ...core.dispatch import as_index
     idx = as_index(unwrap(x))
 
-    def _embedding(w):
-        out = jnp.take(w, idx, axis=0)
+    # idx travels as a payload arg (an array in a closure cell would
+    # reject the op from the lazy-backward cache -> full vjp per call)
+    def _embedding(w, idxa):
+        out = jnp.take(w, idxa, axis=0)
         if padding_idx is not None:
-            mask = (idx == padding_idx)[..., None]
+            mask = (idxa == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
-    return apply(_embedding, weight, name="embedding")
+    return apply(_embedding, weight, idx, name="embedding")
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
@@ -104,6 +106,11 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         normalized_shape = (normalized_shape,)
     n_axes = len(tuple(normalized_shape))
 
+    # close over FLAGS, not the Parameters: a Parameter in a closure cell
+    # rejects the op from the lazy-backward cache (full jax.vjp retrace
+    # per call — ~30x the cached dispatch)
+    has_w, has_b = weight is not None, bias is not None
+
     def _ln(a, *wb):
         axes = tuple(range(a.ndim - n_axes, a.ndim))
         # fp32 statistics regardless of input dtype (matches the reference's
@@ -113,10 +120,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         var = jnp.var(af, axis=axes, keepdims=True)
         out = (af - mean) * jax.lax.rsqrt(var + epsilon)
         i = 0
-        if weight is not None:
+        if has_w:
             out = out * wb[i].astype(jnp.float32)
             i += 1
-        if bias is not None:
+        if has_b:
             out = out + wb[i].astype(jnp.float32)
         return out.astype(a.dtype)
     args = [t for t in (weight, bias) if t is not None]

@@ -36,27 +36,30 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                   use_softmax=True, label_smoothing=0.0, name=None):
     lbl = unwrap(label)
     w_arr = unwrap(weight)
+    has_w = w_arr is not None
 
-    def _ce(logits, *maybe_soft):
+    # label and class weights travel as payload args (arrays in closure
+    # cells reject the op from the lazy-backward cache -> full vjp per
+    # call, the dominant eager cost for models ending in cross_entropy)
+    def _ce(logits, lblv, *extra):
+        w = extra[0] if has_w else None
         lf = logits.astype(jnp.float32)
         logp = jax.nn.log_softmax(lf, axis=axis) if use_softmax else \
             jnp.log(jnp.maximum(lf, 1e-30))
-        if soft_label or maybe_soft:
-            soft = maybe_soft[0].astype(jnp.float32) if maybe_soft else \
-                lbl.astype(jnp.float32)
+        if soft_label:
+            soft = lblv.astype(jnp.float32)
             if label_smoothing > 0.0:
                 k = logits.shape[axis]
                 soft = (1 - label_smoothing) * soft + label_smoothing / k
             loss = -jnp.sum(soft * logp, axis=axis)
-            if w_arr is not None:
-                cls_w = jnp.sum(soft * w_arr, axis=axis)
+            if has_w:
+                cls_w = jnp.sum(soft * w, axis=axis)
                 loss = loss * cls_w
             return _reduce(loss, reduction)
         # hard labels
-        li = lbl
+        li = lblv
         if li.ndim == logp.ndim:  # trailing 1 dim paddle-style
             li = jnp.squeeze(li, axis=axis)
-        k = logits.shape[axis]
         valid = li != ignore_index
         safe = as_index(jnp.where(valid, li, 0))
         # gather-free pick: one-hot mask-reduce instead of take_along_axis.
@@ -71,8 +74,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
         if label_smoothing > 0.0:
             smooth_term = -jnp.mean(logp, axis=axis)
             nll = (1 - label_smoothing) * nll + label_smoothing * smooth_term
-        if w_arr is not None:
-            sample_w = jnp.where(valid, w_arr[safe], 0.0)
+        if has_w:
+            sample_w = jnp.where(valid, w[safe], 0.0)
             nll = nll * sample_w
             if reduction == "mean":
                 denom = jnp.maximum(jnp.sum(sample_w), 1e-12)
@@ -83,9 +86,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             return jnp.sum(nll) / denom
         return _reduce(nll, reduction)
 
+    extra = (w_arr,) if has_w else ()
     if soft_label and hasattr(label, "_data"):
-        return apply(_ce, input, label, name="cross_entropy")
-    return apply(_ce, input, name="cross_entropy")
+        return apply(_ce, input, label, *extra, name="cross_entropy")
+    return apply(_ce, input, lbl, *extra, name="cross_entropy")
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from collections import OrderedDict
 
+import jax
 import jax.numpy as jnp
 
 from ..core import dtype as dtype_mod
@@ -163,6 +164,8 @@ class Optimizer:
     def step(self):
         self._global_step += 1
         self._t = self._global_step
+        if self._fused_eager_step():
+            return
         if self._grad_clip is not None:
             self._grad_clip._apply(self._parameter_list)
         for group in self._groups:
@@ -181,6 +184,103 @@ class Optimizer:
                     new_st["master"] = new_p
                 p._rebind(new_p.astype(p._data.dtype))
                 self._state[id(p)] = new_st
+
+    def _fused_eager_step(self):
+        """Multi-tensor fused update for the eager loop: ALL param
+        updates (plus global-norm clip) trace into ONE donated jitted
+        call — the TPU answer to the reference's fused_adam multi-tensor
+        kernel (`paddle/phi/kernels/gpu/fused_adam_kernel.cu`). Returns
+        False (caller runs the per-param python loop) for
+        param/grad-set shapes the fused path doesn't cover; any build
+        failure also falls back before any state is touched."""
+        items = []
+        for group in self._groups:
+            for p in group["params"]:
+                if p.grad is None or p.stop_gradient:
+                    continue
+                if p.grad._data.shape != p._data.shape:
+                    return False
+                items.append((p, group))
+        if not items:
+            return False
+        sig = tuple(
+            (id(g), g["lr_mult"], g["weight_decay"], g["wd_mode"],
+             p.optimize_attr.get("learning_rate", 1.0), p.need_clip,
+             self._wants_decay(p), str(p._data.dtype))
+            for p, g in items) + (id(self._grad_clip),)
+        cached = getattr(self, "_fused_cache", None)
+        if cached is not None and cached[0] == sig:
+            fused = cached[1]
+        else:
+            groups_s = [g for _, g in items]
+            params_s = [p for p, _ in items]
+            lr_mults = [g["lr_mult"] *
+                        p.optimize_attr.get("learning_rate", 1.0)
+                        for p, g in items]
+            need_clip = [p.need_clip for p, _ in items]
+            dtypes = [p._data.dtype for p, _ in items]
+            clip = self._grad_clip
+            opt = self
+
+            def fused(params, grads, slots, lr, t):
+                prev_t = opt._t
+                opt._t = t
+                try:
+                    g32 = [g.astype(jnp.float32) for g in grads]
+                    if clip is not None:
+                        g32 = clip._clip_arrays(g32, need_clip)
+                    new_params, new_slots = [], []
+                    for i, (p_arr, g, st) in enumerate(
+                            zip(params, g32, slots)):
+                        p32 = st["master"] if st.get("master") is not None \
+                            else p_arr.astype(jnp.float32)
+                        np_, nst = opt._apply_param(
+                            p32, g, st, lr * lr_mults[i], groups_s[i],
+                            param=params_s[i])
+                        if st.get("master") is not None:
+                            nst["master"] = np_
+                        new_params.append(np_.astype(dtypes[i]))
+                        new_slots.append(nst)
+                    # clipped grads go back out so p.grad matches the
+                    # python path's in-place _grad_clip._apply semantics
+                    clipped = [g.astype(orig.dtype)
+                               for g, orig in zip(g32, grads)] \
+                        if clip is not None else None
+                    return new_params, new_slots, clipped
+                finally:
+                    opt._t = prev_t
+
+            try:
+                # NO donation: eager code legitimately aliases p._data /
+                # slot arrays (Lookahead slow weights, state_dict
+                # snapshots) — donating would delete them under the
+                # aliases' feet. The compiled TrainStep (which owns its
+                # buffers) is the donating path.
+                fused = jax.jit(fused)
+            except Exception as e:  # pragma: no cover
+                self._fused_err = e
+                return False
+            self._fused_cache = (sig, fused)
+
+        param_arrays = [p._data for p, _ in items]
+        grad_arrays = [p.grad._data for p, _ in items]
+        slot_states = [self._slots_for(p) for p, _ in items]
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        t = jnp.asarray(self._t, jnp.float32)
+        try:
+            new_params, new_slots, clipped = fused(
+                param_arrays, grad_arrays, slot_states, lr, t)
+        except Exception as e:  # noqa: BLE001 — trace failure: py loop
+            self._fused_cache = None
+            self._fused_err = e  # introspection: why the fused path bailed
+            return False
+        for i, ((p, _), arr, st) in enumerate(zip(items, new_params,
+                                                  new_slots)):
+            p._rebind(arr)
+            self._state[id(p)] = st
+            if clipped is not None:
+                p.grad._rebind(clipped[i])
+        return True
 
     def clear_grad(self, set_to_zero=False):
         for p in self._parameter_list:

@@ -3,8 +3,6 @@ execution under jit over a mesh must match the unsharded kernel, forward
 and backward (the TPU analogue of the reference's flash-attention SPMD
 rule, `paddle/phi/infermeta/spmd_rules/flash_attention.cc`)."""
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
