@@ -382,46 +382,62 @@ def _sem(n):
         dimension_semantics=("parallel",) * 3 + ("arbitrary",) * (n - 3))
 
 
-def _gspmd_wrap(fn, rule, repl):
+def _gspmd_wrap(fn, rule, repl, arg_keeps=None, out_keeps=None):
     """GSPMD sharding rule for a Pallas-calling function — the TPU
     equivalent of the reference's flash-attention SPMD rule
-    (`paddle/phi/infermeta/spmd_rules/flash_attention.cc`): batch (dim 0)
-    and kv-head (dim 1) may be sharded (DP / Megatron-TP head split);
-    sequence, group, and depth are declared need-replication, so GSPMD
-    reshards them instead of failing with "Mosaic kernels cannot be
-    automatically partitioned". Each shard runs the same kernel on its
-    local [b_loc, h_loc, ...] block — no cross-shard reduction exists in
-    any of the three kernels (softmax rows live entirely on one shard).
+    (`paddle/phi/infermeta/spmd_rules/flash_attention.cc`): batch and
+    kv-head dims may be sharded (DP / Megatron-TP head split); every
+    other factor is declared need-replication, so GSPMD reshards them
+    instead of failing with "Mosaic kernels cannot be automatically
+    partitioned". Each shard runs the same kernel on its local block —
+    no cross-shard reduction exists in any of the kernels (softmax rows
+    live entirely on one shard).
+
+    ``arg_keeps``/``out_keeps``: per-arg/out ``(batch_dim, head_dim)``
+    tensor-dimension indices (None = that role absent). Default (None):
+    rank>=4 tensors use (0, 1), lower ranks (0, None) — the internal
+    flash layout.
     """
     from jax.experimental.custom_partitioning import custom_partitioning
     from jax.sharding import NamedSharding, PartitionSpec
 
     cp = custom_partitioning(fn)
 
+    def keep_for(i, a, keeps):
+        if keeps is not None:
+            return keeps[i]
+        return (0, 1) if len(a.shape) >= 4 else (0, None)
+
     def part(mesh, arg_shapes, result_shape):
         b_ax = h_ax = None
-        for a in arg_shapes:
-            if len(a.shape) >= 4:
-                spec = list(a.sharding.spec)
-                spec += [None] * (len(a.shape) - len(spec))
-                b_ax = b_ax if b_ax is not None else spec[0]
-                h_ax = h_ax if h_ax is not None else spec[1]
+        for i, a in enumerate(arg_shapes):
+            bd, hd = keep_for(i, a, arg_keeps)
+            spec = list(a.sharding.spec)
+            spec += [None] * (len(a.shape) - len(spec))
+            if b_ax is None and bd is not None:
+                b_ax = spec[bd]
+            if h_ax is None and hd is not None:
+                h_ax = spec[hd]
         if h_ax == b_ax:
             # distinct args can propose the same mesh axis for batch and
             # head; a PartitionSpec naming one axis twice is invalid —
             # keep it on batch, replicate heads (GSPMD reshards)
             h_ax = None
 
-        def sh_for(a):
-            nd = len(a.shape)
-            spec = [None] * nd
-            spec[0] = b_ax
-            if nd >= 4:
-                spec[1] = h_ax
+        def sh_for(i, a, keeps):
+            bd, hd = keep_for(i, a, keeps)
+            spec = [None] * len(a.shape)
+            if bd is not None:
+                spec[bd] = b_ax
+            if hd is not None:
+                spec[hd] = h_ax
             return NamedSharding(mesh, PartitionSpec(*spec))
 
-        arg_sh = tuple(sh_for(a) for a in arg_shapes)
-        out_sh = jax.tree.map(sh_for, result_shape)
+        arg_sh = tuple(sh_for(i, a, arg_keeps)
+                       for i, a in enumerate(arg_shapes))
+        flat_res, treedef = jax.tree.flatten(result_shape)
+        out_sh = jax.tree.unflatten(treedef, [
+            sh_for(i, r, out_keeps) for i, r in enumerate(flat_res)])
         return mesh, fn, out_sh, arg_sh
 
     # Shardy requires special-factor indices sorted by first appearance

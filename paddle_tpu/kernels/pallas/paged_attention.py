@@ -153,11 +153,27 @@ def paged_decode_attention_kernel(q, k_pool, v_pool, block_tables,
     )
     kernel = functools.partial(_decode_kernel, hk=hk, g=g, bs=bs,
                                npages=npages, scale=sm_scale)
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
-        interpret=interpret,
-    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
-      q, k_pool, v_pool)
+
+    def core(tbl, lens, qq, kp, vp):
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(qq.shape, qq.dtype),
+            interpret=interpret,
+        )(tbl, lens, qq, kp, vp)
+
+    # GSPMD rule (the decode-serving analogue of the flash-attention
+    # SPMD rule): request batch b may be sharded (DP serving over
+    # chips); the page pools are replicated — every shard's block table
+    # indexes the full pool. Head/page dims declared need-replication.
+    from .flash_attention import _gspmd_wrap
+    sharded = _gspmd_wrap(
+        core,
+        "b m, b, b hq d, nb bs hk d, nb bs hk d -> b hq d",
+        ("m", "hq", "d", "nb", "bs", "hk"),
+        arg_keeps=[(0, None), (0, None), (0, None), (None, None),
+                   (None, None)],
+        out_keeps=[(0, None)])
+    out = sharded(block_tables.astype(jnp.int32),
+                  seq_lens.astype(jnp.int32), q, k_pool, v_pool)
     return out

@@ -82,6 +82,30 @@ def test_gqa_head_sharded(mesh):
                                atol=2e-3)
 
 
+def test_paged_decode_batch_sharded(mesh):
+    """DP serving: requests sharded over chips, page pools replicated."""
+    from paddle_tpu.kernels.pallas.paged_attention import (
+        paged_decode_attention_kernel)
+
+    r = np.random.default_rng(5)
+    B, HQ, HK, D, BS, NB, MBPS = 8, 4, 4, 128, 16, 32, 4
+    q = jnp.asarray(r.standard_normal((B, HQ, D)), jnp.float32)
+    kp = jnp.asarray(r.standard_normal((NB, BS, HK, D)), jnp.float32)
+    vp = jnp.asarray(r.standard_normal((NB, BS, HK, D)), jnp.float32)
+    tbl = jnp.asarray(r.integers(0, NB, (B, MBPS)), jnp.int32)
+    lens = jnp.asarray(r.integers(1, MBPS * BS, (B,)), jnp.int32)
+    ref = np.asarray(paged_decode_attention_kernel(q, kp, vp, tbl, lens))
+    shb = NamedSharding(mesh, P("dp"))
+    with mesh:
+        out = jax.jit(paged_decode_attention_kernel)(
+            jax.device_put(q, NamedSharding(mesh, P("dp", None, None))),
+            kp, vp,
+            jax.device_put(tbl, NamedSharding(mesh, P("dp", None))),
+            jax.device_put(lens, shb))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                               atol=2e-4)
+
+
 def test_seq_sharded_input_gets_resharded_not_rejected(mesh):
     # sequence-dim sharding is declared need-replication: GSPMD must
     # insert a reshard (correct numerics), not fail to partition
