@@ -1,0 +1,40 @@
+// Out-of-tree custom-op API (parity: the reference's PD_BUILD_OP /
+// PD_BUILD_GRAD_OP macros in paddle/phi/api/ext/op_meta_info.h).
+//
+// TPU-native seam: a custom op is an XLA FFI handler — the same
+// custom-call machinery XLA itself uses — so it runs under jit,
+// composes with sharding, and needs no framework ABI beyond the
+// (stable, versioned) XLA FFI C API. Write the op over ffi::Buffer
+// views, bind it, and export it under the pd_op_ prefix; the Python
+// side (paddle_tpu.utils.cpp_extension.load_op) discovers every
+// exported pd_op_* symbol, registers it with the runtime, and exposes
+// a Tensor-in/Tensor-out callable. Exporting pd_op_<name>_grad as
+// well wires the backward automatically (inputs... , cotangent) ->
+// one gradient per input.
+//
+//   #include "paddle_ext.h"
+//   static ffi::Error ReluImpl(ffi::Buffer<ffi::F32> x,
+//                              ffi::ResultBuffer<ffi::F32> y) {
+//     for (size_t i = 0; i < x.element_count(); ++i)
+//       y->typed_data()[i] = x.typed_data()[i] > 0 ? x.typed_data()[i]
+//                                                  : 0.0f;
+//     return ffi::Error::Success();
+//   }
+//   PD_BUILD_OP(my_relu, ReluImpl,
+//               ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::F32>>()
+//                               .Ret<ffi::Buffer<ffi::F32>>());
+
+#ifndef PADDLE_TPU_EXT_H_
+#define PADDLE_TPU_EXT_H_
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;  // NOLINT
+
+#define PD_BUILD_OP(opname, impl, binding) \
+  XLA_FFI_DEFINE_HANDLER_SYMBOL(pd_op_##opname, impl, binding)
+
+#define PD_BUILD_GRAD_OP(opname, impl, binding) \
+  XLA_FFI_DEFINE_HANDLER_SYMBOL(pd_op_##opname##_grad, impl, binding)
+
+#endif  // PADDLE_TPU_EXT_H_

@@ -1,13 +1,27 @@
 """Out-of-tree custom-op build system.
 
-Parity: reference `paddle.utils.cpp_extension` (cpp_extension/
-cpp_extension.py:86 `setup`, JIT `load`) compiling user C++/CUDA ops
-against the phi C++ API (PD_BUILD_OP). TPU-native equivalent: user C++
-builds against a plain C ABI (no framework headers needed) and the op is
-registered as a host callback or pure-python jnp composition; `load`
-compiles with g++ and returns a ctypes module. For device-side custom
-kernels users write Pallas (the Pallas guide is the CUDA-kernel
-replacement), which needs no build system at all.
+Parity: reference `paddle.utils.cpp_extension`
+(cpp_extension/cpp_extension.py:86 `setup`, JIT `load`) compiling user
+C++/CUDA ops against the phi C++ API (PD_BUILD_OP macros in
+paddle/phi/api/ext/op_meta_info.h). TPU-native equivalents, three
+tiers:
+
+1. ``load_op`` / ``CustomOpLibrary`` — the PD_BUILD_OP path: user C++
+   written against ``paddle_ext.h`` (XLA FFI handlers, csrc/include/)
+   compiles to a shared library; every exported ``pd_op_*`` symbol is
+   registered as an XLA custom-call target and exposed as a
+   Tensor-in/Tensor-out callable that works eagerly AND under jit.
+   Exporting ``pd_op_<name>_grad`` wires the backward automatically.
+2. ``load`` — plain C-ABI JIT build returning a ctypes.CDLL (the
+   runtime's own native pieces use this).
+3. ``setup`` — the setuptools packaging contract: with a command-line
+   command it drives a real ``setuptools.setup`` (build_ext with the
+   framework + XLA FFI include dirs injected); called bare (no argv
+   command) it builds in place and returns the libraries, the
+   convenience the previous revision shipped.
+
+Device-side custom kernels are Pallas (no build system needed) — the
+CUDA-kernel seam the reference compiles with nvcc.
 """
 
 from __future__ import annotations
@@ -16,9 +30,10 @@ import ctypes
 import hashlib
 import os
 import subprocess
+import sys
 
-__all__ = ["load", "setup", "CppExtension", "CUDAExtension",
-           "get_build_directory"]
+__all__ = ["load", "load_op", "setup", "CppExtension", "CUDAExtension",
+           "CustomOpLibrary", "get_build_directory", "include_paths"]
 
 _BUILD_ROOT = os.path.expanduser("~/.cache/paddle_tpu/extensions")
 
@@ -28,22 +43,30 @@ def get_build_directory():
     return _BUILD_ROOT
 
 
-def load(name, sources, extra_cxx_cflags=None, extra_cuda_cflags=None,
-         extra_ldflags=None, extra_include_paths=None, build_directory=None,
-         verbose=False):
-    """JIT-compile C++ sources into a shared library; returns the loaded
-    ctypes.CDLL. Functions use a plain C ABI."""
+def include_paths():
+    """Framework + XLA FFI header dirs for custom-op builds."""
+    import jax
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [os.path.join(here, "csrc", "include"), jax.ffi.include_dir()]
+
+
+def _compile(name, sources, extra_cxx_cflags=None, extra_ldflags=None,
+             extra_include_paths=None, build_directory=None,
+             verbose=False):
     build_dir = build_directory or get_build_directory()
     os.makedirs(build_dir, exist_ok=True)
     h = hashlib.sha256()
     for s in sources:
         with open(s, "rb") as f:
             h.update(f.read())
+    for fl in (extra_cxx_cflags or []) + (extra_ldflags or []):
+        h.update(str(fl).encode())
     so_path = os.path.join(build_dir, f"{name}-{h.hexdigest()[:12]}.so")
     if not os.path.exists(so_path):
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
                "-o", so_path]
-        for inc in extra_include_paths or []:
+        for inc in (extra_include_paths or []) + include_paths():
             cmd.append(f"-I{inc}")
         cmd += list(extra_cxx_cflags or [])
         cmd += list(sources)
@@ -51,28 +74,188 @@ def load(name, sources, extra_cxx_cflags=None, extra_cuda_cflags=None,
         if verbose:
             print(" ".join(cmd))
         subprocess.run(cmd, check=True, capture_output=not verbose)
+    return so_path
+
+
+def load(name, sources, extra_cxx_cflags=None, extra_cuda_cflags=None,
+         extra_ldflags=None, extra_include_paths=None, build_directory=None,
+         verbose=False):
+    """JIT-compile C++ sources into a shared library; returns the loaded
+    ctypes.CDLL. Functions use a plain C ABI."""
+    so_path = _compile(name, sources, extra_cxx_cflags, extra_ldflags,
+                       extra_include_paths, build_directory, verbose)
     return ctypes.CDLL(so_path)
 
 
+class CustomOpLibrary:
+    """A loaded PD_BUILD_OP library: each discovered op is an attribute
+    taking Tensors and returning Tensors; ops with a registered
+    ``<name>_grad`` handler are differentiable (tape + jit)."""
+
+    def __init__(self, so_path):
+        import jax
+
+        self._so_path = so_path
+        self._cdll = ctypes.CDLL(so_path)
+        self._ops = {}
+        nm = subprocess.run(["nm", "-D", "--defined-only", so_path],
+                            check=True, capture_output=True, text=True)
+        syms = [line.split()[-1] for line in nm.stdout.splitlines()
+                if " T " in line or " t " in line]
+        names = {s[len("pd_op_"):] for s in syms
+                 if s.startswith("pd_op_")}
+        grads = {n[:-len("_grad")] for n in names if n.endswith("_grad")}
+        fwd_names = {n for n in names if not n.endswith("_grad")}
+        tag = hashlib.sha256(so_path.encode()).hexdigest()[:8]
+        for n in fwd_names:
+            target = f"pd.{tag}.{n}"
+            jax.ffi.register_ffi_target(
+                target,
+                jax.ffi.pycapsule(getattr(self._cdll, f"pd_op_{n}")),
+                platform="cpu")
+            grad_target = None
+            if n in grads:
+                grad_target = f"pd.{tag}.{n}_grad"
+                jax.ffi.register_ffi_target(
+                    grad_target,
+                    jax.ffi.pycapsule(getattr(self._cdll,
+                                              f"pd_op_{n}_grad")),
+                    platform="cpu")
+            self._ops[n] = self._make_op(n, target, grad_target)
+
+    def op_names(self):
+        return sorted(self._ops)
+
+    def __getattr__(self, name):
+        ops = self.__dict__.get("_ops") or {}
+        if name in ops:
+            return ops[name]
+        raise AttributeError(
+            f"custom-op library has no op {name!r}; available: "
+            f"{sorted(ops)}")
+
+    def _make_op(self, name, target, grad_target):
+        import jax
+
+        from ..core.dispatch import apply
+
+        def raw(out_specs, *arrays):
+            return jax.ffi.ffi_call(target, out_specs)(*arrays)
+
+        def op(*tensors, out_specs=None):
+            """out_specs: jax.ShapeDtypeStruct (or list of) for the
+            output(s); defaults to the first input's shape/dtype (the
+            elementwise contract)."""
+            from ..core.tensor import Tensor
+
+            arrays = [t._data if isinstance(t, Tensor) else t
+                      for t in tensors]
+            specs = out_specs or jax.ShapeDtypeStruct(
+                arrays[0].shape, arrays[0].dtype)
+            multi = isinstance(specs, (list, tuple))
+
+            if grad_target is None:
+                def fn(*a):
+                    return raw(specs, *a)
+                return apply(fn, *tensors, name=f"custom_op:{name}")
+
+            in_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        for a in arrays]
+
+            @jax.custom_vjp
+            def fn(*a):
+                return raw(specs, *a)
+
+            def fwd(*a):
+                return raw(specs, *a), a
+
+            def bwd(res, ct):
+                cts = list(ct) if multi else [ct]
+                grads = jax.ffi.ffi_call(grad_target, in_specs)(
+                    *res, *cts)
+                if not isinstance(grads, (list, tuple)):
+                    grads = (grads,)
+                return tuple(grads)
+
+            fn.defvjp(fwd, bwd)
+            return apply(fn, *tensors, name=f"custom_op:{name}")
+
+        op.__name__ = name
+        op._ffi_target = target  # jit users can jax.ffi.ffi_call it
+        op._ffi_grad_target = grad_target
+        return op
+
+
+def load_op(name, sources, **kwargs):
+    """Build a PD_BUILD_OP library (paddle_ext.h / XLA FFI handlers) and
+    return a :class:`CustomOpLibrary` — the reference's custom-op
+    ``load`` for ops rather than raw CDLLs."""
+    so_path = _compile(name, sources,
+                       kwargs.get("extra_cxx_cflags"),
+                       kwargs.get("extra_ldflags"),
+                       kwargs.get("extra_include_paths"),
+                       kwargs.get("build_directory"),
+                       kwargs.get("verbose", False))
+    return CustomOpLibrary(so_path)
+
+
 class CppExtension:
-    def __init__(self, sources, *args, **kwargs):
-        self.sources = sources
+    """Extension spec; converts to a setuptools.Extension for the
+    packaging flow (reference CppExtension helper)."""
+
+    def __init__(self, sources, name=None, *args, **kwargs):
+        self.sources = list(sources)
+        self.name = name
         self.kwargs = kwargs
+
+    def as_setuptools(self, fallback_name):
+        from setuptools import Extension
+
+        kw = dict(self.kwargs)
+        inc = list(kw.pop("include_dirs", [])) + include_paths()
+        kw.pop("extra_include_paths", None)
+        extra = list(kw.pop("extra_compile_args",
+                            kw.pop("extra_cxx_cflags", []) or []))
+        return Extension(self.name or fallback_name,
+                         sources=self.sources, include_dirs=inc,
+                         extra_compile_args=["-std=c++17"] + extra,
+                         language="c++",
+                         **{k: v for k, v in kw.items()
+                            if not k.startswith("extra_")})
 
 
 CUDAExtension = CppExtension  # accepted for parity; no CUDA on TPU hosts
 
+_SETUPTOOLS_COMMANDS = {
+    "build", "build_ext", "bdist_wheel", "install", "develop", "sdist",
+    "editable_wheel", "egg_info", "clean",
+}
+
 
 def setup(name=None, ext_modules=None, **kwargs):
-    """Build-at-install parity: compiles each extension immediately and
-    drops the .so next to the build dir (a full setuptools flow is
-    unnecessary for the C-ABI contract)."""
+    """The reference setup contract: with a setuptools command on the
+    command line (``python setup.py install`` / ``bdist_wheel`` /
+    ``build_ext``) this drives a REAL setuptools build of the
+    extensions (framework + XLA FFI includes injected). Called without
+    a command (programmatically) it JIT-builds in place and returns
+    the CDLLs — the behavior scripts already rely on."""
     exts = ext_modules if isinstance(ext_modules, (list, tuple)) else \
         [ext_modules]
+    exts = [e for e in exts if e is not None]
+
+    if any(a in _SETUPTOOLS_COMMANDS for a in sys.argv[1:]):
+        import setuptools
+
+        st_exts = [
+            e.as_setuptools(f"{name or 'ext'}_{i}")
+            if isinstance(e, CppExtension) else e
+            for i, e in enumerate(exts)
+        ]
+        return setuptools.setup(name=name, ext_modules=st_exts,
+                                **kwargs)
+
     libs = []
     for i, ext in enumerate(exts):
-        if ext is None:
-            continue
         libs.append(load(f"{name or 'ext'}_{i}", ext.sources,
                          **{k: v for k, v in ext.kwargs.items()
                             if k.startswith("extra_")}))

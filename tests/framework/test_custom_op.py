@@ -1,0 +1,157 @@
+"""PD_BUILD_OP custom-op path: C++ against paddle_ext.h (XLA FFI) ->
+load_op -> Tensor callable, eager + jit + tape gradient (reference
+paddle/phi/api/ext/op_meta_info.h PD_BUILD_OP / PD_BUILD_GRAD_OP and
+test/custom_op/)."""
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+SRC = r"""
+#include "paddle_ext.h"
+
+// y = x^2 + 1 (elementwise), grad: dx = 2 x ct
+static ffi::Error SqPlusOne(ffi::Buffer<ffi::F32> x,
+                            ffi::ResultBuffer<ffi::F32> y) {
+  const float* in = x.typed_data();
+  float* out = y->typed_data();
+  for (size_t i = 0; i < x.element_count(); ++i)
+    out[i] = in[i] * in[i] + 1.0f;
+  return ffi::Error::Success();
+}
+PD_BUILD_OP(sq_plus_one, SqPlusOne,
+            ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::F32>>()
+                            .Ret<ffi::Buffer<ffi::F32>>());
+
+static ffi::Error SqPlusOneGrad(ffi::Buffer<ffi::F32> x,
+                                ffi::Buffer<ffi::F32> ct,
+                                ffi::ResultBuffer<ffi::F32> dx) {
+  const float* in = x.typed_data();
+  const float* c = ct.typed_data();
+  float* out = dx->typed_data();
+  for (size_t i = 0; i < x.element_count(); ++i)
+    out[i] = 2.0f * in[i] * c[i];
+  return ffi::Error::Success();
+}
+PD_BUILD_GRAD_OP(sq_plus_one, SqPlusOneGrad,
+                 ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::F32>>()
+                                 .Arg<ffi::Buffer<ffi::F32>>()
+                                 .Ret<ffi::Buffer<ffi::F32>>());
+
+// forward-only op: doubles the input
+static ffi::Error Dbl(ffi::Buffer<ffi::F32> x,
+                      ffi::ResultBuffer<ffi::F32> y) {
+  for (size_t i = 0; i < x.element_count(); ++i)
+    y->typed_data()[i] = 2.0f * x.typed_data()[i];
+  return ffi::Error::Success();
+}
+PD_BUILD_OP(dbl, Dbl, ffi::Ffi::Bind().Arg<ffi::Buffer<ffi::F32>>()
+                                      .Ret<ffi::Buffer<ffi::F32>>());
+"""
+
+
+@pytest.fixture(scope="module")
+def oplib():
+    if shutil.which("g++") is None or shutil.which("nm") is None:
+        pytest.skip("no toolchain")
+    if jax.default_backend() != "cpu":
+        pytest.skip("FFI handlers registered for the cpu platform")
+    d = tempfile.mkdtemp()
+    src = os.path.join(d, "ops.cc")
+    with open(src, "w") as f:
+        f.write(SRC)
+    return cpp_extension.load_op("test_custom_ops", [src],
+                                 build_directory=d)
+
+
+def test_discovers_ops(oplib):
+    assert oplib.op_names() == ["dbl", "sq_plus_one"]
+
+
+def test_forward_eager(oplib):
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    y = oplib.sq_plus_one(x)
+    np.testing.assert_allclose(np.asarray(y.numpy()), [2.0, 5.0, 10.0])
+    z = oplib.dbl(x)
+    np.testing.assert_allclose(np.asarray(z.numpy()), [2.0, 4.0, 6.0])
+
+
+def test_gradient_through_tape(oplib):
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = oplib.sq_plus_one(x)
+    (y * paddle.to_tensor(np.array([1.0, 10.0, 100.0],
+                                   np.float32))).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                               [2.0, 40.0, 600.0])
+
+
+def test_under_jit(oplib):
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(a):
+        spec = jax.ShapeDtypeStruct(a.shape, a.dtype)
+        return jax.ffi.ffi_call(oplib.sq_plus_one._ffi_target, spec)(a)
+
+    out = f(jnp.asarray([3.0], jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), [10.0])
+
+
+def test_compiled_train_step_uses_custom_op(oplib):
+    """The custom op composes into the whole-step jit (TrainStep)."""
+    from paddle_tpu import nn, optimizer
+
+    paddle.seed(0)
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return oplib.sq_plus_one(self.fc(x))
+
+    m = M()
+    opt = optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, opt,
+                                lambda mm, x: (mm(x) ** 2).mean())
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (8, 4)).astype("float32"))
+    losses = [float(np.asarray(step(x).numpy())) for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+
+def test_setup_programmatic_and_setuptools_paths(tmp_path):
+    if shutil.which("g++") is None:
+        pytest.skip("no toolchain")
+    src = tmp_path / "lib.cc"
+    src.write_text('extern "C" int forty_two() { return 42; }\n')
+    libs = cpp_extension.setup(
+        name="tiny", ext_modules=[cpp_extension.CppExtension([str(src)])])
+    assert libs[0].forty_two() == 42
+    # setuptools path: build_ext in a subprocess with a real setup.py
+    setup_py = tmp_path / "setup.py"
+    setup_py.write_text(
+        "from paddle_tpu.utils import cpp_extension\n"
+        "cpp_extension.setup(name='tinypkg', version='0.1',\n"
+        "    ext_modules=[cpp_extension.CppExtension(\n"
+        "        ['lib.cc'], name='tinyext')])\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        ["python", "setup.py", "build_ext", "--inplace"],
+        cwd=tmp_path, env=env, capture_output=True, text=True,
+        timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    built = list(tmp_path.glob("tinyext*.so"))
+    assert built, list(tmp_path.iterdir())
