@@ -12,8 +12,16 @@ from ..geometric import (  # noqa: F401,E402
 from ..geometric import (  # noqa: F401,E402
     reindex_graph as graph_reindex,
     sample_neighbors as graph_sample_neighbors,
-    send_u_recv as graph_send_recv,
 )
+from ..geometric import send_u_recv as _send_u_recv  # noqa: E402
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Legacy-name alias (reference incubate.graph_send_recv):
+    ``pool_type`` maps to geometric.send_u_recv's ``reduce_op``."""
+    return _send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                        out_size=out_size)
 from .. import inference  # noqa: F401,E402
 
 

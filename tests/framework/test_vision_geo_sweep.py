@@ -1,0 +1,314 @@
+"""Oracle sweep: vision.ops (NMS/ROI family vs manual references),
+vision.transforms, geometric message passing, incubate misc, device
+surface (reference test/legacy_test + test/vision discipline)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric, incubate
+from paddle_tpu.vision import ops as V
+from paddle_tpu.vision import transforms as TR
+
+R = np.random.default_rng(31)
+T = paddle.to_tensor
+
+
+def _iou(a, b):
+    x1 = max(a[0], b[0]); y1 = max(a[1], b[1])
+    x2 = min(a[2], b[2]); y2 = min(a[3], b[3])
+    inter = max(0, x2 - x1) * max(0, y2 - y1)
+    ar = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1])
+    return inter / (ar - inter + 1e-9)
+
+
+class TestVisionOps:
+    def test_nms_matches_manual(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                          [20, 20, 30, 30], [21, 21, 31, 31],
+                          [50, 50, 60, 60]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7, 0.95, 0.5], np.float32)
+        keep = np.asarray(V.nms(T(boxes), iou_threshold=0.3,
+                                scores=T(scores)).numpy())
+        # manual greedy NMS
+        order = np.argsort(-scores)
+        manual = []
+        for i in order:
+            if all(_iou(boxes[i], boxes[j]) <= 0.3 for j in manual):
+                manual.append(i)
+        np.testing.assert_array_equal(sorted(keep), sorted(manual))
+
+    def test_roi_align_and_pool_uniform_region(self):
+        # constant feature map: every pooled value equals the constant
+        x = np.full((1, 2, 16, 16), 3.0, np.float32)
+        boxes = np.array([[0.0, 0.0, 8.0, 8.0]], np.float32)
+        bn = np.array([1], np.int32)
+        out = np.asarray(V.roi_align(T(x), T(boxes), T(bn),
+                                     output_size=4).numpy())
+        assert out.shape == (1, 2, 4, 4)
+        np.testing.assert_allclose(out, 3.0, rtol=1e-5)
+        out = np.asarray(V.roi_pool(T(x), T(boxes), T(bn),
+                                    output_size=2).numpy())
+        np.testing.assert_allclose(out, 3.0, rtol=1e-5)
+        ps = np.asarray(V.psroi_pool(T(np.full((1, 8, 8, 8), 2.0,
+                                               np.float32)),
+                                     T(boxes), T(bn), 2).numpy())
+        np.testing.assert_allclose(ps, 2.0, rtol=1e-5)
+
+    def test_box_coder_roundtrip(self):
+        prior = np.array([[10., 10., 20., 20.]], np.float32)
+        var = np.array([[0.1, 0.1, 0.2, 0.2]], np.float32)
+        target = np.array([[12., 11., 22., 21.]], np.float32)
+        enc = V.box_coder(T(prior), T(var), T(target),
+                          code_type="encode_center_size")
+        dec = V.box_coder(T(prior), T(var),
+                          paddle.reshape(enc, [1, 1, 4]),
+                          code_type="decode_center_size")
+        np.testing.assert_allclose(np.asarray(dec.numpy())[0], target,
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_deform_conv2d_zero_offset_equals_conv(self):
+        import paddle_tpu.nn.functional as F
+        x = R.standard_normal((1, 3, 8, 8)).astype("float32")
+        w = R.standard_normal((4, 3, 3, 3)).astype("float32")
+        off = np.zeros((1, 18, 6, 6), np.float32)
+        got = np.asarray(V.deform_conv2d(T(x), T(off), T(w)).numpy())
+        ref = np.asarray(F.conv2d(T(x), T(w)).numpy())
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_yolo_box_and_prior_box_shapes(self):
+        xin = R.standard_normal((1, 3 * 7, 4, 4)).astype("float32")
+        boxes, scores = V.yolo_box(T(xin), T(np.array([[32, 32]],
+                                               np.int32)),
+                                   anchors=[10, 13, 16, 30, 33, 23],
+                                   class_num=2)
+        assert boxes.shape[0] == 1 and boxes.shape[-1] == 4
+        pb, pbv = V.prior_box(T(R.standard_normal((1, 3, 4, 4))
+                                .astype("float32")),
+                              T(R.standard_normal((1, 3, 32, 32))
+                                .astype("float32")),
+                              min_sizes=[8.0])
+        assert pb.shape[-1] == 4 and pbv.shape == pb.shape
+
+    def test_fpn_and_proposals(self):
+        rois = np.array([[0, 0, 10, 10], [0, 0, 100, 100],
+                         [5, 5, 200, 200]], np.float32)
+        outs = V.distribute_fpn_proposals(T(rois), 2, 4, 3, 224)
+        multi_rois = outs[0]
+        assert sum(int(r.shape[0]) for r in multi_rois) == 3
+        sc = R.uniform(0, 1, (1, 3, 8, 8)).astype("float32")
+        deltas = (R.standard_normal((1, 12, 8, 8)) * 0.1).astype(
+            "float32")
+        anchors = R.uniform(0, 32, (8, 8, 3, 4)).astype("float32")
+        vari = np.full((8, 8, 3, 4), 0.1, np.float32)
+        rois_out, rscores = V.generate_proposals(
+            T(sc), T(deltas), T(np.array([[64.0, 64.0]], np.float32)),
+            T(anchors), T(vari), pre_nms_top_n=50, post_nms_top_n=10)
+        assert rois_out.shape[-1] == 4
+
+    def test_matrix_nms(self):
+        bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                            [50, 50, 60, 60]]], np.float32)
+        scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+        out = V.matrix_nms(T(bboxes), T(scores), score_threshold=0.1)
+        first = out[0] if isinstance(out, (list, tuple)) else out
+        assert np.asarray(first.numpy()).shape[-1] == 6
+
+
+class TestTransforms:
+    def test_functional_transforms_oracles(self):
+        # HWC ndarray layout (the transforms' canonical input, matching
+        # the reference's PIL/ndarray contract)
+        img = R.uniform(0, 1, (8, 8, 3)).astype("float32")
+        np.testing.assert_allclose(np.asarray(TR.hflip(img)),
+                                   img[:, ::-1, :])
+        np.testing.assert_allclose(np.asarray(TR.vflip(img)),
+                                   img[::-1, :, :])
+        c = np.asarray(TR.crop(img, 2, 1, 4, 5))
+        np.testing.assert_allclose(c, img[2:6, 1:6, :])
+        cc = np.asarray(TR.center_crop(img, 4))
+        np.testing.assert_allclose(cc, img[2:6, 2:6, :])
+        br = np.asarray(TR.adjust_brightness(img, 0.5))
+        np.testing.assert_allclose(br, img * 0.5, rtol=1e-5, atol=1e-6)
+        gs = np.asarray(TR.to_grayscale(img))
+        assert gs.shape[-1] == 1
+        chw = np.ascontiguousarray(img.transpose(2, 0, 1))
+        er = np.asarray(TR.erase(T(chw), 1, 1, 3, 3,
+                                 v=paddle.zeros([3, 3, 3])._data)
+                        .numpy())
+        assert np.allclose(er[:, 1:4, 1:4], 0.0)
+        rot = np.asarray(TR.rotate(img, 90.0))
+        assert rot.shape[:2] == (8, 8)
+        rs = np.asarray(TR.resize(img, [16, 16]))
+        assert rs.shape[:2] == (16, 16)
+        af = np.asarray(TR.affine(img, 0.0, [0, 0], 1.0, [0.0, 0.0]))
+        np.testing.assert_allclose(af, img, atol=1e-5)
+        pp = TR.perspective(img, [[0, 0], [7, 0], [7, 7], [0, 7]],
+                            [[0, 0], [7, 0], [7, 7], [0, 7]])
+        assert np.asarray(pp).shape == img.shape
+        ah = np.asarray(TR.adjust_hue(img, 0.0))
+        np.testing.assert_allclose(ah, img, atol=1e-5)
+        ac = np.asarray(TR.adjust_contrast(img, 1.0))
+        np.testing.assert_allclose(ac, img, atol=1e-5)
+
+    def test_transform_classes_compose(self):
+        paddle.seed(0)
+        img = R.uniform(0, 1, (16, 16, 3)).astype("float32")
+        pipeline = TR.Compose([
+            TR.Resize([20, 20]),
+            TR.CenterCrop(16),
+            TR.RandomHorizontalFlip(0.5),
+            TR.RandomVerticalFlip(0.5),
+            TR.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5],
+                         data_format="HWC"),
+        ])
+        out = np.asarray(pipeline(img))
+        assert out.shape == (16, 16, 3)
+        for cls, args in [
+            (TR.BrightnessTransform, (0.4,)),
+            (TR.ContrastTransform, (0.4,)),
+            (TR.SaturationTransform, (0.4,)),
+            (TR.HueTransform, (0.2,)),
+            (TR.ColorJitter, (0.2, 0.2, 0.2, 0.1)),
+            (TR.Grayscale, ()),
+            (TR.RandomCrop, (12,)),
+            (TR.RandomResizedCrop, (12,)),
+            (TR.RandomRotation, (10,)),
+            (TR.RandomAffine, (10,)),
+            (TR.RandomPerspective, ()),
+            (TR.RandomErasing, ()),
+            (TR.Pad, (2,)),
+            (TR.Transpose, ()),
+        ]:
+            tr = cls(*args)
+            res = tr(img)
+            assert res is not None, cls.__name__
+
+
+class TestGeometric:
+    def test_send_recv_and_segment(self):
+        x = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32)
+        src = np.array([0, 1, 2, 0], np.int64)
+        dst = np.array([1, 2, 0, 2], np.int64)
+        out = geometric.send_u_recv(T(x), T(src), T(dst),
+                                    reduce_op="sum")
+        ref = np.zeros_like(x)
+        for s, d in zip(src, dst):
+            ref[d] += x[s]
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref)
+        seg = geometric.segment_min(
+            T(np.array([3.0, 1.0, 2.0, 5.0], np.float32)),
+            T(np.array([0, 0, 1, 1], np.int64)))
+        np.testing.assert_allclose(np.asarray(seg.numpy()), [1.0, 2.0])
+        suv = geometric.send_uv(T(x), T(x * 2), T(src), T(dst),
+                                message_op="add")
+        assert suv.shape == [4, 2]
+        uer = geometric.send_ue_recv(T(x), T(np.ones((4, 2),
+                                               np.float32)),
+                                     T(src), T(dst), message_op="add",
+                                     reduce_op="sum")
+        assert uer.shape == [3, 2]
+
+    def test_reindex_and_sampling(self):
+        paddle.seed(0)
+        # graph: row=[0,0,1,2], colptr per node
+        row = np.array([1, 2, 2, 0], np.int64)
+        colptr = np.array([0, 2, 3, 4], np.int64)
+        nodes = np.array([0, 1], np.int64)
+        out = geometric.sample_neighbors(T(row), T(colptr), T(nodes),
+                                         sample_size=2)
+        assert len(out) >= 2
+        x = np.array([5, 9], np.int64)
+        neighbors = np.array([9, 7, 5], np.int64)
+        count = np.array([2, 1], np.int64)  # neighbors per x node
+        re_x, re_n, out_nodes = geometric.reindex_graph(
+            T(x), T(neighbors), T(count))
+        assert int(np.asarray(re_n.numpy()).max()) < \
+            len(np.asarray(out_nodes.numpy()))
+        wr = geometric.weighted_sample_neighbors(
+            T(row), T(colptr), T(nodes),
+            T(np.array([1.0, 1.0, 1.0, 1.0], np.float32)),
+            sample_size=1)
+        assert len(wr) >= 2
+        rh = geometric.reindex_heter_graph(
+            T(x), [T(neighbors)], [T(count)])
+        assert rh is not None
+
+
+class TestIncubateMisc:
+    def test_identity_loss_and_segment(self):
+        x = T(np.array([1.0, 2.0, 3.0], np.float32))
+        np.testing.assert_allclose(
+            float(incubate.identity_loss(x, reduction="mean")), 2.0,
+            rtol=1e-6)
+        s = incubate.segment_min(
+            T(np.array([3.0, 1.0, 2.0], np.float32)),
+            T(np.array([0, 0, 1], np.int64)))
+        np.testing.assert_allclose(np.asarray(s.numpy()), [1.0, 2.0])
+
+    def test_softmax_mask_fuse(self):
+        x = R.standard_normal((1, 1, 4, 4)).astype("float32")
+        mask = np.zeros((1, 1, 4, 4), np.float32)
+        out = np.asarray(incubate.softmax_mask_fuse(T(x),
+                                                    T(mask)).numpy())
+        import scipy.special as sps
+        np.testing.assert_allclose(out, sps.softmax(x, -1), rtol=1e-5)
+        up = np.asarray(
+            incubate.softmax_mask_fuse_upper_triangle(T(x)).numpy())
+        # causal: strictly-upper entries get ~0 probability
+        assert up[0, 0, 0, 1] < 1e-6
+        np.testing.assert_allclose(up.sum(-1), 1.0, rtol=1e-5)
+
+    def test_graph_helpers(self):
+        paddle.seed(0)
+        row = np.array([1, 2, 2, 0], np.int64)
+        colptr = np.array([0, 2, 3, 4], np.int64)
+        nodes = np.array([0], np.int64)
+        out = incubate.graph_sample_neighbors(T(row), T(colptr),
+                                              T(nodes), sample_size=1)
+        assert out is not None
+        gsr = incubate.graph_send_recv(
+            T(np.eye(3, dtype=np.float32)),
+            T(np.array([0, 1], np.int64)),
+            T(np.array([1, 2], np.int64)), pool_type="sum")
+        assert gsr.shape == [3, 3]
+        ks = incubate.graph_khop_sampler(T(row), T(colptr), T(nodes),
+                                         sample_sizes=[1])
+        assert ks is not None
+        x = np.array([5, 9], np.int64)
+        ri = incubate.graph_reindex(
+            T(x), T(np.array([9, 5], np.int64)),
+            T(np.array([1, 1], np.int64)))
+        assert ri is not None
+
+    def test_model_average_exists(self):
+        from paddle_tpu import nn, optimizer
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+        ma = incubate.ModelAverage(0.15, parameters=lin.parameters())
+        x = T(R.standard_normal((2, 4)).astype("float32"))
+        (lin(x) ** 2).mean().backward()
+        ma.step()
+        ma.clear_grad()
+
+
+class TestDeviceSurface:
+    def test_device_queries(self):
+        import paddle_tpu.device as dev
+        assert isinstance(dev.get_device(), str)
+        assert isinstance(dev.get_all_device_type(), list)
+        assert isinstance(dev.get_all_custom_device_type(), list)
+        assert dev.is_compiled_with_cinn() in (True, False)
+        assert dev.is_compiled_with_cuda() in (True, False)
+        assert dev.is_compiled_with_rocm() in (True, False)
+        assert dev.is_compiled_with_xpu() in (True, False)
+        assert dev.is_compiled_with_custom_device("npu") in (True,
+                                                            False)
+        assert dev.is_compiled_with_distribute() in (True, False)
+        dev.synchronize()
+        s = dev.Stream()
+        with dev.stream_guard(s):
+            pass
+        e = dev.Event()
+        e.record(s)
+        paddle.device.set_device("cpu")
