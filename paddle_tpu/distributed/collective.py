@@ -171,9 +171,17 @@ alltoall = all_to_all  # legacy name (reference c_ops alltoall)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    # single-controller: every device computes the same program; broadcast
-    # of a replicated value is identity. In-trace from a sharded source we
-    # select src's shard.
+    """Inside shard_map: every rank takes src's shard (all_gather +
+    static index — XLA turns this into the broadcast collective).
+    Single-controller eager: a replicated value is already broadcast —
+    identity."""
+    g = _group(group)
+    if _in_shard_map(g.axis_name):
+        def fn(a):
+            return lax.all_gather(a, g.axis_name)[src]
+        out = apply(fn, tensor, name="broadcast")
+        from ..ops import _inplace_from
+        return _inplace_from(tensor, out)
     return tensor
 
 
@@ -182,14 +190,40 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    if tensor_list:
-        return tensor_list[0]
-    return tensor
+    """Inside shard_map: rank r receives src's ``tensor_list[r]``."""
+    g = _group(group)
+    if tensor_list is None:
+        return tensor
+    from .. import ops
+    if _in_shard_map(g.axis_name):
+        stacked = ops.stack(list(tensor_list), axis=0)  # [n, ...]
+
+        def fn(a):
+            gathered = lax.all_gather(a, g.axis_name)  # [ranks, n, ...]
+            r = lax.axis_index(g.axis_name)
+            return gathered[src][r]
+        out = apply(fn, stacked, name="scatter")
+        if tensor is not None:
+            from ..ops import _inplace_from
+            return _inplace_from(tensor, out)
+        return out
+    return tensor_list[0]
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Inside shard_map: dst receives every rank's value (computed on
+    all ranks — XLA's gather is an all_gather on a lockstep mesh)."""
+    g = _group(group)
+    if _in_shard_map(g.axis_name):
+        def fn(a):
+            return lax.all_gather(a, g.axis_name)
+        gathered = apply(fn, tensor, name="gather")
+        if gather_list is not None:
+            from .. import ops
+            gather_list.extend(ops.unbind(gathered, axis=0))
+        return gathered
     if gather_list is not None:
-        gather_list.extend([tensor] * _group(group).nranks)
+        gather_list.extend([tensor] * g.nranks)
     return tensor
 
 

@@ -155,7 +155,10 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
         from jax import lax
 
         def fn(a):
-            parts = jnp.reshape(a, (g.nranks, -1) + a.shape[1:])
+            # axis size from the trace (the group may span a sub-mesh of
+            # the world, e.g. a 4-device axis on an 8-device host)
+            n = lax.axis_size(g.axis_name)
+            parts = jnp.reshape(a, (n, -1) + a.shape[1:])
             return lax.all_to_all(parts, g.axis_name, 0, 0,
                                   tiled=False).reshape(a.shape)
         out = apply(fn, in_tensor, name="alltoall_single")
