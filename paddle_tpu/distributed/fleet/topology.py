@@ -41,6 +41,25 @@ class CommunicateTopology:
         return tuple(int(c) for c in
                      np.unravel_index(rank, self._dims))
 
+    def get_dim_size(self, axis_name):
+        return self.get_dim(axis_name)
+
+    def get_comm_list(self, axis_name):
+        """Peer-rank groups along ``axis_name`` (reference
+        topology.py:120 get_comm_list): one list per combination of the
+        OTHER axes' coordinates; together they partition the world."""
+        ax = self._names.index(axis_name)
+        ids = np.arange(self.world_size()).reshape(self._dims)
+        moved = np.moveaxis(ids, ax, -1).reshape(-1, self._dims[ax])
+        return [list(map(int, row)) for row in moved]
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        """Rank with the same coords as ``global_rank`` except the axes
+        overridden in kwargs (reference get_rank_from_stage)."""
+        coord = dict(zip(self._names, self.get_coord(global_rank)))
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
 
 class HybridCommunicateGroup:
     def __init__(self, topology: CommunicateTopology):
@@ -58,6 +77,10 @@ class HybridCommunicateGroup:
     @property
     def mesh(self):
         return self._mesh
+
+    @property
+    def nranks(self):
+        return self._topo.world_size()
 
     def topology(self):
         return self._topo

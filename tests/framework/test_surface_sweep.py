@@ -1,0 +1,193 @@
+"""Surface sweep: fleet topology, profiler scheduler/export, autograd
+PyLayer/hooks, amp decorate/auto_cast leftovers, jit aliases, static
+working subset (reference fleet/base/topology.py, profiler.py,
+autograd/py_layer tests)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import amp, autograd, optimizer, profiler, static
+from paddle_tpu.distributed import fleet
+
+T = paddle.to_tensor
+
+
+class TestFleetTopology:
+    def test_communicate_topology_coords(self):
+        topo = fleet.CommunicateTopology(["data", "pipe", "model"],
+                                         [2, 2, 2])
+        assert topo.world_size() == 8
+        assert topo.get_hybrid_group_names() == ["data", "pipe",
+                                                 "model"]
+        assert topo.get_dim("model") == 2
+        # rank <-> coordinate round trip
+        for r in range(8):
+            c = topo.get_coord(r)
+            assert topo.get_rank(data=c[0], pipe=c[1],
+                                 model=c[2]) == r
+        assert topo.get_dim_size("data") == 2
+        assert topo.get_rank_from_stage(0, model=1) == 1
+        # axis peer groups partition the world
+        groups = topo.get_comm_list("model")
+        flat = sorted(x for g in groups for x in g)
+        assert flat == list(range(8))
+
+    def test_hybrid_communicate_group(self):
+        import paddle_tpu.distributed as dist
+        topo = fleet.CommunicateTopology(["data", "pipe", "sharding",
+                                          "sep", "model"],
+                                         [2, 1, 1, 1, 2])
+        hcg = fleet.HybridCommunicateGroup(topo)
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_model_parallel_world_size() == 2
+        assert hcg.nranks == 4
+
+    def test_distributed_strategy_and_role(self):
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                            "pp_degree": 1}
+        assert s.hybrid_configs["dp_degree"] == 2
+        assert fleet.Role.WORKER is not None
+        u = fleet.UtilBase() if callable(fleet.UtilBase) else None
+        assert u is not None or fleet.UtilBase is not None
+
+
+class TestProfilerSurface:
+    def test_scheduler_states(self):
+        sch = profiler.make_scheduler(closed=1, ready=1, record=2,
+                                      repeat=1)
+        states = [sch(i) for i in range(4)]
+        assert states[0] == profiler.ProfilerState.CLOSED
+        assert states[1] == profiler.ProfilerState.READY
+        assert profiler.ProfilerState.RECORD in states[2:]
+
+    def test_profile_and_exports(self):
+        d = tempfile.mkdtemp()
+        with profiler.Profiler(
+                targets=[profiler.ProfilerTarget.CPU],
+                scheduler=(0, 2),
+                on_trace_ready=profiler.export_chrome_tracing(d)) as p:
+            for _ in range(3):
+                x = paddle.randn([8, 8])
+                (x @ x).sum()
+                p.step()
+        files = os.listdir(d)
+        assert files, "chrome trace not exported"
+        assert profiler.SortedKeys.CPUTotal is not None
+        assert profiler.SummaryView is not None
+
+
+class TestAutogradSurface:
+    def test_pylayer_custom_fwd_bwd(self):
+        class Cube(autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return 3.0 * x * x * grad
+
+        x = T(np.array([2.0], np.float32), stop_gradient=False)
+        y = Cube.apply(x)
+        y.backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), [12.0])
+
+    def test_autograd_backward_fn(self):
+        x = T(np.array([3.0], np.float32), stop_gradient=False)
+        y = (x * x).sum()
+        autograd.backward([y])
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), [6.0])
+
+    def test_saved_tensors_hooks(self):
+        packed = []
+
+        def pack(t):
+            packed.append(t)
+            return t
+
+        def unpack(t):
+            return t
+
+        with autograd.saved_tensors_hooks(pack, unpack):
+            x = T(np.ones(3, np.float32), stop_gradient=False)
+            y = (x * x).sum()
+        y.backward()
+        assert x.grad is not None
+
+
+class TestAmpSurface:
+    def test_auto_cast_and_decorate(self):
+        lin = nn.Linear(8, 8)
+        with amp.auto_cast(enable=True, dtype="bfloat16"):
+            out = lin(T(np.ones((2, 8), np.float32)))
+        assert out is not None
+        models, opts = amp.decorate(
+            models=lin, optimizers=optimizer.SGD(
+                learning_rate=0.1, parameters=lin.parameters()),
+            level="O2", dtype="bfloat16")
+        assert str(models.weight.dtype).endswith("bfloat16")
+        assert amp.is_bfloat16_supported() in (True, False)
+        assert amp.is_float16_supported() in (True, False)
+
+
+class TestStaticWorkingSubset:
+    def test_working_names(self):
+        x = static.data("x", [None, 4], "float32")
+        assert x is not None
+        w = static.create_global_var([4, 1], 0.5, "float32")
+        np.testing.assert_allclose(np.asarray(w.numpy()),
+                                   np.full((4, 1), 0.5))
+        scope = static.global_scope()
+        assert scope is not None
+        with static.scope_guard(scope):
+            pass
+        with static.name_scope("blk"):
+            pass
+        with static.device_guard("cpu"):
+            pass
+
+    def test_migration_stubs_raise_with_pointer(self):
+        # Program/program_guard are documented migration stubs: they
+        # must raise, loudly, not half-work
+        with pytest.raises(NotImplementedError):
+            static.Program()
+        with pytest.raises(NotImplementedError):
+            static.program_guard(None)
+        with pytest.raises(NotImplementedError):
+            paddle.enable_static()
+
+    def test_cpu_places(self):
+        places = static.cpu_places(2)
+        assert len(places) == 2
+
+
+class TestJitAliases:
+    def test_not_to_static_passthrough(self):
+        @paddle.jit.not_to_static
+        def f(x):
+            return x * 2
+
+        out = f(T(np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [2.0, 2.0])
+
+    def test_translated_layer_roundtrip(self, tmp_path):
+        lin = nn.Linear(4, 2)
+        lin.eval()
+        path = str(tmp_path / "m")
+        paddle.jit.save(lin, path,
+                        input_spec=[paddle.static.InputSpec(
+                            [None, 4], "float32")])
+        loaded = paddle.jit.load(path)
+        assert isinstance(loaded, paddle.jit.TranslatedLayer)
+        x = T(np.ones((3, 4), np.float32))
+        np.testing.assert_allclose(np.asarray(loaded(x).numpy()),
+                                   np.asarray(lin(x).numpy()),
+                                   rtol=1e-5, atol=1e-6)
