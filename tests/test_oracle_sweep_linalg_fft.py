@@ -174,8 +174,8 @@ def test_rfft_family():
         atol=1e-4)
     np.testing.assert_allclose(
         np.asarray(paddle.fft.irfftn(T(c2), s=(4, 16)).numpy()),
-        np.fft.irfftn(c2, s=(4, 16)).astype("float32"), rtol=1e-4,
-        atol=1e-4)
+        np.fft.irfftn(c2, s=(4, 16), axes=(0, 1)).astype("float32"),
+        rtol=1e-4, atol=1e-4)
 
 
 def test_fft_helpers():
