@@ -7,8 +7,7 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import geometric, incubate
-from paddle_tpu.vision import ops as V
-from paddle_tpu.vision import transforms as TR
+from paddle_tpu import vision
 
 R = np.random.default_rng(31)
 T = paddle.to_tensor
@@ -28,7 +27,7 @@ class TestVisionOps:
                           [20, 20, 30, 30], [21, 21, 31, 31],
                           [50, 50, 60, 60]], np.float32)
         scores = np.array([0.9, 0.8, 0.7, 0.95, 0.5], np.float32)
-        keep = np.asarray(V.nms(T(boxes), iou_threshold=0.3,
+        keep = np.asarray(vision.ops.nms(T(boxes), iou_threshold=0.3,
                                 scores=T(scores)).numpy())
         # manual greedy NMS
         order = np.argsort(-scores)
@@ -43,14 +42,14 @@ class TestVisionOps:
         x = np.full((1, 2, 16, 16), 3.0, np.float32)
         boxes = np.array([[0.0, 0.0, 8.0, 8.0]], np.float32)
         bn = np.array([1], np.int32)
-        out = np.asarray(V.roi_align(T(x), T(boxes), T(bn),
+        out = np.asarray(vision.ops.roi_align(T(x), T(boxes), T(bn),
                                      output_size=4).numpy())
         assert out.shape == (1, 2, 4, 4)
         np.testing.assert_allclose(out, 3.0, rtol=1e-5)
-        out = np.asarray(V.roi_pool(T(x), T(boxes), T(bn),
+        out = np.asarray(vision.ops.roi_pool(T(x), T(boxes), T(bn),
                                     output_size=2).numpy())
         np.testing.assert_allclose(out, 3.0, rtol=1e-5)
-        ps = np.asarray(V.psroi_pool(T(np.full((1, 8, 8, 8), 2.0,
+        ps = np.asarray(vision.ops.psroi_pool(T(np.full((1, 8, 8, 8), 2.0,
                                                np.float32)),
                                      T(boxes), T(bn), 2).numpy())
         np.testing.assert_allclose(ps, 2.0, rtol=1e-5)
@@ -59,9 +58,9 @@ class TestVisionOps:
         prior = np.array([[10., 10., 20., 20.]], np.float32)
         var = np.array([[0.1, 0.1, 0.2, 0.2]], np.float32)
         target = np.array([[12., 11., 22., 21.]], np.float32)
-        enc = V.box_coder(T(prior), T(var), T(target),
+        enc = vision.ops.box_coder(T(prior), T(var), T(target),
                           code_type="encode_center_size")
-        dec = V.box_coder(T(prior), T(var),
+        dec = vision.ops.box_coder(T(prior), T(var),
                           paddle.reshape(enc, [1, 1, 4]),
                           code_type="decode_center_size")
         np.testing.assert_allclose(np.asarray(dec.numpy())[0], target,
@@ -72,18 +71,18 @@ class TestVisionOps:
         x = R.standard_normal((1, 3, 8, 8)).astype("float32")
         w = R.standard_normal((4, 3, 3, 3)).astype("float32")
         off = np.zeros((1, 18, 6, 6), np.float32)
-        got = np.asarray(V.deform_conv2d(T(x), T(off), T(w)).numpy())
+        got = np.asarray(vision.ops.deform_conv2d(T(x), T(off), T(w)).numpy())
         ref = np.asarray(F.conv2d(T(x), T(w)).numpy())
         np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
 
     def test_yolo_box_and_prior_box_shapes(self):
         xin = R.standard_normal((1, 3 * 7, 4, 4)).astype("float32")
-        boxes, scores = V.yolo_box(T(xin), T(np.array([[32, 32]],
+        boxes, scores = vision.ops.yolo_box(T(xin), T(np.array([[32, 32]],
                                                np.int32)),
                                    anchors=[10, 13, 16, 30, 33, 23],
                                    class_num=2)
         assert boxes.shape[0] == 1 and boxes.shape[-1] == 4
-        pb, pbv = V.prior_box(T(R.standard_normal((1, 3, 4, 4))
+        pb, pbv = vision.ops.prior_box(T(R.standard_normal((1, 3, 4, 4))
                                 .astype("float32")),
                               T(R.standard_normal((1, 3, 32, 32))
                                 .astype("float32")),
@@ -93,7 +92,7 @@ class TestVisionOps:
     def test_fpn_and_proposals(self):
         rois = np.array([[0, 0, 10, 10], [0, 0, 100, 100],
                          [5, 5, 200, 200]], np.float32)
-        outs = V.distribute_fpn_proposals(T(rois), 2, 4, 3, 224)
+        outs = vision.ops.distribute_fpn_proposals(T(rois), 2, 4, 3, 224)
         multi_rois = outs[0]
         assert sum(int(r.shape[0]) for r in multi_rois) == 3
         sc = R.uniform(0, 1, (1, 3, 8, 8)).astype("float32")
@@ -101,7 +100,7 @@ class TestVisionOps:
             "float32")
         anchors = R.uniform(0, 32, (8, 8, 3, 4)).astype("float32")
         vari = np.full((8, 8, 3, 4), 0.1, np.float32)
-        rois_out, rscores = V.generate_proposals(
+        rois_out, rscores = vision.ops.generate_proposals(
             T(sc), T(deltas), T(np.array([[64.0, 64.0]], np.float32)),
             T(anchors), T(vari), pre_nms_top_n=50, post_nms_top_n=10)
         assert rois_out.shape[-1] == 4
@@ -110,7 +109,7 @@ class TestVisionOps:
         bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
                             [50, 50, 60, 60]]], np.float32)
         scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
-        out = V.matrix_nms(T(bboxes), T(scores), score_threshold=0.1)
+        out = vision.ops.matrix_nms(T(bboxes), T(scores), score_threshold=0.1)
         first = out[0] if isinstance(out, (list, tuple)) else out
         assert np.asarray(first.numpy()).shape[-1] == 6
 
@@ -120,65 +119,65 @@ class TestTransforms:
         # HWC ndarray layout (the transforms' canonical input, matching
         # the reference's PIL/ndarray contract)
         img = R.uniform(0, 1, (8, 8, 3)).astype("float32")
-        np.testing.assert_allclose(np.asarray(TR.hflip(img)),
+        np.testing.assert_allclose(np.asarray(vision.transforms.hflip(img)),
                                    img[:, ::-1, :])
-        np.testing.assert_allclose(np.asarray(TR.vflip(img)),
+        np.testing.assert_allclose(np.asarray(vision.transforms.vflip(img)),
                                    img[::-1, :, :])
-        c = np.asarray(TR.crop(img, 2, 1, 4, 5))
+        c = np.asarray(vision.transforms.crop(img, 2, 1, 4, 5))
         np.testing.assert_allclose(c, img[2:6, 1:6, :])
-        cc = np.asarray(TR.center_crop(img, 4))
+        cc = np.asarray(vision.transforms.center_crop(img, 4))
         np.testing.assert_allclose(cc, img[2:6, 2:6, :])
-        br = np.asarray(TR.adjust_brightness(img, 0.5))
+        br = np.asarray(vision.transforms.adjust_brightness(img, 0.5))
         np.testing.assert_allclose(br, img * 0.5, rtol=1e-5, atol=1e-6)
-        gs = np.asarray(TR.to_grayscale(img))
+        gs = np.asarray(vision.transforms.to_grayscale(img))
         assert gs.shape[-1] == 1
         chw = np.ascontiguousarray(img.transpose(2, 0, 1))
-        er = np.asarray(TR.erase(T(chw), 1, 1, 3, 3,
+        er = np.asarray(vision.transforms.erase(T(chw), 1, 1, 3, 3,
                                  v=paddle.zeros([3, 3, 3])._data)
                         .numpy())
         assert np.allclose(er[:, 1:4, 1:4], 0.0)
-        rot = np.asarray(TR.rotate(img, 90.0))
+        rot = np.asarray(vision.transforms.rotate(img, 90.0))
         assert rot.shape[:2] == (8, 8)
-        rs = np.asarray(TR.resize(img, [16, 16]))
+        rs = np.asarray(vision.transforms.resize(img, [16, 16]))
         assert rs.shape[:2] == (16, 16)
-        af = np.asarray(TR.affine(img, 0.0, [0, 0], 1.0, [0.0, 0.0]))
+        af = np.asarray(vision.transforms.affine(img, 0.0, [0, 0], 1.0, [0.0, 0.0]))
         np.testing.assert_allclose(af, img, atol=1e-5)
-        pp = TR.perspective(img, [[0, 0], [7, 0], [7, 7], [0, 7]],
+        pp = vision.transforms.perspective(img, [[0, 0], [7, 0], [7, 7], [0, 7]],
                             [[0, 0], [7, 0], [7, 7], [0, 7]])
         assert np.asarray(pp).shape == img.shape
-        ah = np.asarray(TR.adjust_hue(img, 0.0))
+        ah = np.asarray(vision.transforms.adjust_hue(img, 0.0))
         np.testing.assert_allclose(ah, img, atol=1e-5)
-        ac = np.asarray(TR.adjust_contrast(img, 1.0))
+        ac = np.asarray(vision.transforms.adjust_contrast(img, 1.0))
         np.testing.assert_allclose(ac, img, atol=1e-5)
 
     def test_transform_classes_compose(self):
         paddle.seed(0)
         img = R.uniform(0, 1, (16, 16, 3)).astype("float32")
-        pipeline = TR.Compose([
-            TR.Resize([20, 20]),
-            TR.CenterCrop(16),
-            TR.RandomHorizontalFlip(0.5),
-            TR.RandomVerticalFlip(0.5),
-            TR.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5],
+        pipeline = vision.transforms.Compose([
+            vision.transforms.Resize([20, 20]),
+            vision.transforms.CenterCrop(16),
+            vision.transforms.RandomHorizontalFlip(0.5),
+            vision.transforms.RandomVerticalFlip(0.5),
+            vision.transforms.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5],
                          data_format="HWC"),
         ])
         out = np.asarray(pipeline(img))
         assert out.shape == (16, 16, 3)
         for cls, args in [
-            (TR.BrightnessTransform, (0.4,)),
-            (TR.ContrastTransform, (0.4,)),
-            (TR.SaturationTransform, (0.4,)),
-            (TR.HueTransform, (0.2,)),
-            (TR.ColorJitter, (0.2, 0.2, 0.2, 0.1)),
-            (TR.Grayscale, ()),
-            (TR.RandomCrop, (12,)),
-            (TR.RandomResizedCrop, (12,)),
-            (TR.RandomRotation, (10,)),
-            (TR.RandomAffine, (10,)),
-            (TR.RandomPerspective, ()),
-            (TR.RandomErasing, ()),
-            (TR.Pad, (2,)),
-            (TR.Transpose, ()),
+            (vision.transforms.BrightnessTransform, (0.4,)),
+            (vision.transforms.ContrastTransform, (0.4,)),
+            (vision.transforms.SaturationTransform, (0.4,)),
+            (vision.transforms.HueTransform, (0.2,)),
+            (vision.transforms.ColorJitter, (0.2, 0.2, 0.2, 0.1)),
+            (vision.transforms.Grayscale, ()),
+            (vision.transforms.RandomCrop, (12,)),
+            (vision.transforms.RandomResizedCrop, (12,)),
+            (vision.transforms.RandomRotation, (10,)),
+            (vision.transforms.RandomAffine, (10,)),
+            (vision.transforms.RandomPerspective, ()),
+            (vision.transforms.RandomErasing, ()),
+            (vision.transforms.Pad, (2,)),
+            (vision.transforms.Transpose, ()),
         ]:
             tr = cls(*args)
             res = tr(img)
