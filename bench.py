@@ -412,40 +412,147 @@ def _try(fn, *args, **kwargs):
                 "skipped": f"{type(e).__name__}: {e}"[:300]}
 
 
-def _backend_or_cpu_fallback(timeout_s=180):
-    """Resolve the backend with a timeout: a wedged TPU tunnel must not
-    hang the driver's bench run forever. On timeout, force the CPU
-    backend so a parseable (clearly-marked) smoke line still prints."""
-    import threading
+def _tpu_rung_specs():
+    """Ordered (name, thunk) list for the TPU ladder. Called inside the
+    per-rung CHILD process (run_rung) — each rung gets the chip and its
+    HBM to itself; in-process sequencing left earlier rungs' models
+    resident and RESOURCE_EXHAUSTED'd everything after the 770M rung."""
+    from paddle_tpu.models import GPTConfig, LlamaConfig
+    from paddle_tpu.vision.models import vit_l_16
 
-    result = {}
+    fp8_cfg = GPTConfig.gpt2_medium()
+    fp8_cfg.use_fp8 = True
+    return [
+        ("head", lambda: bench_gpt_train(GPTConfig.gpt2_medium(), 8, 1024,
+                                         20, "gpt2_345m")),
+        ("gpt_345m_fp8_train",
+         lambda: bench_gpt_train(fp8_cfg, 8, 1024, 10, "gpt2_345m_fp8")),
+        ("gpt_770m_train",
+         lambda: bench_gpt_train(GPTConfig.gpt2_large(), 4, 1024, 10,
+                                 "gpt2_770m")),
+        ("llama7b_decode",
+         lambda: bench_llama_decode(LlamaConfig.llama2_7b(), 4, 128, 128,
+                                    "llama2_7b_decode")),
+        ("vit_l_train", lambda: bench_vit_train(vit_l_16, 32, 10,
+                                                "vit_l_16")),
+        ("flash_ab", bench_flash_ab),
+        ("paged_ab", bench_paged_ab),
+        ("eager", bench_eager),
+    ]
 
-    def probe():
-        try:
-            result["backend"] = jax.default_backend()
-        except Exception as e:
-            result["error"] = str(e)
 
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if "backend" in result:
-        return result["backend"], None
-    note = result.get("error", f"backend init exceeded {timeout_s}s "
-                               "(TPU tunnel unreachable)")
-    # the probe thread may be stuck inside backend init; a clean CPU
-    # fallback needs a fresh process
+def run_rung(name, out_path):
+    """Child-process entry: execute ONE ladder rung, dump its JSON."""
+    thunk = dict(_tpu_rung_specs())[name]
+    res = _try(thunk)
+    with open(out_path, "w") as f:
+        json.dump(res, f)
+
+
+def _run_rung_subprocess(name, timeout_s=1500):
+    import os
     import subprocess
-    env = dict(__import__("os").environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PADDLE_TPU_BENCH_NOTE"] = note
-    env.pop("PJRT_LIBRARY_PATH", None)
-    code = ("import jax; jax.config.update('jax_platforms','cpu'); "
-            "import bench; bench.main()")
-    rc = subprocess.run([sys.executable, "-c", code], env=env,
-                        cwd=__import__("os").path.dirname(
-                            __import__("os").path.abspath(__file__)))
-    raise SystemExit(rc.returncode)
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    fd, out_path = tempfile.mkstemp(suffix=f"_{name}.json")
+    os.close(fd)
+    os.unlink(out_path)
+    code = f"import bench; bench.run_rung({name!r}, {out_path!r})"
+    try:
+        try:
+            p = subprocess.run([sys.executable, "-c", code], cwd=here,
+                               capture_output=True, text=True,
+                               timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            return {"skipped": RUNG_TIMEOUT_MSG.format(timeout_s)}
+        try:
+            if os.path.exists(out_path):
+                with open(out_path) as f:
+                    return json.load(f)
+        except (OSError, ValueError):
+            pass
+        return {"skipped": f"rung subprocess rc={p.returncode}: "
+                           f"{(p.stderr or '')[-400:]}"}
+    finally:
+        try:
+            if os.path.exists(out_path):
+                os.unlink(out_path)
+        except OSError:
+            pass
+
+
+RUNG_TIMEOUT_MSG = "rung subprocess timed out after {}s"
+
+
+def _cache_path():
+    import os
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_TPU_RESULTS.json")
+
+
+def _cache_rung(name, res):
+    """Persist a SUCCESSFUL TPU rung measurement durably. The axon tunnel
+    comes and goes (it was down for all of rounds 2-3); a hardware number
+    measured earlier in the round must survive to the driver's
+    end-of-round bench run instead of degrading to a CPU smoke line."""
+    if not isinstance(res, dict) or "skipped" in res:
+        return
+    try:
+        with open(_cache_path()) as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        cache = {}
+    cache[name] = dict(res, measured_at=time.strftime(
+        "%Y-%m-%dT%H:%M:%S%z"))
+    try:
+        import os
+        tmp = _cache_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(cache, f, indent=1)
+        os.replace(tmp, _cache_path())  # atomic: never truncate the
+        # durable cache on a mid-dump crash
+    except OSError:
+        pass
+
+
+def _cached_headline():
+    """Return (head, ladder) from BENCH_TPU_RESULTS.json, or None."""
+    try:
+        with open(_cache_path()) as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        return None
+    head = cache.get("head")
+    need = ("tokens_per_s", "mfu", "device", "step_time_ms", "loss",
+            "batch", "seq", "params")
+    if not isinstance(head, dict) or any(k not in head for k in need):
+        return None
+    ladder = {k: v for k, v in cache.items() if k != "head"}
+    return head, ladder
+
+
+def _probe_backend_subprocess(timeout_s=240):
+    """Resolve the backend in a THROWAWAY child process: the parent must
+    never initialize the TPU client itself — a PJRT TPU client is
+    exclusive per process, so a parent holding the chip starves every
+    per-rung child. Returns the backend name, or None on timeout/error
+    (wedged tunnel)."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('BACKEND=' + jax.default_backend())"],
+            cwd=here, capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in (p.stdout or "").splitlines():
+        if line.startswith("BACKEND="):
+            return line.split("=", 1)[1].strip()
+    return None
 
 
 def main():
@@ -460,14 +567,24 @@ def main():
         except RuntimeError:
             pass  # backend already resolved
 
-    from paddle_tpu.models import GPTConfig, LlamaConfig
-    from paddle_tpu.vision.models import vit_l_16
+    from paddle_tpu.models import GPTConfig, LlamaConfig  # noqa: F401
 
-    if os.environ.get("JAX_PLATFORMS") != "cpu" and \
-            "PADDLE_TPU_BENCH_NOTE" not in os.environ:
-        _backend_or_cpu_fallback()
-
-    on_tpu = jax.default_backend() != "cpu"
+    if os.environ.get("JAX_PLATFORMS") == "cpu" or \
+            "PADDLE_TPU_BENCH_NOTE" in os.environ:
+        on_tpu = False
+    else:
+        backend = _probe_backend_subprocess()
+        if backend is None:
+            os.environ["PADDLE_TPU_BENCH_NOTE"] = (
+                "backend probe timed out (TPU tunnel unreachable)")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except RuntimeError:
+                pass
+            on_tpu = False
+        else:
+            on_tpu = backend != "cpu"
     ladder = {}
 
     def _persist(partial):
@@ -482,27 +599,76 @@ def main():
             pass
 
     if on_tpu:
-        head = bench_gpt_train(GPTConfig.gpt2_medium(), 8, 1024, 20,
-                               "gpt2_345m")
-        _persist({"head": head})
-        fp8_cfg = GPTConfig.gpt2_medium()
-        fp8_cfg.use_fp8 = True
-        for name, fn, args in [
-            ("gpt_345m_fp8_train", bench_gpt_train,
-             (fp8_cfg, 8, 1024, 10, "gpt2_345m_fp8")),
-            ("gpt_770m_train", bench_gpt_train,
-             (GPTConfig.gpt2_large(), 4, 1024, 10, "gpt2_770m")),
-            ("llama7b_decode", bench_llama_decode,
-             (LlamaConfig.llama2_7b(), 4, 128, 128, "llama2_7b_decode")),
-            ("vit_l_train", bench_vit_train, (vit_l_16, 32, 10,
-                                              "vit_l_16")),
-            ("flash_ab", bench_flash_ab, ()),
-            ("paged_ab", bench_paged_ab, ()),
-            ("eager", bench_eager, ()),
-        ]:
-            ladder[name] = _try(fn, *args) if args else _try(fn)
-            _persist({"head": head, "ladder": ladder})
-    else:  # smoke mode off-TPU
+        head = None
+        wedged = False
+        for name, _ in _tpu_rung_specs():
+            if wedged:
+                res = {"skipped": "TPU tunnel wedged mid-ladder "
+                                  "(probe failed after a rung timeout)"}
+            else:
+                res = _run_rung_subprocess(name)
+                skip = str(res.get("skipped", ""))
+                if skip.startswith("rung subprocess timed out"):
+                    # rung timed out — distinguish a slow rung from a
+                    # wedged tunnel; don't burn 1500s on each remaining
+                    # rung when the tunnel is gone. (Exact-prefix match:
+                    # child stderr can contain words like 'exceeded'.)
+                    wedged = _probe_backend_subprocess() is None
+            _cache_rung(name, res)
+            if name == "head":
+                head = res
+                _persist({"head": head})
+            else:
+                ladder[name] = res
+                _persist({"head": head, "ladder": ladder})
+        if (not head or "tokens_per_s" not in head) and not wedged:
+            # headline subprocess died — one bounded retry (never
+            # in-process: a wedged tunnel would hang the parent forever
+            # with the cached-fallback branch unreachable below)
+            head = _run_rung_subprocess("head", timeout_s=900)
+            _cache_rung("head", head)
+        if "tokens_per_s" not in head:
+            on_tpu = False  # fall through to the marked smoke path
+            os.environ["PADDLE_TPU_BENCH_NOTE"] = (
+                "TPU headline rung failed: "
+                + str(head.get("skipped", "?"))[:200])
+            # pin the CPU backend for the smoke fallback: the parent
+            # must never TPU-init (wedged tunnel = indefinite hang) nor
+            # run a 'cpu smoke' line on the TPU mislabeled
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except RuntimeError:
+                pass
+
+    if not on_tpu and "PADDLE_TPU_BENCH_NOTE" in os.environ:
+        # the TPU was unreachable THIS run — prefer the durable v5e
+        # measurement cached earlier in the round over a CPU smoke line
+        cached = _cached_headline()
+        if cached is not None:
+            head, cladder = cached
+            out = {
+                "metric": "gpt2_345m_pretrain_tokens_per_sec_per_chip",
+                "value": head["tokens_per_s"],
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(head["mfu"] / BASELINE_MFU, 4),
+                "mfu": head["mfu"], "device": head["device"],
+                "step_time_ms": head["step_time_ms"],
+                "loss": head["loss"],
+                "batch": head["batch"], "seq": head["seq"],
+                "params": head["params"],
+                "ladder": cladder,
+                "cached": True,
+                "note": ("TPU unreachable at bench time ("
+                         + os.environ["PADDLE_TPU_BENCH_NOTE"][:120]
+                         + ") — headline is the v5e measurement cached at "
+                         + str(head.get("measured_at"))
+                         + " this round (BENCH_TPU_RESULTS.json)"),
+            }
+            _persist(out)
+            print(json.dumps(out))
+            return
+    if not on_tpu:  # smoke mode off-TPU
         head = bench_gpt_train(GPTConfig.tiny(), 2, 64, 3, "gpt2_tiny")
         ladder["llama_decode_smoke"] = _try(
             bench_llama_decode, LlamaConfig.tiny(), 2, 8, 8,

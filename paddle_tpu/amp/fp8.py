@@ -110,11 +110,17 @@ def _scaled_mm_fwd(x2d, w, sx, sw):
     wq = _quantize(w, sw, E4M3_MAX, jnp.float8_e4m3fn)
     y = jax.lax.dot_general(xq, wq, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
-    return y * (sx * sw), (xq, wq, sx, sw)
+    # zero-size dtype carriers: the bwd rule must emit cotangents in the
+    # PRIMAL dtypes (bf16 params -> bf16 grads) or the f32 grads leak up
+    # the tape and the upstream vjp_fn rejects them (caught on the v5e
+    # bf16 345M fp8 bench rung)
+    xp = jnp.zeros((0,), x2d.dtype)
+    wp = jnp.zeros((0,), w.dtype)
+    return y * (sx * sw), (xq, wq, sx, sw, xp, wp)
 
 
 def _scaled_mm_bwd(res, g):
-    xq, wq, sx, sw = res
+    xq, wq, sx, sw, xp, wp = res
     g32 = g.astype(jnp.float32)
     # current scaling for the cotangent: e5m2 (wide range, the fp8 grad
     # dtype the reference uses on the cublasLt path as well)
@@ -125,7 +131,8 @@ def _scaled_mm_bwd(res, g):
                              preferred_element_type=jnp.float32)
     dw = jax.lax.dot_general(xq, gq, (((0,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
-    return (dx * (sg * sw), dw * (sx * sg),
+    return ((dx * (sg * sw)).astype(xp.dtype),
+            (dw * (sx * sg)).astype(wp.dtype),
             jnp.zeros_like(sx), jnp.zeros_like(sw))
 
 

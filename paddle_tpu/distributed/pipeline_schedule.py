@@ -298,13 +298,19 @@ def _max_overlap(events):
 _COST = {"F": 1.0, "B": 3.0, "Bd": 2.0, "W": 2.0}
 
 
-def schedule_cost_report(P, M, V=1):
+def schedule_cost_report(P, M, V=1, costs=None):
     """Tick tables + lockstep cost model for every schedule style at
     (P, M[, V]) — the measurement VERDICT r2 asked for (reference
     pipeline_zero_bubble.py ZB-H1). Per tick, every device executes the
     ops its tables fire; the wall-clock of a lockstep tick is the MAX
     over devices of its fired-op cost (devices synchronize on the ring
-    ppermute each tick). Returns {style: {ticks, cost, stash, ...}}."""
+    ppermute each tick). Returns {style: {ticks, cost, stash, ...}}.
+
+    ``costs`` overrides the analytic per-op costs with MEASURED ones
+    ({"F","B","Bd","W"}, any unit) — e.g. per-phase wall-clock of the
+    real per-stage computation on TPU (tools/pipeline_tick_ab.py), so
+    the report predicts hardware step time instead of trace units."""
+    costs = dict(_COST, **(costs or {}))
     styles = ["fthenb", "1f1b", "1f1b_packed", "zb"]
     if V > 1:
         styles = ["fthenb", "interleave", "interleave_packed"]
@@ -322,15 +328,15 @@ def schedule_cost_report(P, M, V=1):
             for d in range(P):
                 c = 0.0
                 if s.fmb[d, t] >= 0:
-                    c += _COST["F"]
+                    c += costs["F"]
                 if s.bmb[d, t] >= 0:
-                    c += _COST["Bd"] if s.has_wgrad else _COST["B"]
+                    c += costs["Bd"] if s.has_wgrad else costs["B"]
                 if s.has_wgrad and s.wmb[d, t] >= 0:
-                    c += _COST["W"]
+                    c += costs["W"]
                 busy += c
                 tick_max = max(tick_max, c)
             cost += tick_max
-        useful = P * v * M * (_COST["F"] + _COST["B"])  # total real work
+        useful = P * v * M * (costs["F"] + costs["B"])  # total real work
         out[style] = {
             "ticks": s.T,
             "lockstep_cost": cost,

@@ -336,3 +336,29 @@ def test_shared_layer_desc_forward_func():
     ids = _ids()
     loss = pipe.loss(ids, ids)
     assert np.isfinite(float(np.asarray(loss._data)))
+
+
+def test_schedule_cost_report_measured_costs():
+    """costs= plugs hardware-measured per-phase times into the tick
+    table (tools/pipeline_tick_ab.py feeds TPU numbers through this)."""
+    from paddle_tpu.distributed.pipeline_schedule import (
+        schedule_cost_report)
+
+    analytic = schedule_cost_report(4, 8)
+    # same relative structure when every cost is scaled by a constant
+    scaled = schedule_cost_report(
+        4, 8, costs={"F": 2.0, "B": 6.0, "Bd": 4.0, "W": 4.0})
+    for style in analytic:
+        assert scaled[style]["ticks"] == analytic[style]["ticks"]
+        assert scaled[style]["lockstep_cost"] == \
+            2 * analytic[style]["lockstep_cost"]
+    # a partial override keeps defaults for the rest
+    part = schedule_cost_report(4, 8, costs={"B": 3.0})
+    assert part["1f1b"]["lockstep_cost"] == \
+        analytic["1f1b"]["lockstep_cost"]
+    # measured regime where W is nearly free: zb must BEAT 1f1b in the
+    # model — the report reflects the costs, not a baked-in stance
+    free_w = schedule_cost_report(
+        8, 32, costs={"F": 1.0, "B": 3.0, "Bd": 2.0, "W": 0.01})
+    assert free_w["zb"]["lockstep_cost"] < \
+        free_w["1f1b"]["lockstep_cost"]

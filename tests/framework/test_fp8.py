@@ -247,6 +247,27 @@ class TestConvergence:
         assert dev < 0.15, (f"fp8 diverges from bf16: max rel dev "
                             f"{dev:.3f}\nfp8={f8}\nbf16={bf16}")
 
+    def test_bf16_params_train_through_fused_step(self):
+        """Regression: with bf16 params the _scaled_mm bwd rule must emit
+        bf16 cotangents — f32 grads leak up the tape and the upstream
+        vjp_fn rejects them (first caught on the v5e fp8 bench rung)."""
+        from paddle_tpu.models import GPT, GPTConfig
+
+        paddle.seed(0)
+        cfg = GPTConfig.tiny()
+        cfg.use_fp8 = True
+        m = GPT(cfg)
+        m.to(dtype="bfloat16")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=m.parameters())
+        step = paddle.jit.TrainStep(m, opt, lambda mm, i: mm.loss(i, i))
+        rng = np.random.default_rng(0)
+        ids = paddle.to_tensor(
+            rng.integers(0, cfg.vocab_size, (2, 32)).astype("int64"))
+        l0 = float(np.asarray(step(ids).numpy()))
+        l1 = float(np.asarray(step(ids).numpy()))
+        assert np.isfinite(l0) and np.isfinite(l1)
+
 
 class TestTPULowering:
     def test_fp8_train_step_lowers_for_tpu(self):
