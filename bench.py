@@ -442,9 +442,17 @@ def _tpu_rung_specs():
 
 
 def run_rung(name, out_path):
-    """Child-process entry: execute ONE ladder rung, dump its JSON."""
+    """Child-process entry: execute ONE ladder rung, dump its JSON.
+    Stamps the backend the child ACTUALLY ran on: PJRT init can fall
+    back to CPU if the tunnel drops between the parent's probe and the
+    child's start, and a CPU fallback must never be cached as TPU
+    ladder data (_cache_rung gates on this)."""
     thunk = dict(_tpu_rung_specs())[name]
     res = _try(thunk)
+    if isinstance(res, dict):
+        res.setdefault("backend", jax.default_backend())
+        res.setdefault("device", getattr(jax.devices()[0], "device_kind",
+                                         "cpu").lower())
     with open(out_path, "w") as f:
         json.dump(res, f)
 
@@ -482,7 +490,8 @@ def _run_rung_subprocess(name, timeout_s=1500):
             pass
 
 
-RUNG_TIMEOUT_MSG = "rung subprocess timed out after {}s"
+RUNG_TIMEOUT_PREFIX = "rung subprocess timed out"
+RUNG_TIMEOUT_MSG = RUNG_TIMEOUT_PREFIX + " after {}s"
 
 
 def _cache_path():
@@ -495,25 +504,46 @@ def _cache_rung(name, res):
     """Persist a SUCCESSFUL TPU rung measurement durably. The axon tunnel
     comes and goes (it was down for all of rounds 2-3); a hardware number
     measured earlier in the round must survive to the driver's
-    end-of-round bench run instead of degrading to a CPU smoke line."""
+    end-of-round bench run instead of degrading to a CPU smoke line.
+
+    Gate on the device the rung child ACTUALLY ran on: a child whose
+    PJRT init fell back to CPU must not poison the TPU cache."""
     if not isinstance(res, dict) or "skipped" in res:
         return
+    dev = str(res.get("device", "")).lower()
+    if "cpu" in dev or (not dev and res.get("backend") == "cpu"):
+        return
+    # Serialize the read-modify-write: the background tpu_watcher and a
+    # driver-run bench.py both fire when a tunnel window opens; without
+    # a lock one would clobber the other's freshly-cached rung.
+    import fcntl
+    import os
+    lock_path = _cache_path() + ".lock"
     try:
-        with open(_cache_path()) as f:
-            cache = json.load(f)
-    except (OSError, ValueError):
-        cache = {}
-    cache[name] = dict(res, measured_at=time.strftime(
-        "%Y-%m-%dT%H:%M:%S%z"))
-    try:
-        import os
-        tmp = _cache_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(cache, f, indent=1)
-        os.replace(tmp, _cache_path())  # atomic: never truncate the
-        # durable cache on a mid-dump crash
+        lock = open(lock_path, "w")
+        fcntl.flock(lock, fcntl.LOCK_EX)
     except OSError:
-        pass
+        lock = None
+    try:
+        try:
+            with open(_cache_path()) as f:
+                cache = json.load(f)
+        except (OSError, ValueError):
+            cache = {}
+        cache[name] = dict(res, measured_at=time.strftime(
+            "%Y-%m-%dT%H:%M:%S%z"))
+        try:
+            tmp = _cache_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(cache, f, indent=1)
+            os.replace(tmp, _cache_path())  # atomic: never truncate the
+            # durable cache on a mid-dump crash
+        except OSError:
+            pass
+    finally:
+        if lock is not None:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+            lock.close()
 
 
 def _cached_headline():
@@ -608,12 +638,14 @@ def main():
             else:
                 res = _run_rung_subprocess(name)
                 skip = str(res.get("skipped", ""))
-                if skip.startswith("rung subprocess timed out"):
+                if skip.startswith(RUNG_TIMEOUT_PREFIX):
                     # rung timed out — distinguish a slow rung from a
                     # wedged tunnel; don't burn 1500s on each remaining
                     # rung when the tunnel is gone. (Exact-prefix match:
                     # child stderr can contain words like 'exceeded'.)
-                    wedged = _probe_backend_subprocess() is None
+                    # A probe answering 'cpu' is a PJRT fallback, i.e.
+                    # the tunnel is just as gone as a timeout.
+                    wedged = _probe_backend_subprocess() in (None, "cpu")
             _cache_rung(name, res)
             if name == "head":
                 head = res
@@ -621,10 +653,16 @@ def main():
             else:
                 ladder[name] = res
                 _persist({"head": head, "ladder": ladder})
-        if (not head or "tokens_per_s" not in head) and not wedged:
-            # headline subprocess died — one bounded retry (never
-            # in-process: a wedged tunnel would hang the parent forever
-            # with the cached-fallback branch unreachable below)
+        timed_out = isinstance(head, dict) and str(
+            head.get("skipped", "")).startswith(RUNG_TIMEOUT_PREFIX)
+        if (not head or "tokens_per_s" not in head) and not wedged \
+                and not timed_out:
+            # headline subprocess DIED (rc != 0) — one bounded retry
+            # (never in-process: a wedged tunnel would hang the parent
+            # forever with the cached-fallback branch unreachable
+            # below). A rung that burned its full 1500s gets no retry:
+            # a 900s rerun from a cold compile is near-guaranteed
+            # futile.
             head = _run_rung_subprocess("head", timeout_s=900)
             _cache_rung("head", head)
         if "tokens_per_s" not in head:

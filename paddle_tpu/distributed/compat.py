@@ -350,7 +350,29 @@ class _Knob:
         self.__dict__.update(kw)
 
 
-DistAttr = tuple  # (mesh, placements) — the dist attr IS this pair here
+class DistAttr:
+    """Reference auto_parallel DistAttr (python/paddle/distributed/
+    auto_parallel/api.py DistAttr): a (process_mesh, sharding_specs)
+    pair. sharding_specs entries are mesh-dim names (or None) per
+    tensor dim; exposed as placements for the TPU mapping."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        from .placement import Replicate, Shard
+
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+        if mesh is not None:
+            names = list(mesh.dim_names)
+            pls = [Replicate()] * mesh.ndim
+            for tdim, spec in enumerate(self.sharding_specs):
+                if spec is not None:
+                    pls[names.index(spec)] = Shard(tdim)
+            self.placements = pls
+        else:
+            self.placements = []
+
+    def __iter__(self):  # keeps the (mesh, placements) pair unpackable
+        return iter((self.process_mesh, self.placements))
 
 
 class DistModel:

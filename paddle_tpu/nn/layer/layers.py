@@ -125,8 +125,16 @@ class Layer:
         dtype = dtype_mod.convert_dtype(dtype) if dtype else \
             (self._dtype or dtype_mod.get_default_dtype())
         attr = ParamAttr._to_attr(attr)
+        # Precedence per reference layer_helper_base.py:375-383: explicit
+        # ParamAttr.initializer wins; otherwise set_global_initializer
+        # overrides even the layer's default_initializer.
+        g = init._get_global_initializer()
+        if g is not None:
+            g = g[1] if is_bias else g[0]
         if attr is not None and attr.initializer is not None:
             initializer = attr.initializer
+        elif g is not None:
+            initializer = g
         elif default_initializer is not None:
             initializer = default_initializer
         elif is_bias:

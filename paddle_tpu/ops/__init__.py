@@ -249,8 +249,15 @@ _MODULE_INPLACE_BASES = _INPLACE_BASES + [
     "index_fill", "lcm", "ldexp", "less_equal", "less_than", "logical_and",
     "logical_not", "logical_or", "logical_xor", "masked_scatter",
     "multigammaln", "not_equal", "polygamma", "renorm", "sinc", "t",
-    "transpose", "where",
+    "transpose",
 ]
+
+
+def where_(condition, x, y, name=None):
+    """In-place `where`: writes the select result into ``x`` (the
+    reference's inplace variant mutates x, NOT the condition —
+    python/paddle/tensor/search.py where_)."""
+    return _inplace_from(x, manipulation.where(condition, x, y))
 
 
 def _make_module_inplace(fn, iname):
@@ -275,6 +282,9 @@ def _bind_module_inplace():
 
 
 _bind_module_inplace()
+# Tensor method form keeps the reference receiver convention:
+# `cond.where_(x, y)` selects into (and returns) x.
+Tensor.where_ = where_
 
 
 def _bind_reference_method_surface():

@@ -19,49 +19,93 @@ from .datasets import (  # noqa: F401,E402
 
 def viterbi_decode(potentials, transition_params, lengths=None,
                    include_bos_eos_tag=True, name=None):
-    """CRF Viterbi decode (reference paddle.text.viterbi_decode /
-    phi viterbi_decode kernel). potentials: [b, t, n] emissions,
-    transition_params: [n, n] (+2 with bos/eos tags at [-2]=bos, [-1]=eos).
-    Returns (scores [b], paths [b, t])."""
+    """CRF Viterbi decode (reference paddle.text.viterbi_decode over the
+    phi viterbi_decode kernel, cpu/viterbi_decode_kernel.cc:158).
 
-    def fn(emis, trans):
+    potentials: [b, t, n] emissions; transition_params: [n, n] — with
+    ``include_bos_eos_tag`` the bos/eos tags are part of those n tags:
+    row n-1 is the start (bos->tag) scores, row n-2 the stop scores
+    added at each sequence's final step (kernel splits the matrix at
+    :225-236). lengths: [b] int; positions past a sequence's length are
+    masked out of the recurrence, path entries there are 0, and the
+    returned paths are trimmed to max(lengths) like the kernel's
+    batch_path. Returns (scores [b], paths [b, min(t, max(lengths))])."""
+    import numpy as np
+
+    from ..core.dispatch import unwrap
+
+    if lengths is None:
+        t_full = unwrap(potentials).shape[1]
+        lens_host = None
+    else:
+        lens_host = np.asarray(unwrap(lengths)).astype("int64")
+        t_full = unwrap(potentials).shape[1]
+
+    def fn(emis, trans, *rest):
         b, t, n = emis.shape
+        lens = rest[0].astype(jnp.int32) if rest else \
+            jnp.full((b,), t, jnp.int32)
 
         if include_bos_eos_tag:
-            start = trans[-2, :][None, :]  # bos -> tag
-            stop = trans[:, -1]
+            start = trans[n - 1]  # bos -> tag row
+            stop = trans[n - 2]   # stop scores row
         else:
-            start = jnp.zeros((1, n), emis.dtype)
+            start = jnp.zeros((n,), emis.dtype)
             stop = jnp.zeros((n,), emis.dtype)
 
-        alpha0 = emis[:, 0] + start  # [b, n]
+        alpha = emis[:, 0] + start[None]
+        left = lens
+        alpha = alpha + jnp.where(left == 1, 1.0, 0.0)[:, None] * \
+            stop[None]
+        left = left - 1
 
-        def step(alpha, e_t):
-            # scores[b, i, j] = alpha[b, i] + trans[i, j]
-            scores = alpha[:, :, None] + trans[None, :n, :n]
-            best_prev = jnp.argmax(scores, axis=1)  # [b, n]
-            alpha_new = jnp.max(scores, axis=1) + e_t
-            return alpha_new, best_prev
+        def step(carry, e_t):
+            alpha, left = carry
+            scores = alpha[:, :, None] + trans[None]
+            bp = jnp.argmax(scores, axis=1)          # [b, n]
+            nxt = jnp.max(scores, axis=1) + e_t
+            active = (left > 0)[:, None]
+            alpha2 = jnp.where(active, nxt, alpha)
+            alpha2 = alpha2 + jnp.where(left == 1, 1.0, 0.0)[:, None] \
+                * stop[None]
+            return (alpha2, left - 1), bp
 
-        alpha, backptrs = jax.lax.scan(step, alpha0,
-                                       jnp.swapaxes(emis[:, 1:], 0, 1))
-        alpha = alpha + stop[None, :]
-        last = jnp.argmax(alpha, axis=-1)  # [b]
+        (alpha, _), backptrs = jax.lax.scan(
+            step, (alpha, left), jnp.swapaxes(emis[:, 1:], 0, 1))
+        last = jnp.argmax(alpha, axis=-1)
         score = jnp.max(alpha, axis=-1)
 
-        def backtrace(carry, bp_t):
-            tag = carry
-            prev = jnp.take_along_axis(bp_t, tag[:, None], 1)[:, 0]
-            return prev, tag
+        batch = jnp.arange(b)
 
-        tag0, path_rest = jax.lax.scan(backtrace, last, backptrs,
-                                       reverse=True)
-        # path_rest[k] = tag at step k+1; tag0 = tag at step 0
-        path = jnp.concatenate([tag0[None], path_rest], axis=0) if t > 1 \
-            else last[None]
+        def backtrace(carry, x):
+            bp_t, i = x
+            cur = carry
+            final_here = (i == lens - 1)
+            cur = jnp.where(final_here, last, cur)
+            out = jnp.where(i <= lens - 1, cur, 0)
+            prev = bp_t[batch, cur]
+            nxt = jnp.where(i <= lens - 1, prev, cur)
+            return nxt, out
+
+        if t > 1:
+            tag0, path_rest = jax.lax.scan(
+                backtrace, last, (backptrs, jnp.arange(1, t)),
+                reverse=True)
+            p0 = jnp.where(0 <= lens - 1, jnp.where(lens == 1, last,
+                                                    tag0), 0)
+            path = jnp.concatenate([p0[None], path_rest], axis=0)
+        else:
+            path = jnp.where(lens >= 1, last, 0)[None]
         return score, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
 
-    return apply(fn, potentials, transition_params, name="viterbi_decode")
+    args = (potentials, transition_params) if lengths is None else \
+        (potentials, transition_params, lengths)
+    score, path = apply(fn, *args, name="viterbi_decode")
+    if lens_host is not None:
+        t_trim = int(min(t_full, int(lens_host.max()) if lens_host.size
+                         else 0))
+        path = path[:, :t_trim]
+    return score, path
 
 
 class ViterbiDecoder:

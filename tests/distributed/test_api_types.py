@@ -1,0 +1,84 @@
+"""Semi-auto SPMD API types (parity: python/paddle/distributed/ —
+ProcessMesh/Placement/Shard/Partial/ReduceOp/Strategy surface used by
+shard_tensor / reshard / to_distributed)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def test_placement_predicates():
+    r = dist.Replicate()
+    s = dist.Shard(1)
+    p = dist.Partial()
+    assert isinstance(r, dist.Placement)
+    assert r.is_replicated() and not r.is_shard() and not r.is_partial()
+    assert s.is_shard() and s.is_shard(1) and not s.is_shard(0)
+    assert s.get_dim() == 1
+    assert p.is_partial() and p.reduce_type == "sum"
+    # value semantics: used as dict keys by placement rules
+    assert dist.Shard(1) == dist.Shard(1) != dist.Shard(0)
+    assert dist.Replicate() == dist.Replicate()
+    assert dist.Partial() == dist.Partial()
+    assert len({dist.Shard(1), dist.Shard(1), dist.Replicate()}) == 2
+
+
+def test_process_mesh():
+    mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                            dim_names=["dp", "tp"])
+    assert mesh.shape == [2, 4]
+    assert mesh.ndim == 2
+    assert mesh.dim_names == ["dp", "tp"]
+    assert mesh.process_ids == list(range(8))
+    assert mesh.jax_mesh.axis_names == ("dp", "tp")
+    np.testing.assert_array_equal(mesh.mesh,
+                                  [[0, 1, 2, 3], [4, 5, 6, 7]])
+
+
+def test_shard_tensor_with_mesh_and_placements():
+    mesh = dist.ProcessMesh([[0, 1], [2, 3], [4, 5], [6, 7]],
+                            dim_names=["x", "y"])
+    t = paddle.ones([8, 4])
+    d = dist.shard_tensor(t, mesh, [dist.Shard(0), dist.Replicate()])
+    np.testing.assert_array_equal(d.numpy(), np.ones((8, 4), "f4"))
+    r = dist.reshard(d, mesh, [dist.Replicate(), dist.Shard(1)])
+    np.testing.assert_array_equal(r.numpy(), np.ones((8, 4), "f4"))
+
+
+def test_reduce_op_and_type():
+    assert dist.ReduceOp.SUM != dist.ReduceOp.MAX
+    assert int(dist.ReduceType.kRedSum) == 0
+    assert int(dist.ReduceType.kRedAvg) == 4
+    assert dist.ParallelMode.DATA_PARALLEL == 0
+    assert dist.ParallelMode.SHARDING_PARALLEL == 3
+
+
+def test_strategy_and_dist_attr():
+    s = dist.Strategy()
+    assert s is not None
+    mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                            dim_names=["dp", "tp"])
+    a = dist.DistAttr(mesh=mesh, sharding_specs=["dp", None])
+    assert a.process_mesh is mesh
+    assert a.placements == [dist.Shard(0), dist.Replicate()]
+    m, pls = a  # unpacks as the (mesh, placements) pair
+    assert m is mesh and pls == a.placements
+    b = dist.DistAttr(mesh=mesh, sharding_specs=[None, "tp"])
+    assert b.placements == [dist.Replicate(), dist.Shard(1)]
+
+
+def test_gloo_compat_single_process():
+    """gloo_* shims: single-process init/barrier/release must work (the
+    reference uses them for CPU bootstrap; XLA collectives own the real
+    path)."""
+    dist.gloo_init_parallel_env(0, 1, "127.0.0.1:0")
+    dist.gloo_barrier()
+    dist.gloo_release()
+
+
+def test_distributed_io_module():
+    assert hasattr(dist, "io")
+    assert hasattr(dist, "launch")
+    assert callable(dist.spawn)

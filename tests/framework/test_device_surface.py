@@ -1,0 +1,70 @@
+"""Device-runtime surface sweep (parity: python/paddle/device/__init__.py;
+SURVEY §1 layer 1 — PJRT owns the real runtime, this is the user-visible
+Stream/Event/introspection surface over it)."""
+
+import paddle_tpu as paddle
+from paddle_tpu import device
+
+
+def test_get_device_and_count():
+    d = device.get_device()
+    assert isinstance(d, str) and ":" in d
+    assert device.device_count() >= 1
+
+
+def test_compiled_with_flags_are_booleans():
+    # TPU build: none of the other accelerator stacks are compiled in
+    assert device.is_compiled_with_cuda() is False
+    assert device.is_compiled_with_rocm() is False
+    assert device.is_compiled_with_xpu() is False
+    assert paddle.device.is_compiled_with_cinn() is False
+    assert paddle.device.is_compiled_with_distribute() is True
+    assert isinstance(
+        device.is_compiled_with_custom_device("tpu"), bool)
+
+
+def test_device_type_introspection():
+    all_types = device.get_all_device_type()
+    assert isinstance(all_types, list) and all_types
+    custom = device.get_all_custom_device_type()
+    assert isinstance(custom, list)
+
+
+def test_synchronize_and_streams():
+    x = paddle.ones([4, 4]) @ paddle.ones([4, 4])
+    device.synchronize()  # must block until x is done, never raise
+    s = device.Stream()
+    assert s.query() in (True, False)
+    with device.stream_guard(s):
+        y = x + 1
+    s.synchronize()
+    assert float(y.numpy()[0, 0]) == 5.0
+    cur = device.current_stream()
+    assert cur is not None
+
+
+def test_event_record_query_synchronize():
+    e = device.Event(enable_timing=True)
+    s = device.current_stream()
+    e.record(s)
+    e.synchronize()
+    assert e.query() is True
+    # stream waits on event: must not deadlock
+    s2 = device.Stream()
+    s2.wait_event(e)
+    s2.wait_stream(s)
+
+
+def test_memory_stats_surface():
+    before = device.memory_allocated()
+    assert isinstance(before, int) and before >= 0
+    assert device.max_memory_allocated() >= 0
+    assert device.memory_reserved() >= 0
+    assert device.max_memory_reserved() >= 0
+    device.empty_cache()  # never raises
+
+
+def test_set_device_roundtrip():
+    cur = device.get_device()
+    device.set_device(cur)
+    assert device.get_device() == cur

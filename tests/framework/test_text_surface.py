@@ -1,0 +1,236 @@
+"""paddle.text surface: viterbi decode vs a brute-force oracle, and the
+dataset parsers driven from synthesized local archives (reference:
+python/paddle/text/datasets/*; hermetic CI passes data_file= the same
+way the reference tests mock the download cache)."""
+
+import gzip
+import io
+import itertools
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import text
+
+
+def _brute_viterbi(emis, trans, start, stop):
+    t, n = emis.shape
+    best, bp = -1e9, None
+    for path in itertools.product(range(n), repeat=t):
+        s = start[path[0]] + emis[0, path[0]]
+        for k in range(1, t):
+            s += trans[path[k - 1], path[k]] + emis[k, path[k]]
+        s += stop[path[-1]]
+        if s > best:
+            best, bp = s, path
+    return best, bp
+
+
+def test_viterbi_decode_no_tags():
+    rng = np.random.default_rng(3)
+    b, t, n = 2, 4, 3
+    emis = rng.standard_normal((b, t, n)).astype("float32")
+    trans = rng.standard_normal((n, n)).astype("float32")
+    sc, pa = text.viterbi_decode(paddle.to_tensor(emis),
+                                 paddle.to_tensor(trans),
+                                 include_bos_eos_tag=False)
+    zero = np.zeros(n, "float32")
+    for i in range(b):
+        bs, bp = _brute_viterbi(emis[i], trans, zero, zero)
+        assert abs(float(sc.numpy()[i]) - bs) < 1e-4
+        assert tuple(pa.numpy()[i]) == bp
+
+
+def test_viterbi_decode_bos_eos():
+    """With bos/eos tags the last two of the n tags are bos/eos: row
+    n-1 of transitions holds the start scores, row n-2 the stop scores
+    (reference cpu/viterbi_decode_kernel.cc:225-236 splits the matrix
+    into rest/stop/start rows)."""
+    rng = np.random.default_rng(5)
+    b, t, n = 3, 4, 5
+    emis = rng.standard_normal((b, t, n)).astype("float32")
+    trans = rng.standard_normal((n, n)).astype("float32")
+    sc, pa = text.viterbi_decode(paddle.to_tensor(emis),
+                                 paddle.to_tensor(trans))
+    for i in range(b):
+        bs, bp = _brute_viterbi(emis[i], trans, trans[n - 1],
+                                trans[n - 2])
+        assert abs(float(sc.numpy()[i]) - bs) < 1e-4
+        assert tuple(pa.numpy()[i]) == bp
+
+
+def test_viterbi_decode_lengths():
+    """Per-sequence lengths: padded steps are masked out, path entries
+    past a sequence's length are 0, and paths are trimmed to
+    max(lengths) (kernel batch_path / TrimPaths semantics — the
+    reference docstring example returns [2, 2] paths for seq_len 4)."""
+    rng = np.random.default_rng(9)
+    b, t, n = 3, 5, 3
+    emis = rng.standard_normal((b, t, n)).astype("float32")
+    trans = rng.standard_normal((n, n)).astype("float32")
+    lens = np.array([3, 4, 2], "int64")
+    sc, pa = text.viterbi_decode(paddle.to_tensor(emis),
+                                 paddle.to_tensor(trans),
+                                 paddle.to_tensor(lens),
+                                 include_bos_eos_tag=False)
+    assert pa.numpy().shape == (b, 4)  # trimmed to max(lengths)
+    zero = np.zeros(n, "float32")
+    for i in range(b):
+        li = int(lens[i])
+        bs, bp = _brute_viterbi(emis[i, :li], trans, zero, zero)
+        assert abs(float(sc.numpy()[i]) - bs) < 1e-4, i
+        got = pa.numpy()[i]
+        assert tuple(got[:li]) == bp
+        assert (got[li:] == 0).all()  # zero-padded past the length
+    # bos/eos + lengths: stop row applied at each sequence's own end
+    sc2, pa2 = text.viterbi_decode(paddle.to_tensor(emis),
+                                   paddle.to_tensor(trans),
+                                   paddle.to_tensor(lens))
+    for i in range(b):
+        li = int(lens[i])
+        bs, bp = _brute_viterbi(emis[i, :li], trans, trans[n - 1],
+                                trans[n - 2])
+        assert abs(float(sc2.numpy()[i]) - bs) < 1e-4, i
+        assert tuple(pa2.numpy()[i][:li]) == bp
+
+
+def test_viterbi_decoder_class():
+    rng = np.random.default_rng(7)
+    emis = rng.standard_normal((1, 3, 4)).astype("float32")
+    trans = rng.standard_normal((4, 4)).astype("float32")
+    dec = text.ViterbiDecoder(paddle.to_tensor(trans))
+    sc, pa = dec(paddle.to_tensor(emis))
+    sc2, pa2 = text.viterbi_decode(paddle.to_tensor(emis),
+                                   paddle.to_tensor(trans))
+    np.testing.assert_allclose(sc.numpy(), sc2.numpy())
+    np.testing.assert_array_equal(pa.numpy(), pa2.numpy())
+
+
+# ------------------------------------------------------------- datasets
+def test_uci_housing_local(tmp_path):
+    rng = np.random.default_rng(0)
+    raw = rng.uniform(0.0, 10.0, (10, 14)).astype("float32")
+    f = tmp_path / "housing.data"
+    np.savetxt(f, raw)
+    train = text.UCIHousing(data_file=str(f), mode="train")
+    test = text.UCIHousing(data_file=str(f), mode="test")
+    assert len(train) == 8 and len(test) == 2
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert x.min() >= 0.0 and x.max() <= 1.0  # min-max normalized
+    np.testing.assert_allclose(y[0], raw[0, -1], rtol=1e-6)
+
+
+def _tar_with(tmp_path, name, files):
+    p = tmp_path / name
+    with tarfile.open(p, "w:gz") as tf:
+        for fname, content in files.items():
+            data = content if isinstance(content, bytes) else \
+                content.encode()
+            info = tarfile.TarInfo(fname)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return str(p)
+
+
+def test_imikolov_local(tmp_path):
+    train = "a b c d e\n" * 60  # every word above the freq cutoff
+    valid = "a b x c\n" * 5
+    path = _tar_with(tmp_path, "simple-examples.tgz", {
+        "./simple-examples/data/ptb.train.txt": train,
+        "./simple-examples/data/ptb.valid.txt": valid,
+    })
+    ds = text.Imikolov(data_file=path, window_size=3, min_word_freq=50)
+    assert len(ds) > 0
+    gram = ds[0]
+    assert gram.shape == (3,) and gram.dtype == np.int64
+    seq = text.Imikolov(data_file=path, data_type="SEQ", mode="test",
+                        min_word_freq=50)
+    s = seq[0]
+    # <s> a b x c <e>: x is unseen in train -> <unk>
+    assert len(s) == 6
+    assert s[3] == seq.word_idx["<unk>"]
+
+
+def test_imdb_local(tmp_path):
+    reviews = {
+        "aclImdb/train/pos/0_9.txt": "great movie great fun " * 60,
+        "aclImdb/train/neg/0_1.txt": "bad movie boring plot " * 60,
+        "aclImdb/test/pos/0_8.txt": "great fun",
+        "aclImdb/test/neg/0_2.txt": "boring bad",
+    }
+    path = _tar_with(tmp_path, "aclImdb_v1.tar.gz", reviews)
+    train = text.Imdb(data_file=path, mode="train", cutoff=10)
+    assert len(train) == 2
+    doc, label = train[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    test = text.Imdb(data_file=path, mode="test", cutoff=10)
+    assert len(test) == 2
+    labels = sorted(int(test[i][1]) for i in range(2))
+    assert labels == [0, 1]  # one pos (0), one neg (1)
+
+
+def test_movielens_local(tmp_path):
+    movies = "1::Toy Story (1995)::Animation|Comedy\n" \
+             "2::Jumanji (1995)::Adventure\n"
+    users = "1::M::25::4::90210\n2::F::35::7::10001\n"
+    ratings = "1::1::5::978300760\n1::2::3::978302109\n" \
+              "2::1::4::978301968\n"
+    p = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(p, "w") as zf:
+        zf.writestr("ml-1m/movies.dat", movies)
+        zf.writestr("ml-1m/users.dat", users)
+        zf.writestr("ml-1m/ratings.dat", ratings)
+    train = text.Movielens(data_file=str(p), mode="train",
+                           test_ratio=0.0)
+    assert len(train) == 3
+    uid, gender, age, job, mid, cats, title, rating = train[0]
+    assert gender in (0, 1) and rating in (3.0, 4.0, 5.0)
+    assert cats.dtype == np.int64 and title.dtype == np.int64
+
+
+def test_wmt14_local(tmp_path):
+    path = _tar_with(tmp_path, "wmt14.tgz", {
+        "wmt14/train.src": "hello world\ngood day\n",
+        "wmt14/train.trg": "bonjour monde\nbonne journee\n",
+        "wmt14/src.dict": "hello\nworld\ngood\nday\n",
+        "wmt14/trg.dict": "bonjour\nmonde\nbonne\njournee\n",
+    })
+    ds = text.WMT14(data_file=path, mode="train")
+    assert len(ds) == 2
+    src, trg_in, trg_out = ds[0]
+    assert src.tolist() == [0, 1]  # hello world
+    # trg_in starts with <s>, trg_out ends with <e>
+    assert trg_in[0] == ds.trg_dict["<s>"]
+    assert trg_out[-1] == ds.trg_dict["<e>"]
+    assert trg_in[1:].tolist() == trg_out[:-1].tolist()
+
+
+def test_wmt16_local(tmp_path):
+    path = _tar_with(tmp_path, "wmt16.tar.gz", {
+        "wmt16/train.en": "a b\n",
+        "wmt16/train.de": "x y\n",
+        "wmt16/en.dict": "a\nb\n",
+        "wmt16/de.dict": "x\ny\n",
+    })
+    ds = text.WMT16(data_file=path, mode="train", lang="en")
+    assert len(ds) == 1
+    src, trg_in, trg_out = ds[0]
+    assert src.tolist() == [0, 1]
+
+
+def test_conll05st_local(tmp_path):
+    words = "The\ncat\nsat\n\nA\ndog\nbarked\n"
+    path = _tar_with(tmp_path, "conll05st-tests.tar.gz", {
+        "conll05st/wordDict.txt": "the\ncat\nsat\na\ndog\nbarked\n<unk>\n",
+        "conll05st/verbDict.txt": "sit\nbark\n",
+        "conll05st/targetDict.txt": "B-A0\nB-V\nO\n",
+        "conll05st/test.wsj.words.gz": gzip.compress(words.encode()),
+    })
+    ds = text.Conll05st(data_file=path)
+    assert len(ds) == 2
+    assert ds[0].tolist() == [0, 1, 2]  # the cat sat
+    assert ds[1].tolist() == [3, 4, 5]

@@ -1,0 +1,156 @@
+"""Initializer sweep (parity: python/paddle/nn/initializer/ +
+test/legacy_test/test_initializer.py discipline: draw, then check the
+defining property — exact values for deterministic inits, moments or
+algebraic identities for random ones)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import initializer as I
+
+
+def _draw(init, shape, dtype="float32"):
+    return np.asarray(init(shape, dtype))
+
+
+def test_constant_exact():
+    out = _draw(nn.initializer.Constant(2.5), (3, 4))
+    np.testing.assert_array_equal(out, np.full((3, 4), 2.5, "float32"))
+
+
+def test_assign_exact_and_shape_guard():
+    v = np.arange(6, dtype="float32").reshape(2, 3)
+    np.testing.assert_array_equal(_draw(nn.initializer.Assign(v), (2, 3)),
+                                  v)
+    with pytest.raises(ValueError):
+        nn.initializer.Assign(v)((3, 2), "float32")
+
+
+def test_dirac_identity_delta():
+    # conv weight [out=4, in=2, k=3]: center tap is an identity map
+    w = _draw(nn.initializer.Dirac(), (4, 2, 3))
+    assert w.shape == (4, 2, 3)
+    for o in range(2):  # min(out, in) channels carry the delta
+        np.testing.assert_array_equal(w[o, o], [0.0, 1.0, 0.0])
+    assert w[2:].sum() == 0.0  # out channels beyond in_c stay zero
+    x = np.random.default_rng(0).standard_normal((1, 2, 8)).astype("f4")
+    y = paddle.nn.functional.conv1d(
+        paddle.to_tensor(x), paddle.to_tensor(w), padding=1).numpy()
+    np.testing.assert_allclose(y[0, :2], x[0], rtol=1e-6)  # identity
+
+
+def test_orthogonal_rows_orthonormal():
+    w = _draw(nn.initializer.Orthogonal(), (4, 9))
+    np.testing.assert_allclose(w @ w.T, np.eye(4), atol=1e-5)
+    g = _draw(nn.initializer.Orthogonal(gain=3.0), (4, 9))
+    np.testing.assert_allclose(g @ g.T, 9.0 * np.eye(4), atol=1e-4)
+    tall = _draw(nn.initializer.Orthogonal(), (6, 3))
+    np.testing.assert_allclose(tall.T @ tall, np.eye(3), atol=1e-5)
+
+
+def test_truncated_normal_bounds():
+    out = _draw(nn.initializer.TruncatedNormal(mean=1.0, std=0.5,
+                                               a=-2.0, b=2.0), (4000,))
+    assert out.min() >= 1.0 - 2.0 * 0.5 - 1e-6
+    assert out.max() <= 1.0 + 2.0 * 0.5 + 1e-6
+    assert abs(out.mean() - 1.0) < 0.05
+
+
+def test_xavier_normal_std():
+    fi, fo = 300, 200
+    out = _draw(nn.initializer.XavierNormal(), (fi, fo))
+    expect = math.sqrt(2.0 / (fi + fo))
+    assert abs(out.std() - expect) / expect < 0.05
+    # explicit fan override
+    out2 = _draw(nn.initializer.XavierNormal(fan_in=100, fan_out=100),
+                 (300, 200))
+    assert abs(out2.std() - math.sqrt(2.0 / 200)) < 0.01
+
+
+def test_xavier_uniform_limit():
+    fi, fo = 300, 200
+    out = _draw(nn.initializer.XavierUniform(), (fi, fo))
+    limit = math.sqrt(6.0 / (fi + fo))
+    assert abs(out).max() <= limit + 1e-6
+    assert abs(out).max() > 0.9 * limit  # actually fills the range
+
+
+def test_kaiming_normal_std():
+    fi = 400
+    out = _draw(nn.initializer.KaimingNormal(), (fi, 300))
+    expect = math.sqrt(2.0) / math.sqrt(fi)
+    assert abs(out.std() - expect) / expect < 0.05
+
+
+def test_kaiming_uniform_limit():
+    fi = 400
+    out = _draw(nn.initializer.KaimingUniform(), (fi, 300))
+    limit = math.sqrt(2.0) * math.sqrt(3.0 / fi)
+    assert abs(out).max() <= limit + 1e-6
+    assert abs(out).max() > 0.9 * limit
+
+
+def test_kaiming_conv_fan():
+    # conv weight [out, in, kh, kw]: fan_in = in * kh * kw
+    out = _draw(nn.initializer.KaimingNormal(), (64, 16, 3, 3))
+    expect = math.sqrt(2.0) / math.sqrt(16 * 9)
+    assert abs(out.std() - expect) / expect < 0.1
+
+
+def test_calculate_gain_table():
+    assert nn.initializer.calculate_gain("linear") == 1.0
+    assert nn.initializer.calculate_gain("tanh") == pytest.approx(5 / 3)
+    assert nn.initializer.calculate_gain("relu") == pytest.approx(
+        math.sqrt(2.0))
+    assert nn.initializer.calculate_gain("leaky_relu", 0.2) == \
+        pytest.approx(math.sqrt(2.0 / 1.04))
+    with pytest.raises(ValueError):
+        nn.initializer.calculate_gain("nope")
+
+
+def test_param_attr_initializer_wins():
+    lin = nn.Linear(
+        4, 3, weight_attr=paddle.ParamAttr(
+            initializer=nn.initializer.Constant(0.25)))
+    np.testing.assert_array_equal(lin.weight.numpy(),
+                                  np.full((4, 3), 0.25, "float32"))
+
+
+def test_set_global_initializer_overrides_layer_default():
+    """Reference layer_helper_base.py:375-383: the global initializer
+    beats the layer's default, loses to an explicit ParamAttr."""
+    nn.initializer.set_global_initializer(
+        nn.initializer.Constant(0.5), nn.initializer.Constant(-0.5))
+    try:
+        lin = nn.Linear(3, 2)
+        np.testing.assert_array_equal(lin.weight.numpy(),
+                                      np.full((3, 2), 0.5, "float32"))
+        np.testing.assert_array_equal(lin.bias.numpy(),
+                                      np.full((2,), -0.5, "float32"))
+        explicit = nn.Linear(3, 2, weight_attr=paddle.ParamAttr(
+            initializer=nn.initializer.Constant(9.0)))
+        np.testing.assert_array_equal(explicit.weight.numpy(),
+                                      np.full((3, 2), 9.0, "float32"))
+    finally:
+        nn.initializer.set_global_initializer(None)
+    after = nn.Linear(3, 2)
+    assert not np.allclose(after.weight.numpy(), 0.5)
+
+
+def test_bilinear_upsample_kernel():
+    w = _draw(nn.initializer.Bilinear(), (1, 1, 4, 4))
+    assert w.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(w[0, 0], w[0, 0].T, rtol=1e-6)  # symmetric
+    assert w.max() <= 1.0 and w.min() >= 0.0
+
+
+def test_seed_controls_init_determinism():
+    paddle.seed(1234)
+    a = _draw(nn.initializer.Normal(), (5, 5))
+    paddle.seed(1234)
+    b = _draw(nn.initializer.Normal(), (5, 5))
+    np.testing.assert_array_equal(a, b)

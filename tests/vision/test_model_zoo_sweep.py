@@ -1,0 +1,126 @@
+"""Forward-shape sweep over the full classic-CNN zoo.
+
+Reference parity: python/paddle/vision/models/__init__.py exports these
+builders/classes; test/legacy_test/test_vision_models.py drives each
+with a random image and checks the logits shape. Same discipline here:
+construct with a small ``num_classes``, forward a tiny batch, assert
+the classifier head shape (and that the output is finite).
+
+Kept deliberately small (batch 1, 64-128px) — this is an architecture
+wiring test, not a perf test; the MXU-path conv coverage lives in the
+op-level sweeps.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import vision
+from paddle_tpu.vision import models
+
+NC = 7  # classifier width: catches heads hard-wired to 1000
+
+# (builder, input HW) — builders referenced as values so the audit's
+# rooted-namespace scan credits them (same style as the op sweeps)
+BUILDERS = [
+    (vision.models.alexnet, 96),
+    (vision.models.densenet121, 64),
+    (vision.models.densenet161, 64),
+    (vision.models.densenet169, 64),
+    (vision.models.densenet201, 64),
+    (vision.models.densenet264, 64),
+    (vision.models.googlenet, 96),
+    (vision.models.inception_v3, 128),
+    (vision.models.mobilenet_v1, 64),
+    (vision.models.mobilenet_v2, 64),
+    (vision.models.mobilenet_v3_large, 64),
+    (vision.models.mobilenet_v3_small, 64),
+    (vision.models.resnet18, 64),
+    (vision.models.resnet34, 64),
+    (vision.models.resnet50, 64),
+    (vision.models.resnet101, 64),
+    (vision.models.resnet152, 64),
+    (vision.models.resnext50_32x4d, 64),
+    (vision.models.resnext50_64x4d, 64),
+    (vision.models.resnext101_32x4d, 64),
+    (vision.models.resnext101_64x4d, 64),
+    (vision.models.resnext152_32x4d, 64),
+    (vision.models.resnext152_64x4d, 64),
+    (vision.models.wide_resnet50_2, 64),
+    (vision.models.wide_resnet101_2, 64),
+    (vision.models.shufflenet_v2_x0_25, 64),
+    (vision.models.shufflenet_v2_x0_33, 64),
+    (vision.models.shufflenet_v2_x0_5, 64),
+    (vision.models.shufflenet_v2_x1_0, 64),
+    (vision.models.shufflenet_v2_x1_5, 64),
+    (vision.models.shufflenet_v2_x2_0, 64),
+    (vision.models.shufflenet_v2_swish, 64),
+    (vision.models.squeezenet1_0, 64),
+    (vision.models.squeezenet1_1, 64),
+    (vision.models.vgg11, 64),
+    (vision.models.vgg13, 64),
+    (vision.models.vgg16, 64),
+    (vision.models.vgg19, 64),
+]
+
+
+def _forward(net, hw, ch=3):
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal(
+            (1, ch, hw, hw)).astype("float32"))
+    net.eval()
+    with paddle.no_grad():
+        return net(x)
+
+
+def _check_logits(out):
+    if isinstance(out, (tuple, list)):  # googlenet: (out, aux1, aux2)
+        for o in out:
+            _check_logits(o)
+        return
+    assert list(out.shape) == [1, NC]
+    assert bool(np.isfinite(out.numpy()).all())
+
+
+@pytest.mark.parametrize("builder,hw", BUILDERS,
+                         ids=[b[0].__name__ for b in BUILDERS])
+def test_builder_forward(builder, hw):
+    net = builder(num_classes=NC)
+    _check_logits(_forward(net, hw))
+
+
+def test_lenet_forward():
+    net = vision.models.LeNet(num_classes=NC)
+    _check_logits(_forward(net, 28, ch=1))
+
+
+# class-form ctors (the functional builders above cover the same graphs;
+# these pin the exported class surface + custom arch args)
+def test_class_ctors():
+    _check_logits(_forward(vision.models.AlexNet(num_classes=NC), 96))
+    _check_logits(_forward(
+        vision.models.SqueezeNet(version="1.1", num_classes=NC), 64))
+    _check_logits(_forward(
+        vision.models.MobileNetV1(scale=0.25, num_classes=NC), 64))
+    _check_logits(_forward(
+        vision.models.MobileNetV2(scale=0.5, num_classes=NC), 64))
+
+
+def test_class_ctors_heavy():
+    _check_logits(_forward(
+        vision.models.DenseNet(layers=121, num_classes=NC), 64))
+    _check_logits(_forward(vision.models.GoogLeNet(num_classes=NC), 96))
+    _check_logits(_forward(vision.models.InceptionV3(num_classes=NC),
+                           128))
+    _check_logits(_forward(
+        vision.models.MobileNetV3Small(num_classes=NC), 64))
+    _check_logits(_forward(
+        vision.models.MobileNetV3Large(num_classes=NC), 64))
+    _check_logits(_forward(
+        vision.models.ShuffleNetV2(scale=0.5, num_classes=NC), 64))
+    from paddle_tpu.vision.models.resnet import BasicBlock
+    _check_logits(_forward(
+        vision.models.ResNet(BasicBlock, depth=18, num_classes=NC), 64))
+    from paddle_tpu.vision.models.vgg import _CFGS, _make_layers
+    _check_logits(_forward(
+        vision.models.VGG(_make_layers(_CFGS["A"]), num_classes=NC), 64))

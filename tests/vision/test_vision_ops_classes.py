@@ -1,0 +1,114 @@
+"""Layer-class forms of the detection ops + file/JPEG IO (parity:
+python/paddle/vision/ops.py RoIAlign/RoIPool/PSRoIPool/DeformConv2D,
+read_file/decode_jpeg, yolo_loss)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import vision
+
+R = np.random.default_rng(17)
+
+
+def _feat(n=1, c=4, h=8, w=8):
+    return paddle.to_tensor(R.standard_normal((n, c, h, w)).astype("f4"))
+
+
+def _boxes():
+    return (paddle.to_tensor(np.array([[0.0, 0.0, 6.0, 6.0],
+                                       [1.0, 1.0, 5.0, 7.0]], "f4")),
+            paddle.to_tensor(np.array([2], "int32")))
+
+
+def test_roi_align_class_matches_functional():
+    x = _feat()
+    boxes, num = _boxes()
+    layer = vision.ops.RoIAlign(output_size=3, spatial_scale=0.5)
+    got = layer(x, boxes, num)
+    ref = vision.ops.roi_align(x, boxes, num, 3, 0.5)
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-6)
+    assert list(got.shape) == [2, 4, 3, 3]
+
+
+def test_roi_pool_class_matches_functional():
+    x = _feat()
+    boxes, num = _boxes()
+    layer = vision.ops.RoIPool(output_size=2, spatial_scale=1.0)
+    got = layer(x, boxes, num)
+    ref = vision.ops.roi_pool(x, boxes, num, 2, 1.0)
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-6)
+    assert list(got.shape) == [2, 4, 2, 2]
+
+
+def test_psroi_pool_class_matches_functional():
+    # position-sensitive: C = out_c * oh * ow = 2 * 2 * 2
+    x = _feat(c=8)
+    boxes, num = _boxes()
+    layer = vision.ops.PSRoIPool(output_size=2, spatial_scale=1.0)
+    got = layer(x, boxes, num)
+    ref = vision.ops.psroi_pool(x, boxes, num, 2, 1.0)
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-6)
+    assert list(got.shape) == [2, 2, 2, 2]
+
+
+def test_deform_conv2d_zero_offset_is_conv():
+    """With zero offsets (and no mask) deformable conv degenerates to a
+    regular convolution — the reference op's defining identity."""
+    x = _feat(c=3)
+    w = paddle.to_tensor(R.standard_normal((5, 3, 3, 3)).astype("f4"))
+    off = paddle.zeros([1, 2 * 9, 8, 8])
+    got = vision.ops.deform_conv2d(x, off, w, padding=1)
+    ref = paddle.nn.functional.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deform_conv2d_layer():
+    layer = vision.ops.DeformConv2D(3, 5, 3, padding=1)
+    x = _feat(c=3)
+    off = paddle.zeros([1, 18, 8, 8])
+    out = layer(x, off)
+    assert list(out.shape) == [1, 5, 8, 8]
+    ref = paddle.nn.functional.conv2d(x, layer.weight, layer.bias,
+                                      padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_read_file_and_decode_jpeg(tmp_path):
+    from PIL import Image
+
+    arr = (R.uniform(0, 255, (16, 16, 3))).astype("uint8")
+    p = tmp_path / "img.jpg"
+    Image.fromarray(arr).save(p, quality=95)
+    raw = vision.ops.read_file(str(p))
+    assert raw.dtype == paddle.uint8
+    with open(p, "rb") as f:
+        np.testing.assert_array_equal(raw.numpy(),
+                                      np.frombuffer(f.read(), np.uint8))
+    img = vision.ops.decode_jpeg(raw)
+    oracle = np.asarray(Image.open(io.BytesIO(bytes(raw.numpy()))))
+    got = img.numpy()
+    if got.shape[0] == 3:  # CHW form
+        got = got.transpose(1, 2, 0)
+    np.testing.assert_array_equal(got, oracle)
+
+
+def test_yolo_loss_shapes_and_signal():
+    n, na, cls, h = 2, 3, 4, 4
+    x = paddle.to_tensor(R.standard_normal(
+        (n, na * (5 + cls), h, h)).astype("f4"))
+    gt_box = paddle.to_tensor(
+        np.array([[[0.5, 0.5, 0.3, 0.3], [0.2, 0.2, 0.1, 0.2]],
+                  [[0.7, 0.3, 0.2, 0.1], [0.0, 0.0, 0.0, 0.0]]], "f4"))
+    gt_label = paddle.to_tensor(np.array([[1, 2], [3, 0]], "int64"))
+    anchors = [10, 13, 16, 30, 33, 23]
+    loss = vision.ops.yolo_loss(x, gt_box, gt_label, anchors,
+                                anchor_mask=[0, 1, 2], class_num=cls,
+                                ignore_thresh=0.7, downsample_ratio=8)
+    out = loss.numpy()
+    assert out.shape == (n,)
+    assert np.isfinite(out).all() and (out > 0).all()

@@ -150,21 +150,35 @@ def _tested_names() -> set[str]:
     `paddle.foo(`, `F.foo(`, `paddle.linalg.foo(` etc. — dotted chains
     whose ROOT is a paddle namespace alias. Bare `x.foo(` matches are
     deliberately NOT counted (they would credit numpy/stdlib method
-    calls to same-named paddle ops). Usage-level evidence, weaker than
-    the per-op oracle sweep, but it cannot be inflated by cross-library
-    name collisions."""
+    calls to same-named paddle ops). Additionally, ONLY in the
+    table-driven sweep files (tests/**/test_*sweep*.py), `paddle.foo`
+    passed as a VALUE (followed by `,` / `)` / `]`) is counted — those
+    tables hand the op callable itself to a parametrized test that
+    calls it, e.g. `(paddle.abs, _any, np.abs, True)`: a call in all
+    but syntax. The value-rule is scoped to sweep files so that mere
+    mentions elsewhere (isinstance checks, skip lists,
+    `callable(dist.spawn)`) do NOT count as test evidence.
+    Usage-level evidence, weaker than the per-op oracle sweep, but it
+    cannot be inflated by cross-library name collisions."""
     global _TESTED_CACHE
     if _TESTED_CACHE is None:
         import re as _re
         tests = Path(__file__).resolve().parent.parent / "tests"
         roots = "|".join(_PADDLE_ROOTS)
-        pat = _re.compile(
+        call_pat = _re.compile(
             rf"\b(?:{roots})(?:\.[A-Za-z_][A-Za-z0-9_]*)*"
             rf"\.([A-Za-z_][A-Za-z0-9_]*)\s*\(")
+        value_pat = _re.compile(
+            rf"\b(?:{roots})(?:\.[A-Za-z_][A-Za-z0-9_]*)*"
+            rf"\.([A-Za-z_][A-Za-z0-9_]*)\s*[,)\]]")
         refs = set()
         for f in tests.rglob("*.py"):
-            for m in pat.finditer(f.read_text()):
+            text = f.read_text()
+            for m in call_pat.finditer(text):
                 refs.add(m.group(1))
+            if "sweep" in f.name:
+                for m in value_pat.finditer(text):
+                    refs.add(m.group(1))
         _TESTED_CACHE = refs
     return _TESTED_CACHE
 

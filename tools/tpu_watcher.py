@@ -1,0 +1,130 @@
+"""Tunnel watcher: harvest TPU windows for the remaining bench ladder.
+
+The axon tunnel comes and goes in short windows (~20-45 min observed);
+a full in-order ladder pass rarely fits in one. This watcher probes the
+backend every --interval seconds and, whenever the TPU answers, runs the
+not-yet-cached rungs one subprocess at a time — SHORT rungs first so a
+brief window still yields results — caching each success durably via
+bench._cache_rung (BENCH_TPU_RESULTS.json). After the ladder is
+complete it runs the pipeline-schedule tick A/B (tools/pipeline_tick_ab
+--device tpu → PIPELINE_TICKS.json) and exits.
+
+Usage: nohup python tools/tpu_watcher.py > /tmp/tpu_watcher.log 2>&1 &
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+# short rungs first: a 20-minute window should still harvest several
+ORDER = ["flash_ab", "paged_ab", "eager", "vit_l_train", "llama7b_decode",
+         "gpt_345m_fp8_train", "gpt_770m_train", "head"]
+TICKS_PATH = os.path.join(REPO, "PIPELINE_TICKS.json")
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def cached():
+    try:
+        with open(bench._cache_path()) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def missing_rungs():
+    have = cached()
+    return [r for r in ORDER if r not in have]
+
+
+def _ticks_backend():
+    try:
+        with open(TICKS_PATH) as f:
+            return json.load(f).get("config", {}).get("backend")
+    except (OSError, ValueError):
+        return None
+
+
+def ticks_done():
+    """Ticks count only if they were measured ON the TPU — a CPU
+    fallback run (tunnel dropped before pipeline_tick_ab started) must
+    not satisfy the deliverable."""
+    return _ticks_backend() not in (None, "cpu")
+
+
+def run_ticks():
+    log("running pipeline tick A/B on TPU ...")
+    p = subprocess.run(
+        [sys.executable, os.path.join(HERE, "pipeline_tick_ab.py"),
+         "--out", TICKS_PATH], cwd=REPO, capture_output=True, text=True,
+        timeout=2400)
+    if p.returncode == 0 and ticks_done():
+        log("pipeline ticks recorded (backend=%s)" % _ticks_backend())
+        return True
+    if p.returncode == 0 and os.path.exists(TICKS_PATH):
+        log("pipeline ticks ran on CPU fallback — discarding")
+        try:
+            os.unlink(TICKS_PATH)
+        except OSError:
+            pass
+        return False
+    log(f"pipeline ticks failed rc={p.returncode}: {(p.stderr or '')[-300:]}")
+    return False
+
+
+def main():
+    interval = 120
+    while True:
+        todo = missing_rungs()
+        if not todo and ticks_done():
+            log("ladder + ticks complete; exiting")
+            return
+        backend = bench._probe_backend_subprocess(timeout_s=150)
+        if backend is None or backend == "cpu":
+            log(f"tunnel down (backend={backend}); sleeping {interval}s "
+                f"(todo: {todo}{'' if ticks_done() else ' +ticks'})")
+            time.sleep(interval)
+            continue
+        log(f"TUNNEL UP — harvesting (todo: {todo})")
+        for name in todo:
+            t0 = time.time()
+            res = bench._run_rung_subprocess(name, timeout_s=1500)
+            dt = time.time() - t0
+            if isinstance(res, dict) and "skipped" not in res:
+                bench._cache_rung(name, res)
+                if name not in cached():
+                    # _cache_rung refused it: the child fell back to the
+                    # CPU backend mid-window — treat as a wedge
+                    log(f"  {name}: completed on CPU fallback, NOT "
+                        "cached; tunnel gone — back to probing")
+                    break
+                log(f"  {name}: OK in {dt:.0f}s "
+                    f"({json.dumps(res)[:120]})")
+            else:
+                log(f"  {name}: {str(res)[:200]} ({dt:.0f}s)")
+                if str(res.get('skipped', '')).startswith(
+                        bench.RUNG_TIMEOUT_PREFIX):
+                    if bench._probe_backend_subprocess(
+                            timeout_s=150) in (None, "cpu"):
+                        log("  tunnel wedged mid-harvest; back to probing")
+                        break
+        if not missing_rungs() and not ticks_done():
+            try:
+                run_ticks()
+            except subprocess.TimeoutExpired:
+                log("pipeline ticks timed out")
+        time.sleep(30)
+
+
+if __name__ == "__main__":
+    main()
