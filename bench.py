@@ -449,10 +449,16 @@ def run_rung(name, out_path):
     ladder data (_cache_rung gates on this)."""
     thunk = dict(_tpu_rung_specs())[name]
     res = _try(thunk)
-    if isinstance(res, dict):
-        res.setdefault("backend", jax.default_backend())
-        res.setdefault("device", getattr(jax.devices()[0], "device_kind",
-                                         "cpu").lower())
+    if isinstance(res, dict) and "skipped" not in res:
+        # never re-touch the backend after a caught init failure: that
+        # would re-raise and replace the descriptive skip reason with a
+        # generic rc!=0 error
+        try:
+            res.setdefault("backend", jax.default_backend())
+            res.setdefault("device", getattr(
+                jax.devices()[0], "device_kind", "cpu").lower())
+        except Exception as e:  # pragma: no cover
+            res.setdefault("device", f"unknown ({type(e).__name__})")
     with open(out_path, "w") as f:
         json.dump(res, f)
 

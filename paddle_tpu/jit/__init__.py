@@ -404,9 +404,12 @@ class TrainStep:
                     have = [i for i, g in enumerate(grads) if g is not None]
                     clipped = opt._grad_clip._clip_arrays(
                         [grads[i] for i in have],
-                        [param_objs[i].need_clip for i in have])
+                        [getattr(param_objs[i], "need_clip", True)
+                         for i in have])
                     for i, g in zip(have, clipped):
                         grads[i] = g
+
+                from ..optimizer.optimizer import _lr_mult
 
                 opt._t = t
                 new_params = []
@@ -417,8 +420,7 @@ class TrainStep:
                         new_params.append(p._data)
                         new_slots.append(st)
                         continue
-                    lr_p = (lr * group["lr_mult"] *
-                            p.optimize_attr.get("learning_rate", 1.0))
+                    lr_p = lr * group["lr_mult"] * _lr_mult(p)
                     p32 = st["master"] if st.get("master") is not None \
                         else p._data.astype(jnp.float32)
                     g32 = g.astype(jnp.float32)

@@ -34,7 +34,7 @@ class ClipGradByValue(ClipGradBase):
 
     def _apply(self, params):
         for p in params:
-            if p.grad is None or not p.need_clip:
+            if p.grad is None or not getattr(p, "need_clip", True):
                 continue
             p.grad._rebind(jnp.clip(p.grad._data, self.min, self.max))
 
@@ -50,7 +50,7 @@ class ClipGradByNorm(ClipGradBase):
 
     def _apply(self, params):
         for p in params:
-            if p.grad is None or not p.need_clip:
+            if p.grad is None or not getattr(p, "need_clip", True):
                 continue
             g = p.grad._data.astype(jnp.float32)
             norm = jnp.sqrt(jnp.sum(g * g))
@@ -81,7 +81,7 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
     def _apply(self, params):
         grads = [p.grad for p in params
-                 if p.grad is not None and p.need_clip]
+                 if p.grad is not None and getattr(p, "need_clip", True)]
         if not grads:
             return
         sq = sum(jnp.sum(jnp.square(g._data.astype(jnp.float32)))

@@ -33,6 +33,12 @@ def _as_float_or_none(wd):
     return "l2", float(wd)
 
 
+def _lr_mult(p):
+    """Per-parameter LR multiplier; plain Tensors (the reference accepts
+    them in parameter lists) have no optimize_attr and default to 1."""
+    return getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+
+
 class Optimizer:
     """Base optimizer.
 
@@ -173,7 +179,7 @@ class Optimizer:
             for p in group["params"]:
                 if p.grad is None or p.stop_gradient:
                     continue
-                lr_p = lr * p.optimize_attr.get("learning_rate", 1.0)
+                lr_p = lr * _lr_mult(p)
                 st = self._slots_for(p)
                 p32 = st["master"] if st["master"] is not None \
                     else p._data.astype(jnp.float32)
@@ -205,7 +211,7 @@ class Optimizer:
             return False
         sig = tuple(
             (id(g), g["lr_mult"], g["weight_decay"], g["wd_mode"],
-             p.optimize_attr.get("learning_rate", 1.0), p.need_clip,
+             _lr_mult(p), getattr(p, "need_clip", True),
              self._wants_decay(p), str(p._data.dtype))
             for p, g in items) + (id(self._grad_clip),)
         cached = getattr(self, "_fused_cache", None)
@@ -215,9 +221,10 @@ class Optimizer:
             groups_s = [g for _, g in items]
             params_s = [p for p, _ in items]
             lr_mults = [g["lr_mult"] *
-                        p.optimize_attr.get("learning_rate", 1.0)
+                        _lr_mult(p)
                         for p, g in items]
-            need_clip = [p.need_clip for p, _ in items]
+            need_clip = [getattr(p, "need_clip", True)
+                         for p, _ in items]
             dtypes = [p._data.dtype for p, _ in items]
             clip = self._grad_clip
             opt = self

@@ -112,10 +112,17 @@ class Cifar10(Dataset):
         root = _HOME
         archive = data_file or os.path.join(root, "cifar-10-python.tar.gz")
         folder = os.path.join(root, self.NAME)
-        if download and not os.path.isdir(folder):
-            _fetch(self.URL, archive)
+        if not os.path.isdir(folder):
+            # a user-supplied data_file is extracted, NEVER re-fetched
+            # over (reference cifar.py honors the local archive)
+            if not os.path.exists(archive):
+                if not download:
+                    raise RuntimeError(f"{archive} missing and "
+                                       "download=False")
+                _fetch(self.URL, archive)
+            os.makedirs(root, exist_ok=True)
             with tarfile.open(archive) as tf:
-                tf.extractall(root)
+                tf.extractall(root, filter="data")
         batches = [f"data_batch_{i}" for i in range(1, 6)] \
             if mode == "train" else ["test_batch"]
         xs, ys = [], []
@@ -149,10 +156,15 @@ class Cifar100(Cifar10):
         root = _HOME
         archive = data_file or os.path.join(root, "cifar-100-python.tar.gz")
         folder = os.path.join(root, self.NAME)
-        if download and not os.path.isdir(folder):
-            _fetch(self.URL, archive)
+        if not os.path.isdir(folder):
+            if not os.path.exists(archive):
+                if not download:
+                    raise RuntimeError(f"{archive} missing and "
+                                       "download=False")
+                _fetch(self.URL, archive)
+            os.makedirs(root, exist_ok=True)
             with tarfile.open(archive) as tf:
-                tf.extractall(root)
+                tf.extractall(root, filter="data")
         fname = "train" if mode == "train" else "test"
         with open(os.path.join(folder, fname), "rb") as f:
             d = pickle.load(f, encoding="bytes")

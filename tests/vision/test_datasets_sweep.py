@@ -1,0 +1,174 @@
+"""Vision dataset parsers driven from synthesized local archives
+(reference python/paddle/vision/datasets/: mnist.py idx format,
+cifar.py pickled batches, folder.py class-per-dir). Hermetic — no
+network; _HOME is pointed at tmp_path."""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import vision
+from paddle_tpu.vision import datasets as D
+
+
+def _write_idx_images(path, imgs):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, len(imgs), 28, 28))
+        f.write(np.asarray(imgs, np.uint8).tobytes())
+
+
+def _write_idx_labels(path, labels):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">II", 2049, len(labels)))
+        f.write(np.asarray(labels, np.uint8).tobytes())
+
+
+def test_mnist_idx_parsing(tmp_path):
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (5, 28, 28), dtype=np.uint8)
+    labels = np.array([3, 1, 4, 1, 5], np.uint8)
+    ip, lp = str(tmp_path / "img.gz"), str(tmp_path / "lab.gz")
+    _write_idx_images(ip, imgs)
+    _write_idx_labels(lp, labels)
+    ds = vision.datasets.MNIST(image_path=ip, label_path=lp,
+                               mode="train", download=False)
+    assert len(ds) == 5
+    x, y = ds[2]
+    assert x.shape == (1, 28, 28) and x.dtype == np.float32
+    assert int(y) == 4
+    np.testing.assert_allclose(x[0], imgs[2].astype("f4") / 255.0)
+    # FashionMNIST shares the idx machinery
+    fds = vision.datasets.FashionMNIST(image_path=ip, label_path=lp,
+                                       mode="test", download=False)
+    assert len(fds) == 5 and int(fds[4][1]) == 5
+
+
+def _cifar_archive(tmp_path, name, folder, batches, labels_key):
+    rng = np.random.default_rng(1)
+    p = tmp_path / name
+    with tarfile.open(p, "w:gz") as tf:
+        for bname, n in batches:
+            d = {b"data": rng.integers(0, 256, (n, 3072),
+                                       dtype=np.uint8).astype(np.uint8),
+                 labels_key: list(rng.integers(0, 10, n))}
+            import io
+            raw = pickle.dumps(d)
+            info = tarfile.TarInfo(f"{folder}/{bname}")
+            info.size = len(raw)
+            tf.addfile(info, io.BytesIO(raw))
+    return str(p)
+
+
+def test_cifar10_local(tmp_path, monkeypatch):
+    monkeypatch.setattr(D, "_HOME", str(tmp_path / "home"))
+    arch = _cifar_archive(
+        tmp_path, "cifar-10-python.tar.gz", "cifar-10-batches-py",
+        [(f"data_batch_{i}", 4) for i in range(1, 6)] +
+        [("test_batch", 3)], b"labels")
+    train = vision.datasets.Cifar10(data_file=arch, mode="train",
+                                    download=False)
+    assert len(train) == 20
+    x, y = train[0]
+    assert x.shape == (3, 32, 32) and x.dtype == np.float32
+    assert 0 <= int(y) < 10
+    test = vision.datasets.Cifar10(data_file=arch, mode="test",
+                                   download=False)
+    assert len(test) == 3
+
+
+def test_cifar100_local(tmp_path, monkeypatch):
+    monkeypatch.setattr(D, "_HOME", str(tmp_path / "home"))
+    arch = _cifar_archive(
+        tmp_path, "cifar-100-python.tar.gz", "cifar-100-python",
+        [("train", 6), ("test", 2)], b"fine_labels")
+    train = vision.datasets.Cifar100(data_file=arch, mode="train",
+                                     download=False)
+    assert len(train) == 6
+    assert train[0][0].shape == (3, 32, 32)
+    test = vision.datasets.Cifar100(data_file=arch, mode="test",
+                                    download=False)
+    assert len(test) == 2
+
+
+def test_cifar_download_false_missing_raises(tmp_path, monkeypatch):
+    monkeypatch.setattr(D, "_HOME", str(tmp_path / "nope"))
+    with pytest.raises(RuntimeError):
+        vision.datasets.Cifar10(data_file=str(tmp_path / "missing.tgz"),
+                                download=False)
+
+
+def _img_tree(tmp_path):
+    from PIL import Image
+    rng = np.random.default_rng(2)
+    root = tmp_path / "imgs"
+    for cls in ("cat", "dog"):
+        os.makedirs(root / cls)
+        for i in range(2):
+            arr = rng.integers(0, 256, (8, 8, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(root / cls / f"{i}.png")
+    return str(root)
+
+
+def test_dataset_folder(tmp_path):
+    root = _img_tree(tmp_path)
+    ds = vision.datasets.DatasetFolder(root)
+    assert ds.classes == ["cat", "dog"]
+    assert ds.class_to_idx == {"cat": 0, "dog": 1}
+    assert len(ds) == 4
+    img, target = ds[0]
+    assert img.shape == (8, 8, 3) and target == 0
+    assert ds[3][1] == 1
+    # custom loader + extension filter
+    npy_dir = tmp_path / "npys" / "a"
+    os.makedirs(npy_dir)
+    np.save(npy_dir / "x.npy", np.zeros((2, 2), "f4"))
+    ds2 = vision.datasets.DatasetFolder(str(tmp_path / "npys"),
+                                        extensions=(".npy",))
+    assert len(ds2) == 1 and ds2[0][0].shape == (2, 2)
+
+
+def test_image_folder(tmp_path):
+    from PIL import Image
+    rng = np.random.default_rng(3)
+    root = tmp_path / "flat"
+    os.makedirs(root)
+    for i in range(3):
+        Image.fromarray(rng.integers(0, 256, (6, 6, 3),
+                                     dtype=np.uint8)).save(
+            root / f"{i}.jpg")
+    ds = vision.datasets.ImageFolder(str(root))
+    assert len(ds) == 3
+    (img,) = ds[1]
+    assert img.shape == (6, 6, 3)
+
+
+def test_folder_with_transform(tmp_path):
+    root = _img_tree(tmp_path)
+    ds = vision.datasets.DatasetFolder(
+        root, transform=vision.transforms.ToTensor())
+    img, _ = ds[0]
+    assert list(img.shape) == [3, 8, 8]  # CHW
+
+
+def test_base_transform_and_to_tensor():
+    arr = (np.arange(48).reshape(4, 4, 3) * 5).astype("uint8")
+    t = vision.transforms.ToTensor()
+    out = t(arr)
+    assert list(out.shape) == [3, 4, 4]
+    np.testing.assert_allclose(
+        np.asarray(out.numpy()),
+        arr.transpose(2, 0, 1).astype("f4") / 255.0, rtol=1e-6)
+
+    class Double(vision.transforms.BaseTransform):
+        def _apply_image(self, img):
+            return img * 2
+
+    assert (Double()(np.ones((2, 2))) == 2).all()
+    with pytest.raises(NotImplementedError):
+        vision.transforms.BaseTransform()(arr)

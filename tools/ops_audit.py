@@ -141,7 +141,7 @@ _PADDLE_ROOTS = (
     "incubate", "profiler", "optimizer", "quantization", "amp",
     "autograd", "jit", "io", "vision", "audio", "text", "metric",
     "distribution", "geometric", "onnx", "static", "functional",
-    "Tensor",
+    "Tensor", "fleet", "device",
 )
 
 
@@ -157,12 +157,16 @@ def _tested_names() -> set[str]:
     calls it, e.g. `(paddle.abs, _any, np.abs, True)`: a call in all
     but syntax. The value-rule is scoped to sweep files so that mere
     mentions elsewhere (isinstance checks, skip lists,
-    `callable(dist.spawn)`) do NOT count as test evidence.
+    `callable(dist.spawn)`) do NOT count as test evidence — and both
+    rules run over CODE TOKENS only (comments and string literals are
+    stripped first), so a name in a docstring or comment never counts.
     Usage-level evidence, weaker than the per-op oracle sweep, but it
     cannot be inflated by cross-library name collisions."""
     global _TESTED_CACHE
     if _TESTED_CACHE is None:
+        import io
         import re as _re
+        import tokenize
         tests = Path(__file__).resolve().parent.parent / "tests"
         roots = "|".join(_PADDLE_ROOTS)
         call_pat = _re.compile(
@@ -171,9 +175,35 @@ def _tested_names() -> set[str]:
         value_pat = _re.compile(
             rf"\b(?:{roots})(?:\.[A-Za-z_][A-Za-z0-9_]*)*"
             rf"\.([A-Za-z_][A-Za-z0-9_]*)\s*[,)\]]")
+
+        def _code_only(text):
+            """Source with comments + string/docstring tokens blanked.
+            Tokens are re-joined tight (no inserted spaces) so dotted
+            chains like `paddle.abs` stay regex-matchable; a space is
+            added only between two identifier-like tokens."""
+            namey = (tokenize.NAME, tokenize.NUMBER)
+            out, prev = [], None
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(text).readline):
+                    if tok.type in (tokenize.COMMENT, tokenize.STRING):
+                        continue
+                    if tok.type in (tokenize.NEWLINE, tokenize.NL,
+                                    tokenize.INDENT, tokenize.DEDENT):
+                        out.append("\n")
+                        prev = None
+                        continue
+                    if prev in namey and tok.type in namey:
+                        out.append(" ")
+                    out.append(tok.string)
+                    prev = tok.type
+            except (tokenize.TokenError, IndentationError):
+                return text  # unparsable: fall back to raw text
+            return "".join(out)
+
         refs = set()
         for f in tests.rglob("*.py"):
-            text = f.read_text()
+            text = _code_only(f.read_text())
             for m in call_pat.finditer(text):
                 refs.add(m.group(1))
             if "sweep" in f.name:
