@@ -227,16 +227,105 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     return tensor
 
 
+_P2P_CHUNK = 1 << 19  # store.get reads into a 1 MB buffer; stay under
+_p2p_state = None
+
+
+def _p2p():
+    """Lazy TCPStore channel for eager cross-process p2p. Rank 0 hosts
+    the server on PADDLE_MASTER's port + 7 (clear of the rendezvous,
+    rpc and ps stores); every rank connects a client."""
+    global _p2p_state
+    if _p2p_state is None:
+        import os
+
+        from .env import get_rank
+        from .store import TCPStore
+        addr = os.environ.get("PADDLE_P2P_MASTER") or \
+            os.environ.get("PADDLE_MASTER", "127.0.0.1:8711")
+        host, port = addr.rsplit(":", 1)
+        port = int(port) + 7
+        store = TCPStore(host, port, is_master=(get_rank() == 0))
+        _p2p_state = (store, {}, {})
+    return _p2p_state
+
+
+def _p2p_guard(g, fn_name, tensor):
+    import jax.core
+    if _in_shard_map(g.axis_name) or isinstance(
+            getattr(tensor, "_data", tensor), jax.core.Tracer):
+        # the default world group has axis_name None, so also catch the
+        # traced case directly — a tracer must never reach .numpy()
+        raise NotImplementedError(
+            f"{fn_name} inside traced/shard_map code: use "
+            "paddle_tpu.distributed.ppermute (the ICI form of p2p) / "
+            "the pipeline engine")
+    from .env import get_world_size
+    if get_world_size() <= 1:
+        raise RuntimeError(
+            f"{fn_name} requires a multi-process launch (it is the "
+            "eager MPMD p2p path; in-mesh p2p is ppermute)")
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv only exist inside shard_map pipelines "
-        "(use paddle_tpu.distributed.ppermute / the pipeline engine)")
+    """Eager cross-process point-to-point send (reference
+    communication/send.py over NCCL P2P; here: length-chunked frames on
+    the native TCPStore — the DCN control-plane path. ICI-speed p2p
+    inside compiled code is `ppermute`)."""
+    import pickle
+
+    import numpy as np
+    g = _group(group)
+    _p2p_guard(g, "send", tensor)
+    from .env import get_rank
+    store, sseq, _ = _p2p()
+    src = get_rank()
+    seq = sseq.get((src, dst), 0)
+    sseq[(src, dst)] = seq + 1
+    arr = np.asarray(tensor.numpy() if hasattr(tensor, "numpy")
+                     else tensor)
+    raw = arr.tobytes()
+    base = f"p2p/{src}/{dst}/{seq}"
+    chunks = [raw[i:i + _P2P_CHUNK]
+              for i in range(0, len(raw), _P2P_CHUNK)] or [b""]
+    for ci, c in enumerate(chunks):
+        store.set(f"{base}/c{ci}", c)
+    # header last: its presence means every chunk is readable
+    store.set(f"{base}/h",
+              pickle.dumps((str(arr.dtype), arr.shape, len(chunks))))
+    return None
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv only exist inside shard_map pipelines "
-        "(use paddle_tpu.distributed.ppermute / the pipeline engine)")
+    """Blocking receive matching :func:`send`; fills ``tensor``
+    in-place and returns it (reference communication/recv.py
+    semantics)."""
+    import pickle
+
+    import numpy as np
+    g = _group(group)
+    _p2p_guard(g, "recv", tensor)
+    from .env import get_rank
+    store, _, rseq = _p2p()
+    dst = get_rank()
+    seq = rseq.get((src, dst), 0)
+    rseq[(src, dst)] = seq + 1
+    base = f"p2p/{src}/{dst}/{seq}"
+    store.wait([f"{base}/h"])
+    dtype, shape, nch = pickle.loads(store.get(f"{base}/h"))
+    raw = b"".join(store.get(f"{base}/c{i}") for i in range(nch))
+    arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
+    for i in range(nch):
+        store.delete_key(f"{base}/c{i}")
+    store.delete_key(f"{base}/h")
+    if tuple(tensor.shape) != tuple(shape):
+        raise ValueError(
+            f"recv: tensor shape {tuple(tensor.shape)} != sent {shape}")
+    if str(np.dtype(str(tensor.numpy().dtype))) != str(np.dtype(dtype)):
+        raise ValueError(
+            f"recv: tensor dtype {tensor.numpy().dtype} != sent {dtype}")
+    from ..ops import _inplace_from
+    return _inplace_from(tensor, Tensor(jnp.asarray(arr)))
 
 
 def ppermute(tensor, perm, group=None):

@@ -34,6 +34,14 @@ def init_parallel_env(strategy=None):
     if coord and nprocs > 1:
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nprocs, process_id=pid)
+        # bring up the p2p store channel NOW: its server lives on rank 0,
+        # and lazily starting it on rank 0's first send/recv would hang
+        # p2p between two non-zero ranks (rank 0 might never call it)
+        try:
+            from .collective import _p2p
+            _p2p()
+        except Exception:  # p2p stays lazily-retried on first use
+            pass
     _initialized = True
 
 

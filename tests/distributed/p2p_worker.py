@@ -1,0 +1,46 @@
+"""Per-rank worker for the eager send/recv E2E test: rank 0 sends a
+large (multi-chunk) array and a small one to rank 1; rank 1 receives
+in-place and echoes a transformed reply. Results are asserted per-rank
+and a sentinel file proves completion."""
+
+import sys
+
+import numpy as np
+
+
+def main():
+    out_dir = sys.argv[1]
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+
+    big = np.arange(200_000, dtype="float32").reshape(500, 400)  # ~800KB
+    small = np.array([7, 8, 9], "int64")
+
+    if rank == 0:
+        dist.send(paddle.to_tensor(big), dst=1)
+        dist.send(paddle.to_tensor(small), dst=1)
+        reply = paddle.zeros([500, 400])
+        dist.recv(reply, src=1)
+        np.testing.assert_allclose(reply.numpy(), big * 2.0, rtol=1e-6)
+    else:
+        buf = paddle.zeros([500, 400])
+        got = dist.recv(buf, src=0)
+        assert got is buf  # fills the provided tensor in-place
+        np.testing.assert_allclose(buf.numpy(), big, rtol=1e-6)
+        ibuf = paddle.zeros([3]).astype("int64")
+        dist.recv(ibuf, src=0)
+        np.testing.assert_array_equal(ibuf.numpy(), small)
+        dist.send(paddle.to_tensor(big * 2.0), dst=0)
+
+    with open(f"{out_dir}/p2p_ok_{rank}", "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -82,12 +82,24 @@ def run_ticks():
     return False
 
 
+TRACE_DIR = os.path.join(REPO, "traces", "headline_tpu")
+
+
+def trace_done():
+    """A capture counts only if it produced files (a crashed capture
+    leaves the bare directory — retry those)."""
+    for _root, _dirs, files in os.walk(TRACE_DIR):
+        if files:
+            return True
+    return False
+
+
 def main():
     interval = 120
     while True:
         todo = missing_rungs()
-        if not todo and ticks_done():
-            log("ladder + ticks complete; exiting")
+        if not todo and ticks_done() and trace_done():
+            log("ladder + ticks + trace complete; exiting")
             return
         backend = bench._probe_backend_subprocess(timeout_s=150)
         if backend is None or backend == "cpu":
@@ -134,6 +146,21 @@ def main():
                     run_ticks()
                 except subprocess.TimeoutExpired:
                     log("pipeline ticks timed out")
+            if not missing_rungs() and not trace_done():
+                log("capturing headline device trace ...")
+                try:
+                    p = subprocess.run(
+                        [sys.executable,
+                         os.path.join(HERE, "capture_headline_trace.py")],
+                        cwd=REPO, capture_output=True, text=True,
+                        timeout=1200)
+                    log(f"trace: rc={p.returncode} "
+                        f"{(p.stdout or '')[-200:]}")
+                except subprocess.TimeoutExpired:
+                    log("trace capture timed out")
+                if not trace_done():
+                    import shutil
+                    shutil.rmtree(TRACE_DIR, ignore_errors=True)
         finally:
             # never leak the sentinel: it gates cooperating jobs forever
             try:
