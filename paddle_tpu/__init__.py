@@ -54,6 +54,11 @@ from . import distributed  # noqa: F401
 from .core.flags import get_flags, set_flags  # noqa: F401
 from . import profiler  # noqa: F401
 from . import hapi  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import hub  # noqa: F401
+from . import reader  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import version  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from . import audio  # noqa: F401
 from . import distribution  # noqa: F401

@@ -1,0 +1,24 @@
+"""`paddle.callbacks` (reference python/paddle/callbacks.py re-exports
+the hapi training callbacks)."""
+
+from .hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    ReduceLROnPlateau,
+    VisualDL,
+    WandbCallback,
+)
+
+__all__ = [
+    "Callback",
+    "ProgBarLogger",
+    "ModelCheckpoint",
+    "VisualDL",
+    "LRScheduler",
+    "EarlyStopping",
+    "ReduceLROnPlateau",
+    "WandbCallback",
+]

@@ -281,7 +281,6 @@ def send(tensor, dst=0, group=None, sync_op=True):
     store, sseq, _ = _p2p()
     src = get_rank()
     seq = sseq.get((src, dst), 0)
-    sseq[(src, dst)] = seq + 1
     arr = np.asarray(tensor.numpy() if hasattr(tensor, "numpy")
                      else tensor)
     raw = arr.tobytes()
@@ -293,6 +292,9 @@ def send(tensor, dst=0, group=None, sync_op=True):
     # header last: its presence means every chunk is readable
     store.set(f"{base}/h",
               pickle.dumps((str(arr.dtype), arr.shape, len(chunks))))
+    # commit the sequence only on success: a failed set must leave the
+    # channel aligned so a retry reuses the same slot
+    sseq[(src, dst)] = seq + 1
     return None
 
 
@@ -309,9 +311,11 @@ def recv(tensor, src=0, group=None, sync_op=True):
     store, _, rseq = _p2p()
     dst = get_rank()
     seq = rseq.get((src, dst), 0)
-    rseq[(src, dst)] = seq + 1
     base = f"p2p/{src}/{dst}/{seq}"
+    # commit the sequence only after the message arrives: a timeout here
+    # must not desynchronize the channel (the retry waits on seq again)
     store.wait([f"{base}/h"])
+    rseq[(src, dst)] = seq + 1
     dtype, shape, nch = pickle.loads(store.get(f"{base}/h"))
     raw = b"".join(store.get(f"{base}/c{i}") for i in range(nch))
     arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
@@ -321,9 +325,10 @@ def recv(tensor, src=0, group=None, sync_op=True):
     if tuple(tensor.shape) != tuple(shape):
         raise ValueError(
             f"recv: tensor shape {tuple(tensor.shape)} != sent {shape}")
-    if str(np.dtype(str(tensor.numpy().dtype))) != str(np.dtype(dtype)):
+    buf_dtype = str(np.dtype(getattr(tensor, "_data", tensor).dtype))
+    if buf_dtype != str(np.dtype(dtype)):
         raise ValueError(
-            f"recv: tensor dtype {tensor.numpy().dtype} != sent {dtype}")
+            f"recv: tensor dtype {buf_dtype} != sent {dtype}")
     from ..ops import _inplace_from
     return _inplace_from(tensor, Tensor(jnp.asarray(arr)))
 

@@ -1,0 +1,96 @@
+"""`paddle.hub` backend (parity: reference python/paddle/hapi/hub.py:
+list/help/load over a repo's hubconf.py entrypoints; sources github /
+gitee / local). Hermetic environments use source='local'; remote
+sources download+cache a repo archive (requires egress)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import zipfile
+
+_HUB_DIR = os.path.expanduser(os.environ.get(
+    "PADDLE_TPU_HUB_DIR", "~/.cache/paddle_tpu/hub"))
+
+
+def _fetch_repo(repo, source, force_reload):
+    owner_repo, _, branch = repo.partition(":")
+    branch = branch or "main"
+    name = owner_repo.replace("/", "_") + "_" + branch
+    target = os.path.join(_HUB_DIR, name)
+    if os.path.isdir(target) and not force_reload:
+        return target
+    host = {"github": "https://github.com/{}/archive/{}.zip",
+            "gitee": "https://gitee.com/{}/repository/archive/{}.zip"}[
+        source]
+    url = host.format(owner_repo, branch)
+    os.makedirs(_HUB_DIR, exist_ok=True)
+    zpath = target + ".zip"
+    import urllib.request
+    try:
+        urllib.request.urlretrieve(url, zpath)
+    except Exception as e:
+        raise RuntimeError(
+            f"paddle.hub: cannot download {url} ({e}); in hermetic "
+            "environments pass source='local' with a local repo_dir "
+            "containing hubconf.py") from e
+    import shutil
+    with zipfile.ZipFile(zpath) as zf:
+        roots = {n.split("/", 1)[0] for n in zf.namelist()}
+        zf.extractall(_HUB_DIR)
+    # force_reload refreshes an existing cache entry: clear it first
+    # (os.rename onto a non-empty dir raises ENOTEMPTY)
+    shutil.rmtree(target, ignore_errors=True)
+    os.rename(os.path.join(_HUB_DIR, roots.pop()), target)
+    return target
+
+
+def _hubconf(repo_dir, source, force_reload):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f"unknown source {source!r}: expected github/gitee/local")
+    path = repo_dir if source == "local" else _fetch_repo(
+        repo_dir, source, force_reload)
+    conf = os.path.join(path, "hubconf.py")
+    if not os.path.exists(conf):
+        raise RuntimeError(f"no hubconf.py under {path}")
+    spec = importlib.util.spec_from_file_location(
+        f"paddle_tpu_hubconf_{abs(hash(conf))}", conf)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, path)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(path)
+    return mod
+
+
+def _entrypoints(mod):
+    return {n: f for n, f in vars(mod).items()
+            if callable(f) and not n.startswith("_")}
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """Names of the callable entrypoints exported by the repo's
+    hubconf.py (reference hapi/hub.py:182)."""
+    return sorted(_entrypoints(_hubconf(repo_dir, source, force_reload)))
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A002
+    """The entrypoint's docstring (reference hapi/hub.py:232)."""
+    eps = _entrypoints(_hubconf(repo_dir, source, force_reload))
+    if model not in eps:
+        raise RuntimeError(f"no entrypoint {model!r}; have "
+                           f"{sorted(eps)}")
+    return eps[model].__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Call the entrypoint and return its model
+    (reference hapi/hub.py:280)."""
+    eps = _entrypoints(_hubconf(repo_dir, source, force_reload))
+    if model not in eps:
+        raise RuntimeError(f"no entrypoint {model!r}; have "
+                           f"{sorted(eps)}")
+    return eps[model](**kwargs)

@@ -1,0 +1,6 @@
+"""`paddle.hub` (reference python/paddle/hub.py re-exports the hapi
+hub entrypoint loaders)."""
+
+from .hapi.hub import help, list, load  # noqa: F401,A004
+
+__all__ = ["list", "help", "load"]

@@ -78,6 +78,63 @@ def _round_up(x: int, m: int) -> int:
 # the candidate grid on-device and caches the winner.
 _AUTOTUNE = {"enable": False, "cache": {}}
 
+
+def _tune_file():
+    import os
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))  # .../paddle_tpu
+    return os.environ.get(
+        "PADDLE_TPU_AUTOTUNE_CACHE",
+        os.path.join(os.path.dirname(pkg), ".pallas_autotune.json"))
+
+
+def _device_kind():
+    try:
+        return getattr(jax.devices()[0], "device_kind", "cpu").lower()
+    except Exception:  # noqa: BLE001
+        return "cpu"
+
+
+def _tune_cache_load(tkey):
+    """File-backed sweep results: bench rungs run one-per-process (a
+    PJRT TPU client is exclusive), so an in-memory cache makes every
+    child re-pay the multi-minute on-chip sweep. Keyed by device kind —
+    a v5e winner means nothing on another generation."""
+    import json
+    import os
+    path = _tune_file()
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        hit = data.get(_device_kind(), {}).get(repr(tkey))
+        return tuple(hit) if hit else None
+    except (OSError, ValueError):
+        return None
+
+
+def _tune_cache_store(tkey, blocks):
+    import fcntl
+    import json
+    import os
+    path = _tune_file()
+    try:
+        with open(path + ".lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                data = {}
+            data.setdefault(_device_kind(), {})[repr(tkey)] = list(blocks)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+    except OSError:
+        pass
+
 _SWEEP_BQ = (128, 256, 512, 1024)
 _SWEEP_BK = (256, 512, 1024)
 
@@ -702,12 +759,17 @@ def flash_attention(q, k, v, causal=False, scale=None,
             and not has_seg and not _interpret():
         tkey = (B, Sq, Sk, Hq, Hk, D, causal, str(q.dtype))
         tuned = _AUTOTUNE["cache"].get(tkey)
+        if tuned is None:
+            tuned = _tune_cache_load(tkey)
+            if tuned is not None:
+                _AUTOTUNE["cache"][tkey] = tuned
         if tuned is None and not isinstance(q, jax.core.Tracer):
             # sweep only on concrete arrays — under a jit trace the
             # timings are meaningless and caching here would pin the
             # defaults for this shape forever
             tuned = _sweep_blocks(q, k, v, causal, scale, Sq, Sk, G)
             _AUTOTUNE["cache"][tkey] = tuned
+            _tune_cache_store(tkey, tuned)
         if tuned is not None:
             bq, bk = tuned
     if block_q:

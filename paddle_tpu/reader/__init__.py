@@ -1,0 +1,219 @@
+"""`paddle.reader` — functional reader-decorator utilities (parity:
+reference python/paddle/reader/decorator.py: cache, map_readers,
+shuffle, chain, compose, buffered, firstn, xmap_readers,
+multiprocess_reader). A *reader* is a zero-arg callable returning an
+iterable of samples; decorators wrap readers into new readers."""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = []  # reference keeps these importable but un-exported
+
+
+class _Raise:
+    """Exception carrier: a worker thread that dies must surface its
+    error at the consumer, never leave it blocked on q.get()."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def cache(reader):
+    """Materialize the wrapped reader once; replay from memory after."""
+    memo = []
+    filled = [False]
+
+    def wrapped():
+        if not filled[0]:
+            memo.extend(reader())
+            filled[0] = True
+        return iter(memo)
+    return wrapped
+
+
+def map_readers(func, *readers):
+    """Yield ``func(a, b, ...)`` over the zipped sample streams."""
+    def wrapped():
+        its = [r() for r in readers]
+        for args in zip(*its):
+            yield func(*args)
+    return wrapped
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer of ``buf_size`` samples."""
+    def wrapped():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return wrapped
+
+
+def chain(*readers):
+    """Concatenate sample streams end to end."""
+    def wrapped():
+        return itertools.chain(*(r() for r in readers))
+    return wrapped
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment=True):
+    """Zip readers into flat tuples: samples (1, 2) + (3, 4) -> (1, 2,
+    3, 4); raises ComposeNotAligned when streams end unevenly (unless
+    ``check_alignment`` is False)."""
+    def _tuple(s):
+        return s if isinstance(s, tuple) else (s,)
+
+    def wrapped():
+        its = [r() for r in readers]
+        _SENTINEL = object()
+        while True:
+            row = [next(it, _SENTINEL) for it in its]
+            done = [s is _SENTINEL for s in row]
+            if all(done):
+                return
+            if any(done):
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                return
+            yield sum((_tuple(s) for s in row), ())
+    return wrapped
+
+
+def buffered(reader, size):
+    """Prefetch up to ``size`` samples on a background thread."""
+    def wrapped():
+        q = _queue.Queue(maxsize=size)
+        _END = object()
+
+        def fill():
+            try:
+                for s in reader():
+                    q.put(s)
+                q.put(_END)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                q.put(_Raise(e))
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is _END:
+                return
+            if isinstance(s, _Raise):
+                raise s.exc
+            yield s
+    return wrapped
+
+
+def firstn(reader, n):
+    """Only the first ``n`` samples."""
+    def wrapped():
+        return itertools.islice(reader(), n)
+    return wrapped
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Map samples on ``process_num`` worker threads, optionally
+    preserving input order."""
+    def wrapped():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        _END = object()
+
+        def feed():
+            try:
+                for i, s in enumerate(reader()):
+                    in_q.put((i, s))
+                for _ in range(process_num):
+                    in_q.put(_END)
+            except BaseException as e:  # noqa: BLE001
+                out_q.put(_Raise(e))
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is _END:
+                        out_q.put(_END)
+                        return
+                    i, s = item
+                    out_q.put((i, mapper(s)))
+            except BaseException as e:  # noqa: BLE001
+                out_q.put(_Raise(e))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        done = 0
+        if not order:
+            while done < process_num:
+                item = out_q.get()
+                if item is _END:
+                    done += 1
+                    continue
+                if isinstance(item, _Raise):
+                    raise item.exc
+                yield item[1]
+            return
+        pending = {}
+        want = 0
+        while done < process_num or pending:
+            if want in pending:
+                yield pending.pop(want)
+                want += 1
+                continue
+            item = out_q.get()
+            if item is _END:
+                done += 1
+                continue
+            if isinstance(item, _Raise):
+                raise item.exc
+            i, mapped = item
+            pending[i] = mapped
+    return wrapped
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers concurrently (thread-backed here:
+    samples are numpy on a single host; the reference uses processes
+    to dodge the GIL its C++ readers don't hold)."""
+    def wrapped():
+        q = _queue.Queue(queue_size)
+        _END = object()
+
+        def fill(r):
+            try:
+                for s in r():
+                    q.put(s)
+                q.put(_END)
+            except BaseException as e:  # noqa: BLE001
+                q.put(_Raise(e))
+
+        for r in readers:
+            threading.Thread(target=fill, args=(r,), daemon=True).start()
+        done = 0
+        while done < len(readers):
+            s = q.get()
+            if s is _END:
+                done += 1
+                continue
+            if isinstance(s, _Raise):
+                raise s.exc
+            yield s
+    return wrapped

@@ -1,0 +1,23 @@
+"""`paddle.sysconfig` (reference python/paddle/sysconfig.py): include /
+lib directories for building native extensions against the framework —
+here the XLA-FFI custom-op headers (csrc/include/paddle_ext.h) and the
+package's shared libraries."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory containing the C++ headers (PD_BUILD_OP /
+    paddle_ext.h — the custom-op build contract)."""
+    return os.path.join(_PKG, "csrc", "include")
+
+
+def get_lib() -> str:
+    """Directory containing the framework's native shared libraries."""
+    return os.path.join(_PKG, "csrc", "_build")

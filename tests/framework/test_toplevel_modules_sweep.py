@@ -1,0 +1,203 @@
+"""paddle.hub / reader / sysconfig / version / callbacks surface
+(parity: python/paddle/hub.py, reader/decorator.py, sysconfig.py, the
+generated version module, callbacks.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import callbacks, hub, reader, sysconfig, version
+
+
+# ----------------------------------------------------------------- hub
+def _mk_repo(tmp_path):
+    (tmp_path / "helper_mod.py").write_text("SCALE = 3\n")
+    (tmp_path / "hubconf.py").write_text(
+        "import helper_mod\n"
+        "def tiny_linear(out_features=2):\n"
+        "    '''A tiny Linear model entrypoint.'''\n"
+        "    import paddle_tpu as paddle\n"
+        "    return paddle.nn.Linear(4, out_features * helper_mod.SCALE "
+        "// helper_mod.SCALE)\n"
+        "def _private():\n"
+        "    return None\n")
+    return str(tmp_path)
+
+
+def test_hub_local_list_help_load(tmp_path):
+    repo = _mk_repo(tmp_path)
+    assert hub.list(repo, source="local") == ["tiny_linear"]
+    assert "tiny Linear" in hub.help(repo, "tiny_linear", source="local")
+    net = hub.load(repo, "tiny_linear", source="local", out_features=5)
+    assert list(net(paddle.ones([1, 4])).shape) == [1, 5]
+
+
+def test_hub_errors(tmp_path):
+    with pytest.raises(ValueError, match="source"):
+        hub.list(str(tmp_path), source="bitbucket")
+    with pytest.raises(RuntimeError, match="hubconf"):
+        hub.list(str(tmp_path), source="local")
+    repo = _mk_repo(tmp_path)
+    with pytest.raises(RuntimeError, match="entrypoint"):
+        hub.load(repo, "nope", source="local")
+
+
+# -------------------------------------------------------------- reader
+def _r(n):
+    def rd():
+        yield from range(n)
+    return rd
+
+
+def test_reader_decorators():
+    assert list(reader.firstn(_r(10), 3)()) == [0, 1, 2]
+    assert list(reader.chain(_r(2), _r(3))()) == [0, 1, 0, 1, 2]
+    assert list(reader.map_readers(lambda a, b: a + b, _r(3), _r(3))()) \
+        == [0, 2, 4]
+    assert sorted(reader.shuffle(_r(5), 2)()) == [0, 1, 2, 3, 4]
+    assert list(reader.buffered(_r(4), 2)()) == [0, 1, 2, 3]
+    cached = reader.cache(_r(3))
+    assert list(cached()) == [0, 1, 2] == list(cached())
+
+
+def test_reader_compose_alignment():
+    c = reader.compose(_r(3), _r(3))
+    assert list(c()) == [(0, 0), (1, 1), (2, 2)]
+    with pytest.raises(reader.ComposeNotAligned):
+        list(reader.compose(_r(2), _r(4))())
+    ok = reader.compose(_r(2), _r(4), check_alignment=False)
+    assert list(ok()) == [(0, 0), (1, 1)]
+
+
+def test_reader_xmap_and_multiprocess():
+    out = sorted(reader.xmap_readers(lambda x: x * 10, _r(6), 3, 4)())
+    assert out == [0, 10, 20, 30, 40, 50]
+    ordered = list(reader.xmap_readers(lambda x: x * 2, _r(6), 3, 4,
+                                       order=True)())
+    assert ordered == [0, 2, 4, 6, 8, 10]
+    merged = sorted(reader.multiprocess_reader([_r(3), _r(3)])())
+    assert merged == [0, 0, 1, 1, 2, 2]
+
+
+# ------------------------------------------------- sysconfig / version
+def test_sysconfig_paths():
+    inc = sysconfig.get_include()
+    assert os.path.isdir(inc)
+    assert os.path.exists(os.path.join(inc, "paddle_ext.h"))
+    assert isinstance(sysconfig.get_lib(), str)
+
+
+def test_version_surface(capsys):
+    assert version.full_version == paddle.__version__
+    assert version.cuda() is False and version.cudnn() is False
+    assert version.nccl() is False and version.xpu() is False
+    version.show()
+    out = capsys.readouterr().out
+    assert "cuda: False" in out
+
+
+# ----------------------------------------------------------- callbacks
+def test_callbacks_reexport_and_early_stopping():
+    assert callbacks.Callback is paddle.hapi.callbacks.Callback
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 2))
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()),
+        loss=paddle.nn.CrossEntropyLoss())
+    x = np.random.default_rng(0).standard_normal((8, 4)).astype("f4")
+    y = np.zeros((8, 1), "int64")
+    es = callbacks.EarlyStopping(monitor="loss", patience=1,
+                                 min_delta=1e9, verbose=0)
+    model.fit(list(zip(x, y)), batch_size=4, epochs=4, verbose=0,
+              callbacks=[es])
+    assert model._fit_epochs_ran < 4 if hasattr(
+        model, "_fit_epochs_ran") else es.stopped_epoch <= 4
+
+
+def test_callbacks_checkpoint_progbar_wandb(tmp_path, capsys):
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 2))
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()),
+        loss=paddle.nn.CrossEntropyLoss())
+    x = np.random.default_rng(1).standard_normal((8, 4)).astype("f4")
+    y = np.zeros((8, 1), "int64")
+    cbs = [callbacks.ModelCheckpoint(save_freq=1,
+                                     save_dir=str(tmp_path / "ck")),
+           callbacks.ProgBarLogger(log_freq=1, verbose=2),
+           callbacks.WandbCallback(dir=str(tmp_path / "wb")),
+           callbacks.LRScheduler(by_step=True)]
+    model.fit(list(zip(x, y)), batch_size=4, epochs=2, verbose=0,
+              callbacks=cbs)
+    assert (tmp_path / "ck").exists()  # per-epoch checkpoints saved
+    assert any((tmp_path / "ck").iterdir())
+    assert "loss" in capsys.readouterr().out  # progbar printed scalars
+    assert (tmp_path / "wb").exists()  # wandb fallback jsonl log
+
+
+def test_callbacks_visualdl_and_plateau(tmp_path):
+    class Probe(callbacks.Callback):
+        hits = 0
+
+        def on_train_batch_end(self, step, logs=None):
+            Probe.hits += 1
+
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 2))
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()),
+        loss=paddle.nn.CrossEntropyLoss())
+    x = np.random.default_rng(2).standard_normal((8, 4)).astype("f4")
+    y = np.zeros((8, 1), "int64")
+    cbs = [callbacks.VisualDL(log_dir=str(tmp_path / "vdl")),
+           callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                       patience=0, min_lr=0.001,
+                                       verbose=0),
+           Probe()]
+    model.fit(list(zip(x, y)), batch_size=4, epochs=2, verbose=0,
+              callbacks=cbs)
+    # a plain list is an iterable of pre-made batches (8 samples =
+    # 8 steps/epoch); Dataset/DataLoader inputs get real batching
+    assert Probe.hits == 16
+    assert (tmp_path / "vdl").exists()
+
+
+def test_reader_worker_exception_propagates():
+    """A dying worker must surface its error, not deadlock the
+    consumer on q.get()."""
+    def broken():
+        yield 1
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        list(reader.buffered(broken, 2)())
+    with pytest.raises(ValueError, match="boom"):
+        list(reader.xmap_readers(lambda x: x, broken, 2, 2)())
+    with pytest.raises(ZeroDivisionError):
+        list(reader.xmap_readers(lambda x: x / 0, _r(3), 2, 2)())
+    with pytest.raises(ValueError, match="boom"):
+        list(reader.multiprocess_reader([broken, _r(2)])())
+
+
+def test_hub_force_reload_refreshes_cache(tmp_path, monkeypatch):
+    """force_reload must replace an existing cache entry, not crash on
+    the rename (the one case the flag exists for)."""
+    import zipfile
+
+    from paddle_tpu.hapi import hub as hub_backend
+    monkeypatch.setattr(hub_backend, "_HUB_DIR", str(tmp_path / "hub"))
+
+    def fake_fetch(url, zpath):
+        os.makedirs(os.path.dirname(zpath), exist_ok=True)
+        with zipfile.ZipFile(zpath, "w") as zf:
+            zf.writestr("repo-main/hubconf.py",
+                        "def entry():\n    return 42\n")
+
+    import urllib.request
+    monkeypatch.setattr(urllib.request, "urlretrieve", fake_fetch)
+    assert hub.list("user/repo", source="github") == ["entry"]
+    assert hub.list("user/repo", source="github",
+                    force_reload=True) == ["entry"]
+    assert hub.load("user/repo", "entry", source="github") == 42

@@ -200,3 +200,26 @@ def test_segment_ids_dense_entry():
     bias = jnp.where(seg[:, :, None] == seg[:, None, :], 0.0, -jnp.inf)
     ref = sdpa_xla(q, k, v, bias=bias[:, None], causal=False)
     np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-5)
+
+
+def test_autotune_file_cache_roundtrip(tmp_path, monkeypatch):
+    """Sweep winners persist across processes via the file cache
+    (bench rungs are one-per-process; re-sweeping per child costs
+    minutes on-chip)."""
+    from paddle_tpu.kernels.pallas import flash_attention as fa
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "tune.json"))
+    key = (4, 1024, 1024, 16, 16, 64, True, "bfloat16")
+    assert fa._tune_cache_load(key) is None
+    fa._tune_cache_store(key, (256, 512))
+    assert fa._tune_cache_load(key) == (256, 512)
+    # per-device-kind namespacing: another kind misses
+    real_kind = fa._device_kind
+    monkeypatch.setattr(fa, "_device_kind", lambda: "v5p")
+    assert fa._tune_cache_load(key) is None
+    fa._tune_cache_store(key, (512, 1024))
+    monkeypatch.setattr(fa, "_device_kind", real_kind)
+    assert fa._tune_cache_load(key) == (256, 512)
+    # corrupt file degrades to a miss, never an exception
+    (tmp_path / "tune.json").write_text("{not json")
+    assert fa._tune_cache_load(key) is None

@@ -94,12 +94,18 @@ def trace_done():
     return False
 
 
+_MAX_TRACE_ATTEMPTS = 3
+
+
 def main():
     interval = 120
+    trace_attempts = 0
     while True:
         todo = missing_rungs()
-        if not todo and ticks_done() and trace_done():
-            log("ladder + ticks + trace complete; exiting")
+        trace_settled = trace_done() or             trace_attempts >= _MAX_TRACE_ATTEMPTS
+        if not todo and ticks_done() and trace_settled:
+            log("ladder + ticks + trace complete (or trace attempts "
+                "exhausted); exiting")
             return
         backend = bench._probe_backend_subprocess(timeout_s=150)
         if backend is None or backend == "cpu":
@@ -146,8 +152,11 @@ def main():
                     run_ticks()
                 except subprocess.TimeoutExpired:
                     log("pipeline ticks timed out")
-            if not missing_rungs() and not trace_done():
-                log("capturing headline device trace ...")
+            if not missing_rungs() and not trace_done() and \
+                    trace_attempts < _MAX_TRACE_ATTEMPTS:
+                trace_attempts += 1
+                log(f"capturing headline device trace (attempt "
+                    f"{trace_attempts}/{_MAX_TRACE_ATTEMPTS}) ...")
                 try:
                     p = subprocess.run(
                         [sys.executable,
