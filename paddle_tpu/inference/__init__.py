@@ -18,7 +18,10 @@ from ..core.autograd import no_grad
 from ..core.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType"]
+           "PlaceType", "DataType", "PredictorPool", "XpuConfig",
+           "convert_to_mixed_precision", "get_version",
+           "get_trt_compile_version", "get_trt_runtime_version",
+           "get_num_bytes_of_data_type"]
 
 
 class PrecisionType:
@@ -186,31 +189,47 @@ class Predictor:
 
     # -- execution ---------------------------------------------------------
     def _ensure_jit(self):
+        """Trace once PER LAYER, under a per-layer lock: predictors that
+        share a layer (PredictorPool clones) must not race the tracer's
+        temporary `p._data` swaps, and they reuse one executable."""
         if self._jitted is not None:
             return
+        import threading
+
         import jax
 
         layer = self._layer
-        items = list(layer.named_parameters()) + \
-            list(layer.named_buffers())
+        lock = getattr(layer, "_pred_trace_lock", None)
+        if lock is None:
+            lock = threading.Lock()
+            object.__setattr__(layer, "_pred_trace_lock", lock)
+        with lock:
+            shared = getattr(layer, "_pred_exec", None)
+            if shared is not None:
+                self._items, self._jitted = shared
+                return
+            items = list(layer.named_parameters()) + \
+                list(layer.named_buffers())
 
-        def pure(arrays, *inputs):
-            restore = []
-            try:
-                for (_, p), a in zip(items, arrays):
-                    restore.append((p, p._data))
-                    p._data = a
-                with no_grad():
-                    out = layer(*[Tensor(x) for x in inputs])
-                outs = out if isinstance(out, (tuple, list)) else [out]
-                return [o._data if isinstance(o, Tensor) else o
-                        for o in outs]
-            finally:
-                for p, a in restore:
-                    p._data = a
+            def pure(arrays, *inputs):
+                restore = []
+                try:
+                    for (_, p), a in zip(items, arrays):
+                        restore.append((p, p._data))
+                        p._data = a
+                    with no_grad():
+                        out = layer(*[Tensor(x) for x in inputs])
+                    outs = out if isinstance(out, (tuple, list)) else [out]
+                    return [o._data if isinstance(o, Tensor) else o
+                            for o in outs]
+                finally:
+                    for p, a in restore:
+                        p._data = a
 
-        self._items = items
-        self._jitted = jax.jit(pure)
+            self._items = items
+            self._jitted = jax.jit(pure)
+            object.__setattr__(layer, "_pred_exec",
+                               (self._items, self._jitted))
 
     def run(self, inputs=None):
         """Feed from input handles (or ``inputs`` list), execute, fill
@@ -235,3 +254,135 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class DataType:
+    """Reference paddle_infer.DataType enum."""
+    FLOAT32 = 0
+    FLOAT16 = 1
+    INT64 = 2
+    INT32 = 3
+    UINT8 = 4
+    INT8 = 5
+    BOOL = 6
+    BFLOAT16 = 7
+    FLOAT64 = 8
+
+
+_DTYPE_BYTES = {DataType.FLOAT32: 4, DataType.FLOAT16: 2,
+                DataType.INT64: 8, DataType.INT32: 4, DataType.UINT8: 1,
+                DataType.INT8: 1, DataType.BOOL: 1, DataType.BFLOAT16: 2,
+                DataType.FLOAT64: 8}
+
+
+def get_num_bytes_of_data_type(dtype):
+    """Bytes per element for a DataType (reference
+    get_num_bytes_of_data_type)."""
+    try:
+        return _DTYPE_BYTES[dtype]
+    except KeyError:
+        raise ValueError(f"unknown inference DataType {dtype!r}") from None
+
+
+def get_version():
+    """Framework version string (reference inference get_version)."""
+    from .. import version
+    return f"version: {version.full_version}"
+
+
+def get_trt_compile_version():
+    """TensorRT is not part of the TPU/XLA build: (0, 0, 0), the same
+    signal the reference's no-TRT wheels give."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name):
+    """Reference maps an op name to its phi kernel name; the TPU build
+    has no phi registry — identity keeps tooling that logs kernel
+    names working."""
+    return op_name
+
+
+class XpuConfig:
+    """Accepted-knob container (reference XpuConfig; no XPU stack in
+    the TPU build)."""
+
+    def __init__(self):
+        self.device_id = 0
+        self.l3_size = 0
+        self.conv_autotune_level = 0
+
+
+class PredictorPool:
+    """A fixed pool of Predictors sharing one Config (reference
+    PredictorPool: per-thread predictors over one loaded model)."""
+
+    def __init__(self, config, size=1):
+        if size < 1:
+            raise ValueError("PredictorPool size must be >= 1")
+        first = Predictor(config)
+        self._preds = [first]
+        for _ in range(size - 1):
+            # share the already-built layer: clones serve concurrently
+            # without reloading params
+            clone_cfg = Config()
+            clone_cfg.set_model_layer(first._layer)
+            clone_cfg._precision = config._precision
+            self._preds.append(Predictor(clone_cfg))
+
+    def retrieve(self, idx):
+        return self._preds[idx]
+
+    def __len__(self):
+        return len(self._preds)
+
+
+def convert_to_mixed_precision(model_file, params_file,
+                               mixed_model_file, mixed_params_file,
+                               mixed_precision=None, backend=None,
+                               keep_io_types=True, black_list=None,
+                               **kwargs):
+    """Offline weight cast of a saved params file (reference
+    convert_to_mixed_precision rewrites the saved inference program):
+    loads the state dict, casts floating-point entries to the target
+    precision (fp16/bf16), and re-saves. The program/StableHLO side
+    needs no rewrite — XLA re-specializes on the new weight dtypes at
+    the next trace."""
+    import shutil
+
+    import numpy as np
+
+    from ..framework.io import load as _load
+    from ..framework.io import save as _save
+
+    allowed = {None: "float16", PrecisionType.Half: "float16",
+               PrecisionType.Bfloat16: "bfloat16",
+               "float16": "float16", "bfloat16": "bfloat16"}
+    if mixed_precision not in allowed:
+        raise ValueError(
+            f"convert_to_mixed_precision: unsupported target "
+            f"{mixed_precision!r} (use PrecisionType.Half/Bfloat16 or "
+            "'float16'/'bfloat16')")
+    target = allowed[mixed_precision]
+    import ml_dtypes
+    np_target = np.dtype(ml_dtypes.bfloat16) if target == "bfloat16" \
+        else np.dtype("float16")
+    black = set(black_list or [])
+    state = _load(params_file)
+    out = {}
+    for k, v in state.items():
+        arr = np.asarray(v)
+        if k not in black and np.issubdtype(arr.dtype, np.floating) \
+                and arr.dtype.itemsize >= 4:
+            arr = arr.astype(np_target)
+        out[k] = arr
+    _save(out, mixed_params_file)
+    if model_file and mixed_model_file and model_file != mixed_model_file:
+        try:
+            shutil.copyfile(model_file, mixed_model_file)
+        except OSError:
+            pass

@@ -201,3 +201,135 @@ def test_hub_force_reload_refreshes_cache(tmp_path, monkeypatch):
     assert hub.list("user/repo", source="github",
                     force_reload=True) == ["entry"]
     assert hub.load("user/repo", "entry", source="github") == 42
+
+
+# -------------------------------------------------- utils / inference
+def test_utils_deprecated_and_require_version():
+    import warnings
+
+    from paddle_tpu import utils
+
+    assert utils.try_import("math") is not None
+    utils.run_check()  # install self-check must pass on this build
+
+    @utils.deprecated(update_to="paddle.new_op", since="0.1",
+                      reason="renamed")
+    def old_op(x):
+        return x + 1
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_op(1) == 2
+    assert any("deprecated" in str(x.message) for x in w)
+    assert utils.require_version("0.0.1")
+    assert utils.require_version("0.0.1", "9.9.9")
+    with pytest.raises(Exception, match="minimum"):
+        utils.require_version("99.0")
+    with pytest.raises(TypeError):
+        utils.require_version(1)
+
+
+def test_inference_surface(tmp_path):
+    from paddle_tpu import inference
+
+    assert inference.get_num_bytes_of_data_type(
+        inference.DataType.FLOAT32) == 4
+    assert inference.get_num_bytes_of_data_type(
+        inference.DataType.BFLOAT16) == 2
+    assert paddle.__version__ in inference.get_version()
+    assert inference.get_trt_compile_version() == (0, 0, 0)
+    assert inference.get_trt_runtime_version() == (0, 0, 0)
+    assert inference.XpuConfig().device_id == 0
+    assert inference._get_phi_kernel_name("relu") == "relu"
+    for enum_cls in (inference.DataType, inference.PlaceType,
+                     inference.PrecisionType):
+        assert isinstance(enum_cls, type)
+    assert inference.DataType.INT8 != inference.DataType.FLOAT32
+    assert inference.PlaceType.CPU == 0
+    assert inference.PrecisionType.Half == 1
+
+    net = paddle.nn.Linear(4, 2)
+    cfg = inference.Config()
+    cfg.set_model_layer(net)
+    pred = inference.create_predictor(cfg)
+    assert isinstance(pred, inference.Predictor)
+    pool = inference.PredictorPool(cfg, size=3)
+    assert len(pool) == 3
+    p0, p2 = pool.retrieve(0), pool.retrieve(2)
+    x = np.random.default_rng(0).standard_normal((1, 4)).astype("f4")
+    outs = []
+    for p in (p0, p2):
+        h = p.get_input_handle(p.get_input_names()[0])
+        h.copy_from_cpu(x)
+        p.run()
+        outs.append(p.get_output_handle(
+            p.get_output_names()[0]).copy_to_cpu())
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
+
+
+def test_convert_to_mixed_precision(tmp_path):
+    from paddle_tpu import inference
+
+    net = paddle.nn.Linear(4, 2)
+    params = str(tmp_path / "m.pdparams")
+    paddle.save(net.state_dict(), params)
+    mixed = str(tmp_path / "m_fp16.pdparams")
+    inference.convert_to_mixed_precision(None, params, None, mixed,
+                                         mixed_precision="float16")
+    st = paddle.load(mixed)
+    w = np.asarray(st["weight"])
+    assert w.dtype == np.float16
+    np.testing.assert_allclose(
+        w.astype("f4"), np.asarray(net.weight.numpy()), atol=2e-3)
+    # bf16 target + black_list keeps excluded entries fp32
+    mixed_bf = str(tmp_path / "m_bf16.pdparams")
+    inference.convert_to_mixed_precision(
+        None, params, None, mixed_bf, mixed_precision="bfloat16",
+        black_list=["bias"])
+    st2 = paddle.load(mixed_bf)
+    import ml_dtypes
+    assert np.asarray(st2["weight"]).dtype == ml_dtypes.bfloat16
+    assert np.asarray(st2["bias"]).dtype == np.float32
+
+
+def test_deprecated_level2_raises_at_call_not_import():
+    from paddle_tpu import utils
+
+    @utils.deprecated(level=2, update_to="paddle.new")
+    def removed():
+        return 1
+
+    # decoration succeeded; the CALL raises
+    with pytest.raises(RuntimeError, match="deprecated"):
+        removed()
+
+
+def test_convert_to_mixed_precision_rejects_unknown(tmp_path):
+    from paddle_tpu import inference
+    net = paddle.nn.Linear(2, 2)
+    params = str(tmp_path / "p.pdparams")
+    paddle.save(net.state_dict(), params)
+    with pytest.raises(ValueError, match="unsupported target"):
+        inference.convert_to_mixed_precision(
+            None, params, None, str(tmp_path / "o.pdparams"),
+            mixed_precision="fp16")
+    with pytest.raises(ValueError, match="unsupported target"):
+        inference.convert_to_mixed_precision(
+            None, params, None, str(tmp_path / "o.pdparams"),
+            mixed_precision=inference.PrecisionType.Int8)
+
+
+def test_predictor_pool_shares_one_trace():
+    from paddle_tpu import inference
+    net = paddle.nn.Linear(3, 2)
+    cfg = inference.Config()
+    cfg.set_model_layer(net)
+    pool = inference.PredictorPool(cfg, size=2)
+    a, b = pool.retrieve(0), pool.retrieve(1)
+    x = np.ones((1, 3), "f4")
+    for p in (a, b):
+        h = p.get_input_handle(p.get_input_names()[0])
+        h.copy_from_cpu(x)
+        p.run()
+    # clones reuse one executable traced under the per-layer lock
+    assert a._jitted is b._jitted
