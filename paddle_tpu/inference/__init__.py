@@ -32,10 +32,14 @@ class PrecisionType:
 
 
 class PlaceType:
+    """Numeric parity with paddle_tensor.h:71 (kUNK=-1, kCPU, kGPU,
+    kXPU, kIPU, kCUSTOM)."""
+    UNK = -1
     CPU = 0
     GPU = 1
     XPU = 2
-    CUSTOM = 3
+    IPU = 3
+    CUSTOM = 4
 
 
 class Config:
@@ -238,8 +242,14 @@ class Predictor:
         if inputs is None:
             names = self.get_input_names()
             inputs = [self._inputs[n]._array for n in names]
-        arrays = [p._data for _, p in self._items]
-        outs = self._jitted(arrays, *inputs)
+        # dispatch under the per-layer lock: a new input signature makes
+        # jax.jit RE-TRACE pure(), which temporarily swaps the shared
+        # params' _data to tracers — another pooled predictor reading
+        # p._data concurrently would pick a tracer up. Dispatch is
+        # cheap (the XLA execution itself is async); correctness first.
+        with self._layer._pred_trace_lock:
+            arrays = [p._data for _, p in self._items]
+            outs = self._jitted(arrays, *inputs)
         out_np = [np.asarray(o) for o in outs]
         self._outputs.clear()
         for i, o in enumerate(out_np):
@@ -257,16 +267,19 @@ def create_predictor(config: Config) -> Predictor:
 
 
 class DataType:
-    """Reference paddle_infer.DataType enum."""
+    """Reference paddle_infer.DataType enum — numeric values MATCH the
+    reference header (fluid/inference/api/paddle_tensor.h:58: FLOAT32,
+    INT64, INT32, UINT8, INT8, FLOAT16, BOOL, FLOAT64, BFLOAT16) so
+    raw enum ints interchange with reference-written code."""
     FLOAT32 = 0
-    FLOAT16 = 1
-    INT64 = 2
-    INT32 = 3
-    UINT8 = 4
-    INT8 = 5
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
     BOOL = 6
-    BFLOAT16 = 7
-    FLOAT64 = 8
+    FLOAT64 = 7
+    BFLOAT16 = 8
 
 
 _DTYPE_BYTES = {DataType.FLOAT32: 4, DataType.FLOAT16: 2,
@@ -382,7 +395,4 @@ def convert_to_mixed_precision(model_file, params_file,
         out[k] = arr
     _save(out, mixed_params_file)
     if model_file and mixed_model_file and model_file != mixed_model_file:
-        try:
-            shutil.copyfile(model_file, mixed_model_file)
-        except OSError:
-            pass
+        shutil.copyfile(model_file, mixed_model_file)

@@ -63,11 +63,17 @@ def require_version(min_version, max_version=None):
     from .. import version as _version
 
     def key(v):
-        parts = []
+        """(numeric tuple, is_release): '0.1.0rc1' < '0.1.0' — a
+        component's LEADING digits count; a pre-release suffix anywhere
+        ranks below the plain release with the same numbers."""
+        import re as _re
+        nums, pre = [], 1
         for p in str(v).split("."):
-            num = "".join(ch for ch in p if ch.isdigit())
-            parts.append(int(num) if num else 0)
-        return tuple(parts + [0] * (4 - len(parts)))
+            m = _re.match(r"(\d*)(.*)", p)
+            nums.append(int(m.group(1)) if m.group(1) else 0)
+            if m.group(2):
+                pre = 0
+        return tuple(nums + [0] * (4 - len(nums))), pre
 
     if not isinstance(min_version, str) or (
             max_version is not None and not isinstance(max_version, str)):

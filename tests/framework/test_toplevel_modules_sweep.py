@@ -223,6 +223,10 @@ def test_utils_deprecated_and_require_version():
     assert any("deprecated" in str(x.message) for x in w)
     assert utils.require_version("0.0.1")
     assert utils.require_version("0.0.1", "9.9.9")
+    # pre-release ordering: an rc minimum is satisfied by its release
+    assert utils.require_version("0.1.0rc1")
+    with pytest.raises(Exception, match="minimum"):
+        utils.require_version("0.1.1rc1")
     with pytest.raises(Exception, match="minimum"):
         utils.require_version("99.0")
     with pytest.raises(TypeError):
@@ -244,8 +248,15 @@ def test_inference_surface(tmp_path):
     for enum_cls in (inference.DataType, inference.PlaceType,
                      inference.PrecisionType):
         assert isinstance(enum_cls, type)
-    assert inference.DataType.INT8 != inference.DataType.FLOAT32
+    # numeric parity with paddle_tensor.h enums
+    assert inference.DataType.FLOAT32 == 0
+    assert inference.DataType.INT64 == 1
+    assert inference.DataType.FLOAT16 == 5
+    assert inference.DataType.BFLOAT16 == 8
+    assert inference.get_num_bytes_of_data_type(1) == 8  # raw int: INT64
+    assert inference.PlaceType.UNK == -1
     assert inference.PlaceType.CPU == 0
+    assert inference.PlaceType.CUSTOM == 4
     assert inference.PrecisionType.Half == 1
 
     net = paddle.nn.Linear(4, 2)
