@@ -20,6 +20,20 @@ import traceback
 import jax
 import numpy as np
 
+# Persistent XLA executable cache (this jax version ignores the
+# JAX_COMPILATION_CACHE_DIR env var, so wire the config directly): a
+# rung that compiled in an earlier tunnel window re-runs measure-only.
+try:
+    import os as _os
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        _os.environ.get("JAX_COMPILATION_CACHE_DIR") or _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            ".jax_compile_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+except Exception:  # older jax without the persistent cache
+    pass
+
 # bf16 peak FLOP/s per chip by device generation
 PEAK_BF16 = {
     "v5e": 197e12,  # TPU v5e (v5litepod)

@@ -27,6 +27,16 @@ def main():
     args = ap.parse_args()
 
     import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), ".jax_compile_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          5.0)
+    except Exception:
+        pass
     if jax.default_backend() == "cpu":
         print(json.dumps({"skipped": "CPU backend — trace must be "
                                      "captured on the TPU"}))

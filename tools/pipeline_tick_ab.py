@@ -36,6 +36,16 @@ import sys
 import time
 
 import jax
+
+try:
+    import os as _os
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), ".jax_compile_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+except Exception:
+    pass
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
