@@ -412,27 +412,123 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
     return apply(_fold, x, name="fold")
 
 
+def _interp_src_coords(out_size, in_size, align_corners, half_pixel):
+    """Destination index -> (fractional) source coordinate, per the
+    reference interpolate kernels (phi/kernels/funcs/interpolate_function.h):
+    align_corners: i*(in-1)/(out-1); else half-pixel (align_mode 0,
+    the torch convention) or legacy i*scale (align_mode 1)."""
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners:
+        if out_size == 1:
+            return jnp.zeros((1,), jnp.float32)
+        return i * (in_size - 1) / (out_size - 1)
+    scale = in_size / out_size
+    if half_pixel:
+        return (i + 0.5) * scale - 0.5
+    return i * scale
+
+
+def _resize_axis(a, axis, out_size, mode, align_corners, align_mode):
+    """Separable 1-D resize along ``axis`` (gathers + weighted sums —
+    the XLA-friendly form of the reference's per-pixel index math)."""
+    in_size = a.shape[axis]
+    if in_size == out_size:
+        return a
+    if mode == "nearest":
+        # reference nearest_interp: floor(i*scale) (align_corners=False)
+        # or round(i*(in-1)/(out-1)) (align_corners=True)
+        if align_corners:
+            src = _interp_src_coords(out_size, in_size, True, False)
+            idx = jnp.clip(jnp.round(src).astype(jnp.int32), 0,
+                           in_size - 1)
+        else:
+            idx = jnp.clip((jnp.arange(out_size, dtype=jnp.float32)
+                            * (in_size / out_size)).astype(jnp.int32),
+                           0, in_size - 1)
+        return jnp.take(a, idx, axis=axis)
+    # align_mode only applies to the linear family: the reference
+    # bicubic kernel is always half-pixel when align_corners=False
+    src = _interp_src_coords(
+        out_size, in_size, align_corners,
+        half_pixel=(mode == "cubic" or align_mode == 0))
+    if mode == "cubic":
+        # Keys cubic convolution, A=-0.75 (reference bicubic_interp /
+        # torch upsample_bicubic2d share this kernel)
+        A = -0.75
+        s0 = jnp.floor(src)
+        t = (src - s0)[None, :]
+        offs = jnp.arange(-1, 3, dtype=jnp.float32)[:, None]
+        d = jnp.abs(offs - t)
+        w = jnp.where(
+            d <= 1.0, ((A + 2) * d - (A + 3)) * d * d + 1,
+            jnp.where(d < 2.0, ((A * d - 5 * A) * d + 8 * A) * d - 4 * A,
+                      0.0))
+        idx = jnp.clip(s0[None, :].astype(jnp.int32)
+                       + offs.astype(jnp.int32), 0, in_size - 1)
+        taps = [jnp.take(a, idx[k], axis=axis) for k in range(4)]
+    else:  # linear family
+        src = jnp.clip(src, 0.0, in_size - 1)
+        i0 = jnp.floor(src).astype(jnp.int32)
+        i1 = jnp.clip(i0 + 1, 0, in_size - 1)
+        f = src - i0.astype(jnp.float32)
+        w = jnp.stack([1.0 - f, f])
+        idx = jnp.stack([i0, i1])
+        taps = [jnp.take(a, idx[k], axis=axis) for k in range(2)]
+    shape = [1] * a.ndim
+    shape[axis] = out_size
+    out = sum(t_.astype(jnp.float32) * w[k].reshape(shape)
+              for k, t_ in enumerate(taps))
+    return out
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
-    def _interp(a):
-        nchw = data_format.startswith("NC")
-        spatial = a.shape[2:] if nchw else a.shape[1:-1]
+    """Resize (reference nn/functional/common.py interpolate over the
+    phi *_interp kernels). Modes nearest/linear/bilinear/trilinear/
+    bicubic/area with the reference's align_corners / align_mode
+    coordinate transforms (align_mode=0: half-pixel, =1: legacy
+    i*scale). 'area' is adaptive average pooling, as in the
+    reference."""
+    nchw = data_format.startswith("NC")
+
+    def _out_spatial(spatial):
         if size is not None:
-            out_spatial = tuple(int(unwrap(s)) for s in (
+            return tuple(int(unwrap(s)) for s in (
                 size if isinstance(size, (list, tuple)) else [size]))
-        else:
-            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
-                else [scale_factor] * len(spatial)
-            out_spatial = tuple(int(s * f) for s, f in zip(spatial, sf))
-        if nchw:
-            target = a.shape[:2] + out_spatial
-        else:
-            target = (a.shape[0],) + out_spatial + (a.shape[-1],)
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * len(spatial)
+        return tuple(int(s * f) for s, f in zip(spatial, sf))
+
+    if mode == "area":
+        from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,
+                              adaptive_avg_pool3d)
+        nd = (len(x.shape) - 2)
+        out = _out_spatial(tuple(x.shape[2:] if nchw else x.shape[1:-1]))
+        pool = {1: adaptive_avg_pool1d, 2: adaptive_avg_pool2d,
+                3: adaptive_avg_pool3d}[nd]
+        if nd == 1:
+            return pool(x, list(out))
+        return pool(x, list(out), data_format=data_format)
+
+    def _interp(a):
+        spatial_axes = list(range(2, a.ndim)) if nchw else \
+            list(range(1, a.ndim - 1))
+        spatial = tuple(a.shape[ax] for ax in spatial_axes)
+        out_spatial = _out_spatial(spatial)
+        if len(out_spatial) != len(spatial_axes):
+            raise ValueError(
+                f"interpolate: size/scale_factor has "
+                f"{len(out_spatial)} entries for a {a.ndim}-D input "
+                f"({len(spatial_axes)} spatial dims)")
         jmode = {"nearest": "nearest", "bilinear": "linear",
                  "trilinear": "linear", "linear": "linear",
-                 "bicubic": "cubic", "area": "linear"}[mode]
-        return jax.image.resize(a, target, method=jmode).astype(a.dtype)
+                 "bicubic": "cubic"}[mode]
+        out = a
+        for ax, osz in zip(spatial_axes, out_spatial):
+            out = _resize_axis(out, ax, int(osz), jmode, align_corners,
+                               align_mode)
+        return out.astype(a.dtype)
     return apply(_interp, x, name="interpolate")
 
 

@@ -336,55 +336,58 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
             fx = ((gx + 1) * w - 1) / 2
             fy = ((gy + 1) * h - 1) / 2
 
-        def clip_or_reflect(v, size):
-            if padding_mode == "border":
-                return jnp.clip(v, 0, size - 1), None
-            if padding_mode == "reflection":
-                span = 2 * (size - 1) if align_corners else 2 * size
+        def reflect(v, size):
+            """Reference/torch reflect: about pixel CENTERS (0, size-1)
+            when align_corners, about pixel EDGES (-0.5, size-0.5)
+            otherwise; sampling coords are clipped afterwards."""
+            if align_corners:
+                span = 2 * max(size - 1, 1)
                 v = jnp.abs(jnp.mod(v, span))
                 v = jnp.minimum(v, span - v)
-                return jnp.clip(v, 0, size - 1), None
-            valid = (v >= 0) & (v <= size - 1)
-            return v, valid
+            else:
+                span = 2 * size
+                v = jnp.abs(jnp.mod(v + 0.5, span))
+                v = jnp.minimum(v, span - v) - 0.5
+            return jnp.clip(v, 0, size - 1)
 
-        fx, vx = clip_or_reflect(fx, w)
-        fy, vy = clip_or_reflect(fy, h)
-        valid = None
-        if vx is not None:
-            valid = vx & vy
+        zeros_pad = padding_mode == "zeros"
+        if padding_mode == "reflection":
+            fx = reflect(fx, w)
+            fy = reflect(fy, h)
+        elif padding_mode == "border":
+            fx = jnp.clip(fx, 0, w - 1)
+            fy = jnp.clip(fy, 0, h - 1)
 
-        if mode == "nearest":
-            ix = jnp.clip(jnp.round(fx).astype(jnp.int32), 0, w - 1)
-            iy = jnp.clip(jnp.round(fy).astype(jnp.int32), 0, h - 1)
-            bidx = jnp.arange(n)[:, None, None]
-            out = a[bidx, :, iy, ix]
-            out = jnp.moveaxis(out, -1, 1)
-            if valid is not None:
-                out = out * valid[:, None].astype(out.dtype)
-            return out
-
-        x0 = jnp.clip(jnp.floor(fx).astype(jnp.int32), 0, w - 1)
-        y0 = jnp.clip(jnp.floor(fy).astype(jnp.int32), 0, h - 1)
-        x1 = jnp.clip(x0 + 1, 0, w - 1)
-        y1 = jnp.clip(y0 + 1, 0, h - 1)
-        wx = fx - jnp.floor(fx)
-        wy = fy - jnp.floor(fy)
         bidx = jnp.arange(n)[:, None, None]
 
-        def gather(iy, ix):
-            return a[bidx, :, iy, ix]  # [n, hg, wg, c]
-        v00 = gather(y0, x0)
-        v01 = gather(y0, x1)
-        v10 = gather(y1, x0)
-        v11 = gather(y1, x1)
-        wx_ = wx[..., None]
-        wy_ = wy[..., None]
-        out = (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_) +
-               v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
-        out = jnp.moveaxis(out, -1, 1)  # [n, c, hg, wg]
-        if valid is not None:
-            out = out * valid[:, None].astype(out.dtype)
-        return out
+        def tap(iy, ix):
+            """Value at integer (iy, ix); zeros padding masks PER TAP
+            (a half-out-of-bounds bilinear sample still blends its
+            in-bounds corners, reference grid_sample_kernel)."""
+            val = a[bidx, :, jnp.clip(iy, 0, h - 1),
+                    jnp.clip(ix, 0, w - 1)]  # [n, hg, wg, c]
+            if zeros_pad:
+                ok = ((iy >= 0) & (iy <= h - 1) & (ix >= 0) &
+                      (ix <= w - 1))
+                val = val * ok[..., None].astype(val.dtype)
+            return val
+
+        if mode == "nearest":
+            ix = jnp.round(fx).astype(jnp.int32)
+            iy = jnp.round(fy).astype(jnp.int32)
+            return jnp.moveaxis(tap(iy, ix), -1, 1)
+
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1 = x0 + 1
+        y1 = y0 + 1
+        wx_ = (fx - jnp.floor(fx))[..., None]
+        wy_ = (fy - jnp.floor(fy))[..., None]
+        out = (tap(y0, x0) * (1 - wx_) * (1 - wy_) +
+               tap(y0, x1) * wx_ * (1 - wy_) +
+               tap(y1, x0) * (1 - wx_) * wy_ +
+               tap(y1, x1) * wx_ * wy_)
+        return jnp.moveaxis(out, -1, 1)  # [n, c, hg, wg]
     return apply(fn, x, grid, name="grid_sample")
 
 

@@ -155,10 +155,20 @@ def adaptive_avg_pool1d(x, output_size, name=None):
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    if data_format == "NHWC":
+        from ...ops import manipulation as _m
+        out = _adaptive(2, _m.transpose(x, [0, 3, 1, 2]), output_size,
+                        "avg", name or "adaptive_avg_pool2d")
+        return _m.transpose(out, [0, 2, 3, 1])
     return _adaptive(2, x, output_size, "avg", name or "adaptive_avg_pool2d")
 
 
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    if data_format == "NDHWC":
+        from ...ops import manipulation as _m
+        out = _adaptive(3, _m.transpose(x, [0, 4, 1, 2, 3]), output_size,
+                        "avg", name or "adaptive_avg_pool3d")
+        return _m.transpose(out, [0, 2, 3, 4, 1])
     return _adaptive(3, x, output_size, "avg", name or "adaptive_avg_pool3d")
 
 

@@ -1,0 +1,165 @@
+"""Torch-oracle sweep for interpolate and grid_sample corner semantics
+(reference phi *_interp kernels + grid_sample_kernel; torch shares the
+same conventions, so torch-cpu is the executable oracle here —
+test/legacy_test/test_bilinear_interp_v2_op.py discipline).
+
+These pin the bugs a resize delegating to jax.image.resize had:
+antialiased downsampling, half-pixel nearest (reference floors
+i*scale), ignored align_corners/align_mode, whole-sample zero masking
+(reference zero-pads per tap), and reflection about pixel centers when
+align_corners=False (reference reflects about pixel edges)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+R = np.random.default_rng(13)
+
+
+INTERP_CASES = [
+    ("nearest", None, [5, 11]),
+    ("nearest", None, [3, 3]),      # downsample: floor(i*scale)
+    ("bilinear", False, [5, 11]),
+    ("bilinear", False, [3, 3]),    # downsample: NO antialias
+    ("bilinear", True, [5, 11]),
+    ("bilinear", True, [3, 3]),
+    ("bicubic", False, [6, 10]),
+    ("bicubic", True, [3, 3]),
+]
+
+
+@pytest.mark.parametrize("mode,ac,size", INTERP_CASES,
+                         ids=[f"{m}-{a}-{s[0]}x{s[1]}"
+                              for m, a, s in INTERP_CASES])
+def test_interpolate_2d_matches_reference(mode, ac, size):
+    x = R.standard_normal((2, 3, 8, 8)).astype("f4")
+    kw = {} if ac is None else {"align_corners": ac}
+    got = F.interpolate(paddle.to_tensor(x), size=size, mode=mode,
+                        **kw).numpy()
+    want = TF.interpolate(torch.from_numpy(x), size=tuple(size),
+                          mode=mode, **kw).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_interpolate_1d_3d_area_nhwc():
+    x1 = R.standard_normal((2, 3, 9)).astype("f4")
+    np.testing.assert_allclose(
+        F.interpolate(paddle.to_tensor(x1), size=[5], mode="linear",
+                      data_format="NCW").numpy(),
+        TF.interpolate(torch.from_numpy(x1), size=(5,),
+                       mode="linear").numpy(), rtol=2e-4, atol=2e-4)
+    x3 = R.standard_normal((1, 2, 4, 5, 6)).astype("f4")
+    for ac in (False, True):
+        np.testing.assert_allclose(
+            F.interpolate(paddle.to_tensor(x3), size=[3, 7, 4],
+                          mode="trilinear", align_corners=ac,
+                          data_format="NCDHW").numpy(),
+            TF.interpolate(torch.from_numpy(x3), size=(3, 7, 4),
+                           mode="trilinear", align_corners=ac).numpy(),
+            rtol=2e-4, atol=2e-4)
+    x = R.standard_normal((2, 3, 8, 8)).astype("f4")
+    np.testing.assert_allclose(
+        F.interpolate(paddle.to_tensor(x), size=[4, 4],
+                      mode="area").numpy(),
+        TF.interpolate(torch.from_numpy(x), size=(4, 4),
+                       mode="area").numpy(), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        F.interpolate(paddle.to_tensor(x.transpose(0, 2, 3, 1)),
+                      size=[5, 5], mode="bilinear",
+                      data_format="NHWC").numpy(),
+        TF.interpolate(torch.from_numpy(x), size=(5, 5),
+                       mode="bilinear").numpy().transpose(0, 2, 3, 1),
+        rtol=2e-4, atol=2e-4)
+
+
+def test_interpolate_align_mode_1_legacy():
+    """align_mode=1 (torch has no equivalent): src = i*scale with
+    linear weights — manual oracle per the reference kernel."""
+    x = R.standard_normal((2, 3, 8, 8)).astype("f4")
+    oh, ow = 5, 6
+    n, c, h, w = x.shape
+    want = np.zeros((n, c, oh, ow), "f4")
+    for i in range(oh):
+        for j in range(ow):
+            sy = min(i * h / oh, h - 1)
+            sx = min(j * w / ow, w - 1)
+            y0, x0 = int(sy), int(sx)
+            y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+            fy, fx = sy - y0, sx - x0
+            want[:, :, i, j] = (
+                x[:, :, y0, x0] * (1 - fy) * (1 - fx)
+                + x[:, :, y1, x0] * fy * (1 - fx)
+                + x[:, :, y0, x1] * (1 - fy) * fx
+                + x[:, :, y1, x1] * fy * fx)
+    got = F.interpolate(paddle.to_tensor(x), size=[oh, ow],
+                        mode="bilinear", align_mode=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("pm", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("ac", [False, True])
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+def test_grid_sample_matches_reference(pm, ac, mode):
+    x = R.standard_normal((2, 3, 6, 5)).astype("f4")
+    # include far out-of-bounds coords: per-tap zero padding and
+    # edge-reflection only differ from the naive forms out there
+    grid = R.uniform(-1.7, 1.7, (2, 4, 5, 2)).astype("f4")
+    got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                        mode=mode, padding_mode=pm,
+                        align_corners=ac).numpy()
+    want = TF.grid_sample(torch.from_numpy(x), torch.from_numpy(grid),
+                          mode=mode, padding_mode=pm,
+                          align_corners=ac).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_grid_sample_partial_oob_blends():
+    """A bilinear sample half outside the image blends its in-bounds
+    corners with zeros (NOT a hard zero for the whole sample)."""
+    x = np.arange(16, dtype="f4").reshape(1, 1, 4, 4)
+    grid = np.array([[[[0.99, -0.99]]]], "f4")
+    got = float(F.grid_sample(
+        paddle.to_tensor(x), paddle.to_tensor(grid),
+        padding_mode="zeros", align_corners=False).numpy())
+    want = float(TF.grid_sample(
+        torch.from_numpy(x), torch.from_numpy(grid),
+        padding_mode="zeros", align_corners=False).numpy())
+    assert want != 0.0  # the oracle itself blends
+    assert abs(got - want) < 1e-4
+
+
+def test_interpolate_gradients_flow():
+    x = paddle.to_tensor(R.standard_normal((1, 2, 6, 6)).astype("f4"))
+    x.stop_gradient = False
+    out = F.interpolate(x, size=[3, 3], mode="bilinear")
+    out.sum().backward()
+    g = x.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_interpolate_area_nhwc_and_bicubic_align_mode():
+    x = R.standard_normal((1, 8, 8, 3)).astype("f4")
+    got = F.interpolate(paddle.to_tensor(x), size=[4, 4], mode="area",
+                        data_format="NHWC").numpy()
+    want = TF.interpolate(
+        torch.from_numpy(x.transpose(0, 3, 1, 2)), size=(4, 4),
+        mode="area").numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    # align_mode only affects the linear family: bicubic stays
+    # half-pixel (reference bicubic kernel has no align_mode branch)
+    xc = R.standard_normal((1, 2, 8, 8)).astype("f4")
+    a0 = F.interpolate(paddle.to_tensor(xc), size=[5, 5], mode="bicubic",
+                       align_mode=0).numpy()
+    a1 = F.interpolate(paddle.to_tensor(xc), size=[5, 5], mode="bicubic",
+                       align_mode=1).numpy()
+    np.testing.assert_array_equal(a0, a1)
+
+
+def test_interpolate_size_rank_mismatch_raises():
+    x = paddle.ones([1, 3, 8, 8])
+    with pytest.raises(ValueError, match="spatial"):
+        F.interpolate(x, size=[5], mode="bilinear")
