@@ -163,3 +163,24 @@ def test_interpolate_size_rank_mismatch_raises():
     x = paddle.ones([1, 3, 8, 8])
     with pytest.raises(ValueError, match="spatial"):
         F.interpolate(x, size=[5], mode="bilinear")
+
+
+def test_batch_norm_running_stats_biased_variance():
+    """The reference BN kernel accumulates the BIASED batch variance
+    into running_var (cpu/batch_norm_kernel.cc:130 divides by
+    N*sample_size with no Bessel correction; :157 blends it into the
+    running buffer) — torch uses the unbiased form here, so this pins
+    the PADDLE semantics explicitly."""
+    from paddle_tpu import nn
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((4, 6, 5, 5)).astype("f4")
+    bn = nn.BatchNorm2D(6, momentum=0.9)
+    bn.train()
+    bn(paddle.to_tensor(x))
+    biased_var = x.var(axis=(0, 2, 3))          # 1/N, the reference form
+    want = 1.0 * 0.9 + biased_var * 0.1         # init var 1, momentum .9
+    np.testing.assert_allclose(bn._variance.numpy(), want, rtol=1e-4,
+                               atol=1e-5)
+    want_mean = 0.0 * 0.9 + x.mean(axis=(0, 2, 3)) * 0.1
+    np.testing.assert_allclose(bn._mean.numpy(), want_mean, rtol=1e-4,
+                               atol=1e-5)
