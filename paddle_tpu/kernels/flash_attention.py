@@ -98,9 +98,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     mask_arr = unwrap(attn_mask)
     use_dropout = training and dropout_p > 0.0
     key_rng = next_key() if use_dropout else None
+    # Route decision OUTSIDE the traced closure: _use_pallas reads the
+    # PADDLE_FLASH_FORCE env A/B switch, and anything read inside the
+    # closure is invisible to the dispatch-cache key — flipping the env
+    # var would silently cache-hit the other path's trace. As a closure
+    # cell (bool) it is part of _fn_key.
+    route_pallas = (_use_pallas(unwrap(query)) and mask_arr is None
+                    and not use_dropout)
 
     def _sdpa(q, k, v):
-        if _use_pallas(q) and mask_arr is None and not use_dropout:
+        if route_pallas:
             # native-GQA Pallas kernel: grouped KV heads are never expanded
             try:
                 from .pallas.flash_attention import (

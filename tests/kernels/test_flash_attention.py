@@ -223,3 +223,31 @@ def test_autotune_file_cache_roundtrip(tmp_path, monkeypatch):
     # corrupt file degrades to a miss, never an exception
     (tmp_path / "tune.json").write_text("{not json")
     assert fa._tune_cache_load(key) is None
+
+
+def test_force_switch_is_cache_keyed(monkeypatch):
+    """The PADDLE_FLASH_FORCE A/B switch must produce DISTINCT dispatch
+    cache entries. It used to be read inside the traced closure — flipping
+    the env var cache-hit the other path's trace, so bench_flash_ab's
+    "xla" leg silently re-ran the Pallas kernel (regression: the route
+    decision is now a closure cell, part of _fn_key)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core import dispatch
+    from paddle_tpu.nn import functional as F
+
+    # fresh cache: the key holds no array shapes, so an earlier suite
+    # test's sdpa call would pre-create the xla-leg entry and skew the
+    # count below
+    monkeypatch.setattr(dispatch, "_LAZY_FWD_CACHE", {})
+    rng = np.random.default_rng(3)
+    qkv = [paddle.to_tensor(_rand(rng, (1, 128, 2, 64)))
+           for _ in range(3)]
+    with paddle.no_grad():
+        monkeypatch.setenv("PADDLE_FLASH_FORCE", "pallas")
+        o1 = F.scaled_dot_product_attention(*qkv, is_causal=True)
+        monkeypatch.setenv("PADDLE_FLASH_FORCE", "xla")
+        o2 = F.scaled_dot_product_attention(*qkv, is_causal=True)
+    assert len(dispatch._LAZY_FWD_CACHE) == 2
+    np.testing.assert_allclose(np.asarray(o1._data, np.float32),
+                               np.asarray(o2._data, np.float32),
+                               atol=5e-3, rtol=5e-3)
