@@ -300,18 +300,37 @@ def gather_tree(ids, parents):
 # spatial sampling
 # ---------------------------------------------------------------------------
 
+def _grid_axis(size, align_corners):
+    """Normalized sample coords along one axis, the reference
+    affine_grid Linspace convention (affine_grid_kernel.cc:25): corner
+    centers at +-1 when align_corners, else half-pixel offsets."""
+    if align_corners:
+        return jnp.linspace(-1.0, 1.0, size)
+    return (jnp.arange(size) + 0.5) * 2.0 / size - 1.0
+
+
 def affine_grid(theta, out_shape, align_corners=True, name=None):
-    """2D affine sampling grid (reference affine_grid)."""
+    """Affine sampling grid (reference affine_grid): theta [N,2,3] with
+    out_shape [N,C,H,W] -> grid [N,H,W,2], or theta [N,3,4] with
+    out_shape [N,C,D,H,W] -> grid [N,D,H,W,3] (AffineGrid5DKernel,
+    base vector [x, y, z, 1] — affine_grid_utils.h:104)."""
+    dims = tuple(int(v) for v in out_shape)  # tuple: list closure
+    # cells are rejected by the dispatch cache (_cell_key whitelist)
+
     def fn(th):
-        n, _, h, w = [int(v) for v in out_shape] if len(out_shape) == 4 \
-            else (int(out_shape[0]), 0, int(out_shape[2]),
-                  int(out_shape[3]))
-        if align_corners:
-            xs = jnp.linspace(-1, 1, w)
-            ys = jnp.linspace(-1, 1, h)
-        else:
-            xs = (jnp.arange(w) + 0.5) * 2 / w - 1
-            ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+        if len(dims) == 5:
+            _, _, d, h, w = dims
+            zs = _grid_axis(d, align_corners)
+            ys = _grid_axis(h, align_corners)
+            xs = _grid_axis(w, align_corners)
+            gz, gy, gx = jnp.meshgrid(zs, ys, xs, indexing="ij")
+            base = jnp.stack([gx, gy, gz, jnp.ones_like(gx)],
+                             -1).reshape(-1, 4)  # [d*h*w, 4]
+            out = jnp.einsum("nij,pj->npi", th, base)  # [n, d*h*w, 3]
+            return out.reshape(th.shape[0], d, h, w, 3)
+        _, _, h, w = dims
+        xs = _grid_axis(w, align_corners)
+        ys = _grid_axis(h, align_corners)
         gx, gy = jnp.meshgrid(xs, ys)
         ones = jnp.ones_like(gx)
         base = jnp.stack([gx, gy, ones], -1).reshape(-1, 3)  # [h*w, 3]
@@ -320,50 +339,95 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
     return apply(fn, theta, name="affine_grid")
 
 
+def _gs_unnormalize(v, size, align_corners):
+    """[-1,1] -> pixel coords (reference grid_sample Unnormalize)."""
+    if align_corners:
+        return (v + 1) * (size - 1) / 2
+    return ((v + 1) * size - 1) / 2
+
+
+def _gs_reflect(v, size, align_corners):
+    """Reference/torch reflect: about pixel CENTERS (0, size-1) when
+    align_corners, about pixel EDGES (-0.5, size-0.5) otherwise;
+    sampling coords are clipped afterwards."""
+    if align_corners:
+        span = 2 * max(size - 1, 1)
+        v = jnp.abs(jnp.mod(v, span))
+        v = jnp.minimum(v, span - v)
+    else:
+        span = 2 * size
+        v = jnp.abs(jnp.mod(v + 0.5, span))
+        v = jnp.minimum(v, span - v) - 0.5
+    return jnp.clip(v, 0, size - 1)
+
+
+def _gs_coords(g, sizes, padding_mode, align_corners):
+    """Per-axis sampled pixel coords from a [-1,1] grid whose LAST dim
+    orders axes (x, y[, z]) fastest-varying-first; ``sizes`` are the
+    matching input extents (w, h[, d])."""
+    coords = []
+    for ax, size in enumerate(sizes):
+        f = _gs_unnormalize(g[..., ax], size, align_corners)
+        if padding_mode == "reflection":
+            f = _gs_reflect(f, size, align_corners)
+        elif padding_mode == "border":
+            f = jnp.clip(f, 0, size - 1)
+        coords.append(f)
+    return coords
+
+
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 align_corners=True, name=None):
-    """2D grid sampling (reference grid_sample): x [N,C,H,W], grid
-    [N,Hg,Wg,2] in [-1,1] xy order."""
+    """Grid sampling (reference grid_sample_kernel.cc): 4-D x [N,C,H,W]
+    with grid [N,Hg,Wg,2] (xy order), or 5-D x [N,C,D,H,W] with grid
+    [N,Dg,Hg,Wg,3] (xyz order — Calc3DGridLocations). Bilinear/
+    trilinear or nearest; zeros padding masks PER TAP (a half-out-of-
+    bounds sample still blends its in-bounds corners)."""
+    three_d = getattr(unwrap(x), "ndim", 4) == 5
 
     def fn(a, g):
-        n, c, h, w = a.shape
-        gx = g[..., 0]
-        gy = g[..., 1]
-        if align_corners:
-            fx = (gx + 1) * (w - 1) / 2
-            fy = (gy + 1) * (h - 1) / 2
-        else:
-            fx = ((gx + 1) * w - 1) / 2
-            fy = ((gy + 1) * h - 1) / 2
-
-        def reflect(v, size):
-            """Reference/torch reflect: about pixel CENTERS (0, size-1)
-            when align_corners, about pixel EDGES (-0.5, size-0.5)
-            otherwise; sampling coords are clipped afterwards."""
-            if align_corners:
-                span = 2 * max(size - 1, 1)
-                v = jnp.abs(jnp.mod(v, span))
-                v = jnp.minimum(v, span - v)
-            else:
-                span = 2 * size
-                v = jnp.abs(jnp.mod(v + 0.5, span))
-                v = jnp.minimum(v, span - v) - 0.5
-            return jnp.clip(v, 0, size - 1)
-
         zeros_pad = padding_mode == "zeros"
-        if padding_mode == "reflection":
-            fx = reflect(fx, w)
-            fy = reflect(fy, h)
-        elif padding_mode == "border":
-            fx = jnp.clip(fx, 0, w - 1)
-            fy = jnp.clip(fy, 0, h - 1)
+        if three_d:
+            n, c, d, h, w = a.shape
+            fx, fy, fz = _gs_coords(g, (w, h, d), padding_mode,
+                                    align_corners)
+            bidx = jnp.arange(n)[:, None, None, None]
 
+            def tap(iz, iy, ix):
+                val = a[bidx, :, jnp.clip(iz, 0, d - 1),
+                        jnp.clip(iy, 0, h - 1),
+                        jnp.clip(ix, 0, w - 1)]  # [n, dg, hg, wg, c]
+                if zeros_pad:
+                    ok = ((iz >= 0) & (iz <= d - 1) & (iy >= 0) &
+                          (iy <= h - 1) & (ix >= 0) & (ix <= w - 1))
+                    val = val * ok[..., None].astype(val.dtype)
+                return val
+
+            if mode == "nearest":
+                return jnp.moveaxis(
+                    tap(jnp.round(fz).astype(jnp.int32),
+                        jnp.round(fy).astype(jnp.int32),
+                        jnp.round(fx).astype(jnp.int32)), -1, 1)
+
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            z0 = jnp.floor(fz).astype(jnp.int32)
+            wx_ = (fx - jnp.floor(fx))[..., None]
+            wy_ = (fy - jnp.floor(fy))[..., None]
+            wz_ = (fz - jnp.floor(fz))[..., None]
+            out = 0
+            for dz, cz in ((0, 1 - wz_), (1, wz_)):
+                for dy, cy in ((0, 1 - wy_), (1, wy_)):
+                    for dx, cx in ((0, 1 - wx_), (1, wx_)):
+                        out = out + tap(z0 + dz, y0 + dy,
+                                        x0 + dx) * cz * cy * cx
+            return jnp.moveaxis(out, -1, 1)  # [n, c, dg, hg, wg]
+
+        n, c, h, w = a.shape
+        fx, fy = _gs_coords(g, (w, h), padding_mode, align_corners)
         bidx = jnp.arange(n)[:, None, None]
 
         def tap(iy, ix):
-            """Value at integer (iy, ix); zeros padding masks PER TAP
-            (a half-out-of-bounds bilinear sample still blends its
-            in-bounds corners, reference grid_sample_kernel)."""
             val = a[bidx, :, jnp.clip(iy, 0, h - 1),
                     jnp.clip(ix, 0, w - 1)]  # [n, hg, wg, c]
             if zeros_pad:

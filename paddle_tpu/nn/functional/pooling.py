@@ -21,19 +21,24 @@ def _pool_nd(n, x, kernel_size, stride, padding, reducer, init, data_format,
     kernel = _tuplize(kernel_size, n)
     stride = _tuplize(stride if stride is not None else kernel_size, n)
     channel_last = data_format in ("NLC", "NHWC", "NDHWC")
-    pads = _padding_pairs(padding, n, kernel, (1,) * n)
+    base_pads = _padding_pairs(padding, n, kernel, (1,) * n)
     if ceil_mode:
         # extend hi padding so the last partial window is included
-        pads = [(lo, hi + s - 1) for (lo, hi), s in zip(pads, stride)]
+        # (reference PoolOutputSize ceil formula, pooling.h:501)
+        pads = [(lo, hi + s - 1) for (lo, hi), s in zip(base_pads, stride)]
+    else:
+        pads = base_pads
 
     if channel_last:
         window = (1,) + kernel + (1,)
         strides = (1,) + stride + (1,)
         padcfg = [(0, 0)] + pads + [(0, 0)]
+        base_padcfg = [(0, 0)] + base_pads + [(0, 0)]
     else:
         window = (1, 1) + kernel
         strides = (1, 1) + stride
         padcfg = [(0, 0), (0, 0)] + pads
+        base_padcfg = [(0, 0), (0, 0)] + base_pads
 
     def fwd(a):
         # init must stay a PYTHON scalar: an asarray() init becomes a
@@ -43,14 +48,29 @@ def _pool_nd(n, x, kernel_size, stride, padding, reducer, init, data_format,
         out = lax.reduce_window(a, np.asarray(init, a.dtype).item(),
                                 reducer, window, strides, padcfg)
         if average:
+            zero = 0.0 if jnp.issubdtype(a.dtype, jnp.floating) else 0
             if count_include_pad:
-                denom = np.prod(kernel).astype(np.float32)
-                out = out / jnp.asarray(denom, a.dtype)
+                if ceil_mode:
+                    # the reference caps the INCLUSIVE window at
+                    # input+padding (pooling.cc:78 hend = min(hstart+k,
+                    # H+pad)): base padding counts, the ceil-mode
+                    # extension beyond it does not — count via ones
+                    # padded with 1s over base padding only
+                    ones = jnp.pad(jnp.ones(a.shape, a.dtype),
+                                   base_padcfg, constant_values=1)
+                    ext_padcfg = [(0, p - b) for (_, p), (_, b)
+                                  in zip(padcfg, base_padcfg)]
+                    counts = lax.reduce_window(
+                        ones, zero, lax.add, window, strides,
+                        ext_padcfg)
+                    out = out / counts
+                else:
+                    denom = np.prod(kernel).astype(np.float32)
+                    out = out / jnp.asarray(denom, a.dtype)
             else:
                 ones = jnp.ones(a.shape, a.dtype)
                 counts = lax.reduce_window(
-                    ones, 0.0 if jnp.issubdtype(a.dtype, jnp.floating)
-                    else 0, lax.add, window, strides, padcfg)
+                    ones, zero, lax.add, window, strides, padcfg)
                 out = out / counts
         return out
 

@@ -184,3 +184,75 @@ def test_batch_norm_running_stats_biased_variance():
     want_mean = 0.0 * 0.9 + x.mean(axis=(0, 2, 3)) * 0.1
     np.testing.assert_allclose(bn._mean.numpy(), want_mean, rtol=1e-4,
                                atol=1e-5)
+
+
+@pytest.mark.parametrize("ac", [True, False])
+def test_affine_grid_2d_matches_reference(ac):
+    """affine_grid 2D vs torch (same Linspace convention,
+    affine_grid_kernel.cc:25)."""
+    rng = np.random.default_rng(7)
+    th = rng.standard_normal((2, 2, 3)).astype("f4")
+    got = F.affine_grid(paddle.to_tensor(th), [2, 3, 5, 4],
+                        align_corners=ac).numpy()
+    want = TF.affine_grid(torch.from_numpy(th), (2, 3, 5, 4),
+                          align_corners=ac).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_affine_grid_2d_docstring_values():
+    """Pin the reference docstring example exactly
+    (python/paddle/nn/functional/vision.py affine_grid example)."""
+    th = np.array([[[-0.7, -0.4, 0.3], [0.6, 0.5, 1.5]]], "f4")
+    got = F.affine_grid(paddle.to_tensor(th), [1, 2, 3, 3],
+                        align_corners=False).numpy()
+    want = np.array([[[[1.0333333, 0.76666665], [0.5666667, 1.1666666],
+                       [0.1, 1.5666667]],
+                      [[0.76666665, 1.0999999], [0.3, 1.5],
+                       [-0.16666666, 1.9000001]],
+                      [[0.5, 1.4333333], [0.03333333, 1.8333334],
+                       [-0.43333334, 2.2333333]]]], "f4")
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("ac", [True, False])
+def test_affine_grid_3d_matches_reference(ac):
+    """affine_grid theta [N,3,4] -> [N,D,H,W,3]
+    (AffineGrid5DKernel, affine_grid_utils.h:104)."""
+    rng = np.random.default_rng(8)
+    th = rng.standard_normal((2, 3, 4)).astype("f4")
+    got = F.affine_grid(paddle.to_tensor(th), [2, 1, 3, 4, 5],
+                        align_corners=ac).numpy()
+    want = TF.affine_grid(torch.from_numpy(th), (2, 1, 3, 4, 5),
+                          align_corners=ac).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("pm", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("ac", [True, False])
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+def test_grid_sample_3d_matches_reference(pm, ac, mode):
+    """5-D grid_sample (trilinear/nearest, Calc3DGridLocations) vs
+    torch; grid pushed out of [-1,1] to exercise every padding mode."""
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((2, 3, 4, 5, 6)).astype("f4")
+    grid = (rng.uniform(-1.6, 1.6, (2, 3, 4, 2, 3))).astype("f4")
+    got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                        mode=mode, padding_mode=pm,
+                        align_corners=ac).numpy()
+    want = TF.grid_sample(torch.from_numpy(x), torch.from_numpy(grid),
+                          mode=mode, padding_mode=pm,
+                          align_corners=ac).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_affine_grid_then_sample_3d_identity():
+    """Identity theta + 3-D grid_sample round-trips the volume."""
+    rng = np.random.default_rng(10)
+    x = rng.standard_normal((1, 2, 4, 4, 4)).astype("f4")
+    th = np.broadcast_to(
+        np.eye(3, 4, dtype="f4"), (1, 3, 4)).copy()
+    g = F.affine_grid(paddle.to_tensor(th), [1, 2, 4, 4, 4],
+                      align_corners=True)
+    out = F.grid_sample(paddle.to_tensor(x), g,
+                        align_corners=True).numpy()
+    np.testing.assert_allclose(out, x, atol=1e-5)

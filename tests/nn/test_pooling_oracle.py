@@ -1,0 +1,114 @@
+"""Pooling corner-semantics oracle sweep vs torch-cpu.
+
+Reference kernels: paddle/phi/kernels/funcs/pooling.cc (window math,
+inclusive pool_size capped at input+padding: :78), pooling.h:501
+(PoolOutputSize ceil formula). torch shares these conventions for the
+configurations below (k >= s, so the paddle formula and torch's
+"window starts within input+pad" rule agree); paddle `exclusive` is
+the negation of torch `count_include_pad`.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _x(shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype("f4")
+
+
+@pytest.mark.parametrize("ceil", [False, True])
+@pytest.mark.parametrize("exclusive", [True, False])
+@pytest.mark.parametrize("k,s,p", [(3, 2, 1), (2, 2, 0), (3, 3, 1)])
+def test_avg_pool2d_matches_reference(ceil, exclusive, k, s, p):
+    x = _x((2, 3, 7, 9))
+    got = F.avg_pool2d(paddle.to_tensor(x), k, stride=s, padding=p,
+                       ceil_mode=ceil, exclusive=exclusive).numpy()
+    want = TF.avg_pool2d(torch.from_numpy(x), k, stride=s, padding=p,
+                         ceil_mode=ceil,
+                         count_include_pad=not exclusive).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("ceil", [False, True])
+@pytest.mark.parametrize("k,s,p", [(3, 2, 1), (2, 2, 0)])
+def test_max_pool2d_matches_reference(ceil, k, s, p):
+    x = _x((2, 3, 7, 9), 1)
+    got = F.max_pool2d(paddle.to_tensor(x), k, stride=s, padding=p,
+                       ceil_mode=ceil).numpy()
+    want = TF.max_pool2d(torch.from_numpy(x), k, stride=s, padding=p,
+                         ceil_mode=ceil).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("exclusive", [True, False])
+def test_avg_pool1d_3d_ceil_inclusive(exclusive):
+    x1 = _x((2, 3, 11), 2)
+    got = F.avg_pool1d(paddle.to_tensor(x1), 4, stride=3, padding=2,
+                       ceil_mode=True, exclusive=exclusive).numpy()
+    want = TF.avg_pool1d(torch.from_numpy(x1), 4, stride=3, padding=2,
+                         ceil_mode=True,
+                         count_include_pad=not exclusive).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+    x3 = _x((1, 2, 5, 6, 7), 3)
+    got = F.avg_pool3d(paddle.to_tensor(x3), 3, stride=2, padding=1,
+                       ceil_mode=True, exclusive=exclusive).numpy()
+    want = TF.avg_pool3d(torch.from_numpy(x3), 3, stride=2, padding=1,
+                         ceil_mode=True,
+                         count_include_pad=not exclusive).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_avg_pool2d_divisor_override_reference_form():
+    """Reference python applies divisor_override as
+    output * k0*k1 / divisor ON TOP of the exclusive result
+    (nn/functional/pooling.py:409) — pin that exact form."""
+    x = _x((1, 2, 6, 6), 4)
+    base = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                        exclusive=True).numpy()
+    got = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                       exclusive=True, divisor_override=5).numpy()
+    np.testing.assert_allclose(got, base * 9.0 / 5.0, rtol=1e-6)
+
+
+def test_max_pool2d_return_mask_matches_reference():
+    """Mask is the flat index into the spatial plane (reference
+    max_pool_with_index)."""
+    x = _x((2, 3, 8, 8), 5)
+    got, mask = F.max_pool2d(paddle.to_tensor(x), 2, stride=2,
+                             return_mask=True)
+    want, widx = TF.max_pool2d(torch.from_numpy(x), 2, stride=2,
+                               return_indices=True)
+    np.testing.assert_allclose(got.numpy(), want.numpy(), atol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), widx.numpy())
+
+
+@pytest.mark.parametrize("out", [(3, 3), (4, 5), (1, 1)])
+def test_adaptive_avg_pool2d_matches_reference(out):
+    x = _x((2, 3, 7, 9), 6)
+    got = F.adaptive_avg_pool2d(paddle.to_tensor(x), out).numpy()
+    want = TF.adaptive_avg_pool2d(torch.from_numpy(x), out).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize("out", [(3,), (5,)])
+def test_adaptive_max_pool1d_matches_reference(out):
+    x = _x((2, 3, 11), 7)
+    got = F.adaptive_max_pool1d(paddle.to_tensor(x), out[0]).numpy()
+    want = TF.adaptive_max_pool1d(torch.from_numpy(x), out).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_avg_pool_gradients_flow_through_ceil_inclusive():
+    t = paddle.to_tensor(_x((1, 2, 7, 7), 8))
+    t.stop_gradient = False
+    y = F.avg_pool2d(t, 3, stride=2, padding=1, ceil_mode=True,
+                     exclusive=False)
+    y.sum().backward()
+    g = t.grad.numpy()
+    assert np.isfinite(g).all() and (np.abs(g) > 0).any()
