@@ -250,8 +250,11 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
         moved = jnp.moveaxis(sq, ch_axis, -1)
         pad = [(0, 0)] * (moved.ndim - 1) + [(size // 2, (size - 1) // 2)]
         padded = jnp.pad(moved, pad)
+        # reference divides the windowed sum by size (avg_pool over the
+        # zero-padded square, nn/functional/norm.py local_response_norm:
+        # div = scale(avg_pool(x^2), alpha) — torch's convention too)
         win = jnp.stack([padded[..., i:i + moved.shape[-1]]
-                         for i in range(size)], axis=-1).sum(-1)
+                         for i in range(size)], axis=-1).mean(-1)
         win = jnp.moveaxis(win, -1, ch_axis)
         return a / jnp.power(k + alpha * win, beta)
     return apply(_lrn, x, name="local_response_norm")
@@ -324,9 +327,12 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
             out = a.reshape(n, c // (r * r), r, r, h, w)
             out = out.transpose(0, 1, 4, 2, 5, 3)
             return out.reshape(n, c // (r * r), h * r, w * r)
+        # NHWC: channels decompose as (c', r1, r2) — c' FIRST
+        # (pixel_shuffle_kernel_impl.h:42 t.Resize{n,h,w,c',r,r} with
+        # axis {0,1,4,2,5,3})
         n, h, w, c = a.shape
-        out = a.reshape(n, h, w, r, r, c // (r * r))
-        out = out.transpose(0, 1, 3, 2, 4, 5)
+        out = a.reshape(n, h, w, c // (r * r), r, r)
+        out = out.transpose(0, 1, 4, 2, 5, 3)
         return out.reshape(n, h * r, w * r, c // (r * r))
     return apply(_ps, x, name="pixel_shuffle")
 
@@ -340,9 +346,11 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
             out = a.reshape(n, c, h // r, r, w // r, r)
             out = out.transpose(0, 1, 3, 5, 2, 4)
             return out.reshape(n, c * r * r, h // r, w // r)
+        # NHWC: output channels are (c, r1, r2) with ORIGINAL c first
+        # (pixel_unshuffle_kernel_impl.h:41 axis {0,1,3,5,2,4})
         n, h, w, c = a.shape
         out = a.reshape(n, h // r, r, w // r, r, c)
-        out = out.transpose(0, 1, 3, 2, 4, 5)
+        out = out.transpose(0, 1, 3, 5, 2, 4)
         return out.reshape(n, h // r, w // r, c * r * r)
     return apply(_pu, x, name="pixel_unshuffle")
 
@@ -371,7 +379,10 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
         if len(pd) == 2:
             pads = (pd[0], pd[0], pd[1], pd[1])
         else:
-            pads = tuple(pd)
+            # reference 4-form is [top, LEFT, bottom, RIGHT]
+            # (nn/functional/common.py unfold: hout uses paddings[0]+
+            # paddings[2], wout uses paddings[1]+paddings[3])
+            pads = (pd[0], pd[2], pd[1], pd[3])
         ap = jnp.pad(a, ((0, 0), (0, 0), (pads[0], pads[1]),
                          (pads[2], pads[3])))
         oh = (ap.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
@@ -392,23 +403,31 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
     os_ = _pair(output_sizes)
     ks = _pair(kernel_sizes)
     st = _pair(strides)
-    pd = _pair(paddings)
     dl = _pair(dilations)
+    # reference normalizes paddings to the im2col 4-form
+    # [top, left, bottom, right] (nn/functional/common.py fold: len-2
+    # [ph, pw] doubles to [ph, pw, ph, pw])
+    if isinstance(paddings, (list, tuple)) and len(paddings) == 4:
+        p4 = tuple(int(p) for p in paddings)
+    else:
+        ph, pw = _pair(paddings)  # int / np scalar / len-2, like unfold
+        p4 = (ph, pw, ph, pw)
+    pt, pl, pb, pr = p4
 
     def _fold(a):
         n, ckk, L = a.shape
         c = ckk // (ks[0] * ks[1])
-        oh = (os_[0] + 2 * pd[0] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
-        ow = (os_[1] + 2 * pd[1] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        oh = (os_[0] + pt + pb - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (os_[1] + pl + pr - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
         a2 = a.reshape(n, c, ks[0], ks[1], oh, ow)
-        out = jnp.zeros((n, c, os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]),
+        out = jnp.zeros((n, c, os_[0] + pt + pb, os_[1] + pl + pr),
                         a.dtype)
         for i in range(ks[0]):
             for j in range(ks[1]):
                 out = out.at[:, :, i * dl[0]:i * dl[0] + oh * st[0]:st[0],
                              j * dl[1]:j * dl[1] + ow * st[1]:st[1]].add(
                     a2[:, :, i, j])
-        return out[:, :, pd[0]:os_[0] + pd[0], pd[1]:os_[1] + pd[1]]
+        return out[:, :, pt:os_[0] + pt, pl:os_[1] + pl]
     return apply(_fold, x, name="fold")
 
 

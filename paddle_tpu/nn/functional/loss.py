@@ -150,11 +150,15 @@ def l1_loss(input, label, reduction="mean", name=None):
 
 
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    """Reference smooth_l1_loss delegates to the HUBER kernel
+    (loss.py:1166 -> huber_loss_kernel_impl.h:25): 0.5*d^2 inside
+    delta, delta*(|d| - 0.5*delta) outside — NOT torch's beta form
+    (0.5*d^2/beta), which only coincides at delta=1."""
     def _sl1(a, b):
         d = a - b
         abs_d = jnp.abs(d)
-        loss = jnp.where(abs_d < delta, 0.5 * d * d / delta,
-                         abs_d - 0.5 * delta)
+        loss = jnp.where(abs_d <= delta, 0.5 * d * d,
+                         delta * (abs_d - 0.5 * delta))
         return _reduce(loss, reduction)
     return apply(_sl1, input, label, name="smooth_l1_loss")
 

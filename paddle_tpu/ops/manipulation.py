@@ -378,8 +378,14 @@ def _norm_range(s, e, dim):
 
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
-    pad = [int(unwrap(p)) for p in pad] if not isinstance(pad, int) else \
-        [int(pad)] * (2 * x.ndim)
+    if isinstance(pad, int):
+        # int padding means the SPATIAL dims only (reference Pad1D/2D/3D
+        # expand an int via _npairs to the partial spatial spec) — the
+        # full-rank expansion would also pad batch/channel
+        n_spatial = x.ndim - 2 if 3 <= x.ndim <= 5 else x.ndim
+        pad = [int(pad)] * (2 * n_spatial)
+    else:
+        pad = [int(unwrap(p)) for p in pad]
 
     def _pad(a):
         nd = a.ndim
@@ -390,12 +396,16 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
             # applies to all dims in order.
             widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
         else:
-            # partial spec applies to trailing spatial dims (torch-style,
-            # which paddle follows for NCHW/NCL/NCDHW): last dim first.
+            # partial spec applies to SPATIAL dims, innermost first
+            # (pad_left/right = W, then H, then D — reference pad3d
+            # dispatch in nn/functional/common.py): for channel-last
+            # layouts the last spatial dim is nd-2, not nd-1.
             n = len(pad) // 2
             widths = [(0, 0)] * nd
+            channel_last = data_format.upper() in ("NLC", "NHWC", "NDHWC")
+            last_spatial = nd - 2 if channel_last else nd - 1
             for i in range(n):
-                dim = nd - 1 - i
+                dim = last_spatial - i
                 widths[dim] = (pad[2 * i], pad[2 * i + 1])
         jmode = {"constant": "constant", "reflect": "reflect",
                  "replicate": "edge", "circular": "wrap"}[mode]

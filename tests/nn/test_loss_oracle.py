@@ -1,0 +1,201 @@
+"""Loss-family corner-semantics oracle sweep vs torch-cpu.
+
+Reference: python/paddle/nn/functional/loss.py + phi loss kernels.
+Parameter mapping where conventions differ:
+- paddle smooth_l1_loss(delta) IS the huber kernel
+  (huber_loss_kernel_impl.h:25) == torch.nn.functional.huber_loss —
+  NOT torch's smooth_l1_loss(beta) form.
+- everything else maps 1:1 for the configurations below.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _r(shape, seed=0, scale=1.0):
+    return (np.random.default_rng(seed).standard_normal(shape)
+            * scale).astype("f4")
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+@pytest.mark.parametrize("delta", [0.5, 1.0, 2.5])
+@pytest.mark.parametrize("red", ["mean", "sum", "none"])
+def test_smooth_l1_is_huber(delta, red):
+    x, y = _r((4, 7), 0, 2.0), _r((4, 7), 1, 2.0)
+    got = F.smooth_l1_loss(_t(x), _t(y), reduction=red,
+                           delta=delta).numpy()
+    want = TF.huber_loss(torch.from_numpy(x), torch.from_numpy(y),
+                         reduction=red, delta=delta).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("log_target", [False, True])
+@pytest.mark.parametrize("red", ["mean", "sum", "batchmean", "none"])
+def test_kl_div(log_target, red):
+    logp = np.log(np.random.default_rng(2).dirichlet(
+        np.ones(5), 6)).astype("f4")
+    tgt = np.random.default_rng(3).dirichlet(np.ones(5), 6).astype("f4")
+    t_in = np.log(tgt) if log_target else tgt
+    got = F.kl_div(_t(logp), _t(t_in), reduction=red,
+                   log_target=log_target).numpy()
+    want = TF.kl_div(torch.from_numpy(logp), torch.from_numpy(t_in),
+                     reduction=red, log_target=log_target).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_kl_div_zero_target_no_nan():
+    """label=0 bins contribute exactly 0 (xlogy convention), not NaN."""
+    logp = np.log(np.array([[0.25, 0.25, 0.5]], "f4"))
+    tgt = np.array([[0.0, 0.3, 0.7]], "f4")
+    got = F.kl_div(_t(logp), _t(tgt), reduction="none").numpy()
+    assert np.isfinite(got).all() and got[0, 0] == 0.0
+
+
+@pytest.mark.parametrize("margin", [0.0, 0.3])
+@pytest.mark.parametrize("red", ["mean", "sum", "none"])
+def test_margin_ranking(margin, red):
+    a, b = _r((9,), 4), _r((9,), 5)
+    t = np.sign(_r((9,), 6)).astype("f4")
+    got = F.margin_ranking_loss(_t(a), _t(b), _t(t), margin=margin,
+                                reduction=red).numpy()
+    want = TF.margin_ranking_loss(
+        torch.from_numpy(a), torch.from_numpy(b), torch.from_numpy(t),
+        margin=margin, reduction=red).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("red", ["mean", "sum", "none"])
+def test_hinge_and_soft_margin(red):
+    a = _r((8,), 7)
+    t = np.where(_r((8,), 8) > 0, 1.0, -1.0).astype("f4")
+    got = F.hinge_embedding_loss(_t(a), _t(t), margin=1.0,
+                                 reduction=red).numpy()
+    want = TF.hinge_embedding_loss(
+        torch.from_numpy(a), torch.from_numpy(t), margin=1.0,
+        reduction=red).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+    got = F.soft_margin_loss(_t(a), _t(t), reduction=red).numpy()
+    want = TF.soft_margin_loss(torch.from_numpy(a),
+                               torch.from_numpy(t),
+                               reduction=red).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("margin", [0.0, 0.4])
+def test_cosine_embedding(margin):
+    a, b = _r((6, 5), 9), _r((6, 5), 10)
+    t = np.where(_r((6,), 11) > 0, 1, -1).astype("f4")
+    got = F.cosine_embedding_loss(_t(a), _t(b), _t(t),
+                                  margin=margin).numpy()
+    want = TF.cosine_embedding_loss(
+        torch.from_numpy(a), torch.from_numpy(b), torch.from_numpy(t),
+        margin=margin).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("swap", [False, True])
+@pytest.mark.parametrize("p", [1.0, 2.0])
+def test_triplet_margin(swap, p):
+    a, pos, neg = _r((5, 8), 12), _r((5, 8), 13), _r((5, 8), 14)
+    got = F.triplet_margin_loss(_t(a), _t(pos), _t(neg), p=p,
+                                swap=swap).numpy()
+    want = TF.triplet_margin_loss(
+        torch.from_numpy(a), torch.from_numpy(pos),
+        torch.from_numpy(neg), p=p, swap=swap).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("red", ["mean", "sum", "none"])
+def test_nll_weight_ignore_index_denominator(red):
+    """Weighted mean divides by the SUM OF PICKED WEIGHTS over
+    non-ignored rows (reference nll_loss total_weight semantics)."""
+    rng = np.random.default_rng(15)
+    logp = np.log(rng.dirichlet(np.ones(4), 10)).astype("f4")
+    lbl = rng.integers(0, 4, 10).astype("i8")
+    lbl[[2, 7]] = -100
+    w = np.array([0.2, 1.5, 0.7, 1.0], "f4")
+    got = F.nll_loss(_t(logp), _t(lbl), weight=_t(w),
+                     reduction=red).numpy()
+    want = TF.nll_loss(torch.from_numpy(logp), torch.from_numpy(lbl),
+                       weight=torch.from_numpy(w), ignore_index=-100,
+                       reduction=red).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_bce_with_logits_pos_weight():
+    rng = np.random.default_rng(16)
+    z = _r((6, 3), 17, 2.0)
+    t = (rng.random((6, 3)) > 0.5).astype("f4")
+    pw = np.array([0.5, 2.0, 1.3], "f4")
+    w = np.array([1.0, 0.3, 0.9], "f4")
+    got = F.binary_cross_entropy_with_logits(
+        _t(z), _t(t), weight=_t(w), pos_weight=_t(pw)).numpy()
+    want = TF.binary_cross_entropy_with_logits(
+        torch.from_numpy(z), torch.from_numpy(t),
+        weight=torch.from_numpy(w),
+        pos_weight=torch.from_numpy(pw)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("log_input,full", [(True, False), (False, False),
+                                            (True, True)])
+def test_poisson_nll(log_input, full):
+    x = _r((7,), 18)
+    t = np.abs(_r((7,), 19, 2.0)).astype("f4")
+    got = F.poisson_nll_loss(_t(x), _t(t), log_input=log_input,
+                             full=full).numpy()
+    want = TF.poisson_nll_loss(torch.from_numpy(x), torch.from_numpy(t),
+                               log_input=log_input, full=full).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("full", [False, True])
+def test_gaussian_nll(full):
+    x, t = _r((6, 4), 20), _r((6, 4), 21)
+    var = (np.abs(_r((6, 4), 22)) + 0.1).astype("f4")
+    got = F.gaussian_nll_loss(_t(x), _t(t), _t(var), full=full).numpy()
+    want = TF.gaussian_nll_loss(torch.from_numpy(x),
+                                torch.from_numpy(t),
+                                torch.from_numpy(var), full=full).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_label_soft_margin():
+    z = _r((5, 6), 23, 1.5)
+    t = (np.random.default_rng(24).random((5, 6)) > 0.5).astype("f4")
+    w = np.abs(_r((6,), 25)).astype("f4") + 0.1
+    got = F.multi_label_soft_margin_loss(_t(z), _t(t),
+                                         weight=_t(w)).numpy()
+    want = TF.multilabel_soft_margin_loss(
+        torch.from_numpy(z), torch.from_numpy(t),
+        weight=torch.from_numpy(w)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_cross_entropy_label_smoothing():
+    rng = np.random.default_rng(26)
+    z = _r((8, 5), 27, 2.0)
+    lbl = rng.integers(0, 5, 8).astype("i8")
+    got = F.cross_entropy(_t(z), _t(lbl), label_smoothing=0.2).numpy()
+    want = TF.cross_entropy(torch.from_numpy(z), torch.from_numpy(lbl),
+                            label_smoothing=0.2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_smooth_l1_gradients_flow():
+    t = _t(_r((4, 4), 28, 3.0))
+    t.stop_gradient = False
+    F.smooth_l1_loss(t, _t(_r((4, 4), 29)), delta=2.0).backward()
+    g = t.grad.numpy()
+    assert np.isfinite(g).all()
+    # huber grad: d inside delta, delta*sign(d) outside (scaled by 1/N)
+    assert np.abs(g).max() <= 2.0 / 16 + 1e-6

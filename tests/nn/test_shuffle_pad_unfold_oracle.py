@@ -1,0 +1,210 @@
+"""Oracle sweep: pad modes, pixel/channel shuffle (incl. NHWC), fold/
+unfold, local_response_norm — vs torch-cpu.
+
+Reference semantics verified against the phi kernels:
+- pixel_shuffle_kernel_impl.h:42 — NHWC decomposes channels (c', r, r)
+  with c' first; same element mapping as NCHW modulo layout transpose,
+  so torch-via-transpose is an exact NHWC oracle.
+- pixel_unshuffle_kernel_impl.h:41 — NHWC output channels (c, r1, r2).
+- unfold/fold 4-element paddings are [top, left, bottom, right]
+  (nn/functional/common.py: hout uses paddings[0]+paddings[2]).
+- local_response_norm divides the window sum by size (avg_pool form),
+  matching torch's alpha convention.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _r(shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype("f4")
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+@pytest.mark.parametrize("mode", ["constant", "reflect", "replicate",
+                                  "circular"])
+def test_pad_2d_partial_matches_reference(mode):
+    x = _r((2, 3, 5, 6))
+    pad = [1, 2, 2, 1]  # l, r, t, b
+    got = paddle.nn.functional.pad(_t(x), pad, mode=mode,
+                                   value=0.5).numpy()
+    want = TF.pad(torch.from_numpy(x), pad, mode=mode,
+                  value=0.5 if mode == "constant" else 0.0).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-7)
+
+
+@pytest.mark.parametrize("mode", ["constant", "reflect", "replicate",
+                                  "circular"])
+def test_pad_channel_last_pads_spatial_dims(mode):
+    """NHWC partial pad targets the SPATIAL dims (reference pad3d
+    NDHWC dispatch) — not the trailing channel dim."""
+    x = _r((2, 5, 6, 3), 1)
+    pad = [1, 2, 2, 1]
+    got = paddle.nn.functional.pad(_t(x), pad, mode=mode, value=0.25,
+                                   data_format="NHWC").numpy()
+    xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+    want = TF.pad(xt, pad, mode=mode,
+                  value=0.25 if mode == "constant" else 0.0)
+    want = want.permute(0, 2, 3, 1).numpy()
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=1e-7)
+
+
+def test_pad_1d_3d_modes():
+    x1 = _r((2, 3, 8), 2)
+    for mode in ["reflect", "replicate", "circular"]:
+        got = paddle.nn.functional.pad(_t(x1), [2, 1], mode=mode,
+                                       data_format="NCL").numpy()
+        want = TF.pad(torch.from_numpy(x1), [2, 1], mode=mode).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-7)
+    x3 = _r((1, 2, 4, 5, 6), 3)
+    for mode in ["replicate", "circular"]:
+        got = paddle.nn.functional.pad(
+            _t(x3), [1, 2, 2, 1, 1, 0], mode=mode,
+            data_format="NCDHW").numpy()
+        want = TF.pad(torch.from_numpy(x3), [1, 2, 2, 1, 1, 0],
+                      mode=mode).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-7)
+
+
+def test_pad_full_rank_constant():
+    x = _r((2, 3, 4), 4)
+    got = paddle.nn.functional.pad(_t(x), [1, 0, 0, 2, 1, 1],
+                                   value=7.0).numpy()
+    want = np.pad(x, [(1, 0), (0, 2), (1, 1)], constant_values=7.0)
+    np.testing.assert_allclose(got, want)
+
+
+@pytest.mark.parametrize("r", [2, 3])
+def test_pixel_shuffle_nchw_and_nhwc(r):
+    x = _r((2, 4 * r * r, 3, 5), 5)
+    got = F.pixel_shuffle(_t(x), r).numpy()
+    want = TF.pixel_shuffle(torch.from_numpy(x), r).numpy()
+    np.testing.assert_allclose(got, want)
+    # NHWC shares the (c', r1, r2) decomposition -> transpose oracle
+    xl = np.ascontiguousarray(x.transpose(0, 2, 3, 1))
+    got = F.pixel_shuffle(_t(xl), r, data_format="NHWC").numpy()
+    np.testing.assert_allclose(got, want.transpose(0, 2, 3, 1))
+
+
+@pytest.mark.parametrize("r", [2, 3])
+def test_pixel_unshuffle_nchw_and_nhwc(r):
+    x = _r((2, 3, 4 * r, 5 * r), 6)
+    got = F.pixel_unshuffle(_t(x), r).numpy()
+    want = TF.pixel_unshuffle(torch.from_numpy(x), r).numpy()
+    np.testing.assert_allclose(got, want)
+    xl = np.ascontiguousarray(x.transpose(0, 2, 3, 1))
+    got = F.pixel_unshuffle(_t(xl), r, data_format="NHWC").numpy()
+    np.testing.assert_allclose(got, want.transpose(0, 2, 3, 1))
+
+
+def test_pixel_shuffle_unshuffle_roundtrip_nhwc():
+    x = _r((1, 4, 6, 8), 7)  # NHWC, c=8=2*2*2
+    y = F.pixel_shuffle(_t(x), 2, data_format="NHWC")
+    back = F.pixel_unshuffle(y, 2, data_format="NHWC").numpy()
+    np.testing.assert_allclose(back, x)
+
+
+def test_channel_shuffle_nchw_and_nhwc():
+    x = _r((2, 6, 3, 4), 8)
+    got = F.channel_shuffle(_t(x), 3).numpy()
+    want = TF.channel_shuffle(torch.from_numpy(x), 3).numpy()
+    np.testing.assert_allclose(got, want)
+    xl = np.ascontiguousarray(x.transpose(0, 2, 3, 1))
+    got = F.channel_shuffle(_t(xl), 3, data_format="NHWC").numpy()
+    np.testing.assert_allclose(got, want.transpose(0, 2, 3, 1))
+
+
+@pytest.mark.parametrize("st,dl", [(1, 1), (2, 1), (1, 2), (2, 2)])
+def test_unfold_matches_reference(st, dl):
+    x = _r((2, 3, 9, 10), 9)
+    got = F.unfold(_t(x), 3, strides=st, paddings=1,
+                   dilations=dl).numpy()
+    want = TF.unfold(torch.from_numpy(x), 3, stride=st, padding=1,
+                     dilation=dl).numpy()
+    np.testing.assert_allclose(got, want)
+
+
+def test_unfold_asymmetric_padding_order():
+    """4-element paddings are [top, LEFT, bottom, RIGHT]
+    (reference unfold: wout uses paddings[1] + paddings[3])."""
+    x = _r((1, 2, 6, 7), 10)
+    got = F.unfold(_t(x), [2, 3], paddings=[1, 0, 2, 1]).numpy()
+    # oracle: pad manually (t=1, b=2, l=0, r=1), then unfold unpadded
+    xp = np.pad(x, [(0, 0), (0, 0), (1, 2), (0, 1)])
+    want = TF.unfold(torch.from_numpy(xp), (2, 3)).numpy()
+    np.testing.assert_allclose(got, want)
+
+
+def test_fold_matches_reference_and_roundtrip():
+    x = _r((2, 3 * 2 * 2, 12), 11)
+    got = F.fold(_t(x), [4, 5], [2, 2], strides=1, paddings=0).numpy()
+    want = TF.fold(torch.from_numpy(x), (4, 5), (2, 2)).numpy()
+    np.testing.assert_allclose(got, want)
+    # fold(unfold(x)) == divisor-weighted x (overlap counts)
+    img = _r((1, 2, 6, 6), 12)
+    u = F.unfold(_t(img), 3, strides=1, paddings=1)
+    f = F.fold(u, [6, 6], 3, strides=1, paddings=1).numpy()
+    ut = TF.unfold(torch.from_numpy(img), 3, stride=1, padding=1)
+    ft = TF.fold(ut, (6, 6), 3, stride=1, padding=1).numpy()
+    np.testing.assert_allclose(f, ft, atol=1e-6)
+
+
+def test_fold_asymmetric_padding():
+    x = _r((1, 2 * 2 * 2, 30), 13)
+    got = F.fold(_t(x), [5, 6], [2, 2], strides=1,
+                 paddings=[1, 0, 0, 1]).numpy()  # t, l, b, r
+    # oracle: fold into the padded canvas then crop
+    want_full = TF.fold(torch.from_numpy(x), (6, 7), (2, 2)).numpy()
+    want = want_full[:, :, 1:6, 0:6]
+    np.testing.assert_allclose(got, want)
+
+
+@pytest.mark.parametrize("size,alpha,beta,k", [(5, 1e-4, 0.75, 1.0),
+                                               (3, 0.02, 0.5, 2.0)])
+def test_local_response_norm_matches_reference(size, alpha, beta, k):
+    """div = k + alpha * MEAN(x^2 over window) — the avg_pool form the
+    reference python builds; torch shares the convention."""
+    x = _r((2, 7, 5, 6), 14)
+    got = F.local_response_norm(_t(x), size, alpha=alpha, beta=beta,
+                                k=k).numpy()
+    want = TF.local_response_norm(torch.from_numpy(x), size,
+                                  alpha=alpha, beta=beta, k=k).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_unfold_gradients_flow():
+    t = _t(_r((1, 2, 5, 5), 15))
+    t.stop_gradient = False
+    F.fold(F.unfold(t, 2, strides=1), [5, 5], 2,
+           strides=1).sum().backward()
+    g = t.grad.numpy()
+    assert np.isfinite(g).all() and g.min() >= 1.0 - 1e-6
+
+
+def test_pad_int_pads_spatial_only():
+    """Int padding targets SPATIAL dims (reference Pad2D expands an int
+    via _npairs to the partial spec), never batch/channel."""
+    x = _r((2, 3, 4, 5), 16)
+    got = paddle.nn.functional.pad(_t(x), 1).numpy()
+    assert got.shape == (2, 3, 6, 7)
+    want = TF.pad(torch.from_numpy(x), [1, 1, 1, 1]).numpy()
+    np.testing.assert_allclose(got, want)
+    from paddle_tpu import nn
+    y = nn.Pad2D(1)(_t(x)).numpy()
+    np.testing.assert_allclose(y, want)
+
+
+def test_fold_scalar_like_paddings():
+    x = _r((1, 2 * 2 * 2, 42), 17)
+    a = F.fold(_t(x), [5, 6], [2, 2], paddings=np.int64(1)).numpy()
+    b = F.fold(_t(x), [5, 6], [2, 2], paddings=1).numpy()
+    np.testing.assert_allclose(a, b)
