@@ -35,11 +35,19 @@ class Tensor:
         if isinstance(data, (jax.Array, jax.core.Tracer)):
             arr = data if dtype is None else data.astype(dtype)
         else:
-            if isinstance(data, (float, int)) and dtype is None:
+            if isinstance(data, (float, int)) and dtype is None \
+                    and not isinstance(data, np.generic):
+                # np.float64 subclasses float — typed numpy scalars keep
+                # their dtype below, only PYTHON scalars take defaults
                 dtype = (dtype_mod.get_default_dtype()
                          if isinstance(data, float) else dtype_mod.int64)
             arr = jnp.asarray(data, dtype=dtype)
-            if arr.dtype == jnp.float64 and dtype is None:
+            if arr.dtype == jnp.float64 and dtype is None and not (
+                    isinstance(data, (np.ndarray, np.generic))
+                    and data.dtype == np.float64):
+                # python float lists become f64 under x64 — those take
+                # the default dtype (f32), but an EXPLICIT numpy f64
+                # array keeps f64 like the reference's to_tensor
                 arr = arr.astype(dtype_mod.get_default_dtype())
         if place is not None:
             arr = jax.device_put(arr, place_mod.Place.parse(place).jax_device())

@@ -93,16 +93,91 @@ def count_nonzero(x, axis=None, keepdim=False, name=None):
                  .astype(jnp.int64), x, name="count_nonzero")
 
 
+def _median_min(a, ax, keepdim, nan_aware):
+    """mode='min' median: the LOWER middle element at sorted position
+    (n-1)//2 with its index along the axis (reference stat.py median:
+    kth-1 for even sizes, kth for odd — both are (n-1)//2). Output
+    keeps x's dtype; a NaN anywhere on the axis propagates NaN with the
+    first NaN's index (nan_aware=False) or is skipped (nanmedian)."""
+    ax = ax % a.ndim
+    sz = a.shape[ax]
+    order = jnp.argsort(a, axis=ax)  # stable; one sort, values gathered
+    svals = jnp.take_along_axis(a, order, axis=ax)
+    if nan_aware and jnp.issubdtype(a.dtype, jnp.floating):
+        n_valid = jnp.sum(~jnp.isnan(a), axis=ax, keepdims=True)
+        pos = jnp.clip((n_valid - 1) // 2, 0, sz - 1)
+    else:
+        pos = jnp.full([1] * a.ndim, (sz - 1) // 2, jnp.int32)
+    val = jnp.take_along_axis(svals, pos, axis=ax)
+    idx = jnp.take_along_axis(order, pos, axis=ax).astype(jnp.int64)
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        isnan = jnp.isnan(a)
+        if nan_aware:
+            # all-NaN slice: value NaN, index -1 (the reference
+            # nanmedian kernel's sentinel, nanmedian_kernel.cc:61)
+            all_nan = jnp.all(isnan, axis=ax, keepdims=True)
+            val = jnp.where(all_nan, jnp.nan, val)
+            idx = jnp.where(all_nan, -1, idx)
+        else:
+            has_nan = jnp.any(isnan, axis=ax, keepdims=True)
+            first_nan = jnp.argmax(isnan, axis=ax, keepdims=True)
+            val = jnp.where(has_nan, jnp.nan, val)
+            idx = jnp.where(has_nan, first_nan, idx)
+    if not keepdim:
+        val = jnp.squeeze(val, axis=ax)
+        idx = jnp.squeeze(idx, axis=ax)
+    return val, idx
+
+
 def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    """Reference paddle.median (stat.py:466): mode='avg' averages the
+    two middles (float output); mode='min' takes the lower middle in
+    x's dtype and, when axis is given, also returns its index."""
+    if mode not in ("avg", "min"):
+        raise ValueError(
+            f"Mode {mode} is not supported. Must be avg or min.")
     ax = _norm_axis(axis)
-    return apply(lambda a: jnp.median(a, axis=ax, keepdims=keepdim),
-                 x, name="median")
+    if mode == "min" and isinstance(ax, (list, tuple)):
+        raise ValueError(
+            "median with mode='min' requires a single int axis or None")
+    if mode == "avg":
+        return apply(lambda a: jnp.median(
+            a, axis=ax, keepdims=keepdim).astype(
+                jnp.float64 if a.dtype == jnp.float64 else jnp.float32),
+            x, name="median")
+    if ax is None:
+        return apply(
+            lambda a: _median_min(a.reshape(-1), 0, True,
+                                  False)[0].reshape(
+                [1] * (a.ndim if keepdim else 0)),
+            x, name="median")
+    return apply(lambda a: _median_min(a, ax, keepdim, False), x,
+                 name="median")
 
 
-def nanmedian(x, axis=None, keepdim=False, name=None):
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    """Reference paddle.nanmedian: like median but NaNs are skipped;
+    mode='min' with an axis returns (value, index)."""
+    if mode not in ("avg", "min"):
+        raise ValueError(
+            f"Mode {mode} is not supported. Must be avg or min.")
     ax = _norm_axis(axis)
-    return apply(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim),
-                 x, name="nanmedian")
+    if mode == "min" and isinstance(ax, (list, tuple)):
+        raise ValueError(
+            "nanmedian with mode='min' requires a single int axis or None")
+    if mode == "avg":
+        return apply(lambda a: jnp.nanmedian(
+            a, axis=ax, keepdims=keepdim).astype(
+                jnp.float64 if a.dtype == jnp.float64 else jnp.float32),
+            x, name="nanmedian")
+    if ax is None:
+        return apply(
+            lambda a: _median_min(a.reshape(-1), 0, True,
+                                  True)[0].reshape(
+                [1] * (a.ndim if keepdim else 0)),
+            x, name="nanmedian")
+    return apply(lambda a: _median_min(a, ax, keepdim, True), x,
+                 name="nanmedian")
 
 
 def var(x, axis=None, unbiased=True, keepdim=False, name=None):

@@ -1,0 +1,166 @@
+"""median/nanmedian modes, kthvalue, mode, searchsorted, histogram —
+oracle sweep vs torch/numpy.
+
+Reference: python/paddle/tensor/stat.py median (:466 — mode='min'
+takes the LOWER middle at sorted position (n-1)//2, keeps x's dtype,
+returns indices when axis is given; mode='avg' averages the middles
+and casts to float32 unless input is float64). torch.median/nanmedian
+implement exactly the min-mode convention.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+
+def _r(shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype("f4")
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+@pytest.mark.parametrize("keepdim", [False, True])
+@pytest.mark.parametrize("axis", [0, 1, -1])
+def test_median_min_mode_matches_torch(axis, keepdim):
+    x = _r((5, 6, 4), 1)
+    got_v, got_i = paddle.median(_t(x), axis=axis, keepdim=keepdim,
+                                 mode="min")
+    want_v, want_i = torch.median(torch.from_numpy(x), dim=axis,
+                                  keepdim=keepdim)
+    np.testing.assert_allclose(got_v.numpy(), want_v.numpy())
+    np.testing.assert_array_equal(got_i.numpy(), want_i.numpy())
+    assert got_v.numpy().dtype == np.float32  # keeps x dtype
+
+
+@pytest.mark.parametrize("n", [4, 5])
+def test_median_avg_mode_and_dtype(n):
+    x = _r((3, n), 2)
+    got = paddle.median(_t(x), axis=1).numpy()
+    np.testing.assert_allclose(got, np.median(x, axis=1), rtol=1e-6)
+    # int input -> float32 (reference dtype rule), f64 stays f64
+    xi = np.arange(12, dtype="i8").reshape(3, 4)
+    assert paddle.median(_t(xi), axis=1).numpy().dtype == np.float32
+    xd = x.astype("f8")
+    assert paddle.median(_t(xd), axis=1).numpy().dtype == np.float64
+
+
+def test_median_min_axis_none_scalar():
+    x = _r((3, 4), 3)
+    got = paddle.median(_t(x), mode="min").numpy()
+    want = torch.median(torch.from_numpy(x)).numpy()  # lower middle
+    np.testing.assert_allclose(got, want)
+
+
+def test_median_min_nan_propagates_with_first_nan_index():
+    x = _r((2, 5), 4)
+    x[0, 3] = np.nan
+    got_v, got_i = paddle.median(_t(x), axis=1, mode="min")
+    want_v, want_i = torch.median(torch.from_numpy(x), dim=1)
+    np.testing.assert_allclose(got_v.numpy(), want_v.numpy())
+    assert np.isnan(got_v.numpy()[0]) and got_i.numpy()[0] == 3
+    np.testing.assert_allclose(got_i.numpy()[1], want_i.numpy()[1])
+
+
+def test_nanmedian_min_mode_skips_nans():
+    x = _r((3, 6), 5)
+    x[0, [1, 4]] = np.nan
+    x[2, :] = np.nan
+    got_v, got_i = paddle.nanmedian(_t(x), axis=1, mode="min")
+    want_v, want_i = torch.nanmedian(torch.from_numpy(x), dim=1)
+    np.testing.assert_allclose(got_v.numpy()[:2], want_v.numpy()[:2])
+    np.testing.assert_array_equal(got_i.numpy()[:2], want_i.numpy()[:2])
+    assert np.isnan(got_v.numpy()[2])  # all-NaN row
+    assert got_i.numpy()[2] == -1  # reference sentinel (nanmedian_kernel.cc:61)
+
+
+def test_nanmedian_avg_matches_numpy():
+    x = _r((4, 7), 6)
+    x[1, 2] = np.nan
+    got = paddle.nanmedian(_t(x), axis=1).numpy()
+    np.testing.assert_allclose(got, np.nanmedian(x, axis=1), rtol=1e-6)
+
+
+@pytest.mark.parametrize("k", [1, 3, 6])
+def test_kthvalue_matches_torch(k):
+    x = _r((4, 6), 7)
+    got_v, got_i = paddle.kthvalue(_t(x), k, axis=1)
+    want_v, want_i = torch.kthvalue(torch.from_numpy(x), k, dim=1)
+    np.testing.assert_allclose(got_v.numpy(), want_v.numpy())
+    np.testing.assert_array_equal(got_i.numpy(), want_i.numpy())
+
+
+def test_mode_tie_semantics():
+    """Smallest most-frequent value, LAST occurrence index (torch
+    convention, shared by the reference mode kernel)."""
+    x = np.array([[2.0, 1.0, 1.0, 2.0, 3.0]], "f4")
+    got_v, got_i = paddle.mode(_t(x), axis=1)
+    want_v, want_i = torch.mode(torch.from_numpy(x), dim=1)
+    np.testing.assert_allclose(got_v.numpy(), want_v.numpy())
+    np.testing.assert_array_equal(got_i.numpy(), want_i.numpy())
+
+
+@pytest.mark.parametrize("right", [False, True])
+def test_searchsorted_1d_and_nd(right):
+    seq = np.sort(_r((8,), 8))
+    vals = _r((3, 5), 9)
+    got = paddle.searchsorted(_t(seq), _t(vals), right=right).numpy()
+    want = torch.searchsorted(torch.from_numpy(seq),
+                              torch.from_numpy(vals),
+                              right=right).numpy()
+    np.testing.assert_array_equal(got, want)
+    seq2 = np.sort(_r((3, 8), 10), axis=-1)
+    vals2 = _r((3, 5), 11)
+    got = paddle.searchsorted(_t(seq2), _t(vals2), right=right).numpy()
+    want = torch.searchsorted(torch.from_numpy(seq2),
+                              torch.from_numpy(vals2),
+                              right=right).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bucketize_matches_torch():
+    bounds = np.sort(_r((6,), 12))
+    x = _r((4, 4), 13)
+    got = paddle.bucketize(_t(x), _t(bounds), right=True).numpy()
+    want = torch.bucketize(torch.from_numpy(x),
+                           torch.from_numpy(bounds),
+                           right=True).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_histogram_matches_torch():
+    x = _r((50,), 14)
+    got = paddle.histogram(_t(x), bins=7, min=-2, max=2).numpy()
+    want = torch.histc(torch.from_numpy(x), bins=7, min=-2,
+                       max=2).numpy()
+    np.testing.assert_array_equal(got, want.astype(np.int64))
+    # auto-range when min == max == 0
+    got = paddle.histogram(_t(x), bins=5).numpy()
+    want, _ = np.histogram(x, bins=5)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_quantile_interpolations():
+    x = _r((3, 9), 15)
+    for interp in ["linear", "lower", "higher", "nearest", "midpoint"]:
+        got = paddle.quantile(_t(x), 0.3, axis=1,
+                              interpolation=interp).numpy()
+        want = np.quantile(x, 0.3, axis=1, method=interp)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_median_min_multi_axis_raises():
+    x = _r((3, 4), 20)
+    import pytest as _pt
+    with _pt.raises(ValueError, match="single int axis"):
+        paddle.median(_t(x), axis=[0, 1], mode="min")
+
+
+def test_to_tensor_numpy_scalar_dtype_preserved():
+    assert paddle.to_tensor(np.float64(1.5)).numpy().dtype == np.float64
+    assert paddle.to_tensor(np.float32(1.5)).numpy().dtype == np.float32
+    assert paddle.to_tensor(1.5).numpy().dtype == np.float32  # python float
+    assert paddle.to_tensor(np.int32(3)).numpy().dtype == np.int32
