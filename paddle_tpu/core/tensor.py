@@ -39,8 +39,13 @@ class Tensor:
                     and not isinstance(data, np.generic):
                 # np.float64 subclasses float — typed numpy scalars keep
                 # their dtype below, only PYTHON scalars take defaults
-                dtype = (dtype_mod.get_default_dtype()
-                         if isinstance(data, float) else dtype_mod.int64)
+                # (and bool subclasses int: True must stay a bool tensor)
+                if isinstance(data, bool):
+                    dtype = dtype_mod.bool_
+                else:
+                    dtype = (dtype_mod.get_default_dtype()
+                             if isinstance(data, float)
+                             else dtype_mod.int64)
             arr = jnp.asarray(data, dtype=dtype)
             if arr.dtype == jnp.float64 and dtype is None and not (
                     isinstance(data, (np.ndarray, np.generic))

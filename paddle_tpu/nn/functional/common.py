@@ -41,6 +41,9 @@ def linear(x, weight, bias=None, name=None):
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     from ...core.dispatch import as_index
     idx = as_index(unwrap(x))
+    if padding_idx is not None and padding_idx < 0:
+        # reference normalizes a negative padding_idx by vocab size
+        padding_idx = int(weight.shape[0]) + int(padding_idx)
 
     # idx travels as a payload arg (an array in a closure cell would
     # reject the op from the lazy-backward cache -> full vjp per call)

@@ -1,0 +1,172 @@
+"""Activation corner-semantics oracle sweep vs torch-cpu.
+
+Reference: python/paddle/nn/functional/activation.py + phi activation
+kernels. Inputs include boundary values (threshold edges, zeros, large
+magnitudes) where branch-boundary mistakes show up. Parameter mapping
+is 1:1 with torch for everything probed here at the paddle defaults.
+"""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+# boundary-heavy probe grid
+X = np.array([-25.0, -6.0, -3.0, -1.0, -0.5, -1e-3, 0.0, 1e-3, 0.5,
+              1.0, 2.9999, 3.0, 3.0001, 6.0, 20.0, 25.0], "f4")
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+CASES = [
+    ("relu", {}, lambda x: TF.relu(x)),
+    ("relu6", {}, lambda x: TF.relu6(x)),
+    ("elu", {"alpha": 0.7}, lambda x: TF.elu(x, alpha=0.7)),
+    ("celu", {"alpha": 1.3}, lambda x: TF.celu(x, alpha=1.3)),
+    ("selu", {}, lambda x: TF.selu(x)),
+    ("silu", {}, lambda x: TF.silu(x)),
+    ("mish", {}, lambda x: TF.mish(x)),
+    ("softsign", {}, lambda x: TF.softsign(x)),
+    ("tanhshrink", {}, lambda x: TF.tanhshrink(x)),
+    ("softshrink", {"threshold": 0.4},
+     lambda x: TF.softshrink(x, lambd=0.4)),
+    ("hardshrink", {"threshold": 0.4},
+     lambda x: TF.hardshrink(x, lambd=0.4)),
+    ("hardtanh", {"min": -1.2, "max": 0.8},
+     lambda x: TF.hardtanh(x, min_val=-1.2, max_val=0.8)),
+    ("hardsigmoid", {}, lambda x: TF.hardsigmoid(x)),
+    ("hardswish", {}, lambda x: TF.hardswish(x)),
+    ("log_sigmoid", {}, lambda x: TF.logsigmoid(x)),
+    ("softplus", {"beta": 2.0, "threshold": 15.0},
+     lambda x: TF.softplus(x, beta=2.0, threshold=15.0)),
+    ("leaky_relu", {"negative_slope": 0.05},
+     lambda x: TF.leaky_relu(x, negative_slope=0.05)),
+    ("gelu", {}, lambda x: TF.gelu(x)),
+    ("gelu", {"approximate": True},
+     lambda x: TF.gelu(x, approximate="tanh")),
+    ("thresholded_relu", {"threshold": 1.0},
+     lambda x: TF.threshold(x, 1.0, 0.0)),
+]
+
+
+@pytest.mark.parametrize("name,kwargs,oracle",
+                         CASES, ids=[f"{c[0]}-{i}" for i, c in
+                                     enumerate(CASES)])
+def test_activation_matches_torch(name, kwargs, oracle):
+    fn = getattr(F, name)
+    got = fn(_t(X), **kwargs).numpy()
+    want = oracle(torch.from_numpy(X)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6,
+                               err_msg=name)
+
+
+def test_prelu_matches_torch():
+    x = np.random.default_rng(0).standard_normal((2, 3, 4)).astype("f4")
+    w = np.array([0.1, 0.2, 0.3], "f4")
+    got = F.prelu(_t(x), _t(w)).numpy()
+    want = TF.prelu(torch.from_numpy(x), torch.from_numpy(w)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_glu_matches_torch():
+    x = np.random.default_rng(1).standard_normal((3, 8)).astype("f4")
+    got = F.glu(_t(x), axis=-1).numpy()
+    want = TF.glu(torch.from_numpy(x), dim=-1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_rrelu_eval_uses_mean_slope():
+    x = np.array([-2.0, -1.0, 1.0], "f4")
+    got = F.rrelu(_t(x), lower=0.1, upper=0.3, training=False).numpy()
+    want = np.where(x < 0, x * 0.2, x)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_rrelu_train_slope_in_range():
+    paddle.seed(7)
+    x = np.full((2000,), -1.0, "f4")
+    out = F.rrelu(_t(x), lower=0.1, upper=0.3, training=True).numpy()
+    slopes = -out
+    assert slopes.min() >= 0.1 - 1e-6 and slopes.max() <= 0.3 + 1e-6
+    assert slopes.std() > 0.01  # actually random, not a constant
+
+
+def test_logit_eps_clamps():
+    x = np.array([0.0, 1e-8, 0.5, 1 - 1e-8, 1.0], "f4")
+    got = paddle.logit(_t(x), eps=1e-6).numpy()
+    want = torch.logit(torch.from_numpy(x), eps=1e-6).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_softmax_temperature_axis():
+    x = np.random.default_rng(2).standard_normal((4, 5, 6)).astype("f4")
+    for ax in [0, 1, -1]:
+        got = F.softmax(_t(x), axis=ax).numpy()
+        want = TF.softmax(torch.from_numpy(x), dim=ax).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+        got = F.log_softmax(_t(x), axis=ax).numpy()
+        want = TF.log_softmax(torch.from_numpy(x), dim=ax).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_activation_gradients_at_boundaries():
+    """Gradients are finite at every branch boundary in the grid."""
+    for name, kwargs, _ in CASES:
+        t = _t(X.copy())
+        t.stop_gradient = False
+        getattr(F, name)(t, **kwargs).sum().backward()
+        assert np.isfinite(t.grad.numpy()).all(), name
+
+
+def test_embedding_padding_idx_zeroes_output_and_grad():
+    """Reference embedding zeroes the OUTPUT row for padding_idx (the
+    kernel masks regardless of weight content) and blocks its grad;
+    negative padding_idx normalizes by vocab size."""
+    rng = np.random.default_rng(3)
+    w = _t(rng.standard_normal((5, 3)).astype("f4"))
+    w.stop_gradient = False
+    ids = _t(np.array([0, 4, 2, 4], "i8"))
+    out = F.embedding(ids, w, padding_idx=-1)  # -1 -> 4
+    np.testing.assert_allclose(out.numpy()[[1, 3]], 0.0)
+    out.sum().backward()
+    g = w.grad.numpy()
+    np.testing.assert_allclose(g[4], 0.0)
+    np.testing.assert_allclose(g[0], 1.0)
+    np.testing.assert_allclose(g[2], 1.0)
+
+
+@pytest.mark.parametrize("groups", [1, 2, 6])
+def test_group_norm_nchw_nhwc(groups):
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 6, 4, 5)).astype("f4")
+    w = rng.standard_normal(6).astype("f4")
+    b = rng.standard_normal(6).astype("f4")
+    got = F.group_norm(_t(x), groups, weight=_t(w), bias=_t(b)).numpy()
+    want = TF.group_norm(torch.from_numpy(x), groups,
+                         torch.from_numpy(w),
+                         torch.from_numpy(b)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    xl = np.ascontiguousarray(x.transpose(0, 2, 3, 1))
+    got = F.group_norm(_t(xl), groups, weight=_t(w), bias=_t(b),
+                       data_format="NHWC").numpy()
+    np.testing.assert_allclose(got, want.transpose(0, 2, 3, 1),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(2, 3, 7), (2, 3, 4, 5),
+                                   (2, 3, 3, 4, 5)])
+def test_instance_norm_matches_torch(shape):
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal(shape).astype("f4")
+    w = rng.standard_normal(shape[1]).astype("f4")
+    b = rng.standard_normal(shape[1]).astype("f4")
+    got = F.instance_norm(_t(x), weight=_t(w), bias=_t(b)).numpy()
+    want = TF.instance_norm(torch.from_numpy(x),
+                            weight=torch.from_numpy(w),
+                            bias=torch.from_numpy(b)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

@@ -154,8 +154,7 @@ def test_quantile_interpolations():
 
 def test_median_min_multi_axis_raises():
     x = _r((3, 4), 20)
-    import pytest as _pt
-    with _pt.raises(ValueError, match="single int axis"):
+    with pytest.raises(ValueError, match="single int axis"):
         paddle.median(_t(x), axis=[0, 1], mode="min")
 
 
@@ -164,3 +163,9 @@ def test_to_tensor_numpy_scalar_dtype_preserved():
     assert paddle.to_tensor(np.float32(1.5)).numpy().dtype == np.float32
     assert paddle.to_tensor(1.5).numpy().dtype == np.float32  # python float
     assert paddle.to_tensor(np.int32(3)).numpy().dtype == np.int32
+
+
+def test_to_tensor_python_bool_is_bool():
+    assert paddle.to_tensor(True).numpy().dtype == np.bool_
+    assert paddle.to_tensor([True, False]).numpy().dtype == np.bool_
+    assert paddle.to_tensor(3).numpy().dtype == np.int64
