@@ -41,9 +41,16 @@ def linear(x, weight, bias=None, name=None):
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     from ...core.dispatch import as_index
     idx = as_index(unwrap(x))
-    if padding_idx is not None and padding_idx < 0:
-        # reference normalizes a negative padding_idx by vocab size
-        padding_idx = int(weight.shape[0]) + int(padding_idx)
+    if padding_idx is not None:
+        vocab = int(weight.shape[0])
+        if not -vocab <= padding_idx < vocab:
+            # reference functional embedding validates the range
+            raise ValueError(
+                f"padding_idx must be within [-{vocab}, {vocab}), "
+                f"but got {padding_idx}")
+        if padding_idx < 0:
+            # negative padding_idx normalizes by vocab size
+            padding_idx = vocab + int(padding_idx)
 
     # idx travels as a payload arg (an array in a closure cell would
     # reject the op from the lazy-backward cache -> full vjp per call)

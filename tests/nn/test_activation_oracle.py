@@ -170,3 +170,12 @@ def test_instance_norm_matches_torch(shape):
                             weight=torch.from_numpy(w),
                             bias=torch.from_numpy(b)).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_padding_idx_out_of_range_raises():
+    w = _t(np.zeros((5, 3), "f4"))
+    ids = _t(np.array([0], "i8"))
+    with pytest.raises(ValueError, match="padding_idx"):
+        F.embedding(ids, w, padding_idx=-7)
+    with pytest.raises(ValueError, match="padding_idx"):
+        F.embedding(ids, w, padding_idx=5)

@@ -1,0 +1,120 @@
+"""linalg corner-case oracle sweep vs numpy/scipy/torch.
+
+Reference: python/paddle/tensor/linalg.py + phi linalg kernels. These
+target the argument corners the broad FD sweeps don't reach: lstsq
+rank/residuals, pinv hermitian, matrix_power negative exponents, cond
+in every norm, slogdet sign on negative-determinant inputs, matrix/
+vector norms at p in {0, +-inf, 'nuc', 'fro'}, and triangular_solve
+configurations.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+
+def _r(shape, seed=0):
+    return np.random.default_rng(seed).standard_normal(shape).astype("f4")
+
+
+def _t(a):
+    return paddle.to_tensor(a)
+
+
+def test_lstsq_solution_and_residuals():
+    a = _r((6, 3), 1)
+    b = _r((6, 2), 2)
+    sol, res, rank, sv = paddle.linalg.lstsq(_t(a), _t(b))
+    w_sol, w_res, w_rank, w_sv = np.linalg.lstsq(a, b, rcond=None)
+    np.testing.assert_allclose(sol.numpy(), w_sol, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(res.numpy(), w_res, rtol=1e-3, atol=1e-4)
+    assert int(rank.numpy()) == w_rank
+
+
+def test_pinv_plain_and_hermitian():
+    a = _r((4, 4), 3)
+    np.testing.assert_allclose(paddle.linalg.pinv(_t(a)).numpy(),
+                               np.linalg.pinv(a), rtol=1e-3, atol=1e-4)
+    h = a + a.T  # symmetric
+    got = paddle.linalg.pinv(_t(h), hermitian=True).numpy()
+    np.testing.assert_allclose(got, np.linalg.pinv(h), rtol=1e-3,
+                               atol=1e-4)
+
+
+@pytest.mark.parametrize("n", [-3, -1, 0, 1, 3])
+def test_matrix_power_exponents(n):
+    a = _r((3, 3), 4) + 3 * np.eye(3, dtype="f4")  # well-conditioned
+    got = paddle.linalg.matrix_power(_t(a), n).numpy()
+    want = np.linalg.matrix_power(a.astype("f8"), n)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("p", [None, "fro", "nuc", 1, -1, 2, -2,
+                               np.inf, -np.inf])
+def test_cond_all_norms(p):
+    a = _r((4, 4), 5) + 2 * np.eye(4, dtype="f4")
+    got = float(paddle.linalg.cond(_t(a), p=p).numpy())
+    want = float(np.linalg.cond(a.astype("f8"),
+                                p=2 if p is None else p))
+    np.testing.assert_allclose(got, want, rtol=2e-3)
+
+
+def test_slogdet_negative_determinant():
+    a = _r((3, 3), 6)
+    a[0] *= -1  # flip sign
+    got = paddle.linalg.slogdet(_t(a))
+    sign, logdet = np.linalg.slogdet(a.astype("f8"))
+    np.testing.assert_allclose(float(got[0].numpy()), sign, atol=1e-5)
+    np.testing.assert_allclose(float(got[1].numpy()), logdet,
+                               rtol=1e-4)
+
+
+@pytest.mark.parametrize("p", [0, 1, -1, 2, np.inf, -np.inf, 3.5])
+def test_vector_norm_corners(p):
+    x = np.array([3.0, -4.0, 0.0, 1e-3], "f4")
+    got = float(paddle.linalg.norm(_t(x), p=p).numpy())
+    want = float(np.linalg.norm(x.astype("f8"), ord=p))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("p", ["fro", "nuc", 1, -1, np.inf, -np.inf])
+def test_matrix_norm_corners(p):
+    a = _r((3, 5), 7)
+    got = float(paddle.linalg.norm(_t(a), p=p, axis=[-2, -1]).numpy())
+    want = float(np.linalg.norm(a.astype("f8"), ord=p))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+@pytest.mark.parametrize("upper,transpose,unitriangular",
+                         [(True, False, False), (False, False, False),
+                          (True, True, False), (False, False, True)])
+def test_triangular_solve_configs(upper, transpose, unitriangular):
+    a = _r((4, 4), 8) + 4 * np.eye(4, dtype="f4")
+    tri = np.triu(a) if upper else np.tril(a)
+    b = _r((4, 2), 9)
+    got = paddle.linalg.triangular_solve(
+        _t(tri), _t(b), upper=upper, transpose=transpose,
+        unitriangular=unitriangular).numpy()
+    want = torch.linalg.solve_triangular(
+        torch.from_numpy(tri).transpose(-2, -1) if transpose
+        else torch.from_numpy(tri),
+        torch.from_numpy(b), upper=(not upper) if transpose else upper,
+        unitriangular=unitriangular).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_matrix_rank_tolerance():
+    a = _r((5, 3), 10)
+    a[:, 2] = a[:, 0] + a[:, 1]  # rank 2
+    assert int(paddle.linalg.matrix_rank(_t(a)).numpy()) == 2
+
+
+def test_householder_product_matches_torch():
+    a = _r((5, 3), 11)
+    tau = np.abs(_r((3,), 12)) * 0.5
+    got = paddle.linalg.householder_product(_t(a), _t(tau)).numpy()
+    want = torch.linalg.householder_product(
+        torch.from_numpy(a), torch.from_numpy(tau)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
