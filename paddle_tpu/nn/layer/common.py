@@ -55,6 +55,14 @@ class Embedding(Layer):
         super().__init__()
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
+        if padding_idx is not None and not \
+                -num_embeddings <= padding_idx < num_embeddings:
+            # validate BEFORE normalizing: the pre-normalized value
+            # would pass F.embedding's own range check and silently
+            # mask the wrong row
+            raise ValueError(
+                f"padding_idx must be within [-{num_embeddings}, "
+                f"{num_embeddings}), but got {padding_idx}")
         self._padding_idx = (None if padding_idx is None else
                              padding_idx if padding_idx >= 0 else
                              num_embeddings + padding_idx)

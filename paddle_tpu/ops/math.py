@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.dispatch import apply, unwrap
 from ..core.dtype import convert_dtype
@@ -33,8 +34,60 @@ __all__ = [
 ]
 
 
+def _int_kind(dt):
+    return dt is not None and (jnp.issubdtype(dt, jnp.integer)
+                               or dt == jnp.bool_)
+
+
+def _float_scalar(v):
+    return isinstance(v, (float, np.floating))
+
+
+def _int_like(v):
+    if isinstance(v, (bool, int, np.integer, np.bool_)):
+        return True
+    dt = getattr(v._data if isinstance(v, Tensor) else v, "dtype", None)
+    return _int_kind(dt)
+
+
+def _ref_promote(x, y, divide_op=False):
+    """Reference scalar/arith type promotion (the eager math-op patch,
+    eager_math_op_patch.cc:113 _supported_int_dtype_ incl. BOOL): an
+    int/bool tensor meeting a python/numpy FLOAT scalar is cast to
+    float32 (NOT f64 — jnp's weak-f64 rule diverges here under x64);
+    true division (:740) additionally casts to float32 whenever both
+    operands are int-kind."""
+    def dt(v):
+        return getattr(v._data if isinstance(v, Tensor) else v,
+                       "dtype", None)
+
+    def cast32(v):
+        if isinstance(v, Tensor):
+            return v.astype(jnp.float32)
+        return v.astype(jnp.float32) if hasattr(v, "astype") else float(v)
+
+    def weak(v):
+        # np.float64(1.5) is a STRONG f64 for jnp and would promote
+        # the freshly-cast f32 tensor right back up; the reference
+        # reads the scalar as a double and applies it at the
+        # tensor's dtype — a weak python float does the same
+        return float(v) if isinstance(v, np.floating) else v
+
+    xd, yd = dt(x), dt(y)
+    if (_int_kind(xd) and _float_scalar(y)) or \
+            (_int_kind(yd) and _float_scalar(x)):
+        return (cast32(x) if _int_kind(xd) else weak(x),
+                cast32(y) if _int_kind(yd) else weak(y))
+    if divide_op and _int_like(x) and _int_like(y):
+        return cast32(x), cast32(y)
+    return x, y
+
+
 def _binop(fn, name):
+    divide_op = name == "divide"
+
     def op(x, y, name_=None):
+        x, y = _ref_promote(x, y, divide_op=divide_op)
         return apply(fn, x, y, name=name)
     op.__name__ = name
     return op
@@ -61,10 +114,16 @@ lcm = _binop(jnp.lcm, "lcm")
 
 
 def pow(x, y, name=None):
+    x, y = _ref_promote(x, y)
     return apply(jnp.power, x, y, name="pow")
 
 
-float_power = pow
+def float_power(x, y, name=None):
+    """Not a reference name (torch-ism kept for convenience): always
+    computes in float64, torch.float_power's contract."""
+    return apply(lambda a, b: jnp.power(jnp.asarray(a, jnp.float64),
+                                        jnp.asarray(b, jnp.float64)),
+                 x, y, name="float_power")
 
 
 def _unop(fn, name):

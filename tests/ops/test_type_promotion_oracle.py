@@ -1,0 +1,80 @@
+"""Scalar/tensor arithmetic type promotion, pinned to the reference's
+eager math-op patch (eager_math_op_patch.cc:113 _supported_int_dtype_
+including BOOL; :673 float-scalar casts int tensors to FLOAT32; :740
+true division casts both operands to FLOAT32 when both are int-kind).
+jnp's weak-f64 rules diverge here under x64 — these tests pin the
+paddle semantics.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(a, dt=None):
+    return paddle.to_tensor(np.asarray(a, dt))
+
+
+I64 = _t([3, 4], "i8")
+I32 = _t([3, 4], "i4")
+F32 = _t([1.0, 2.0], "f4")
+BF16 = _t([1.0, 2.0], "f4").astype("bfloat16")
+BOOL = _t([True, False])
+
+
+@pytest.mark.parametrize("expr,want", [
+    (lambda: I64 + 1.5, "float32"),
+    (lambda: 1.5 * I64, "float32"),
+    (lambda: I64 - 0.5, "float32"),
+    (lambda: I32 + np.float64(1.5), "float32"),
+    (lambda: I64 ** 0.5, "float32"),
+    (lambda: I64 // 2.5, "float32"),
+    (lambda: I64 % 2.5, "float32"),
+    (lambda: BOOL + 1.5, "float32"),
+    # int-kind true division is always float32
+    (lambda: I64 / I64, "float32"),
+    (lambda: I64 / 2, "float32"),
+    (lambda: 2 / I64, "float32"),
+    (lambda: I32 / I64, "float32"),
+    (lambda: BOOL / BOOL, "float32"),
+    (lambda: paddle.divide(I64, I64), "float32"),
+    # int scalars keep the tensor dtype
+    (lambda: I64 + 2, "int64"),
+    (lambda: I32 * 3, "int32"),
+    (lambda: I64 // 2, "int64"),
+    (lambda: BF16 + 2, "bfloat16"),
+    # float scalars keep float tensor dtypes
+    (lambda: F32 + 1.5, "float32"),
+    (lambda: BF16 + 0.5, "bfloat16"),
+    # tensor-tensor float promotion
+    (lambda: BF16 + F32, "float32"),
+    (lambda: I64 + F32, "float32"),
+    (lambda: I32 + I64, "int64"),
+])
+def test_promotion_matrix(expr, want):
+    assert want in str(expr().dtype)
+
+
+def test_int_division_values_are_true_division():
+    out = (I64 / 2).numpy()
+    np.testing.assert_allclose(out, [1.5, 2.0])
+    out = paddle.divide(_t([7, 8], "i8"), _t([2, 3], "i8")).numpy()
+    np.testing.assert_allclose(out, [3.5, 8 / 3], rtol=1e-6)
+
+
+def test_float_scalar_int_tensor_values():
+    np.testing.assert_allclose((I64 * 1.5).numpy(), [4.5, 6.0])
+    np.testing.assert_allclose((I64 + 0.25).numpy(), [3.25, 4.25])
+
+
+def test_float_power_always_f64():
+    out = paddle.float_power(I64, 0.5)
+    assert "float64" in str(out.dtype)
+    np.testing.assert_allclose(out.numpy(), [3 ** 0.5, 2.0], rtol=1e-12)
+
+
+def test_embedding_layer_out_of_range_padding_idx_raises():
+    from paddle_tpu import nn
+    with pytest.raises(ValueError, match="padding_idx"):
+        nn.Embedding(5, 3, padding_idx=-7)
