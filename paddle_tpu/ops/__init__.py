@@ -48,6 +48,15 @@ def _inplace_from(t: Tensor, out: Tensor) -> Tensor:
             out._node is not None:
         raise RuntimeError(
             "in-place operation on a leaf tensor that requires grad")
+    if out._data.dtype != t._data.dtype:
+        # the reference's inplace promotion whitelist casts only the
+        # NON-inplaced operand (eager_gen.py type_promote_inplace_
+        # white_list); an op whose result dtype differs from x cannot
+        # write back in place — int_x.add_(1.5) errors, never silently
+        # retypes x
+        raise TypeError(
+            f"in-place operation would change dtype from "
+            f"{t._data.dtype} to {out._data.dtype}; cast explicitly")
     t._data = out._data
     t._node = out._node
     t._out_idx = out._out_idx
