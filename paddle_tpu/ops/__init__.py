@@ -35,7 +35,30 @@ from . import random, linalg, einsum as einsum_mod
 from . import special
 
 
-def _inplace_from(t: Tensor, out: Tensor) -> Tensor:
+# inplace families with reference-sanctioned dtype behavior
+# (python/paddle/tensor/logic.py:627 `equal_` and siblings write the bool
+# result back into the receiver's buffer — the receiver KEEPS its dtype and
+# holds 0/1 values; `cast_` is the one op whose receiver legitimately
+# retypes).
+_INPLACE_CAST_RESULT = frozenset({
+    "equal", "not_equal", "greater_equal", "greater_than", "less_equal",
+    "less_than", "logical_and", "logical_not", "logical_or", "logical_xor",
+})
+_INPLACE_RETYPES = frozenset({"cast"})
+# inplace ops whose receiver legitimately changes shape (reshape_ etc.);
+# every other generated inplace op must preserve the receiver's shape —
+# the reference raises ValueError when broadcasting would grow the
+# inplace tensor (python/paddle/tensor/logic.py equal_ shape check).
+_INPLACE_RESHAPES = frozenset({
+    "reshape", "squeeze", "unsqueeze", "flatten", "t", "transpose",
+    # axis=None cumsum_/cumprod_ is an in-place flatten in the reference
+    # (python/paddle/tensor/math.py:4221 cumsum_ flatten=True)
+    "cumsum", "cumprod",
+})
+
+
+def _inplace_from(t: Tensor, out: Tensor, *, cast_result: bool = False,
+                  allow_retype: bool = False) -> Tensor:
     """Give ``t`` the value (and tape position) of ``out`` — the functional
     realization of the reference's inplace ops (`x.add_(y)` etc.).
 
@@ -48,15 +71,20 @@ def _inplace_from(t: Tensor, out: Tensor) -> Tensor:
             out._node is not None:
         raise RuntimeError(
             "in-place operation on a leaf tensor that requires grad")
-    if out._data.dtype != t._data.dtype:
-        # the reference's inplace promotion whitelist casts only the
-        # NON-inplaced operand (eager_gen.py type_promote_inplace_
-        # white_list); an op whose result dtype differs from x cannot
-        # write back in place — int_x.add_(1.5) errors, never silently
-        # retypes x
-        raise TypeError(
-            f"in-place operation would change dtype from "
-            f"{t._data.dtype} to {out._data.dtype}; cast explicitly")
+    if out._data.dtype != t._data.dtype and not allow_retype:
+        if cast_result:
+            # comparison/logical family: the bool result is written back
+            # into the receiver's existing dtype (reference logic.py:627)
+            out = manipulation.cast(out, t.dtype)
+        else:
+            # the reference's inplace promotion whitelist casts only the
+            # NON-inplaced operand (eager_gen.py type_promote_inplace_
+            # white_list); an arithmetic op whose result dtype differs
+            # from x cannot write back in place — int_x.add_(1.5) errors,
+            # never silently retypes x
+            raise TypeError(
+                f"in-place operation would change dtype from "
+                f"{t._data.dtype} to {out._data.dtype}; cast explicitly")
     t._data = out._data
     t._node = out._node
     t._out_idx = out._out_idx
@@ -211,10 +239,24 @@ def _make_method(fn):
     return method
 
 
-def _make_inplace(fn):
+def _make_inplace(fn, base=None):
+    base = base or fn.__name__
+    cast_result = base in _INPLACE_CAST_RESULT
+    allow_retype = base in _INPLACE_RETYPES
+    keep_shape = base not in _INPLACE_RESHAPES
+
     def method(self, *args, **kwargs):
-        return _inplace_from(self, fn(self, *args, **kwargs))
-    method.__name__ = fn.__name__ + "_"
+        out = fn(self, *args, **kwargs)
+        if keep_shape and tuple(out.shape) != tuple(self.shape):
+            # reference parity: broadcasting may not grow the inplace
+            # receiver (tensor/logic.py equal_ raises ValueError)
+            raise ValueError(
+                f"{base}_: broadcast output shape {tuple(out.shape)} "
+                f"differs from the inplace tensor shape "
+                f"{tuple(self.shape)}")
+        return _inplace_from(self, out, cast_result=cast_result,
+                             allow_retype=allow_retype)
+    method.__name__ = base + "_"
     return method
 
 
@@ -232,7 +274,7 @@ def bind_tensor_methods(cls=Tensor):
     for base in _INPLACE_BASES:
         fn = _METHODS.get(base)
         if fn is not None and not hasattr(cls, base + "_"):
-            setattr(cls, base + "_", _make_inplace(fn))
+            setattr(cls, base + "_", _make_inplace(fn, base))
 
     def _t_property(self):
         # numpy-style full reverse (paddle Tensor.T semantics)
@@ -270,9 +312,7 @@ def where_(condition, x, y, name=None):
 
 
 def _make_module_inplace(fn, iname):
-    def f(x, *args, **kwargs):
-        return _inplace_from(x, fn(x, *args, **kwargs))
-    f.__name__ = iname
+    f = _make_inplace(fn, iname[:-1])
     f.__doc__ = f"In-place variant of `{fn.__name__}`."
     return f
 

@@ -278,3 +278,39 @@ def test_audit_is_clean():
     assert impl == tested + present
     assert impl + raises == total  # nothing missing
     assert tested >= 550  # the usage-evidence floor (grows over rounds)
+
+
+def test_inplace_dtype_and_shape_guards():
+    """Reference inplace semantics (tensor/logic.py equal_ and siblings;
+    eager_gen.py type_promote_inplace_white_list):
+    - comparison/logical inplace writes the bool result back into the
+      receiver's EXISTING dtype;
+    - cast_ is the one op whose receiver legitimately retypes;
+    - arithmetic inplace whose result dtype differs errors, never
+      silently retypes;
+    - broadcasting may not grow the inplace receiver (ValueError, as the
+      reference's test_inplace.py test_broadcast_error pins)."""
+    a = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    b = np.array([[1.0, 9.0], [3.0, 0.0]], "float32")
+    t = paddle.to_tensor(a.copy())
+    out = paddle.less_than_(t, paddle.to_tensor(b))
+    assert out is t
+    assert "float32" in str(t.dtype)
+    np.testing.assert_array_equal(t.numpy().astype(bool), a < b)
+
+    y = paddle.to_tensor([1.0, 2.0])
+    assert paddle.cast_(y, "float64") is y and "float64" in str(y.dtype)
+
+    i = paddle.to_tensor(np.array([1, 2], "int32"))
+    with pytest.raises(TypeError):
+        i.add_(paddle.to_tensor(1.5))
+
+    x = paddle.to_tensor(np.ones([3, 1], "float32"))
+    wide = paddle.to_tensor(np.ones([3, 4], "float32"))
+    with pytest.raises(ValueError):
+        paddle.logical_and_(x, wide)
+    with pytest.raises(ValueError):
+        x.add_(wide)
+    # same-shape broadcast against a scalar is fine
+    x.add_(paddle.to_tensor(2.0))
+    np.testing.assert_allclose(x.numpy(), np.full([3, 1], 3.0))
