@@ -308,7 +308,12 @@ def where_(condition, x, y, name=None):
     """In-place `where`: writes the select result into ``x`` (the
     reference's inplace variant mutates x, NOT the condition —
     python/paddle/tensor/search.py where_)."""
-    return _inplace_from(x, manipulation.where(condition, x, y))
+    out = manipulation.where(condition, x, y)
+    if tuple(out.shape) != tuple(x.shape):
+        raise ValueError(
+            f"where_: broadcast output shape {tuple(out.shape)} differs "
+            f"from the inplace tensor shape {tuple(x.shape)}")
+    return _inplace_from(x, out)
 
 
 def _make_module_inplace(fn, iname):

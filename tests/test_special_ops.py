@@ -314,3 +314,7 @@ def test_inplace_dtype_and_shape_guards():
     # same-shape broadcast against a scalar is fine
     x.add_(paddle.to_tensor(2.0))
     np.testing.assert_allclose(x.numpy(), np.full([3, 1], 3.0))
+    # where_ routes through the same guard
+    cond = paddle.to_tensor(np.array([[True], [False], [True]]))
+    with pytest.raises(ValueError):
+        paddle.where_(cond, x, paddle.to_tensor(np.zeros([3, 4], "f4")))

@@ -3,8 +3,9 @@
 The axon tunnel comes and goes in short windows (~20-45 min observed);
 a full in-order ladder pass rarely fits in one. This watcher probes the
 backend every --interval seconds and, whenever the TPU answers, runs the
-not-yet-cached rungs one subprocess at a time — SHORT rungs first so a
-brief window still yields results — caching each success durably via
+not-yet-cached rungs one subprocess at a time — in the round-5 priority
+order (never-measured ladder rungs first; see ORDER) — caching each
+success durably via
 bench._cache_rung (BENCH_TPU_RESULTS.json). After the ladder is
 complete it runs the pipeline-schedule tick A/B (tools/pipeline_tick_ab
 --device tpu → PIPELINE_TICKS.json) and exits.
@@ -24,9 +25,12 @@ sys.path.insert(0, REPO)
 
 import bench  # noqa: E402
 
-# short rungs first: a 20-minute window should still harvest several
-ORDER = ["flash_ab", "paged_ab", "eager", "vit_l_train", "llama7b_decode",
-         "gpt_345m_fp8_train", "gpt_770m_train", "head"]
+# Priority order (round-5): the never-measured BASELINE.md ladder rungs
+# first — decode (first compiled-on-chip run of the paged Pallas kernel),
+# then the two train rungs — so a 45-minute window closes the "3 of 6
+# rungs have no hardware number" gap before the short A/B rungs rerun.
+ORDER = ["llama7b_decode", "gpt_770m_train", "vit_l_train", "flash_ab",
+         "paged_ab", "eager", "gpt_345m_fp8_train", "head"]
 TICKS_PATH = os.path.join(REPO, "PIPELINE_TICKS.json")
 
 
