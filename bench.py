@@ -486,6 +486,19 @@ def _tpu_rung_specs():
     ]
 
 
+def _peak_hbm_bytes():
+    """Device-reported peak memory (VERDICT r4 #9: every rung row carries
+    peak HBM so MFU pushes and fp8 claims can't silently regress memory).
+    The reference's analogue is phi's memory stats surface
+    (paddle/phi/core/memory/stats.h)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        return int(peak) if peak is not None else None
+    except Exception:
+        return None  # CPU PJRT has no memory_stats
+
+
 def run_rung(name, out_path):
     """Child-process entry: execute ONE ladder rung, dump its JSON.
     Stamps the backend the child ACTUALLY ran on: PJRT init can fall
@@ -495,6 +508,9 @@ def run_rung(name, out_path):
     thunk = dict(_tpu_rung_specs())[name]
     res = _try(thunk)
     if isinstance(res, dict) and "skipped" not in res:
+        peak = _peak_hbm_bytes()
+        if peak is not None:
+            res.setdefault("peak_hbm_bytes", peak)
         # never re-touch the backend after a caught init failure: that
         # would re-raise and replace the descriptive skip reason with a
         # generic rc!=0 error
@@ -551,6 +567,66 @@ def _cache_path():
                         "BENCH_TPU_RESULTS.json")
 
 
+# primary metric per rung for the vs-cache regression gate:
+# (result key, higher_is_better)
+_RUNG_METRIC = {
+    "head": ("tokens_per_s", True),
+    "gpt_345m_fp8_train": ("tokens_per_s", True),
+    "gpt_770m_train": ("tokens_per_s", True),
+    "llama7b_decode": ("decode_tokens_per_s", True),
+    "vit_l_train": ("images_per_s", True),
+    "flash_ab": ("pallas_ms", False),
+    "paged_ab": ("kernel_ms", False),
+    "eager": ("eager_train_steps_per_s", True),
+}
+_REGRESSION_THRESHOLD = 0.10  # flag >10% worse than the durable cache
+
+
+def _norm_device(s):
+    s = str(s or "").lower()
+    if "cpu" in s:
+        return "cpu"
+    if "v5 lite" in s or "v5e" in s or "v5litepod" in s:
+        return "v5e"
+    for gen in ("v5p", "v6e", "v4", "v3"):
+        if gen in s:
+            return gen
+    return s
+
+
+def _stamp_vs_cache(name, res, prev):
+    """Annotate a fresh rung with its delta vs the durable cache — the
+    per-rung relative perf gate (VERDICT r4 #7; the reference's analogue
+    is tools/ci_op_benchmark.sh's PR-vs-develop op gate). Only compares
+    measurements from the same device generation; flags (never blocks —
+    headline variance is tunnel-dominated, see BASELINE.md) regressions
+    beyond _REGRESSION_THRESHOLD."""
+    if not isinstance(res, dict) or "skipped" in res:
+        return
+    key, higher_better = _RUNG_METRIC.get(name, (None, True))
+    if key is None or not isinstance(prev, dict):
+        return
+    new_v, old_v = res.get(key), prev.get(key)
+    if not new_v or not old_v:
+        return
+    if _norm_device(res.get("device")) != _norm_device(prev.get("device")):
+        return
+    # the comparison baseline RATCHETS to the best-ever same-device value
+    # (carried in gate_baseline on the cached row): a flagged regression
+    # that gets cached must not become the next run's baseline, or the
+    # flag self-clears after one run and sub-threshold drift compounds
+    # invisibly (the ci_op_benchmark analogue compares vs fixed develop)
+    better = max if higher_better else min
+    base_v = better(old_v,
+                    (prev.get("gate_baseline") or {}).get(key, old_v))
+    ratio = (new_v / base_v) if higher_better else (base_v / new_v)
+    res["vs_cache"] = round(ratio, 4)
+    res["vs_cache_prev"] = {key: old_v,
+                            "measured_at": prev.get("measured_at")}
+    res["perf_regressed"] = bool(ratio < 1.0 - _REGRESSION_THRESHOLD)
+    res["gate_baseline"] = {key: better(base_v, new_v)}
+
+
 def _cache_rung(name, res):
     """Persist a SUCCESSFUL TPU rung measurement durably. The axon tunnel
     comes and goes (it was down for all of rounds 2-3); a hardware number
@@ -581,6 +657,7 @@ def _cache_rung(name, res):
                 cache = json.load(f)
         except (OSError, ValueError):
             cache = {}
+        _stamp_vs_cache(name, res, cache.get(name))
         cache[name] = dict(res, measured_at=time.strftime(
             "%Y-%m-%dT%H:%M:%S%z"))
         try:
@@ -736,11 +813,15 @@ def main():
         cached = _cached_headline()
         if cached is not None:
             head, cladder = cached
+            regs = [n for n, r in [("head", head)] + sorted(cladder.items())
+                    if isinstance(r, dict) and r.get("perf_regressed")]
             out = {
                 "metric": "gpt2_345m_pretrain_tokens_per_sec_per_chip",
                 "value": head["tokens_per_s"],
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(head["mfu"] / BASELINE_MFU, 4),
+                "perf_gate": {"pass": not regs, "regressed": regs,
+                              "threshold": _REGRESSION_THRESHOLD},
                 "mfu": head["mfu"], "device": head["device"],
                 "step_time_ms": head["step_time_ms"],
                 "loss": head["loss"],
@@ -769,11 +850,15 @@ def main():
         ladder["eager"] = _try(bench_eager)
 
     if on_tpu:
+        regs = [n for n, r in [("head", head)] + sorted(ladder.items())
+                if isinstance(r, dict) and r.get("perf_regressed")]
         out = {
             "metric": "gpt2_345m_pretrain_tokens_per_sec_per_chip",
             "value": head["tokens_per_s"],
             "unit": "tokens/s/chip",
             "vs_baseline": round(head["mfu"] / BASELINE_MFU, 4),
+            "perf_gate": {"pass": not regs, "regressed": regs,
+                          "threshold": _REGRESSION_THRESHOLD},
         }
     else:
         # a DISTINCT metric name: the tiny-model smoke number must never

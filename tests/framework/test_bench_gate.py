@@ -1,0 +1,106 @@
+"""Per-rung perf regression gate + peak-HBM plumbing (bench.py).
+
+Models the reference's relative op-perf CI gate
+(tools/ci_op_benchmark.sh + tools/check_op_benchmark_result.py): each
+fresh rung is compared against the durable same-device cache and flagged
+— never blocked — on a >10% regression.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import bench  # noqa: E402
+
+
+def test_norm_device():
+    assert bench._norm_device("tpu v5 lite") == "v5e"
+    assert bench._norm_device("v5e") == "v5e"
+    assert bench._norm_device("TPU v5p pod") == "v5p"
+    assert bench._norm_device("cpu") == "cpu"
+    assert bench._norm_device(None) == ""
+
+
+def test_stamp_vs_cache_flags_regression():
+    res = {"tokens_per_s": 30000.0, "device": "v5e"}
+    prev = {"tokens_per_s": 37827.0, "device": "tpu v5 lite",
+            "measured_at": "2026-07-30"}
+    bench._stamp_vs_cache("head", res, prev)
+    assert res["perf_regressed"] is True
+    assert res["vs_cache"] == round(30000.0 / 37827.0, 4)
+    assert res["vs_cache_prev"]["tokens_per_s"] == 37827.0
+
+
+def test_stamp_vs_cache_improvement_and_lower_better():
+    res = {"tokens_per_s": 40000.0, "device": "v5e"}
+    bench._stamp_vs_cache("head", res, {"tokens_per_s": 37827.0,
+                                        "device": "v5e"})
+    assert res["perf_regressed"] is False and res["vs_cache"] > 1.0
+    # kernel-time rungs: LOWER is better (flash_ab's primary key is
+    # pallas_ms — the real bench_flash_ab result shape)
+    ab = {"pallas_ms": 3.0, "device": "v5e"}
+    bench._stamp_vs_cache("flash_ab", ab, {"pallas_ms": 2.56,
+                                           "device": "v5e"})
+    assert ab["perf_regressed"] is True
+    ab2 = {"pallas_ms": 2.4, "device": "v5e"}
+    bench._stamp_vs_cache("flash_ab", ab2, {"pallas_ms": 2.56,
+                                            "device": "v5e"})
+    assert ab2["perf_regressed"] is False
+    pg = {"kernel_ms": 3.0, "device": "v5e"}
+    bench._stamp_vs_cache("paged_ab", pg, {"kernel_ms": 2.0,
+                                           "device": "v5e"})
+    assert pg["perf_regressed"] is True
+
+
+def test_gate_baseline_ratchets():
+    """A cached regression must not become the next run's baseline."""
+    prev = {"tokens_per_s": 37827.0, "device": "v5e"}
+    r1 = {"tokens_per_s": 30000.0, "device": "v5e"}
+    bench._stamp_vs_cache("head", r1, prev)
+    assert r1["perf_regressed"] is True
+    assert r1["gate_baseline"]["tokens_per_s"] == 37827.0
+    # next run compares against the RATCHETED baseline carried on r1,
+    # not r1's degraded value — the flag must not self-clear
+    r2 = {"tokens_per_s": 30000.0, "device": "v5e"}
+    bench._stamp_vs_cache("head", r2, r1)
+    assert r2["perf_regressed"] is True
+    assert r2["vs_cache"] == round(30000.0 / 37827.0, 4)
+    # and a later improvement raises the ratchet
+    r3 = {"tokens_per_s": 40000.0, "device": "v5e"}
+    bench._stamp_vs_cache("head", r3, r2)
+    assert r3["perf_regressed"] is False
+    assert r3["gate_baseline"]["tokens_per_s"] == 40000.0
+
+
+def test_stamp_vs_cache_skips_cross_device_and_missing():
+    res = {"tokens_per_s": 100.0, "device": "cpu"}
+    bench._stamp_vs_cache("head", res, {"tokens_per_s": 37827.0,
+                                        "device": "v5e"})
+    assert "vs_cache" not in res  # cpu smoke never compared to v5e
+    res2 = {"tokens_per_s": 100.0, "device": "v5e"}
+    bench._stamp_vs_cache("head", res2, None)
+    assert "vs_cache" not in res2  # first-ever measurement
+    skipped = {"skipped": "OOM", "device": "v5e"}
+    bench._stamp_vs_cache("head", skipped, {"tokens_per_s": 1,
+                                            "device": "v5e"})
+    assert "vs_cache" not in skipped
+
+
+def test_cache_rung_stamps_and_persists(tmp_path, monkeypatch):
+    path = tmp_path / "cache.json"
+    monkeypatch.setattr(bench, "_cache_path", lambda: str(path))
+    first = {"tokens_per_s": 37000.0, "device": "v5e", "mfu": 0.45}
+    bench._cache_rung("head", first)
+    second = {"tokens_per_s": 30000.0, "device": "v5e", "mfu": 0.36}
+    bench._cache_rung("head", second)
+    cache = json.loads(path.read_text())
+    assert cache["head"]["perf_regressed"] is True
+    assert cache["head"]["vs_cache"] == round(30000.0 / 37000.0, 4)
+    assert cache["head"]["measured_at"]
+    # cpu fallback must never enter the cache at all
+    bench._cache_rung("head", {"tokens_per_s": 5.0, "device": "cpu"})
+    cache = json.loads(path.read_text())
+    assert cache["head"]["tokens_per_s"] == 30000.0
