@@ -34,6 +34,10 @@ class GPTConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
     use_fp8: bool = False  # fp8 block linears (amp.fp8 delayed scaling)
+    # loss() computes CE through the blockwise fused LM-head
+    # (F.fused_linear_cross_entropy) instead of materializing [b,s,V]
+    # logits — the c_softmax_with_cross_entropy-class fusion
+    fused_head_ce: bool = True
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -145,7 +149,8 @@ class GPT(nn.Layer):
             from ..amp.fp8 import convert_to_fp8
             convert_to_fp8(self, exclude=("lm_head",))
 
-    def forward(self, input_ids):
+    def forward_hidden(self, input_ids):
+        """Transformer stack output (post ln_f), before the LM head."""
         from .. import ops
         b, s = input_ids.shape
         pos = ops.arange(0, s, dtype="int64")
@@ -153,7 +158,11 @@ class GPT(nn.Layer):
         x = self.drop(x)
         for block in self.h:
             x = block(x)
-        x = self.ln_f(x)
+        return self.ln_f(x)
+
+    def forward(self, input_ids):
+        from .. import ops
+        x = self.forward_hidden(input_ids)
         if self.lm_head is not None:
             return self.lm_head(x)
         # weight-tied head: [b,s,d] @ [d,vocab]
@@ -162,6 +171,13 @@ class GPT(nn.Layer):
     def loss(self, input_ids, labels):
         """Next-token cross entropy; labels already shifted or equal to
         input_ids (we shift internally)."""
+        if self.config.fused_head_ce:
+            # blockwise head+CE: the [b,s,V] logits never materialize
+            x = self.forward_hidden(input_ids)[:, :-1, :]
+            tied = self.lm_head is None
+            w = self.wte.weight if tied else self.lm_head.weight
+            return F.fused_linear_cross_entropy(x, w, labels[:, 1:],
+                                                transpose_weight=tied)
         logits = self(input_ids)
         shift_logits = logits[:, :-1, :]
         shift_labels = labels[:, 1:]

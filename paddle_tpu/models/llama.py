@@ -36,6 +36,8 @@ class LlamaConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
     use_fp8: bool = False  # fp8 block linears (amp.fp8 delayed scaling)
+    # loss() uses the blockwise fused LM-head CE (see models/gpt.py)
+    fused_head_ce: bool = True
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -238,16 +240,17 @@ class Llama(nn.Layer):
     def forward(self, input_ids, caches=None, position_offset=0,
                 kv_sink=None):
         from .. import ops
-        x = self.embed_tokens(input_ids)
-        new_caches = [] if caches is not None else None
-        for i, block in enumerate(self.layers):
-            if caches is None:
-                x = block(x, kv_sink=kv_sink)
-            else:
+        new_caches = None
+        if caches is None:
+            x = self.forward_hidden(input_ids, kv_sink=kv_sink)
+        else:
+            x = self.embed_tokens(input_ids)
+            new_caches = []
+            for i, block in enumerate(self.layers):
                 x, c = block(x, cache=caches[i],
                              position_offset=position_offset)
                 new_caches.append(c)
-        x = self.norm(x)
+            x = self.norm(x)
         if self.lm_head is not None:
             logits = self.lm_head(x)
         else:
@@ -421,7 +424,20 @@ class Llama(nn.Layer):
                                    cache.seq_lens + 1, cache.seq_lens)
         return toks
 
+    def forward_hidden(self, input_ids, kv_sink=None):
+        """Decoder stack output (post final RMSNorm), before the head."""
+        x = self.embed_tokens(input_ids)
+        for block in self.layers:
+            x = block(x, kv_sink=kv_sink)
+        return self.norm(x)
+
     def loss(self, input_ids, labels):
+        if self.config.fused_head_ce:
+            x = self.forward_hidden(input_ids)[:, :-1, :]
+            tied = self.lm_head is None
+            w = self.embed_tokens.weight if tied else self.lm_head.weight
+            return F.fused_linear_cross_entropy(x, w, labels[:, 1:],
+                                                transpose_weight=tied)
         logits = self(input_ids)
         return F.cross_entropy(logits[:, :-1, :], labels[:, 1:])
 

@@ -14,3 +14,4 @@ from .pooling import (  # noqa: F401
     avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d, max_pool2d, max_pool3d,
 )
 from .extra import *  # noqa: F401,F403,E402
+from .fused_ce import fused_linear_cross_entropy  # noqa: F401,E402

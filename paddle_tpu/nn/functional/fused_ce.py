@@ -1,0 +1,192 @@
+"""Fused (blockwise) linear + softmax cross-entropy over a large vocab.
+
+The LM-head matmul [N, D] @ [D, V] followed by softmax-CE is the one
+place a GPT-class model materializes an [N, V] activation (V ~ 50k): at
+the headline shape that is ~1.6 GB of f32 logits written to and re-read
+from HBM in forward AND recomputed/re-read for the backward — pure HBM
+traffic that bounds step time well before the MXU does.
+
+This op never materializes the full logits: it scans the vocab in K-wide
+chunks, carrying the running max / sum-exp (online softmax, the same
+recurrence the flash kernel uses along sequence) plus the label logit;
+backward recomputes each chunk's logits and accumulates dx and the
+per-chunk dW directly. Peak extra memory is one [N, K] f32 chunk. The
+vocab splits into ``C`` full K-chunks scanned with a dynamic slice plus
+one statically-sliced remainder chunk — no padding, so no masking inside
+the online-softmax recurrence.
+
+Capability parity: the reference's fused/vocab-distributed CE family —
+`c_softmax_with_cross_entropy` (blockwise/collective softmax-CE,
+paddle/fluid/operators/collective/c_softmax_with_cross_entropy_op.cu:1)
+and the fused_linear heads (python/paddle/incubate/nn/functional/). The
+TP vocab-sharded form lives in
+`distributed.fleet.mp_layers.ParallelCrossEntropy`; this is the
+single-device/DP fusion the headline rung rides.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import apply, unwrap
+
+__all__ = ["fused_linear_cross_entropy"]
+
+_INIT_MAX = -1e30  # finite lowest: keeps exp(m - m_new) NaN-free
+
+
+def _chunk_plan(V):
+    """(K, C, R): C full K-wide chunks plus an R-wide remainder."""
+    K = min(8192, V)
+    C = V // K
+    return K, C, V - C * K
+
+
+def _slice_w(w, start, size, transpose_w, dynamic):
+    axis = 0 if transpose_w else 1
+    if dynamic:
+        return lax.dynamic_slice_in_dim(w, start, size, axis=axis)
+    return lax.slice_in_dim(w, start, start + size, axis=axis)
+
+
+def _logits(x2, wc, transpose_w):
+    """[N, size] f32 chunk logits (f32 accumulation on the MXU via
+    preferred_element_type; operands stay in the model dtype)."""
+    dims = (((1,), (1,)), ((), ())) if transpose_w else \
+        (((1,), (0,)), ((), ()))
+    return lax.dot_general(x2, wc, dims,
+                           preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _fused_ce(x2, w, lbl, transpose_w, V, K, C, R, ignore_index):
+    per_tok, _ = _fwd_impl(x2, w, lbl, transpose_w, V, K, C, R,
+                           ignore_index)
+    return per_tok
+
+
+def _fwd_impl(x2, w, lbl, transpose_w, V, K, C, R, ignore_index):
+    N = x2.shape[0]
+    lbl = lbl.astype(jnp.int32)
+
+    def online_step(carry, logits, start, size):
+        m, s, ll = carry
+        cols = start + lax.iota(jnp.int32, size)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=1))
+        s = s * jnp.exp(m - m_new) + \
+            jnp.sum(jnp.exp(logits - m_new[:, None]), axis=1)
+        ll = ll + jnp.sum(
+            jnp.where(cols[None, :] == lbl[:, None], logits, 0.0), axis=1)
+        return m_new, s, ll
+
+    carry = (jnp.full((N,), _INIT_MAX, jnp.float32),
+             jnp.zeros((N,), jnp.float32), jnp.zeros((N,), jnp.float32))
+    if C > 0:
+        def body(i, cr):
+            wc = _slice_w(w, i * K, K, transpose_w, dynamic=True)
+            return online_step(cr, _logits(x2, wc, transpose_w), i * K, K)
+        carry = lax.fori_loop(0, C, body, carry)
+    if R > 0:
+        wc = _slice_w(w, C * K, R, transpose_w, dynamic=False)
+        carry = online_step(carry, _logits(x2, wc, transpose_w), C * K, R)
+    m, s, ll = carry
+    log_z = m + jnp.log(s)
+    valid = lbl != ignore_index
+    per_tok = jnp.where(valid, log_z - ll, 0.0)
+    return per_tok, (log_z, valid)
+
+
+def _fused_ce_fwd(x2, w, lbl, transpose_w, V, K, C, R, ignore_index):
+    per_tok, (log_z, valid) = _fwd_impl(x2, w, lbl, transpose_w, V, K, C,
+                                        R, ignore_index)
+    return per_tok, (x2, w, lbl.astype(jnp.int32), log_z, valid)
+
+
+def _fused_ce_bwd(transpose_w, V, K, C, R, ignore_index, res, g):
+    x2, w, lbl, log_z, valid = res
+    gi = jnp.asarray(g, jnp.float32) * valid.astype(jnp.float32)
+    N, D = x2.shape
+
+    def chunk_grads(start, size, dynamic):
+        """(delta @ Wc^T contribution to dx, dWc) for one chunk."""
+        wc = _slice_w(w, start, size, transpose_w, dynamic)
+        logits = _logits(x2, wc, transpose_w)
+        cols = start + lax.iota(jnp.int32, size)
+        p = jnp.exp(logits - log_z[:, None])
+        delta = (p - (cols[None, :] == lbl[:, None]).astype(jnp.float32))
+        delta = delta * gi[:, None]  # ignored tokens zero out here
+        if transpose_w:  # wc: [size, D]
+            dxc = lax.dot_general(delta, wc, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+            dwc = lax.dot_general(  # [size, D]
+                delta, x2, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(w.dtype)
+        else:  # wc: [D, size]
+            dxc = lax.dot_general(delta, wc, (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+            dwc = lax.dot_general(  # [D, size]
+                x2, delta, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(w.dtype)
+        return dxc, dwc
+
+    dx = jnp.zeros((N, D), jnp.float32)
+    parts = []
+    if C > 0:
+        def body(carry, c):
+            dxc, dwc = chunk_grads(c * K, K, dynamic=True)
+            return carry + dxc, dwc
+        dx, dw_full = lax.scan(body, dx,
+                               jnp.arange(C, dtype=jnp.int32))
+        if transpose_w:  # [C, K, D] -> [C*K, D]
+            parts.append(dw_full.reshape(C * K, D))
+        else:  # [C, D, K] -> [D, C*K]
+            parts.append(jnp.moveaxis(dw_full, 0, 1).reshape(D, C * K))
+    if R > 0:
+        dxr, dwr = chunk_grads(C * K, R, dynamic=False)
+        dx = dx + dxr
+        parts.append(dwr)
+    axis = 0 if transpose_w else 1
+    dw = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=axis)
+    return dx.astype(x2.dtype), dw, None
+
+
+_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+def fused_linear_cross_entropy(x, weight, labels, transpose_weight=False,
+                               ignore_index=-100, reduction="mean",
+                               name=None):
+    """CE( x @ W , labels ) without materializing the [N, V] logits.
+
+    Args:
+        x: [..., D] hidden states (the LM head input).
+        weight: [D, V], or [V, D] with ``transpose_weight=True`` (the
+            tied-embedding layout, ``matmul(x, wte.weight,
+            transpose_y=True)``).
+        labels: [...] int targets; ``ignore_index`` rows contribute 0.
+        reduction: 'mean' (over non-ignored tokens) | 'sum' | 'none'.
+    """
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError(f"unknown reduction {reduction!r}")
+    w_arr = unwrap(weight)
+    V = int(w_arr.shape[0] if transpose_weight else w_arr.shape[1])
+    K, C, R = _chunk_plan(V)
+
+    def _fn(xv, wv, lv):
+        lead = xv.shape[:-1]
+        x2 = xv.reshape(-1, xv.shape[-1])
+        per_tok = _fused_ce(x2, wv, lv.reshape(-1), transpose_weight, V,
+                            K, C, R, ignore_index)
+        if reduction == "none":
+            return per_tok.reshape(lead)
+        if reduction == "sum":
+            return jnp.sum(per_tok)
+        n_valid = jnp.sum((lv.reshape(-1) != ignore_index)
+                          .astype(jnp.float32))
+        return jnp.sum(per_tok) / jnp.maximum(n_valid, 1.0)
+
+    return apply(_fn, x, weight, labels, name="fused_linear_cross_entropy")

@@ -306,4 +306,12 @@ class TestTPULowering:
             jax.ShapeDtypeStruct((512,), jnp.bfloat16),
             jax.ShapeDtypeStruct((), jnp.float32),
             jax.ShapeDtypeStruct((), jnp.float32))
-        assert "f8E4M3FN" in exp.mlir_module()
+        txt = exp.mlir_module()
+        assert "f8E4M3FN" in txt
+        # win-condition evidence (BASELINE.md fp8 note): the dot itself
+        # takes f8 operands, so fp8-native MXU generations (v6e+) run it
+        # on the fp8 path; a stray cast in front would make fp8 pure
+        # overhead on every generation
+        assert any("dot_general" in ln and "f8E4M3FN" in ln
+                   for ln in txt.splitlines()), \
+            "no f8-operand dot_general in the FP8Linear module"

@@ -90,3 +90,34 @@ def test_paged_decode_lowers_for_tpu():
     n = _lower(lambda q, k, v, t, l: paged_decode_attention_kernel(
         q, k, v, t, l, interpret=False), q, kp, kp, tbl, lens)
     assert n == 1
+
+
+def test_fused_linear_ce_lowers_for_tpu():
+    """The blockwise fused LM-head CE (fori/scan + dynamic_slice over W,
+    online-softmax carries) must legalize for TPU in fwd AND bwd — the
+    headline train step rides it (models/gpt.py loss)."""
+    from paddle_tpu.nn.functional.fused_ce import (_chunk_plan, _fused_ce)
+
+    D, V = 128, 50304  # remainder-free plan
+    K, C, R = _chunk_plan(V)
+    Kr, Cr, Rr = _chunk_plan(50257)  # ragged vocab exercises the epilogue
+
+    def train(x, w, lbl):
+        def f(x, w):
+            return jnp.sum(_fused_ce(x, w, lbl, True, V, K, C, R, -100))
+        l, (dx, dw) = jax.value_and_grad(f, argnums=(0, 1))(x, w)
+        return l, dx, dw
+
+    export.export(jax.jit(train), platforms=["tpu"])(
+        _aval((256, D), jnp.bfloat16), _aval((V, D), jnp.bfloat16),
+        _aval((256,), jnp.int32))
+
+    def train_ragged(x, w, lbl):
+        def f(x, w):
+            return jnp.sum(_fused_ce(x, w, lbl, False, 50257, Kr, Cr,
+                                     Rr, -100))
+        return jax.value_and_grad(f, argnums=(0, 1))(x, w)
+
+    export.export(jax.jit(train_ragged), platforms=["tpu"])(
+        _aval((256, D), jnp.bfloat16), _aval((D, 50257), jnp.bfloat16),
+        _aval((256,), jnp.int32))

@@ -242,7 +242,17 @@ def gate_fp8_step() -> bool:
     def check_fp8(txt):
         assert "f8E4M3FN" in txt, "no e4m3 in fp8 step"
         assert "f8E5M2" in txt, "no e5m2 grads in fp8 step"
-        return {"fp8": "e4m3 fwd + e5m2 grads in module"}
+        # the WIN CONDITION evidence (BASELINE.md fp8 note): the dot
+        # itself must take f8 operands — XLA on fp8-native MXU
+        # generations (v6e+) then runs it on the fp8 path, while v5e
+        # legalizes it to convert+bf16-dot (the measured ~13% overhead).
+        # If a cast slipped in front, the dot would take bf16 operands
+        # and fp8 would be pure overhead on EVERY generation.
+        f8_dots = [ln for ln in txt.splitlines()
+                   if "dot_general" in ln and "f8E4M3FN" in ln]
+        assert f8_dots, "no dot_general with f8 operands in fp8 step"
+        return {"fp8": f"e4m3 fwd + e5m2 grads in module; "
+                       f"{len(f8_dots)} f8-operand dot_general ops"}
 
     return gate("gpt_fp8_train_step", ts._pure,
                 *trainstep_avals(ts, opt, (2, 64)),
