@@ -215,14 +215,17 @@ def bench_eager(tag="eager"):
 
     paddle.seed(0)
     x = paddle.to_tensor(np.ones((256, 256), np.float32))
-    # single-op dispatch rate (async: don't sync per op)
+    # single-op dispatch rate (async: don't sync per op). One warmup
+    # pass first: the deferred-chain dispatch jit-compiles each chain
+    # STRUCTURE once; steady state is what the rate claims.
     n = 300
-    y = x
-    t0 = time.perf_counter()
-    for _ in range(n):
-        y = y * 1.0001 + 0.0001
-    _sync(y.sum())
-    ops_per_s = 2 * n / (time.perf_counter() - t0)
+    for _ in range(2):
+        y = x
+        t0 = time.perf_counter()
+        for _ in range(n):
+            y = y * 1.0001 + 0.0001
+        _sync(y.sum())
+        ops_per_s = 2 * n / (time.perf_counter() - t0)
 
     # eager train step (forward + tape backward + SGD), no jit
     net = nn.Sequential(nn.Linear(256, 256), nn.GELU(),
@@ -249,9 +252,58 @@ def bench_eager(tag="eager"):
         "tag": tag, "eager_elementwise_ops_per_s": round(ops_per_s, 1),
         "eager_train_steps_per_s": round(steps / dt, 2),
     }
+    out["defer_depth_curve_ops_per_s"] = _defer_depth_curve()
     out["dispatch_breakdown_us"] = _dispatch_breakdown()
     out.update(_eager_vs_jit_budget())
     return out
+
+
+def _defer_depth_curve(n=256):
+    """ops/s of a dependent elementwise chain vs the deferred-chain cap
+    (core/deferred.py): the measured enqueue-amortization curve. On a
+    remote-attached chip each flush pays one transport round trip, so
+    ops/s should scale ~linearly with the cap until host-side work
+    dominates — the direct evidence that consecutive eager ops batch
+    into one dispatched segment (VERDICT r4 #5). cap=1 approximates
+    per-op dispatch."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core import deferred
+
+    x = paddle.to_tensor(np.ones((256, 256), np.float32))
+    curve = {}
+    old_cap = deferred.DEFER_CAP
+    try:
+        for cap in (1, 8, 32, 64):
+            deferred.DEFER_CAP = cap
+            y = x  # warm the jit cache for this cap's chain shapes
+            for _ in range(n):
+                y = y * 1.0001 + 0.0001
+            _sync(y.sum())
+            t0 = time.perf_counter()
+            y = x
+            for _ in range(n):
+                y = y * 1.0001 + 0.0001
+            _sync(y.sum())
+            curve[str(cap)] = round(2 * n / (time.perf_counter() - t0), 1)
+    finally:
+        deferred.DEFER_CAP = old_cap
+    import paddle_tpu as _p
+    prior = _p.get_flags("FLAGS_eager_defer")["FLAGS_eager_defer"]
+    try:
+        _p.set_flags({"FLAGS_eager_defer": False})
+        y = x
+        for _ in range(n):
+            y = y * 1.0001 + 0.0001
+        _sync(y.sum())
+        t0 = time.perf_counter()
+        y = x
+        for _ in range(n):
+            y = y * 1.0001 + 0.0001
+        _sync(y.sum())
+        curve["off"] = round(2 * n / (time.perf_counter() - t0), 1)
+    finally:
+        _p.set_flags({"FLAGS_eager_defer": prior})
+    return curve
 
 
 def _dispatch_breakdown(n=2000):

@@ -1,0 +1,253 @@
+"""Deferred elementwise chains: batch consecutive eager ops into ONE
+device dispatch.
+
+On a remote-attached TPU every eager dispatch pays the transport round
+trip (measured ~3.8 ms over the axon tunnel vs ~157 us of host work —
+bench.py `_dispatch_breakdown`), so a dependent chain like
+``y = y * a + b`` in a python loop is RTT-bound no matter how fast
+dispatch is. The reference hides per-op latency with its async eager
+executor (SURVEY §3.1: ad_func enqueue + device streams); the XLA-native
+equivalent is to not dispatch per op at all: shape/dtype-preserving
+elementwise ops on no-grad tensors accumulate into a small expression
+DAG, and the chain executes as ONE jitted XLA program — keyed by chain
+STRUCTURE (scalar constants ride as 0-d jit arguments, so loop-varying
+scalars do NOT recompile), so steady-state loops hit the jit cache and
+pay one transport round trip per `DEFER_CAP` ops.
+
+Semantics are preserved by construction:
+- only ops explicitly marked ``defer=True`` in the op library enter a
+  chain (same-shape/same-float-dtype elementwise, python scalars ok);
+- any read of ``Tensor._data`` (numpy(), item(), an undeferrable op,
+  autograd, jit boundaries) flushes the chain first — no user-visible
+  laziness beyond what jax's own async dispatch already has;
+- a flush stamps the value of every chain node still owned by a LIVE
+  Tensor, so shared subexpressions are never re-executed;
+- gradients never defer: ops with diff inputs take the tape path in
+  ``dispatch.apply`` before deferral is consulted;
+- under jit tracing payloads are Tracers and deferral bails out.
+
+Flag: ``FLAGS_eager_defer`` (default on; env ``FLAGS_eager_defer=0``).
+"""
+
+from __future__ import annotations
+
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFER_CAP = 64  # max unique nodes per chain before forced materialization
+
+_JIT_CACHE: dict = {}
+_JIT_CACHE_MAX = 512
+
+
+class Expr:
+    """One deferred op node: fn applied to (leaf | node | const) args."""
+
+    __slots__ = ("fn", "argspec", "kwargs", "shape", "dtype", "n_nodes",
+                 "value", "owner", "__weakref__")
+
+    def __init__(self, fn, argspec, kwargs, shape, dtype, n_nodes):
+        self.fn = fn
+        self.argspec = argspec  # (("leaf", arr)|("node", Expr)|("const", v), ...)
+        self.kwargs = kwargs
+        self.shape = shape
+        self.dtype = dtype
+        self.n_nodes = n_nodes  # additive upper bound (see try_defer)
+        self.value = None  # stamped after a flush
+        self.owner = None  # weakref to the Tensor holding this node
+
+
+class _DtypeOnly:
+    """Minimal out-descriptor for _post_op_hooks at defer time (AMP
+    op-stats record the declared dtype; there is no array yet)."""
+
+    __slots__ = ("dtype",)
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+
+
+def enabled():
+    from . import flags as flags_mod
+    return bool(flags_mod.flag("FLAGS_eager_defer"))
+
+
+def _peek(t):
+    """A Tensor's payload WITHOUT materializing: Expr | jax.Array."""
+    pend = getattr(t, "_pending", None)
+    if pend is not None:
+        return pend if pend.value is None else pend.value
+    return t._buf
+
+
+def _unique_count(roots):
+    seen = set()
+    stack = list(roots)
+    while stack:
+        e = stack.pop()
+        if id(e) in seen:
+            continue
+        seen.add(id(e))
+        for kind, v in e.argspec:
+            if kind == "node" and v.value is None:
+                stack.append(v)
+    return len(seen)
+
+
+def try_defer(fn, args, kwargs, recording):
+    """Build an Expr for fn(*args) if every condition holds, else None.
+
+    args are the ORIGINAL apply() args (Tensors / scalars); kwargs must
+    freeze hashable. Returns an Expr carrying the declared out meta."""
+    from .dispatch import _fn_key, _freeze
+    from .tensor import Tensor
+
+    shape = None
+    dtype = None
+    argspec = []
+    n_nodes = 1
+    for a in args:
+        if isinstance(a, Tensor):
+            if recording and not a.stop_gradient:
+                return None  # diff input: tape path owns it
+            p = _peek(a)
+            if isinstance(p, jax.core.Tracer):
+                return None  # under jit tracing: no deferral
+            if isinstance(p, Expr):
+                s, dt = p.shape, p.dtype
+                n_nodes += p.n_nodes
+                argspec.append(("node", p))
+            elif isinstance(p, jax.Array):
+                s, dt = p.shape, p.dtype
+                argspec.append(("leaf", p))
+            else:  # unexpected payload
+                return None
+            if not jnp.issubdtype(dt, jnp.floating):
+                return None
+            if shape is None:
+                shape, dtype = s, dt
+            elif s != shape or dt != dtype:
+                return None  # no implicit broadcast/promotion in chains
+        elif isinstance(a, (bool, int, float)) and not isinstance(
+                a, np.generic):
+            argspec.append(("const", float(a)))
+        elif isinstance(a, (np.integer, np.floating)):
+            argspec.append(("const", float(a)))
+        else:
+            return None
+    if shape is None:
+        return None
+    if n_nodes > DEFER_CAP:
+        # the additive count double-counts shared nodes (y = y * y);
+        # pay the exact traversal — ONE shared visited-set across all
+        # args — only when the estimate trips the cap
+        n_nodes = 1 + _unique_count(
+            [v for k, v in argspec if k == "node"])
+        if n_nodes > DEFER_CAP:
+            return None
+    try:
+        fk = _fn_key(fn)
+        hash((fk, _freeze(kwargs)))
+    except (TypeError, ValueError):
+        return None
+    return Expr(fn, tuple(argspec), kwargs, shape, dtype, n_nodes)
+
+
+def _linearize(root):
+    """Postorder-unique (nodes, leaves, consts): leaves deduped by array
+    id; consts collected as jit ARGUMENTS (values stay out of the cache
+    key, so loop-varying scalars don't recompile)."""
+    nodes, leaves, consts = [], [], []
+    node_ix, leaf_ix = {}, {}
+
+    def visit(e):
+        if id(e) in node_ix:
+            return node_ix[id(e)]
+        spec = []
+        for kind, v in e.argspec:
+            if kind == "node":
+                if v.value is not None:  # flushed since: now a leaf
+                    kind, v = "leaf", v.value
+                else:
+                    spec.append(("node", visit(v)))
+                    continue
+            if kind == "leaf":
+                ix = leaf_ix.get(id(v))
+                if ix is None:
+                    ix = leaf_ix[id(v)] = len(leaves)
+                    leaves.append(v)
+                spec.append(("leaf", ix))
+            else:
+                consts.append(v)
+                spec.append(("const", len(consts) - 1))
+        nodes.append((e, tuple(spec)))
+        node_ix[id(e)] = len(nodes) - 1
+        return node_ix[id(e)]
+
+    visit(root)
+    return nodes, leaves, consts
+
+
+def flush(root):
+    """Evaluate the chain as one jitted program. Every node still owned
+    by a live Tensor is returned and stamped (shared subexpressions are
+    never re-executed); returns the root's value."""
+    if root.value is not None:
+        return root.value
+    from .dispatch import _fn_key, _freeze
+    nodes, leaves, consts = _linearize(root)
+    out_ixs = tuple(i for i, (e, _) in enumerate(nodes)
+                    if e is root or (e.owner is not None
+                                     and e.owner() is not None))
+    key = (tuple((_fn_key(e.fn), spec, _freeze(e.kwargs))
+                 for e, spec in nodes), out_ixs)
+    jf = _JIT_CACHE.get(key)
+    if jf is None:
+        if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
+            _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
+        descr = [(e.fn, spec, e.kwargs) for e, spec in nodes]
+        n_leaves = len(leaves)
+
+        @jax.jit
+        def jf(*arrs):
+            leaf_arrays = arrs[:n_leaves]
+            const_arrays = arrs[n_leaves:]
+            vals = []
+            for fn, spec, kw in descr:
+                argv = [leaf_arrays[ix] if kind == "leaf" else
+                        vals[ix] if kind == "node" else const_arrays[ix]
+                        for kind, ix in spec]
+                vals.append(fn(*argv, **kw))
+            return tuple(vals[i] for i in out_ixs)
+
+        _JIT_CACHE[key] = jf
+    # consts ride as 0-d arrays AT THE CHAIN DTYPE — the same value a
+    # weak python scalar would contribute against a dtype-uniform chain
+    # (memoized: a 64-op chain has ~100 consts and flushes in a loop)
+    cargs = [_const_arr(c, root.dtype) for c in consts]
+    outs = jf(*leaves, *cargs)
+    for i, ov in zip(out_ixs, outs):
+        nodes[i][0].value = ov
+    return root.value
+
+
+_CONST_MEMO: dict = {}
+
+
+def _const_arr(c, dtype):
+    key = (c, str(dtype))
+    a = _CONST_MEMO.get(key)
+    if a is None:
+        if len(_CONST_MEMO) > 4096:
+            _CONST_MEMO.clear()
+        a = _CONST_MEMO[key] = jnp.asarray(c, dtype=dtype)
+    return a
+
+
+def bind_owner(expr, tensor):
+    """Record the Tensor owning this chain node (weakly): flush stamps
+    values for nodes whose owners are still alive."""
+    expr.owner = weakref.ref(tensor)

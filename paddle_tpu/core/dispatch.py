@@ -396,7 +396,8 @@ def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf):
     return out_tuple, _LazyVjp(bwd, arrs), was_tuple
 
 
-def apply(fn: Callable, *args, name: str = None, **kwargs):
+def apply(fn: Callable, *args, name: str = None, defer: bool = False,
+          **kwargs):
     """Run ``fn`` over the payloads of ``args`` and wrap outputs as Tensors.
 
     - Tensor args are unwrapped to jax arrays; non-Tensor args pass through.
@@ -404,6 +405,11 @@ def apply(fn: Callable, *args, name: str = None, **kwargs):
       Node is attached to every differentiable output.
     - ``fn`` may return one array or a tuple/list of arrays; ``apply``
       returns a single Tensor or a list of Tensors accordingly.
+    - ``defer=True`` marks a shape/dtype-preserving elementwise op as
+      eligible for the deferred-chain dispatch (core/deferred.py): on a
+      no-grad path the op joins a pending expression instead of
+      dispatching, and the whole chain runs as one jitted program at the
+      first ``_data`` read — one device round trip per chain.
     """
     name = name or getattr(fn, "__name__", "op")
     from ..amp import amp_state
@@ -412,9 +418,17 @@ def apply(fn: Callable, *args, name: str = None, **kwargs):
         args = amp_dispatch_pre(name, args)
     from . import flags as flags_mod
     check_naninf = flags_mod.flag("FLAGS_check_nan_inf")
+    recording = is_grad_enabled()
+    if defer and not check_naninf:
+        from . import deferred
+        if deferred.enabled():
+            expr = deferred.try_defer(fn, args, kwargs, recording)
+            if expr is not None:
+                _post_op_hooks(name, (deferred._DtypeOnly(expr.dtype),),
+                               False)
+                return Tensor._from_pending(expr)
     diff_idx = []
     payloads = []
-    recording = is_grad_enabled()
     for i, a in enumerate(args):
         if isinstance(a, Tensor):
             payloads.append(a._data)

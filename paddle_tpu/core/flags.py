@@ -73,6 +73,10 @@ define_flag("FLAGS_check_nan_inf_level", 0,
             "0: raise on nan/inf; 1: warn; 3: collect stats only")
 define_flag("FLAGS_benchmark", False, "per-op timing")
 define_flag("FLAGS_use_stride_kernel", True, "strided view kernels")
+define_flag("FLAGS_eager_defer", True,
+            "batch consecutive no-grad elementwise eager ops into one "
+            "jitted dispatch (core/deferred.py) — hides per-op transport "
+            "RTT on remote-attached devices")
 define_flag("FLAGS_embedding_deterministic", 0,
             "deterministic embedding grad accumulation")
 define_flag("FLAGS_cudnn_deterministic", False,

@@ -23,8 +23,9 @@ from .autograd import backward as _backward
 
 
 class Tensor:
-    __slots__ = ("_data", "grad", "stop_gradient", "_node", "_out_idx", "name",
-                 "persistable", "_dist_attr", "__weakref__")
+    __slots__ = ("_buf", "_pending", "grad", "stop_gradient", "_node",
+                 "_out_idx", "name", "persistable", "_dist_attr",
+                 "__weakref__")
 
     def __init__(self, data, dtype=None, place=None, stop_gradient=True,
                  name=None):
@@ -56,7 +57,8 @@ class Tensor:
                 arr = arr.astype(dtype_mod.get_default_dtype())
         if place is not None:
             arr = jax.device_put(arr, place_mod.Place.parse(place).jax_device())
-        self._data = arr
+        self._buf = arr
+        self._pending = None
         self.grad = None
         self.stop_gradient = stop_gradient
         self._node = None
@@ -65,6 +67,48 @@ class Tensor:
         self.persistable = False
         self._dist_attr = None  # (ProcessMesh, [Placement]) when sharded
 
+    # -- deferred-chain payload (core/deferred.py) ------------------------
+    @property
+    def _data(self):
+        """The jax payload. Reading it materializes any deferred
+        elementwise chain — the ONLY flush point, so laziness is never
+        user-visible."""
+        pend = self._pending
+        if pend is not None:
+            from .deferred import flush
+            self._buf = flush(pend)
+            self._pending = None
+        return self._buf
+
+    @_data.setter
+    def _data(self, value):
+        self._buf = value
+        self._pending = None
+
+    @classmethod
+    def _from_pending(cls, expr):
+        """Wrap a deferred Expr as a (no-grad) Tensor without running it."""
+        t = cls.__new__(cls)
+        t._buf = None
+        t._pending = expr
+        from .deferred import bind_owner
+        bind_owner(expr, t)
+        t.grad = None
+        t.stop_gradient = True
+        t._node = None
+        t._out_idx = 0
+        t.name = None
+        t.persistable = False
+        t._dist_attr = None
+        return t
+
+    def _meta(self):
+        """(shape, dtype) without materializing a deferred chain."""
+        pend = self._pending
+        if pend is not None and pend.value is None:
+            return pend.shape, pend.dtype
+        return self._data.shape, self._data.dtype
+
     # -- metadata ---------------------------------------------------------
     @property
     def data(self):
@@ -72,19 +116,20 @@ class Tensor:
 
     @property
     def shape(self):
-        return list(self._data.shape)
+        return list(self._meta()[0])
 
     @property
     def ndim(self):
-        return self._data.ndim
+        return len(self._meta()[0])
 
     @property
     def dtype(self):
-        return self._data.dtype
+        return self._meta()[1]
 
     @property
     def size(self):
-        return int(np.prod(self._data.shape)) if self._data.shape else 1
+        shape = self._meta()[0]
+        return int(np.prod(shape)) if shape else 1
 
     @property
     def place(self):

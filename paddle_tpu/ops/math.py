@@ -46,7 +46,8 @@ def _float_scalar(v):
 def _int_like(v):
     if isinstance(v, (bool, int, np.integer, np.bool_)):
         return True
-    dt = getattr(v._data if isinstance(v, Tensor) else v, "dtype", None)
+    # Tensor.dtype reads chain meta — never materializes a deferred chain
+    dt = getattr(v, "dtype", None)
     return _int_kind(dt)
 
 
@@ -58,8 +59,8 @@ def _ref_promote(x, y, divide_op=False):
     true division (:740) additionally casts to float32 whenever both
     operands are int-kind."""
     def dt(v):
-        return getattr(v._data if isinstance(v, Tensor) else v,
-                       "dtype", None)
+        # Tensor.dtype is meta-only (no deferred-chain materialization)
+        return getattr(v, "dtype", None)
 
     def cast32(v):
         if isinstance(v, Tensor):
@@ -83,25 +84,29 @@ def _ref_promote(x, y, divide_op=False):
     return x, y
 
 
-def _binop(fn, name):
+def _binop(fn, name, defer=False):
+    # defer=True: shape/dtype-preserving float elementwise — eligible
+    # for the deferred-chain dispatch (core/deferred.py); the runtime
+    # conditions (no grad, same shape+float dtype, no tracer) are
+    # checked per call in dispatch.apply
     divide_op = name == "divide"
 
     def op(x, y, name_=None):
         x, y = _ref_promote(x, y, divide_op=divide_op)
-        return apply(fn, x, y, name=name)
+        return apply(fn, x, y, name=name, defer=defer)
     op.__name__ = name
     return op
 
 
-add = _binop(jnp.add, "add")
-subtract = _binop(jnp.subtract, "subtract")
-multiply = _binop(jnp.multiply, "multiply")
-divide = _binop(jnp.divide, "divide")
+add = _binop(jnp.add, "add", defer=True)
+subtract = _binop(jnp.subtract, "subtract", defer=True)
+multiply = _binop(jnp.multiply, "multiply", defer=True)
+divide = _binop(jnp.divide, "divide", defer=True)
 floor_divide = _binop(jnp.floor_divide, "floor_divide")
 mod = _binop(jnp.mod, "mod")
 remainder = mod
-maximum = _binop(jnp.maximum, "maximum")
-minimum = _binop(jnp.minimum, "minimum")
+maximum = _binop(jnp.maximum, "maximum", defer=True)
+minimum = _binop(jnp.minimum, "minimum", defer=True)
 fmax = _binop(jnp.fmax, "fmax")
 fmin = _binop(jnp.fmin, "fmin")
 atan2 = _binop(jnp.arctan2, "atan2")
@@ -126,42 +131,42 @@ def float_power(x, y, name=None):
                  x, y, name="float_power")
 
 
-def _unop(fn, name):
+def _unop(fn, name, defer=False):
     def op(x, name_=None):
-        return apply(fn, x, name=name)
+        return apply(fn, x, name=name, defer=defer)
     op.__name__ = name
     return op
 
 
-sqrt = _unop(jnp.sqrt, "sqrt")
-rsqrt = _unop(jax.lax.rsqrt, "rsqrt")
-exp = _unop(jnp.exp, "exp")
-expm1 = _unop(jnp.expm1, "expm1")
-log = _unop(jnp.log, "log")
-log2 = _unop(jnp.log2, "log2")
-log10 = _unop(jnp.log10, "log10")
-log1p = _unop(jnp.log1p, "log1p")
-abs = _unop(jnp.abs, "abs")
-neg = _unop(jnp.negative, "neg")
-sign = _unop(jnp.sign, "sign")
-floor = _unop(jnp.floor, "floor")
-ceil = _unop(jnp.ceil, "ceil")
-round = _unop(jnp.round, "round")
-trunc = _unop(jnp.trunc, "trunc")
-sin = _unop(jnp.sin, "sin")
-cos = _unop(jnp.cos, "cos")
-tan = _unop(jnp.tan, "tan")
-asin = _unop(jnp.arcsin, "asin")
-acos = _unop(jnp.arccos, "acos")
-atan = _unop(jnp.arctan, "atan")
-sinh = _unop(jnp.sinh, "sinh")
-cosh = _unop(jnp.cosh, "cosh")
-tanh = _unop(jnp.tanh, "tanh")
+sqrt = _unop(jnp.sqrt, "sqrt", defer=True)
+rsqrt = _unop(jax.lax.rsqrt, "rsqrt", defer=True)
+exp = _unop(jnp.exp, "exp", defer=True)
+expm1 = _unop(jnp.expm1, "expm1", defer=True)
+log = _unop(jnp.log, "log", defer=True)
+log2 = _unop(jnp.log2, "log2", defer=True)
+log10 = _unop(jnp.log10, "log10", defer=True)
+log1p = _unop(jnp.log1p, "log1p", defer=True)
+abs = _unop(jnp.abs, "abs", defer=True)
+neg = _unop(jnp.negative, "neg", defer=True)
+sign = _unop(jnp.sign, "sign", defer=True)
+floor = _unop(jnp.floor, "floor", defer=True)
+ceil = _unop(jnp.ceil, "ceil", defer=True)
+round = _unop(jnp.round, "round", defer=True)
+trunc = _unop(jnp.trunc, "trunc", defer=True)
+sin = _unop(jnp.sin, "sin", defer=True)
+cos = _unop(jnp.cos, "cos", defer=True)
+tan = _unop(jnp.tan, "tan", defer=True)
+asin = _unop(jnp.arcsin, "asin", defer=True)
+acos = _unop(jnp.arccos, "acos", defer=True)
+atan = _unop(jnp.arctan, "atan", defer=True)
+sinh = _unop(jnp.sinh, "sinh", defer=True)
+cosh = _unop(jnp.cosh, "cosh", defer=True)
+tanh = _unop(jnp.tanh, "tanh", defer=True)
 asinh = _unop(jnp.arcsinh, "asinh")
 acosh = _unop(jnp.arccosh, "acosh")
 atanh = _unop(jnp.arctanh, "atanh")
-reciprocal = _unop(jnp.reciprocal, "reciprocal")
-square = _unop(jnp.square, "square")
+reciprocal = _unop(jnp.reciprocal, "reciprocal", defer=True)
+square = _unop(jnp.square, "square", defer=True)
 erf = _unop(jax.scipy.special.erf, "erf")
 erfinv = _unop(jax.scipy.special.erfinv, "erfinv")
 isnan = _unop(jnp.isnan, "isnan")
@@ -176,7 +181,7 @@ imag = _unop(jnp.imag, "imag")
 digamma = _unop(jax.scipy.special.digamma, "digamma")
 lgamma = _unop(jax.scipy.special.gammaln, "lgamma")
 gammaln = lgamma
-sigmoid = _unop(jax.nn.sigmoid, "sigmoid")
+sigmoid = _unop(jax.nn.sigmoid, "sigmoid", defer=True)
 i0 = _unop(jax.scipy.special.i0, "i0")
 i1 = _unop(jax.scipy.special.i1, "i1")
 

@@ -1,0 +1,177 @@
+"""Deferred elementwise chains (core/deferred.py): semantics must be
+IDENTICAL to per-op eager dispatch — laziness is never user-visible.
+
+Reference comparator: the async dygraph executor (SURVEY §3.1) hides
+per-op enqueue latency; here consecutive no-grad elementwise ops batch
+into one jitted dispatch and any _data read flushes.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import deferred
+
+
+def _rand(*s):
+    return np.random.default_rng(0).standard_normal(s).astype("float32")
+
+
+def test_chain_defers_and_matches_eager():
+    x = paddle.to_tensor(_rand(16, 16))
+    y = x
+    for _ in range(5):
+        y = (y * 1.01 + 0.5).tanh()
+    assert y._pending is not None
+    paddle.set_flags({"FLAGS_eager_defer": False})
+    try:
+        z = x
+        for _ in range(5):
+            z = (z * 1.01 + 0.5).tanh()
+        assert z._pending is None
+        np.testing.assert_allclose(y.numpy(), z.numpy(), rtol=1e-6,
+                                   atol=1e-7)
+    finally:
+        paddle.set_flags({"FLAGS_eager_defer": True})
+
+
+def test_meta_access_does_not_flush():
+    x = paddle.to_tensor(_rand(4, 8))
+    y = x * 2.0
+    assert y._pending is not None
+    assert y.shape == [4, 8]
+    assert y.ndim == 2
+    assert y.size == 32
+    assert "float32" in str(y.dtype)
+    assert y._pending is not None  # still pending after meta reads
+    np.testing.assert_allclose(y.numpy(), x.numpy() * 2.0)
+
+
+def test_dag_sharing_consistent_and_stamped():
+    x = paddle.to_tensor(_rand(8))
+    base = x * 3.0
+    a = base + 1.0
+    b = base - 1.0
+    va = a.numpy()  # flushes a's chain; base (live Tensor) is stamped
+    assert base._pending.value is not None, \
+        "shared live subexpression must be stamped at flush"
+    vb = b.numpy()
+    np.testing.assert_allclose(va - vb, 2.0 * np.ones(8), rtol=1e-6)
+
+
+def test_loop_varying_scalar_no_recompile():
+    """Scalar constants ride as jit arguments: a loop-varying scalar
+    must not create one compile cache entry per value."""
+    x = paddle.to_tensor(_rand(8, 8))
+    (x * 0.123).numpy()  # settle the structure's cache entry
+    before = len(deferred._JIT_CACHE)
+    for step in range(1, 40):
+        (x * (1.0 / step)).numpy()
+    assert len(deferred._JIT_CACHE) - before <= 1
+    np.testing.assert_allclose((x * (1.0 / 39)).numpy(),
+                               x.numpy() * np.float32(1.0 / 39),
+                               rtol=1e-6)
+
+
+def test_self_square_dedup_cap():
+    """y = y * y shares the whole prefix as both args: the unique-node
+    cap must allow ~CAP ops, not log2(CAP)."""
+    x = paddle.to_tensor(np.full((4,), 1.0000001, "float32"))
+    y = x
+    for _ in range(20):
+        y = y * y  # additive estimate doubles; unique count is 21
+    assert y._pending is not None, "dedup cap flushed a 21-node chain"
+    base = float(np.float32(1.0000001))  # the f32-rounded operand
+    ref = np.full((4,), base, "float64") ** (2 ** 20)
+    np.testing.assert_allclose(y.numpy(), ref.astype("float32"),
+                               rtol=1e-4)
+
+
+def test_nondeferrable_consumer_flushes():
+    x = paddle.to_tensor(_rand(4, 4))
+    y = x * 2.0
+    out = paddle.matmul(y, paddle.to_tensor(_rand(4, 4)))
+    assert out is not None  # matmul consumed the flushed value
+    np.testing.assert_allclose(
+        out.numpy(),
+        (x.numpy() * 2.0) @ _rand(4, 4), rtol=1e-5)
+
+
+def test_grad_path_never_defers():
+    g = paddle.to_tensor(_rand(3, 3), stop_gradient=False)
+    h = g * 2.0
+    assert h._pending is None
+    h.sum().backward()
+    np.testing.assert_allclose(g.grad.numpy(), 2.0 * np.ones((3, 3)))
+
+
+def test_int_and_broadcast_fall_back():
+    i = paddle.to_tensor(np.arange(6, dtype="int32"))
+    assert (i * 2)._pending is None  # int dtype: no deferral
+    a = paddle.to_tensor(_rand(3, 1))
+    b = paddle.to_tensor(_rand(3, 4))
+    c = a + b  # broadcast: no deferral
+    assert c._pending is None
+    np.testing.assert_allclose(c.numpy(), a.numpy() + b.numpy())
+
+
+def test_inplace_on_pending_receiver():
+    x = paddle.to_tensor(_rand(5))
+    y = x * 2.0
+    y.add_(paddle.to_tensor(np.ones(5, "float32")))
+    np.testing.assert_allclose(y.numpy(), x.numpy() * 2.0 + 1.0,
+                               rtol=1e-6)
+
+
+def test_cap_bounds_chain_and_long_chain_correct():
+    x = paddle.to_tensor(np.full((4,), 1.0, "float32"))
+    y = x
+    for _ in range(deferred.DEFER_CAP * 3):
+        y = y * 1.001
+    np.testing.assert_allclose(
+        y.numpy(), np.float32(1.001) ** (deferred.DEFER_CAP * 3),
+        rtol=1e-3)
+
+
+def test_under_jit_tracing_bails():
+    import jax
+
+    def f(arr):
+        t = paddle.to_tensor(arr)
+        return (t * 2.0 + 1.0)._data
+
+    out = jax.jit(f)(np.ones((3,), np.float32))
+    np.testing.assert_allclose(np.asarray(out), 3.0 * np.ones(3))
+
+
+def test_fuzz_random_chains_match_eager():
+    """Randomized op sequences over the deferrable surface must match
+    flag-off execution exactly (same op sequence, jit vs eager)."""
+    uns = [lambda t: t.tanh(), lambda t: t.sigmoid(), lambda t: t.exp(),
+           lambda t: t.abs(), lambda t: t * 0.5, lambda t: t + 0.25,
+           lambda t: t - 0.1, lambda t: t.square()]
+    rng = np.random.default_rng(7)
+    for trial in range(10):
+        arr = rng.standard_normal((6, 6)).astype("float32") * 0.3
+        ops = [uns[i] for i in rng.integers(0, len(uns), 12)]
+        results = []
+        for flag in (True, False):
+            paddle.set_flags({"FLAGS_eager_defer": flag})
+            try:
+                t = paddle.to_tensor(arr)
+                for op in ops:
+                    t = op(t)
+                results.append(t.numpy())
+            finally:
+                paddle.set_flags({"FLAGS_eager_defer": True})
+        np.testing.assert_allclose(results[0], results[1], rtol=1e-6,
+                                   atol=1e-7)
+
+
+def test_sum_of_pending_matches():
+    x = paddle.to_tensor(_rand(32, 32))
+    y = (x * 1.5 + 2.0).cos()
+    s = float(y.sum())
+    ref = float(np.cos(x.numpy() * np.float32(1.5) + np.float32(2.0))
+                .sum())
+    assert abs(s - ref) < 1e-2
