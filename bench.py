@@ -287,10 +287,9 @@ def _defer_depth_curve(n=256):
             curve[str(cap)] = round(2 * n / (time.perf_counter() - t0), 1)
     finally:
         deferred.DEFER_CAP = old_cap
-    import paddle_tpu as _p
-    prior = _p.get_flags("FLAGS_eager_defer")["FLAGS_eager_defer"]
+    prior = paddle.get_flags("FLAGS_eager_defer")["FLAGS_eager_defer"]
     try:
-        _p.set_flags({"FLAGS_eager_defer": False})
+        paddle.set_flags({"FLAGS_eager_defer": False})
         y = x
         for _ in range(n):
             y = y * 1.0001 + 0.0001
@@ -302,7 +301,7 @@ def _defer_depth_curve(n=256):
         _sync(y.sum())
         curve["off"] = round(2 * n / (time.perf_counter() - t0), 1)
     finally:
-        _p.set_flags({"FLAGS_eager_defer": prior})
+        paddle.set_flags({"FLAGS_eager_defer": prior})
     return curve
 
 

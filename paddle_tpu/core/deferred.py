@@ -127,10 +127,16 @@ def try_defer(fn, args, kwargs, recording):
                 return None
             if not jnp.issubdtype(dt, jnp.floating):
                 return None
-            if shape is None:
-                shape, dtype = s, dt
-            elif s != shape or dt != dtype:
-                return None  # no implicit broadcast/promotion in chains
+            if dtype is None:
+                dtype = dt
+            elif dt != dtype:
+                return None  # no implicit promotion in chains
+            if s == ():
+                pass  # same-dtype 0-d tensor: broadcast-neutral leaf
+            elif shape is None:
+                shape = s
+            elif s != shape:
+                return None  # no implicit (shape-changing) broadcast
         elif isinstance(a, (bool, int, float)) and not isinstance(
                 a, np.generic):
             argspec.append(("const", float(a)))
@@ -138,8 +144,10 @@ def try_defer(fn, args, kwargs, recording):
             argspec.append(("const", float(a)))
         else:
             return None
-    if shape is None:
+    if dtype is None:
         return None
+    if shape is None:
+        shape = ()  # every arg 0-d: the result is 0-d
     if n_nodes > DEFER_CAP:
         # the additive count double-counts shared nodes (y = y * y);
         # pay the exact traversal — ONE shared visited-set across all
@@ -238,7 +246,9 @@ _CONST_MEMO: dict = {}
 
 
 def _const_arr(c, dtype):
-    key = (c, str(dtype))
+    # repr distinguishes -0.0 from 0.0 (they hash equal as floats, but
+    # x / -0.0 must stay -inf with the memo exactly as without it)
+    key = (repr(c), str(dtype))
     a = _CONST_MEMO.get(key)
     if a is None:
         if len(_CONST_MEMO) > 4096:

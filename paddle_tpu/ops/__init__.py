@@ -71,7 +71,10 @@ def _inplace_from(t: Tensor, out: Tensor, *, cast_result: bool = False,
             out._node is not None:
         raise RuntimeError(
             "in-place operation on a leaf tensor that requires grad")
-    if out._data.dtype != t._data.dtype and not allow_retype:
+    if out.dtype != t.dtype and not allow_retype:
+        # (Tensor.dtype reads chain meta — an inplace rebind must not
+        # materialize a deferred elementwise chain, or inplace loops
+        # would pay one dispatch per op)
         if cast_result:
             # comparison/logical family: the bool result is written back
             # into the receiver's existing dtype (reference logic.py:627)
@@ -85,7 +88,14 @@ def _inplace_from(t: Tensor, out: Tensor, *, cast_result: bool = False,
             raise TypeError(
                 f"in-place operation would change dtype from "
                 f"{t._data.dtype} to {out._data.dtype}; cast explicitly")
-    t._data = out._data
+    # adopt out's payload WITHOUT materializing a deferred chain: an
+    # inplace loop (x.add_(y) per step) then batches like its
+    # out-of-place form, flushing only on a real read
+    t._buf = out._buf
+    t._pending = out._pending
+    if t._pending is not None:
+        from ..core.deferred import bind_owner
+        bind_owner(t._pending, t)
     t._node = out._node
     t._out_idx = out._out_idx
     t.stop_gradient = out.stop_gradient and t.stop_gradient

@@ -175,3 +175,23 @@ def test_sum_of_pending_matches():
     ref = float(np.cos(x.numpy() * np.float32(1.5) + np.float32(2.0))
                 .sum())
     assert abs(s - ref) < 1e-2
+
+
+def test_inplace_chain_defers():
+    """x.add_(...) in a loop batches like its out-of-place form: the
+    rebind adopts the pending chain instead of flushing it."""
+    x = paddle.to_tensor(np.zeros((8,), "float32"))
+    for _ in range(10):
+        x.add_(paddle.to_tensor(np.float32(0.5)))
+        x.multiply_(paddle.to_tensor(np.float32(1.0)))
+    assert x._pending is not None, "inplace rebind flushed the chain"
+    np.testing.assert_allclose(x.numpy(), np.full(8, 5.0), rtol=1e-6)
+
+
+def test_signed_zero_consts_distinct():
+    """-0.0 and +0.0 hash equal as floats; the const memo must keep
+    them apart (x / -0.0 is -inf, x / 0.0 is +inf)."""
+    x = paddle.to_tensor(np.array([3.0], "float32"))
+    pos = (x / 0.0).numpy()
+    neg = (x / -0.0).numpy()
+    assert np.isposinf(pos).all() and np.isneginf(neg).all(), (pos, neg)

@@ -80,3 +80,80 @@ def check_grad(op_fn, inputs, grad_inputs=None, atol=5e-3, rtol=5e-3,
         numeric = numeric_grad(fn, inputs, i, delta=delta)
         np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol,
                                    err_msg=f"grad mismatch on input {i}")
+
+
+def _round_bf16(a):
+    """f32 array -> the exact f64 value of its bf16 rounding."""
+    import ml_dtypes
+    a = np.asarray(a)
+    if np.issubdtype(a.dtype, np.floating):
+        return a.astype(ml_dtypes.bfloat16).astype(np.float64)
+    return a
+
+
+def check_output_bf16(op_fn, np_fn, inputs, atol=8e-3, rtol=8e-3,
+                      kwargs=None, out_dtype="bfloat16"):
+    """bf16 tier of check_output (reference bf16 OpTest discipline,
+    test/legacy_test/op_test.py:418 convert_float_to_uint16): float
+    inputs are ROUNDED to bf16 first, the oracle runs in f64 on the
+    rounded values, and the op's bf16 output must match within bf16-
+    scale tolerance (eps = 2^-8 ~ 3.9e-3). Pins both the math AND that
+    accumulation does not degrade to naive bf16 (a sequential-bf16 sum
+    of 64k uniforms would miss by ~1e-2, 100x the tolerance)."""
+    kwargs = kwargs or {}
+    rounded = [_round_bf16(a) for a in inputs]
+    tensors = []
+    for a in inputs:
+        t = paddle.to_tensor(np.asarray(a))
+        if paddle.core.dtype.is_floating_point(t.dtype):
+            t = t.astype("bfloat16")
+        tensors.append(t)
+    out = op_fn(*tensors, **kwargs)
+    ref = np_fn(*rounded, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    assert len(outs) == len(refs)
+    for o, r in zip(outs, refs):
+        r = np.asarray(r)
+        if np.issubdtype(r.dtype, np.floating):
+            if out_dtype is not None:
+                assert out_dtype in str(o.dtype), \
+                    f"bf16 op returned {o.dtype}, expected {out_dtype}"
+            got = np.asarray(o.numpy()).astype(np.float64)
+            np.testing.assert_allclose(got, r, atol=atol, rtol=rtol)
+        else:
+            np.testing.assert_array_equal(np.asarray(o.numpy()), r)
+    return outs
+
+
+def check_grad_bf16(op_fn, inputs, atol=6e-2, rtol=6e-2, delta=1e-2,
+                    kwargs=None):
+    """bf16 tape gradients vs f64 finite differences on the bf16-rounded
+    inputs. Tolerances are bf16-scaled: one rounding per op in fwd AND
+    bwd."""
+    kwargs = kwargs or {}
+    rounded = [_round_bf16(a) for a in inputs]
+
+    fd_fn = lambda *ts: op_fn(*ts, **kwargs)  # noqa: E731
+
+    tensors = [paddle.to_tensor(np.asarray(a, np.float32))
+               .astype("bfloat16") for a in inputs]
+    for t in tensors:
+        t.stop_gradient = False
+    out = op_fn(*tensors, **kwargs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    total = None
+    for o in outs:
+        if paddle.core.dtype.is_floating_point(o.dtype):
+            s = o.astype("float32").sum()
+            total = s if total is None else total + s
+    total.backward()
+    for i, t in enumerate(tensors):
+        assert t.grad is not None, f"input {i} got no gradient"
+        assert "bfloat16" in str(t.grad.dtype), \
+            f"bf16 grad dtype {t.grad.dtype}"
+        analytic = t.grad.numpy().astype(np.float64)
+        numeric = numeric_grad(fd_fn, rounded, i, delta=delta)
+        scale = max(1.0, float(np.max(np.abs(numeric))))
+        np.testing.assert_allclose(analytic, numeric, atol=atol * scale,
+                                   rtol=rtol)
