@@ -548,9 +548,31 @@ def _tpu_rung_specs():
 
     fp8_cfg = GPTConfig.gpt2_medium()
     fp8_cfg.use_fp8 = True
+
+    def _head():
+        # loss-path autotune: the ce_fusion_ab rung (earlier in the
+        # watcher ORDER) measured fused-vs-dense CE on THIS chip this
+        # window; the headline rides whichever won. CPU is FLOP-bound
+        # (fused pays +1 head-matmul of bwd recompute, measured 0.91x
+        # there); the TPU case is HBM-bound where skipping the [N,V]
+        # f32 logits materialization is the win — decided by data.
+        cfg = GPTConfig.gpt2_medium()
+        try:
+            with open(_cache_path()) as f:
+                ab = json.load(f).get("ce_fusion_ab", {})
+            sp = ab.get("fused_speedup")
+            if sp is not None and sp < 1.0 and \
+                    _norm_device(ab.get("device")) != "cpu":
+                cfg.fused_head_ce = False
+        except (OSError, ValueError):
+            pass
+        res = bench_gpt_train(cfg, 8, 1024, 20, "gpt2_345m")
+        if isinstance(res, dict):
+            res["fused_head_ce"] = cfg.fused_head_ce
+        return res
+
     return [
-        ("head", lambda: bench_gpt_train(GPTConfig.gpt2_medium(), 8, 1024,
-                                         20, "gpt2_345m")),
+        ("head", _head),
         ("gpt_345m_fp8_train",
          lambda: bench_gpt_train(fp8_cfg, 8, 1024, 10, "gpt2_345m_fp8")),
         ("gpt_770m_train",
