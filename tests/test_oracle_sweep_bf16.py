@@ -266,3 +266,65 @@ def test_adamw_step_bf16_params_f32_master():
     mv = float(np.asarray(st["master"]).mean())
     np.testing.assert_allclose(mv, 1.0 - 10 * 1e-4, rtol=0.3), \
         "master did not accumulate ~lr*steps of Adam updates"
+
+
+def test_conv2d_bf16():
+    """conv2d at bf16 (the ViT-rung path): f32 accumulation expected —
+    k=3x3x16 bf16 accumulation would drift well past 1 bf16 ulp."""
+    x = _any(2, 16, 12, 12) * 0.3
+    w = _any(8, 16, 3, 3) * 0.2
+    rx, rw = _round_bf16(x), _round_bf16(w)
+    import torch
+    import torch.nn.functional as TF
+    ref = TF.conv2d(torch.from_numpy(rx), torch.from_numpy(rw),
+                    padding=1).numpy()
+    got = F.conv2d(paddle.to_tensor(x).astype("bfloat16"),
+                   paddle.to_tensor(w).astype("bfloat16"), padding=1)
+    assert "bfloat16" in str(got.dtype)
+    np.testing.assert_allclose(got.astype("float32").numpy(), ref,
+                               atol=3e-2, rtol=2e-2)
+
+
+def test_batch_norm_eval_and_pool_bf16():
+    x = _any(2, 8, 10, 10)
+    rm = _any(8) * 0.1
+    rv = _pos(8)
+    rx = _round_bf16(x)
+    ref = ((rx - _round_bf16(rm)[None, :, None, None])
+           / np.sqrt(_round_bf16(rv)[None, :, None, None] + 1e-5))
+    got = F.batch_norm(paddle.to_tensor(x).astype("bfloat16"),
+                       paddle.to_tensor(rm).astype("bfloat16"),
+                       paddle.to_tensor(rv).astype("bfloat16"),
+                       training=False)
+    np.testing.assert_allclose(got.astype("float32").numpy(), ref,
+                               atol=2e-2, rtol=2e-2)
+    gp = F.avg_pool2d(paddle.to_tensor(x).astype("bfloat16"), 2)
+    ref_p = rx.reshape(2, 8, 5, 2, 5, 2).mean((3, 5))
+    np.testing.assert_allclose(gp.astype("float32").numpy(), ref_p,
+                               atol=1e-2, rtol=1e-2)
+
+
+def test_sdpa_bf16_vs_f64_oracle():
+    """scaled_dot_product_attention at bf16 (the train path's hot op)
+    against a f64 oracle on bf16-rounded inputs."""
+    rng = np.random.default_rng(9)
+    q = (rng.standard_normal((1, 16, 2, 8)) * 0.5).astype("float32")
+    k = (rng.standard_normal((1, 16, 2, 8)) * 0.5).astype("float32")
+    v = (rng.standard_normal((1, 16, 2, 8)) * 0.5).astype("float32")
+    rq, rk, rv = (_round_bf16(a) for a in (q, k, v))
+    # dense causal reference in f64
+    scale = 1 / np.sqrt(8)
+    ref = np.empty_like(rq)
+    for h in range(2):
+        s = rq[0, :, h] @ rk[0, :, h].T * scale
+        mask = np.tril(np.ones((16, 16), bool))
+        s = np.where(mask, s, -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref[0, :, h] = p @ rv[0, :, h]
+    got = F.scaled_dot_product_attention(
+        paddle.to_tensor(q).astype("bfloat16"),
+        paddle.to_tensor(k).astype("bfloat16"),
+        paddle.to_tensor(v).astype("bfloat16"), is_causal=True)
+    np.testing.assert_allclose(got.astype("float32").numpy(), ref,
+                               atol=3e-2, rtol=3e-2)
