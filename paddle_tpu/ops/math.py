@@ -221,7 +221,10 @@ def softplus_math(x, beta=1.0, threshold=20.0):
 def clip(x, min=None, max=None, name=None):
     lo = unwrap(min)
     hi = unwrap(max)
-    return apply(lambda a: jnp.clip(a, lo, hi), x, name="clip")
+    # scalar-bound clip defers (closure floats hash into the chain key);
+    # tensor bounds are arrays in cells -> try_defer rejects, eager path
+    return apply(lambda a: jnp.clip(a, lo, hi), x, name="clip",
+                 defer=not (hasattr(lo, "shape") or hasattr(hi, "shape")))
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
@@ -230,7 +233,8 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
     def _scale(a):
         out = a * s + b if bias_after_scale else (a + b) * s
         return out
-    return apply(_scale, x, name="scale")
+    return apply(_scale, x, name="scale",
+                 defer=not (hasattr(s, "shape") or hasattr(b, "shape")))
 
 
 def add_n(inputs, name=None):
