@@ -65,9 +65,13 @@ class PagedKVCache:
         # block 0 is reserved as the null block so fresh table entries are
         # valid indices; the length mask hides its contents
         self._free = list(range(num_blocks - 1, 0, -1))
-        self.block_tables = jnp.zeros((max_batch, max_blocks_per_seq),
-                                      jnp.int32)
-        self.seq_lens = jnp.zeros((max_batch,), jnp.int32)
+        # HOST-side metadata (numpy, not device arrays): block tables and
+        # lengths mutate every step from python, and on a remote-attached
+        # chip every .at[].set / device fetch is a transport round trip.
+        # They upload as (tiny) jit-call arguments instead.
+        self.block_tables = np.zeros((max_batch, max_blocks_per_seq),
+                                     np.int32)
+        self.seq_lens = np.zeros((max_batch,), np.int32)
         self._slot_blocks = [[] for _ in range(max_batch)]
         self._live = [False] * max_batch
 
@@ -97,8 +101,8 @@ class PagedKVCache:
         self._live[slot] = True
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
         row[:need] = blocks
-        self.block_tables = self.block_tables.at[slot].set(jnp.asarray(row))
-        self.seq_lens = self.seq_lens.at[slot].set(0)
+        self.block_tables[slot] = row
+        self.seq_lens[slot] = 0
         return slot
 
     def ensure_capacity(self, slot, new_len):
@@ -110,7 +114,7 @@ class PagedKVCache:
             if not self._free or have >= self.max_blocks_per_seq:
                 return False
             b = self._free.pop()
-            self.block_tables = self.block_tables.at[slot, have].set(b)
+            self.block_tables[slot, have] = b
             self._slot_blocks[slot].append(b)
             have += 1
         return True
@@ -119,9 +123,8 @@ class PagedKVCache:
         self._free.extend(reversed(self._slot_blocks[slot]))
         self._slot_blocks[slot] = []
         self._live[slot] = False
-        self.block_tables = self.block_tables.at[slot].set(
-            jnp.zeros((self.max_blocks_per_seq,), jnp.int32))
-        self.seq_lens = self.seq_lens.at[slot].set(0)
+        self.block_tables[slot] = 0
+        self.seq_lens[slot] = 0
 
 
 # ---------------------------------------------------------------------------
@@ -316,7 +319,8 @@ class ContinuousBatchingEngine:
         for slot in self.running:
             active_np[slot] = True
         # grow tables where the next token crosses a block boundary
-        lens = np.asarray(self.cache.seq_lens)
+        # (seq_lens is host metadata: no device fetch here)
+        lens = self.cache.seq_lens
         for slot in list(self.running):
             if not self.cache.ensure_capacity(slot, int(lens[slot]) + 1):
                 # pool exhausted: finish the victim early
@@ -326,8 +330,8 @@ class ContinuousBatchingEngine:
         if not self.running:
             return []
         toks = self.model.paged_decode_step(
-            self.cache, jnp.asarray(self._last_tok),
-            jnp.asarray(active_np), temperature=self.temperature)
+            self.cache, np.asarray(self._last_tok), active_np,
+            temperature=self.temperature)
         toks_np = np.asarray(toks)
         out = []
         for slot, req in list(self.running.items()):

@@ -347,7 +347,7 @@ class Llama(nn.Layer):
         for i in range(cache.num_layers):
             cache.k_pools[i], cache.v_pools[i] = paged_prefill_write(
                 cache.k_pools[i], cache.v_pools[i], row, ks[i], vs[i])
-        cache.seq_lens = cache.seq_lens.at[slot].set(s)
+        cache.seq_lens[slot] = s
         return int(tok)
 
     def paged_decode_step(self, cache, last_tokens, active,
@@ -415,13 +415,15 @@ class Llama(nn.Layer):
         toks, new_k, new_v = self._paged_decode_jit(
             arrs, jnp.asarray(last_tokens, jnp.int32),
             cache.k_pools, cache.v_pools, cache.block_tables,
-            cache.seq_lens, jnp.asarray(active), next_key(),
+            jnp.asarray(cache.seq_lens), jnp.asarray(active),
+            next_key(),
             jnp.float32(temperature))
         self._param_rebind()(arrs)
         cache.k_pools = list(new_k)
         cache.v_pools = list(new_v)
-        cache.seq_lens = jnp.where(jnp.asarray(active),
-                                   cache.seq_lens + 1, cache.seq_lens)
+        act = np.asarray(active)
+        cache.seq_lens = np.where(act, cache.seq_lens + 1,
+                                  cache.seq_lens).astype(np.int32)
         return toks
 
     def forward_hidden(self, input_ids, kv_sink=None):
