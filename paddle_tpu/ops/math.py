@@ -118,9 +118,17 @@ gcd = _binop(jnp.gcd, "gcd")
 lcm = _binop(jnp.lcm, "lcm")
 
 
+def _pow_fn(a, b):
+    return jnp.power(a, b)
+
+
 def pow(x, y, name=None):
     x, y = _ref_promote(x, y)
-    return apply(jnp.power, x, y, name="pow")
+    # module-level wrapper: jnp.power itself carries an unhashable
+    # closure cell, which would reject the op from the deferred-chain /
+    # lazy-backward caches; the wrapper keys cleanly (jnp by module
+    # identity)
+    return apply(_pow_fn, x, y, name="pow", defer=True)
 
 
 def float_power(x, y, name=None):

@@ -195,3 +195,24 @@ def test_signed_zero_consts_distinct():
     pos = (x / 0.0).numpy()
     neg = (x / -0.0).numpy()
     assert np.isposinf(pos).all() and np.isneginf(neg).all(), (pos, neg)
+
+
+def test_pow_and_autocast_interplay():
+    x = paddle.to_tensor(np.abs(_rand(4, 4)) + 0.5)
+    y = x ** 2
+    assert y._pending is not None
+    np.testing.assert_allclose(y.numpy(), x.numpy() ** 2, rtol=1e-6)
+    # under amp auto_cast the dispatch pre-hook may swap args; results
+    # must still match the flag-off path exactly
+    from paddle_tpu import amp
+    with amp.auto_cast(enable=True, dtype="bfloat16"):
+        a = paddle.to_tensor(_rand(8, 8)).astype("bfloat16")
+        r1 = ((a * 1.5 + 0.25).tanh()).astype("float32").numpy()
+    paddle.set_flags({"FLAGS_eager_defer": False})
+    try:
+        with amp.auto_cast(enable=True, dtype="bfloat16"):
+            a = paddle.to_tensor(_rand(8, 8)).astype("bfloat16")
+            r2 = ((a * 1.5 + 0.25).tanh()).astype("float32").numpy()
+    finally:
+        paddle.set_flags({"FLAGS_eager_defer": True})
+    np.testing.assert_allclose(r1, r2, rtol=0, atol=0)
