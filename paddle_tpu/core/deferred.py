@@ -47,9 +47,10 @@ class Expr:
     """One deferred op node: fn applied to (leaf | node | const) args."""
 
     __slots__ = ("fn", "argspec", "kwargs", "shape", "dtype", "n_nodes",
-                 "value", "owner", "__weakref__")
+                 "value", "owner", "node_key", "__weakref__")
 
-    def __init__(self, fn, argspec, kwargs, shape, dtype, n_nodes):
+    def __init__(self, fn, argspec, kwargs, shape, dtype, n_nodes,
+                 node_key):
         self.fn = fn
         self.argspec = argspec  # (("leaf", arr)|("node", Expr)|("const", v), ...)
         self.kwargs = kwargs
@@ -58,6 +59,7 @@ class Expr:
         self.n_nodes = n_nodes  # additive upper bound (see try_defer)
         self.value = None  # stamped after a flush
         self.owner = None  # weakref to the Tensor holding this node
+        self.node_key = node_key  # (fn_key, frozen kwargs), built once
 
 
 class _DtypeOnly:
@@ -157,11 +159,12 @@ def try_defer(fn, args, kwargs, recording):
         if n_nodes > DEFER_CAP:
             return None
     try:
-        fk = _fn_key(fn)
-        hash((fk, _freeze(kwargs)))
+        node_key = (_fn_key(fn), _freeze(kwargs))
+        hash(node_key)
     except (TypeError, ValueError):
         return None
-    return Expr(fn, tuple(argspec), kwargs, shape, dtype, n_nodes)
+    return Expr(fn, tuple(argspec), kwargs, shape, dtype, n_nodes,
+                node_key)
 
 
 def _linearize(root):
@@ -169,7 +172,7 @@ def _linearize(root):
     id; consts collected as jit ARGUMENTS (values stay out of the cache
     key, so loop-varying scalars don't recompile)."""
     nodes, leaves, consts = [], [], []
-    node_ix, leaf_ix = {}, {}
+    node_ix, leaf_ix, const_ix = {}, {}, {}
 
     def visit(e):
         if id(e) in node_ix:
@@ -189,8 +192,14 @@ def _linearize(root):
                     leaves.append(v)
                 spec.append(("leaf", ix))
             else:
-                consts.append(v)
-                spec.append(("const", len(consts) - 1))
+                # dedupe by value (repr keeps -0.0 distinct): a loop
+                # reusing two scalars must pass 2 jit args, not one per
+                # occurrence — jit call overhead scales with arg count
+                ci = const_ix.get(repr(v))
+                if ci is None:
+                    ci = const_ix[repr(v)] = len(consts)
+                    consts.append(v)
+                spec.append(("const", ci))
         nodes.append((e, tuple(spec)))
         node_ix[id(e)] = len(nodes) - 1
         return node_ix[id(e)]
@@ -205,13 +214,11 @@ def flush(root):
     never re-executed); returns the root's value."""
     if root.value is not None:
         return root.value
-    from .dispatch import _fn_key, _freeze
     nodes, leaves, consts = _linearize(root)
     out_ixs = tuple(i for i, (e, _) in enumerate(nodes)
                     if e is root or (e.owner is not None
                                      and e.owner() is not None))
-    key = (tuple((_fn_key(e.fn), spec, _freeze(e.kwargs))
-                 for e, spec in nodes), out_ixs)
+    key = (tuple((e.node_key, spec) for e, spec in nodes), out_ixs)
     jf = _JIT_CACHE.get(key)
     if jf is None:
         if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
