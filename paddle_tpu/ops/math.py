@@ -147,7 +147,13 @@ def _unop(fn, name, defer=False):
 
 
 sqrt = _unop(jnp.sqrt, "sqrt", defer=True)
-rsqrt = _unop(jax.lax.rsqrt, "rsqrt", defer=True)
+def _rsqrt_fn(a):
+    return jax.lax.rsqrt(a)
+
+
+# jax.lax.rsqrt (like jnp.power) carries closure state _fn_key rejects;
+# the module wrapper keys cleanly so rsqrt can join deferred chains
+rsqrt = _unop(_rsqrt_fn, "rsqrt", defer=True)
 exp = _unop(jnp.exp, "exp", defer=True)
 expm1 = _unop(jnp.expm1, "expm1", defer=True)
 log = _unop(jnp.log, "log", defer=True)
