@@ -18,20 +18,20 @@ __all__ = [
 
 
 def relu(x, name=None):
-    return apply(jax.nn.relu, x, name="relu")
+    return apply(jax.nn.relu, x, name="relu", defer=True)
 
 
 def relu6(x, name=None):
-    return apply(jax.nn.relu6, x, name="relu6")
+    return apply(jax.nn.relu6, x, name="relu6", defer=True)
 
 
 def gelu(x, approximate=False, name=None):
     return apply(lambda a: jax.nn.gelu(a, approximate=approximate), x,
-                 name="gelu")
+                 name="gelu", defer=True)
 
 
 def silu(x, name=None):
-    return apply(jax.nn.silu, x, name="silu")
+    return apply(jax.nn.silu, x, name="silu", defer=True)
 
 
 def swish(x, name=None):
@@ -39,15 +39,15 @@ def swish(x, name=None):
 
 
 def sigmoid(x, name=None):
-    return apply(jax.nn.sigmoid, x, name="sigmoid")
+    return apply(jax.nn.sigmoid, x, name="sigmoid", defer=True)
 
 
 def log_sigmoid(x, name=None):
-    return apply(jax.nn.log_sigmoid, x, name="log_sigmoid")
+    return apply(jax.nn.log_sigmoid, x, name="log_sigmoid", defer=True)
 
 
 def tanh(x, name=None):
-    return apply(jnp.tanh, x, name="tanh")
+    return apply(jnp.tanh, x, name="tanh", defer=True)
 
 
 def softmax(x, axis=-1, dtype=None, name=None):
@@ -56,7 +56,7 @@ def softmax(x, axis=-1, dtype=None, name=None):
             from ...core.dtype import convert_dtype
             a = a.astype(convert_dtype(dtype))
         return jax.nn.softmax(a, axis=axis)
-    return apply(_softmax, x, name="softmax")
+    return apply(_softmax, x, name="softmax", defer=dtype is None)
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):
@@ -65,16 +65,16 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
             from ...core.dtype import convert_dtype
             a = a.astype(convert_dtype(dtype))
         return jax.nn.log_softmax(a, axis=axis)
-    return apply(_log_softmax, x, name="log_softmax")
+    return apply(_log_softmax, x, name="log_softmax", defer=dtype is None)
 
 
 def leaky_relu(x, negative_slope=0.01, name=None):
     return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), x,
-                 name="leaky_relu")
+                 name="leaky_relu", defer=True)
 
 
 def elu(x, alpha=1.0, name=None):
-    return apply(lambda a: jax.nn.elu(a, alpha), x, name="elu")
+    return apply(lambda a: jax.nn.elu(a, alpha), x, name="elu", defer=True)
 
 
 def selu(x,
@@ -82,11 +82,11 @@ def selu(x,
          alpha=1.6732632423543772848170429916717, name=None):
     return apply(lambda a: scale * jnp.where(a > 0, a,
                                              alpha * jnp.expm1(a)),
-                 x, name="selu")
+                 x, name="selu", defer=True)
 
 
 def celu(x, alpha=1.0, name=None):
-    return apply(lambda a: jax.nn.celu(a, alpha), x, name="celu")
+    return apply(lambda a: jax.nn.celu(a, alpha), x, name="celu", defer=True)
 
 
 def prelu(x, weight, data_format="NCHW", name=None):
@@ -116,21 +116,21 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
 
 def hardshrink(x, threshold=0.5, name=None):
     return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x,
-                 name="hardshrink")
+                 name="hardshrink", defer=True)
 
 
 def hardsigmoid(x, slope=1.0 / 6.0, offset=0.5, name=None):
     return apply(lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x,
-                 name="hardsigmoid")
+                 name="hardsigmoid", defer=True)
 
 
 def hardswish(x, name=None):
     return apply(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x,
-                 name="hardswish")
+                 name="hardswish", defer=True)
 
 
 def hardtanh(x, min=-1.0, max=1.0, name=None):
-    return apply(lambda a: jnp.clip(a, min, max), x, name="hardtanh")
+    return apply(lambda a: jnp.clip(a, min, max), x, name="hardtanh", defer=True)
 
 
 def softplus(x, beta=1.0, threshold=20.0, name=None):
