@@ -1,0 +1,54 @@
+"""tools/suite_gate.py mapping pins (the pre-commit affected-test gate).
+
+VERDICT r4 #1: snapshots must be mechanically suite-gated. The gate is
+only as good as its file->tests map, so the map itself is pinned here.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools"))
+
+import suite_gate  # noqa: E402
+
+
+def test_ops_changes_map_to_sweeps():
+    t = suite_gate.targets_for(["paddle_tpu/ops/math.py"])
+    assert "tests/test_oracle_sweep_binary.py" in t
+    assert "tests/test_special_ops.py" in t
+    assert "tests/test_tensor.py" in t  # core smoke always present
+
+
+def test_linalg_gets_its_sweep_despite_ops_prefix():
+    t = suite_gate.targets_for(["paddle_tpu/ops/linalg.py"])
+    assert "tests/test_oracle_sweep_linalg_fft.py" in t
+
+
+def test_test_files_run_directly_and_docs_are_free():
+    t = suite_gate.targets_for(["tests/nn/test_fused_ce.py", "README.md",
+                                "BASELINE.md"])
+    assert t == ["tests/nn/test_fused_ce.py"]
+    assert suite_gate.targets_for(["docs/MIGRATION.md"]) == []
+
+
+def test_smoke_survives_truncation_on_broad_diffs():
+    files = [f"paddle_tpu/ops/mod{i}.py" for i in range(5)] + \
+        ["paddle_tpu/core/x.py", "paddle_tpu/nn/y.py",
+         "paddle_tpu/distributed/z.py", "paddle_tpu/kernels/k.py",
+         "paddle_tpu/optimizer/o.py", "paddle_tpu/vision/v.py",
+         "paddle_tpu/amp/a.py"]
+    t = suite_gate.targets_for(files)
+    assert len(t) <= suite_gate._MAX_TARGETS
+    assert t[0] == "tests/test_tensor.py"  # smoke first, never truncated
+
+
+def test_unmapped_module_falls_back_to_framework_mirror():
+    t = suite_gate.targets_for(["paddle_tpu/inference/paged.py"])
+    # no explicit inference mapping: core smoke still runs
+    assert "tests/test_tensor.py" in t
+
+
+def test_conftest_change_triggers_smoke():
+    t = suite_gate.targets_for(["tests/conftest.py"])
+    assert "tests/test_tensor.py" in t
