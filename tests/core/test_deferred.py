@@ -216,3 +216,36 @@ def test_pow_and_autocast_interplay():
     finally:
         paddle.set_flags({"FLAGS_eager_defer": True})
     np.testing.assert_allclose(r1, r2, rtol=0, atol=0)
+
+
+def test_threaded_chains_are_isolated():
+    """Chains built concurrently from worker threads (the DataLoader
+    pattern) share only the structure-keyed jit cache; values never
+    cross streams."""
+    import threading
+
+    errs = []
+
+    def worker(seed):
+        try:
+            rng = np.random.default_rng(seed)
+            a = rng.standard_normal((16, 16)).astype("float32")
+            t = paddle.to_tensor(a)
+            for _ in range(30):
+                t = (t * 1.01 + float(seed) * 1e-3).tanh()
+            ref = a.copy()
+            for _ in range(30):
+                ref = np.tanh(ref * np.float32(1.01)
+                              + np.float32(seed * 1e-3))
+            np.testing.assert_allclose(t.numpy(), ref, rtol=1e-5,
+                                       atol=1e-6)
+        except Exception as e:  # noqa: BLE001
+            errs.append((seed, e))
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(6)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs, errs
