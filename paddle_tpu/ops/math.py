@@ -181,8 +181,18 @@ acosh = _unop(jnp.arccosh, "acosh")
 atanh = _unop(jnp.arctanh, "atanh")
 reciprocal = _unop(jnp.reciprocal, "reciprocal", defer=True)
 square = _unop(jnp.square, "square", defer=True)
-erf = _unop(jax.scipy.special.erf, "erf")
-erfinv = _unop(jax.scipy.special.erfinv, "erfinv")
+def _erf_fn(a):
+    return jax.scipy.special.erf(a)
+
+
+def _erfinv_fn(a):
+    return jax.scipy.special.erfinv(a)
+
+
+# jax.scipy.special fns carry closure state _fn_key rejects; module
+# wrappers key cleanly so the erf family joins deferred chains
+erf = _unop(_erf_fn, "erf", defer=True)
+erfinv = _unop(_erfinv_fn, "erfinv", defer=True)
 isnan = _unop(jnp.isnan, "isnan")
 isinf = _unop(jnp.isinf, "isinf")
 isfinite = _unop(jnp.isfinite, "isfinite")
@@ -201,7 +211,7 @@ i1 = _unop(jax.scipy.special.i1, "i1")
 
 
 def frac(x, name=None):
-    return apply(lambda a: a - jnp.trunc(a), x, name="frac")
+    return apply(lambda a: a - jnp.trunc(a), x, name="frac", defer=True)
 
 
 def sgn(x, name=None):
