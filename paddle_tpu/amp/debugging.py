@@ -15,7 +15,13 @@ import jax
 import jax.numpy as jnp
 
 from ..core import flags as flags_mod
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, _host_read
+from ..profiler import metrics as _metrics
+
+_C_CHECKED = _metrics.counter("amp.check_naninf.checked")
+_C_FLAGGED = _metrics.counter("amp.check_naninf.flagged")
+_C_OP_CALLS = {k: _metrics.counter(f"amp.op_calls.{k}")
+               for k in ("fp32", "fp16", "bf16", "other")}
 
 __all__ = ["TensorCheckerConfig", "enable_tensor_checker",
            "disable_tensor_checker", "check_numerics",
@@ -75,9 +81,14 @@ def check_array(name, arr):
         return
     if not jnp.issubdtype(arr.dtype, jnp.floating):
         return
-    finite = bool(jnp.isfinite(arr).all())
+    _C_CHECKED.inc()
+    # the bool() forces a device sync — worth a Sync span of its own:
+    # with FLAGS_check_nan_inf on, this is usually the dominant cost
+    finite = _host_read(f"check_naninf/{name}",
+                        lambda: bool(jnp.isfinite(arr).all()))
     if finite:
         return
+    _C_FLAGGED.inc()
     level = flags_mod.flag("FLAGS_check_nan_inf_level")
     msg = f"Operator {name!r} produced NaN/Inf output"
     if level == DebugMode.CHECK_NAN_INF_AND_ABORT:
@@ -128,3 +139,4 @@ def record_op(name, dtype):
     key = {"float32": "fp32", "float16": "fp16",
            "bfloat16": "bf16"}.get(str(dtype), "other")
     _op_stats[name][key] += 1
+    _C_OP_CALLS[key].inc()

@@ -31,16 +31,72 @@ Flag: ``FLAGS_eager_defer`` (default on; env ``FLAGS_eager_defer=0``).
 
 from __future__ import annotations
 
+import threading
+import time
 import weakref
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..profiler import _recorder as _prof
+from ..profiler import metrics as _metrics
+
 DEFER_CAP = 64  # max unique nodes per chain before forced materialization
 
 _JIT_CACHE: dict = {}
 _JIT_CACHE_MAX = 512
+# chains are built thread-locally (one per tensor graph) but _JIT_CACHE
+# and _CONST_MEMO are process-global: eviction at the cap is
+# iterate-then-pop and two racing flushes could StopIteration/KeyError a
+# worker thread — all structural mutation goes through this lock
+_CACHE_LOCK = threading.Lock()
+
+_C_JIT_HIT = _metrics.counter("deferred.jit_cache.hit")
+_C_JIT_COMPILE = _metrics.counter("deferred.jit_cache.compiles")
+_C_JIT_EVICT = _metrics.counter("deferred.jit_cache.evictions")
+_H_CHAIN_LEN = _metrics.histogram("deferred.chain_len")
+_H_COMPILE_US = _metrics.histogram(
+    "deferred.compile_us",
+    bounds=(100, 1000, 10_000, 100_000, 1_000_000, 10_000_000))
+
+# why the chain materialized — stamped by the site that triggers the
+# flush (dispatch.apply marks op boundaries; plain _data reads default
+# to data_read); a plain module global, so a concurrent flush may read a
+# neighbour's cause — acceptable for a labeling counter
+_FLUSH_CAUSE = "data_read"
+
+
+def note_flush_cause(cause, weak=False):
+    """Label the NEXT flush (consumed and reset by flush()). A ``weak``
+    stamp never overrides an already-pending non-default cause — the
+    op-boundary loop in dispatch.apply stamps weakly so it can't clobber
+    the more specific ``cap`` label set by try_defer."""
+    global _FLUSH_CAUSE
+    if weak and _FLUSH_CAUSE != "data_read":
+        return
+    _FLUSH_CAUSE = cause
+
+
+# flush causes and reject reasons are closed sets on the per-op dispatch
+# path: pre-bound like the _C_PATH_* counters in dispatch.py so each
+# event costs one dict hit + locked add, not an f-string + registry get
+_C_FLUSH = {c: _metrics.counter(f"deferred.flush.{c}")
+            for c in ("data_read", "op_boundary", "cap")}
+_C_REJECT = {r: _metrics.counter(f"deferred.reject.{r}")
+             for r in ("grad", "tracer", "payload", "dtype",
+                       "dtype_mismatch", "shape_mismatch", "arg_type",
+                       "no_tensor_arg", "cap", "unhashable")}
+
+
+def _count_flush(cause, n_nodes):
+    _C_FLUSH[cause].inc()
+    _H_CHAIN_LEN.observe(n_nodes)
+
+
+def _count_reject(reason):
+    """try_defer bailed: the op falls back to normal dispatch."""
+    _C_REJECT[reason].inc()
 
 
 class Expr:
@@ -64,12 +120,14 @@ class Expr:
 
 class _DtypeOnly:
     """Minimal out-descriptor for _post_op_hooks at defer time (AMP
-    op-stats record the declared dtype; there is no array yet)."""
+    op-stats record the declared dtype, profiler spans the declared
+    shape; there is no array yet)."""
 
-    __slots__ = ("dtype",)
+    __slots__ = ("dtype", "shape")
 
-    def __init__(self, dtype):
+    def __init__(self, dtype, shape=()):
         self.dtype = dtype
+        self.shape = shape
 
 
 def enabled():
@@ -114,9 +172,11 @@ def try_defer(fn, args, kwargs, recording):
     for a in args:
         if isinstance(a, Tensor):
             if recording and not a.stop_gradient:
+                _count_reject("grad")
                 return None  # diff input: tape path owns it
             p = _peek(a)
             if isinstance(p, jax.core.Tracer):
+                _count_reject("tracer")
                 return None  # under jit tracing: no deferral
             if isinstance(p, Expr):
                 s, dt = p.shape, p.dtype
@@ -126,18 +186,22 @@ def try_defer(fn, args, kwargs, recording):
                 s, dt = p.shape, p.dtype
                 argspec.append(("leaf", p))
             else:  # unexpected payload
+                _count_reject("payload")
                 return None
             if not jnp.issubdtype(dt, jnp.floating):
+                _count_reject("dtype")
                 return None
             if dtype is None:
                 dtype = dt
             elif dt != dtype:
+                _count_reject("dtype_mismatch")
                 return None  # no implicit promotion in chains
             if s == ():
                 pass  # same-dtype 0-d tensor: broadcast-neutral leaf
             elif shape is None:
                 shape = s
             elif s != shape:
+                _count_reject("shape_mismatch")
                 return None  # no implicit (shape-changing) broadcast
         elif isinstance(a, (bool, int, float)) and not isinstance(
                 a, np.generic):
@@ -145,8 +209,10 @@ def try_defer(fn, args, kwargs, recording):
         elif isinstance(a, (np.integer, np.floating)):
             argspec.append(("const", float(a)))
         else:
+            _count_reject("arg_type")
             return None
     if dtype is None:
+        _count_reject("no_tensor_arg")
         return None
     if shape is None:
         shape = ()  # every arg 0-d: the result is 0-d
@@ -157,11 +223,16 @@ def try_defer(fn, args, kwargs, recording):
         n_nodes = 1 + _unique_count(
             [v for k, v in argspec if k == "node"])
         if n_nodes > DEFER_CAP:
+            # the op dispatches eagerly, so reading its args' _data
+            # flushes the over-cap chain — label that flush
+            _count_reject("cap")
+            note_flush_cause("cap")
             return None
     try:
         node_key = (_fn_key(fn), _freeze(kwargs))
         hash(node_key)
     except (TypeError, ValueError):
+        _count_reject("unhashable")
         return None
     return Expr(fn, tuple(argspec), kwargs, shape, dtype, n_nodes,
                 node_key)
@@ -211,18 +282,31 @@ def _linearize(root):
 def flush(root):
     """Evaluate the chain as one jitted program. Every node still owned
     by a live Tensor is returned and stamped (shared subexpressions are
-    never re-executed); returns the root's value."""
+    never re-executed); returns the root's value.
+
+    The flush-counter label (data_read / op_boundary / cap) is the
+    module-level cause stamped by the triggering site via
+    ``note_flush_cause``; it is consumed here and reset to the default
+    ``data_read``."""
+    global _FLUSH_CAUSE
     if root.value is not None:
+        # already computed by a sibling flush: nothing runs, so discard
+        # any cause stamped for this read — it must not leak onto the
+        # next real flush
+        _FLUSH_CAUSE = "data_read"
         return root.value
+    cause = _FLUSH_CAUSE
+    _FLUSH_CAUSE = "data_read"
+    t0 = time.perf_counter_ns() if _prof.enabled else None
     nodes, leaves, consts = _linearize(root)
+    _count_flush(cause, len(nodes))
     out_ixs = tuple(i for i, (e, _) in enumerate(nodes)
                     if e is root or (e.owner is not None
                                      and e.owner() is not None))
     key = (tuple((e.node_key, spec) for e, spec in nodes), out_ixs)
     jf = _JIT_CACHE.get(key)
-    if jf is None:
-        if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
-            _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
+    fresh = jf is None
+    if fresh:
         descr = [(e.fn, spec, e.kwargs) for e, spec in nodes]
         n_leaves = len(leaves)
 
@@ -238,14 +322,41 @@ def flush(root):
                 vals.append(fn(*argv, **kw))
             return tuple(vals[i] for i in out_ixs)
 
-        _JIT_CACHE[key] = jf
+        with _CACHE_LOCK:
+            if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
+                try:
+                    _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
+                    _C_JIT_EVICT.inc()
+                except (KeyError, StopIteration):
+                    pass  # a racing flush already evicted
+            won = _JIT_CACHE.setdefault(key, jf)
+            # a racing flush may have inserted the same key first: only
+            # the winner counts the compile / times the first call
+            fresh = won is jf
+            jf = won
+    if not fresh:
+        _C_JIT_HIT.inc()
     # consts ride as 0-d arrays AT THE CHAIN DTYPE — the same value a
     # weak python scalar would contribute against a dtype-uniform chain
     # (memoized: a 64-op chain has ~100 consts and flushes in a loop)
     cargs = [_const_arr(c, root.dtype) for c in consts]
-    outs = jf(*leaves, *cargs)
+    if fresh:
+        # first call of a fresh jf pays trace+compile: time it (the
+        # jax.monitoring listener in profiler.metrics counts the true
+        # backend compiles; this is the end-to-end chain-build cost)
+        tc = time.perf_counter_ns()
+        outs = jf(*leaves, *cargs)
+        _C_JIT_COMPILE.inc()
+        _H_COMPILE_US.observe((time.perf_counter_ns() - tc) / 1000.0)
+    else:
+        outs = jf(*leaves, *cargs)
     for i, ov in zip(out_ixs, outs):
         nodes[i][0].value = ov
+    if t0 is not None and _prof.enabled:
+        _prof.record("deferred_flush", t0 / 1000.0,
+                     time.perf_counter_ns() / 1000.0, "Sync",
+                     {"nodes": len(nodes), "cause": cause,
+                      "compiled": fresh})
     return root.value
 
 
@@ -258,9 +369,13 @@ def _const_arr(c, dtype):
     key = (repr(c), str(dtype))
     a = _CONST_MEMO.get(key)
     if a is None:
-        if len(_CONST_MEMO) > 4096:
-            _CONST_MEMO.clear()
-        a = _CONST_MEMO[key] = jnp.asarray(c, dtype=dtype)
+        # build outside the lock — jnp.asarray is a device put, and the
+        # lock is shared with _JIT_CACHE eviction on the flush path
+        fresh = jnp.asarray(c, dtype=dtype)
+        with _CACHE_LOCK:
+            if len(_CONST_MEMO) > 4096:
+                _CONST_MEMO.clear()
+            a = _CONST_MEMO.setdefault(key, fresh)
     return a
 
 
@@ -268,3 +383,13 @@ def bind_owner(expr, tensor):
     """Record the Tensor owning this chain node (weakly): flush stamps
     values for nodes whose owners are still alive."""
     expr.owner = weakref.ref(tensor)
+
+
+def release_owner(expr, tensor):
+    """Inverse of bind_owner for payload replacement: ``tensor`` is
+    adopting a new payload, so if it still owns ``expr`` the node's
+    output can never be read through it — drop the owner weakref so
+    later flushes of chains sharing the node don't compute it."""
+    if expr is not None and expr.owner is not None \
+            and expr.owner() is tensor:
+        expr.owner = None

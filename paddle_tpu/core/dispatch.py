@@ -10,6 +10,7 @@ kernel registry to search: XLA owns kernel selection per backend.
 
 from __future__ import annotations
 
+import time
 from typing import Callable
 
 import jax
@@ -18,6 +19,32 @@ import numpy as np
 from . import dtype as dtype_mod
 from .autograd import Node, is_grad_enabled
 from .tensor import Tensor
+
+# profiler package imports only stdlib at module level — no cycle back
+# into core; _recorder is the process-global host span store (never
+# rebound) and metrics is the always-on counter registry
+from ..profiler import _recorder as _prof
+from ..profiler import metrics as _metrics
+
+# dispatch-route counters (see docs/OBSERVABILITY.md): which of the five
+# paths each op takes — pre-bound so the per-op cost is one locked add
+_C_PATH_EAGER = _metrics.counter("dispatch.path.eager")
+_C_PATH_JITFWD = _metrics.counter("dispatch.path.jitted_fwd")
+_C_PATH_LAZY = _metrics.counter("dispatch.path.lazy_vjp")
+_C_PATH_EAGER_VJP = _metrics.counter("dispatch.path.eager_vjp")
+_C_PATH_DEFERRED = _metrics.counter("dispatch.path.deferred")
+_C_FWD_HIT = _metrics.counter("dispatch.fwd_cache.hit")
+_C_FWD_MISS = _metrics.counter("dispatch.fwd_cache.miss")
+_C_FWD_EVICT = _metrics.counter("dispatch.fwd_cache.evictions")
+_C_BWD_HIT = _metrics.counter("dispatch.bwd_cache.hit")
+_C_BWD_MISS = _metrics.counter("dispatch.bwd_cache.miss")
+_C_BWD_EVICT = _metrics.counter("dispatch.bwd_cache.evictions")
+
+
+def _count_eager_only(reason):
+    """An op was rejected from the lazy/jitted caches: count it with the
+    reason (rare events — the get-or-create lookup is fine here)."""
+    _metrics.counter(f"dispatch.eager_only.{reason}").inc()
 
 
 def _differentiable(dt) -> bool:
@@ -70,8 +97,9 @@ def _fwd_cached_call(fn, payloads, kwargs):
     """No-grad/inference fast path: composite ops run through the same
     cached jitted forward the recording path uses (keyed with an empty
     diff set), instead of per-primitive eager dispatch. Returns
-    _NOT_CACHED when the op is not (yet) eligible — the caller then runs
-    the plain eager forward, and the second call onward hits the cache."""
+    ``(out, path)`` with out = _NOT_CACHED when the op is not (yet)
+    eligible — the caller then runs the plain eager forward, and the
+    second call onward hits the cache."""
     arr_pos, arrs, statics = [], [], []
     for i, p in enumerate(payloads):
         if isinstance(p, (jax.Array, np.ndarray)):
@@ -84,18 +112,21 @@ def _fwd_cached_call(fn, payloads, kwargs):
                _freeze(tuple(statics)), _freeze(kwargs))
         hash(key)
     except (TypeError, ValueError):
-        return _NOT_CACHED
+        _count_eager_only("unhashable_key")
+        return _NOT_CACHED, "eager"
     fwd = _LAZY_FWD_CACHE.get(key)
     if fwd is None:
         # probe on the first call (outside any timing-critical loop)
+        _C_FWD_MISS.inc()
         out = fn(*payloads, **kwargs)
         _populate_fwd_cache(key, fn, len(payloads), tuple(arr_pos),
                             tuple(statics), kwargs,
                             isinstance(out, (tuple, list)), arrs)
-        return out
+        return out, "eager"
     if fwd is _EAGER_ONLY:
-        return _NOT_CACHED
-    return fwd(*arrs)
+        return _NOT_CACHED, "eager"
+    _C_FWD_HIT.inc()
+    return fwd(*arrs), "jitted_fwd"
 
 
 def _populate_fwd_cache(key, fn, n_payloads, arr_pos, statics, kwargs,
@@ -108,7 +139,13 @@ def _populate_fwd_cache(key, fn, n_payloads, arr_pos, statics, kwargs,
     if key in _LAZY_FWD_CACHE:
         return
     if len(_LAZY_FWD_CACHE) >= _LAZY_BWD_CACHE_MAX:
-        _LAZY_FWD_CACHE.pop(next(iter(_LAZY_FWD_CACHE)))
+        try:
+            _LAZY_FWD_CACHE.pop(next(iter(_LAZY_FWD_CACHE)))
+            _C_FWD_EVICT.inc()
+        except (KeyError, StopIteration, RuntimeError):
+            # concurrent evictions at the cap raced (RuntimeError is
+            # "dict changed size during iteration"); cache shrank
+            pass
     statics_d = dict(statics)
 
     def bound(*a):
@@ -121,13 +158,16 @@ def _populate_fwd_cache(key, fn, n_payloads, arr_pos, statics, kwargs,
 
     try:
         n_eqns = len(jax.make_jaxpr(bound)(*arrs).jaxpr.eqns)
+        reject_reason = "below_composite_threshold"
     except Exception:  # noqa: BLE001 — non-traceable: stay eager
         n_eqns = 0
+        reject_reason = "nontraceable"
     if n_eqns >= 3:
         _LAZY_FWD_CACHE[key] = _make_lazy_fwd(
             fn, n_payloads, arr_pos, statics, kwargs, was_tuple)
     else:
         _LAZY_FWD_CACHE[key] = _EAGER_ONLY
+        _count_eager_only(reject_reason)
 
 
 def _freeze(v):
@@ -160,7 +200,9 @@ def _lazy_bwd_for(key, fn, n_payloads, diff_idx, arr_pos, statics,
                   kwargs, was_tuple):
     entry = _LAZY_BWD_CACHE.get(key)
     if entry is not None and entry is not _EAGER_ONLY:
+        _C_BWD_HIT.inc()
         return entry
+    _C_BWD_MISS.inc()
     statics_d = dict(statics)
     diff_idx = tuple(diff_idx)
     arr_pos = tuple(arr_pos)
@@ -186,7 +228,13 @@ def _lazy_bwd_for(key, fn, n_payloads, diff_idx, arr_pos, statics,
         return vjp_fn(cts)
 
     if len(_LAZY_BWD_CACHE) >= _LAZY_BWD_CACHE_MAX:
-        _LAZY_BWD_CACHE.pop(next(iter(_LAZY_BWD_CACHE)))
+        try:
+            _LAZY_BWD_CACHE.pop(next(iter(_LAZY_BWD_CACHE)))
+            _C_BWD_EVICT.inc()
+        except (KeyError, StopIteration, RuntimeError):
+            # concurrent evictions at the cap raced (RuntimeError is
+            # "dict changed size during iteration"); cache shrank
+            pass
     _LAZY_BWD_CACHE[key] = bwd
     return bwd
 
@@ -344,7 +392,8 @@ def _cell_key_fn(v, _seen=None):
     return _cell_key(v, _seen)
 
 
-def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf):
+def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf,
+                    begin=None):
     """Fast diff path: plain eager forward + cached lazy pullback.
     Returns wrapped outputs, or None when the op is not cacheable."""
     arr_pos, arrs, statics = [], [], []
@@ -359,6 +408,7 @@ def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf):
                _freeze(tuple(statics)), _freeze(kwargs))
         hash(key)
     except (TypeError, ValueError):
+        _count_eager_only("unhashable_key")
         return None
     if _LAZY_BWD_CACHE.get(key) is _EAGER_ONLY:
         return None  # known non-diff-output op: skip the probe forward
@@ -370,14 +420,18 @@ def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf):
         # dispatch — the eager-mode answer to the reference's fused
         # per-op kernels (phi/kernels/fusion). Same cacheability rules
         # as the lazy backward, so semantics are unchanged.
+        _C_FWD_HIT.inc()
         out = fwd(*arrs)
         was_tuple = isinstance(out, (tuple, list))
         out_tuple = tuple(out) if was_tuple else (out,)
-        _post_op_hooks(name, out_tuple, check_naninf)
+        _post_op_hooks(name, out_tuple, check_naninf, begin=begin,
+                       path="lazy_vjp")
         bwd = _lazy_bwd_for(key, fn, len(payloads), diff_idx, arr_pos,
                             statics, kwargs, was_tuple)
         return out_tuple, _LazyVjp(bwd, arrs), was_tuple
 
+    if fwd is None:
+        _C_FWD_MISS.inc()  # probe forward below populates the cache
     out = fn(*payloads, **kwargs)
     was_tuple = isinstance(out, (tuple, list))
     out_tuple = tuple(out) if was_tuple else (out,)
@@ -387,10 +441,12 @@ def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf):
     if not all(hasattr(o, "dtype") and _differentiable(o.dtype)
                for o in out_tuple):
         _LAZY_BWD_CACHE[key] = _EAGER_ONLY
+        _count_eager_only("nondiff_output")
         return None
     _populate_fwd_cache(key, fn, len(payloads), tuple(arr_pos),
                         tuple(statics), kwargs, was_tuple, arrs)
-    _post_op_hooks(name, out_tuple, check_naninf)
+    _post_op_hooks(name, out_tuple, check_naninf, begin=begin,
+                   path="lazy_vjp")
     bwd = _lazy_bwd_for(key, fn, len(payloads), diff_idx, arr_pos,
                         statics, kwargs, was_tuple)
     return out_tuple, _LazyVjp(bwd, arrs), was_tuple
@@ -412,6 +468,8 @@ def apply(fn: Callable, *args, name: str = None, defer: bool = False,
       first ``_data`` read — one device round trip per chain.
     """
     name = name or getattr(fn, "__name__", "op")
+    # span begin: one clock read per op, only while a Profiler records
+    t0 = time.perf_counter_ns() if _prof.enabled else None
     from ..amp import amp_state
     if amp_state().enabled:
         from ..amp import amp_dispatch_pre
@@ -424,13 +482,18 @@ def apply(fn: Callable, *args, name: str = None, defer: bool = False,
         if deferred.enabled():
             expr = deferred.try_defer(fn, args, kwargs, recording)
             if expr is not None:
-                _post_op_hooks(name, (deferred._DtypeOnly(expr.dtype),),
-                               False)
+                _C_PATH_DEFERRED.inc()
+                _post_op_hooks(
+                    name, (deferred._DtypeOnly(expr.dtype, expr.shape),),
+                    False, begin=t0, path="deferred")
                 return Tensor._from_pending(expr)
     diff_idx = []
     payloads = []
     for i, a in enumerate(args):
         if isinstance(a, Tensor):
+            if a._pending is not None:
+                from . import deferred
+                deferred.note_flush_cause("op_boundary", weak=True)
             payloads.append(a._data)
             if recording and not a.stop_gradient and \
                     _differentiable(a._data.dtype):
@@ -439,18 +502,20 @@ def apply(fn: Callable, *args, name: str = None, defer: bool = False,
             payloads.append(a)
 
     if not diff_idx:
-        out = _fwd_cached_call(fn, payloads, kwargs)
+        out, path = _fwd_cached_call(fn, payloads, kwargs)
         if out is _NOT_CACHED:
             out = fn(*payloads, **kwargs)
+        (_C_PATH_JITFWD if path == "jitted_fwd" else _C_PATH_EAGER).inc()
         _post_op_hooks(name, out if isinstance(out, (tuple, list))
-                       else (out,), check_naninf)
+                       else (out,), check_naninf, begin=t0, path=path)
         if isinstance(out, (tuple, list)):
             return [Tensor(o) for o in out]
         return Tensor(out)
 
     lazy = _try_lazy_apply(fn, payloads, diff_idx, kwargs, name,
-                           check_naninf)
+                           check_naninf, begin=t0)
     if lazy is not None:
+        _C_PATH_LAZY.inc()
         out_tuple, vjp_fn, was_tuple_v = lazy
         was_tuple = [was_tuple_v]
     else:
@@ -468,7 +533,9 @@ def apply(fn: Callable, *args, name: str = None, defer: bool = False,
             return (out,)
 
         out_tuple, vjp_fn = jax.vjp(pure, *diff_args)
-        _post_op_hooks(name, out_tuple, check_naninf)
+        _C_PATH_EAGER_VJP.inc()
+        _post_op_hooks(name, out_tuple, check_naninf, begin=t0,
+                       path="eager_vjp")
     out_meta = [(o.shape, o.dtype) for o in out_tuple]
     # fwd_fn: the node's pure forward over its diff inputs — what lets
     # create_graph=True re-record this op's backward differentiably
@@ -504,17 +571,28 @@ def apply(fn: Callable, *args, name: str = None, defer: bool = False,
     return outs[0]
 
 
-def _post_op_hooks(name, outs, check_naninf):
+def _post_op_hooks(name, outs, check_naninf, begin=None, path="eager"):
     """Per-op post hooks: NaN/Inf sanitizer (FLAGS_check_nan_inf — the
     generated-ad_func CheckTensorHasNanOrInf analogue), AMP op-stats, and
-    profiler op spans (the generated ad_funcs' RecordEvent analogue)."""
-    import sys
+    profiler op spans (the generated ad_funcs' RecordEvent analogue).
 
-    prof = sys.modules.get("paddle_tpu.profiler")
-    if prof is not None and prof._recorder.enabled:
-        import time
-        now = time.perf_counter_ns() / 1000.0
-        prof._recorder.record(name, now, now, "Operator")
+    ``begin`` is the perf_counter_ns captured at ``apply`` entry — the
+    span covers the full dispatch (unwrap, cache lookups, the jax call),
+    so Operator events carry REAL durations, begin/end style. ``path``
+    labels which dispatch route ran (eager / jitted_fwd / lazy_vjp /
+    eager_vjp / deferred) and lands in the span args."""
+    if _prof.enabled:
+        end = time.perf_counter_ns() / 1000.0
+        start = end if begin is None else begin / 1000.0
+        span_args = {"path": path}
+        if _prof.record_shapes:
+            span_args["shapes"] = [
+                list(getattr(o, "shape", ())) for o in outs]
+            span_args["dtypes"] = [
+                str(getattr(o, "dtype", "?")) for o in outs]
+        _prof.record(name, start, end, "Operator", span_args)
+
+    import sys
 
     dbg = sys.modules.get("paddle_tpu.amp.debugging")
     if dbg is not None and getattr(dbg, "_op_stats", None) is not None:

@@ -21,6 +21,23 @@ from . import dtype as dtype_mod
 from . import place as place_mod
 from .autograd import backward as _backward
 
+# host-read sync spans: the profiler recorder is standalone (no import
+# cycle); reads block on jax's async dispatch, so they are where "the
+# python line that waits" actually shows up in a trace
+from ..profiler import _recorder as _prof
+
+
+def _host_read(label, fn):
+    """Run a blocking device->host read, recording a Sync span while a
+    Profiler is armed (zero-cost one-flag check otherwise)."""
+    if not _prof.enabled:
+        return fn()
+    import time
+    t0 = time.perf_counter_ns() / 1000.0
+    out = fn()
+    _prof.record(label, t0, time.perf_counter_ns() / 1000.0, "Sync")
+    return out
+
 
 class Tensor:
     __slots__ = ("_buf", "_pending", "grad", "stop_gradient", "_node",
@@ -82,6 +99,9 @@ class Tensor:
 
     @_data.setter
     def _data(self, value):
+        if self._pending is not None:
+            from .deferred import release_owner
+            release_owner(self._pending, self)
         self._buf = value
         self._pending = None
 
@@ -151,19 +171,22 @@ class Tensor:
 
     # -- host interop -----------------------------------------------------
     def numpy(self):
-        return np.asarray(self._data)
+        return _host_read("Tensor.numpy", lambda: np.asarray(self._data))
 
     def item(self, *idx):
-        arr = self._data
-        if idx:
-            arr = arr[idx]
-        return arr.item()
+        def read():
+            arr = self._data
+            if idx:
+                arr = arr[idx]
+            return arr.item()
+        return _host_read("Tensor.item", read)
 
     def tolist(self):
-        return np.asarray(self._data).tolist()
+        return _host_read("Tensor.tolist",
+                          lambda: np.asarray(self._data).tolist())
 
     def __array__(self, dtype=None):
-        a = np.asarray(self._data)
+        a = _host_read("Tensor.__array__", lambda: np.asarray(self._data))
         return a.astype(dtype) if dtype is not None else a
 
     # -- autograd ---------------------------------------------------------

@@ -18,9 +18,46 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+import numpy as np
+
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
+from ..profiler import metrics as _metrics
 from .mesh import get_mesh
+
+
+# one (calls, bytes) counter pair per collective, pre-bound so a
+# gradient all_reduce storm pays one dict hit + locked add per call
+_C_COLLECTIVE = {
+    name: (_metrics.counter(f"collective.{name}.calls"),
+           _metrics.counter(f"collective.{name}.bytes"))
+    for name in ("all_reduce", "all_gather", "reduce_scatter",
+                 "all_to_all", "broadcast", "scatter", "gather", "send",
+                 "recv", "ppermute", "barrier")}
+
+
+def _record_collective(name, *tensors):
+    """Per-collective telemetry: call count + payload bytes. Sizes come
+    from meta (shape/dtype) only — recording a collective must never
+    materialize a deferred chain or block on a device value."""
+    c_calls, c_bytes = _C_COLLECTIVE[name]
+    c_calls.inc()
+    nbytes = 0
+    for t in tensors:
+        if t is None:
+            continue
+        for x in (t if isinstance(t, (list, tuple)) else (t,)):
+            try:
+                if isinstance(x, Tensor):
+                    shape, dt = x._meta()
+                else:
+                    shape, dt = x.shape, x.dtype
+                nbytes += int(np.prod(shape) if shape else 1) * \
+                    np.dtype(dt).itemsize
+            except Exception:  # noqa: BLE001 — unsized payloads: skip
+                pass
+    if nbytes:
+        c_bytes.inc(nbytes)
 
 
 class ReduceOp:
@@ -98,6 +135,7 @@ _REDUCERS = {
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     axis = _axis(group)
+    _record_collective("all_reduce", tensor)
     if _in_shard_map(axis):
         def fn(a):
             if op == ReduceOp.AVG:
@@ -114,6 +152,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     g = _group(group)
+    _record_collective("all_gather", tensor)
     if _in_shard_map(g.axis_name):
         def fn(a):
             return lax.all_gather(a, g.axis_name)
@@ -134,6 +173,7 @@ def all_gather_object(object_list, obj, group=None):
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group=None, sync_op=True):
     g = _group(group)
+    _record_collective("reduce_scatter", tensor_or_tensor_list)
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
         from .. import ops
@@ -152,6 +192,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     g = _group(group)
+    _record_collective("all_to_all", in_tensor_list)
     from .. import ops
     stacked = in_tensor_list if isinstance(in_tensor_list, Tensor) else \
         ops.stack(list(in_tensor_list), axis=0)
@@ -176,6 +217,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     Single-controller eager: a replicated value is already broadcast —
     identity."""
     g = _group(group)
+    _record_collective("broadcast", tensor)
     if _in_shard_map(g.axis_name):
         def fn(a):
             return lax.all_gather(a, g.axis_name)[src]
@@ -193,7 +235,8 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     """Inside shard_map: rank r receives src's ``tensor_list[r]``."""
     g = _group(group)
     if tensor_list is None:
-        return tensor
+        return tensor  # identity no-op: not a collective, not counted
+    _record_collective("scatter", tensor_list)
     from .. import ops
     if _in_shard_map(g.axis_name):
         stacked = ops.stack(list(tensor_list), axis=0)  # [n, ...]
@@ -214,6 +257,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     """Inside shard_map: dst receives every rank's value (computed on
     all ranks — XLA's gather is an all_gather on a lockstep mesh)."""
     g = _group(group)
+    _record_collective("gather", tensor)
     if _in_shard_map(g.axis_name):
         def fn(a):
             return lax.all_gather(a, g.axis_name)
@@ -273,10 +317,9 @@ def send(tensor, dst=0, group=None, sync_op=True):
     the native TCPStore — the DCN control-plane path. ICI-speed p2p
     inside compiled code is `ppermute`)."""
     import pickle
-
-    import numpy as np
     g = _group(group)
     _p2p_guard(g, "send", tensor)
+    _record_collective("send", tensor)
     from .env import get_rank
     store, sseq, _ = _p2p()
     src = get_rank()
@@ -303,10 +346,9 @@ def recv(tensor, src=0, group=None, sync_op=True):
     in-place and returns it (reference communication/recv.py
     semantics)."""
     import pickle
-
-    import numpy as np
     g = _group(group)
     _p2p_guard(g, "recv", tensor)
+    _record_collective("recv", tensor)
     from .env import get_rank
     store, _, rseq = _p2p()
     dst = get_rank()
@@ -336,6 +378,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
 def ppermute(tensor, perm, group=None):
     """Ring/permutation p2p (the XLA-native form of batch_isend_irecv)."""
     axis = _axis(group)
+    _record_collective("ppermute", tensor)
 
     def fn(a):
         return lax.ppermute(a, axis, perm)
@@ -344,6 +387,7 @@ def ppermute(tensor, perm, group=None):
 
 
 def barrier(group=None):
+    _record_collective("barrier")
     jax.effects_barrier()
 
 

@@ -91,6 +91,12 @@ def _inplace_from(t: Tensor, out: Tensor, *, cast_result: bool = False,
     # adopt out's payload WITHOUT materializing a deferred chain: an
     # inplace loop (x.add_(y) per step) then batches like its
     # out-of-place form, flushing only on a real read
+    if t._pending is not None and t._pending is not out._pending:
+        # the replaced pending Expr would otherwise keep its owner
+        # weakref on the (live) receiver, and later flushes of chains
+        # sharing it would compute an output no one can ever read
+        from ..core.deferred import release_owner
+        release_owner(t._pending, t)
     t._buf = out._buf
     t._pending = out._pending
     if t._pending is not None:

@@ -245,20 +245,42 @@ def softplus_math(x, beta=1.0, threshold=20.0):
 def clip(x, min=None, max=None, name=None):
     lo = unwrap(min)
     hi = unwrap(max)
-    # scalar-bound clip defers (closure floats hash into the chain key);
-    # tensor bounds are arrays in cells -> try_defer rejects, eager path
-    return apply(lambda a: jnp.clip(a, lo, hi), x, name="clip",
-                 defer=not (hasattr(lo, "shape") or hasattr(hi, "shape")))
+    # bounds ride as POSITIONAL args: on the deferred path scalars become
+    # ("const", v) chain-argspec entries, i.e. 0-d jit ARGUMENTS whose
+    # values stay out of the chain jit key — a loop-varying clip
+    # threshold reuses one compiled program instead of recompiling per
+    # value and churning _JIT_CACHE (ADVICE r5). jnp.clip is itself the
+    # maximum/minimum composition, so numerics (and vjp tie behavior)
+    # are unchanged; tensor bounds are array args -> try_defer rejects,
+    # eager path as before.
+    if lo is not None and hi is not None:
+        return apply(_clip_both, x, lo, hi, name="clip", defer=True)
+    if lo is not None:
+        return apply(jnp.maximum, x, lo, name="clip", defer=True)
+    if hi is not None:
+        return apply(jnp.minimum, x, hi, name="clip", defer=True)
+    # no bounds: still a fresh tensor, like jnp.clip(a)
+    return apply(jnp.positive, x, name="clip", defer=True)
+
+
+def _clip_both(a, lo, hi):
+    return jnp.clip(a, lo, hi)
+
+
+def _scale_after(a, s, b):
+    return a * s + b
+
+
+def _scale_before(a, s, b):
+    return (a + b) * s
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
     s, b = unwrap(scale), unwrap(bias)
-
-    def _scale(a):
-        out = a * s + b if bias_after_scale else (a + b) * s
-        return out
-    return apply(_scale, x, name="scale",
-                 defer=not (hasattr(s, "shape") or hasattr(b, "shape")))
+    # s/b as positional args, same reasoning as clip: loop-varying
+    # scale/bias dedupe into deferred-chain jit arguments, no recompile
+    fn = _scale_after if bias_after_scale else _scale_before
+    return apply(fn, x, s, b, name="scale", defer=True)
 
 
 def add_n(inputs, name=None):

@@ -20,7 +20,7 @@ from enum import Enum
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
-           "load_profiler_result", "SortedKeys", "SummaryView"]
+           "load_profiler_result", "SortedKeys", "SummaryView", "metrics"]
 
 
 class ProfilerState(Enum):
@@ -61,20 +61,28 @@ class SummaryView(Enum):
 
 
 class _HostEventRecorder:
-    """Lock-free-ish host span store (reference host_event_recorder.h)."""
+    """Lock-free-ish host span store (reference host_event_recorder.h).
+
+    ``record_shapes`` mirrors the armed Profiler's flag: instrumentation
+    sites (core/dispatch._post_op_hooks) read it to decide whether to
+    collect output shapes/dtypes into span args."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.events = []
         self.enabled = False
+        self.record_shapes = False
 
-    def record(self, name, start, end, event_type="UserDefined"):
+    def record(self, name, start, end, event_type="UserDefined",
+               args=None):
         if not self.enabled:
             return
+        ev = {"name": name, "ts": start, "dur": end - start,
+              "tid": threading.get_ident(), "type": event_type}
+        if args:
+            ev["args"] = args
         with self._lock:
-            self.events.append(
-                {"name": name, "ts": start, "dur": end - start,
-                 "tid": threading.get_ident(), "type": event_type})
+            self.events.append(ev)
 
     def drain(self):
         with self._lock:
@@ -83,6 +91,11 @@ class _HostEventRecorder:
 
 
 _recorder = _HostEventRecorder()
+
+# the always-on metrics registry rides in the profiler package
+# (paddle_tpu.profiler.metrics); importing it also installs the
+# jax.monitoring XLA-compile listener
+from . import metrics  # noqa: E402,F401
 
 
 class RecordEvent:
@@ -190,9 +203,12 @@ class Profiler:
             (lambda step: ProfilerState.RECORD))
         self._on_trace_ready = on_trace_ready or _default_on_trace_ready
         self._timer_only = timer_only
+        self._record_shapes = record_shapes
+        self._profile_memory = profile_memory
         self.step_num = 0
         self._state = ProfilerState.CLOSED
         self._events = []
+        self._memory_samples = []
         self._export_count = 0
         self._device_trace_dir = None
         self._step_begin = None
@@ -216,6 +232,7 @@ class Profiler:
                 self._step_info = (
                     f"ips: {num_samples / dur:.3f} samples/s")
         self._step_begin = time.perf_counter()
+        self._maybe_sample_memory()
         prev = self._state
         if prev == ProfilerState.RECORD_AND_RETURN:
             self._collect()
@@ -232,12 +249,52 @@ class Profiler:
         recording_states = (ProfilerState.RECORD,
                             ProfilerState.RECORD_AND_RETURN)
         if new in recording_states and self._state not in recording_states:
+            _recorder.record_shapes = self._record_shapes
             _recorder.enabled = True
+            self._maybe_sample_memory()
             self._maybe_start_device_trace()
         if new not in recording_states and self._state in recording_states:
+            self._maybe_sample_memory()
             _recorder.enabled = False
+            _recorder.record_shapes = False
             self._maybe_stop_device_trace()
         self._state = new
+
+    def _maybe_sample_memory(self):
+        """profile_memory=True: sample live device memory at step
+        boundaries — `jax.live_arrays()` (count + bytes; works on every
+        backend incl. CPU) plus `device.memory_stats()` where the
+        runtime exposes it (TPU/GPU). Gated on the recorder so samples
+        accumulate only while a Profiler records (callers on the
+        enable/disable edges sequence around the flag flip)."""
+        if not self._profile_memory or not _recorder.enabled:
+            return
+        try:
+            import jax
+            arrs = [a for a in jax.live_arrays()
+                    if getattr(a, "is_deleted", lambda: False)() is False]
+            live_bytes = sum(int(getattr(a, "nbytes", 0)) for a in arrs)
+            sample = {"ts": time.perf_counter_ns() / 1000.0,
+                      "step": self.step_num,
+                      "live_arrays": len(arrs),
+                      "live_bytes": live_bytes}
+            try:
+                dev = jax.devices()[0]
+                stats = dev.memory_stats() or {}
+                if "bytes_in_use" in stats:
+                    sample["device_bytes_in_use"] = int(
+                        stats["bytes_in_use"])
+                if "peak_bytes_in_use" in stats:
+                    sample["device_peak_bytes"] = int(
+                        stats["peak_bytes_in_use"])
+                sample["device"] = f"{dev.platform}:{dev.id}"
+            except Exception:  # noqa: BLE001 — CPU backend: no stats
+                pass
+            self._memory_samples.append(sample)
+            metrics.gauge("memory.live_bytes").set(live_bytes)
+            metrics.gauge("memory.live_arrays").set(len(arrs))
+        except Exception:  # noqa: BLE001 — sampling must never break a step
+            pass
 
     def _maybe_start_device_trace(self):
         if self._timer_only:
@@ -264,12 +321,26 @@ class Profiler:
     # -- export / summary --------------------------------------------------
     def _export_chrome(self, path):
         self._export_count += 1
-        trace = [{"name": e["name"], "ph": "X", "ts": e["ts"],
+        trace = []
+        for e in self._events:
+            ce = {"name": e["name"], "ph": "X", "ts": e["ts"],
                   "dur": e["dur"], "pid": os.getpid(), "tid": e["tid"],
-                  "cat": e["type"]} for e in self._events]
+                  "cat": e["type"]}
+            if e.get("args"):
+                ce["args"] = e["args"]
+            trace.append(ce)
+        pid = os.getpid()
+        for s in self._memory_samples:
+            # chrome counter events: live memory renders as a graph track
+            trace.append({"name": "live_bytes", "ph": "C", "ts": s["ts"],
+                          "pid": pid,
+                          "args": {"live_bytes": s["live_bytes"]}})
         with open(path, "w") as f:
             json.dump({"traceEvents": trace,
-                       "xplane_dir": self._device_trace_dir}, f)
+                       "memory_samples": self._memory_samples,
+                       "metrics": metrics.snapshot(),
+                       "xplane_dir": self._device_trace_dir}, f,
+                      default=str)
 
     def _export_protobuf(self, path, worker_name=""):
         self._export_count += 1
@@ -284,6 +355,23 @@ class Profiler:
             ev.start_us = float(e["ts"])
             ev.dur_us = float(e["dur"])
             ev.tid = int(e["tid"])
+            for k, v in (e.get("args") or {}).items():
+                kv = ev.args.add()
+                kv.key = str(k)
+                kv.value = json.dumps(v, default=str)
+        for s in self._memory_samples:
+            ms = t.memory_samples.add()
+            ms.ts_us = float(s["ts"])
+            ms.step = int(s["step"])
+            ms.live_arrays = int(s["live_arrays"])
+            ms.live_bytes = int(s["live_bytes"])
+            ms.device_bytes_in_use = int(s.get("device_bytes_in_use", 0))
+            ms.device_peak_bytes = int(s.get("device_peak_bytes", 0))
+            ms.device = s.get("device", "")
+        for k, v in metrics.snapshot().items():
+            kv = t.metrics.add()
+            kv.key = k
+            kv.value = json.dumps(v, default=str)
         with open(path, "wb") as f:
             f.write(t.SerializeToString())
 
@@ -297,19 +385,41 @@ class Profiler:
     def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
                 thread_sep=False, time_unit="ms", views=None):
         self._collect()
+        # OperatorView: per-op totals + dispatch-path breakdown (the
+        # path rides in span args, recorded by core/dispatch)
         agg = {}
         for e in self._events:
-            a = agg.setdefault(e["name"],
-                               {"calls": 0, "total": 0.0, "max": 0.0})
+            a = agg.setdefault(
+                e["name"], {"calls": 0, "total": 0.0, "max": 0.0,
+                            "paths": {}})
             a["calls"] += 1
             a["total"] += e["dur"]
             a["max"] = max(a["max"], e["dur"])
-        lines = ["{:<40} {:>8} {:>12} {:>12} {:>12}".format(
-            "Name", "Calls", "Total(us)", "Avg(us)", "Max(us)")]
+            path = (e.get("args") or {}).get("path")
+            if path:
+                a["paths"][path] = a["paths"].get(path, 0) + 1
+        lines = ["{:<40} {:>8} {:>12} {:>12} {:>12}  {}".format(
+            "Name", "Calls", "Total(us)", "Avg(us)", "Max(us)",
+            "Paths(path=calls)")]
         for name, a in sorted(agg.items(), key=lambda kv: -kv[1]["total"]):
-            lines.append("{:<40} {:>8} {:>12.1f} {:>12.1f} {:>12.1f}".format(
-                name[:40], a["calls"], a["total"],
-                a["total"] / a["calls"], a["max"]))
+            paths = ",".join(f"{k}={v}"
+                             for k, v in sorted(a["paths"].items()))
+            lines.append(
+                "{:<40} {:>8} {:>12.1f} {:>12.1f} {:>12.1f}  {}".format(
+                    name[:40], a["calls"], a["total"],
+                    a["total"] / a["calls"], a["max"], paths))
+        if self._memory_samples:
+            # MemoryView (reference profiler_statistic.py memory table)
+            lines.append("")
+            lines.append("{:-^72}".format(" Memory View "))
+            lines.append("{:<8} {:>14} {:>14} {:>18} {:>12}".format(
+                "Step", "LiveArrays", "LiveBytes", "DeviceInUse", "Peak"))
+            for s in self._memory_samples:
+                lines.append(
+                    "{:<8} {:>14} {:>14} {:>18} {:>12}".format(
+                        s["step"], s["live_arrays"], s["live_bytes"],
+                        s.get("device_bytes_in_use", "-"),
+                        s.get("device_peak_bytes", "-")))
         table = "\n".join(lines)
         print(table)
         return table

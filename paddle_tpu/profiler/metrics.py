@@ -1,0 +1,274 @@
+"""Always-on runtime metrics registry.
+
+The reference ships a profiler that must be armed to see anything; the
+questions that actually come up in production ("is the lazy-vjp cache
+hitting", "how often do deferred chains flush", "is jit recompiling every
+step") need counters that are ALWAYS live, cost ~a dict hit + int add per
+event, and can be snapshotted at any moment without pausing the program.
+
+Three instrument kinds, Prometheus-shaped:
+
+- ``Counter``   — monotone event count (``inc``)
+- ``Gauge``     — last-write-wins level (``set`` / ``add``)
+- ``Histogram`` — value distribution (``observe``): count / sum / min /
+  max plus fixed-bound bucket counts
+
+All mutation is lock-guarded (instrumented code runs from worker threads
+— e.g. DataLoader workers dispatching ops), and ``snapshot()`` returns a
+deep copy so a reader never observes later mutation.
+
+Usage::
+
+    from paddle_tpu.profiler import metrics
+    metrics.counter("my.events").inc()
+    metrics.histogram("my.latency_us").observe(dt)
+    print(metrics.dump())          # human-readable table
+    metrics.snapshot()             # {name: value | dict} plain data
+
+XLA compile telemetry rides on ``jax.monitoring``: importing this module
+subscribes a listener that folds ``/jax/core/compile/*`` durations into
+``xla.compile.count`` / ``xla.compile.seconds`` — every backend compile
+is counted no matter which layer (deferred chains, lazy-vjp jits, user
+``jax.jit``) triggered it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+__all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
+           "histogram", "snapshot", "dump", "reset", "registry"]
+
+
+class Counter:
+    """Monotonically increasing event count."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def _snap(self):
+        return self._value
+
+    def _reset(self):
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Last-write-wins level (cache sizes, live bytes, ...)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def add(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def _snap(self):
+        return self._value
+
+    def _reset(self):
+        with self._lock:
+            self._value = 0
+
+
+# default bounds suit the two native uses: chain lengths (1..64) and
+# microsecond-scale latencies — override per-histogram at creation
+_DEFAULT_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+class Histogram:
+    """Fixed-bucket distribution: bucket[i] counts observations
+    <= bounds[i]; one overflow bucket catches the rest."""
+
+    __slots__ = ("name", "bounds", "_buckets", "_count", "_sum", "_min",
+                 "_max", "_lock")
+
+    def __init__(self, name, bounds=_DEFAULT_BOUNDS):
+        self.name = name
+        self.bounds = tuple(sorted(bounds))
+        self._buckets = [0] * (len(self.bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._lock = threading.Lock()
+
+    def observe(self, v):
+        with self._lock:
+            i = 0
+            for b in self.bounds:
+                if v <= b:
+                    break
+                i += 1
+            self._buckets[i] += 1
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def _snap(self):
+        with self._lock:
+            return {"count": self._count, "sum": self._sum,
+                    "min": self._min, "max": self._max,
+                    "avg": (self._sum / self._count) if self._count else None,
+                    "buckets": dict(zip(
+                        [*map(str, self.bounds), "+inf"],
+                        list(self._buckets)))}
+
+    def _reset(self):
+        with self._lock:
+            self._buckets = [0] * (len(self.bounds) + 1)
+            self._count = 0
+            self._sum = 0.0
+            self._min = None
+            self._max = None
+
+
+class Registry:
+    """Name -> instrument. Get-or-create is locked; the returned objects
+    are cached at call sites so steady-state cost is one ``inc``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get(self, name, cls, **kw):
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = self._metrics[name] = cls(name, **kw)
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(m).__name__}, not {cls.__name__}")
+        return m
+
+    def counter(self, name):
+        return self._get(name, Counter)
+
+    def gauge(self, name):
+        return self._get(name, Gauge)
+
+    def histogram(self, name, bounds=_DEFAULT_BOUNDS):
+        return self._get(name, Histogram, bounds=bounds)
+
+    def snapshot(self):
+        """Plain-data copy of every metric, isolated from later updates."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m._snap() for name, m in items}
+
+    def dump(self, path=None):
+        """Human-readable table; optionally also written to ``path`` as
+        JSON (the snapshot) for machine consumption."""
+        snap = self.snapshot()
+        lines = ["{:<48} {}".format("metric", "value")]
+        for name in sorted(snap):
+            v = snap[name]
+            if isinstance(v, dict):
+                desc = (f"count={v['count']} sum={v['sum']:.6g}"
+                        + (f" avg={v['avg']:.6g} min={v['min']:.6g}"
+                           f" max={v['max']:.6g}" if v["count"] else ""))
+            else:
+                desc = str(v)
+            lines.append("{:<48} {}".format(name, desc))
+        text = "\n".join(lines)
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(snap, f, indent=1, sort_keys=True)
+        return text
+
+    def reset(self):
+        """Zero every registered metric (tests / between benchmark runs).
+        Instrument objects stay valid: call sites keep cached references."""
+        with self._lock:
+            items = list(self._metrics.values())
+        for m in items:
+            m._reset()
+
+
+registry = Registry()
+
+counter = registry.counter
+gauge = registry.gauge
+histogram = registry.histogram
+snapshot = registry.snapshot
+dump = registry.dump
+reset = registry.reset
+
+
+# -- XLA compile telemetry (jax.monitoring) --------------------------------
+
+_monitoring_installed = False
+
+
+def _install_jax_monitoring():
+    """Fold jax's own compile events into the registry. Idempotent; the
+    listener is module-global and permanent (jax has no unsubscribe), so
+    it filters cheaply by prefix."""
+    global _monitoring_installed
+    if _monitoring_installed:
+        return
+    try:
+        import jax.monitoring as jm
+
+        c_count = counter("xla.compile.count")
+        h_secs = histogram(
+            "xla.compile.seconds",
+            bounds=(0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60, 300))
+        c_trace = counter("xla.trace.count")
+
+        def _on_duration(event, duration, **kw):
+            # /jax/core/compile/backend_compile_duration is the real XLA
+            # compile; jaxpr_trace_duration counts python traces
+            if event.endswith("backend_compile_duration"):
+                c_count.inc()
+                h_secs.observe(duration)
+            elif event.endswith("jaxpr_trace_duration"):
+                c_trace.inc()
+
+        jm.register_event_duration_secs_listener(_on_duration)
+        _monitoring_installed = True
+    except Exception:  # noqa: BLE001 — telemetry must never break dispatch
+        _monitoring_installed = True
+
+
+_install_jax_monitoring()

@@ -1,0 +1,36 @@
+"""tools/metrics_gate.py — the dispatch-overhead smoke for the
+always-on telemetry layer, runnable in tier-1 under JAX_PLATFORMS=cpu.
+
+The budgets here are the gate's own (generous) defaults: they catch a
+gross regression — an accidental device sync, a span recorded while the
+profiler is closed, a lock held across a jax call — not scheduler
+jitter on a loaded CI box.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools"))
+
+import metrics_gate  # noqa: E402
+
+
+def test_metric_primitive_cost_in_budget():
+    assert metrics_gate.check_primitives()
+
+
+def test_dispatch_overhead_in_budget_recorder_closed():
+    ok, per_op = metrics_gate.check_dispatch_overhead()
+    assert ok, f"per-op dispatch {per_op:.1f}us over budget"
+
+
+def test_armed_profiler_ratio_bounded():
+    _, per_op = metrics_gate.check_dispatch_overhead()
+    assert metrics_gate.check_armed_ratio(per_op)
+
+
+def test_profiler_mapping_in_suite_gate():
+    import suite_gate
+    t = suite_gate.targets_for(["paddle_tpu/profiler/metrics.py"])
+    assert "tests/framework/test_telemetry.py" in t

@@ -1,0 +1,404 @@
+"""Runtime telemetry layer (ISSUE 1): the always-on metrics registry
+(`paddle_tpu.profiler.metrics`), real begin/end op spans with shape
+args, cache hit/miss counters across the dispatch layer, deferred-chain
+flush accounting, memory profiling, and the chrome/protobuf round-trip
+of all of it.
+
+Counters are process-global and other tests dispatch ops too, so every
+assertion here is DELTA-based (snapshot before, snapshot after) — never
+an absolute value.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import metrics
+
+
+def _rand(*s):
+    return np.random.default_rng(7).standard_normal(s).astype("float32")
+
+
+def _flat(snap):
+    """snapshot() with histograms flattened to their observation count."""
+    return {k: (v["count"] if isinstance(v, dict) else v)
+            for k, v in snap.items()}
+
+
+def _delta(before, after):
+    b, a = _flat(before), _flat(after)
+    return {k: a[k] - b.get(k, 0) for k in a}
+
+
+# -- metrics primitives ----------------------------------------------------
+
+def test_counter_semantics():
+    c = metrics.counter("test.ctr.basic")
+    base = c.value
+    c.inc()
+    c.inc(41)
+    assert c.value == base + 42
+    # get-or-create returns the same instrument
+    assert metrics.counter("test.ctr.basic") is c
+
+
+def test_gauge_semantics():
+    g = metrics.gauge("test.gauge.basic")
+    g.set(7)
+    assert g.value == 7
+    g.add(3)
+    assert g.value == 10
+    g.set(-1)
+    assert g.value == -1
+
+
+def test_histogram_semantics():
+    h = metrics.histogram("test.hist.basic", bounds=(1, 10, 100))
+    for v in (0.5, 5, 50, 500):
+        h.observe(v)
+    snap = metrics.snapshot()["test.hist.basic"]
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(555.5)
+    assert snap["min"] == 0.5 and snap["max"] == 500
+    assert snap["avg"] == pytest.approx(555.5 / 4)
+    assert snap["buckets"] == {"1": 1, "10": 1, "100": 1, "+inf": 1}
+
+
+def test_metric_kind_conflict_raises():
+    metrics.counter("test.kind.conflict")
+    with pytest.raises(TypeError):
+        metrics.gauge("test.kind.conflict")
+
+
+def test_snapshot_isolation():
+    c = metrics.counter("test.snap.iso")
+    h = metrics.histogram("test.snap.iso_h")
+    c.inc()
+    h.observe(3)
+    snap = metrics.snapshot()
+    frozen_c = snap["test.snap.iso"]
+    frozen_h = dict(snap["test.snap.iso_h"])
+    c.inc(100)
+    h.observe(999999)
+    assert snap["test.snap.iso"] == frozen_c
+    assert snap["test.snap.iso_h"] == frozen_h  # deep-copied, not live
+
+
+def test_thread_safety_exact_counts():
+    c = metrics.counter("test.thread.ctr")
+    h = metrics.histogram("test.thread.hist")
+    base = c.value
+    hbase = h.count
+    n_threads, per_thread = 8, 2500
+
+    def work():
+        for _ in range(per_thread):
+            c.inc()
+            h.observe(1.0)
+
+    ts = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value - base == n_threads * per_thread
+    assert h.count - hbase == n_threads * per_thread
+
+
+def test_reset_keeps_instruments_live():
+    c = metrics.counter("test.reset.ctr")
+    c.inc(5)
+    metrics.reset()
+    assert c.value == 0
+    c.inc()  # cached reference still works after reset
+    assert c.value == 1
+
+
+def test_dump_renders_table():
+    metrics.counter("test.dump.ctr").inc()
+    text = metrics.dump()
+    assert "test.dump.ctr" in text
+
+
+# -- real op spans ---------------------------------------------------------
+
+def test_operator_spans_have_real_durations_and_shapes(tmp_path):
+    prof = profiler.Profiler(record_shapes=True)
+    prof.start()
+    x = paddle.to_tensor(_rand(32, 32))
+    paddle.matmul(x, x).numpy()
+    prof.stop()
+    p = str(tmp_path / "trace.json")
+    prof.export(p)
+    trace = json.load(open(p))
+    ops = [e for e in trace["traceEvents"]
+           if e.get("cat") == "Operator" and "matmul" in e["name"]]
+    assert ops, [e["name"] for e in trace["traceEvents"]]
+    ev = ops[0]
+    assert ev["dur"] > 0  # begin/end pair, not a zero-width instant
+    assert ev["args"]["path"] in (
+        "eager", "jitted_fwd", "lazy_vjp", "eager_vjp", "deferred")
+    assert [32, 32] in ev["args"]["shapes"]
+    assert any("float32" in d for d in ev["args"]["dtypes"])
+
+
+def test_deferred_span_carries_declared_shape(tmp_path):
+    prof = profiler.Profiler(record_shapes=True)
+    prof.start()
+    x = paddle.to_tensor(_rand(8, 4))
+    y = x * 2.0  # defers: span records the DECLARED shape, no array yet
+    assert y._pending is not None
+    prof.stop()
+    p = str(tmp_path / "trace.json")
+    prof.export(p)
+    trace = json.load(open(p))
+    spans = [e for e in trace["traceEvents"]
+             if e.get("args", {}).get("path") == "deferred"]
+    assert spans
+    assert [8, 4] in spans[-1]["args"]["shapes"]
+
+
+def test_shapes_not_recorded_by_default(tmp_path):
+    prof = profiler.Profiler()  # record_shapes=False
+    prof.start()
+    x = paddle.to_tensor(_rand(4, 4))
+    paddle.matmul(x, x).numpy()
+    prof.stop()
+    p = str(tmp_path / "t.json")
+    prof.export(p)
+    trace = json.load(open(p))
+    ops = [e for e in trace["traceEvents"] if e.get("cat") == "Operator"]
+    assert ops
+    assert all("shapes" not in e.get("args", {}) for e in ops)
+
+
+def test_sync_span_on_host_read(tmp_path):
+    prof = profiler.Profiler()
+    prof.start()
+    x = paddle.to_tensor(_rand(16,))
+    (x + 1.0).numpy()  # blocking device->host read
+    prof.stop()
+    p = str(tmp_path / "t.json")
+    prof.export(p)
+    trace = json.load(open(p))
+    syncs = [e for e in trace["traceEvents"] if e.get("cat") == "Sync"]
+    assert any(e["name"] == "Tensor.numpy" for e in syncs)
+
+
+# -- dispatch / cache counters --------------------------------------------
+
+def test_fwd_cache_counters_across_repeated_calls():
+    x = paddle.to_tensor(_rand(8, 8))
+    before = metrics.snapshot()
+    for _ in range(4):
+        # shape-reducing composite op (>=3 eqns): never defers, so it
+        # exercises the jitted-forward cache
+        paddle.logsumexp(x, axis=-1).numpy()
+    d = _delta(before, metrics.snapshot())
+    assert d.get("dispatch.fwd_cache.hit", 0) >= 1
+    assert d.get("dispatch.path.jitted_fwd", 0) >= 1
+
+
+def test_train_loop_lazy_hits_and_flush_counters():
+    """The acceptance-criteria loop: after a small train loop the
+    registry shows lazy-cache hits AND deferred-chain flushes."""
+    xs = paddle.to_tensor(_rand(16, 4))
+    ys = paddle.to_tensor(_rand(16, 1))
+    w = paddle.to_tensor(np.zeros((4, 1), "float32"))
+    w.stop_gradient = False
+    before = metrics.snapshot()
+    for _ in range(4):
+        err = paddle.matmul(xs, w) - ys
+        loss = (err * err).mean()
+        loss.backward()
+        with paddle.no_grad():
+            g = w.grad
+            # deferred chain: scale + subtract batch into one flush
+            upd = (w - g * 0.1) * 1.0
+        w = paddle.to_tensor(upd.numpy())
+        w.stop_gradient = False
+    d = _delta(before, metrics.snapshot())
+    assert d.get("dispatch.bwd_cache.hit", 0) >= 1, d
+    flushes = sum(v for k, v in d.items()
+                  if k.startswith("deferred.flush."))
+    assert flushes >= 1, d
+    assert d.get("deferred.chain_len", 0) >= 1  # histogram observed
+
+
+def test_cap_flush_labeled_cap():
+    from paddle_tpu.core import deferred as dmod
+    x = paddle.to_tensor(_rand(4, 4))
+    before = metrics.snapshot()
+    y = x
+    for _ in range(dmod.DEFER_CAP + 4):
+        y = y * 1.01  # each op a unique node: chain grows to the cap
+    y.numpy()
+    d = _delta(before, metrics.snapshot())
+    # the over-cap flush keeps its specific label — the op-boundary
+    # stamp in apply() is weak and must not clobber it
+    assert d.get("deferred.flush.cap", 0) >= 1, d
+    assert d.get("deferred.reject.cap", 0) >= 1, d
+
+
+def test_noop_flush_does_not_leak_cause():
+    x = paddle.to_tensor(_rand(4, 4))
+    a = x * 2.0
+    b = a + 1.0  # a and b share the chain through a's node
+    b.numpy()    # flushes the whole chain; a's Expr gets stamped
+    # consuming a in a non-deferrable op stamps op_boundary, but its
+    # chain is already computed: nothing flushes, the stamp must not
+    # leak onto the next real flush
+    paddle.matmul(a, a).numpy()
+    before = metrics.snapshot()
+    (paddle.to_tensor(_rand(4, 4)) * 3.0).numpy()
+    d = _delta(before, metrics.snapshot())
+    assert d.get("deferred.flush.data_read", 0) == 1, d
+    assert d.get("deferred.flush.op_boundary", 0) == 0, d
+
+
+def test_eager_only_rejection_counted():
+    before = metrics.snapshot()
+    x = paddle.to_tensor(np.arange(6, dtype="int32"))
+    for _ in range(2):
+        (x + x).numpy()  # int: trivial single-eqn op stays eager
+    d = _delta(before, metrics.snapshot())
+    eager_only = sum(v for k, v in d.items()
+                     if k.startswith("dispatch.eager_only."))
+    assert eager_only + d.get("dispatch.path.eager", 0) >= 1
+
+
+def test_collective_counters():
+    before = metrics.snapshot()
+    t = paddle.to_tensor(_rand(4, 4))
+    paddle.distributed.all_reduce(t)
+    d = _delta(before, metrics.snapshot())
+    assert d.get("collective.all_reduce.calls", 0) == 1
+    assert d.get("collective.all_reduce.bytes", 0) == 4 * 4 * 4
+
+
+# -- clip/scale recompile regression (ADVICE r5 satellite) ----------------
+
+def test_clip_loop_varying_bounds_no_recompile():
+    x = paddle.to_tensor(_rand(8, 8))
+    # warm the chain jit for this structure
+    x.clip(-0.5, 0.5).numpy()
+    before = metrics.snapshot()
+    for i in range(6):
+        lo, hi = -1.0 - 0.1 * i, 1.0 + 0.1 * i
+        got = x.clip(lo, hi).numpy()
+        np.testing.assert_allclose(got, np.clip(x.numpy(), lo, hi),
+                                   rtol=1e-6)
+    d = _delta(before, metrics.snapshot())
+    # bounds ride as 0-d jit arguments: varying them reuses the compiled
+    # chain — no per-value recompiles, no _JIT_CACHE churn
+    assert d.get("deferred.jit_cache.compiles", 0) == 0, d
+    assert d.get("deferred.jit_cache.hit", 0) >= 6
+
+
+def test_scale_loop_varying_scalar_no_recompile():
+    x = paddle.to_tensor(_rand(8,))
+    paddle.scale(x, scale=2.0, bias=1.0).numpy()
+    before = metrics.snapshot()
+    for i in range(5):
+        s = 1.0 + 0.25 * i
+        got = paddle.scale(x, scale=s, bias=0.5).numpy()
+        np.testing.assert_allclose(got, x.numpy() * s + 0.5, rtol=1e-6)
+    d = _delta(before, metrics.snapshot())
+    assert d.get("deferred.jit_cache.compiles", 0) == 0, d
+
+
+def test_clip_grad_still_correct():
+    x = paddle.to_tensor(np.array([-2.0, 0.0, 2.0], "float32"))
+    x.stop_gradient = False
+    y = x.clip(-1.0, 1.0).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 0.0])
+
+
+# -- memory profiling ------------------------------------------------------
+
+def test_memory_view_populated(tmp_path, capsys):
+    prof = profiler.Profiler(profile_memory=True)
+    prof.start()
+    x = paddle.to_tensor(_rand(64, 64))
+    for _ in range(2):
+        x = paddle.matmul(x, x)
+        x.numpy()
+        prof.step()
+    prof.stop()
+    table = prof.summary()
+    assert "Memory View" in table
+    assert prof._memory_samples
+    s = prof._memory_samples[0]
+    assert s["live_arrays"] >= 1 and s["live_bytes"] > 0
+    # chrome export carries counter events + raw samples
+    p = str(tmp_path / "t.json")
+    prof.export(p)
+    trace = json.load(open(p))
+    assert trace["memory_samples"]
+    assert any(e.get("ph") == "C" for e in trace["traceEvents"])
+
+
+def test_summary_has_path_breakdown():
+    prof = profiler.Profiler()
+    prof.start()
+    x = paddle.to_tensor(_rand(8, 8))
+    paddle.matmul(x, x).numpy()
+    prof.stop()
+    table = prof.summary()
+    assert "Paths(" in table.splitlines()[0]
+    assert any("=" in ln.split()[-1] for ln in table.splitlines()[1:]
+               if "matmul" in ln)
+
+
+# -- export round-trips ----------------------------------------------------
+
+def test_protobuf_roundtrip_with_args_memory_metrics(tmp_path):
+    prof = profiler.Profiler(record_shapes=True, profile_memory=True)
+    prof.start()
+    x = paddle.to_tensor(_rand(16, 16))
+    paddle.matmul(x, x).numpy()
+    prof.step()
+    prof.stop()
+    p = str(tmp_path / "trace.pb")
+    prof.export(p, format="pb")
+    t = profiler.load_profiler_result(p)
+    ev = next(e for e in t.events if "matmul" in e.name)
+    assert ev.dur_us > 0
+    args = {kv.key: json.loads(kv.value) for kv in ev.args}
+    assert args["path"] in (
+        "eager", "jitted_fwd", "lazy_vjp", "eager_vjp", "deferred")
+    assert [16, 16] in args["shapes"]
+    assert len(t.memory_samples) >= 1
+    ms = t.memory_samples[0]
+    assert ms.live_arrays >= 1 and ms.live_bytes > 0
+    names = {kv.key for kv in t.metrics}
+    assert any(n.startswith("dispatch.path.") for n in names)
+
+
+def test_chrome_export_embeds_metrics_snapshot(tmp_path):
+    prof = profiler.Profiler()
+    prof.start()
+    x = paddle.to_tensor(_rand(4, 4))
+    (x + x).numpy()
+    prof.stop()
+    p = str(tmp_path / "t.json")
+    prof.export(p)
+    trace = json.load(open(p))
+    assert any(k.startswith("dispatch.path.") for k in trace["metrics"])
+
+
+# -- overhead guard --------------------------------------------------------
+
+def test_recorder_disabled_records_nothing():
+    from paddle_tpu.profiler import _recorder
+    assert not _recorder.enabled
+    n0 = len(_recorder.events)
+    x = paddle.to_tensor(_rand(4, 4))
+    (x + x).numpy()
+    assert len(_recorder.events) == n0

@@ -45,8 +45,11 @@ _MAP = [
     ("paddle_tpu/optimizer/", ["tests/optimizer"]),
     ("paddle_tpu/vision/", ["tests/vision"]),
     ("paddle_tpu/amp/", ["tests/amp", "tests/test_amp.py"]),
+    ("paddle_tpu/profiler/", ["tests/framework/test_profiler_protobuf.py",
+                              "tests/framework/test_telemetry.py"]),
     ("paddle_tpu/jit/", ["tests/jit"]),
     ("bench.py", []),   # bench has no pytest surface; exercised by driver
+    ("tools/metrics_gate.py", ["tests/framework/test_metrics_gate.py"]),
     ("tools/", []),
 ]
 # smoke that always runs when any paddle_tpu source changed
