@@ -135,6 +135,14 @@ def enabled():
     return bool(flags_mod.flag("FLAGS_eager_defer"))
 
 
+def passes_enabled():
+    """Graph-optimization pass pipeline toggle (paddle_tpu/passes):
+    ``FLAGS_deferred_passes`` / env ``PADDLE_TPU_PASSES=0`` reverts
+    flush to the verbatim (capture-order) compile path."""
+    from . import flags as flags_mod
+    return bool(flags_mod.flag("FLAGS_deferred_passes"))
+
+
 def _peek(t):
     """A Tensor's payload WITHOUT materializing: Expr | jax.Array."""
     pend = getattr(t, "_pending", None)
@@ -238,10 +246,24 @@ def try_defer(fn, args, kwargs, recording):
                 node_key)
 
 
+def _buffer_key(v):
+    """Secondary leaf-dedup key: the underlying device buffer. Distinct
+    jax.Array wrappers can share one buffer (e.g. ``addressable_data``
+    views handed out by distributed code); keying on the buffer pointer
+    gives CSE one leaf index per array instead of one per wrapper. None
+    when the array doesn't expose a stable pointer (sharded/committed
+    elsewhere) — id-dedup still applies."""
+    try:
+        return ("buf", v.unsafe_buffer_pointer(), v.shape, str(v.dtype))
+    except Exception:  # noqa: BLE001 — probe, not a contract
+        return None
+
+
 def _linearize(root):
     """Postorder-unique (nodes, leaves, consts): leaves deduped by array
-    id; consts collected as jit ARGUMENTS (values stay out of the cache
-    key, so loop-varying scalars don't recompile)."""
+    id, then by underlying buffer; consts collected as jit ARGUMENTS
+    (values stay out of the cache key, so loop-varying scalars don't
+    recompile)."""
     nodes, leaves, consts = [], [], []
     node_ix, leaf_ix, const_ix = {}, {}, {}
 
@@ -259,8 +281,15 @@ def _linearize(root):
             if kind == "leaf":
                 ix = leaf_ix.get(id(v))
                 if ix is None:
-                    ix = leaf_ix[id(v)] = len(leaves)
-                    leaves.append(v)
+                    bk = _buffer_key(v)
+                    if bk is not None:
+                        ix = leaf_ix.get(bk)
+                    if ix is None:
+                        ix = len(leaves)
+                        leaves.append(v)
+                        if bk is not None:
+                            leaf_ix[bk] = ix
+                    leaf_ix[id(v)] = ix
                 spec.append(("leaf", ix))
             else:
                 # dedupe by value (repr keeps -0.0 distinct): a loop
@@ -279,10 +308,68 @@ def _linearize(root):
     return nodes, leaves, consts
 
 
+def _jit_cache_insert(key, jf):
+    """Insert under the cache lock with at-cap eviction; returns the
+    winning callable and whether OUR ``jf`` won (a racing flush may have
+    inserted the same key first — only the winner counts the compile and
+    times the first call)."""
+    with _CACHE_LOCK:
+        if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
+            try:
+                _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
+                _C_JIT_EVICT.inc()
+            except (KeyError, StopIteration):
+                pass  # a racing flush already evicted
+        won = _JIT_CACHE.setdefault(key, jf)
+        return won, won is jf
+
+
+def _build_chain_jf(descr, n_leaves, out_ixs):
+    """The jitted chain interpreter BOTH flush paths compile: evaluate
+    ``descr`` (``(fn, spec, kwargs)`` in topological order, each spec a
+    list of ``(kind, index)`` refs) over ``(leaf..., const...)`` call
+    arguments and return the ``out_ixs`` value slots. Verbatim and
+    pass-optimized flushes must share this one interpreter — the pass
+    pipeline's bitwise on-vs-off equivalence is judged against exactly
+    this evaluation, so a fix applied to a private copy of the loop
+    would silently break it."""
+
+    @jax.jit
+    def jf(*arrs):
+        leaf_arrays = arrs[:n_leaves]
+        const_arrays = arrs[n_leaves:]
+        vals = []
+        for fn, spec, kw in descr:
+            argv = [leaf_arrays[ix] if kind == "leaf" else
+                    vals[ix] if kind == "node" else const_arrays[ix]
+                    for kind, ix in spec]
+            vals.append(fn(*argv, **kw))
+        return tuple(vals[i] for i in out_ixs)
+
+    return jf
+
+
+def _timed_first_call(jf, args):
+    """First call of a fresh jf pays trace+compile: time it (the
+    jax.monitoring listener in profiler.metrics counts the true backend
+    compiles; this is the end-to-end chain-build cost)."""
+    tc = time.perf_counter_ns()
+    outs = jf(*args)
+    _C_JIT_COMPILE.inc()
+    _H_COMPILE_US.observe((time.perf_counter_ns() - tc) / 1000.0)
+    return outs
+
+
 def flush(root):
     """Evaluate the chain as one jitted program. Every node still owned
     by a live Tensor is returned and stamped (shared subexpressions are
     never re-executed); returns the root's value.
+
+    With ``FLAGS_deferred_passes`` on (default) the linearized chain
+    runs through the paddle_tpu/passes pipeline (canonicalize, fold,
+    CSE, DCE) before cache lookup — smaller programs, canonical cache
+    keys; ``PADDLE_TPU_PASSES=0`` keeps the verbatim capture-order
+    compile below.
 
     The flush-counter label (data_read / op_boundary / cap) is the
     module-level cause stamped by the triggering site via
@@ -303,37 +390,16 @@ def flush(root):
     out_ixs = tuple(i for i, (e, _) in enumerate(nodes)
                     if e is root or (e.owner is not None
                                      and e.owner() is not None))
+    if passes_enabled():
+        return _flush_optimized(root, nodes, leaves, consts, out_ixs,
+                                cause, t0)
     key = (tuple((e.node_key, spec) for e, spec in nodes), out_ixs)
     jf = _JIT_CACHE.get(key)
     fresh = jf is None
     if fresh:
-        descr = [(e.fn, spec, e.kwargs) for e, spec in nodes]
-        n_leaves = len(leaves)
-
-        @jax.jit
-        def jf(*arrs):
-            leaf_arrays = arrs[:n_leaves]
-            const_arrays = arrs[n_leaves:]
-            vals = []
-            for fn, spec, kw in descr:
-                argv = [leaf_arrays[ix] if kind == "leaf" else
-                        vals[ix] if kind == "node" else const_arrays[ix]
-                        for kind, ix in spec]
-                vals.append(fn(*argv, **kw))
-            return tuple(vals[i] for i in out_ixs)
-
-        with _CACHE_LOCK:
-            if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
-                try:
-                    _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
-                    _C_JIT_EVICT.inc()
-                except (KeyError, StopIteration):
-                    pass  # a racing flush already evicted
-            won = _JIT_CACHE.setdefault(key, jf)
-            # a racing flush may have inserted the same key first: only
-            # the winner counts the compile / times the first call
-            fresh = won is jf
-            jf = won
+        jf = _build_chain_jf([(e.fn, spec, e.kwargs) for e, spec in nodes],
+                             len(leaves), out_ixs)
+        jf, fresh = _jit_cache_insert(key, jf)
     if not fresh:
         _C_JIT_HIT.inc()
     # consts ride as 0-d arrays AT THE CHAIN DTYPE — the same value a
@@ -341,13 +407,7 @@ def flush(root):
     # (memoized: a 64-op chain has ~100 consts and flushes in a loop)
     cargs = [_const_arr(c, root.dtype) for c in consts]
     if fresh:
-        # first call of a fresh jf pays trace+compile: time it (the
-        # jax.monitoring listener in profiler.metrics counts the true
-        # backend compiles; this is the end-to-end chain-build cost)
-        tc = time.perf_counter_ns()
-        outs = jf(*leaves, *cargs)
-        _C_JIT_COMPILE.inc()
-        _H_COMPILE_US.observe((time.perf_counter_ns() - tc) / 1000.0)
+        outs = _timed_first_call(jf, [*leaves, *cargs])
     else:
         outs = jf(*leaves, *cargs)
     for i, ov in zip(out_ixs, outs):
@@ -357,6 +417,54 @@ def flush(root):
                      time.perf_counter_ns() / 1000.0, "Sync",
                      {"nodes": len(nodes), "cause": cause,
                       "compiled": fresh})
+    return root.value
+
+
+def _flush_optimized(root, nodes, leaves, consts, out_ixs, cause, t0):
+    """Pass-pipeline flush: linearized chain -> ir.Graph -> PassManager
+    -> jit on the OPTIMIZED graph, keyed by its canonical structure.
+
+    Outputs may have been rewritten to leaf/const references (a chain
+    that canonicalized away entirely never compiles at all); node
+    outputs come back from the single jitted call in order."""
+    from ..passes import LEAF, NODE, Graph, default_manager
+
+    out_exprs = [nodes[i][0] for i in out_ixs]
+    g = Graph.from_linearized(nodes, leaves, consts, out_ixs, root.dtype)
+    g = default_manager().run(g)
+    node_outs = tuple(ix for kind, ix in g.outputs if kind == NODE)
+    fresh = False
+    outs = ()
+    if node_outs:
+        key = ("passes/v1", g.cache_key())
+        jf = _JIT_CACHE.get(key)
+        fresh = jf is None
+        if fresh:
+            jf = _build_chain_jf(
+                [(n.fn, n.args, n.kwargs) for n in g.nodes],
+                len(g.leaves), node_outs)
+            jf, fresh = _jit_cache_insert(key, jf)
+        if not fresh:
+            _C_JIT_HIT.inc()
+        cargs = [_const_arr(c, root.dtype) for c in g.consts]
+        if fresh:
+            outs = _timed_first_call(jf, [*g.leaves, *cargs])
+        else:
+            outs = jf(*g.leaves, *cargs)
+    it = iter(outs)
+    for expr, (kind, ix) in zip(out_exprs, g.outputs):
+        if kind == NODE:
+            expr.value = next(it)
+        elif kind == LEAF:
+            expr.value = g.leaves[ix]
+        else:  # const output: the same 0-d chain-dtype array the
+            # in-graph computation would have produced
+            expr.value = _const_arr(g.consts[ix], root.dtype)
+    if t0 is not None and _prof.enabled:
+        _prof.record("deferred_flush", t0 / 1000.0,
+                     time.perf_counter_ns() / 1000.0, "Sync",
+                     {"nodes": len(nodes), "opt_nodes": len(g.nodes),
+                      "cause": cause, "compiled": fresh})
     return root.value
 
 

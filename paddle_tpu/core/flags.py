@@ -77,6 +77,14 @@ define_flag("FLAGS_eager_defer", True,
             "batch consecutive no-grad elementwise eager ops into one "
             "jitted dispatch (core/deferred.py) — hides per-op transport "
             "RTT on remote-attached devices")
+define_flag("FLAGS_deferred_passes",
+            os.environ.get("PADDLE_TPU_PASSES", "1").lower()
+            not in ("0", "false", "off", "no"),
+            "run the graph-optimization pass pipeline (paddle_tpu/passes:"
+            " canonicalize, constant-fold, CSE, DCE) on deferred chains "
+            "between capture and jit — smaller programs, canonical jit "
+            "cache keys; PADDLE_TPU_PASSES=0 (or this flag) reverts to "
+            "the verbatim capture-order compile")
 define_flag("FLAGS_embedding_deterministic", 0,
             "deterministic embedding grad accumulation")
 define_flag("FLAGS_cudnn_deterministic", False,

@@ -223,6 +223,8 @@ _METHODS = {
     "matrix_power": matrix_power, "det": det, "slogdet": slogdet,
     "trace": linalg.trace, "eigvals": eigvals, "cov": cov,
     "corrcoef": corrcoef, "histogram": histogram, "lu": lu,
+    # extras
+    "renorm": renorm,
     # creation-ish
     "clone": clone, "tril": tril, "triu": triu, "diag": diag,
     "diagflat": diagflat,

@@ -1,0 +1,25 @@
+"""Graph-optimization passes for the deferred elementwise IR.
+
+The compile-pipeline layer between chain capture and ``jax.jit``
+(core/deferred.flush): DCE, hash-cons CSE, constant folding, and
+algebraic canonicalization over the immutable linearized chain graph.
+The reference stack dedicates `paddle/pir` pass infrastructure + the
+CINN compiler to this role; here the IR is the `_linearize` postorder
+form and every rewrite must be IEEE-bitwise-exact (docs/PASSES.md).
+
+Toggle: ``FLAGS_deferred_passes`` / env ``PADDLE_TPU_PASSES=0`` reverts
+flush to the verbatim (unoptimized) compile path.
+"""
+
+from .ir import CONST, LEAF, NODE, Graph, GraphNode  # noqa: F401
+from .canon import Canonicalize  # noqa: F401
+from .cse import HashConsCSE  # noqa: F401
+from .dce import DeadCodeElim  # noqa: F401
+from .fold import ConstantFold  # noqa: F401
+from .manager import PassManager, default_manager, default_passes  # noqa: F401
+
+__all__ = [
+    "CONST", "LEAF", "NODE", "Graph", "GraphNode",
+    "Canonicalize", "ConstantFold", "HashConsCSE", "DeadCodeElim",
+    "PassManager", "default_manager", "default_passes",
+]

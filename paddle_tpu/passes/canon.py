@@ -1,0 +1,141 @@
+"""Algebraic canonicalization: IEEE-exact identity elimination plus
+commutative-operand ordering.
+
+Two jobs, both about CACHE-KEY unification as much as program size:
+
+- identity ops vanish (``x * 1.0``, ``x / 1.0``, ``x - 0.0``,
+  ``x + (-0.0)``, ``neg(neg(x))``) — the consumer rewires to the
+  operand, DCE sweeps the husk;
+- commutative ops (``add``, ``multiply``) order their two operands
+  canonically (consts < leaves < nodes, then by index) so ``x * y`` and
+  ``y * x`` compile once between them.
+
+Only BITWISE-exact rewrites are admitted — the deferred engine promises
+flag-off-identical results, so fast-math algebra is out of bounds:
+
+- ``x + 0.0`` is NOT eliminated: for ``x = -0.0`` IEEE-754 addition
+  yields ``+0.0``, not ``x``. Only the sign-preserving ``x + (-0.0)``
+  and ``x - (+0.0)`` are identities.
+- ``x * 1.0``, ``x / 1.0`` are exact for every input (signed zeros,
+  infinities, NaN).
+- ``neg(neg(x))`` is a double sign-bit flip — exact including NaN.
+- Known, accepted exception: SIGNALING NaN payloads. Eliminating an
+  identity op returns the input array itself, while actually executing
+  the op quiets an sNaN (0x7f800001 -> 0x7fc00001), so a chain fed
+  sNaN bits (bitcast/corrupted data — no public op produces them)
+  differs from the verbatim path in the quiet bit. Quieting is
+  hardware-dependent anyway; sNaN transparency is out of scope for the
+  whole engine, matching IEEE 754 §6.2's latitude on NaN propagation.
+- ``maximum``/``minimum`` do NOT commute bitwise (``np.maximum(0., -0.)``
+  is ``-0.0`` but ``np.maximum(-0., 0.)`` is ``+0.0``) and are excluded.
+
+Rewrite decisions read CONST VALUES (which ride as jit arguments, outside
+the cache key) — that is sound because the decision itself reshapes the
+graph, so a chain where the scalar happens to be 1.0 simply maps to a
+different (smaller) cache entry than the same chain at 2.0.
+
+Ops are recognized by the identity of the fn the op library dispatches
+(jnp ufunc singletons); wrapper closures like ``scale``/``clip`` keys
+are deliberately NOT matched — their semantics live in python code this
+pass does not inspect.
+"""
+
+from __future__ import annotations
+
+import math
+
+from .ir import CONST, NODE, ref_sort_key, resolve
+
+_TABLES = None
+
+
+def _tables():
+    """(commutative fn set, rule dispatch) — built lazily so importing
+    the pass package never forces jax initialization ordering."""
+    global _TABLES
+    if _TABLES is None:
+        import jax.numpy as jnp
+        commutative = (jnp.add, jnp.multiply)
+        _TABLES = {
+            "commutative": commutative,
+            "add": jnp.add, "sub": jnp.subtract,
+            "mul": jnp.multiply, "div": jnp.divide,
+            "neg": jnp.negative,
+        }
+    return _TABLES
+
+
+def _is_neg_zero(c):
+    return c == 0.0 and math.copysign(1.0, c) < 0
+
+
+def _is_pos_zero(c):
+    return c == 0.0 and math.copysign(1.0, c) > 0
+
+
+def _identity_target(fn, args, consts, t):
+    """The reference this node is an identity of, or None."""
+    if fn is t["neg"]:
+        return None  # unary: handled by the double-neg rule in run()
+    if len(args) != 2:
+        return None
+    (k0, i0), (k1, i1) = args
+    if fn is t["add"]:
+        # x + (-0.0) == x bitwise for every x; x + (+0.0) flips -0.0
+        if k1 == CONST and _is_neg_zero(consts[i1]):
+            return args[0]
+        if k0 == CONST and _is_neg_zero(consts[i0]):
+            return args[1]
+    elif fn is t["sub"]:
+        if k1 == CONST and _is_pos_zero(consts[i1]):
+            return args[0]
+    elif fn is t["mul"]:
+        if k1 == CONST and consts[i1] == 1.0:
+            return args[0]
+        if k0 == CONST and consts[i0] == 1.0:
+            return args[1]
+    elif fn is t["div"]:
+        if k1 == CONST and consts[i1] == 1.0:
+            return args[0]
+    return None
+
+
+class Canonicalize:
+    """metric: passes.canon.rewrites"""
+
+    name = "canon"
+    metric_name = "passes.canon.rewrites"
+
+    def run(self, graph):
+        t = _tables()
+        alias = {}
+        new_nodes = []
+        count = 0
+        for i, n in enumerate(graph.nodes):
+            args = tuple(resolve(a, alias) for a in n.args)
+            if not n.kwargs:
+                # identity elimination: alias this node away
+                target = _identity_target(n.fn, args, graph.consts, t)
+                if target is None and n.fn is t["neg"] and len(args) == 1 \
+                        and args[0][0] == NODE:
+                    inner = new_nodes[args[0][1]]
+                    if inner.fn is t["neg"] and not inner.kwargs \
+                            and len(inner.args) == 1:
+                        target = inner.args[0]  # already resolved
+                if target is not None:
+                    alias[(NODE, i)] = target
+                    count += 1
+                    # keep the (now dead) husk so indices stay stable;
+                    # DCE renumbers in one sweep at the end of the pipe
+                    new_nodes.append(n.with_args(args))
+                    continue
+                if n.fn in t["commutative"] and len(args) == 2:
+                    ordered = tuple(sorted(args, key=ref_sort_key))
+                    if ordered != args:
+                        count += 1
+                        args = ordered
+            new_nodes.append(n.with_args(args))
+        if not count:
+            return graph, 0
+        outputs = tuple(resolve(o, alias) for o in graph.outputs)
+        return graph.replace(nodes=new_nodes, outputs=outputs), count

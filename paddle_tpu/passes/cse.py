@@ -1,0 +1,53 @@
+"""Hash-cons common-subexpression elimination.
+
+``_linearize`` dedupes chain nodes by python ``id`` only, so two
+structurally identical subtrees built as distinct Expr objects (a loop
+body re-applied per branch, two tensors mapped through the same formula)
+each occupy nodes, re-execute, and — worse — produce DIFFERENT jit cache
+keys for chains that compute the same program. One topological sweep
+hash-conses every node on ``(node_key, resolved args)``: later
+duplicates alias to the first occurrence, consumers rewire, and the
+orphaned husks fall to DCE.
+
+Merging identical applications of a pure fn to identical inputs is
+value-exact by construction (same computation, computed once), and
+because the hash key is STRUCTURAL (fn behavior key + argument wiring,
+never python object identity), structurally equal chains from different
+Python objects canonicalize to one cache key — one compile, then hits.
+"""
+
+from __future__ import annotations
+
+from .ir import NODE, resolve
+
+
+class HashConsCSE:
+    """metric: passes.cse.merged"""
+
+    name = "cse"
+    metric_name = "passes.cse.merged"
+
+    def run(self, graph):
+        alias = {}
+        seen = {}
+        new_nodes = []
+        count = 0
+        for i, n in enumerate(graph.nodes):
+            args = tuple(resolve(a, alias) for a in n.args)
+            try:
+                key = (n.node_key, args)
+                hash(key)
+            except TypeError:
+                new_nodes.append(n.with_args(args))
+                continue  # unhashable structural key: never merged
+            first = seen.get(key)
+            if first is not None:
+                alias[(NODE, i)] = (NODE, first)
+                count += 1
+            else:
+                seen[key] = i
+            new_nodes.append(n.with_args(args))
+        if not count:
+            return graph, 0
+        outputs = tuple(resolve(o, alias) for o in graph.outputs)
+        return graph.replace(nodes=new_nodes, outputs=outputs), count

@@ -1,0 +1,79 @@
+"""Dead-code elimination: drop everything the outputs cannot reach.
+
+Every node ``_linearize`` emits feeds the flush root by construction, so
+on a raw capture this pass is a no-op — its real job is sweeping the
+husks the OTHER passes orphan (CSE-merged duplicates, canonicalized-away
+identities, folded const subtrees) plus any leaf/const slots those husks
+were the last consumer of. Pruning matters beyond program size: dead
+slots would otherwise linger in the jit argument list (device transfers)
+and dead nodes in the cache key (spurious compile-cache misses between
+chains that optimize to the same program).
+
+Renumbering is order-preserving (surviving nodes/leaves/consts keep
+their relative order), so the output graph is a deterministic function
+of the input structure — a requirement for cache-key canonicalization.
+"""
+
+from __future__ import annotations
+
+from .ir import CONST, LEAF, NODE
+
+
+class DeadCodeElim:
+    """metric: passes.dce.removed"""
+
+    name = "dce"
+    metric_name = "passes.dce.removed"
+
+    def run(self, graph):
+        nodes = graph.nodes
+        live = set()
+        stack = [ix for kind, ix in graph.outputs if kind == NODE]
+        while stack:
+            i = stack.pop()
+            if i in live:
+                continue
+            live.add(i)
+            for kind, ix in nodes[i].args:
+                if kind == NODE and ix not in live:
+                    stack.append(ix)
+        removed = len(nodes) - len(live)
+        # leaves/consts referenced by live nodes or directly by outputs
+        used_leaves, used_consts = set(), set()
+        for i in live:
+            for kind, ix in nodes[i].args:
+                if kind == LEAF:
+                    used_leaves.add(ix)
+                elif kind == CONST:
+                    used_consts.add(ix)
+        for kind, ix in graph.outputs:
+            if kind == LEAF:
+                used_leaves.add(ix)
+            elif kind == CONST:
+                used_consts.add(ix)
+        if not removed and len(used_leaves) == len(graph.leaves) \
+                and len(used_consts) == len(graph.consts):
+            return graph, 0
+        node_map = {}
+        leaf_map = {old: new for new, old in enumerate(sorted(used_leaves))}
+        const_map = {old: new for new, old in enumerate(sorted(used_consts))}
+
+        def remap(ref):
+            kind, ix = ref
+            if kind == NODE:
+                return (NODE, node_map[ix])
+            if kind == LEAF:
+                return (LEAF, leaf_map[ix])
+            return (CONST, const_map[ix])
+
+        new_nodes = []
+        for i, n in enumerate(nodes):
+            if i not in live:
+                continue
+            node_map[i] = len(new_nodes)
+            new_nodes.append(n.with_args(remap(a) for a in n.args))
+        return graph.replace(
+            nodes=new_nodes,
+            leaves=[graph.leaves[old] for old in sorted(used_leaves)],
+            consts=[graph.consts[old] for old in sorted(used_consts)],
+            outputs=tuple(remap(o) for o in graph.outputs)), removed

@@ -1,0 +1,91 @@
+"""Constant folding: collapse const-only subtrees into single values.
+
+A node whose arguments are ALL consts computes the same value every
+execution of the structure *for the same scalar inputs* — so it is
+evaluated once here (exactly as the jitted program would: each const
+becomes a 0-d array at the CHAIN dtype via core/deferred._const_arr, and
+the node's own fn runs on those), and the result joins the graph as a
+fresh 0-d LEAF. Leaves, like consts, ride as jit call arguments, so the
+folded VALUE stays out of the compile cache key — the fold decision is
+purely structural and deterministic, keeping cache keys canonical.
+
+Evaluation is memoized on (node structural key, const value reprs,
+dtype) — ``repr`` keeps ``-0.0`` distinct from ``0.0`` exactly like the
+const memo in core/deferred.py — so steady-state loops over the same
+scalars never re-dispatch the fold.
+
+Note the engine's own capture rules (core/deferred.try_defer rejects ops
+with no tensor argument) mean chains built through the public op surface
+contain no const-only nodes today; the pass earns its place on graphs
+canonicalization produces and on IR constructed by other front ends
+(tests build such graphs directly).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .ir import CONST, LEAF, NODE, resolve
+
+_FOLD_MEMO: dict = {}
+_FOLD_MEMO_MAX = 4096
+_FOLD_LOCK = threading.Lock()
+
+
+def _eval_const_node(node, cvals, dtype):
+    """fn(*consts-as-0d-arrays) at the chain dtype, memoized; None when
+    the op refuses (fold then simply leaves the node in place)."""
+    try:
+        key = (node.node_key, tuple(repr(c) for c in cvals), str(dtype))
+    except TypeError:
+        return None
+    out = _FOLD_MEMO.get(key)
+    if out is None:
+        from ..core.deferred import _const_arr
+        try:
+            fresh = node.fn(*[_const_arr(c, dtype) for c in cvals],
+                            **node.kwargs)
+        except Exception:  # noqa: BLE001 — unfoldable op: skip, don't break
+            return None
+        if getattr(fresh, "shape", None) != () or \
+                getattr(fresh, "dtype", None) != dtype:
+            return None  # op changed rank/dtype: not a chain-safe fold
+        with _FOLD_LOCK:
+            if len(_FOLD_MEMO) > _FOLD_MEMO_MAX:
+                _FOLD_MEMO.clear()
+            out = _FOLD_MEMO.setdefault(key, fresh)
+    return out
+
+
+class ConstantFold:
+    """metric: passes.fold.folded"""
+
+    name = "fold"
+    metric_name = "passes.fold.folded"
+
+    def run(self, graph):
+        alias = {}
+        new_nodes = []
+        leaves = list(graph.leaves)
+        leaf_ix = {id(v): i for i, v in enumerate(leaves)}
+        count = 0
+        for i, n in enumerate(graph.nodes):
+            args = tuple(resolve(a, alias) for a in n.args)
+            if args and all(k == CONST for k, _ in args):
+                val = _eval_const_node(
+                    n, [graph.consts[ix] for _, ix in args], graph.dtype)
+                if val is not None:
+                    # memo returns one array object per (structure,
+                    # values): reuse its leaf slot across the graph
+                    ix = leaf_ix.get(id(val))
+                    if ix is None:
+                        ix = leaf_ix[id(val)] = len(leaves)
+                        leaves.append(val)
+                    alias[(NODE, i)] = (LEAF, ix)
+                    count += 1
+            new_nodes.append(n.with_args(args))
+        if not count:
+            return graph, 0
+        outputs = tuple(resolve(o, alias) for o in graph.outputs)
+        return graph.replace(nodes=new_nodes, leaves=leaves,
+                             outputs=outputs), count

@@ -1,0 +1,156 @@
+"""The deferred-chain IR the graph-optimization passes rewrite.
+
+This is the `_linearize` postorder form of core/deferred.py lifted into
+an immutable value: a topologically ordered tuple of ``GraphNode``s whose
+arguments are ``(kind, index)`` references into the node list, the leaf
+list (concrete jax arrays, the jit's array arguments) or the const list
+(python floats that ride as 0-d jit arguments so their VALUES stay out
+of the compile cache key).
+
+Contracts every pass must preserve (see docs/PASSES.md):
+
+- topological order: a node's ``("node", j)`` references satisfy j < i;
+- value semantics: for any leaf/const assignment, evaluating the
+  rewritten graph yields BITWISE-identical values for every output slot
+  (passes may only apply IEEE-exact rewrites — no fast-math). Sole
+  carve-out: signaling-NaN payloads, which executing an op quiets but
+  an identity elimination passes through untouched (see canon.py —
+  no public op produces sNaN bits, and quieting is hardware-dependent);
+- output arity and order: ``outputs[k]`` of the rewritten graph computes
+  the same value as ``outputs[k]`` of the input graph (the reference may
+  move between kinds, e.g. a node collapsing to a leaf);
+- structural determinism: the rewritten graph is a function of the input
+  STRUCTURE plus const values only — never of leaf array contents or
+  python object identity — so structurally equal chains map to equal
+  ``cache_key()``s.
+
+Reference analogue: `paddle/pir` keeps one Program the passes mutate in
+place under a rewrite driver; here graphs are tiny (<= DEFER_CAP nodes)
+so passes return fresh immutable graphs instead, which keeps every pass
+trivially thread-safe (chains are built and flushed from worker threads).
+"""
+
+from __future__ import annotations
+
+NODE = "node"
+LEAF = "leaf"
+CONST = "const"
+
+# canonical operand order for commutative-op sorting: consts first, then
+# leaves, then nodes, each by index — stable across structurally equal
+# chains because indices are discovery-ordered
+_KIND_RANK = {CONST: 0, LEAF: 1, NODE: 2}
+
+
+def ref_sort_key(ref):
+    kind, ix = ref
+    return (_KIND_RANK[kind], ix)
+
+
+def resolve(ref, alias):
+    """Chase an alias map ``{ref: ref}`` to its fixed point. Pass
+    implementations record rewrites as aliases and resolve argument /
+    output references through this — a single topological sweep then
+    handles arbitrarily nested rewrites (e.g. neg(neg(neg(x))))."""
+    while ref in alias:
+        ref = alias[ref]
+    return ref
+
+
+class GraphNode:
+    """One op application: ``fn(*argrefs, **kwargs)``.
+
+    ``node_key`` is the structural identity of the op — the
+    ``(fn_key, frozen kwargs)`` pair core/deferred.py precomputes per
+    Expr — and is what the jit cache key and CSE hash on; ``fn`` and
+    ``kwargs`` are carried for execution and constant folding."""
+
+    __slots__ = ("fn", "node_key", "kwargs", "args")
+
+    def __init__(self, fn, node_key, kwargs, args):
+        self.fn = fn
+        self.node_key = node_key
+        self.kwargs = kwargs
+        self.args = tuple(args)
+
+    def with_args(self, args):
+        args = tuple(args)
+        if args == self.args:
+            return self
+        return GraphNode(self.fn, self.node_key, self.kwargs, args)
+
+    def __repr__(self):
+        name = getattr(self.fn, "__name__", None) or repr(self.fn)
+        return f"GraphNode({name}, args={self.args!r})"
+
+
+class Graph:
+    """Immutable linearized chain: nodes + leaves + consts + outputs.
+
+    ``outputs`` is a tuple of references, one per requested result (the
+    flush's live-owned Exprs, root included) — duplicates allowed (CSE
+    may merge two requested nodes into one), and any kind allowed (a
+    canonicalized-away root IS its argument leaf)."""
+
+    __slots__ = ("nodes", "leaves", "consts", "outputs", "dtype")
+
+    def __init__(self, nodes, leaves, consts, outputs, dtype):
+        self.nodes = tuple(nodes)
+        self.leaves = tuple(leaves)
+        self.consts = tuple(consts)
+        self.outputs = tuple(outputs)
+        self.dtype = dtype
+
+    @classmethod
+    def from_linearized(cls, nodes, leaves, consts, out_ixs, dtype):
+        """Build from core/deferred._linearize output: ``nodes`` is the
+        postorder ``[(Expr, spec)]`` list, ``out_ixs`` the node indices
+        to return (in stamping order)."""
+        gnodes = [GraphNode(e.fn, e.node_key, e.kwargs, spec)
+                  for e, spec in nodes]
+        return cls(gnodes, leaves, consts,
+                   tuple((NODE, i) for i in out_ixs), dtype)
+
+    def cache_key(self):
+        """Structural identity for the jit cache: node ops + wiring +
+        output references. Leaf/const VALUES are excluded by design —
+        they are call arguments, so loop-varying scalars and fresh
+        device buffers reuse the compiled program."""
+        return (tuple((n.node_key, n.args) for n in self.nodes),
+                self.outputs)
+
+    def replace(self, **kw):
+        return Graph(kw.get("nodes", self.nodes),
+                     kw.get("leaves", self.leaves),
+                     kw.get("consts", self.consts),
+                     kw.get("outputs", self.outputs),
+                     kw.get("dtype", self.dtype))
+
+    def validate(self):
+        """Structural invariants (tests / debugging — not on the hot
+        path): topo order, reference bounds, output bounds."""
+        for i, n in enumerate(self.nodes):
+            for kind, ix in n.args:
+                if kind == NODE:
+                    if not 0 <= ix < i:
+                        raise ValueError(
+                            f"node {i} breaks topo order: arg node {ix}")
+                elif kind == LEAF:
+                    if not 0 <= ix < len(self.leaves):
+                        raise ValueError(f"node {i}: leaf {ix} OOB")
+                elif kind == CONST:
+                    if not 0 <= ix < len(self.consts):
+                        raise ValueError(f"node {i}: const {ix} OOB")
+                else:
+                    raise ValueError(f"node {i}: unknown kind {kind!r}")
+        for kind, ix in self.outputs:
+            bound = {NODE: len(self.nodes), LEAF: len(self.leaves),
+                     CONST: len(self.consts)}[kind]
+            if not 0 <= ix < bound:
+                raise ValueError(f"output ({kind}, {ix}) OOB")
+        return self
+
+    def __repr__(self):
+        return (f"Graph(nodes={len(self.nodes)}, leaves="
+                f"{len(self.leaves)}, consts={len(self.consts)}, "
+                f"outputs={self.outputs!r})")

@@ -1,0 +1,74 @@
+"""PassManager: the ordered rewrite pipeline core/deferred.flush runs
+between ``_linearize`` and jit-cache lookup.
+
+A pass is any object with ``name`` (short slug), ``metric_name`` (the
+``profiler.metrics`` counter fed with its rewrite count) and
+``run(graph) -> (graph, n_rewrites)`` honoring the contracts in
+``ir.Graph``'s docstring (topo order, bitwise value preservation,
+structural determinism). Adding a pass is: write the class, append an
+instance to ``default_passes()`` at the right point in the order (see
+docs/PASSES.md for the ordering rationale), done — the manager handles
+counters and timing uniformly.
+
+Default order:
+
+1. ``canon``  — identity elimination + commutative ordering (creates
+   dead husks, exposes duplicate structure)
+2. ``fold``   — const-only subtrees to folded leaves
+3. ``cse``    — hash-cons merge (benefits from canonical operand order)
+4. ``dce``    — one sweep collects everything the others orphaned
+
+Per-run cost lands in the ``passes.total_us`` histogram (the gate in
+tools/passes_gate.py budgets it); each pass's rewrite count lands in its
+own counter (``passes.dce.removed``, ``passes.cse.merged``, ...), and
+``passes.runs`` counts pipeline invocations.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..profiler import metrics as _metrics
+from .canon import Canonicalize
+from .cse import HashConsCSE
+from .dce import DeadCodeElim
+from .fold import ConstantFold
+
+_C_RUNS = _metrics.counter("passes.runs")
+_H_TOTAL_US = _metrics.histogram(
+    "passes.total_us", bounds=(10, 50, 100, 500, 1000, 5000, 10_000))
+
+
+class PassManager:
+    """Runs passes in order over an ``ir.Graph``; counts and times."""
+
+    def __init__(self, passes):
+        self.passes = list(passes)
+        self._counters = [_metrics.counter(p.metric_name)
+                          for p in self.passes]
+
+    def run(self, graph):
+        t0 = time.perf_counter_ns()
+        for p, c in zip(self.passes, self._counters):
+            graph, n = p.run(graph)
+            if n:
+                c.inc(n)
+        _C_RUNS.inc()
+        _H_TOTAL_US.observe((time.perf_counter_ns() - t0) / 1000.0)
+        return graph
+
+
+def default_passes():
+    return [Canonicalize(), ConstantFold(), HashConsCSE(), DeadCodeElim()]
+
+
+_DEFAULT = None
+
+
+def default_manager():
+    """Process-wide manager instance (passes are stateless; a benign
+    construction race just builds an equivalent pipeline)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = PassManager(default_passes())
+    return _DEFAULT

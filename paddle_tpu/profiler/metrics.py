@@ -190,16 +190,19 @@ class Registry:
     def histogram(self, name, bounds=_DEFAULT_BOUNDS):
         return self._get(name, Histogram, bounds=bounds)
 
-    def snapshot(self):
-        """Plain-data copy of every metric, isolated from later updates."""
+    def snapshot(self, prefix=None):
+        """Plain-data copy of every metric, isolated from later updates.
+        ``prefix`` restricts to one metric family (``"passes."``,
+        ``"deferred."``, ...) — what gates and tests diff against."""
         with self._lock:
             items = list(self._metrics.items())
-        return {name: m._snap() for name, m in items}
+        return {name: m._snap() for name, m in items
+                if prefix is None or name.startswith(prefix)}
 
-    def dump(self, path=None):
+    def dump(self, path=None, prefix=None):
         """Human-readable table; optionally also written to ``path`` as
         JSON (the snapshot) for machine consumption."""
-        snap = self.snapshot()
+        snap = self.snapshot(prefix)
         lines = ["{:<48} {}".format("metric", "value")]
         for name in sorted(snap):
             v = snap[name]

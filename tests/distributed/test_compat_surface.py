@@ -1,6 +1,7 @@
 """Distributed-namespace compat surface (reference
 distributed/__init__.py __all__): behavior checks for the fills."""
 
+import os
 import re
 
 import numpy as np
@@ -11,9 +12,14 @@ from paddle_tpu import distributed as dist
 from paddle_tpu import nn, optimizer
 
 
+_REF = "/root/reference/python/paddle/distributed/__init__.py"
+
+
+@pytest.mark.skipif(not os.path.exists(_REF),
+                    reason="reference checkout absent (environment "
+                           "resource probe)")
 def test_all_reference_exports_present():
-    src = open("/root/reference/python/paddle/distributed/__init__.py"
-               ).read()
+    src = open(_REF).read()
     m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
     names = re.findall(r'"([^"]+)"', m.group(1))
     missing = sorted(n for n in names if not hasattr(dist, n))

@@ -17,6 +17,16 @@ from pathlib import Path
 
 import pytest
 
+from paddle_tpu import distributed as dist
+
+# capability probe, not a version pin: launch spawns real worker
+# processes that run collectives as one multi-controller computation —
+# unimplemented on XLA's CPU backend, so known noise without a capable
+# backend
+pytestmark = pytest.mark.skipif(
+    not dist.has_multiprocess_collectives(),
+    reason="backend lacks multiprocess collectives (feature probe)")
+
 REPO = Path(__file__).resolve().parent.parent.parent
 WORKER = Path(__file__).resolve().parent / "launch_worker.py"
 

@@ -1,0 +1,366 @@
+"""Graph-optimization pass subsystem (paddle_tpu/passes).
+
+Two layers of pinning:
+
+- IR-level unit tests build ``Graph``s directly and check each pass's
+  contract in isolation (DCE reachability + slot pruning, CSE
+  hash-consing, constant folding at chain dtype, canonicalization's
+  IEEE-exactness rules);
+- equivalence property tests drive the PUBLIC op surface and assert the
+  pass pipeline is invisible: passes-on vs ``PADDLE_TPU_PASSES=0``
+  (``FLAGS_deferred_passes``) produce BITWISE-identical results across
+  randomized chains — shared subtrees, duplicated subtrees built from
+  distinct Python objects, identity ops, signed zeros/infs, inplace
+  rebinding — plus counter-pinned regressions for the cache-key
+  canonicalization this PR exists for.
+"""
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import passes
+from paddle_tpu.core import deferred
+from paddle_tpu.passes import (CONST, LEAF, NODE, Graph, GraphNode,
+                               default_manager)
+from paddle_tpu.profiler import metrics
+
+
+def _rand(*s):
+    return np.random.default_rng(0).standard_normal(s).astype("float32")
+
+
+@contextlib.contextmanager
+def _passes_flag(on):
+    prev = paddle.get_flags(["FLAGS_deferred_passes"])[
+        "FLAGS_deferred_passes"]
+    paddle.set_flags({"FLAGS_deferred_passes": on})
+    try:
+        yield
+    finally:
+        paddle.set_flags({"FLAGS_deferred_passes": prev})
+
+
+def _both_ways(build):
+    """Run ``build()`` under passes-on and passes-off; return both
+    results as numpy arrays."""
+    with _passes_flag(True):
+        on = build().numpy()
+    with _passes_flag(False):
+        off = build().numpy()
+    return on, off
+
+
+def _assert_bitwise(a, b):
+    assert a.dtype == b.dtype and a.shape == b.shape
+    assert a.tobytes() == b.tobytes(), (a, b)
+
+
+def _delta(before, after, key):
+    return after.get(key, 0) - before.get(key, 0)
+
+
+# ---------------------------------------------------------------- IR unit
+def _n(fn, args, key=None):
+    return GraphNode(fn, key or (getattr(fn, "__name__", str(fn)), ()),
+                     {}, args)
+
+
+def test_graph_validate_rejects_broken_topo_and_bounds():
+    l0 = jnp.ones((2,), jnp.float32)
+    g = Graph([_n(jnp.add, ((LEAF, 0), (CONST, 0)))], [l0], [1.5],
+              [(NODE, 0)], jnp.float32)
+    g.validate()
+    with pytest.raises(ValueError):
+        Graph([_n(jnp.add, ((NODE, 0), (CONST, 0)))], [l0], [1.5],
+              [(NODE, 0)], jnp.float32).validate()  # self-reference
+    with pytest.raises(ValueError):
+        Graph([_n(jnp.add, ((LEAF, 3), (CONST, 0)))], [l0], [1.5],
+              [(NODE, 0)], jnp.float32).validate()  # leaf OOB
+    with pytest.raises(ValueError):
+        Graph([], [l0], [], [(NODE, 0)], jnp.float32).validate()
+
+
+def test_dce_drops_unreachable_and_prunes_slots():
+    l0, l1 = jnp.ones((2,), jnp.float32), jnp.zeros((2,), jnp.float32)
+    g = Graph(
+        [_n(jnp.add, ((LEAF, 0), (CONST, 0))),       # live
+         _n(jnp.multiply, ((LEAF, 1), (CONST, 1)))],  # dead
+        [l0, l1], [1.5, 2.5], [(NODE, 0)], jnp.float32)
+    out, removed = passes.DeadCodeElim().run(g)
+    assert removed == 1
+    assert len(out.nodes) == 1 and len(out.leaves) == 1
+    assert out.consts == (1.5,)
+    assert out.outputs == ((NODE, 0),)
+    out.validate()
+
+
+def test_cse_hash_conses_duplicates():
+    l0 = jnp.ones((3,), jnp.float32)
+    dup = lambda: _n(jnp.add, ((LEAF, 0), (CONST, 0)), key=("add", ()))
+    g = Graph([dup(), dup(),
+               _n(jnp.multiply, ((NODE, 0), (NODE, 1)), key=("mul", ()))],
+              [l0], [0.5], [(NODE, 2)], jnp.float32)
+    out, merged = passes.HashConsCSE().run(g)
+    assert merged == 1
+    assert out.nodes[2].args == ((NODE, 0), (NODE, 0))
+    # the husk is swept by DCE, not CSE
+    swept, removed = passes.DeadCodeElim().run(out)
+    assert removed == 1 and len(swept.nodes) == 2
+    swept.validate()
+
+
+def test_fold_collapses_const_only_node_at_chain_dtype():
+    l0 = jnp.ones((2,), jnp.float32)
+    g = Graph([_n(jnp.add, ((CONST, 0), (CONST, 1)), key=("add", ())),
+               _n(jnp.multiply, ((LEAF, 0), (NODE, 0)), key=("mul", ()))],
+              [l0], [2.0, 3.0], [(NODE, 1)], jnp.float32)
+    out, folded = passes.ConstantFold().run(g)
+    assert folded == 1
+    # the const subtree became a fresh 0-d leaf at the chain dtype
+    assert len(out.leaves) == 2
+    val = out.leaves[1]
+    assert val.shape == () and val.dtype == jnp.float32
+    assert float(val) == 5.0
+    assert out.nodes[1].args == ((LEAF, 0), (LEAF, 1))
+    final = default_manager().run(g)
+    assert len(final.nodes) == 1 and final.consts == ()
+    final.validate()
+
+
+def test_canon_identities_are_ieee_exact_only():
+    l0 = jnp.ones((2,), jnp.float32)
+
+    def run_one(fn, consts, args):
+        g = Graph([_n(fn, args)], [l0], consts, [(NODE, 0)], jnp.float32)
+        return passes.Canonicalize().run(g)
+
+    # x * 1.0, 1.0 * x, x / 1.0, x - (+0.0), x + (-0.0): eliminated
+    for fn, c, args in [
+            (jnp.multiply, [1.0], ((LEAF, 0), (CONST, 0))),
+            (jnp.multiply, [1.0], ((CONST, 0), (LEAF, 0))),
+            (jnp.divide, [1.0], ((LEAF, 0), (CONST, 0))),
+            (jnp.subtract, [0.0], ((LEAF, 0), (CONST, 0))),
+            (jnp.add, [-0.0], ((LEAF, 0), (CONST, 0))),
+            (jnp.add, [-0.0], ((CONST, 0), (LEAF, 0)))]:
+        out, n = run_one(fn, c, args)
+        assert n == 1 and out.outputs == ((LEAF, 0),), (fn, c, args)
+    # NOT eliminated: x + (+0.0) flips -0.0; x - (-0.0); 0.0 / 1.0-like
+    # positions; divide with const on the left
+    for fn, c, args in [
+            (jnp.add, [0.0], ((LEAF, 0), (CONST, 0))),
+            (jnp.subtract, [-0.0], ((LEAF, 0), (CONST, 0))),
+            (jnp.divide, [1.0], ((CONST, 0), (LEAF, 0))),
+            (jnp.subtract, [0.0], ((CONST, 0), (LEAF, 0)))]:
+        out, n = run_one(fn, c, args)
+        assert out.outputs == ((NODE, 0),), (fn, c, args)
+
+
+def test_canon_double_negation_and_commute():
+    l0 = jnp.ones((2,), jnp.float32)
+    g = Graph([_n(jnp.negative, ((LEAF, 0),)),
+               _n(jnp.negative, ((NODE, 0),)),
+               _n(jnp.add, ((NODE, 1), (LEAF, 0)))],
+              [l0], [], [(NODE, 2)], jnp.float32)
+    out, n = passes.Canonicalize().run(g)
+    assert n == 1  # neg(neg(x)) -> x; operands then equal, no reorder
+    assert out.nodes[2].args == ((LEAF, 0), (LEAF, 0))
+    final = default_manager().run(g)
+    assert len(final.nodes) == 1  # both negs swept
+    final.validate()
+    # commutative ordering: consts < leaves < nodes
+    g2 = Graph([_n(jnp.tanh, ((LEAF, 0),)),
+                _n(jnp.add, ((NODE, 0), (LEAF, 0))),
+                _n(jnp.multiply, ((NODE, 1), (CONST, 0)))],
+               [l0], [2.0], [(NODE, 2)], jnp.float32)
+    out2, n2 = passes.Canonicalize().run(g2)
+    assert n2 == 2
+    assert out2.nodes[1].args == ((LEAF, 0), (NODE, 0))
+    assert out2.nodes[2].args == ((CONST, 0), (NODE, 1))
+
+
+# ------------------------------------------------- equivalence (public API)
+_UNARY = [
+    lambda v: v * 1.0, lambda v: v + 0.0, lambda v: v - 0.0,
+    lambda v: v / 1.0, lambda v: -(-v), lambda v: v.tanh(),
+    lambda v: v.sigmoid(), lambda v: v * 0.5, lambda v: v + 0.25,
+    lambda v: v.square(), lambda v: v.abs(), lambda v: v.exp(),
+]
+_BINARY = [lambda a, b: a + b, lambda a, b: b + a,
+           lambda a, b: a * b, lambda a, b: b * a,
+           lambda a, b: a - b, lambda a, b: a.maximum(b)]
+
+
+def _random_chain(seed, arr):
+    """Deterministic random chain over the deferrable surface with
+    shared subtrees, duplicated subtrees and identity ops."""
+    rng = np.random.default_rng(seed)
+    vals = [paddle.to_tensor(arr)]
+    for _ in range(int(rng.integers(6, 14))):
+        roll = rng.random()
+        if roll < 0.55 or len(vals) < 2:
+            v = vals[int(rng.integers(0, len(vals)))]
+            vals.append(_UNARY[int(rng.integers(0, len(_UNARY)))](v))
+        elif roll < 0.85:
+            a = vals[int(rng.integers(0, len(vals)))]
+            b = vals[int(rng.integers(0, len(vals)))]
+            vals.append(_BINARY[int(rng.integers(0, len(_BINARY)))](a, b))
+        else:
+            # duplicated subtree from distinct python objects: the same
+            # two ops applied twice to one operand, results combined
+            v = vals[int(rng.integers(0, len(vals)))]
+            i = int(rng.integers(0, len(_UNARY)))
+            j = int(rng.integers(0, len(_UNARY)))
+            vals.append(_UNARY[j](_UNARY[i](v)) + _UNARY[j](_UNARY[i](v)))
+    out = vals[-1]
+    for v in vals[:-1]:
+        if int(rng.integers(0, 2)):
+            out = out + v * 0.125  # keep a few interior nodes live
+    return out
+
+
+@pytest.mark.parametrize("trial", range(8))
+def test_property_random_chains_bitwise_equal(trial):
+    arr = (np.random.default_rng(100 + trial)
+           .standard_normal((6, 6)).astype("float32") * 0.4)
+    arr[0, 0] = -0.0  # signed zero must survive the identity rules
+    arr[0, 1] = 0.0
+    arr[1, 0] = np.inf
+    arr[1, 1] = -np.inf
+    on, off = _both_ways(lambda: _random_chain(trial, arr))
+    _assert_bitwise(on, off)
+
+
+def test_inplace_rebinding_chain_bitwise_equal():
+    arr = _rand(8)
+
+    def build():
+        x = paddle.to_tensor(arr.copy())
+        for _ in range(6):
+            x.add_(paddle.to_tensor(np.float32(0.5)))
+            x.multiply_(paddle.to_tensor(np.float32(1.0)))
+            x.subtract_(paddle.to_tensor(np.float32(0.0)))
+        assert x._pending is not None
+        return x
+
+    on, off = _both_ways(build)
+    _assert_bitwise(on, off)
+
+
+def test_bf16_chain_keeps_0d_const_dtype_discipline():
+    arr = _rand(8, 8)
+
+    def build():
+        t = paddle.to_tensor(arr).astype("bfloat16")
+        return ((t * 1.5 + 0.25).tanh() * 1.0).astype("float32")
+
+    on, off = _both_ways(build)
+    _assert_bitwise(on, off)
+
+
+# ------------------------------------------------- counter-pinned behavior
+def test_duplicated_subtree_merges_and_sweeps():
+    x = paddle.to_tensor(_rand(8, 8))
+    before = metrics.snapshot("passes.")
+    a = (x * 2.0).tanh()
+    b = (x * 2.0).tanh()  # distinct Exprs, identical structure
+    out = (a + b).numpy()
+    after = metrics.snapshot("passes.")
+    assert _delta(before, after, "passes.cse.merged") >= 1
+    assert _delta(before, after, "passes.dce.removed") >= 1
+    with _passes_flag(False):
+        a = (x * 2.0).tanh()
+        b = (x * 2.0).tanh()
+        ref = (a + b).numpy()
+    _assert_bitwise(out, ref)
+
+
+def test_structurally_equal_chains_one_compile_one_hit():
+    with deferred._CACHE_LOCK:
+        deferred._JIT_CACHE.clear()
+    before = metrics.snapshot("deferred.")
+    t1 = paddle.to_tensor(_rand(5, 3))
+    ((t1 * 0.37).sigmoid() + t1.tanh()).numpy()
+    t2 = paddle.to_tensor(_rand(5, 3) + 1.0)  # different python objects
+    ((t2 * 0.37).sigmoid() + t2.tanh()).numpy()
+    after = metrics.snapshot("deferred.")
+    assert _delta(before, after, "deferred.jit_cache.compiles") == 1
+    assert _delta(before, after, "deferred.jit_cache.hit") == 1
+
+
+def test_identity_only_chain_never_compiles():
+    x = paddle.to_tensor(_rand(4, 4))
+    x.numpy()  # settle
+    before = metrics.snapshot("deferred.")
+    got = (x * 1.0).numpy()
+    after = metrics.snapshot("deferred.")
+    assert _delta(before, after, "deferred.jit_cache.compiles") == 0
+    assert _delta(before, after, "deferred.jit_cache.hit") == 0
+    _assert_bitwise(got, x.numpy())
+
+
+def test_flag_off_reverts_to_verbatim_compile():
+    x = paddle.to_tensor(_rand(4, 4))
+    before = metrics.snapshot("passes.")
+    with _passes_flag(False):
+        ((x * 2.0).tanh() + (x * 2.0).tanh()).numpy()
+    after = metrics.snapshot("passes.")
+    assert _delta(before, after, "passes.runs") == 0
+    # and with the flag back on the pipeline runs again
+    ((x * 3.0).tanh() + (x * 3.0).tanh()).numpy()
+    assert metrics.snapshot("passes.")["passes.runs"] > after.get(
+        "passes.runs", 0)
+
+
+def test_dag_sharing_still_stamped_with_passes():
+    x = paddle.to_tensor(_rand(8))
+    base = x * 3.0
+    a = base + 1.0
+    b = base - 1.0
+    va = a.numpy()
+    assert base._pending.value is not None
+    vb = b.numpy()
+    np.testing.assert_allclose(va - vb, 2.0 * np.ones(8), rtol=1e-6)
+
+
+# ------------------------------------------------- leaf dedup (satellite)
+def test_linearize_dedups_same_buffer_different_wrappers():
+    a = jnp.asarray(_rand(4, 4))
+    alias = a.addressable_data(0)  # distinct wrapper, same device buffer
+    assert alias is not a
+    t1, t2 = paddle.to_tensor(a), paddle.to_tensor(alias)
+    y = t1 * 2.0 + t2 * 2.0
+    nodes, leaves, consts = deferred._linearize(y._pending)
+    assert len(leaves) == 1, "same buffer must be ONE leaf"
+    before = metrics.snapshot("passes.")
+    got = y.numpy()
+    after = metrics.snapshot("passes.")
+    # with one leaf index the two (x*2.0) nodes are structurally equal
+    assert _delta(before, after, "passes.cse.merged") >= 1
+    np.testing.assert_allclose(got, np.asarray(a) * 4.0, rtol=1e-6)
+
+
+def test_linearize_keeps_distinct_buffers_apart():
+    t1 = paddle.to_tensor(_rand(4, 4))
+    t2 = paddle.to_tensor(_rand(4, 4) + 1.0)
+    y = t1 * 2.0 + t2 * 2.0
+    nodes, leaves, consts = deferred._linearize(y._pending)
+    assert len(leaves) == 2
+    np.testing.assert_allclose(
+        y.numpy(), t1.numpy() * 2.0 + t2.numpy() * 2.0, rtol=1e-6)
+
+
+# ------------------------------------------------- plumbing
+def test_passes_mapping_in_suite_gate():
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "tools"))
+    import suite_gate
+    t = suite_gate.targets_for(["paddle_tpu/passes/cse.py"])
+    assert "tests/framework/test_passes.py" in t
+    t = suite_gate.targets_for(["tools/passes_gate.py"])
+    assert "tests/framework/test_passes.py" in t

@@ -1,0 +1,166 @@
+"""Equivalence + payoff gate for the deferred-chain pass pipeline.
+
+Runs a fixed corpus of chains BOTH ways — pass pipeline on vs
+``FLAGS_deferred_passes`` off (the ``PADDLE_TPU_PASSES=0`` verbatim
+path) — and asserts, in order of importance:
+
+  1. equivalence — every corpus output is BITWISE identical across the
+     two modes (the pass contract: only IEEE-exact rewrites);
+  2. payoff — the corpus actually exercises the optimizer: non-zero
+     ``passes.cse.merged`` and ``passes.dce.removed``, and the cache-key
+     canonicalization holds (two structurally-equal chains built from
+     distinct python objects = ONE compile + ONE hit);
+  3. overhead — mean pipeline cost per flush (``passes.total_us``)
+     stays under ``PASSES_GATE_BUDGET_US`` (generous: it catches an
+     accidental O(n^2) rewrite or a device sync inside a pass, not
+     scheduler jitter).
+
+Budgets are env-overridable (PASSES_GATE_*). Exit 0 on pass, 1 on fail;
+`python tools/passes_gate.py` prints one line per check. Runs under
+JAX_PLATFORMS=cpu (tier-1); wired into tools/suite_gate.py beside
+metrics_gate.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BUDGET_US = float(os.environ.get("PASSES_GATE_BUDGET_US", "2000"))
+
+
+def _corpus(paddle, np):
+    """Chain builders over a fixed input: (name, build) pairs. Each
+    build returns one Tensor; shapes/dtypes fixed so both modes trace
+    identical user programs."""
+    arr = np.random.default_rng(3).standard_normal((8, 8)) \
+        .astype("float32") * 0.4
+    arr[0, 0] = -0.0
+    arr[0, 1] = np.inf
+
+    def dup_subtree():
+        x = paddle.to_tensor(arr)
+        a = (x * 2.0).tanh()
+        b = (x * 2.0).tanh()  # distinct Exprs, equal structure
+        return a + b
+
+    def identities():
+        x = paddle.to_tensor(arr)
+        return (((x * 1.0) / 1.0 - 0.0).sigmoid() * 1.0) + (-(-x))
+
+    def shared_dag():
+        x = paddle.to_tensor(arr)
+        base = (x * 0.5 + 0.25).tanh()
+        return (base + 1.0) * (base - 1.0)
+
+    def inplace_loop():
+        x = paddle.to_tensor(arr.copy())
+        for _ in range(5):
+            x.add_(paddle.to_tensor(np.float32(0.125)))
+            x.multiply_(paddle.to_tensor(np.float32(1.0)))
+        return x
+
+    def deep_chain():
+        x = paddle.to_tensor(arr)
+        y = x
+        for i in range(12):
+            y = (y * 1.01 + 0.5 / (i + 1)).tanh()
+        return y
+
+    return [("dup_subtree", dup_subtree), ("identities", identities),
+            ("shared_dag", shared_dag), ("inplace_loop", inplace_loop),
+            ("deep_chain", deep_chain)]
+
+
+def check_equivalence_and_counters():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+
+    prev = paddle.get_flags(["FLAGS_deferred_passes"])[
+        "FLAGS_deferred_passes"]
+    before = metrics.snapshot("passes.")
+    ok = True
+    try:
+        for name, build in _corpus(paddle, np):
+            paddle.set_flags({"FLAGS_deferred_passes": True})
+            on = build().numpy()
+            paddle.set_flags({"FLAGS_deferred_passes": False})
+            off = build().numpy()
+            same = on.tobytes() == off.tobytes()
+            ok &= same
+            print(f"[passes-gate] equivalence {name}: "
+                  f"{'PASS' if same else 'FAIL (bitwise mismatch)'}")
+    finally:
+        paddle.set_flags({"FLAGS_deferred_passes": prev})
+    after = metrics.snapshot("passes.")
+    merged = after["passes.cse.merged"] - before.get("passes.cse.merged", 0)
+    removed = after["passes.dce.removed"] - before.get(
+        "passes.dce.removed", 0)
+    elim_ok = merged >= 1 and removed >= 1
+    ok &= elim_ok
+    print(f"[passes-gate] elimination: cse.merged={merged} "
+          f"dce.removed={removed} {'PASS' if elim_ok else 'FAIL'}")
+    return ok, (before, after)
+
+
+def check_cache_canonicalization():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import deferred
+    from paddle_tpu.profiler import metrics
+
+    prev = paddle.get_flags(["FLAGS_deferred_passes"])[
+        "FLAGS_deferred_passes"]
+    with deferred._CACHE_LOCK:
+        deferred._JIT_CACHE.clear()
+    before = metrics.snapshot("deferred.")
+    try:
+        # the 1-compile/1-hit claim is a property of the OPTIMIZED path:
+        # force it on for the probe chains whatever the ambient flag
+        paddle.set_flags({"FLAGS_deferred_passes": True})
+        for seed in (5, 6):  # two structurally-equal, object-distinct
+            t = paddle.to_tensor(np.random.default_rng(seed)
+                                 .standard_normal((6, 6)).astype("float32"))
+            ((t * 0.73).tanh() + t.sigmoid()).numpy()
+    finally:
+        paddle.set_flags({"FLAGS_deferred_passes": prev})
+    after = metrics.snapshot("deferred.")
+    compiles = after["deferred.jit_cache.compiles"] - before.get(
+        "deferred.jit_cache.compiles", 0)
+    hits = after["deferred.jit_cache.hit"] - before.get(
+        "deferred.jit_cache.hit", 0)
+    ok = compiles == 1 and hits == 1
+    print(f"[passes-gate] cache canonicalization: compiles={compiles} "
+          f"hits={hits} (want 1/1) {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_overhead(snaps):
+    before, after = snaps
+    b = before.get("passes.total_us") or {"count": 0, "sum": 0.0}
+    a = after["passes.total_us"]
+    runs = a["count"] - b["count"]
+    mean_us = (a["sum"] - b["sum"]) / max(runs, 1)
+    ok = mean_us < BUDGET_US
+    print(f"[passes-gate] overhead: {mean_us:.1f}us/flush over {runs} "
+          f"runs budget={BUDGET_US}us {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def main():
+    ok1, snaps = check_equivalence_and_counters()
+    ok2 = check_cache_canonicalization()
+    ok3 = check_overhead(snaps)
+    if ok1 and ok2 and ok3:
+        print("[passes-gate] PASS")
+        return 0
+    print("[passes-gate] FAIL")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,8 @@ _MAP = [
                          "tests/ops"]),
     ("paddle_tpu/core/", ["tests/core", "tests/test_autograd.py",
                           "tests/test_tensor.py", "tests/framework"]),
+    ("paddle_tpu/passes/", ["tests/framework/test_passes.py",
+                            "tests/core/test_deferred.py"]),
     ("paddle_tpu/nn/", ["tests/nn", "tests/test_oracle_sweep_api.py"]),
     ("paddle_tpu/distributed/", ["tests/distributed"]),
     ("paddle_tpu/fleet/", ["tests/distributed"]),
@@ -50,6 +52,8 @@ _MAP = [
     ("paddle_tpu/jit/", ["tests/jit"]),
     ("bench.py", []),   # bench has no pytest surface; exercised by driver
     ("tools/metrics_gate.py", ["tests/framework/test_metrics_gate.py"]),
+    ("tools/passes_gate.py", ["tests/framework/test_passes.py",
+                              "tests/core/test_deferred.py"]),
     ("tools/", []),
 ]
 # smoke that always runs when any paddle_tpu source changed
