@@ -313,6 +313,7 @@ def _dispatch_breakdown(n=2000):
 
     import paddle_tpu as paddle
     from paddle_tpu.core.dispatch import _fn_key, apply
+    from paddle_tpu.profiler import metrics
 
     x = paddle.to_tensor(np.ones((256, 256), np.float32))
     xa = x._data
@@ -327,9 +328,17 @@ def _dispatch_breakdown(n=2000):
 
     # raw jax call: the PJRT async dispatch floor
     raw = timeit(lambda: fn(xa))
-    # no-grad apply: + python arg handling / amp+flags checks / wrapping
+    # no-grad apply: + python arg handling / amp+flags checks / wrapping;
+    # the plan-cache split over the timed window shows whether the loop
+    # ran on the per-call-site fast path (steady state: all hits)
     with paddle.no_grad():
+        before = metrics.snapshot("dispatch.plan_cache.")
         nograd = timeit(lambda: apply(fn, x, name="tanh"))
+        after = metrics.snapshot("dispatch.plan_cache.")
+    plan_hit = after.get("dispatch.plan_cache.hit", 0) \
+        - before.get("dispatch.plan_cache.hit", 0)
+    plan_miss = after.get("dispatch.plan_cache.miss", 0) \
+        - before.get("dispatch.plan_cache.miss", 0)
     # recording apply (cache hit): + key build + tape node + lazy-vjp
     x.stop_gradient = False
     rec = timeit(lambda: apply(fn, x, name="tanh"))
@@ -342,6 +351,9 @@ def _dispatch_breakdown(n=2000):
         "arg_handling": round(max(nograd - raw, 0.0), 2),
         "record_overhead": round(max(rec - nograd, 0.0), 2),
         "fn_key_build": round(key, 2),
+        "plan_hit": int(plan_hit),
+        "plan_miss": int(plan_miss),
+        "plan_hit_rate": round(plan_hit / max(plan_hit + plan_miss, 1), 4),
     }
 
 

@@ -17,6 +17,7 @@ import threading
 import jax.numpy as jnp
 
 from ..core import dtype as dtype_mod
+from ..core import flags as flags_mod
 from ..core.tensor import Tensor
 
 __all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "AmpScaler",
@@ -96,11 +97,16 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
     _state.level = level
     _state.custom_white = set(custom_white_list or ())
     _state.custom_black = set(custom_black_list or ())
+    # dispatch snapshots amp-enabled per settings epoch; bump AFTER the
+    # state change so the very next op (warm call sites included)
+    # observes the toggle — no stale-snapshot window
+    flags_mod._bump_epoch()
     try:
         yield
     finally:
         (_state.enabled, _state.dtype, _state.level,
          _state.custom_white, _state.custom_black) = prev
+        flags_mod._bump_epoch()
 
 
 autocast = auto_cast

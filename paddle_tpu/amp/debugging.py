@@ -105,12 +105,17 @@ def enable_operator_stats_collection():
     global _op_stats
     _op_stats = collections.defaultdict(
         lambda: {"fp32": 0, "fp16": 0, "bf16": 0, "other": 0})
+    # dispatch keeps an epoch-gated snapshot of whether op-stats are
+    # live (it used to probe sys.modules per op); make the toggle
+    # visible to warm call sites on the very next op
+    flags_mod._bump_epoch()
 
 
 def disable_operator_stats_collection():
     global _op_stats
     stats = _op_stats
     _op_stats = None
+    flags_mod._bump_epoch()
     if stats:
         print("<{:-^120}>".format(" op list "))
         fmt = "{:<50} | {:<10} | {:<10} | {:<10} | {:<10}"

@@ -39,8 +39,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import flags as flags_mod
 from ..profiler import _recorder as _prof
 from ..profiler import metrics as _metrics
+
+# dispatch/tensor bindings resolved once at first use (module-level
+# import would cycle: dispatch itself lazily imports this module) —
+# try_defer runs per deferrable op, so the old per-call
+# ``from .dispatch import ...`` import-machinery hits were hot-path cost
+_fn_key = None
+_freeze = None
+_Tensor = None
+
+
+def _bind_dispatch():
+    global _fn_key, _freeze, _Tensor
+    from .dispatch import _fn_key as fk, _freeze as fz
+    from .tensor import Tensor
+    _fn_key, _freeze, _Tensor = fk, fz, Tensor
 
 DEFER_CAP = 64  # max unique nodes per chain before forced materialization
 
@@ -131,7 +147,6 @@ class _DtypeOnly:
 
 
 def enabled():
-    from . import flags as flags_mod
     return bool(flags_mod.flag("FLAGS_eager_defer"))
 
 
@@ -139,7 +154,6 @@ def passes_enabled():
     """Graph-optimization pass pipeline toggle (paddle_tpu/passes):
     ``FLAGS_deferred_passes`` / env ``PADDLE_TPU_PASSES=0`` reverts
     flush to the verbatim (capture-order) compile path."""
-    from . import flags as flags_mod
     return bool(flags_mod.flag("FLAGS_deferred_passes"))
 
 
@@ -170,8 +184,9 @@ def try_defer(fn, args, kwargs, recording):
 
     args are the ORIGINAL apply() args (Tensors / scalars); kwargs must
     freeze hashable. Returns an Expr carrying the declared out meta."""
-    from .dispatch import _fn_key, _freeze
-    from .tensor import Tensor
+    if _Tensor is None:
+        _bind_dispatch()
+    Tensor = _Tensor
 
     shape = None
     dtype = None

@@ -6,18 +6,38 @@ Capability parity with the reference's generated dispatch chain
 ``apply`` runs the jnp/lax forward, and — when any floating input requires
 grad — records a tape Node holding the `jax.vjp` pullback. There is no
 kernel registry to search: XLA owns kernel selection per backend.
+
+The steady-state path is organized around two caches (the reference
+avoids this cost with generated C++ ad_func chains; we cache the
+dispatch DECISION instead, the LazyTensor / PyTorch-2 per-call-site
+specialization move):
+
+- a **dispatch-plan cache**: ``(fn behavior key, per-arg
+  kind/requires-grad signature, frozen statics/kwargs)`` -> a ``_Plan``
+  holding the array/static positions, the diff set, and the already
+  built lazy-cache key — warm call sites skip ``_freeze``, key hashing,
+  and route selection entirely;
+- an **epoch-gated settings snapshot** (``_GATE``): the per-op flag
+  reads (``FLAGS_check_nan_inf``, ``FLAGS_eager_defer``), amp-enabled,
+  and the op-stats hook are re-read only when ``core.flags._EPOCH``
+  moves (``set_flags`` / ``auto_cast`` enter+exit / op-stats toggles
+  bump it), so the hot path pays one int compare instead of locked
+  registry lookups and per-call imports.
 """
 
 from __future__ import annotations
 
+import threading
 import time
+from collections import OrderedDict
 from typing import Callable
 
 import jax
 import numpy as np
 
 from . import dtype as dtype_mod
-from .autograd import Node, is_grad_enabled
+from . import flags as flags_mod
+from .autograd import Node, _grad_state, is_grad_enabled  # noqa: F401
 from .tensor import Tensor
 
 # profiler package imports only stdlib at module level — no cycle back
@@ -39,16 +59,100 @@ _C_FWD_EVICT = _metrics.counter("dispatch.fwd_cache.evictions")
 _C_BWD_HIT = _metrics.counter("dispatch.bwd_cache.hit")
 _C_BWD_MISS = _metrics.counter("dispatch.bwd_cache.miss")
 _C_BWD_EVICT = _metrics.counter("dispatch.bwd_cache.evictions")
+_C_PLAN_HIT = _metrics.counter("dispatch.plan_cache.hit")
+_C_PLAN_MISS = _metrics.counter("dispatch.plan_cache.miss")
+_C_PLAN_EVICT = _metrics.counter("dispatch.plan_cache.evictions")
+
+# rejection reasons are a closed set on the dispatch path: pre-bound
+# like the route counters (a get-or-create registry lookup per rejected
+# op was measurable on the hot no-grad path); unknown reasons still
+# lazily register so the registry stays the single source of truth
+_C_EAGER_ONLY = {r: _metrics.counter(f"dispatch.eager_only.{r}")
+                 for r in ("unhashable_key", "below_composite_threshold",
+                           "nontraceable", "nondiff_output")}
 
 
 def _count_eager_only(reason):
-    """An op was rejected from the lazy/jitted caches: count it with the
-    reason (rare events — the get-or-create lookup is fine here)."""
-    _metrics.counter(f"dispatch.eager_only.{reason}").inc()
+    """An op was rejected from the lazy/jitted caches: count it."""
+    c = _C_EAGER_ONLY.get(reason)
+    if c is None:
+        c = _C_EAGER_ONLY[reason] = _metrics.counter(
+            f"dispatch.eager_only.{reason}")
+    c.inc()
+
+
+# differentiability is a pure function of dtype and dtypes are a tiny
+# closed set at runtime — memoized so the per-arg check is one dict hit
+_DIFF_DTYPE: dict = {}
 
 
 def _differentiable(dt) -> bool:
-    return dtype_mod.is_floating_point(dt) or dtype_mod.is_complex(dt)
+    r = _DIFF_DTYPE.get(dt)
+    if r is None:
+        r = _DIFF_DTYPE[dt] = bool(dtype_mod.is_floating_point(dt)
+                                   or dtype_mod.is_complex(dt))
+    return r
+
+
+# ---------------------------------------------------------------------------
+# epoch-gated settings snapshot
+# ---------------------------------------------------------------------------
+
+class _GateState(threading.local):
+    """Per-thread snapshot of the per-op gating reads. ``epoch`` is the
+    flags-module settings epoch the snapshot was taken at; amp state is
+    thread-local, so the snapshot must be too (a toggle in one thread
+    bumps the global epoch, and each thread refreshes against its OWN
+    amp state)."""
+
+    def __init__(self):
+        self.epoch = -1  # sentinel: first op in every thread refreshes
+        self.check_naninf = False
+        self.eager_defer = True
+        self.amp_enabled = False
+        self.dbg_record = None  # amp.debugging.record_op when stats on
+
+
+_GATE = _GateState()
+
+# sibling modules bound once at the first gate refresh (module-level
+# import would cycle through the package __init__ mid-load)
+_amp_mod = None
+_dbg_mod = None
+_deferred_mod = None
+_ARR_T = None  # the concrete jax device-array type (ArrayImpl)
+
+
+def _refresh_gate(g):
+    """Re-read every epoch-gated setting (rare: only after a flags
+    mutation / autocast toggle / op-stats toggle, or a thread's first
+    op). The epoch is read FIRST: a bump racing the value reads leaves
+    a stale epoch behind, forcing another (correct) refresh next op."""
+    global _amp_mod, _dbg_mod, _deferred_mod, _ARR_T
+    e = flags_mod._EPOCH
+    if _amp_mod is None:
+        import jax.numpy as jnp
+        from .. import amp as _a
+        from ..amp import debugging as _d
+        from . import deferred as _df
+        _ARR_T = type(jnp.zeros(()))
+        _amp_mod, _dbg_mod, _deferred_mod = _a, _d, _df
+    g.check_naninf = bool(flags_mod.flag("FLAGS_check_nan_inf"))
+    g.eager_defer = bool(flags_mod.flag("FLAGS_eager_defer"))
+    g.amp_enabled = _amp_mod.amp_state().enabled
+    g.dbg_record = _dbg_mod.record_op \
+        if _dbg_mod._op_stats is not None else None
+    g.epoch = e
+    return g
+
+
+def _wrap_out(o):
+    """Wrap one op output: the slot-assignment fast constructor for the
+    dominant device-array case, the validating ``Tensor`` constructor
+    for everything else (tracers under jit, numpy, python scalars)."""
+    if type(o) is _ARR_T:
+        return Tensor._wrap(o)
+    return Tensor(o)
 
 
 # ---------------------------------------------------------------------------
@@ -64,12 +168,41 @@ def _differentiable(dt) -> bool:
 #
 # Cacheable = fn has no closure cells (excludes RNG-capturing closures like
 # dropout — recompute must be deterministic) and kwargs/static args hash.
+#
+# Both caches are LRU (move-to-end on hit, evict oldest): a hot composite
+# forward can't be evicted by a burst of one-shot keys.
 # ---------------------------------------------------------------------------
 
-_LAZY_BWD_CACHE: dict = {}
-_LAZY_FWD_CACHE: dict = {}
+_LAZY_BWD_CACHE: OrderedDict = OrderedDict()
+_LAZY_FWD_CACHE: OrderedDict = OrderedDict()
 _LAZY_BWD_CACHE_MAX = 2048
 _EAGER_ONLY = object()  # negative entry: op rejected from the lazy path
+
+
+def _lru_touch(cache, key):
+    """Move a hit entry to the MRU end. Tolerates a plain-dict stand-in
+    (tests monkeypatch the caches) and a racing eviction of the key."""
+    try:
+        cache.move_to_end(key)
+    except (AttributeError, KeyError):
+        pass
+
+
+def _evict_oldest(cache, counter):
+    """Drop the LRU entry (single atomic C call on OrderedDict); the
+    fallback branch handles plain-dict stand-ins, where insertion order
+    is the best available approximation."""
+    try:
+        cache.popitem(last=False)
+        counter.inc()
+    except KeyError:
+        pass  # a racing eviction emptied the cache
+    except TypeError:
+        try:
+            cache.pop(next(iter(cache)))
+            counter.inc()
+        except (KeyError, StopIteration, RuntimeError):
+            pass
 
 
 def _make_lazy_fwd(fn, n_payloads, arr_pos, statics, kwargs, was_tuple):
@@ -94,12 +227,12 @@ _NOT_CACHED = object()
 
 
 def _fwd_cached_call(fn, payloads, kwargs):
-    """No-grad/inference fast path: composite ops run through the same
-    cached jitted forward the recording path uses (keyed with an empty
-    diff set), instead of per-primitive eager dispatch. Returns
-    ``(out, path)`` with out = _NOT_CACHED when the op is not (yet)
-    eligible — the caller then runs the plain eager forward, and the
-    second call onward hits the cache."""
+    """No-grad/inference fallback (no dispatch plan): composite ops run
+    through the same cached jitted forward the recording path uses
+    (keyed with an empty diff set), instead of per-primitive eager
+    dispatch. Returns ``(out, path)`` with out = _NOT_CACHED when the op
+    is not (yet) eligible — the caller then runs the plain eager
+    forward, and the second call onward hits the cache."""
     arr_pos, arrs, statics = [], [], []
     for i, p in enumerate(payloads):
         if isinstance(p, (jax.Array, np.ndarray)):
@@ -126,6 +259,7 @@ def _fwd_cached_call(fn, payloads, kwargs):
     if fwd is _EAGER_ONLY:
         return _NOT_CACHED, "eager"
     _C_FWD_HIT.inc()
+    _lru_touch(_LAZY_FWD_CACHE, key)
     return fwd(*arrs), "jitted_fwd"
 
 
@@ -139,13 +273,7 @@ def _populate_fwd_cache(key, fn, n_payloads, arr_pos, statics, kwargs,
     if key in _LAZY_FWD_CACHE:
         return
     if len(_LAZY_FWD_CACHE) >= _LAZY_BWD_CACHE_MAX:
-        try:
-            _LAZY_FWD_CACHE.pop(next(iter(_LAZY_FWD_CACHE)))
-            _C_FWD_EVICT.inc()
-        except (KeyError, StopIteration, RuntimeError):
-            # concurrent evictions at the cap raced (RuntimeError is
-            # "dict changed size during iteration"); cache shrank
-            pass
+        _evict_oldest(_LAZY_FWD_CACHE, _C_FWD_EVICT)
     statics_d = dict(statics)
 
     def bound(*a):
@@ -201,6 +329,7 @@ def _lazy_bwd_for(key, fn, n_payloads, diff_idx, arr_pos, statics,
     entry = _LAZY_BWD_CACHE.get(key)
     if entry is not None and entry is not _EAGER_ONLY:
         _C_BWD_HIT.inc()
+        _lru_touch(_LAZY_BWD_CACHE, key)
         return entry
     _C_BWD_MISS.inc()
     statics_d = dict(statics)
@@ -228,13 +357,7 @@ def _lazy_bwd_for(key, fn, n_payloads, diff_idx, arr_pos, statics,
         return vjp_fn(cts)
 
     if len(_LAZY_BWD_CACHE) >= _LAZY_BWD_CACHE_MAX:
-        try:
-            _LAZY_BWD_CACHE.pop(next(iter(_LAZY_BWD_CACHE)))
-            _C_BWD_EVICT.inc()
-        except (KeyError, StopIteration, RuntimeError):
-            # concurrent evictions at the cap raced (RuntimeError is
-            # "dict changed size during iteration"); cache shrank
-            pass
+        _evict_oldest(_LAZY_BWD_CACHE, _C_BWD_EVICT)
     _LAZY_BWD_CACHE[key] = bwd
     return bwd
 
@@ -394,8 +517,9 @@ def _cell_key_fn(v, _seen=None):
 
 def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf,
                     begin=None):
-    """Fast diff path: plain eager forward + cached lazy pullback.
-    Returns wrapped outputs, or None when the op is not cacheable."""
+    """Diff fallback (no dispatch plan): plain eager forward + cached
+    lazy pullback. Returns wrapped outputs, or None when the op is not
+    cacheable."""
     arr_pos, arrs, statics = [], [], []
     for i, p in enumerate(payloads):
         if isinstance(p, (jax.Array, np.ndarray)):
@@ -421,6 +545,7 @@ def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf,
         # per-op kernels (phi/kernels/fusion). Same cacheability rules
         # as the lazy backward, so semantics are unchanged.
         _C_FWD_HIT.inc()
+        _lru_touch(_LAZY_FWD_CACHE, key)
         out = fwd(*arrs)
         was_tuple = isinstance(out, (tuple, list))
         out_tuple = tuple(out) if was_tuple else (out,)
@@ -452,6 +577,201 @@ def _try_lazy_apply(fn, payloads, diff_idx, kwargs, name, check_naninf,
     return out_tuple, _LazyVjp(bwd, arrs), was_tuple
 
 
+# ---------------------------------------------------------------------------
+# dispatch-plan cache
+# ---------------------------------------------------------------------------
+
+# per-arg signature sentinels: an ARRAY operand (Tensor payload or raw
+# array — identical for routing: a jit argument slot), a DIFF operand
+# (recording, requires-grad, differentiable dtype), or a static whose
+# FROZEN VALUE is part of the key (statics are baked into the cached
+# forward exactly as in the lazy-cache keys)
+_SIG_ARR = ("a",)
+_SIG_DIFF = ("d",)
+
+_PLAN_CACHE: OrderedDict = OrderedDict()
+_PLAN_CACHE_MAX = 4096
+
+
+class _Plan:
+    """The precomputed dispatch decision for one call-site signature:
+    where the arrays/statics sit, which args are differentiated, and the
+    lazy-cache key those positions produce. Everything here is
+    position/route information — VALUES (payloads, scalar statics) are
+    taken from the live call, so a plan can never serve stale data."""
+
+    __slots__ = ("n_args", "arr_pos", "static_pos", "diff_idx", "fwd_key")
+
+    def __init__(self, n_args, arr_pos, static_pos, diff_idx, fwd_key):
+        self.n_args = n_args
+        self.arr_pos = arr_pos
+        self.static_pos = static_pos
+        self.diff_idx = diff_idx
+        self.fwd_key = fwd_key
+
+
+def _insert_plan(plan_key):
+    """Build + insert the plan for a signature (one-time per call site);
+    the derived ``fwd_key`` matches the legacy `_fwd_cached_call` /
+    `_try_lazy_apply` key layout exactly, so plan and fallback paths
+    share the same lazy-cache entries."""
+    fnk, kwk = plan_key[0], plan_key[1]
+    arr_pos, static_pos, diff_idx, statics_f = [], [], [], []
+    for i in range(2, len(plan_key)):
+        s = plan_key[i]
+        if s is _SIG_ARR:
+            arr_pos.append(i - 2)
+        elif s is _SIG_DIFF:
+            arr_pos.append(i - 2)
+            diff_idx.append(i - 2)
+        else:
+            static_pos.append(i - 2)
+            statics_f.append((i - 2, s[1]))
+    fwd_key = (fnk, tuple(diff_idx), tuple(arr_pos), tuple(statics_f), kwk)
+    plan = _Plan(len(plan_key) - 2, tuple(arr_pos), tuple(static_pos),
+                 tuple(diff_idx), fwd_key)
+    if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
+        _evict_oldest(_PLAN_CACHE, _C_PLAN_EVICT)
+    _PLAN_CACHE[plan_key] = plan
+    return plan
+
+
+def _plan_apply_nograd(plan, fn, payloads, arrs, kwargs, name,
+                       check_naninf, t0, g):
+    """Steady-state no-grad dispatch: one lazy-cache get decides jitted
+    vs eager; outputs wrap through the slot-assignment constructor."""
+    fwd = _LAZY_FWD_CACHE.get(plan.fwd_key)
+    if fwd is not None and fwd is not _EAGER_ONLY:
+        _C_FWD_HIT.inc()
+        _lru_touch(_LAZY_FWD_CACHE, plan.fwd_key)
+        out = fwd(*arrs)
+        _C_PATH_JITFWD.inc()
+        path = "jitted_fwd"
+    else:
+        if fwd is None:
+            _C_FWD_MISS.inc()
+            out = fn(*payloads, **kwargs)
+            _populate_fwd_cache(
+                plan.fwd_key, fn, plan.n_args, plan.arr_pos,
+                tuple((i, payloads[i]) for i in plan.static_pos),
+                kwargs, isinstance(out, (tuple, list)), arrs)
+        else:
+            out = fn(*payloads, **kwargs)
+        _C_PATH_EAGER.inc()
+        path = "eager"
+    if t0 is not None or check_naninf or g.dbg_record is not None:
+        _post_op_hooks(name, out if isinstance(out, (tuple, list))
+                       else (out,), check_naninf, begin=t0, path=path)
+    if isinstance(out, (tuple, list)):
+        return [_wrap_out(o) for o in out]
+    return _wrap_out(out)
+
+
+def _plan_apply_diff(plan, fn, args, payloads, arrs, kwargs, name,
+                     check_naninf, t0, g):
+    """Steady-state recording dispatch through the plan's prebuilt key:
+    cached (or probing) forward + cached lazy pullback + tape Node.
+    Returns _NOT_CACHED when the op must take the eager-vjp fallback
+    (same rejections the legacy path enforces)."""
+    key = plan.fwd_key
+    if _LAZY_BWD_CACHE.get(key) is _EAGER_ONLY:
+        return _NOT_CACHED
+    fwd = _LAZY_FWD_CACHE.get(key)
+    if fwd is not None and fwd is not _EAGER_ONLY:
+        _C_FWD_HIT.inc()
+        _lru_touch(_LAZY_FWD_CACHE, key)
+        out = fwd(*arrs)
+        was_tuple = isinstance(out, (tuple, list))
+        out_tuple = tuple(out) if was_tuple else (out,)
+    else:
+        if fwd is None:
+            _C_FWD_MISS.inc()
+        out = fn(*payloads, **kwargs)
+        was_tuple = isinstance(out, (tuple, list))
+        out_tuple = tuple(out) if was_tuple else (out,)
+        if not all(hasattr(o, "dtype") and _differentiable(o.dtype)
+                   for o in out_tuple):
+            _LAZY_BWD_CACHE[key] = _EAGER_ONLY
+            _count_eager_only("nondiff_output")
+            return _NOT_CACHED
+        if fwd is None:
+            _populate_fwd_cache(
+                key, fn, plan.n_args, plan.arr_pos,
+                tuple((i, payloads[i]) for i in plan.static_pos),
+                kwargs, was_tuple, arrs)
+    _C_PATH_LAZY.inc()
+    if t0 is not None or check_naninf or g.dbg_record is not None:
+        _post_op_hooks(name, out_tuple, check_naninf, begin=t0,
+                       path="lazy_vjp")
+    bwd = _lazy_bwd_for(key, fn, plan.n_args, plan.diff_idx, plan.arr_pos,
+                        tuple((i, payloads[i]) for i in plan.static_pos),
+                        kwargs, was_tuple)
+    return _finish_recorded(fn, args, payloads, plan.diff_idx, kwargs,
+                            out_tuple, _LazyVjp(bwd, arrs), was_tuple,
+                            name)
+
+
+def _finish_recorded(fn, args, payloads, diff_idx, kwargs, out_tuple,
+                     vjp_fn, was_tuple, name):
+    """Shared recording tail: tape Node + wrapped outputs."""
+    out_meta = [(o.shape, o.dtype) for o in out_tuple]
+    # fwd_fn: the node's pure forward over its diff inputs — what lets
+    # create_graph=True re-record this op's backward differentiably
+    def fwd_fn(*diff_vals):
+        full = list(payloads)
+        for pos, v in zip(diff_idx, diff_vals):
+            full[pos] = v
+        out = fn(*full, **kwargs)
+        return tuple(out) if was_tuple else (out,)
+
+    node = Node(vjp_fn, [args[i] for i in diff_idx], out_meta, name=name,
+                fwd_fn=fwd_fn,
+                primals=[payloads[i] for i in diff_idx])
+
+    outs = []
+    any_diff_out = False
+    for idx, o in enumerate(out_tuple):
+        t = _wrap_out(o)
+        if _differentiable(o.dtype):
+            t.stop_gradient = False
+            t._node = node
+            t._out_idx = idx
+            any_diff_out = True
+        outs.append(t)
+    if not any_diff_out:
+        for t in outs:
+            t._node = None
+
+    if was_tuple:
+        return outs
+    return outs[0]
+
+
+def _eager_vjp_apply(fn, args, payloads, diff_idx, kwargs, name,
+                     check_naninf, t0, g):
+    """Per-call jax.vjp fallback for ops the lazy caches reject."""
+    diff_args = [payloads[i] for i in diff_idx]
+    was_tuple = [False]
+
+    def pure(*diff_vals):
+        full = list(payloads)
+        for pos, v in zip(diff_idx, diff_vals):
+            full[pos] = v
+        out = fn(*full, **kwargs)
+        if isinstance(out, (tuple, list)):
+            was_tuple[0] = True
+            return tuple(out)
+        return (out,)
+
+    out_tuple, vjp_fn = jax.vjp(pure, *diff_args)
+    _C_PATH_EAGER_VJP.inc()
+    if t0 is not None or check_naninf or g.dbg_record is not None:
+        _post_op_hooks(name, out_tuple, check_naninf, begin=t0,
+                       path="eager_vjp")
+    return _finish_recorded(fn, args, payloads, diff_idx, kwargs,
+                            out_tuple, vjp_fn, was_tuple[0], name)
+
+
 def apply(fn: Callable, *args, name: str = None, defer: bool = False,
           **kwargs):
     """Run ``fn`` over the payloads of ``args`` and wrap outputs as Tensors.
@@ -467,33 +787,151 @@ def apply(fn: Callable, *args, name: str = None, defer: bool = False,
       dispatching, and the whole chain runs as one jitted program at the
       first ``_data`` read — one device round trip per chain.
     """
-    name = name or getattr(fn, "__name__", "op")
     # span begin: one clock read per op, only while a Profiler records
     t0 = time.perf_counter_ns() if _prof.enabled else None
-    from ..amp import amp_state
-    if amp_state().enabled:
-        from ..amp import amp_dispatch_pre
-        args = amp_dispatch_pre(name, args)
-    from . import flags as flags_mod
-    check_naninf = flags_mod.flag("FLAGS_check_nan_inf")
-    recording = is_grad_enabled()
-    if defer and not check_naninf:
-        from . import deferred
-        if deferred.enabled():
-            expr = deferred.try_defer(fn, args, kwargs, recording)
-            if expr is not None:
-                _C_PATH_DEFERRED.inc()
+    g = _GATE
+    if g.epoch != flags_mod._EPOCH:
+        _refresh_gate(g)
+    name = name or getattr(fn, "__name__", "op")
+    if g.amp_enabled:
+        args = _amp_mod.amp_dispatch_pre(name, args)
+    check_naninf = g.check_naninf
+    recording = _grad_state.enabled
+    if defer and not check_naninf and g.eager_defer:
+        expr = _deferred_mod.try_defer(fn, args, kwargs, recording)
+        if expr is not None:
+            _C_PATH_DEFERRED.inc()
+            if t0 is not None or g.dbg_record is not None:
                 _post_op_hooks(
-                    name, (deferred._DtypeOnly(expr.dtype, expr.shape),),
+                    name,
+                    (_deferred_mod._DtypeOnly(expr.dtype, expr.shape),),
                     False, begin=t0, path="deferred")
-                return Tensor._from_pending(expr)
+            return Tensor._from_pending(expr)
+
+    # -- plan fast path: one signature build + one OrderedDict get ------
+    payloads = None
+    plan = None
+    try:
+        nargs = len(args)
+        if nargs == 1:
+            # unary specialization: no intermediate lists on the
+            # dominant 1-Tensor-arg shape; the pending check inlines
+            # Tensor._data's fast path (plain _buf read when no chain)
+            a0 = args[0]
+            if isinstance(a0, Tensor):
+                if a0._pending is None:
+                    p0 = a0._buf
+                else:
+                    _deferred_mod.note_flush_cause("op_boundary",
+                                                   weak=True)
+                    p0 = a0._data
+                s0 = _SIG_DIFF if (recording and not a0.stop_gradient
+                                   and _differentiable(p0.dtype)) \
+                    else _SIG_ARR
+            elif isinstance(a0, (jax.Array, np.ndarray)):
+                p0, s0 = a0, _SIG_ARR
+            else:
+                p0, s0 = a0, ("s", _freeze(a0))
+            plan_key = (_fn_key(fn), _freeze(kwargs) if kwargs else (),
+                        s0)
+            payloads = (p0,)
+            arrs = () if s0[0] == "s" else payloads
+        elif nargs == 2:
+            # binary specialization (x op y, x op scalar)
+            a0, a1 = args
+            if isinstance(a0, Tensor):
+                if a0._pending is None:
+                    p0 = a0._buf
+                else:
+                    _deferred_mod.note_flush_cause("op_boundary",
+                                                   weak=True)
+                    p0 = a0._data
+                s0 = _SIG_DIFF if (recording and not a0.stop_gradient
+                                   and _differentiable(p0.dtype)) \
+                    else _SIG_ARR
+            elif isinstance(a0, (jax.Array, np.ndarray)):
+                p0, s0 = a0, _SIG_ARR
+            else:
+                p0, s0 = a0, ("s", _freeze(a0))
+            if isinstance(a1, Tensor):
+                if a1._pending is None:
+                    p1 = a1._buf
+                else:
+                    _deferred_mod.note_flush_cause("op_boundary",
+                                                   weak=True)
+                    p1 = a1._data
+                s1 = _SIG_DIFF if (recording and not a1.stop_gradient
+                                   and _differentiable(p1.dtype)) \
+                    else _SIG_ARR
+            elif isinstance(a1, (jax.Array, np.ndarray)):
+                p1, s1 = a1, _SIG_ARR
+            else:
+                p1, s1 = a1, ("s", _freeze(a1))
+            plan_key = (_fn_key(fn), _freeze(kwargs) if kwargs else (),
+                        s0, s1)
+            payloads = (p0, p1)
+            if s0[0] == "s":
+                arrs = () if s1[0] == "s" else (p1,)
+            elif s1[0] == "s":
+                arrs = (p0,)
+            else:
+                arrs = payloads
+        else:
+            sig = [_fn_key(fn), _freeze(kwargs) if kwargs else ()]
+            payloads = []
+            arrs = []
+            for a in args:
+                if isinstance(a, Tensor):
+                    if a._pending is not None:
+                        _deferred_mod.note_flush_cause("op_boundary",
+                                                       weak=True)
+                    p = a._data
+                    payloads.append(p)
+                    arrs.append(p)
+                    sig.append(
+                        _SIG_DIFF if (recording and not a.stop_gradient
+                                      and _differentiable(p.dtype))
+                        else _SIG_ARR)
+                elif isinstance(a, (jax.Array, np.ndarray)):
+                    payloads.append(a)
+                    arrs.append(a)
+                    sig.append(_SIG_ARR)
+                else:
+                    payloads.append(a)
+                    sig.append(("s", _freeze(a)))
+            plan_key = tuple(sig)
+        plan = _PLAN_CACHE.get(plan_key)
+        if plan is None:
+            _C_PLAN_MISS.inc()
+            plan = _insert_plan(plan_key)
+        else:
+            # no per-hit LRU touch: it would re-hash the key every op,
+            # and a plan evicted by FIFO churn rebuilds in ~µs (unlike
+            # the lazy caches, where eviction costs a retrace)
+            _C_PLAN_HIT.inc()
+    except (TypeError, ValueError):
+        plan = None  # unplannable signature: legacy fallback below
+
+    if plan is not None:
+        if not plan.diff_idx:
+            return _plan_apply_nograd(plan, fn, payloads, arrs, kwargs,
+                                      name, check_naninf, t0, g)
+        out = _plan_apply_diff(plan, fn, args, payloads, arrs, kwargs,
+                               name, check_naninf, t0, g)
+        if out is not _NOT_CACHED:
+            return out
+        return _eager_vjp_apply(fn, args, payloads, plan.diff_idx,
+                                kwargs, name, check_naninf, t0, g)
+
+    # -- fallback: unplannable fn/args (unhashable key, bound method,
+    # tensor-in-static, ...) — the pre-plan dispatch logic, preserving
+    # every cacheability rejection and counter exactly ------------------
     diff_idx = []
     payloads = []
     for i, a in enumerate(args):
         if isinstance(a, Tensor):
             if a._pending is not None:
-                from . import deferred
-                deferred.note_flush_cause("op_boundary", weak=True)
+                _deferred_mod.note_flush_cause("op_boundary", weak=True)
             payloads.append(a._data)
             if recording and not a.stop_gradient and \
                     _differentiable(a._data.dtype):
@@ -509,66 +947,18 @@ def apply(fn: Callable, *args, name: str = None, defer: bool = False,
         _post_op_hooks(name, out if isinstance(out, (tuple, list))
                        else (out,), check_naninf, begin=t0, path=path)
         if isinstance(out, (tuple, list)):
-            return [Tensor(o) for o in out]
-        return Tensor(out)
+            return [_wrap_out(o) for o in out]
+        return _wrap_out(out)
 
     lazy = _try_lazy_apply(fn, payloads, diff_idx, kwargs, name,
                            check_naninf, begin=t0)
     if lazy is not None:
         _C_PATH_LAZY.inc()
-        out_tuple, vjp_fn, was_tuple_v = lazy
-        was_tuple = [was_tuple_v]
-    else:
-        diff_args = [payloads[i] for i in diff_idx]
-        was_tuple = [False]
-
-        def pure(*diff_vals):
-            full = list(payloads)
-            for pos, v in zip(diff_idx, diff_vals):
-                full[pos] = v
-            out = fn(*full, **kwargs)
-            if isinstance(out, (tuple, list)):
-                was_tuple[0] = True
-                return tuple(out)
-            return (out,)
-
-        out_tuple, vjp_fn = jax.vjp(pure, *diff_args)
-        _C_PATH_EAGER_VJP.inc()
-        _post_op_hooks(name, out_tuple, check_naninf, begin=t0,
-                       path="eager_vjp")
-    out_meta = [(o.shape, o.dtype) for o in out_tuple]
-    # fwd_fn: the node's pure forward over its diff inputs — what lets
-    # create_graph=True re-record this op's backward differentiably
-    tuple_flag = was_tuple[0]
-
-    def fwd_fn(*diff_vals):
-        full = list(payloads)
-        for pos, v in zip(diff_idx, diff_vals):
-            full[pos] = v
-        out = fn(*full, **kwargs)
-        return tuple(out) if tuple_flag else (out,)
-
-    node = Node(vjp_fn, [args[i] for i in diff_idx], out_meta, name=name,
-                fwd_fn=fwd_fn,
-                primals=[payloads[i] for i in diff_idx])
-
-    outs = []
-    any_diff_out = False
-    for idx, o in enumerate(out_tuple):
-        t = Tensor(o)
-        if _differentiable(o.dtype):
-            t.stop_gradient = False
-            t._node = node
-            t._out_idx = idx
-            any_diff_out = True
-        outs.append(t)
-    if not any_diff_out:
-        for t in outs:
-            t._node = None
-
-    if was_tuple[0]:
-        return outs
-    return outs[0]
+        out_tuple, vjp_fn, was_tuple = lazy
+        return _finish_recorded(fn, args, payloads, diff_idx, kwargs,
+                                out_tuple, vjp_fn, was_tuple, name)
+    return _eager_vjp_apply(fn, args, payloads, diff_idx, kwargs, name,
+                            check_naninf, t0, g)
 
 
 def _post_op_hooks(name, outs, check_naninf, begin=None, path="eager"):
@@ -580,7 +970,11 @@ def _post_op_hooks(name, outs, check_naninf, begin=None, path="eager"):
     span covers the full dispatch (unwrap, cache lookups, the jax call),
     so Operator events carry REAL durations, begin/end style. ``path``
     labels which dispatch route ran (eager / jitted_fwd / lazy_vjp /
-    eager_vjp / deferred) and lands in the span args."""
+    eager_vjp / deferred) and lands in the span args.
+
+    The op-stats probe is the epoch-gated ``_GATE.dbg_record`` snapshot
+    (refreshed by apply before this runs) — the old per-op ``import
+    sys`` + ``sys.modules.get`` probe was pure hot-path overhead."""
     if _prof.enabled:
         end = time.perf_counter_ns() / 1000.0
         start = end if begin is None else begin / 1000.0
@@ -592,19 +986,19 @@ def _post_op_hooks(name, outs, check_naninf, begin=None, path="eager"):
                 str(getattr(o, "dtype", "?")) for o in outs]
         _prof.record(name, start, end, "Operator", span_args)
 
-    import sys
-
-    dbg = sys.modules.get("paddle_tpu.amp.debugging")
-    if dbg is not None and getattr(dbg, "_op_stats", None) is not None:
+    rec = _GATE.dbg_record
+    if rec is not None:
         for o in outs:
             if hasattr(o, "dtype"):
-                dbg.record_op(name, o.dtype)
+                rec(name, o.dtype)
                 break
     if check_naninf:
-        from ..amp import debugging
+        dbg = _dbg_mod
+        if dbg is None:
+            from ..amp import debugging as dbg
         for o in outs:
             if hasattr(o, "dtype"):
-                debugging.check_array(name, o)
+                dbg.check_array(name, o)
 
 
 def unwrap(x):

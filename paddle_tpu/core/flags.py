@@ -13,6 +13,29 @@ import threading
 _lock = threading.Lock()
 _registry: dict[str, dict] = {}
 
+# Settings epoch: bumped on every flag mutation (and by the AMP layer on
+# autocast / op-stats toggles). Hot paths keep a snapshot of the handful
+# of per-op gate values (core/dispatch._GATE) and re-read them ONLY when
+# this counter moves — one int compare per op instead of a locked
+# registry lookup per flag. Bumps are rare, so they take a dedicated
+# lock (an unlocked `+= 1` could interleave and move the counter
+# BACKWARD past a value a snapshot was taken at, masking a later
+# change); reads stay lock-free — an int read can't tear, and a read
+# racing a bump at worst triggers one extra refresh.
+_EPOCH = 0
+_epoch_lock = threading.Lock()
+
+
+def _bump_epoch():
+    global _EPOCH
+    with _epoch_lock:
+        _EPOCH += 1
+
+
+def epoch():
+    """Current settings epoch (see core/dispatch gate snapshot)."""
+    return _EPOCH
+
 
 def define_flag(name, default, help="", type=None):
     t = type or builtin_type(default)
@@ -21,6 +44,7 @@ def define_flag(name, default, help="", type=None):
     with _lock:
         _registry[name] = {"value": value, "default": default,
                            "help": help, "type": t}
+        _bump_epoch()
 
 
 def builtin_type(v):
@@ -42,12 +66,19 @@ def _parse(s, t):
 def set_flags(flags: dict):
     """paddle.set_flags parity."""
     with _lock:
-        for name, value in flags.items():
-            if name not in _registry:
-                raise ValueError(f"unknown flag {name!r}")
-            _registry[name]["value"] = _parse(str(value),
-                                              _registry[name]["type"]) \
-                if not isinstance(value, _registry[name]["type"]) else value
+        try:
+            for name, value in flags.items():
+                if name not in _registry:
+                    raise ValueError(f"unknown flag {name!r}")
+                _registry[name]["value"] = _parse(
+                    str(value), _registry[name]["type"]) \
+                    if not isinstance(value, _registry[name]["type"]) \
+                    else value
+        finally:
+            # bump even on an unknown-name error: names BEFORE the bad
+            # one were already applied, and a skipped bump would leave
+            # warm gate snapshots silently stale on those values
+            _bump_epoch()
 
 
 def get_flags(flags):

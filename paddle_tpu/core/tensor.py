@@ -106,6 +106,26 @@ class Tensor:
         self._pending = None
 
     @classmethod
+    def _wrap(cls, arr):
+        """Wrap an op-output jax array as a fresh no-grad Tensor with
+        direct slot assignment — no ``__init__`` type sniffing or dtype
+        coercion. The dispatch fast path calls this once per op output,
+        so every store here is on the per-op budget; callers guarantee
+        ``arr`` is already a device array (dispatch falls back to the
+        validating constructor for anything else)."""
+        t = cls.__new__(cls)
+        t._buf = arr
+        t._pending = None
+        t.grad = None
+        t.stop_gradient = True
+        t._node = None
+        t._out_idx = 0
+        t.name = None
+        t.persistable = False
+        t._dist_attr = None
+        return t
+
+    @classmethod
     def _from_pending(cls, expr):
         """Wrap a deferred Expr as a (no-grad) Tensor without running it."""
         t = cls.__new__(cls)

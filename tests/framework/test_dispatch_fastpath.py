@@ -1,0 +1,346 @@
+"""The dispatch-plan fast path (core/dispatch.apply).
+
+Pins the three contracts the per-call-site plan cache must keep:
+
+- **counters**: plan hit/miss/eviction metrics move exactly as the
+  cache does, and every op still lands in one ``dispatch.path.*`` route;
+- **epoch invalidation**: a WARM call site observes ``set_flags``
+  (check_nan_inf, eager_defer), ``amp.auto_cast`` entry AND exit, and
+  op-stats toggles on the very next op — no stale-snapshot window —
+  and a requires-grad flip on an input re-routes the same call site;
+- **LRU + thread safety**: the lazy fwd/bwd caches keep hot entries
+  under one-shot-key bursts (move-to-end on hit, counter-pinned), and
+  concurrent plan-cache population/eviction never corrupts dispatch.
+
+Counters are process-global and other tests dispatch ops too, so every
+assertion is a before/after delta.
+"""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import dispatch
+from paddle_tpu.core import flags as flags_mod
+from paddle_tpu.core.dispatch import apply
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.profiler import metrics
+
+
+def _rand(*s):
+    return np.random.default_rng(7).standard_normal(s).astype("float32")
+
+
+def _delta(before, after):
+    return {k: after.get(k, 0) - before.get(k, 0)
+            for k in set(after) | set(before)
+            if not isinstance(after.get(k), dict)}
+
+
+def _mk_unary(c):
+    """Distinct closure constant -> distinct _fn_key -> fresh plan."""
+    def f(a):
+        return a * c
+    return f
+
+
+# -- plan-cache counters ---------------------------------------------------
+
+def test_plan_cache_miss_then_hit_counters():
+    fn = _mk_unary(1.25077)
+    x = paddle.to_tensor(_rand(4, 4))
+    with paddle.no_grad():
+        before = metrics.snapshot("dispatch.plan_cache.")
+        y1 = apply(fn, x, name="u")
+        mid = metrics.snapshot("dispatch.plan_cache.")
+        y2 = apply(fn, x, name="u")
+        after = metrics.snapshot("dispatch.plan_cache.")
+    assert _delta(before, mid)["dispatch.plan_cache.miss"] == 1
+    assert _delta(before, mid)["dispatch.plan_cache.hit"] == 0
+    assert _delta(mid, after)["dispatch.plan_cache.hit"] == 1
+    assert _delta(mid, after)["dispatch.plan_cache.miss"] == 0
+    np.testing.assert_allclose(y1.numpy(), x.numpy() * 1.25077, rtol=1e-6)
+    np.testing.assert_allclose(y2.numpy(), y1.numpy())
+
+
+def test_every_planned_op_still_routes_exactly_once():
+    x = paddle.to_tensor(_rand(8, 8))
+    y = paddle.to_tensor(_rand(8, 8))
+    before = metrics.snapshot("dispatch.path.")
+    with paddle.no_grad():
+        for _ in range(5):
+            apply(jnp.matmul, x, y, name="matmul")
+            apply(jnp.tanh, x, name="tanh")
+    d = _delta(before, metrics.snapshot("dispatch.path."))
+    assert sum(d.values()) == 10, d
+
+
+def test_scalar_static_keys_plan_by_value():
+    """Statics are part of the plan key: same call site, different
+    scalar -> different plan; repeated scalar -> hit. Values must stay
+    correct either way."""
+    fn = _mk_unary(3.0)  # closure makes the fn unique to this test
+    x = paddle.to_tensor(_rand(4,))
+    with paddle.no_grad():
+        before = metrics.snapshot("dispatch.plan_cache.")
+        a = apply(jnp.add, x, 41.5, name="adds")
+        b = apply(jnp.add, x, 42.5, name="adds")   # new static value
+        c = apply(jnp.add, x, 41.5, name="adds")   # back to the first
+        del fn
+    d = _delta(before, metrics.snapshot("dispatch.plan_cache."))
+    assert d["dispatch.plan_cache.hit"] >= 1
+    np.testing.assert_allclose(a.numpy(), x.numpy() + 41.5, rtol=1e-6)
+    np.testing.assert_allclose(b.numpy(), x.numpy() + 42.5, rtol=1e-6)
+    np.testing.assert_allclose(c.numpy(), a.numpy())
+
+
+# -- epoch invalidation ----------------------------------------------------
+
+def test_flags_epoch_bumps_on_set_flags():
+    e0 = flags_mod.epoch()
+    paddle.set_flags({"FLAGS_benchmark": False})
+    assert flags_mod.epoch() > e0
+
+
+def test_partial_set_flags_failure_still_bumps_epoch():
+    """An unknown name mid-dict raises AFTER earlier names applied;
+    the epoch must still bump or warm snapshots would silently miss
+    the applied values."""
+    x = paddle.to_tensor(np.array([-1.0], np.float32))
+    with paddle.no_grad():
+        apply(jnp.log, x, name="partial_probe")  # warm the site
+    prev = paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+    try:
+        with pytest.raises(ValueError):
+            paddle.set_flags({"FLAGS_check_nan_inf": True,
+                              "FLAGS_not_a_real_flag": 1})
+        # dict order applied check_nan_inf before the bad name: the very
+        # next op through the warm site must see it
+        assert paddle.get_flags("FLAGS_check_nan_inf")[
+            "FLAGS_check_nan_inf"] is True
+        with paddle.no_grad(), pytest.raises(FloatingPointError):
+            apply(jnp.log, x, name="partial_probe")
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": prev})
+
+
+def test_warm_site_observes_check_nan_inf_next_op():
+    """Warm the call site with the flag off, flip it on, and the VERY
+    NEXT op through the same site must run the nan check."""
+    x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32))
+    with paddle.no_grad():
+        apply(jnp.log, x, name="log_naninf_probe")  # warm (nan output ok)
+    prev = paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+    level = paddle.get_flags("FLAGS_check_nan_inf_level")[
+        "FLAGS_check_nan_inf_level"]
+    try:
+        paddle.set_flags({"FLAGS_check_nan_inf": True,
+                          "FLAGS_check_nan_inf_level": 0})
+        with paddle.no_grad(), pytest.raises(FloatingPointError):
+            apply(jnp.log, x, name="log_naninf_probe")
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": prev,
+                          "FLAGS_check_nan_inf_level": level})
+    # and off again: the same warm site stops checking immediately
+    with paddle.no_grad():
+        apply(jnp.log, x, name="log_naninf_probe")
+
+
+def test_warm_site_observes_autocast_entry_and_exit():
+    x = paddle.to_tensor(_rand(8, 8))
+    y = paddle.to_tensor(_rand(8, 8))
+    with paddle.no_grad():
+        out = paddle.matmul(x, y)          # warm, amp off
+        assert str(out.dtype) == "float32"
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out_amp = paddle.matmul(x, y)  # same warm site, amp on
+            assert str(out_amp.dtype) == "bfloat16"
+        out2 = paddle.matmul(x, y)         # amp off again on exit
+        assert str(out2.dtype) == "float32"
+
+
+def test_warm_site_observes_eager_defer_toggle():
+    x = paddle.to_tensor(_rand(4, 4))
+    prev = paddle.get_flags("FLAGS_eager_defer")["FLAGS_eager_defer"]
+    try:
+        paddle.set_flags({"FLAGS_eager_defer": True})
+        (x * 1.5).numpy()  # warm the deferrable site
+        before = metrics.snapshot("dispatch.path.")
+        (x * 1.5).numpy()
+        d = _delta(before, metrics.snapshot("dispatch.path."))
+        assert d["dispatch.path.deferred"] >= 1, d
+        paddle.set_flags({"FLAGS_eager_defer": False})
+        before = metrics.snapshot("dispatch.path.")
+        (x * 1.5).numpy()
+        d = _delta(before, metrics.snapshot("dispatch.path."))
+        assert d["dispatch.path.deferred"] == 0, d
+        assert sum(d.values()) >= 1, d  # it still dispatched somewhere
+    finally:
+        paddle.set_flags({"FLAGS_eager_defer": prev})
+
+
+def test_warm_site_observes_op_stats_toggle():
+    from paddle_tpu.amp import debugging as dbg
+    x = paddle.to_tensor(_rand(4, 4))
+    with paddle.no_grad():
+        apply(jnp.cosh, x, name="opstats_probe")  # warm, stats off
+        stats = None
+        try:
+            dbg.enable_operator_stats_collection()
+            apply(jnp.cosh, x, name="opstats_probe")
+        finally:
+            stats = dbg.disable_operator_stats_collection()
+        apply(jnp.cosh, x, name="opstats_probe")  # off again: no record
+    assert stats is not None and stats["opstats_probe"]["fp32"] == 1
+
+
+def test_requires_grad_flip_reroutes_warm_site():
+    """The same call site must re-route when an input starts requiring
+    grad: nograd route first (eager/jitted_fwd), then a recorded route
+    (lazy_vjp/eager_vjp) with a working backward."""
+    fn = _mk_unary(2.5)
+    x = paddle.to_tensor(_rand(4, 4))
+    for _ in range(2):
+        apply(fn, x, name="flip")  # warm the nograd plan
+    before = metrics.snapshot("dispatch.path.")
+    x.stop_gradient = False
+    y = apply(fn, x, name="flip")
+    d = _delta(before, metrics.snapshot("dispatch.path."))
+    assert d.get("dispatch.path.lazy_vjp", 0) \
+        + d.get("dispatch.path.eager_vjp", 0) == 1, d
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((4, 4), 2.5),
+                               rtol=1e-6)
+
+
+# -- lazy-cache LRU (move-to-end on hit) -----------------------------------
+
+def _mk_composite(c):
+    """>= 3 primitives so the fwd cache stores a real jitted entry."""
+    def f(a):
+        return jnp.tanh(a * c) + c
+    return f
+
+
+def test_fwd_cache_lru_keeps_hot_entry_under_burst(monkeypatch):
+    monkeypatch.setattr(dispatch, "_LAZY_BWD_CACHE_MAX", 8)
+    hot = _mk_composite(0.7731)
+    x = paddle.to_tensor(_rand(4, 4))
+    with paddle.no_grad():
+        apply(hot, x, name="hot")  # probe + populate
+        apply(hot, x, name="hot")  # first hit
+        for i in range(30):        # one-shot burst well past the cap
+            apply(_mk_composite(1.0 + i * 1e-4), x, name=f"burst{i}")
+            before = metrics.snapshot("dispatch.fwd_cache.")
+            apply(hot, x, name="hot")  # touch the hot key every op
+            d = _delta(before, metrics.snapshot("dispatch.fwd_cache."))
+            assert d["dispatch.fwd_cache.hit"] == 1, \
+                f"hot entry evicted by one-shot burst at i={i}: {d}"
+            assert d["dispatch.fwd_cache.miss"] == 0
+
+
+def test_bwd_cache_lru_keeps_hot_entry_under_burst(monkeypatch):
+    monkeypatch.setattr(dispatch, "_LAZY_BWD_CACHE_MAX", 8)
+    hot = _mk_composite(0.3317)
+    x = paddle.to_tensor(_rand(4, 4))
+    x.stop_gradient = False
+    apply(hot, x, name="hot").sum().backward()  # miss + build
+    for i in range(20):
+        apply(_mk_composite(2.0 + i * 1e-4), x,
+              name=f"burst{i}").sum().backward()
+        before = metrics.snapshot("dispatch.bwd_cache.")
+        apply(hot, x, name="hot").sum().backward()
+        d = _delta(before, metrics.snapshot("dispatch.bwd_cache."))
+        # >= 1: the window also covers the (warm, shared) sum/backward
+        # bwd lookups; the pin is miss == 0 — the hot entry survived
+        assert d["dispatch.bwd_cache.hit"] >= 1, \
+            f"hot bwd evicted by one-shot burst at i={i}: {d}"
+        assert d["dispatch.bwd_cache.miss"] == 0
+
+
+# -- pre-bound rejection counters ------------------------------------------
+
+def test_eager_only_counters_prebound_and_extensible():
+    before = metrics.snapshot("dispatch.eager_only.")
+    dispatch._count_eager_only("unhashable_key")
+    dispatch._count_eager_only("some_new_reason")
+    d = _delta(before, metrics.snapshot("dispatch.eager_only."))
+    assert d["dispatch.eager_only.unhashable_key"] == 1
+    assert d["dispatch.eager_only.some_new_reason"] == 1
+
+
+def test_unhashable_kwargs_still_dispatch_eagerly():
+    x = paddle.to_tensor(_rand(4,))
+    before = metrics.snapshot("dispatch.")
+
+    def f(a, tag=None):
+        return a * 2.0
+
+    with paddle.no_grad():
+        # a set survives _freeze unhashable -> the op can't be planned
+        # or lazily cached, and must still dispatch eagerly
+        y = apply(f, x, name="unh", tag={"not", "hashable"})
+    d = _delta(before, metrics.snapshot("dispatch."))
+    assert d["dispatch.eager_only.unhashable_key"] == 1
+    assert d["dispatch.path.eager"] == 1
+    assert d["dispatch.plan_cache.miss"] == 0  # never entered the cache
+    np.testing.assert_allclose(y.numpy(), x.numpy() * 2.0, rtol=1e-6)
+
+
+# -- fast constructor ------------------------------------------------------
+
+def test_tensor_wrap_fast_constructor_defaults():
+    arr = jnp.ones((3, 2), jnp.float32)
+    t = Tensor._wrap(arr)
+    assert t._buf is arr and t._pending is None
+    assert t.stop_gradient is True and t.grad is None
+    assert t._node is None and t._out_idx == 0
+    assert t.name is None and t.persistable is False
+    assert t.shape == [3, 2]
+
+
+# -- thread-safety smoke ---------------------------------------------------
+
+def test_concurrent_plan_population_and_eviction(monkeypatch):
+    monkeypatch.setattr(dispatch, "_PLAN_CACHE_MAX", 16)
+    dispatch._PLAN_CACHE.clear()  # start at zero so the cap binds
+    errs = []
+    xs = paddle.to_tensor(_rand(4, 4))
+
+    def worker(seed):
+        try:
+            fns = [_mk_unary(10.0 + seed + i * 1e-3) for i in range(12)]
+            with paddle.no_grad():
+                for _ in range(6):
+                    for j, f in enumerate(fns):
+                        out = apply(f, xs, name="t")
+                        np.testing.assert_allclose(
+                            out.numpy(),
+                            xs.numpy() * (10.0 + seed + j * 1e-3),
+                            rtol=1e-5)
+        except Exception as e:  # noqa: BLE001 — surfaced via errs
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(4)]
+    before = metrics.snapshot("dispatch.plan_cache.")
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    d = _delta(before, metrics.snapshot("dispatch.plan_cache."))
+    assert not errs, errs
+    assert d["dispatch.plan_cache.evictions"] > 0, d
+    assert len(dispatch._PLAN_CACHE) <= 16 + 4  # cap modulo racing inserts
+
+
+# -- the CPU-host gate -----------------------------------------------------
+
+def test_dispatch_gate_passes():
+    import importlib
+    import tools.dispatch_gate as gate
+    importlib.reload(gate)
+    assert gate.main() == 0

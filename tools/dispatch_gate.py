@@ -1,0 +1,132 @@
+"""Dispatch fast-path gate: the plan cache must keep paying.
+
+The dispatch-plan cache (core/dispatch.apply) exists to hold
+``apply_nograd - raw_jax_call`` — the pure-python per-op overhead — near
+the PJRT call floor. This gate pins the two properties that make it a
+perf feature instead of a cache that happens to exist:
+
+  1. overhead — median per-op python overhead (apply() minus the raw
+     jax call of the same fn) over a fixed op corpus stays under
+     ``DISPATCH_GATE_BUDGET_US`` (generous: it catches a reintroduced
+     per-op import/lock/freeze on the hot path, not scheduler jitter);
+  2. plan-cache payoff — the steady-state corpus runs with a warm-loop
+     hit rate of at least ``DISPATCH_GATE_HIT_RATE`` and a nonzero
+     ``dispatch.plan_cache.hit`` delta, and every timed op still lands
+     in exactly one ``dispatch.path.*`` route counter.
+
+Budgets are env-overridable (DISPATCH_GATE_*). Exit 0 on pass, 1 on
+fail; `python tools/dispatch_gate.py` prints one line per check. Runs
+under JAX_PLATFORMS=cpu (tier-1); wired into tools/suite_gate.py beside
+metrics_gate/passes_gate.
+"""
+
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BUDGET_US = float(os.environ.get("DISPATCH_GATE_BUDGET_US", "120"))
+HIT_RATE = float(os.environ.get("DISPATCH_GATE_HIT_RATE", "0.9"))
+N = int(os.environ.get("DISPATCH_GATE_N", "300"))
+
+
+def _med_us(fn, k, trials=3):
+    outs = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            fn()
+        outs.append((time.perf_counter() - t0) * 1e6 / k)
+    return statistics.median(outs)
+
+
+def _corpus():
+    """(name, fn, paddle-arg builder) triples: the steady-state op mix
+    the plan cache must serve — unary, binary, scalar-static, kwarg'd
+    reduction. Module-level jnp callables so every trial is the same
+    call site."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((64, 64)).astype("float32"))
+    y = paddle.to_tensor(np.random.default_rng(1)
+                         .standard_normal((64, 64)).astype("float32"))
+    return [
+        ("tanh", jnp.tanh, (x,), {}),
+        ("add", jnp.add, (x, y), {}),
+        ("matmul", jnp.matmul, (x, y), {}),
+        ("sum_axis", jnp.sum, (x,), {"axis": -1}),
+    ]
+
+
+def check_overhead():
+    import paddle_tpu as paddle
+    from paddle_tpu.core.dispatch import apply, unwrap
+
+    ok = True
+    overheads = []
+    with paddle.no_grad():
+        for name, fn, args, kwargs in _corpus():
+            payloads = tuple(unwrap(a) for a in args)
+            raw = _med_us(lambda: fn(*payloads, **kwargs), N)
+            wrapped = _med_us(
+                lambda: apply(fn, *args, name=name, **kwargs), N)
+            overheads.append(max(wrapped - raw, 0.0))
+            print(f"[dispatch-gate] {name}: raw={raw:.1f}us "
+                  f"apply={wrapped:.1f}us "
+                  f"overhead={max(wrapped - raw, 0.0):.1f}us")
+    med = statistics.median(overheads)
+    ok = med < BUDGET_US
+    print(f"[dispatch-gate] overhead: median={med:.1f}us "
+          f"budget={BUDGET_US}us {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_plan_cache():
+    import paddle_tpu as paddle
+    from paddle_tpu.core.dispatch import apply
+    from paddle_tpu.profiler import metrics
+
+    corpus = _corpus()
+    with paddle.no_grad():
+        for name, fn, args, kwargs in corpus:  # warm: plans built here
+            apply(fn, *args, name=name, **kwargs)
+        before = metrics.snapshot("dispatch.")
+        for _ in range(50):
+            for name, fn, args, kwargs in corpus:
+                apply(fn, *args, name=name, **kwargs)
+        after = metrics.snapshot("dispatch.")
+
+    def d(key):
+        return after.get(key, 0) - before.get(key, 0)
+
+    n_ops = 50 * len(corpus)
+    hits = d("dispatch.plan_cache.hit")
+    misses = d("dispatch.plan_cache.miss")
+    rate = hits / max(hits + misses, 1)
+    routed = sum(d(k) for k in after if k.startswith("dispatch.path."))
+    ok = hits > 0 and rate >= HIT_RATE and routed == n_ops
+    print(f"[dispatch-gate] plan cache: hit={hits} miss={misses} "
+          f"rate={rate:.3f} (want >={HIT_RATE}) routed={routed}/{n_ops} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def main():
+    ok1 = check_overhead()
+    ok2 = check_plan_cache()
+    if ok1 and ok2:
+        print("[dispatch-gate] PASS")
+        return 0
+    print("[dispatch-gate] FAIL")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

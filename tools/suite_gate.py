@@ -54,6 +54,8 @@ _MAP = [
     ("tools/metrics_gate.py", ["tests/framework/test_metrics_gate.py"]),
     ("tools/passes_gate.py", ["tests/framework/test_passes.py",
                               "tests/core/test_deferred.py"]),
+    ("tools/dispatch_gate.py",
+     ["tests/framework/test_dispatch_fastpath.py"]),
     ("tools/", []),
 ]
 # smoke that always runs when any paddle_tpu source changed
