@@ -40,8 +40,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import flags as flags_mod
+from . import resilience as _resilience
 from ..profiler import _recorder as _prof
 from ..profiler import metrics as _metrics
+from ..testing import faults as _faults
 
 # dispatch/tensor bindings resolved once at first use (module-level
 # import would cycle: dispatch itself lazily imports this module) —
@@ -68,6 +70,7 @@ _JIT_CACHE_MAX = 512
 # worker thread — all structural mutation goes through this lock
 _CACHE_LOCK = threading.Lock()
 
+_C_EAGER_REPLAY = _metrics.counter("deferred.flush.eager_replay")
 _C_JIT_HIT = _metrics.counter("deferred.jit_cache.hit")
 _C_JIT_COMPILE = _metrics.counter("deferred.jit_cache.compiles")
 _C_JIT_EVICT = _metrics.counter("deferred.jit_cache.evictions")
@@ -339,26 +342,30 @@ def _jit_cache_insert(key, jf):
         return won, won is jf
 
 
+def _eval_chain(descr, leaf_arrays, const_arrays):
+    """THE chain interpreter every flush rung runs: evaluate ``descr``
+    (``(fn, spec, kwargs)`` in topological order, each spec a list of
+    ``(kind, index)`` refs) over leaf/const arrays; returns all value
+    slots. Verbatim and pass-optimized flushes trace it under jit and
+    the rung-2 eager replay calls it directly — the ladder's fidelity
+    contract is judged against exactly this evaluation, so a fix
+    applied to a private copy of the loop would silently break it."""
+    vals = []
+    for fn, spec, kw in descr:
+        argv = [leaf_arrays[ix] if kind == "leaf" else
+                vals[ix] if kind == "node" else const_arrays[ix]
+                for kind, ix in spec]
+        vals.append(fn(*argv, **kw))
+    return vals
+
+
 def _build_chain_jf(descr, n_leaves, out_ixs):
-    """The jitted chain interpreter BOTH flush paths compile: evaluate
-    ``descr`` (``(fn, spec, kwargs)`` in topological order, each spec a
-    list of ``(kind, index)`` refs) over ``(leaf..., const...)`` call
-    arguments and return the ``out_ixs`` value slots. Verbatim and
-    pass-optimized flushes must share this one interpreter — the pass
-    pipeline's bitwise on-vs-off equivalence is judged against exactly
-    this evaluation, so a fix applied to a private copy of the loop
-    would silently break it."""
+    """Jit-wrap ``_eval_chain`` returning the ``out_ixs`` slots — what
+    both compile paths cache."""
 
     @jax.jit
     def jf(*arrs):
-        leaf_arrays = arrs[:n_leaves]
-        const_arrays = arrs[n_leaves:]
-        vals = []
-        for fn, spec, kw in descr:
-            argv = [leaf_arrays[ix] if kind == "leaf" else
-                    vals[ix] if kind == "node" else const_arrays[ix]
-                    for kind, ix in spec]
-            vals.append(fn(*argv, **kw))
+        vals = _eval_chain(descr, arrs[:n_leaves], arrs[n_leaves:])
         return tuple(vals[i] for i in out_ixs)
 
     return jf
@@ -375,6 +382,14 @@ def _timed_first_call(jf, args):
     return outs
 
 
+def _run_chain(jf, args, fresh):
+    """Execute a (possibly fresh) chain program. The injection site is
+    where a real backend failure surfaces — jax traces/compiles on the
+    first call and can raise RESOURCE_EXHAUSTED from either."""
+    _faults.site("deferred.compile")
+    return _timed_first_call(jf, args) if fresh else jf(*args)
+
+
 def flush(root):
     """Evaluate the chain as one jitted program. Every node still owned
     by a live Tensor is returned and stamped (shared subexpressions are
@@ -384,7 +399,22 @@ def flush(root):
     runs through the paddle_tpu/passes pipeline (canonicalize, fold,
     CSE, DCE) before cache lookup — smaller programs, canonical cache
     keys; ``PADDLE_TPU_PASSES=0`` keeps the verbatim capture-order
-    compile below.
+    compile.
+
+    Degradation ladder (``FLAGS_flush_degradation``, default on): a
+    failure never kills the step as long as the captured ops themselves
+    are sound. Each rung re-executes the SAME captured chain, so every
+    rung is bitwise-identical to the healthy path (chaos-gate pinned):
+
+      rung 0  pass pipeline + jit          (healthy)
+      rung 1  any optimized-path failure   -> verbatim compile, the
+              disjoint non-``passes/v1`` cache namespace
+      rung 2  verbatim compile/run failure -> eager op-by-op replay,
+              no jit at all (bitwise caveat: see _flush_eager)
+
+    Rungs count ``resilience.degrade.flush.{retry_verbatim,
+    eager_replay}`` and append watchdog flight records. Ladder off =
+    strict mode: the first exception propagates.
 
     The flush-counter label (data_read / op_boundary / cap) is the
     module-level cause stamped by the triggering site via
@@ -405,9 +435,35 @@ def flush(root):
     out_ixs = tuple(i for i, (e, _) in enumerate(nodes)
                     if e is root or (e.owner is not None
                                      and e.owner() is not None))
+    ladder = bool(flags_mod.flag("FLAGS_flush_degradation"))
     if passes_enabled():
-        return _flush_optimized(root, nodes, leaves, consts, out_ixs,
-                                cause, t0)
+        try:
+            return _flush_optimized(root, nodes, leaves, consts,
+                                    out_ixs, cause, t0)
+        except Exception as e:  # noqa: BLE001 — rung 1 catches anything
+            # the optimizer/compiler threw; sound-chain errors re-raise
+            # from the rungs below
+            if not ladder:
+                raise
+            _resilience.degrade(
+                "flush.retry_verbatim",
+                detail=f"nodes={len(nodes)} cause={cause}", exc=e)
+    try:
+        return _flush_verbatim(root, nodes, leaves, consts, out_ixs,
+                               cause, t0)
+    except Exception as e:  # noqa: BLE001 — rung 2
+        if not ladder:
+            raise
+        _resilience.degrade(
+            "flush.eager_replay",
+            detail=f"nodes={len(nodes)} cause={cause}", exc=e)
+        return _flush_eager(root, nodes, leaves, consts, out_ixs,
+                            cause, t0)
+
+
+def _flush_verbatim(root, nodes, leaves, consts, out_ixs, cause, t0):
+    """Capture-order compile (no pass pipeline) — rung 0 when passes
+    are disabled, rung 1 of the degradation ladder otherwise."""
     key = (tuple((e.node_key, spec) for e, spec in nodes), out_ixs)
     jf = _JIT_CACHE.get(key)
     fresh = jf is None
@@ -421,10 +477,7 @@ def flush(root):
     # weak python scalar would contribute against a dtype-uniform chain
     # (memoized: a 64-op chain has ~100 consts and flushes in a loop)
     cargs = [_const_arr(c, root.dtype) for c in consts]
-    if fresh:
-        outs = _timed_first_call(jf, [*leaves, *cargs])
-    else:
-        outs = jf(*leaves, *cargs)
+    outs = _run_chain(jf, [*leaves, *cargs], fresh)
     for i, ov in zip(out_ixs, outs):
         nodes[i][0].value = ov
     if t0 is not None and _prof.enabled:
@@ -432,6 +485,30 @@ def flush(root):
                      time.perf_counter_ns() / 1000.0, "Sync",
                      {"nodes": len(nodes), "cause": cause,
                       "compiled": fresh})
+    return root.value
+
+
+def _flush_eager(root, nodes, leaves, consts, out_ixs, cause, t0):
+    """Rung 2: replay the captured chain op-by-op with NO jit — each fn
+    is an ordinary jax op, dispatched eagerly in capture order over the
+    same leaf/const arrays: exactly what ``FLAGS_eager_defer=0`` would
+    have computed for the same user program. That equals the fused
+    chain bitwise except where XLA contracts a mul->add pair into an
+    FMA inside the fused program (see docs/ROBUSTNESS.md "fidelity
+    caveat"; the chaos corpus pins contraction-exact chains). Survives
+    compile-layer failures (RESOURCE_EXHAUSTED, cache corruption) at
+    per-op dispatch cost."""
+    cargs = [_const_arr(c, root.dtype) for c in consts]
+    vals = _eval_chain([(e.fn, spec, e.kwargs) for e, spec in nodes],
+                       leaves, cargs)
+    for i in out_ixs:
+        nodes[i][0].value = vals[i]
+    _C_EAGER_REPLAY.inc()
+    if t0 is not None and _prof.enabled:
+        _prof.record("deferred_flush", t0 / 1000.0,
+                     time.perf_counter_ns() / 1000.0, "Sync",
+                     {"nodes": len(nodes), "cause": cause,
+                      "eager_replay": True})
     return root.value
 
 
@@ -445,6 +522,7 @@ def _flush_optimized(root, nodes, leaves, consts, out_ixs, cause, t0):
     from ..passes import LEAF, NODE, Graph, default_manager
 
     out_exprs = [nodes[i][0] for i in out_ixs]
+    _faults.site("deferred.passes")
     g = Graph.from_linearized(nodes, leaves, consts, out_ixs, root.dtype)
     g = default_manager().run(g)
     node_outs = tuple(ix for kind, ix in g.outputs if kind == NODE)
@@ -462,10 +540,7 @@ def _flush_optimized(root, nodes, leaves, consts, out_ixs, cause, t0):
         if not fresh:
             _C_JIT_HIT.inc()
         cargs = [_const_arr(c, root.dtype) for c in g.consts]
-        if fresh:
-            outs = _timed_first_call(jf, [*g.leaves, *cargs])
-        else:
-            outs = jf(*g.leaves, *cargs)
+        outs = _run_chain(jf, [*g.leaves, *cargs], fresh)
     it = iter(outs)
     for expr, (kind, ix) in zip(out_exprs, g.outputs):
         if kind == NODE:

@@ -134,3 +134,21 @@ define_flag("FLAGS_distributed_timeout", 1800,
 define_flag("FLAGS_enable_collective_watchdog", False,
             "supervise each dispatched step with a timeout + flight "
             "records (reference comm_task_manager.h:37)")
+define_flag("FLAGS_retry_max_attempts", 5,
+            "core.resilience: retries per policy before the last "
+            "exception propagates (per-call overridable)")
+define_flag("FLAGS_retry_base_delay_ms", 50.0,
+            "core.resilience: first backoff delay; doubles per retry")
+define_flag("FLAGS_retry_max_delay_ms", 2000.0,
+            "core.resilience: backoff cap per sleep")
+define_flag("FLAGS_rendezvous_deadline", 120.0,
+            "total seconds a rendezvous retry loop (TCPStore/rpc/elastic "
+            "connect) may keep retrying before giving up")
+define_flag("FLAGS_flush_degradation", True,
+            "deferred-flush degradation ladder (core/deferred.py): "
+            "pass-pipeline failure retries the verbatim compile, compile "
+            "failure replays the chain op-by-op; off = strict mode, "
+            "flush exceptions propagate")
+define_flag("FLAGS_checkpoint_keep", 3,
+            "retain-last-K sweep after each successful save_state_dict "
+            "(versioned ckpt_* layout); 0 keeps every checkpoint")

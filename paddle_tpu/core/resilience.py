@@ -1,0 +1,258 @@
+"""Retry / timeout / backoff policies + degradation events.
+
+The reference treats fault handling as a subsystem (comm_task_manager's
+watchdog, store retry loops, elastic relaunch); here every recovery
+path in the stack routes through ONE policy layer so behavior is
+uniform, observable, and testable:
+
+- ``RetryPolicy`` — attempts / jittered exponential backoff / overall
+  deadline / which exceptions are transient. Defaults come from
+  ``core.flags`` (``FLAGS_retry_*``, ``FLAGS_rendezvous_deadline``) and
+  per-domain overrides, so ops can tune production behavior without
+  code changes.
+- ``retry`` (decorator), ``retry_call`` (direct), and ``attempts``
+  (context-manager loop) — three forms of the same loop::
+
+      @resilience.retry(domain="store.connect")
+      def connect(): ...
+
+      sock = resilience.retry_call(open_channel, domain="rpc.connect")
+
+      for attempt in resilience.attempts(policy):
+          with attempt:
+              handshake()
+
+- ``degrade(domain, ...)`` — records that a *fallback* path ran (a
+  flush rung, a quarantined checkpoint, a lost elastic node): one
+  ``resilience.degrade.<domain>`` counter in the always-on metrics
+  registry plus a flight record in the watchdog ring
+  (``distributed.watchdog.flight_recorder()``), so a post-mortem shows
+  degradations interleaved with the steps that ran around them.
+
+Every retry is counted (``resilience.retry.<domain>.{retries,
+recovered,giveup}``). Policies never swallow the final error: when
+attempts or the deadline run out, the LAST exception propagates
+unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import time
+
+from . import flags as flags_mod
+from ..profiler import metrics as _metrics
+
+__all__ = ["RetryPolicy", "policy", "retry", "retry_call", "attempts",
+           "degrade"]
+
+# monkeypatch seam for tests (and the chaos gate) — backoff sleeps go
+# through here so a scenario can run wall-clock-free
+_sleep = time.sleep
+
+# domains whose overall deadline is the rendezvous deadline flag rather
+# than "attempts exhausted": bootstrap loops racing a peer's startup
+_RENDEZVOUS_DOMAINS = ("store.connect", "rpc.connect", "elastic.store")
+
+
+class RetryPolicy:
+    """Immutable retry schedule. ``None`` ctor args resolve from flags
+    at construction time (so ``set_flags`` changes apply to the next
+    policy lookup, not to loops already in flight)."""
+
+    __slots__ = ("domain", "max_attempts", "base_delay", "max_delay",
+                 "multiplier", "jitter", "deadline", "retry_on")
+
+    def __init__(self, domain="default", max_attempts=None,
+                 base_delay=None, max_delay=None, multiplier=2.0,
+                 jitter=0.5, deadline=None, retry_on=(Exception,)):
+        self.domain = domain
+        self.base_delay = (
+            flags_mod.flag("FLAGS_retry_base_delay_ms") / 1000.0
+            if base_delay is None else float(base_delay))
+        self.max_delay = (
+            flags_mod.flag("FLAGS_retry_max_delay_ms") / 1000.0
+            if max_delay is None else float(max_delay))
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        if deadline is None and domain in _RENDEZVOUS_DOMAINS:
+            deadline = flags_mod.flag("FLAGS_rendezvous_deadline")
+        self.deadline = None if deadline is None else float(deadline)
+        if max_attempts is None:
+            # deadline-governed loops (rendezvous) retry until the
+            # clock runs out — a 5-attempt cap would give up in <1s of
+            # backoff, making the deadline unreachable
+            max_attempts = (2 ** 31 if self.deadline is not None
+                            else flags_mod.flag("FLAGS_retry_max_attempts"))
+        self.max_attempts = int(max_attempts)
+        self.retry_on = tuple(retry_on)
+
+    def backoff(self, attempt, rng=None):
+        """Delay before retry number ``attempt`` (1-based): exponential
+        from ``base_delay`` capped at ``max_delay``, with up to
+        ``jitter`` fraction of random spread (full determinism at
+        ``jitter=0``)."""
+        d = min(self.base_delay * (self.multiplier ** (attempt - 1)),
+                self.max_delay)
+        if self.jitter:
+            d *= 1.0 + (rng or random).uniform(-self.jitter, self.jitter)
+        return max(d, 0.0)
+
+
+# policies resolve flags and counters format names: cache both so
+# per-call sites (every rpc dial goes through policy()) don't pay
+# repeated flag/registry lookups. Keyed by the flags epoch, so
+# set_flags invalidates naturally; domains are a closed set in
+# practice, but cap growth anyway.
+_policy_cache: dict = {}
+_counter_cache: dict = {}
+
+
+def policy(domain="default", **overrides):
+    """Policy for ``domain`` with flag-resolved defaults (memoized per
+    flags epoch)."""
+    try:
+        key = (domain, flags_mod.epoch(),
+               tuple(sorted(overrides.items())))
+        hash(key)
+    except TypeError:
+        return RetryPolicy(domain=domain, **overrides)
+    pol = _policy_cache.get(key)
+    if pol is None:
+        if len(_policy_cache) > 256:
+            _policy_cache.clear()
+        pol = _policy_cache[key] = RetryPolicy(domain=domain, **overrides)
+    return pol
+
+
+def _counters(domain):
+    c = _counter_cache.get(domain)
+    if c is None:
+        c = _counter_cache[domain] = (
+            _metrics.counter(f"resilience.retry.{domain}.retries"),
+            _metrics.counter(f"resilience.retry.{domain}.recovered"),
+            _metrics.counter(f"resilience.retry.{domain}.giveup"))
+    return c
+
+
+class _Attempt:
+    """One ``with`` body in an ``attempts()`` loop: swallows retryable
+    exceptions while budget remains, re-raises otherwise."""
+
+    __slots__ = ("_loop", "number", "failed")
+
+    def __init__(self, loop, number):
+        self._loop = loop
+        self.number = number
+        self.failed = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._loop.succeeded = True
+            return False
+        self.failed = True
+        return self._loop.on_failure(exc)
+
+
+class _Loop:
+    def __init__(self, pol):
+        self.policy = pol
+        self.succeeded = False
+        self.attempt = 0
+        self.start = time.monotonic()
+        self._retries, self._recovered, self._giveup = \
+            _counters(pol.domain)
+
+    def on_failure(self, exc):
+        p = self.policy
+        if not isinstance(exc, p.retry_on):
+            return False
+        if self.attempt >= p.max_attempts or (
+                p.deadline is not None
+                and time.monotonic() - self.start >= p.deadline):
+            self._giveup.inc()
+            return False
+        self._retries.inc()
+        return True
+
+
+def attempts(pol):
+    """Iterator of attempt context managers (see module docstring).
+    Ends after a success; lets the final failure propagate."""
+    loop = _Loop(pol)
+    while True:
+        loop.attempt += 1
+        a = _Attempt(loop, loop.attempt)
+        yield a
+        if loop.succeeded:
+            if loop.attempt > 1:
+                loop._recovered.inc()
+            return
+        if not a.failed:
+            # body broke out without entering / raising: caller's loop
+            # control, not a retry decision
+            return
+        d = loop.policy.backoff(loop.attempt)
+        if loop.policy.deadline is not None:
+            d = min(d, max(
+                0.0, loop.policy.deadline
+                - (time.monotonic() - loop.start)))
+        if d:
+            _sleep(d)
+
+
+def _invoke(fn, pol, args, kwargs):
+    out = None
+    for attempt in attempts(pol):
+        with attempt:
+            out = fn(*args, **kwargs)
+    return out
+
+
+def retry_call(fn, *args, policy=None, domain="default", **kwargs):
+    """Call ``fn(*args, **kwargs)`` under a retry policy; returns its
+    result or raises its last exception. ``policy`` / ``domain`` are
+    reserved keyword names here — a wrapped fn taking kwargs by those
+    names must go through the :func:`retry` decorator (which forwards
+    every caller kwarg untouched) instead."""
+    pol = policy if policy is not None else globals()["policy"](domain)
+    return _invoke(fn, pol, args, kwargs)
+
+
+def retry(policy=None, *, domain="default", **overrides):
+    """Decorator form: ``@retry(domain="store.connect")``. All of the
+    wrapped function's args/kwargs pass through verbatim (including
+    ones named ``policy``/``domain``)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            pol = policy if policy is not None else \
+                globals()["policy"](domain, **overrides)
+            return _invoke(fn, pol, args, kwargs)
+        return wrapper
+    return deco
+
+
+# -- degradation events ----------------------------------------------------
+
+def degrade(domain, detail=None, exc=None):
+    """A fallback path ran. Counts ``resilience.degrade.<domain>`` and
+    appends a flight record so hang/crash post-mortems show which
+    degradations preceded the incident. Never raises: the degraded path
+    is already handling a failure and must not fail on telemetry."""
+    _metrics.counter(f"resilience.degrade.{domain}").inc()
+    meta = {}
+    if detail:
+        meta["detail"] = str(detail)
+    if exc is not None:
+        meta["error"] = f"{type(exc).__name__}: {exc}"
+    try:
+        from ..distributed import watchdog
+        watchdog.record_event(f"degrade/{domain}", meta or None,
+                              status="degraded")
+    except Exception:  # noqa: BLE001 — telemetry must not mask recovery
+        pass

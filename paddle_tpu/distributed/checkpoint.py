@@ -1,37 +1,82 @@
-"""Distributed (sharded) checkpoint.
+"""Distributed (sharded) checkpoint — crash-safe on-disk format v2.
 
 Parity: reference `python/paddle/distributed/checkpoint/` —
 `save_state_dict` (save_state_dict.py:100: per-rank local shards + global
 metadata, replicated-tensor dedup :72) and `load_state_dict` (reshards
-across mismatched meshes/strategies at load).
+across mismatched meshes/strategies at load). Persistence semantics
+follow orbax-style atomic checkpointing: stage, fsync, rename, commit
+marker last.
 
-TPU-first: the single-controller runtime holds global (sharded) arrays, so
-"shards" are the addressable shards of each jax.Array. Each HOST writes
-only its addressable shards plus its own ``metadata_{host}.json`` (the
-reference's per-rank `.distcp` + global metadata, without needing a
-cross-host barrier); the loader unions all per-host metadata files. Shard
-keys are host-qualified and each shard entry records its source file, so
-same-named shards from different hosts can never collide. Loading
-reassembles the global array and `device_put`s it to the TARGET sharding —
-cross-strategy resharding for free (the reference needs explicit reshard
-functions). Async save runs on a background thread (orbax-style), parity
-with the reference's async_save.
+TPU-first: the single-controller runtime holds global (sharded) arrays,
+so "shards" are the addressable shards of each jax.Array. Each HOST
+writes only its addressable shards plus its own ``metadata_{host}.json``
+(the reference's per-rank `.distcp` + global metadata, without needing a
+cross-host barrier); the loader unions all per-host metadata files.
+Loading reassembles the global array and `device_put`s it to the TARGET
+sharding — cross-strategy resharding for free.
+
+On-disk format v2 (docs/ROBUSTNESS.md has the full contract)::
+
+    <path>/                        # checkpoint ROOT passed to save/load
+      ckpt_1/                      # one complete checkpoint per save
+        shards_0.npz               # per-host shard payload
+        metadata_0.json            # per-host manifest + crc32 checksums
+      ckpt_2/                      # a later save
+      ckpt_3.corrupt-*/            # quarantined by a failed load
+      .tmp.ckpt_4.0.<pid>/         # staging of an in-flight save
+
+Crash safety: every file is written into a private staging dir, fsynced,
+then ``os.replace``d into the final dir with ``metadata_{host}.json``
+moved LAST — the metadata file is the per-host commit marker, and a
+kill -9 at ANY point leaves either no ``ckpt_N`` dir, or one without
+metadata, or a complete one; never a half-trusted state.
+``load_state_dict`` scans candidates newest-first, verifies checksums
+and shard coverage BEFORE touching any target tensor, quarantines
+invalid dirs (rename to ``*.corrupt-<n>``, counted + flight-recorded),
+and restores the most recent valid checkpoint. A retain-last-K sweep
+(``FLAGS_checkpoint_keep``) bounds disk growth. The v1 flat layout
+(files directly under ``path``) still loads as the oldest candidate.
+
+Async saves return an ``AsyncSaveHandle`` backed by a tracked
+non-daemon writer thread; a captured exception re-raises on
+``result()``/``join()`` and, if never collected, on the NEXT save —
+failures cannot vanish with a daemon thread.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
+import shutil
 import threading
+import zlib
 
 import jax
 import numpy as np
 
+from ..core import flags as flags_mod
+from ..core import resilience
 from ..core.tensor import Tensor
+from ..profiler import metrics as _metrics
+from ..testing import faults
 
-__all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict",
+           "AsyncSaveHandle"]
 
 _LEGACY_METADATA = "metadata.json"
+_CKPT_RE = re.compile(r"^ckpt_(\d+)$")
+
+_C_SAVES = _metrics.counter("checkpoint.saves")
+_C_LOADS = _metrics.counter("checkpoint.loads")
+_C_ASYNC_FAIL = _metrics.counter("checkpoint.async.failures")
+_C_QUARANTINE = _metrics.counter("checkpoint.quarantined")
+_C_RETAIN = _metrics.counter("checkpoint.retention_removed")
+
+
+class CorruptCheckpointError(ValueError):
+    """A candidate checkpoint failed integrity validation (missing
+    commit marker, checksum mismatch, unreadable shard, coverage gap)."""
 
 
 def _flatten(sd, prefix=""):
@@ -45,19 +90,156 @@ def _flatten(sd, prefix=""):
     return flat
 
 
+# -- durability helpers ----------------------------------------------------
+
+def _fsync_path(path):
+    """Flush a file's (or directory's) dirty pages to stable storage —
+    the rename-based commit is only atomic-after-crash if the renamed
+    bytes and the directory entry both hit disk."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _crc32(path):
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _ckpt_ids(root):
+    ids = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return ids
+    for fn in names:
+        m = _CKPT_RE.match(fn)
+        if m and os.path.isdir(os.path.join(root, fn)):
+            ids.append(int(m.group(1)))
+    return sorted(ids)
+
+
+def _next_ckpt_id(root):
+    # count quarantined/corrupt dirs too: a recycled id would make
+    # "newest" ambiguous after a quarantine
+    last = 0
+    try:
+        names = os.listdir(root)
+    except OSError:
+        names = []
+    for fn in names:
+        m = re.match(r"^\.?(?:tmp\.)?ckpt_(\d+)", fn)
+        if m:
+            last = max(last, int(m.group(1)))
+    return last + 1
+
+
+# -- save ------------------------------------------------------------------
+
+_async_lock = threading.Lock()
+_reserve_lock = threading.Lock()
+_async_pending: list["AsyncSaveHandle"] = []
+_live_staging: set = set()
+_save_seq = [0]  # distinguishes concurrent saves in one process
+
+
+def _reserve_staging(root, final_dir, host):
+    """Create the staging dir SYNCHRONOUSLY (before any writer thread
+    runs): the dir both uniquifies this save and reserves its ckpt id —
+    `_next_ckpt_id` counts staging names, so an overlapping async save
+    scans past it instead of sharing the same id and staging path."""
+    with _async_lock:
+        _save_seq[0] += 1
+        seq = _save_seq[0]
+    staging = os.path.join(
+        root, f".tmp.{os.path.basename(final_dir)}.{host}."
+              f"{os.getpid()}.{seq}")
+    with _async_lock:
+        _live_staging.add(staging)
+    os.makedirs(staging, exist_ok=True)
+    return staging
+
+
+class AsyncSaveHandle:
+    """Tracked async-save writer. ``result()``/``join()`` re-raise the
+    writer's exception; an uncollected failure surfaces on the next
+    ``save_state_dict`` call."""
+
+    def __init__(self, path):
+        self.path = path
+        self._exc = None
+        self._thread = None
+        self._collected = False
+
+    def done(self):
+        th = self._thread
+        # ident is None until start(): a created-but-unstarted writer
+        # must not read as finished (reap would untrack it)
+        return th is not None and th.ident is not None \
+            and not th.is_alive()
+
+    def result(self, timeout=None):
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError(
+                f"async save to {self.path} still running")
+        if self._exc is not None:
+            self._collected = True  # seen here: don't resurface later
+            raise self._exc
+        return self.path
+
+    # drop-in for the daemon-Thread object earlier versions returned
+    join = result
+
+
+def _reap_async():
+    """Surface finished-but-uncollected async failures on the caller's
+    thread (the 'next save' half of the handle contract). Raises ONE
+    failure per call and leaves the rest pending, so no failure is
+    ever dropped when several writers died."""
+    failed = None
+    with _async_lock:  # one critical section: concurrent reaps must
+        for h in list(_async_pending):  # not double-remove a handle
+            if not h.done():
+                continue
+            if h._exc is not None and not h._collected:
+                if failed is None:
+                    failed = h
+                    _async_pending.remove(h)
+                # further failures stay pending for the NEXT reap
+            else:
+                _async_pending.remove(h)
+    if failed is not None:
+        raise RuntimeError(
+            f"previous async save_state_dict to {failed.path} "
+            "failed") from failed._exc
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
-    """Write sharded checkpoint to directory ``path``.
+    """Write one crash-safe checkpoint under root ``path``.
 
     Multi-host safe: every host writes ``shards_{host}.npz`` with its
     addressable shards and ``metadata_{host}.json`` describing them; no
-    host needs to see another host's shards.
+    host needs to see another host's shards. Multi-host runs should
+    pass an agreed ``unique_id`` (the step number) so hosts commit into
+    the same ``ckpt_<id>`` dir; single-host saves auto-increment.
+
+    Returns ``None``, or an :class:`AsyncSaveHandle` when
+    ``async_save=True``.
     """
+    _reap_async()
     os.makedirs(path, exist_ok=True)
     flat = _flatten(state_dict)
     host = jax.process_index()
     shard_fn = f"shards_{host}.npz"
-    meta = {"tensors": {}, "host": host, "num_hosts": jax.process_count()}
+    meta = {"format": 2, "tensors": {}, "host": host,
+            "num_hosts": jax.process_count()}
     arrays = {}
     for name, t in flat.items():
         if isinstance(t, Tensor):
@@ -94,32 +276,176 @@ def save_state_dict(state_dict, path, process_group=None,
                  "index": [[0, d] for d in np.shape(arr)], "host": host})
         meta["tensors"][name] = entry
 
-    def _write():
-        np.savez(os.path.join(path, shard_fn), **arrays)
-        with open(os.path.join(path, f"metadata_{host}.json"), "w") as f:
-            json.dump(meta, f)
+    # id choice + staging reservation are one critical section: the
+    # staging dir is what makes the chosen id visible to the next
+    # scan, so a concurrent save in another thread must not scan
+    # between the two
+    with _reserve_lock:
+        if unique_id is not None:
+            final_dir = os.path.join(path, f"ckpt_{int(unique_id)}")
+        elif jax.process_count() > 1:
+            # hosts cannot agree on a scan-derived id without
+            # coordination (two racing saves would split one checkpoint
+            # across two ids, and the loader would quarantine both
+            # halves) — fall back to the v1 flat layout, which needs no
+            # agreement; versioned multi-host saves require an agreed
+            # unique_id (the step)
+            final_dir = path
+        else:
+            final_dir = os.path.join(path, f"ckpt_{_next_ckpt_id(path)}")
+        staging = _reserve_staging(path, final_dir, host)
 
     if async_save:
-        th = threading.Thread(target=_write, daemon=True)
+        handle = AsyncSaveHandle(final_dir)
+
+        def _run():
+            try:
+                _write_commit(path, final_dir, host, shard_fn, arrays,
+                              meta, staging)
+                _retention_sweep(path, host)
+            except BaseException as e:  # noqa: BLE001 — held for result()
+                handle._exc = e
+                _C_ASYNC_FAIL.inc()
+                resilience.degrade("checkpoint.async_save",
+                                   detail=final_dir, exc=e)
+
+        th = threading.Thread(target=_run, daemon=False,
+                              name="paddle-tpu-ckpt-writer")
+        handle._thread = th
+        with _async_lock:
+            _async_pending.append(handle)
         th.start()
-        return th
-    _write()
+        return handle
+
+    _write_commit(path, final_dir, host, shard_fn, arrays, meta, staging)
+    _retention_sweep(path, host)
+    return None
+
+
+def _write_commit(root, final_dir, host, shard_fn, arrays, meta,
+                  staging):
+    """Stage -> fsync -> rename, metadata last (the commit marker)."""
+    try:
+        shard_path = os.path.join(staging, shard_fn)
+        faults.site("checkpoint.write_shards")
+        np.savez(shard_path, **arrays)
+        faults.site("checkpoint.fsync")
+        _fsync_path(shard_path)
+        meta["files"] = {shard_fn: {"crc32": _crc32(shard_path),
+                                    "bytes": os.path.getsize(shard_path)}}
+        meta_fn = f"metadata_{host}.json"
+        meta_path = os.path.join(staging, meta_fn)
+        faults.site("checkpoint.write_meta")
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        faults.site("checkpoint.commit")
+        os.makedirs(final_dir, exist_ok=True)
+        # re-saving an already-committed id (or the flat layout) must
+        # not tear the old copy: move the old METADATA aside first so
+        # the dir is never [new shard + old manifest] — any crash from
+        # here leaves either the old commit intact (.bak not yet made)
+        # or an uncommitted dir the loader skips, old bytes preserved
+        baks = []
+        try:
+            for fn in (meta_fn, shard_fn):
+                p = os.path.join(final_dir, fn)
+                if os.path.exists(p):
+                    os.replace(p, p + ".bak")
+                    baks.append(p)
+            os.replace(shard_path, os.path.join(final_dir, shard_fn))
+            # metadata rename is the per-host commit point: a crash
+            # before this line leaves a dir the loader treats as invalid
+            os.replace(meta_path, os.path.join(final_dir, meta_fn))
+        except BaseException:
+            # non-crash failure mid-recommit: put the old commit back
+            # (shard before metadata, so the manifest only reappears
+            # over matching bytes); a true kill -9 can't run this, and
+            # the loader then skips/falls back as documented
+            for p in reversed(baks):
+                try:
+                    os.replace(p + ".bak", p)
+                except OSError:
+                    pass
+            raise
+        _fsync_path(final_dir)
+        _fsync_path(root)
+        for p in baks:
+            try:
+                os.remove(p + ".bak")
+            except OSError:
+                pass
+        _C_SAVES.inc()
+    finally:
+        shutil.rmtree(staging, ignore_errors=True)
+        with _async_lock:
+            _live_staging.discard(staging)
+
+
+def _retention_sweep(root, host):
+    """Keep the last ``FLAGS_checkpoint_keep`` committed checkpoints
+    (host 0 only — one retention sweeper per shared filesystem); EVERY
+    host reaps its own dead-writer staging dirs, since only the owner
+    can tell a crashed save from an in-flight one."""
+    faults.site("checkpoint.retention")
+    if host == 0:
+        keep = int(flags_mod.flag("FLAGS_checkpoint_keep"))
+        if keep > 0:
+            for i in _ckpt_ids(root)[:-keep]:
+                shutil.rmtree(os.path.join(root, f"ckpt_{i}"),
+                              ignore_errors=True)
+                _C_RETAIN.inc()
+    # orphaned staging: only THIS host's dirs whose writer process is
+    # gone — another host's (or a live async writer's) staging on a
+    # shared filesystem is an in-flight save, not garbage. listdir
+    # FIRST, live-set second: writers register in _live_staging before
+    # mkdir, so any dir the listing caught is in a snapshot taken after
+    names = os.listdir(root)
+    with _async_lock:
+        live = set(_live_staging)
+    for fn in names:
+        p = os.path.join(root, fn)
+        m = re.match(r"^\.tmp\..+\.(\d+)\.(\d+)\.(\d+)$", fn)
+        if m is None or p in live or int(m.group(1)) != host:
+            continue
+        pid = int(m.group(2))
+        if pid != os.getpid():
+            try:
+                os.kill(pid, 0)
+                continue  # writer still alive
+            except ProcessLookupError:
+                pass
+            except OSError:
+                continue  # can't tell: leave it
+        shutil.rmtree(p, ignore_errors=True)
 
 
 def async_save_state_dict(state_dict, path, **kw):
     return save_state_dict(state_dict, path, async_save=True, **kw)
 
 
+# -- load ------------------------------------------------------------------
+
 def _read_metadata(path):
-    """Union all per-host metadata files (legacy single-file fallback)."""
+    """Union all per-host metadata files; returns (tensors, metas).
+    Raises CorruptCheckpointError when no metadata exists (an
+    uncommitted / torn checkpoint dir)."""
     metas = []
     for fn in sorted(os.listdir(path)):
         if fn.startswith("metadata_") and fn.endswith(".json"):
-            with open(os.path.join(path, fn)) as f:
-                metas.append(json.load(f))
+            try:
+                with open(os.path.join(path, fn)) as f:
+                    metas.append(json.load(f))
+            except (OSError, json.JSONDecodeError) as e:
+                raise CorruptCheckpointError(
+                    f"unreadable metadata {fn}: {e}") from e
     if not metas and os.path.exists(os.path.join(path, _LEGACY_METADATA)):
         with open(os.path.join(path, _LEGACY_METADATA)) as f:
             metas.append(json.load(f))
+    if not metas:
+        raise CorruptCheckpointError(
+            f"no metadata (uncommitted checkpoint) in {path}")
     merged = {}
     for m in metas:
         default_file = f"shards_{m.get('host', 0)}.npz"
@@ -139,14 +465,68 @@ def _read_metadata(path):
                 sh = dict(sh)
                 sh.setdefault("file", default_file)
                 tgt["shards"].append(sh)
-    return merged
+    return merged, metas
 
 
-def load_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, unique_id=None):
-    """Fill ``state_dict``'s tensors in place from ``path``, resharding to
-    each target tensor's current sharding (any source strategy)."""
-    tensors = _read_metadata(path)
+def _verify_checksums(path, metas):
+    """v2 manifests record per-file crc32: any referenced file must
+    exist and match before a single byte is trusted."""
+    for m in metas:
+        for fn, info in (m.get("files") or {}).items():
+            p = os.path.join(path, fn)
+            if not os.path.exists(p):
+                raise CorruptCheckpointError(
+                    f"manifest references missing file {fn}")
+            crc = _crc32(p)
+            if crc != int(info.get("crc32", crc)):
+                raise CorruptCheckpointError(
+                    f"checksum mismatch for {fn}: "
+                    f"{crc:#010x} != {int(info['crc32']):#010x}")
+
+
+def _union_elems(ranges, shape):
+    """Elements covered by the UNION of axis-aligned index boxes
+    (each ``[(lo, hi), ...]`` per dim), O(#boxes * #grid-cells) via
+    coordinate compression — no O(numel) mask allocation. An empty
+    range list means the box covers the whole array (the loader
+    assigns it with ``...``)."""
+    if ranges and any(len(r) == 0 for r in ranges):
+        ranges = [r for r in ranges if r] + \
+            [[(0, d) for d in shape]]  # normalize full-cover boxes
+    if not shape:
+        return 1 if ranges else 0
+    edges = []
+    for d, dim in enumerate(shape):
+        es = {0, dim}
+        for r in ranges:
+            es.add(min(max(r[d][0], 0), dim))
+            es.add(min(max(r[d][1], 0), dim))
+        edges.append(sorted(es))
+    import itertools
+    total = 0
+    for cell in itertools.product(*(range(len(e) - 1) for e in edges)):
+        lo = [edges[d][c] for d, c in enumerate(cell)]
+        hi = [edges[d][c + 1] for d, c in enumerate(cell)]
+        if any(h <= l for l, h in zip(lo, hi)):
+            continue
+        for r in ranges:
+            if all(r[d][0] <= lo[d] and hi[d] <= r[d][1]
+                   for d in range(len(shape))):
+                vol = 1
+                for l, h in zip(lo, hi):
+                    vol *= h - l
+                total += vol
+                break
+    return total
+
+
+def _assemble(flat_targets, path):
+    """Validate + reassemble every target tensor's full array from
+    ``path``. Pure read phase: raises CorruptCheckpointError without
+    having touched any target, so a corrupt candidate can be skipped
+    with the state_dict intact."""
+    tensors, metas = _read_metadata(path)
+    _verify_checksums(path, metas)
     files = {}
 
     def lookup(shard):
@@ -155,35 +535,133 @@ def load_state_dict(state_dict, path, process_group=None,
             files[fn] = np.load(os.path.join(path, fn))
         return files[fn][shard["key"]]
 
+    import ml_dtypes
+    out = {}
+    try:
+        for name, target in flat_targets.items():
+            if name not in tensors:
+                continue
+            entry = tensors[name]
+            if "scalar" in entry:
+                continue
+            dtype = entry["dtype"]
+            np_dtype = getattr(ml_dtypes, dtype) if "bfloat16" in dtype or \
+                "float8" in dtype else np.dtype(dtype)
+            full = np.zeros(entry["shape"], np_dtype)
+            for sh in entry["shards"]:
+                data = lookup(sh)
+                sl = tuple(slice(lo, hi) for lo, hi in sh["index"]) or ...
+                full[sl] = data
+            # coverage by the UNION of shard index ranges: summing
+            # per-shard element counts double-counts overlap, letting
+            # "overlapping shards + one missing" pass validation
+            expected = int(np.prod(entry["shape"]))  # 0: nothing to cover
+            n_cov = _union_elems(
+                [[tuple(map(int, ix)) for ix in sh["index"]]
+                 for sh in entry["shards"]], tuple(entry["shape"]))
+            if expected > 0 and n_cov < expected:
+                raise CorruptCheckpointError(
+                    f"checkpoint shard(s) missing for '{name}': covered "
+                    f"{n_cov}/{expected} elements — a host's "
+                    "shard/metadata file is absent from the checkpoint "
+                    "directory")
+            out[name] = full
+    except CorruptCheckpointError:
+        raise
+    except Exception as e:  # torn npz / bad key / shape mismatch
+        raise CorruptCheckpointError(
+            f"unreadable shard data in {path}: "
+            f"{type(e).__name__}: {e}") from e
+    finally:
+        for f in files.values():  # NpzFile handles hold the zip open
+            f.close()
+    return out
+
+
+def _save_in_flight(root, cand):
+    """True while any host's staging dir for ``cand``'s id exists — the
+    save may still commit, so an invalid-looking candidate must be
+    skipped, not quarantined. (A kill -9 leaves its staging behind too;
+    that save stays 'in flight' until the owner host's next retention
+    sweep reaps the dead writer's dir, after which a load may
+    quarantine the torn commit.)"""
+    prefix = f".tmp.{os.path.basename(cand)}."
+    try:
+        return any(fn.startswith(prefix) for fn in os.listdir(root))
+    except OSError:
+        return False
+
+
+def _quarantine(root, cand, err):
+    """Rename an invalid ckpt dir out of the candidate namespace so the
+    next scan skips it; keep the bytes for forensics."""
+    dst = cand + ".corrupt"
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{cand}.corrupt-{n}"
+    try:
+        os.replace(cand, dst)
+    except OSError:
+        return
+    _C_QUARANTINE.inc()
+    resilience.degrade("checkpoint.quarantine",
+                       detail=os.path.basename(cand), exc=err)
+
+
+def _candidates(path):
+    """Candidate checkpoint dirs, newest committed first; the legacy
+    flat layout (v1 files directly under ``path``) is the fallback."""
+    cands = [os.path.join(path, f"ckpt_{i}")
+             for i in reversed(_ckpt_ids(path))]
+    for fn in os.listdir(path):
+        if fn == _LEGACY_METADATA or (fn.startswith("metadata_")
+                                      and fn.endswith(".json")):
+            cands.append(path)
+            break
+    return cands
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None):
+    """Fill ``state_dict``'s tensors in place from the most recent VALID
+    checkpoint under root ``path``, resharding to each target tensor's
+    current sharding (any source strategy). Invalid candidates are
+    quarantined and the scan falls back to the previous save; target
+    tensors are only mutated once a candidate fully validates."""
     flat = _flatten(state_dict)
-    for name, target in flat.items():
-        if name not in tensors:
+    cands = _candidates(path)
+    if not cands:
+        raise FileNotFoundError(f"no checkpoint found under {path}")
+    last_err = None
+    for cand in cands:
+        try:
+            values = _assemble(flat, cand)
+        except (CorruptCheckpointError, OSError) as e:
+            # OSError: the candidate vanished mid-scan (concurrent
+            # quarantine / retention) — fall back like any bad dir
+            last_err = e
+            if cand == path:
+                # legacy flat layout IS the root: nothing to fall back
+                # to and renaming the user's directory would be rude
+                raise
+            if _save_in_flight(path, cand):
+                # a writer is still staging for this id (concurrent
+                # async save / another host mid-commit): incomplete,
+                # not corrupt — skip it without destroying the commit
+                continue
+            _quarantine(path, cand, e)
             continue
-        entry = tensors[name]
-        if "scalar" in entry:
-            continue
-        import ml_dtypes
-        dtype = entry["dtype"]
-        np_dtype = getattr(ml_dtypes, dtype) if "bfloat16" in dtype or \
-            "float8" in dtype else np.dtype(dtype)
-        full = np.zeros(entry["shape"], np_dtype)
-        filled = 0
-        for sh in entry["shards"]:
-            data = lookup(sh)
-            sl = tuple(slice(lo, hi) for lo, hi in sh["index"]) or ...
-            full[sl] = data
-            filled += int(np.prod(np.shape(data))) or 1
-        expected = int(np.prod(entry["shape"])) or 1
-        if filled < expected:
-            raise ValueError(
-                f"checkpoint shard(s) missing for '{name}': covered "
-                f"{filled}/{expected} elements — a host's shard/metadata "
-                "file is absent from the checkpoint directory")
-        if isinstance(target, Tensor):
-            arr = full
-            if getattr(target._data, "sharding", None) is not None and \
-                    not isinstance(target._data, jax.core.Tracer):
-                arr = jax.device_put(full, target._data.sharding)
-            target._rebind(arr if isinstance(arr, jax.Array)
-                           else jax.numpy.asarray(arr))
-    return state_dict
+        for name, full in values.items():
+            target = flat[name]
+            if isinstance(target, Tensor):
+                arr = full
+                if getattr(target._data, "sharding", None) is not None \
+                        and not isinstance(target._data, jax.core.Tracer):
+                    arr = jax.device_put(full, target._data.sharding)
+                target._rebind(arr if isinstance(arr, jax.Array)
+                               else jax.numpy.asarray(arr))
+        _C_LOADS.inc()
+        return state_dict
+    raise CorruptCheckpointError(
+        f"no valid checkpoint under {path}; last error: {last_err}")

@@ -14,6 +14,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..core import resilience
 from .store import TCPStore
 
 __all__ = ["ElasticManager", "ElasticStatus"]
@@ -40,11 +41,20 @@ class ElasticManager:
         self.port = self.store.port
         self._stop = threading.Event()
         self._hb_thread = None
+        self._last_status = ElasticStatus.HOLD
         self.enabled = True
 
     # -- registration + heartbeat (reference manager.py:253) --------------
     def register(self):
-        self.store.set(f"node/{self.node_id}", str(time.time()))
+        # registration is the node's rendezvous: a store hiccup here
+        # must not drop the node from the job, so it rides the
+        # elastic.store retry policy (idempotent set; the membership
+        # add runs once, after the lease is durably published)
+        def _publish():
+            self.store.set(f"node/{self.node_id}", str(time.time()))
+        resilience.retry_call(
+            _publish, policy=resilience.policy(
+                "elastic.store", retry_on=(RuntimeError, OSError)))
         self.store.add("nodes", 1)
         self._hb_thread = threading.Thread(target=self._heartbeat,
                                            daemon=True)
@@ -76,11 +86,18 @@ class ElasticManager:
         recover)."""
         n = expect or self.np
         alive = self.alive_nodes(n)
-        if len(alive) == n:
-            return ElasticStatus.HOLD
-        if len(alive) >= 1:
-            return ElasticStatus.RESTART
-        return ElasticStatus.EXIT
+        status = ElasticStatus.HOLD if len(alive) == n else \
+            ElasticStatus.RESTART if alive else ElasticStatus.EXIT
+        # a membership TRANSITION is a degradation event: count it and
+        # flight-record which nodes went missing so a later hang report
+        # shows the history. Per-transition, not per-poll — a node down
+        # for minutes of 2s polls must not flood the flight ring
+        if status != self._last_status and status != ElasticStatus.HOLD:
+            missing = sorted(set(range(n)) - set(alive))
+            resilience.degrade(f"elastic.{status}",
+                               detail=f"missing nodes {missing} of {n}")
+        self._last_status = status
+        return status
 
     def signal_restart(self):
         self.store.add("restart_epoch", 1)

@@ -29,6 +29,9 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
+from ..core import resilience
+from ..testing import faults
+
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos",
            "get_current_worker_info", "WorkerInfo"]
@@ -122,13 +125,27 @@ class _Agent:
             conn.close()
 
     # -- client side --------------------------------------------------
+    def _open_channel(self, info, timeout):
+        """Channel setup ONLY retries here — a refused/reset connect is
+        a peer still starting (or an exhausted accept backlog), safe to
+        redial; the call frame itself is never resent (remote fns are
+        not assumed idempotent)."""
+        def dial():
+            faults.site("rpc.connect")
+            return socket.create_connection((info.ip, info.port),
+                                            timeout=timeout or None)
+        return resilience.retry_call(
+            dial, policy=resilience.policy(
+                "rpc.connect", deadline=timeout or None,
+                retry_on=(ConnectionRefusedError, ConnectionResetError,
+                          ConnectionAbortedError)))
+
     def call(self, to, fn, args, kwargs, timeout):
         info = self.workers.get(to)
         if info is None:
             raise ValueError(f"unknown rpc worker {to!r}; known: "
                              f"{sorted(self.workers)}")
-        with socket.create_connection((info.ip, info.port),
-                                      timeout=timeout or None) as sock:
+        with self._open_channel(info, timeout) as sock:
             if timeout and timeout > 0:
                 sock.settimeout(timeout)
             self._send_frame(sock, {"fn": fn, "args": tuple(args or ()),

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import ctypes
 
+from ..core import resilience
 from ..csrc.build import load_library
+from ..testing import faults
 
 
 def _lib():
@@ -46,12 +48,20 @@ def _lib():
 
 class TCPStore:
     """paddle.distributed.TCPStore parity: ``is_master`` hosts the server
-    in-process; all roles hold a client connection."""
+    in-process; all roles hold a client connection.
+
+    Rendezvous-robust: a non-master client racing the master's startup
+    retries the connect with jittered exponential backoff under the
+    ``store.connect`` policy (``FLAGS_rendezvous_deadline`` caps the
+    whole loop) instead of failing the job on the first refusal. The
+    master's OWN client connect targets an in-process server that is
+    already listening, so its first attempt succeeds."""
 
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
                  world_size=1, timeout=900):
         self._lib = _lib()
         self._server = None
+        self._client = None
         self._timeout_ms = int(timeout * 1000)
         if is_master:
             self._server = self._lib.pt_store_server_start(port)
@@ -60,10 +70,24 @@ class TCPStore:
             port = self._lib.pt_store_server_port(self._server)
         self.host = host
         self.port = port
-        self._client = self._lib.pt_store_client_connect(
-            host.encode(), port, self._timeout_ms)
-        if not self._client:
-            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+        def _connect():
+            faults.site("store.connect")
+            client = self._lib.pt_store_client_connect(
+                host.encode(), port, self._timeout_ms)
+            if not client:
+                raise ConnectionError(
+                    f"TCPStore: cannot connect {host}:{port}")
+            return client
+
+        if is_master:
+            self._client = _connect()
+        else:
+            self._client = resilience.retry_call(
+                _connect,
+                policy=resilience.policy(
+                    "store.connect",
+                    retry_on=(ConnectionError, OSError)))
 
     def set(self, key, value):
         data = value if isinstance(value, bytes) else str(value).encode()

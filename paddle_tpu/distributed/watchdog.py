@@ -36,7 +36,7 @@ from collections import deque
 from ..core.flags import get_flags
 
 __all__ = ["FlightRecorder", "CollectiveWatchdog", "get_watchdog",
-           "watch_step"]
+           "watch_step", "flight_recorder", "record_event"]
 
 
 class FlightRecorder:
@@ -182,6 +182,29 @@ class _Watch:
 
 
 _global = [None]
+
+# standalone ring for processes that never arm a watchdog: degradation
+# events (core/resilience.degrade) must always land in SOME flight
+# recorder, or single-process post-mortems lose the fallback history
+_standalone_recorder = FlightRecorder(capacity=128)
+
+
+def flight_recorder():
+    """The global watchdog's recorder when one exists, else the
+    standalone module ring. Event producers (resilience.degrade,
+    checkpoint quarantine) call this per event, so records migrate to
+    the watchdog's ring as soon as one is configured."""
+    if _global[0] is not None:
+        return _global[0].recorder
+    return _standalone_recorder
+
+
+def record_event(tag, meta=None, status="degraded"):
+    """Append a point-in-time (already finished) flight record — the
+    degradation-event hook; ``status`` labels it in dumps."""
+    rec = flight_recorder().start(tag, meta)
+    flight_recorder().finish(rec, status)
+    return rec
 
 
 def get_watchdog(**kwargs):

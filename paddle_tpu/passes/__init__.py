@@ -16,10 +16,11 @@ from .canon import Canonicalize  # noqa: F401
 from .cse import HashConsCSE  # noqa: F401
 from .dce import DeadCodeElim  # noqa: F401
 from .fold import ConstantFold  # noqa: F401
-from .manager import PassManager, default_manager, default_passes  # noqa: F401
+from .manager import (PassError, PassManager, default_manager,  # noqa: F401
+                      default_passes)
 
 __all__ = [
     "CONST", "LEAF", "NODE", "Graph", "GraphNode",
     "Canonicalize", "ConstantFold", "HashConsCSE", "DeadCodeElim",
-    "PassManager", "default_manager", "default_passes",
+    "PassError", "PassManager", "default_manager", "default_passes",
 ]

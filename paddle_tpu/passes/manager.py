@@ -35,8 +35,15 @@ from .dce import DeadCodeElim
 from .fold import ConstantFold
 
 _C_RUNS = _metrics.counter("passes.runs")
+_C_ERRORS = _metrics.counter("passes.errors")
 _H_TOTAL_US = _metrics.histogram(
     "passes.total_us", bounds=(10, 50, 100, 500, 1000, 5000, 10_000))
+
+
+class PassError(RuntimeError):
+    """A rewrite pass raised. Carries the pass name so the flush
+    degradation ladder's flight record (core/deferred.py rung 1) names
+    the culprit instead of an anonymous pipeline failure."""
 
 
 class PassManager:
@@ -50,7 +57,13 @@ class PassManager:
     def run(self, graph):
         t0 = time.perf_counter_ns()
         for p, c in zip(self.passes, self._counters):
-            graph, n = p.run(graph)
+            try:
+                graph, n = p.run(graph)
+            except Exception as e:
+                _C_ERRORS.inc()
+                raise PassError(
+                    f"pass '{p.name}' failed: "
+                    f"{type(e).__name__}: {e}") from e
             if n:
                 c.inc(n)
         _C_RUNS.inc()

@@ -1,0 +1,11 @@
+"""Test-support machinery shipped inside the package (reference
+`paddle.base.core` exposes its fault hooks the same way: injection must
+live where the product code can call it, not in tests/).
+
+`paddle_tpu.testing.faults` — deterministic, named fault-injection
+sites; see docs/ROBUSTNESS.md for the site catalog.
+"""
+
+from . import faults  # noqa: F401
+
+__all__ = ["faults"]

@@ -1,0 +1,151 @@
+"""Deterministic fault injection: named sites, armed per test.
+
+Recovery code is only trustworthy if every path is *driven*, and real
+faults (a disk dying mid-fsync, a peer refusing a connect, XLA throwing
+RESOURCE_EXHAUSTED) are not reproducible in CI. Product code therefore
+declares **named injection sites** at the exact points where those
+faults strike::
+
+    from ..testing import faults
+    ...
+    faults.site("checkpoint.write_shards")   # may raise when armed
+    np.savez(tmp_path, **arrays)
+
+and chaos tests (tests/framework/test_chaos.py, tools/chaos_gate.py)
+arm them deterministically::
+
+    with faults.inject("checkpoint.write_shards", nth=1,
+                       exc=faults.FaultInjected):
+        ckpt.save_state_dict(sd, path)       # "crashes" mid-write
+
+Design rules:
+
+- **Compiled out when idle.** ``site()`` is a single module-global
+  boolean read unless at least one injection is armed — the hot paths
+  that carry sites (deferred flush) pay nothing in production.
+- **Deterministic.** An injection fires on the ``nth`` hit of its site
+  (1-based, counted from arming) and on the ``count - 1`` hits after
+  it; no randomness, so a chaos scenario replays exactly.
+- **Raise or delay.** ``exc`` may be an exception instance, an
+  exception class, or a zero-arg callable returning either; ``delay``
+  sleeps (for racing-timeout scenarios) before any raise.
+
+The site catalog lives in docs/ROBUSTNESS.md; a site string is API —
+renaming one breaks the chaos corpus.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+__all__ = ["FaultInjected", "site", "inject", "arm", "disarm", "clear",
+           "hits", "fired", "active"]
+
+
+class FaultInjected(RuntimeError):
+    """Default exception raised at an armed site."""
+
+
+class _Injection:
+    __slots__ = ("name", "nth", "count", "exc", "delay", "fired")
+
+    def __init__(self, name, nth, count, exc, delay):
+        self.name = name
+        self.nth = int(nth)
+        self.count = int(count)
+        self.exc = exc
+        self.delay = float(delay)
+        self.fired = 0
+
+
+# the idle-path contract: site() reads ONE module global and returns.
+# _ENABLED is true iff _ARMED is non-empty; all bookkeeping is locked.
+_ENABLED = False
+_lock = threading.Lock()
+_ARMED: dict[str, _Injection] = {}
+_HITS: dict[str, int] = {}
+
+
+def site(name):
+    """Declare an injection point. No-op unless a fault is armed
+    somewhere; raises / sleeps when ``name``'s injection triggers."""
+    if not _ENABLED:
+        return
+    _hit(name)
+
+
+def _hit(name):
+    with _lock:
+        n = _HITS.get(name, 0) + 1
+        _HITS[name] = n
+        inj = _ARMED.get(name)
+        if inj is None or n < inj.nth or inj.fired >= inj.count:
+            return
+        inj.fired += 1
+        delay, exc = inj.delay, inj.exc
+    if delay:
+        time.sleep(delay)
+    if exc is None:
+        return
+    e = exc() if callable(exc) else exc
+    if isinstance(e, BaseException):
+        raise e
+
+
+def arm(name, nth=1, exc=FaultInjected, delay=0.0, count=1):
+    """Arm ``name``: hits ``nth`` .. ``nth+count-1`` (counted from this
+    call) trigger. Returns the injection record (``.fired`` observable)."""
+    global _ENABLED
+    inj = _Injection(name, nth, count, exc, delay)
+    with _lock:
+        _ARMED[name] = inj
+        _HITS[name] = 0
+        _ENABLED = True
+    return inj
+
+
+def disarm(name):
+    global _ENABLED
+    with _lock:
+        _ARMED.pop(name, None)
+        if not _ARMED:
+            _ENABLED = False
+
+
+def clear():
+    """Disarm everything and zero hit counters."""
+    global _ENABLED
+    with _lock:
+        _ARMED.clear()
+        _HITS.clear()
+        _ENABLED = False
+
+
+@contextlib.contextmanager
+def inject(name, nth=1, exc=FaultInjected, delay=0.0, count=1):
+    """Context-manager arming: disarms on exit however the body ends."""
+    inj = arm(name, nth=nth, exc=exc, delay=delay, count=count)
+    try:
+        yield inj
+    finally:
+        disarm(name)
+
+
+def hits(name):
+    """Hits of ``name`` since it was last armed (0 when never armed —
+    hits are only counted while injection is enabled)."""
+    with _lock:
+        return _HITS.get(name, 0)
+
+
+def fired(name):
+    with _lock:
+        inj = _ARMED.get(name)
+        return inj.fired if inj is not None else 0
+
+
+def active():
+    with _lock:
+        return sorted(_ARMED)

@@ -37,7 +37,8 @@ def test_cross_strategy_reshard():
                                mesh_tp)
     path = tempfile.mkdtemp()
     ckpt.save_state_dict(m1.state_dict(), path)
-    assert os.path.exists(os.path.join(path, "metadata_0.json"))
+    # v2 layout: one committed ckpt_<id> dir holding the host manifest
+    assert os.path.exists(os.path.join(path, "ckpt_1", "metadata_0.json"))
 
     paddle.seed(2)
     mesh_dp = dist.init_mesh([8], ["dp"])

@@ -1,0 +1,513 @@
+"""Chaos tests: drive testing/faults through every recovery path.
+
+Each test injects a deterministic fault at a named site and pins the
+recovery contract: crash-safe checkpoints restore the latest valid
+save, every flush-ladder rung is bitwise-identical to the healthy path,
+rendezvous connects succeed after injected refusals, and every
+degradation lands in the metrics registry + watchdog flight ring.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core import deferred, resilience
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed import watchdog
+from paddle_tpu.profiler import metrics
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _counter(name):
+    return metrics.snapshot().get(name, 0)
+
+
+# -- faults machinery ------------------------------------------------------
+
+def test_site_is_noop_when_disarmed():
+    faults.site("nonexistent.site")  # must not raise, count, or allocate
+    assert faults.hits("nonexistent.site") == 0
+
+
+def test_nth_and_count_are_deterministic():
+    with faults.inject("u.site", nth=3, count=2) as inj:
+        faults.site("u.site")
+        faults.site("u.site")
+        with pytest.raises(faults.FaultInjected):
+            faults.site("u.site")
+        with pytest.raises(faults.FaultInjected):
+            faults.site("u.site")
+        faults.site("u.site")  # budget spent: no-op again
+        assert inj.fired == 2
+        assert faults.hits("u.site") == 5
+    assert faults.active() == []
+
+
+def test_exception_class_instance_and_callable():
+    with faults.inject("u.exc", exc=ConnectionError):
+        with pytest.raises(ConnectionError):
+            faults.site("u.exc")
+    with faults.inject("u.exc", exc=OSError("boom")):
+        with pytest.raises(OSError, match="boom"):
+            faults.site("u.exc")
+    with faults.inject("u.exc", exc=lambda: ValueError("made")):
+        with pytest.raises(ValueError, match="made"):
+            faults.site("u.exc")
+
+
+def test_delay_only_injection():
+    import time
+    with faults.inject("u.delay", exc=None, delay=0.02):
+        t0 = time.monotonic()
+        faults.site("u.delay")
+        assert time.monotonic() - t0 >= 0.02
+
+
+# -- resilience policies ---------------------------------------------------
+
+def test_retry_recovers_and_counts():
+    n = [0]
+
+    def flaky():
+        n[0] += 1
+        if n[0] < 3:
+            raise ConnectionError("not yet")
+        return "up"
+
+    before = _counter("resilience.retry.unit.recovered")
+    out = resilience.retry_call(flaky, policy=resilience.policy(
+        "unit", base_delay=0.001, jitter=0,
+        retry_on=(ConnectionError,)))
+    assert out == "up" and n[0] == 3
+    assert _counter("resilience.retry.unit.recovered") == before + 1
+
+
+def test_backoff_schedule_deterministic(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(resilience, "_sleep", sleeps.append)
+
+    def always_down():
+        raise ConnectionError("down")
+
+    pol = resilience.policy("unit.sched", base_delay=0.01, jitter=0,
+                            multiplier=2.0, max_delay=0.04,
+                            max_attempts=4, retry_on=(ConnectionError,))
+    with pytest.raises(ConnectionError):
+        resilience.retry_call(always_down, policy=pol)
+    # exponential, capped at max_delay; 4 attempts = 3 retry sleeps
+    assert sleeps == [0.01, 0.02, 0.04]
+
+
+def test_non_retryable_exception_propagates_immediately():
+    n = [0]
+
+    def typed():
+        n[0] += 1
+        raise KeyError("wrong kind")
+
+    with pytest.raises(KeyError):
+        resilience.retry_call(typed, policy=resilience.policy(
+            "unit", retry_on=(ConnectionError,)))
+    assert n[0] == 1
+
+
+def test_deadline_bounds_the_loop(monkeypatch):
+    monkeypatch.setattr(resilience, "_sleep", lambda s: None)
+    clock = [0.0]
+    monkeypatch.setattr(resilience.time, "monotonic",
+                        lambda: clock.__setitem__(0, clock[0] + 1.0)
+                        or clock[0])
+    before = _counter("resilience.retry.unit.dl.giveup")
+    with pytest.raises(ConnectionError):
+        resilience.retry_call(
+            lambda: (_ for _ in ()).throw(ConnectionError("x")),
+            policy=resilience.policy("unit.dl", deadline=2.0, jitter=0,
+                                     max_attempts=99,
+                                     retry_on=(ConnectionError,)))
+    assert _counter("resilience.retry.unit.dl.giveup") == before + 1
+
+
+def test_decorator_and_attempts_forms():
+    n = [0]
+
+    @resilience.retry(domain="unit.deco", base_delay=0.001, jitter=0,
+                      retry_on=(ValueError,))
+    def decorated():
+        n[0] += 1
+        if n[0] < 2:
+            raise ValueError("again")
+        return n[0]
+
+    assert decorated() == 2
+
+    m = [0]
+    for attempt in resilience.attempts(resilience.policy(
+            "unit.cm", base_delay=0.001, jitter=0,
+            retry_on=(ValueError,))):
+        with attempt:
+            m[0] += 1
+            if m[0] < 3:
+                raise ValueError("again")
+    assert m[0] == 3
+
+
+def test_degrade_records_metrics_and_flight():
+    before = _counter("resilience.degrade.unit.path")
+    resilience.degrade("unit.path", detail="d", exc=RuntimeError("r"))
+    assert _counter("resilience.degrade.unit.path") == before + 1
+    recs = watchdog.flight_recorder().records()
+    mine = [r for r in recs if r["tag"] == "degrade/unit.path"]
+    assert mine and mine[-1]["status"] == "degraded"
+    assert "RuntimeError" in mine[-1]["error"]
+
+
+def test_degrade_lands_in_configured_watchdog_ring():
+    wd = watchdog.get_watchdog()  # arms the global watchdog
+    resilience.degrade("unit.wd")
+    assert any(r["tag"] == "degrade/unit.wd"
+               for r in wd.recorder.records())
+
+
+# -- flush degradation ladder ---------------------------------------------
+
+_ARR = np.random.default_rng(7).standard_normal((8, 8)) \
+    .astype("float32") * 0.3
+
+
+def _chain():
+    x = paddle.to_tensor(_ARR)
+    base = (x * 0.5 + 0.25).tanh()
+    return (base + 1.0) * (base - 1.0)
+
+
+def test_ladder_rung1_verbatim_retry_bitwise():
+    healthy = _chain().numpy()
+    before = _counter("resilience.degrade.flush.retry_verbatim")
+    with faults.inject("deferred.passes"):
+        degraded = _chain().numpy()
+    assert degraded.tobytes() == healthy.tobytes()
+    assert _counter("resilience.degrade.flush.retry_verbatim") \
+        == before + 1
+
+
+def test_ladder_rung2_eager_replay_bitwise():
+    healthy = _chain().numpy()
+    b1 = _counter("resilience.degrade.flush.retry_verbatim")
+    b2 = _counter("resilience.degrade.flush.eager_replay")
+    br = _counter("deferred.flush.eager_replay")
+    # count=2 fails the optimized AND the verbatim compile: both rungs
+    with faults.inject("deferred.compile", count=2):
+        degraded = _chain().numpy()
+    assert degraded.tobytes() == healthy.tobytes()
+    assert _counter("resilience.degrade.flush.retry_verbatim") == b1 + 1
+    assert _counter("resilience.degrade.flush.eager_replay") == b2 + 1
+    assert _counter("deferred.flush.eager_replay") == br + 1
+
+
+def test_ladder_with_passes_disabled_goes_straight_to_replay():
+    prev = paddle.get_flags(["FLAGS_deferred_passes"])[
+        "FLAGS_deferred_passes"]
+    try:
+        paddle.set_flags({"FLAGS_deferred_passes": False})
+        healthy = _chain().numpy()
+        b1 = _counter("resilience.degrade.flush.retry_verbatim")
+        with faults.inject("deferred.compile", count=1):
+            degraded = _chain().numpy()
+        assert degraded.tobytes() == healthy.tobytes()
+        # no optimized path ran, so rung 1 never fires
+        assert _counter("resilience.degrade.flush.retry_verbatim") == b1
+    finally:
+        paddle.set_flags({"FLAGS_deferred_passes": prev})
+
+
+def test_ladder_off_is_strict():
+    try:
+        paddle.set_flags({"FLAGS_flush_degradation": False})
+        with faults.inject("deferred.passes"):
+            with pytest.raises(faults.FaultInjected):
+                _chain().numpy()
+    finally:
+        paddle.set_flags({"FLAGS_flush_degradation": True})
+    # the poisoned chain must not leak into later tests
+    assert _chain().numpy().shape == (8, 8)
+
+
+def test_ladder_flight_records():
+    with faults.inject("deferred.passes"):
+        _chain().numpy()
+    assert any(r["tag"] == "degrade/flush.retry_verbatim"
+               for r in watchdog.flight_recorder().records())
+
+
+# -- crash-safe checkpoints ------------------------------------------------
+
+_CRASH_SITES = ("checkpoint.write_shards", "checkpoint.fsync",
+                "checkpoint.write_meta", "checkpoint.commit")
+
+
+@pytest.mark.parametrize("site", _CRASH_SITES)
+def test_crash_mid_save_restores_latest_valid(site):
+    paddle.seed(11)
+    m = nn.Linear(4, 4)
+    path = tempfile.mkdtemp()
+    ckpt.save_state_dict(m.state_dict(), path)
+    good = m.weight.numpy().copy()
+
+    m.weight.set_value(paddle.randn([4, 4]))
+    with faults.inject(site):
+        with pytest.raises(faults.FaultInjected):
+            ckpt.save_state_dict(m.state_dict(), path)
+
+    m2 = nn.Linear(4, 4)
+    ckpt.load_state_dict(m2.state_dict(), path)
+    assert np.array_equal(m2.weight.numpy(), good)
+
+
+def test_corrupt_shard_quarantined_and_previous_loaded():
+    paddle.seed(12)
+    m = nn.Linear(4, 4)
+    path = tempfile.mkdtemp()
+    ckpt.save_state_dict(m.state_dict(), path)
+    good = m.weight.numpy().copy()
+    m.weight.set_value(paddle.randn([4, 4]))
+    ckpt.save_state_dict(m.state_dict(), path)
+
+    shard = os.path.join(path, "ckpt_2", "shards_0.npz")
+    blob = bytearray(open(shard, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(shard, "wb").write(bytes(blob))
+
+    bq = _counter("checkpoint.quarantined")
+    m2 = nn.Linear(4, 4)
+    ckpt.load_state_dict(m2.state_dict(), path)
+    assert np.array_equal(m2.weight.numpy(), good)
+    assert os.path.isdir(os.path.join(path, "ckpt_2.corrupt"))
+    assert not os.path.exists(os.path.join(path, "ckpt_2"))
+    assert _counter("checkpoint.quarantined") == bq + 1
+
+
+def test_torn_metadata_quarantined():
+    paddle.seed(13)
+    m = nn.Linear(4, 4)
+    path = tempfile.mkdtemp()
+    ckpt.save_state_dict(m.state_dict(), path)
+    good = m.weight.numpy().copy()
+    m.weight.set_value(paddle.randn([4, 4]))
+    ckpt.save_state_dict(m.state_dict(), path)
+    with open(os.path.join(path, "ckpt_2", "metadata_0.json"), "w") as f:
+        f.write('{"format": 2, "tens')  # torn mid-write
+
+    m2 = nn.Linear(4, 4)
+    ckpt.load_state_dict(m2.state_dict(), path)
+    assert np.array_equal(m2.weight.numpy(), good)
+
+
+def test_in_flight_save_skipped_not_quarantined():
+    """A candidate with no manifest but a LIVE staging dir is a save
+    still committing (async writer / another host): the loader must
+    fall back without renaming it — quarantining would destroy the
+    commit mid-flight."""
+    paddle.seed(19)
+    m = nn.Linear(3, 3)
+    path = tempfile.mkdtemp()
+    ckpt.save_state_dict(m.state_dict(), path)
+    good = m.weight.numpy().copy()
+    os.makedirs(os.path.join(path, "ckpt_2"))  # committing, no manifest
+    os.makedirs(os.path.join(path, ".tmp.ckpt_2.1.99999.1"))  # host 1 busy
+
+    m2 = nn.Linear(3, 3)
+    ckpt.load_state_dict(m2.state_dict(), path)
+    assert np.array_equal(m2.weight.numpy(), good)
+    assert os.path.isdir(os.path.join(path, "ckpt_2"))  # untouched
+    assert not os.path.exists(os.path.join(path, "ckpt_2.corrupt"))
+
+
+def test_retention_spares_other_hosts_staging():
+    """The orphan sweep must not rmtree another host's in-flight
+    staging on a shared filesystem."""
+    paddle.seed(20)
+    m = nn.Linear(2, 2)
+    path = tempfile.mkdtemp()
+    other = os.path.join(path, ".tmp.ckpt_9.1.99999.1")  # host 1's save
+    os.makedirs(other)
+    ckpt.save_state_dict(m.state_dict(), path)  # triggers host-0 sweep
+    assert os.path.isdir(other)
+
+
+def test_own_dead_writer_staging_reaped_and_concurrent_async_ids():
+    """A crashed writer's staging (this host, dead pid) is collected by
+    the next sweep; overlapping async saves reserve DISTINCT ids."""
+    paddle.seed(21)
+    m = nn.Linear(2, 2)
+    path = tempfile.mkdtemp()
+    dead = os.path.join(path, ".tmp.ckpt_1.0.999999.1")
+    os.makedirs(dead)
+    h1 = ckpt.save_state_dict(m.state_dict(), path, async_save=True)
+    h2 = ckpt.save_state_dict(m.state_dict(), path, async_save=True)
+    assert h1.path != h2.path  # staging reservation prevents id sharing
+    h1.result(), h2.result()
+    assert not os.path.exists(dead)  # reaped by a sweep
+    ids = sorted(d for d in os.listdir(path) if d.startswith("ckpt_"))
+    assert ids == ["ckpt_2", "ckpt_3"]  # id 1 was reserved by the dead save
+
+
+def test_no_valid_checkpoint_raises():
+    path = tempfile.mkdtemp()
+    os.makedirs(os.path.join(path, "ckpt_1"))  # uncommitted: no metadata
+    with pytest.raises(ValueError, match="no valid checkpoint"):
+        ckpt.load_state_dict({"w": paddle.zeros([2])}, path)
+
+
+def test_retention_keeps_last_k():
+    paddle.seed(14)
+    m = nn.Linear(2, 2)
+    path = tempfile.mkdtemp()
+    try:
+        paddle.set_flags({"FLAGS_checkpoint_keep": 2})
+        for _ in range(5):
+            ckpt.save_state_dict(m.state_dict(), path)
+    finally:
+        paddle.set_flags({"FLAGS_checkpoint_keep": 3})
+    live = sorted(d for d in os.listdir(path) if d.startswith("ckpt_"))
+    assert live == ["ckpt_4", "ckpt_5"]
+
+
+def test_async_save_failure_reraises_on_result():
+    paddle.seed(15)
+    m = nn.Linear(2, 2)
+    path = tempfile.mkdtemp()
+    with faults.inject("checkpoint.write_shards"):
+        h = ckpt.save_state_dict(m.state_dict(), path, async_save=True)
+        with pytest.raises(faults.FaultInjected):
+            h.result()
+    # collected failure must NOT resurface on the next save
+    ckpt.save_state_dict(m.state_dict(), path)
+
+
+def test_async_save_failure_surfaces_on_next_save():
+    paddle.seed(16)
+    m = nn.Linear(2, 2)
+    path = tempfile.mkdtemp()
+    with faults.inject("checkpoint.write_shards"):
+        h = ckpt.save_state_dict(m.state_dict(), path, async_save=True)
+        h._thread.join()  # wait without collecting the exception
+    with pytest.raises(RuntimeError, match="previous async save"):
+        ckpt.save_state_dict(m.state_dict(), path)
+    # surfaced once, then dropped: saves work again
+    ckpt.save_state_dict(m.state_dict(), path)
+
+
+def test_async_save_success_roundtrip_and_tracking():
+    paddle.seed(17)
+    m = nn.Linear(3, 3)
+    path = tempfile.mkdtemp()
+    h = ckpt.save_state_dict(m.state_dict(), path, async_save=True)
+    assert not h._thread.daemon  # tracked writer, not fire-and-forget
+    h.result()
+    m2 = nn.Linear(3, 3)
+    ckpt.load_state_dict(m2.state_dict(), path)
+    assert np.array_equal(m.weight.numpy(), m2.weight.numpy())
+
+
+def test_load_closes_npz_handles(monkeypatch):
+    paddle.seed(18)
+    m = nn.Linear(3, 3)
+    path = tempfile.mkdtemp()
+    ckpt.save_state_dict(m.state_dict(), path)
+    opened = []
+    real_load = np.load
+
+    def spying_load(*a, **kw):
+        f = real_load(*a, **kw)
+        opened.append(f)
+        return f
+
+    monkeypatch.setattr(np, "load", spying_load)
+    ckpt.load_state_dict(m.state_dict(), path)
+    assert opened and all(f.zip is None for f in opened)
+
+
+def test_coverage_union_rejects_overlap_plus_gap():
+    """Overlapping shards [0,4) + [2,6) sum to 8 'filled' elements on a
+    shape-[8] tensor — the old per-shard count passed while [6,8) was
+    never written. The union count must reject it."""
+    import json
+
+    path = tempfile.mkdtemp()
+    np.savez(os.path.join(path, "shards_0.npz"),
+             **{"w::0::0": np.ones(4, np.float32),
+                "w::0::1": np.ones(4, np.float32)})
+    json.dump({"host": 0, "tensors": {"w": {
+        "shape": [8], "dtype": "float32",
+        "shards": [
+            {"key": "w::0::0", "index": [[0, 4]], "host": 0,
+             "file": "shards_0.npz"},
+            {"key": "w::0::1", "index": [[2, 6]], "host": 0,
+             "file": "shards_0.npz"}]}}},
+        open(os.path.join(path, "metadata_0.json"), "w"))
+    with pytest.raises(ValueError, match="missing"):
+        ckpt.load_state_dict({"w": paddle.zeros([8])}, path)
+
+
+def test_coverage_union_accepts_full_overlap():
+    import json
+
+    path = tempfile.mkdtemp()
+    full = np.arange(8, dtype=np.float32)
+    np.savez(os.path.join(path, "shards_0.npz"),
+             **{"w::0::0": full[:6], "w::0::1": full[4:]})
+    json.dump({"host": 0, "tensors": {"w": {
+        "shape": [8], "dtype": "float32",
+        "shards": [
+            {"key": "w::0::0", "index": [[0, 6]], "host": 0,
+             "file": "shards_0.npz"},
+            {"key": "w::0::1", "index": [[4, 8]], "host": 0,
+             "file": "shards_0.npz"}]}}},
+        open(os.path.join(path, "metadata_0.json"), "w"))
+    target = {"w": paddle.zeros([8])}
+    ckpt.load_state_dict(target, path)
+    np.testing.assert_allclose(target["w"].numpy(), full)
+
+
+# -- rendezvous retry ------------------------------------------------------
+
+def _store_lib_available():
+    try:
+        from paddle_tpu.csrc.build import load_library
+        load_library("pt_store")
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _store_lib_available(),
+                    reason="native pt_store unavailable")
+def test_store_connect_succeeds_after_injected_refusals():
+    from paddle_tpu.distributed.store import TCPStore
+
+    master = TCPStore(is_master=True)
+    prev = paddle.get_flags(["FLAGS_retry_base_delay_ms"])[
+        "FLAGS_retry_base_delay_ms"]
+    br = _counter("resilience.retry.store.connect.recovered")
+    try:
+        paddle.set_flags({"FLAGS_retry_base_delay_ms": 1.0})
+        with faults.inject("store.connect", nth=1, count=3,
+                           exc=ConnectionError("refused")) as inj:
+            client = TCPStore(port=master.port)
+        assert inj.fired == 3
+    finally:
+        paddle.set_flags({"FLAGS_retry_base_delay_ms": prev})
+    client.set("chaos", "ok")
+    assert client.get("chaos") == b"ok"
+    assert _counter("resilience.retry.store.connect.recovered") == br + 1

@@ -36,6 +36,8 @@ _MAP = [
                          "tests/test_oracle_sweep_extras.py",
                          "tests/test_special_ops.py", "tests/test_ops.py",
                          "tests/ops"]),
+    ("paddle_tpu/core/resilience.py", ["tests/framework/test_chaos.py"]),
+    ("paddle_tpu/testing/", ["tests/framework/test_chaos.py"]),
     ("paddle_tpu/core/", ["tests/core", "tests/test_autograd.py",
                           "tests/test_tensor.py", "tests/framework"]),
     ("paddle_tpu/passes/", ["tests/framework/test_passes.py",
@@ -56,6 +58,8 @@ _MAP = [
                               "tests/core/test_deferred.py"]),
     ("tools/dispatch_gate.py",
      ["tests/framework/test_dispatch_fastpath.py"]),
+    ("tools/chaos_gate.py", ["tests/framework/test_chaos.py",
+                             "tests/distributed/test_checkpoint.py"]),
     ("tools/", []),
 ]
 # smoke that always runs when any paddle_tpu source changed
