@@ -74,6 +74,30 @@ def main():
             print(f"  request {rid}: {len(out)} tokens -> "
                   f"{np.asarray(out).reshape(-1)[:8].tolist()}...")
 
+    # --- the serving layer: streaming, deadlines, SLO telemetry --------
+    # (docs/SERVING.md) — same engine underneath, plus admission
+    # control, preemption instead of truncation, and token streaming
+    from paddle_tpu.serving import ServingEngine
+
+    with ServingEngine(model, max_batch=4, block_size=8, max_seq_len=128,
+                       temperature=0.0, bucket_cap=64) as serving:
+        prompt = rng.integers(3, model.config.vocab_size, size=7)
+        handle = serving.submit(prompt, max_new_tokens=args.max_new,
+                                deadline_s=120.0)
+        streamed = list(handle.stream(timeout=300))
+        print(f"serving: streamed {len(streamed)} tokens "
+              f"(status={handle.status}) -> {streamed[:8]}...")
+    from paddle_tpu.profiler import metrics
+    snap = metrics.snapshot("serving.")
+
+    def _avg(name):  # histogram avg is None until it has observations
+        v = snap[name]["avg"]
+        return f"{v:.0f}us" if v is not None else "n/a"
+
+    print(f"serving SLO: ttft_avg={_avg('serving.ttft_us')} "
+          f"itl_avg={_avg('serving.itl_us')} "
+          f"preempts={snap['serving.preempt']}")
+
     # paged decode must agree with the dense-cache generate path
     prompt = rng.integers(3, model.config.vocab_size, size=6)
     dense = model.generate(paddle.to_tensor(prompt[None, :]),

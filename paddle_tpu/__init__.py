@@ -64,6 +64,7 @@ from . import audio  # noqa: F401
 from . import distribution  # noqa: F401
 from . import inference  # noqa: F401
 from . import models  # noqa: F401
+from . import serving  # noqa: F401
 from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401

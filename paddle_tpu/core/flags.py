@@ -152,3 +152,16 @@ define_flag("FLAGS_flush_degradation", True,
 define_flag("FLAGS_checkpoint_keep", 3,
             "retain-last-K sweep after each successful save_state_dict "
             "(versioned ckpt_* layout); 0 keeps every checkpoint")
+define_flag("FLAGS_serving_max_queue", 256,
+            "serving admission-queue bound (paddle_tpu/serving): submits "
+            "beyond this raise QueueFullError — backpressure instead of "
+            "unbounded host memory growth; 0 = unbounded")
+define_flag("FLAGS_serving_prefill_budget", 512,
+            "max prompt tokens prefilled per scheduler step (iteration-"
+            "level scheduling: bounds prefill work per step so long "
+            "prompts cannot starve running decodes); 0 = unlimited")
+define_flag("FLAGS_serving_prefill_bucket_cap", 1024,
+            "serving prefill padded lengths round up to power-of-two "
+            "buckets capped here (bounds the warm jit-cache footprint to "
+            "log2(cap) prefill programs); 0 disables bucketing (pad to "
+            "block multiple only)")

@@ -44,8 +44,8 @@ import time
 from . import flags as flags_mod
 from ..profiler import metrics as _metrics
 
-__all__ = ["RetryPolicy", "policy", "retry", "retry_call", "attempts",
-           "degrade"]
+__all__ = ["RetryPolicy", "Deadline", "policy", "retry", "retry_call",
+           "attempts", "degrade"]
 
 # monkeypatch seam for tests (and the chaos gate) — backoff sleeps go
 # through here so a scenario can run wall-clock-free
@@ -235,6 +235,35 @@ def retry(policy=None, *, domain="default", **overrides):
             return _invoke(fn, pol, args, kwargs)
         return wrapper
     return deco
+
+
+class Deadline:
+    """Absolute time budget on the monotonic clock.
+
+    The serving layer attaches one per request (``Deadline.after(
+    timeout_s)``) and sweeps ``expired()`` at step boundaries; retry
+    loops can use ``remaining()`` to bound their final sleep. A
+    ``None``-deadline is represented by not constructing one (callers
+    test ``deadline is not None``), keeping ``expired()`` branch-free.
+    """
+
+    __slots__ = ("expires_at",)
+
+    def __init__(self, seconds):
+        self.expires_at = time.monotonic() + float(seconds)
+
+    @classmethod
+    def after(cls, seconds):
+        return cls(seconds)
+
+    def expired(self):
+        return time.monotonic() >= self.expires_at
+
+    def remaining(self):
+        return max(0.0, self.expires_at - time.monotonic())
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"Deadline(remaining={self.remaining():.3f}s)"
 
 
 # -- degradation events ----------------------------------------------------

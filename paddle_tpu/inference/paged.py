@@ -35,9 +35,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..profiler import metrics as _metrics
+
+# pool-exhaustion preemptions (free the victim's blocks + requeue for
+# re-prefill) — shared name with the serving layer's scheduler so both
+# engines report under one metric
+_PREEMPTS = _metrics.counter("serving.preempt")
 
 __all__ = ["PagedKVCache", "paged_prefill_write", "paged_decode_attention",
-           "paged_decode_attention_dense", "ContinuousBatchingEngine"]
+           "paged_decode_attention_dense", "ContinuousBatchingEngine",
+           "validate_request"]
 
 
 class PagedKVCache:
@@ -225,6 +232,38 @@ def paged_decode_attention_dense(q, k_pool, v_pool, block_tables, seq_lens,
 # continuous batching engine
 # ---------------------------------------------------------------------------
 
+def validate_request(prompt_ids, max_new_tokens, max_seq_len, cache,
+                     who="add_request"):
+    """Shared submit-time validation for the base engine AND the serving
+    scheduler (one place, so the contracts cannot drift): non-empty
+    prompt, >= 1 new token, prompt and prompt+max_new within
+    ``max_seq_len``, and the worst-case block demand
+    ``ceil((prompt+max_new-1)/block_size)`` within the pool — a request
+    that could never finish even alone must be rejected HERE, not hang
+    admission forever. Returns the flattened prompt array."""
+    prompt = np.asarray(prompt_ids).reshape(-1)
+    if prompt.size == 0:
+        raise ValueError(f"{who}: empty prompt")
+    if max_new_tokens < 1:
+        raise ValueError(f"{who}: max_new_tokens must be >= 1, got "
+                         f"{max_new_tokens}")
+    if prompt.size > max_seq_len:
+        raise ValueError(
+            f"{who}: prompt length {prompt.size} exceeds max_seq_len "
+            f"{max_seq_len}")
+    if prompt.size + max_new_tokens > max_seq_len:
+        raise ValueError(
+            f"{who}: prompt ({prompt.size}) + max_new_tokens "
+            f"({max_new_tokens}) exceeds max_seq_len {max_seq_len}")
+    need = math.ceil((prompt.size + max_new_tokens - 1) / cache.block_size)
+    usable = cache.num_blocks - 1
+    if need > usable:
+        raise ValueError(
+            f"{who}: request needs up to {need} KV blocks but the pool "
+            f"has only {usable} usable; increase num_blocks or lower "
+            "max_new_tokens")
+    return prompt
+
 @dataclass
 class _Request:
     rid: int
@@ -250,6 +289,7 @@ class ContinuousBatchingEngine:
         self.model = model
         self.eos_token_id = eos_token_id
         self.temperature = temperature
+        self.max_seq_len = max_seq_len
         mbps = math.ceil(max_seq_len / block_size)
         if num_blocks is None:
             num_blocks = max_batch * mbps + 1  # +1: reserved null block
@@ -266,21 +306,34 @@ class ContinuousBatchingEngine:
         self._remaining = np.zeros((max_batch,), np.int64)
 
     def add_request(self, prompt_ids, max_new_tokens=32):
+        prompt = validate_request(prompt_ids, max_new_tokens,
+                                  self.max_seq_len, self.cache)
         rid = self._next_rid
         self._next_rid += 1
-        self.waiting.append(_Request(rid, np.asarray(prompt_ids).reshape(-1),
-                                     max_new_tokens))
+        self.waiting.append(_Request(rid, prompt, max_new_tokens))
         return rid
 
     @property
     def has_work(self):
         return bool(self.waiting or self.running)
 
+    def _prefill_ids(self, req):
+        """Prompt plus any already-generated tokens: after a preemption
+        the request re-prefills its full context, and the prefill's
+        sampled token is the NEXT new token (greedy decode therefore
+        continues bit-identically to an uncontended run)."""
+        if not req.generated:
+            return req.prompt
+        return np.concatenate(
+            [req.prompt,
+             np.asarray(req.generated, dtype=req.prompt.dtype)])
+
     def _admit(self):
         admitted = []
         still_waiting = []
         for req in self.waiting:
-            slot = self.cache.alloc_slot(len(req.prompt)) \
+            slot = self.cache.alloc_slot(
+                len(req.prompt) + len(req.generated)) \
                 if len(self.running) < self.cache.max_batch else None
             if slot is None:
                 still_waiting.append(req)
@@ -290,12 +343,24 @@ class ContinuousBatchingEngine:
             admitted.append(req)
         self.waiting = still_waiting
         for req in admitted:
-            tok = self.model.paged_prefill(self.cache, req.slot, req.prompt,
+            tok = self.model.paged_prefill(self.cache, req.slot,
+                                           self._prefill_ids(req),
                                            temperature=self.temperature)
             self._last_tok[req.slot] = tok
-            self._remaining[req.slot] = req.max_new_tokens - 1
+            self._remaining[req.slot] = \
+                req.max_new_tokens - len(req.generated) - 1
             req.generated.append(int(tok))
             self._maybe_finish(req.slot)
+
+    def _preempt(self, slot):
+        """Victim loses its slot and blocks NOW; its generated tokens are
+        kept and it rejoins the FRONT of the waiting queue, where the
+        next `_admit` re-prefills prompt+generated (see `_prefill_ids`)."""
+        req = self.running.pop(slot)
+        self.cache.free_slot(slot)
+        req.slot = -1
+        self.waiting.insert(0, req)
+        _PREEMPTS.inc()
 
     def _maybe_finish(self, slot):
         req = self.running.get(slot)
@@ -323,9 +388,18 @@ class ContinuousBatchingEngine:
         lens = self.cache.seq_lens
         for slot in list(self.running):
             if not self.cache.ensure_capacity(slot, int(lens[slot]) + 1):
-                # pool exhausted: finish the victim early
-                self._remaining[slot] = 0
-                self._maybe_finish(slot)
+                # pool exhausted: preempt (free the blocks, requeue for
+                # re-prefill once others release pages) instead of
+                # silently truncating the sequence
+                if len(self.running) == 1:
+                    req = self.running[slot]
+                    raise RuntimeError(
+                        f"KV pool exhausted: request {req.rid} needs "
+                        f"{math.ceil((int(lens[slot]) + 1) / self.cache.block_size)} "
+                        f"blocks but the pool has only "
+                        f"{self.cache.num_blocks - 1} usable and no other "
+                        "running request to wait for; increase num_blocks")
+                self._preempt(slot)
                 active_np[slot] = False
         if not self.running:
             return []

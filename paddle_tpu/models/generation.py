@@ -25,7 +25,10 @@ def sample_token(logits, temperature=1.0, top_k=0, top_p=1.0, key=None):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / temperature
     if top_k and top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        # clamp: top_k >= vocab keeps every token (and avoids the
+        # out-of-bounds [:, -top_k] static index)
+        k = min(int(top_k), logits.shape[-1])
+        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
@@ -103,16 +106,30 @@ def generate(model, input_ids, max_new_tokens=32, temperature=0.0,
 
 def _generate_no_cache(model, ids, max_new_tokens, temperature, top_k,
                        top_p, eos_token_id):
-    """Fallback full-context decoding for models without cache support."""
+    """Fallback full-context decoding for models without cache support.
+    Same eos contract as the cached path: rows that hit eos keep
+    emitting eos, and once every row is done the remaining positions
+    fill with eos without further model calls."""
     from ..core.autograd import no_grad
 
     with no_grad():
         out = ids
-        for _ in range(max_new_tokens):
+        b = ids.shape[0]
+        done = jnp.zeros((b,), bool)
+        for t in range(max_new_tokens):
             logits = model(Tensor(out))
             key = random_mod.next_key()
             tok = sample_token(logits._data[:, -1, :], temperature, top_k,
                                top_p, key)
+            if eos_token_id is not None:
+                tok = jnp.where(done, eos_token_id, tok)
+                done = done | (tok == eos_token_id)
             out = jnp.concatenate([out, tok[:, None].astype(out.dtype)],
                                   axis=1)
+            if eos_token_id is not None and t < max_new_tokens - 1 \
+                    and bool(done.all()):
+                pad = jnp.full((b, max_new_tokens - 1 - t), eos_token_id,
+                               out.dtype)
+                out = jnp.concatenate([out, pad], axis=1)
+                break
         return Tensor(out)

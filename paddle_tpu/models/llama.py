@@ -300,10 +300,18 @@ class Llama(nn.Layer):
     def _param_arrays(self):
         return tuple(p._data for _, p in self.named_parameters())
 
-    def paged_prefill(self, cache, slot, prompt_ids, temperature=0.0):
+    def paged_prefill(self, cache, slot, prompt_ids, temperature=0.0,
+                      pad_to=None):
         """Run the prompt through the dense forward (causal), write its
         post-rope KV into the slot's pool blocks, set seq_len, and return
-        the first sampled token."""
+        the first sampled token.
+
+        ``pad_to`` (serving/bucketing.py): pad the prompt to a bucketed
+        length instead of the next block multiple, so warm serving traces
+        a bounded set of prefill shapes. Padding beyond the slot's
+        allocated blocks is safe: the extra table entries are 0, the
+        reserved null block, and everything past ``true_len`` is masked.
+        """
         from ..core.random import next_key
         from ..inference.paged import paged_prefill_write
 
@@ -311,6 +319,10 @@ class Llama(nn.Layer):
         s = prompt.shape[0]
         bs = cache.block_size
         spad = -(-s // bs) * bs
+        if pad_to is not None:
+            cap = cache.max_blocks_per_seq * bs
+            want = min(max(int(pad_to), spad), cap)
+            spad = -(-want // bs) * bs
         ids = np.zeros((1, spad), np.int64)
         ids[:, :s] = prompt
 

@@ -408,6 +408,27 @@ class Profiler:
                 "{:<40} {:>8} {:>12.1f} {:>12.1f} {:>12.1f}  {}".format(
                     name[:40], a["calls"], a["total"],
                     a["total"] / a["calls"], a["max"], paths))
+        serving = metrics.snapshot("serving.")
+        # the family registers at import time, so gate the section on
+        # actual serving activity, not mere registration
+        if serving and serving.get("serving.steps"):
+            # Serving / SLO view: the always-on serving.* registry
+            # family (TTFT / ITL histograms, queue/slot/KV gauges,
+            # admit/decode/preempt counters) — docs/SERVING.md
+            lines.append("")
+            lines.append("{:-^72}".format(" Serving / SLO View "))
+            lines.append("{:<36} {}".format("metric", "value"))
+            for name in sorted(serving):
+                v = serving[name]
+                if isinstance(v, dict):
+                    desc = f"count={v['count']}"
+                    if v["count"]:
+                        desc += (f" avg={v['avg']:.6g}"
+                                 f" min={v['min']:.6g}"
+                                 f" max={v['max']:.6g}")
+                else:
+                    desc = str(v)
+                lines.append("{:<36} {}".format(name, desc))
         if self._memory_samples:
             # MemoryView (reference profiler_statistic.py memory table)
             lines.append("")

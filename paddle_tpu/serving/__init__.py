@@ -1,0 +1,30 @@
+"""Production-shaped LLM serving over the paged KV cache.
+
+The layer the ROADMAP's "serves heavy traffic" north star needs on top
+of `inference.paged`: an iteration-level continuous-batching scheduler
+(admission control + prefill budgeting + preemption instead of
+truncation), a thread-safe streaming frontend with per-request
+deadlines and cancellation, prefill length bucketing for a bounded
+warm jit-cache footprint, and SLO telemetry in the always-on metrics
+registry (``serving.*``, surfaced by ``profiler.summary()``).
+
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(model, max_batch=8, max_seq_len=2048)
+    h = eng.submit(prompt_ids, max_new_tokens=128, deadline_s=30.0)
+    for tok in h.stream():
+        ...
+    assert h.status == "DONE"
+
+See docs/SERVING.md for the scheduling policy, the preemption
+contract, and the metric catalog.
+"""
+
+from .bucketing import bucket_length, bucket_lengths  # noqa: F401
+from .frontend import (QueueFullError, RequestHandle,  # noqa: F401
+                       RequestStatus, ServingEngine)
+from .scheduler import Scheduler, ServingRequest  # noqa: F401
+
+__all__ = ["ServingEngine", "RequestHandle", "RequestStatus",
+           "QueueFullError", "Scheduler", "ServingRequest",
+           "bucket_length", "bucket_lengths"]

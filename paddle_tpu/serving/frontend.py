@@ -1,0 +1,245 @@
+"""Thread-safe serving frontend: submit/stream/cancel over the
+iteration scheduler.
+
+``ServingEngine`` is the process-wide entry point a server loop (RPC
+handler, HTTP worker pool, ...) calls from many threads:
+
+- ``submit() -> RequestHandle`` — validated admission; the handle
+  streams tokens incrementally (``stream()`` iterator, ``on_token``
+  callback), waits for completion (``result()``), and cancels.
+- a background **driver thread** (default) runs scheduler steps while
+  work exists and sleeps on a condition otherwise; ``background=False``
+  hands the stepping to the caller (``step()`` / ``drain()``) for
+  deterministic tests and gates.
+- per-request deadlines ride on ``core.resilience.Deadline``; expired
+  requests finish with status ``TIMEOUT`` at the next step boundary.
+
+One re-entrant lock guards all scheduler state, and the driver holds it
+for the duration of a scheduling iteration (prefill + decode are device
+calls) — so ``submit()``/``cancel()``/``tokens()`` are cheap host-side
+operations that may nevertheless wait up to one in-flight step (or a
+cold compile, on the very first requests) before acquiring the lock.
+Don't call them on a thread that cannot tolerate ~one decode step of
+latency. If the driver thread dies, every live request terminates with
+``ERROR`` and the cause re-raises from ``submit``/``result`` — a
+crashed engine never leaves a consumer blocked on a silent stream.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+
+from ..core import resilience
+from .scheduler import QueueFullError, RequestStatus, Scheduler
+
+__all__ = ["ServingEngine", "RequestHandle", "QueueFullError",
+           "RequestStatus"]
+
+_SENTINEL = object()
+
+
+class RequestHandle:
+    """Caller-side view of one request. Safe to use from any thread."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self._req = None  # bound by ServingEngine.submit
+        self._q = queue_mod.Queue()
+        self._done = threading.Event()
+
+    @property
+    def rid(self):
+        return self._req.rid
+
+    @property
+    def status(self):
+        return self._req.status
+
+    @property
+    def preempts(self):
+        return self._req.preempts
+
+    def tokens(self):
+        """Tokens generated so far (stable snapshot)."""
+        with self._engine._lock:
+            return list(self._req.generated)
+
+    def cancel(self):
+        self._engine.cancel(self)
+
+    def stream(self, timeout=None):
+        """Yield tokens as they are produced; ends when the request
+        reaches a terminal status (check ``.status`` for CANCELLED /
+        TIMEOUT). If the ENGINE died the stream raises its fatal error
+        instead of ending — truncated output must never look complete.
+        ``timeout`` bounds the wait per token (queue.Empty past it)."""
+        while True:
+            item = self._q.get(timeout=timeout)
+            if item is _SENTINEL:
+                if self._req.status == RequestStatus.ERROR:
+                    err = self._engine._error
+                    if err is not None:
+                        raise err
+                return
+            yield item
+
+    def result(self, timeout=None):
+        """Block until terminal; returns the generated tokens. Raises
+        TimeoutError if the wait exceeds ``timeout``, or the engine's
+        fatal error if serving itself died."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.rid} not finished within {timeout}s")
+        if self._req.status == RequestStatus.ERROR:
+            err = self._engine._error
+            if err is not None:
+                raise err
+        return self.tokens()
+
+
+class ServingEngine:
+    """See module docstring. Construct once per model; context-manager
+    friendly (``with ServingEngine(model) as eng: ...``)."""
+
+    def __init__(self, model, *, max_batch=8, block_size=16,
+                 max_seq_len=2048, num_blocks=None, temperature=0.0,
+                 eos_token_id=None, dtype=None,
+                 prefill_token_budget=None, max_queue=None,
+                 bucket_cap=None, background=True):
+        self._sched = Scheduler(
+            model, max_batch=max_batch, block_size=block_size,
+            max_seq_len=max_seq_len, num_blocks=num_blocks,
+            temperature=temperature, eos_token_id=eos_token_id,
+            dtype=dtype, prefill_token_budget=prefill_token_budget,
+            max_queue=max_queue, bucket_cap=bucket_cap)
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._background = background
+        self._thread = None
+        self._closed = False
+        self._error = None
+
+    # -- submission ----------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens=32, *, deadline_s=None,
+               deadline=None, on_token=None):
+        """Enqueue a request; returns a RequestHandle immediately.
+
+        ``deadline_s`` (relative seconds) or ``deadline`` (a
+        ``resilience.Deadline``) bounds total latency: expiry finishes
+        the request with status TIMEOUT at the next step boundary and
+        frees its blocks. ``on_token(token)`` is called per generated
+        token from the stepping thread — keep it fast.
+        """
+        handle = RequestHandle(self)
+
+        def _sink_token(req, tok):
+            handle._q.put(tok)
+            if on_token is not None:
+                on_token(tok)
+
+        def _sink_finish(req):
+            handle._q.put(_SENTINEL)
+            handle._done.set()
+
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("ServingEngine is closed")
+            if self._error is not None:
+                raise RuntimeError(
+                    "ServingEngine died; no new submissions") \
+                    from self._error
+            if deadline is None and deadline_s is not None:
+                deadline = resilience.Deadline.after(deadline_s)
+            handle._req = self._sched.submit(
+                prompt_ids, max_new_tokens, deadline=deadline,
+                on_token=_sink_token, on_finish=_sink_finish)
+            if self._background and self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._drive, name="paddle-tpu-serving",
+                    daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+        return handle
+
+    def cancel(self, handle):
+        with self._cond:
+            self._sched.cancel(handle._req)
+            self._cond.notify_all()
+
+    # -- stepping ------------------------------------------------------
+
+    @property
+    def has_work(self):
+        return self._sched.has_work
+
+    @property
+    def scheduler(self):
+        return self._sched
+
+    @property
+    def cache(self):
+        return self._sched.cache
+
+    def step(self):
+        """Run one scheduling iteration (foreground mode, or extra
+        nudges in background mode)."""
+        with self._lock:
+            return self._sched.step()
+
+    def drain(self):
+        """Step until idle (foreground mode). Results arrive via the
+        handles."""
+        while True:
+            with self._lock:
+                if not self._sched.has_work:
+                    return
+            self.step()
+
+    def _drive(self):
+        try:
+            while True:
+                with self._cond:
+                    while not self._sched.has_work:
+                        if self._closed:
+                            return
+                        self._cond.wait()
+                    if self._closed and not self._sched.has_work:
+                        return
+                self.step()
+        except BaseException as e:  # noqa: BLE001 — fail loud, not silent
+            with self._cond:
+                self._error = e
+                self._sched.fail_all(e)
+            resilience.degrade("serving.engine", exc=e)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def close(self, cancel_pending=True, timeout=60):
+        """Stop serving. ``cancel_pending=True`` (default) cancels all
+        live requests (they finish CANCELLED at the final sweep);
+        ``False`` drains them first."""
+        with self._cond:
+            self._closed = True
+            if cancel_pending:
+                for req in list(self._sched.queue):
+                    req.cancel_requested = True
+                for req in list(self._sched.running.values()):
+                    req.cancel_requested = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        # foreground mode (or a dead driver): flush remaining work so
+        # every handle reaches a terminal status
+        with self._lock:
+            if self._error is None:
+                while self._sched.has_work:
+                    self._sched.step()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

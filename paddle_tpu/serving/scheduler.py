@@ -1,0 +1,417 @@
+"""Iteration-level continuous-batching scheduler (Orca-style) over the
+paged KV cache.
+
+Single-threaded policy core of the serving subsystem (thread safety is
+the frontend's job — `serving.frontend.ServingEngine` holds one lock
+around every entry point). Each ``step()`` is one scheduling iteration:
+
+1. **sweep** — cancellations and expired deadlines (``core.resilience.
+   Deadline``) finish at the step boundary and free their blocks;
+2. **admit** — strict FCFS from a bounded queue, limited by free slots,
+   free blocks, and a per-step *prefill token budget*
+   (``FLAGS_serving_prefill_budget``) so a burst of long prompts cannot
+   starve running decodes; admitted prompts prefill at a bucketed
+   length (`serving.bucketing`) and stream their first token;
+3. **decode** — ONE jitted step for every live slot. Pool exhaustion
+   preempts the newest-admitted victim (free blocks + requeue at the
+   queue front for re-prefill) instead of truncating anyone —
+   ``serving.preempt`` counts it, and greedy outputs stay bit-identical
+   to an uncontended run because re-prefill replays prompt+generated
+   and the prefill's sampled token is the next new token.
+
+Every request terminates in exactly one of ``DONE`` / ``CANCELLED`` /
+``TIMEOUT`` (or ``ERROR`` if the engine itself died). SLO telemetry
+goes to the always-on registry under ``serving.*`` (TTFT / inter-token
+latency histograms, queue/slot/KV-utilization gauges, admitted/decoded/
+preempted counters) and is surfaced by ``profiler.summary()``.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+import numpy as np
+
+from ..core import flags as flags_mod
+from ..core import resilience
+from ..inference.paged import PagedKVCache, validate_request
+from ..profiler import metrics as _metrics
+from .bucketing import bucket_length
+
+__all__ = ["RequestStatus", "ServingRequest", "Scheduler",
+           "QueueFullError"]
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at FLAGS_serving_max_queue: backpressure — the
+    caller should retry later or shed load upstream."""
+
+
+class RequestStatus:
+    QUEUED = "QUEUED"
+    RUNNING = "RUNNING"
+    DONE = "DONE"
+    CANCELLED = "CANCELLED"
+    TIMEOUT = "TIMEOUT"
+    ERROR = "ERROR"
+
+    TERMINAL = (DONE, CANCELLED, TIMEOUT, ERROR)
+
+
+class ServingRequest:
+    """One request's full lifecycle state. ``generated`` only ever
+    appends (preemption keeps it), so handle readers see a stable
+    prefix."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "deadline",
+                 "on_token", "on_finish", "status", "generated", "slot",
+                 "preempts", "admit_seq", "submitted_at", "admitted_at",
+                 "first_token_at", "last_token_at", "cancel_requested")
+
+    def __init__(self, rid, prompt, max_new_tokens, deadline=None,
+                 on_token=None, on_finish=None):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.deadline = deadline
+        self.on_token = on_token
+        self.on_finish = on_finish
+        self.status = RequestStatus.QUEUED
+        self.generated = []
+        self.slot = -1
+        self.preempts = 0
+        self.admit_seq = -1
+        self.submitted_at = time.monotonic()
+        self.admitted_at = None
+        self.first_token_at = None
+        self.last_token_at = None
+        self.cancel_requested = False
+
+    @property
+    def done(self):
+        return self.status in RequestStatus.TERMINAL
+
+
+# -- SLO instrumentation (always-on registry; see docs/SERVING.md) -------
+_US_BOUNDS = (500, 1000, 2500, 5000, 10000, 25000, 50000, 100000,
+              250000, 500000, 1000000, 5000000)
+_m_admitted = _metrics.counter("serving.admitted")
+_m_decoded = _metrics.counter("serving.decoded_tokens")
+_m_preempt = _metrics.counter("serving.preempt")
+_m_done = _metrics.counter("serving.completed")
+_m_cancelled = _metrics.counter("serving.cancelled")
+_m_timeout = _metrics.counter("serving.timeout")
+_m_rejected = _metrics.counter("serving.rejected")
+_m_errors = _metrics.counter("serving.errors")
+_m_cb_errors = _metrics.counter("serving.callback_errors")
+_m_steps = _metrics.counter("serving.steps")
+_h_ttft = _metrics.histogram("serving.ttft_us", bounds=_US_BOUNDS)
+_h_itl = _metrics.histogram("serving.itl_us", bounds=_US_BOUNDS)
+_h_queue_wait = _metrics.histogram("serving.queue_wait_us",
+                                   bounds=_US_BOUNDS)
+_h_step = _metrics.histogram("serving.step_us", bounds=_US_BOUNDS)
+_g_queue = _metrics.gauge("serving.queue.depth")
+_g_running = _metrics.gauge("serving.slots.running")
+_g_blocks = _metrics.gauge("serving.kv.blocks_used")
+_g_util = _metrics.gauge("serving.kv.utilization")
+
+
+class Scheduler:
+    """See module docstring. NOT thread-safe — callers serialize."""
+
+    def __init__(self, model, *, max_batch=8, block_size=16,
+                 max_seq_len=2048, num_blocks=None, temperature=0.0,
+                 eos_token_id=None, dtype=None,
+                 prefill_token_budget=None, max_queue=None,
+                 bucket_cap=None):
+        import jax.numpy as jnp
+
+        cfg = model.config
+        self.model = model
+        self.temperature = temperature
+        self.eos_token_id = eos_token_id
+        self.max_seq_len = max_seq_len
+        mbps = math.ceil(max_seq_len / block_size)
+        if num_blocks is None:
+            num_blocks = max_batch * mbps + 1  # +1: reserved null block
+        self.cache = PagedKVCache(
+            cfg.num_layers, cfg.num_kv_heads,
+            cfg.hidden_size // cfg.num_heads, num_blocks=num_blocks,
+            block_size=block_size, max_blocks_per_seq=mbps,
+            max_batch=max_batch,
+            dtype=dtype if dtype is not None else jnp.bfloat16)
+        self.prefill_token_budget = (
+            flags_mod.flag("FLAGS_serving_prefill_budget")
+            if prefill_token_budget is None else int(prefill_token_budget))
+        self.max_queue = (flags_mod.flag("FLAGS_serving_max_queue")
+                          if max_queue is None else int(max_queue))
+        self.bucket_cap = (
+            flags_mod.flag("FLAGS_serving_prefill_bucket_cap")
+            if bucket_cap is None else int(bucket_cap))
+        self.queue: list[ServingRequest] = []
+        self.running: dict[int, ServingRequest] = {}  # slot -> request
+        self.finished: dict[int, ServingRequest] = {}  # rid -> request
+        self._next_rid = 0
+        self._next_admit_seq = 0
+        self._last_tok = np.zeros((max_batch,), np.int64)
+        self._remaining = np.zeros((max_batch,), np.int64)
+
+    # -- submission / cancellation ------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens=32, *, deadline=None,
+               on_token=None, on_finish=None):
+        """Validate + enqueue; returns the ServingRequest. Raises
+        ValueError on malformed or never-servable input (never corrupts
+        the cache, never hangs admission) and QueueFullError past the
+        admission bound."""
+        prompt = validate_request(prompt_ids, max_new_tokens,
+                                  self.max_seq_len, self.cache,
+                                  who="serving.submit")
+        if self.max_queue and len(self.queue) >= self.max_queue:
+            _m_rejected.inc()
+            raise QueueFullError(
+                f"serving.submit: admission queue full "
+                f"({len(self.queue)} >= {self.max_queue})")
+        req = ServingRequest(self._next_rid, prompt, max_new_tokens,
+                             deadline=deadline, on_token=on_token,
+                             on_finish=on_finish)
+        self._next_rid += 1
+        self.queue.append(req)
+        _g_queue.set(len(self.queue))
+        return req
+
+    def cancel(self, req):
+        """Request cancellation; takes effect (blocks freed, status
+        CANCELLED, stream closed) at the next step boundary."""
+        if not req.done:
+            req.cancel_requested = True
+
+    @property
+    def has_work(self):
+        return bool(self.queue or self.running)
+
+    # -- the scheduling iteration -------------------------------------
+
+    def step(self):
+        """One iteration: sweep -> admit -> decode. Returns the list of
+        (rid, token) emitted this step (prefill first tokens included)."""
+        t0 = time.monotonic()
+        self._sweep()
+        out = self._admit()
+        out += self._decode()
+        _m_steps.inc()
+        _h_step.observe((time.monotonic() - t0) * 1e6)
+        self._update_gauges()
+        return out
+
+    def run_to_completion(self):
+        """Drain everything; {rid: generated tokens} for ALL terminal
+        requests (check .status for how each ended)."""
+        while self.has_work:
+            self.step()
+        return {rid: req.generated
+                for rid, req in self.finished.items()}
+
+    # -- internals -----------------------------------------------------
+
+    def _sweep(self):
+        for req in list(self.queue):
+            if req.cancel_requested:
+                self.queue.remove(req)
+                self._finish(req, RequestStatus.CANCELLED)
+            elif req.deadline is not None and req.deadline.expired():
+                self.queue.remove(req)
+                self._expire(req)
+        for slot, req in list(self.running.items()):
+            if req.cancel_requested:
+                self._finish(req, RequestStatus.CANCELLED)
+            elif req.deadline is not None and req.deadline.expired():
+                self._expire(req)
+
+    def _expire(self, req):
+        resilience.degrade("serving.deadline",
+                           detail=f"rid={req.rid} "
+                                  f"tokens={len(req.generated)}")
+        self._finish(req, RequestStatus.TIMEOUT)
+
+    def _prefill_ids(self, req):
+        # mirror of ContinuousBatchingEngine._prefill_ids — the
+        # re-prefill contract (prefill of prompt+generated samples the
+        # NEXT new token) must stay identical in both engines; each is
+        # pinned against uncontended references by its own test file
+        if not req.generated:
+            return req.prompt
+        return np.concatenate(
+            [req.prompt,
+             np.asarray(req.generated, dtype=req.prompt.dtype)])
+
+    def _admit(self):
+        """Strict FCFS: stop at the first request that doesn't fit (no
+        head-of-line bypass — a small late prompt never jumps an older
+        large one). Budgeted: cumulative prefill tokens per step stay
+        under the budget, except the step's first admission, which is
+        always allowed so an over-budget prompt still makes progress."""
+        out = []
+        used = 0
+        budget = self.prefill_token_budget
+        while self.queue:
+            req = self.queue[0]
+            ids_len = len(req.prompt) + len(req.generated)
+            if used > 0 and budget and used + ids_len > budget:
+                break
+            if len(self.running) >= self.cache.max_batch:
+                break
+            slot = self.cache.alloc_slot(ids_len)
+            if slot is None:
+                break
+            self.queue.pop(0)
+            used += ids_len
+            req.slot = slot
+            req.status = RequestStatus.RUNNING
+            req.admit_seq = self._next_admit_seq
+            self._next_admit_seq += 1
+            now = time.monotonic()
+            if req.admitted_at is None:
+                req.admitted_at = now
+                _h_queue_wait.observe((now - req.submitted_at) * 1e6)
+            self.running[slot] = req
+            _m_admitted.inc()
+            pad_to = bucket_length(ids_len, self.cache.block_size,
+                                   self.bucket_cap,
+                                   max_len=self.max_seq_len)
+            tok = int(self.model.paged_prefill(
+                self.cache, slot, self._prefill_ids(req),
+                temperature=self.temperature, pad_to=pad_to))
+            self._last_tok[slot] = tok
+            self._remaining[slot] = \
+                req.max_new_tokens - len(req.generated) - 1
+            self._emit(req, tok)
+            out.append((req.rid, tok))
+            self._maybe_finish(slot)
+        return out
+
+    def _decode(self):
+        if not self.running:
+            return []
+        # grow block tables; preempt the newest-admitted victim on pool
+        # exhaustion (never truncate)
+        for slot in list(self.running):
+            if slot not in self.running:  # preempted as a victim below
+                continue
+            while not self.cache.ensure_capacity(
+                    slot, int(self.cache.seq_lens[slot]) + 1):
+                if len(self.running) == 1:
+                    # unreachable since validate_request bounds each
+                    # request's worst-case demand to the pool; keep as
+                    # an invariant guard
+                    req = self.running[slot]
+                    need = math.ceil(
+                        (int(self.cache.seq_lens[slot]) + 1)
+                        / self.cache.block_size)
+                    raise RuntimeError(
+                        f"serving: KV pool exhausted — request "
+                        f"{req.rid} needs {need} blocks, pool has "
+                        f"{self.cache.num_blocks - 1} usable and no "
+                        "other running request to preempt; increase "
+                        "num_blocks or lower max_seq_len")
+                # true newest-victim: the growing slot is a candidate
+                # too — when IT is the newest it self-preempts rather
+                # than evicting an older request (FCFS holds)
+                victim = max(self.running,
+                             key=lambda s: self.running[s].admit_seq)
+                self._preempt(victim)
+                if victim == slot:
+                    break  # grower preempted itself; re-prefills later
+        if not self.running:
+            return []
+        active = np.zeros((self.cache.max_batch,), bool)
+        for slot in self.running:
+            active[slot] = True
+        toks = np.asarray(self.model.paged_decode_step(
+            self.cache, np.asarray(self._last_tok), active,
+            temperature=self.temperature))
+        out = []
+        for slot, req in list(self.running.items()):
+            t = int(toks[slot])
+            self._last_tok[slot] = t
+            self._remaining[slot] -= 1
+            self._emit(req, t)
+            out.append((req.rid, t))
+            self._maybe_finish(slot)
+        _m_decoded.inc(len(out))
+        return out
+
+    def _preempt(self, slot):
+        """Free the victim's slot + blocks; requeue at the FRONT for
+        re-prefill (prompt + generated) once pages free up. Greedy
+        decode continues identically — pinned by test_serving.py."""
+        req = self.running.pop(slot)
+        self.cache.free_slot(slot)
+        req.slot = -1
+        req.status = RequestStatus.QUEUED
+        req.preempts += 1
+        self.queue.insert(0, req)
+        _m_preempt.inc()
+        resilience.degrade("serving.preempt",
+                           detail=f"rid={req.rid} "
+                                  f"len={len(req.prompt) + len(req.generated)}")
+
+    def _emit(self, req, tok):
+        req.generated.append(tok)
+        now = time.monotonic()
+        if req.first_token_at is None:
+            req.first_token_at = now
+            _h_ttft.observe((now - req.submitted_at) * 1e6)
+        else:
+            _h_itl.observe((now - req.last_token_at) * 1e6)
+        req.last_token_at = now
+        if req.on_token is not None:
+            try:
+                req.on_token(req, tok)
+            except Exception:  # noqa: BLE001 — user cb must not kill serving
+                _m_cb_errors.inc()
+
+    def _maybe_finish(self, slot):
+        req = self.running.get(slot)
+        if req is None:
+            return
+        if self._remaining[slot] <= 0 or (
+                self.eos_token_id is not None and req.generated
+                and req.generated[-1] == self.eos_token_id):
+            self._finish(req, RequestStatus.DONE)
+
+    def _finish(self, req, status):
+        if req.slot >= 0:
+            self.cache.free_slot(req.slot)
+            self.running.pop(req.slot, None)
+            req.slot = -1
+        req.status = status
+        self.finished[req.rid] = req
+        {RequestStatus.DONE: _m_done,
+         RequestStatus.CANCELLED: _m_cancelled,
+         RequestStatus.TIMEOUT: _m_timeout,
+         RequestStatus.ERROR: _m_errors}[status].inc()
+        if req.on_finish is not None:
+            try:
+                req.on_finish(req)
+            except Exception:  # noqa: BLE001
+                _m_cb_errors.inc()
+
+    def fail_all(self, exc=None):
+        """Engine died: terminate every live request with ERROR so no
+        consumer blocks forever (the frontend re-raises the cause)."""
+        for req in list(self.queue):
+            self._finish(req, RequestStatus.ERROR)
+        self.queue.clear()
+        for slot in list(self.running):
+            self._finish(self.running[slot], RequestStatus.ERROR)
+        self._update_gauges()
+
+    def _update_gauges(self):
+        usable = self.cache.num_blocks - 1
+        used = usable - self.cache.num_free_blocks()
+        _g_queue.set(len(self.queue))
+        _g_running.set(len(self.running))
+        _g_blocks.set(used)
+        _g_util.set(round(used / usable, 4) if usable else 0.0)

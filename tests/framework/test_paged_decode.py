@@ -115,6 +115,52 @@ def test_cache_rejects_oversize():
     assert c.alloc_slot(100) is None  # > max_blocks_per_seq
 
 
+def test_add_request_validates_inputs(model):
+    eng = ContinuousBatchingEngine(model, max_batch=1, block_size=8,
+                                   max_seq_len=32, temperature=0.0)
+    with pytest.raises(ValueError):
+        eng.add_request([])
+    with pytest.raises(ValueError):
+        eng.add_request(np.arange(40))                    # > max_seq_len
+    with pytest.raises(ValueError):
+        eng.add_request(np.arange(30), max_new_tokens=8)  # total too long
+    with pytest.raises(ValueError):
+        eng.add_request(np.arange(4), max_new_tokens=0)
+    assert not eng.has_work
+    # never-servable block demand rejected up front (was: infinite
+    # admission loop in run_to_completion)
+    tiny_pool = ContinuousBatchingEngine(model, max_batch=1, block_size=8,
+                                         max_seq_len=32, num_blocks=3,
+                                         temperature=0.0)
+    with pytest.raises(ValueError):
+        tiny_pool.add_request(np.arange(10), max_new_tokens=10)
+
+
+def test_pool_exhaustion_preempts_not_truncates(model):
+    """Pool exhaustion used to silently zero `_remaining` (truncating a
+    running request); now the victim is preempted — blocks freed,
+    requeued, re-prefilled — and still emits its FULL uncontended
+    greedy output. serving.preempt counts the event."""
+    from paddle_tpu.profiler import metrics
+
+    rng = np.random.default_rng(7)
+    p1 = rng.integers(0, 255, (8,)).astype("int64")
+    p2 = rng.integers(0, 255, (8,)).astype("int64")
+    refs = [_dense_tokens(model, p, 12) for p in (p1, p2)]
+    before = metrics.snapshot("serving.")["serving.preempt"]
+    # 7 usable blocks, each request peaks at 5 -> exhaustion mid-decode
+    eng = ContinuousBatchingEngine(model, max_batch=2, block_size=4,
+                                   max_seq_len=32, num_blocks=8,
+                                   temperature=0.0)
+    r1 = eng.add_request(p1, max_new_tokens=12)
+    r2 = eng.add_request(p2, max_new_tokens=12)
+    out = eng.run_to_completion()
+    assert metrics.snapshot("serving.")["serving.preempt"] > before
+    assert out[r1] == refs[0]        # full length, bit-identical
+    assert out[r2] == refs[1]
+    assert eng.cache.num_free_blocks() == eng.cache.num_blocks - 1
+
+
 def test_paged_gqa_ratio(model):
     """tiny() config is GQA (4 q heads, 2 kv heads) — covered above — also
     check an MHA config decodes identically."""

@@ -1,0 +1,429 @@
+"""Serving layer: continuous-batching scheduler, streaming frontend,
+deadlines, preemption, bucketing, SLO telemetry.
+
+Pins the serving contract (docs/SERVING.md): every request terminates
+DONE / CANCELLED / TIMEOUT, greedy outputs are identical to an
+uncontended `ContinuousBatchingEngine` run even across preemption, and
+warm serving never recompiles (bucketing, via the `xla.compile.count`
+metric). Plus the generation satellites: `_generate_no_cache` eos
+handling and `sample_token` top_k clamping.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.paged import ContinuousBatchingEngine
+from paddle_tpu.models import Llama, LlamaConfig
+from paddle_tpu.profiler import metrics
+from paddle_tpu.serving import (QueueFullError, RequestStatus,
+                                ServingEngine, bucket_length,
+                                bucket_lengths)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _ref_tokens(model, prompt, n, *, block_size=8, max_seq_len=64):
+    """Uncontended greedy reference via the base engine."""
+    eng = ContinuousBatchingEngine(model, max_batch=2,
+                                   block_size=block_size,
+                                   max_seq_len=max_seq_len,
+                                   temperature=0.0)
+    rid = eng.add_request(prompt, max_new_tokens=n)
+    return eng.run_to_completion()[rid]
+
+
+def _prompts(seed, sizes):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 255, (s,)).astype("int64") for s in sizes]
+
+
+# -- bucketing ----------------------------------------------------------
+
+
+def test_bucket_length_unit():
+    assert bucket_length(1, 8, 64) == 8
+    assert bucket_length(5, 8, 64) == 8
+    assert bucket_length(9, 8, 64) == 16
+    assert bucket_length(17, 8, 64) == 32
+    assert bucket_length(33, 8, 64) == 64
+    # beyond the cap: plain block-multiple padding
+    assert bucket_length(40, 8, 32) == 40
+    assert bucket_length(41, 8, 32) == 48
+    # cap 0 disables bucketing
+    assert bucket_length(9, 8, 0) == 16
+    assert bucket_length(11, 8, 0) == 16
+    # max_len clamps a bucket but never below the minimal pad
+    assert bucket_length(33, 8, 64, max_len=40) == 40
+    assert bucket_lengths(8, 32, 64) == [8, 16, 32, 40, 48, 56, 64]
+    with pytest.raises(ValueError):
+        bucket_length(0, 8, 64)
+
+
+# -- streaming + equivalence --------------------------------------------
+
+
+def test_streaming_order_and_equivalence(model):
+    prompts = _prompts(0, [5, 9, 12])
+    refs = [_ref_tokens(model, p, 8) for p in prompts]
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, background=False)
+    handles = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.drain()
+    for h, ref in zip(handles, refs):
+        assert h.status == RequestStatus.DONE
+        assert h.tokens() == ref
+        # the stream buffer replays the same tokens in order
+        assert list(h.stream(timeout=1)) == ref
+
+
+def test_streaming_callback(model):
+    (p,) = _prompts(1, [6])
+    ref = _ref_tokens(model, p, 6)
+    got = []
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, background=False)
+    h = eng.submit(p, max_new_tokens=6, on_token=got.append)
+    eng.drain()
+    assert got == ref == h.tokens()
+
+
+def test_background_thread_streams_live(model):
+    (p,) = _prompts(2, [7])
+    ref = _ref_tokens(model, p, 8)
+    with ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                       temperature=0.0) as eng:
+        h = eng.submit(p, max_new_tokens=8)
+        assert list(h.stream(timeout=120)) == ref
+        assert h.result(timeout=1) == ref
+        assert h.status == RequestStatus.DONE
+
+
+# -- cancellation / deadlines -------------------------------------------
+
+
+def test_cancel_frees_blocks(model):
+    (p,) = _prompts(3, [8])
+    before = metrics.snapshot("serving.")["serving.cancelled"]
+    eng = ServingEngine(model, max_batch=1, block_size=8, max_seq_len=64,
+                        temperature=0.0, background=False)
+    h = eng.submit(p, max_new_tokens=20)
+    eng.step()
+    eng.step()
+    h.cancel()
+    eng.step()  # cancellation lands at the step boundary
+    assert h.status == RequestStatus.CANCELLED
+    assert 1 <= len(h.tokens()) < 20
+    assert eng.cache.num_free_blocks() == eng.cache.num_blocks - 1
+    assert not eng.has_work
+    assert metrics.snapshot("serving.")["serving.cancelled"] == before + 1
+
+
+def test_deadline_expiry(model):
+    p1, p2 = _prompts(4, [6, 6])
+    before = metrics.snapshot("serving.")["serving.timeout"]
+    eng = ServingEngine(model, max_batch=1, block_size=8, max_seq_len=64,
+                        temperature=0.0, background=False)
+    # queued request with an already-expired deadline: TIMEOUT without
+    # ever touching the cache
+    hq = eng.submit(p1, max_new_tokens=8, deadline_s=0.0)
+    eng.step()
+    assert hq.status == RequestStatus.TIMEOUT
+    assert hq.tokens() == []
+    # running request: expires mid-decode, keeps partial tokens, frees
+    # blocks at the next step boundary
+    hr = eng.submit(p2, max_new_tokens=30, deadline_s=0.05)
+    eng.step()
+    time.sleep(0.08)
+    eng.step()
+    assert hr.status == RequestStatus.TIMEOUT
+    assert len(hr.tokens()) >= 1
+    assert eng.cache.num_free_blocks() == eng.cache.num_blocks - 1
+    after = metrics.snapshot("serving.")
+    assert after["serving.timeout"] == before + 2
+    assert metrics.snapshot("resilience.")[
+        "resilience.degrade.serving.deadline"] >= 2
+
+
+# -- preemption ---------------------------------------------------------
+
+
+def test_preempt_reprefill_identical_greedy(model):
+    """Pool exhaustion preempts (free + requeue + re-prefill) and the
+    preempted request still produces the exact uncontended greedy
+    tokens — the contract that replaced silent truncation."""
+    p1, p2 = _prompts(5, [8, 8])
+    r1 = _ref_tokens(model, p1, 12, block_size=4, max_seq_len=32)
+    r2 = _ref_tokens(model, p2, 12, block_size=4, max_seq_len=32)
+    before = metrics.snapshot("serving.")["serving.preempt"]
+    # 7 usable blocks; two requests peak at 5 blocks each -> exhaustion
+    eng = ServingEngine(model, max_batch=2, block_size=4, max_seq_len=32,
+                        num_blocks=8, temperature=0.0, background=False)
+    h1 = eng.submit(p1, max_new_tokens=12)
+    h2 = eng.submit(p2, max_new_tokens=12)
+    eng.drain()
+    assert metrics.snapshot("serving.")["serving.preempt"] > before
+    assert h1.status == h2.status == RequestStatus.DONE
+    assert h1.tokens() == r1
+    assert h2.tokens() == r2
+    assert eng.cache.num_free_blocks() == eng.cache.num_blocks - 1
+
+
+# -- admission policy ---------------------------------------------------
+
+
+def test_prefill_budget_limits_admissions(model):
+    p1, p2 = _prompts(6, [6, 6])
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, prefill_token_budget=8,
+                        background=False)
+    eng.submit(p1, max_new_tokens=4)
+    eng.submit(p2, max_new_tokens=4)
+    eng.step()
+    # 6 + 6 > 8: only the head was admitted this step
+    assert len(eng.scheduler.running) == 1
+    assert len(eng.scheduler.queue) == 1
+    eng.step()
+    assert len(eng.scheduler.running) == 2
+    eng.drain()
+
+
+def test_oversubscribed_fcfs_and_terminal_statuses(model):
+    """4x max_batch concurrent requests, mixed lengths + deadlines +
+    a cancellation: zero silent truncations — every request ends in a
+    terminal status, DONE outputs equal the uncontended reference, and
+    admission respects submission order (FCFS)."""
+    sizes = [5, 9, 12, 6, 14, 7, 10, 8]
+    prompts = _prompts(7, sizes)
+    refs = [_ref_tokens(model, p, 6) for p in prompts]
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, background=False)
+    handles = []
+    for i, p in enumerate(prompts):
+        # requests 2 and 5 carry an already-expired deadline
+        dl = 0.0 if i in (2, 5) else None
+        handles.append(eng.submit(p, max_new_tokens=6, deadline_s=dl))
+    handles[6].cancel()  # cancelled while still queued
+    eng.drain()
+    for i, h in enumerate(handles):
+        if i in (2, 5):
+            assert h.status == RequestStatus.TIMEOUT
+        elif i == 6:
+            assert h.status == RequestStatus.CANCELLED
+        else:
+            assert h.status == RequestStatus.DONE
+            assert h.tokens() == refs[i]
+    # FCFS: admit order == submit order among admitted requests
+    seqs = [h._req.admit_seq for i, h in enumerate(handles)
+            if i not in (2, 5, 6)]
+    assert seqs == sorted(seqs)
+    assert eng.cache.num_free_blocks() == eng.cache.num_blocks - 1
+
+
+def test_queue_bound_rejects(model):
+    p1, p2, p3 = _prompts(8, [5, 5, 5])
+    before = metrics.snapshot("serving.")["serving.rejected"]
+    eng = ServingEngine(model, max_batch=1, block_size=8, max_seq_len=64,
+                        temperature=0.0, max_queue=2, background=False)
+    eng.submit(p1, max_new_tokens=4)
+    eng.submit(p2, max_new_tokens=4)
+    with pytest.raises(QueueFullError):
+        eng.submit(p3, max_new_tokens=4)
+    assert metrics.snapshot("serving.")["serving.rejected"] == before + 1
+    eng.drain()
+
+
+def test_submit_validation(model):
+    eng = ServingEngine(model, max_batch=1, block_size=8, max_seq_len=32,
+                        temperature=0.0, background=False)
+    with pytest.raises(ValueError):
+        eng.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError):
+        eng.submit(np.arange(40), max_new_tokens=4)     # prompt too long
+    with pytest.raises(ValueError):
+        eng.submit(np.arange(30), max_new_tokens=8)     # total too long
+    with pytest.raises(ValueError):
+        eng.submit(np.arange(4), max_new_tokens=0)
+    assert not eng.has_work
+    # a request whose worst-case block demand can NEVER fit the pool is
+    # rejected at submit (it would otherwise hang admission forever)
+    small = ServingEngine(model, max_batch=2, block_size=4,
+                          max_seq_len=32, num_blocks=8,
+                          temperature=0.0, background=False)
+    with pytest.raises(ValueError):
+        small.submit(np.arange(25), max_new_tokens=6)  # needs 8 of 7
+    assert not small.has_work
+
+
+# -- thread safety ------------------------------------------------------
+
+
+def test_concurrent_submit_from_threads(model):
+    prompts = _prompts(9, [5, 8, 11, 6, 9, 7])
+    refs = [_ref_tokens(model, p, 6) for p in prompts]
+    with ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                       temperature=0.0) as eng:
+        handles = [None] * len(prompts)
+
+        def worker(i):
+            handles[i] = eng.submit(prompts[i], max_new_tokens=6)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for h, ref in zip(handles, refs):
+            assert h.result(timeout=180) == ref
+            assert h.status == RequestStatus.DONE
+
+
+def test_engine_death_fails_loud(model):
+    """If the driver dies, stream()/result()/submit() all raise the
+    cause — truncated output must never look complete."""
+    eng = ServingEngine(model, max_batch=1, block_size=8, max_seq_len=64,
+                        temperature=0.0)
+    orig = model.paged_decode_step
+    model.paged_decode_step = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("injected device failure"))
+    try:
+        h = eng.submit(np.arange(5), max_new_tokens=8)
+        with pytest.raises(RuntimeError, match="injected"):
+            list(h.stream(timeout=60))
+        assert h.status == RequestStatus.ERROR
+        with pytest.raises(RuntimeError, match="injected"):
+            h.result(timeout=1)
+        with pytest.raises(RuntimeError, match="died"):
+            eng.submit(np.arange(4), max_new_tokens=2)
+    finally:
+        model.paged_decode_step = orig
+
+
+# -- bucketing compile pin ----------------------------------------------
+
+
+def test_bucketing_holds_compile_count(model):
+    """After warming each bucket, serving NEW prompt lengths inside the
+    same buckets compiles nothing (the jit-cache-footprint pin for warm
+    serving, via the profiler.metrics jax.monitoring counter)."""
+    rng = np.random.default_rng(10)
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, bucket_cap=32, background=False)
+    for n in (5, 9, 17):  # buckets 8, 16, 32
+        eng.submit(rng.integers(0, 255, (n,)).astype("int64"),
+                   max_new_tokens=3)
+        eng.drain()
+    warm = metrics.snapshot()["xla.compile.count"]
+    for n in (3, 7, 10, 15, 20, 30):  # same buckets, new lengths
+        eng.submit(rng.integers(0, 255, (n,)).astype("int64"),
+                   max_new_tokens=3)
+    eng.drain()
+    assert metrics.snapshot()["xla.compile.count"] == warm
+
+
+# -- telemetry ----------------------------------------------------------
+
+
+def test_slo_metrics_and_summary_view(model):
+    (p,) = _prompts(11, [6])
+    before = metrics.snapshot("serving.")
+    eng = ServingEngine(model, max_batch=1, block_size=8, max_seq_len=64,
+                        temperature=0.0, background=False)
+    h = eng.submit(p, max_new_tokens=5)
+    eng.drain()
+    assert h.status == RequestStatus.DONE
+    after = metrics.snapshot("serving.")
+    assert after["serving.admitted"] == before["serving.admitted"] + 1
+    assert after["serving.completed"] == before["serving.completed"] + 1
+    d = after["serving.ttft_us"]["count"] - \
+        before["serving.ttft_us"]["count"]
+    assert d == 1
+    assert after["serving.itl_us"]["count"] >= \
+        before["serving.itl_us"]["count"] + 4
+    assert after["serving.step_us"]["count"] > \
+        before["serving.step_us"]["count"]
+    assert after["serving.kv.blocks_used"] == 0  # drained
+    # the serving family surfaces in profiler.summary()
+    prof = paddle.profiler.Profiler()
+    table = prof.summary()
+    assert "Serving / SLO View" in table
+    assert "serving.ttft_us" in table
+
+
+# -- generation satellites ----------------------------------------------
+
+
+def test_generate_no_cache_respects_eos(model):
+    """`_generate_no_cache` ignored eos_token_id entirely; now rows that
+    hit eos keep emitting eos, exactly like the cached path."""
+    prompt = np.random.default_rng(12).integers(0, 255, (1, 6)) \
+        .astype("int64")
+    free = model.generate(paddle.to_tensor(prompt), max_new_tokens=6,
+                          temperature=0.0, use_cache=False)
+    first = int(free.numpy()[0, prompt.shape[1]])
+    out = model.generate(paddle.to_tensor(prompt), max_new_tokens=6,
+                         temperature=0.0, use_cache=False,
+                         eos_token_id=first)
+    gen = out.numpy()[0, prompt.shape[1]:]
+    assert out.numpy().shape == (1, prompt.shape[1] + 6)
+    assert (gen == first).all()  # eos on step 1, eos-fill afterwards
+    # cached and uncached paths agree under the same eos
+    cached = model.generate(paddle.to_tensor(prompt), max_new_tokens=6,
+                            temperature=0.0, use_cache=True,
+                            eos_token_id=first)
+    assert (cached.numpy() == out.numpy()).all()
+
+
+def test_generate_no_cache_early_exits(model):
+    """Once every row is done the loop stops calling the model."""
+    calls = []
+
+    class Counting:
+        def __call__(self, ids, **kw):
+            calls.append(1)
+            return model(ids, **kw)
+
+    prompt = np.random.default_rng(13).integers(0, 255, (1, 5)) \
+        .astype("int64")
+    probe = Counting()(paddle.to_tensor(prompt))
+    first = int(np.asarray(probe.numpy())[0, -1].argmax())
+    calls.clear()
+    from paddle_tpu.models.generation import generate
+    out = generate(Counting(), paddle.to_tensor(prompt),
+                   max_new_tokens=8, temperature=0.0,
+                   eos_token_id=first)   # no init_cache -> no-cache path
+    assert len(calls) == 1               # early exit after the first eos
+    assert out.numpy().shape == (1, prompt.shape[1] + 8)
+
+
+def test_sample_token_topk_clamps_to_vocab():
+    """top_k >= vocab used to index out of bounds; now it equals plain
+    temperature sampling."""
+    import jax
+
+    from paddle_tpu.models.generation import sample_token
+    logits = np.random.default_rng(14).standard_normal((3, 16)) \
+        .astype("float32")
+    key = jax.random.PRNGKey(7)
+    plain = np.asarray(sample_token(logits, temperature=1.0, top_k=0,
+                                    key=key))
+    exact = np.asarray(sample_token(logits, temperature=1.0, top_k=16,
+                                    key=key))
+    over = np.asarray(sample_token(logits, temperature=1.0, top_k=100,
+                                   key=key))
+    assert (plain == exact).all()
+    assert (plain == over).all()
+    # clamping must not perturb genuine top-k masking
+    topk2 = np.asarray(sample_token(logits, temperature=1e-6, top_k=2,
+                                    key=key))
+    assert (topk2 == logits.argmax(-1)).all()

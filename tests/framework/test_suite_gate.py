@@ -44,9 +44,19 @@ def test_smoke_survives_truncation_on_broad_diffs():
 
 
 def test_unmapped_module_falls_back_to_framework_mirror():
-    t = suite_gate.targets_for(["paddle_tpu/inference/paged.py"])
-    # no explicit inference mapping: core smoke still runs
+    # audio has no explicit mapping: smoke still runs, nothing crashes
+    t = suite_gate.targets_for(["paddle_tpu/audio/functional.py"])
     assert "tests/test_tensor.py" in t
+
+
+def test_inference_and_serving_map_to_their_tests():
+    t = suite_gate.targets_for(["paddle_tpu/inference/paged.py"])
+    assert "tests/framework/test_paged_decode.py" in t
+    assert "tests/framework/test_serving.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/serving/scheduler.py"])
+    assert "tests/framework/test_serving.py" in t
+    t = suite_gate.targets_for(["tools/serving_gate.py"])
+    assert "tests/framework/test_serving.py" in t
 
 
 def test_conftest_change_triggers_smoke():

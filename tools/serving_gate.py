@@ -1,0 +1,178 @@
+"""Serving-layer gate: a fixed workload through `ServingEngine` with
+four pass/fail checks, in order of importance:
+
+  1. stability  — after warming every prefill bucket, the serve phase
+     triggers ZERO xla compiles (bucketing pin: a mid-serve recompile
+     is a multi-second latency spike for whoever drew that prompt
+     length);
+  2. preemption — pool exhaustion preempts + re-prefills, the preempted
+     request's greedy tokens are identical to an uncontended
+     `ContinuousBatchingEngine` run, and `serving.preempt` counted it;
+  3. latency    — warm TTFT and mean scheduler step overhead stay under
+     `SERVING_GATE_BUDGET_MS` (generous: catches a device sync or an
+     O(queue^2) scan in the step loop, not scheduler jitter);
+  4. reclamation — cancellation and deadline expiry return every KV
+     block to the pool.
+
+Budgets are env-overridable (SERVING_GATE_*). Exit 0 on pass, 1 on
+fail; one line per check. Runs under JAX_PLATFORMS=cpu (tier-1); wired
+into tools/suite_gate.py beside the chaos/passes/dispatch gates.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+BUDGET_MS = float(os.environ.get("SERVING_GATE_BUDGET_MS", "250"))
+
+
+def _model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def check_no_warm_recompiles(model):
+    import numpy as np
+
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(0)
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, bucket_cap=32, background=False)
+    for n in (5, 9, 17):  # warm buckets 8, 16, 32
+        eng.submit(rng.integers(0, 255, (n,)).astype("int64"),
+                   max_new_tokens=4)
+        eng.drain()
+    warm = metrics.snapshot()["xla.compile.count"]
+    t0 = time.perf_counter()
+    handles = [eng.submit(rng.integers(0, 255, (n,)).astype("int64"),
+                          max_new_tokens=6)
+               for n in (3, 7, 10, 14, 20, 25, 30, 12)]
+    eng.drain()
+    dt = time.perf_counter() - t0
+    compiles = metrics.snapshot()["xla.compile.count"] - warm
+    done = all(h.status == "DONE" for h in handles)
+    ok = compiles == 0 and done
+    print(f"[serving-gate] stability: {len(handles)} reqs in "
+          f"{dt * 1000:.0f}ms, warm compiles={compiles} (want 0), "
+          f"all DONE={done} {'PASS' if ok else 'FAIL'}")
+    return ok, eng
+
+
+def check_preemption(model):
+    import numpy as np
+
+    from paddle_tpu.inference.paged import ContinuousBatchingEngine
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(1)
+    p1 = rng.integers(0, 255, (8,)).astype("int64")
+    p2 = rng.integers(0, 255, (8,)).astype("int64")
+    refs = []
+    for p in (p1, p2):
+        ref_eng = ContinuousBatchingEngine(
+            model, max_batch=2, block_size=4, max_seq_len=32,
+            temperature=0.0)
+        rid = ref_eng.add_request(p, max_new_tokens=12)
+        refs.append(ref_eng.run_to_completion()[rid])
+    before = metrics.snapshot("serving.")["serving.preempt"]
+    eng = ServingEngine(model, max_batch=2, block_size=4, max_seq_len=32,
+                        num_blocks=8, temperature=0.0, background=False)
+    h1 = eng.submit(p1, max_new_tokens=12)
+    h2 = eng.submit(p2, max_new_tokens=12)
+    eng.drain()
+    preempts = metrics.snapshot("serving.")["serving.preempt"] - before
+    match = h1.tokens() == refs[0] and h2.tokens() == refs[1]
+    ok = preempts >= 1 and match and \
+        h1.status == h2.status == "DONE"
+    print(f"[serving-gate] preemption: preempts={preempts} (want >=1), "
+          f"outputs bit-identical={match} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_latency(model):
+    import numpy as np
+
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(2)
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, bucket_cap=32, background=False)
+    # warm the bucket + decode program
+    eng.submit(rng.integers(0, 255, (6,)).astype("int64"),
+               max_new_tokens=4)
+    eng.drain()
+    before = metrics.snapshot("serving.")
+    t0 = time.perf_counter()
+    h = eng.submit(rng.integers(0, 255, (6,)).astype("int64"),
+                   max_new_tokens=8)
+    eng.step()
+    ttft_ms = (time.perf_counter() - t0) * 1000.0
+    eng.drain()
+    after = metrics.snapshot("serving.")
+    steps = after["serving.step_us"]["count"] - \
+        before["serving.step_us"]["count"]
+    mean_ms = (after["serving.step_us"]["sum"]
+               - before["serving.step_us"]["sum"]) / max(steps, 1) / 1000.0
+    ok = ttft_ms < BUDGET_MS and mean_ms < BUDGET_MS and \
+        h.status == "DONE"
+    print(f"[serving-gate] latency: warm ttft={ttft_ms:.1f}ms "
+          f"mean step={mean_ms:.1f}ms over {steps} steps "
+          f"budget={BUDGET_MS}ms {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_reclamation(model):
+    import numpy as np
+
+    from paddle_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(3)
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, background=False)
+    h1 = eng.submit(rng.integers(0, 255, (8,)).astype("int64"),
+                    max_new_tokens=20)
+    h2 = eng.submit(rng.integers(0, 255, (8,)).astype("int64"),
+                    max_new_tokens=20, deadline_s=0.05)
+    eng.step()
+    h1.cancel()
+    time.sleep(0.06)
+    eng.drain()
+    usable = eng.cache.num_blocks - 1
+    free = eng.cache.num_free_blocks()
+    ok = free == usable and h1.status == "CANCELLED" and \
+        h2.status == "TIMEOUT"
+    print(f"[serving-gate] reclamation: free={free}/{usable} "
+          f"h1={h1.status} h2={h2.status} {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def main():
+    model = _model()
+    ok1, _ = check_no_warm_recompiles(model)
+    ok2 = check_preemption(model)
+    ok3 = check_latency(model)
+    ok4 = check_reclamation(model)
+    if ok1 and ok2 and ok3 and ok4:
+        print("[serving-gate] PASS")
+        return 0
+    print("[serving-gate] FAIL")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,7 +36,15 @@ _MAP = [
                          "tests/test_oracle_sweep_extras.py",
                          "tests/test_special_ops.py", "tests/test_ops.py",
                          "tests/ops"]),
-    ("paddle_tpu/core/resilience.py", ["tests/framework/test_chaos.py"]),
+    ("paddle_tpu/core/resilience.py", ["tests/framework/test_chaos.py",
+                                       "tests/framework/test_serving.py"]),
+    ("paddle_tpu/serving/", ["tests/framework/test_serving.py"]),
+    ("paddle_tpu/inference/", ["tests/framework/test_paged_decode.py",
+                               "tests/framework/test_serving.py"]),
+    ("paddle_tpu/models/generation.py",
+     ["tests/framework/test_serving.py",
+      "tests/framework/test_paged_decode.py",
+      "tests/framework/test_highlevel.py"]),
     ("paddle_tpu/testing/", ["tests/framework/test_chaos.py"]),
     ("paddle_tpu/core/", ["tests/core", "tests/test_autograd.py",
                           "tests/test_tensor.py", "tests/framework"]),
@@ -60,6 +68,7 @@ _MAP = [
      ["tests/framework/test_dispatch_fastpath.py"]),
     ("tools/chaos_gate.py", ["tests/framework/test_chaos.py",
                              "tests/distributed/test_checkpoint.py"]),
+    ("tools/serving_gate.py", ["tests/framework/test_serving.py"]),
     ("tools/", []),
 ]
 # smoke that always runs when any paddle_tpu source changed
