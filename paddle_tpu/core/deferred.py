@@ -43,6 +43,7 @@ from . import flags as flags_mod
 from . import resilience as _resilience
 from ..profiler import _recorder as _prof
 from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
 from ..testing import faults as _faults
 
 # dispatch/tensor bindings resolved once at first use (module-level
@@ -436,29 +437,35 @@ def flush(root):
                     if e is root or (e.owner is not None
                                      and e.owner() is not None))
     ladder = bool(flags_mod.flag("FLAGS_flush_degradation"))
-    if passes_enabled():
+    # a child span when a request trace is active (serving prefill /
+    # decode, an rpc handler) — the null path costs two no-op calls per
+    # flush otherwise. Ladder rungs run INSIDE it, so a degraded flush
+    # shows up as a long span with the degrade events stamped with the
+    # same trace_id (resilience.degrade reads the ambient context).
+    with _tracing.span("deferred.flush", cause=cause, nodes=len(nodes)):
+        if passes_enabled():
+            try:
+                return _flush_optimized(root, nodes, leaves, consts,
+                                        out_ixs, cause, t0)
+            except Exception as e:  # noqa: BLE001 — rung 1 catches
+                # anything the optimizer/compiler threw; sound-chain
+                # errors re-raise from the rungs below
+                if not ladder:
+                    raise
+                _resilience.degrade(
+                    "flush.retry_verbatim",
+                    detail=f"nodes={len(nodes)} cause={cause}", exc=e)
         try:
-            return _flush_optimized(root, nodes, leaves, consts,
-                                    out_ixs, cause, t0)
-        except Exception as e:  # noqa: BLE001 — rung 1 catches anything
-            # the optimizer/compiler threw; sound-chain errors re-raise
-            # from the rungs below
+            return _flush_verbatim(root, nodes, leaves, consts, out_ixs,
+                                   cause, t0)
+        except Exception as e:  # noqa: BLE001 — rung 2
             if not ladder:
                 raise
             _resilience.degrade(
-                "flush.retry_verbatim",
+                "flush.eager_replay",
                 detail=f"nodes={len(nodes)} cause={cause}", exc=e)
-    try:
-        return _flush_verbatim(root, nodes, leaves, consts, out_ixs,
-                               cause, t0)
-    except Exception as e:  # noqa: BLE001 — rung 2
-        if not ladder:
-            raise
-        _resilience.degrade(
-            "flush.eager_replay",
-            detail=f"nodes={len(nodes)} cause={cause}", exc=e)
-        return _flush_eager(root, nodes, leaves, consts, out_ixs,
-                            cause, t0)
+            return _flush_eager(root, nodes, leaves, consts, out_ixs,
+                                cause, t0)
 
 
 def _flush_verbatim(root, nodes, leaves, consts, out_ixs, cause, t0):

@@ -160,6 +160,18 @@ define_flag("FLAGS_serving_prefill_budget", 512,
             "max prompt tokens prefilled per scheduler step (iteration-"
             "level scheduling: bounds prefill work per step so long "
             "prompts cannot starve running decodes); 0 = unlimited")
+define_flag("FLAGS_trace_enable", True,
+            "request-scoped tracing (profiler/tracing.py): record "
+            "sampled spans (serving request lifecycle, deferred flush, "
+            "rpc/store/checkpoint) into the in-process ring; off = every "
+            "tracing entry point is a single global read")
+define_flag("FLAGS_trace_sample", 1.0,
+            "fraction of root traces sampled (decided once per trace at "
+            "start_trace); children of an unsampled root cost the same "
+            "as disabled tracing, so overhead scales with this rate")
+define_flag("FLAGS_trace_ring", 4096,
+            "span ring-buffer capacity (profiler/tracing.py): bounded "
+            "memory — old spans age out; resize drops buffered history")
 define_flag("FLAGS_serving_prefill_bucket_cap", 1024,
             "serving prefill padded lengths round up to power-of-two "
             "buckets capped here (bounds the warm jit-cache footprint to "

@@ -43,6 +43,7 @@ import time
 
 from . import flags as flags_mod
 from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
 
 __all__ = ["RetryPolicy", "Deadline", "policy", "retry", "retry_call",
            "attempts", "degrade"]
@@ -271,14 +272,20 @@ class Deadline:
 def degrade(domain, detail=None, exc=None):
     """A fallback path ran. Counts ``resilience.degrade.<domain>`` and
     appends a flight record so hang/crash post-mortems show which
-    degradations preceded the incident. Never raises: the degraded path
-    is already handling a failure and must not fail on telemetry."""
+    degradations preceded the incident; when a request trace is active
+    (profiler/tracing.py) the record carries its trace_id, so an
+    incident links back to the exact request that degraded. Never
+    raises: the degraded path is already handling a failure and must
+    not fail on telemetry."""
     _metrics.counter(f"resilience.degrade.{domain}").inc()
     meta = {}
     if detail:
         meta["detail"] = str(detail)
     if exc is not None:
         meta["error"] = f"{type(exc).__name__}: {exc}"
+    tid = _tracing.current_trace_id()
+    if tid is not None:
+        meta["trace"] = tid
     try:
         from ..distributed import watchdog
         watchdog.record_event(f"degrade/{domain}", meta or None,

@@ -59,6 +59,7 @@ from ..core import flags as flags_mod
 from ..core import resilience
 from ..core.tensor import Tensor
 from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
 from ..testing import faults
 
 __all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict",
@@ -317,8 +318,14 @@ def save_state_dict(state_dict, path, process_group=None,
         th.start()
         return handle
 
-    _write_commit(path, final_dir, host, shard_fn, arrays, meta, staging)
-    _retention_sweep(path, host)
+    # child span when a trace is active (a checkpoint inside a traced
+    # request/step); the async path runs on the writer thread, which
+    # has no ambient context — its lifecycle is visible through the
+    # checkpoint.* counters and degrade events instead
+    with _tracing.span("checkpoint.save", dir=final_dir):
+        _write_commit(path, final_dir, host, shard_fn, arrays, meta,
+                      staging)
+        _retention_sweep(path, host)
     return None
 
 
@@ -636,7 +643,10 @@ def load_state_dict(state_dict, path, process_group=None,
     last_err = None
     for cand in cands:
         try:
-            values = _assemble(flat, cand)
+            # one span per candidate attempt: a quarantined dir shows
+            # up as an "error" span preceding the successful load
+            with _tracing.span("checkpoint.load", dir=cand):
+                values = _assemble(flat, cand)
         except (CorruptCheckpointError, OSError) as e:
             # OSError: the candidate vanished mid-scan (concurrent
             # quarantine / retention) — fall back like any bad dir

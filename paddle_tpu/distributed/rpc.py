@@ -30,6 +30,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 
 from ..core import resilience
+from ..profiler import tracing
 from ..testing import faults
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
@@ -104,7 +105,16 @@ class _Agent:
                 return
             fn = req["fn"]
             try:
-                result = fn(*req.get("args", ()), **req.get("kwargs", {}))
+                # adopt the caller's trace context (if the frame carries
+                # one): spans recorded while executing the remote fn
+                # land in THIS host's ring under the caller's trace_id,
+                # so multi-host exports stitch into one trace
+                with tracing.attach(req.get("trace")), \
+                        tracing.span("rpc.serve",
+                                     fn=getattr(fn, "__name__",
+                                                str(fn))):
+                    result = fn(*req.get("args", ()),
+                                **req.get("kwargs", {}))
                 self._send_frame(conn, {"ok": True, "result": result})
             except BaseException as e:  # noqa: BLE001 — re-raised remotely
                 try:
@@ -132,8 +142,10 @@ class _Agent:
         not assumed idempotent)."""
         def dial():
             faults.site("rpc.connect")
-            return socket.create_connection((info.ip, info.port),
-                                            timeout=timeout or None)
+            with tracing.span("rpc.connect",
+                              peer=f"{info.ip}:{info.port}"):
+                return socket.create_connection(
+                    (info.ip, info.port), timeout=timeout or None)
         return resilience.retry_call(
             dial, policy=resilience.policy(
                 "rpc.connect", deadline=timeout or None,
@@ -145,12 +157,20 @@ class _Agent:
         if info is None:
             raise ValueError(f"unknown rpc worker {to!r}; known: "
                              f"{sorted(self.workers)}")
-        with self._open_channel(info, timeout) as sock:
-            if timeout and timeout > 0:
-                sock.settimeout(timeout)
-            self._send_frame(sock, {"fn": fn, "args": tuple(args or ()),
-                                    "kwargs": dict(kwargs or {})})
-            resp = self._recv_frame(sock)
+        with tracing.span("rpc.call", to=to,
+                          fn=getattr(fn, "__name__", str(fn))):
+            # context captured INSIDE the span so the remote rpc.serve
+            # span parents under rpc.call, not under the caller's span
+            ctx = tracing.current_context()
+            frame = {"fn": fn, "args": tuple(args or ()),
+                     "kwargs": dict(kwargs or {})}
+            if ctx is not None:
+                frame["trace"] = ctx
+            with self._open_channel(info, timeout) as sock:
+                if timeout and timeout > 0:
+                    sock.settimeout(timeout)
+                self._send_frame(sock, frame)
+                resp = self._recv_frame(sock)
         if resp["ok"]:
             return resp.get("result")
         raise resp["error"]

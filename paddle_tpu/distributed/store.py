@@ -13,6 +13,7 @@ import ctypes
 
 from ..core import resilience
 from ..csrc.build import load_library
+from ..profiler import tracing
 from ..testing import faults
 
 
@@ -73,12 +74,15 @@ class TCPStore:
 
         def _connect():
             faults.site("store.connect")
-            client = self._lib.pt_store_client_connect(
-                host.encode(), port, self._timeout_ms)
-            if not client:
-                raise ConnectionError(
-                    f"TCPStore: cannot connect {host}:{port}")
-            return client
+            # child span when a trace is active (an rpc rendezvous
+            # inside a traced request) — null path otherwise
+            with tracing.span("store.connect", peer=f"{host}:{port}"):
+                client = self._lib.pt_store_client_connect(
+                    host.encode(), port, self._timeout_ms)
+                if not client:
+                    raise ConnectionError(
+                        f"TCPStore: cannot connect {host}:{port}")
+                return client
 
         if is_master:
             self._client = _connect()
@@ -91,20 +95,24 @@ class TCPStore:
 
     def set(self, key, value):
         data = value if isinstance(value, bytes) else str(value).encode()
-        if self._lib.pt_store_set(self._client, key.encode(), data,
-                                  len(data)) != 0:
-            raise RuntimeError("TCPStore.set failed")
+        with tracing.span("store.set", key=key):
+            if self._lib.pt_store_set(self._client, key.encode(), data,
+                                      len(data)) != 0:
+                raise RuntimeError("TCPStore.set failed")
 
     def get(self, key):
         buf = ctypes.create_string_buffer(1 << 20)
-        n = self._lib.pt_store_get(self._client, key.encode(), buf,
-                                   len(buf))
+        with tracing.span("store.get", key=key):
+            n = self._lib.pt_store_get(self._client, key.encode(), buf,
+                                       len(buf))
         if n < 0:
             raise KeyError(key)
         return buf.raw[:n]
 
     def add(self, key, amount):
-        r = self._lib.pt_store_add(self._client, key.encode(), int(amount))
+        with tracing.span("store.add", key=key):
+            r = self._lib.pt_store_add(self._client, key.encode(),
+                                       int(amount))
         if r == -(2 ** 63):
             raise RuntimeError("TCPStore.add failed")
         return int(r)
@@ -114,8 +122,11 @@ class TCPStore:
             keys = [keys]
         ms = int((timeout or self._timeout_ms / 1000) * 1000)
         for k in keys:
-            if self._lib.pt_store_wait(self._client, k.encode(), ms) != 0:
-                raise TimeoutError(f"TCPStore.wait timeout on {k!r}")
+            with tracing.span("store.wait", key=k):
+                if self._lib.pt_store_wait(self._client, k.encode(),
+                                           ms) != 0:
+                    raise TimeoutError(
+                        f"TCPStore.wait timeout on {k!r}")
 
     def check(self, key):
         return bool(self._lib.pt_store_check(self._client, key.encode()))

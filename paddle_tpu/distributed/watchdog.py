@@ -39,9 +39,23 @@ __all__ = ["FlightRecorder", "CollectiveWatchdog", "get_watchdog",
            "watch_step", "flight_recorder", "record_event"]
 
 
+def _active_trace_id():
+    """The ambient request trace_id (profiler/tracing.py), or None.
+    Lazy-bound: the watchdog must import standalone (launcher helpers)
+    without dragging the profiler package in."""
+    try:
+        from ..profiler import tracing
+    except Exception:  # noqa: BLE001 — telemetry probe, never fatal
+        return None
+    return tracing.current_trace_id()
+
+
 class FlightRecorder:
     """Ring buffer of recent step records (the reference's store-based
-    flight recording, comm_task_manager.cc:142)."""
+    flight recording, comm_task_manager.cc:142). Records are stamped
+    with the active trace_id when one exists, so a timeout dump (or
+    the "Recent incidents" summary view) links each event back to the
+    request that was in flight."""
 
     def __init__(self, capacity=64):
         self._buf = deque(maxlen=capacity)
@@ -49,10 +63,13 @@ class FlightRecorder:
         self._seq = 0
 
     def start(self, tag, meta=None):
+        tid = _active_trace_id()
         with self._lock:
             self._seq += 1
             rec = {"seq": self._seq, "tag": tag, "start": time.time(),
                    "end": None, "status": "running", **(meta or {})}
+            if tid is not None and "trace" not in rec:
+                rec["trace"] = tid
             self._buf.append(rec)
             return rec
 

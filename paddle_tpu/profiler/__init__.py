@@ -20,7 +20,8 @@ from enum import Enum
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
-           "load_profiler_result", "SortedKeys", "SummaryView", "metrics"]
+           "load_profiler_result", "SortedKeys", "SummaryView", "metrics",
+           "tracing", "export"]
 
 
 class ProfilerState(Enum):
@@ -96,6 +97,11 @@ _recorder = _HostEventRecorder()
 # (paddle_tpu.profiler.metrics); importing it also installs the
 # jax.monitoring XLA-compile listener
 from . import metrics  # noqa: E402,F401
+
+# request-scoped tracing (span ring + contextvars TraceContext) and the
+# export surface (OpenMetrics text, /metrics HTTP endpoint); importing
+# tracing wires the histogram-exemplar probe into the registry
+from . import export, tracing  # noqa: E402,F401
 
 
 class RecordEvent:
@@ -187,6 +193,60 @@ def load_profiler_result(path):
         return t
     with open(path) as f:
         return json.load(f)
+
+
+def _slow_requests_view(serving_snap):
+    """"Slow requests" summary section: the per-bucket max-latency
+    exemplars of the serving SLO histograms (docs/OBSERVABILITY.md),
+    worst first — each row names the trace_id to pull from the ring
+    (``tracing.export_trace`` / the /traces/<id> endpoint). ``spans``
+    says how much of that trace is still exportable."""
+    rows = []
+    for name in ("serving.ttft_us", "serving.itl_us",
+                 "serving.queue_wait_us"):
+        v = serving_snap.get(name)
+        if isinstance(v, dict):
+            for ex in (v.get("exemplars") or {}).values():
+                rows.append((ex["value"], name, ex["trace_id"]))
+    if not rows:
+        return []
+    rows.sort(reverse=True)
+    lines = ["", "{:-^72}".format(" Slow requests (exemplars) "),
+             "{:<24} {:>14}  {:<18} {}".format(
+                 "metric", "latency_us", "trace_id", "spans")]
+    for value, name, tid in rows[:8]:
+        lines.append("{:<24} {:>14.1f}  {:<18} {}".format(
+            name, value, tid, len(tracing.get_trace(tid))))
+    return lines
+
+
+def _recent_incidents_view(limit=10):
+    """"Recent incidents" summary section: the watchdog flight-recorder
+    ring (degrade / preempt / retry / quarantine events recorded by
+    core.resilience and the collective watchdog) — recorded since PR 4
+    but never surfaced outside a timeout dump until now."""
+    try:
+        from ..distributed import watchdog
+    except Exception:  # noqa: BLE001 — summary must render regardless
+        return []
+    recs = [r for r in watchdog.flight_recorder().records()
+            if r.get("status") not in ("done", "running")]
+    if not recs:
+        return []
+    now = time.time()
+    lines = ["", "{:-^72}".format(" Recent incidents (flight ring) "),
+             "{:<5} {:>8} {:<28} {:<10} {}".format(
+                 "seq", "age_s", "event", "status", "detail")]
+    for r in recs[-limit:]:
+        meta = {k: v for k, v in r.items()
+                if k not in ("seq", "tag", "start", "end", "status")}
+        detail = meta.pop("detail", "")
+        if meta:
+            detail = (detail + " " + json.dumps(meta, default=str)).strip()
+        lines.append("{:<5} {:>8.1f} {:<28} {:<10} {}".format(
+            r["seq"], now - r["start"], r["tag"][:28], r["status"],
+            detail[:60]))
+    return lines
 
 
 class Profiler:
@@ -425,10 +485,15 @@ class Profiler:
                     if v["count"]:
                         desc += (f" avg={v['avg']:.6g}"
                                  f" min={v['min']:.6g}"
-                                 f" max={v['max']:.6g}")
+                                 f" max={v['max']:.6g}"
+                                 f" p50={v['p50']:.6g}"
+                                 f" p95={v['p95']:.6g}"
+                                 f" p99={v['p99']:.6g}")
                 else:
                     desc = str(v)
                 lines.append("{:<36} {}".format(name, desc))
+            lines.extend(_slow_requests_view(serving))
+        lines.extend(_recent_incidents_view())
         if self._memory_samples:
             # MemoryView (reference profiler_statistic.py memory table)
             lines.append("")

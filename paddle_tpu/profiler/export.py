@@ -1,0 +1,316 @@
+"""Telemetry export surface: OpenMetrics text, delta rates, /metrics.
+
+The registry (profiler/metrics.py) is in-process data; a fleet router
+or Prometheus scraper needs it on a wire. Three pieces:
+
+- ``render_prometheus()`` — the whole registry (or one prefix family)
+  as OpenMetrics/Prometheus text exposition: counters as ``_total``,
+  gauges plain, histograms as cumulative ``_bucket{le=...}`` series
+  with ``_sum``/``_count`` — and bucket **exemplars**
+  (``# {trace_id="..."} value ts``) linking SLO histograms to
+  exportable traces (profiler/tracing.py).
+- ``DeltaRates`` — successive snapshots diffed into per-second rates
+  (counters and histogram counts), what a watcher plots without
+  keeping its own state.
+- ``MetricsServer`` — a stdlib ``http.server`` endpoint:
+
+  =====================  ==============================================
+  ``/metrics``           OpenMetrics text (scrape me)
+  ``/metrics/delta``     JSON per-second rates since the last delta call
+  ``/healthz``           JSON liveness + the serving SLO gauges
+  ``/traces``            whole span ring, Chrome/Perfetto JSON
+  ``/traces/<trace_id>`` one trace, Chrome/Perfetto JSON (404 unknown)
+  =====================  ==============================================
+
+  ``ServingEngine.serve_metrics()`` attaches one to a live engine so
+  its ``/healthz`` reflects engine state (closed / died), which is
+  what a multi-replica router health-checks.
+
+``parse_prometheus()`` round-trips the exposition for gates and tests
+(tools/trace_gate.py scrapes, parses, and diffs against snapshot()).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+
+from . import metrics as _metrics
+from . import tracing as _tracing
+
+__all__ = ["render_prometheus", "parse_prometheus", "DeltaRates",
+           "MetricsServer"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _pname(name):
+    """Registry name -> Prometheus metric name (dots become
+    underscores; leading digits cannot occur in our registry)."""
+    return _NAME_RE.sub("_", name)
+
+
+def _fnum(v):
+    """Float formatting matching Prometheus conventions: integral
+    values render bare, +inf as ``+Inf``."""
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def render_prometheus(prefix=None):
+    """OpenMetrics text for every registered metric (optionally one
+    ``prefix`` family). Ends with ``# EOF`` per the spec."""
+    with _metrics.registry._lock:
+        items = sorted(_metrics.registry._metrics.items())
+    lines = []
+    for name, m in items:
+        if prefix is not None and not name.startswith(prefix):
+            continue
+        pn = _pname(name)
+        if isinstance(m, _metrics.Counter):
+            lines.append(f"# TYPE {pn} counter")
+            lines.append(f"{pn}_total {_fnum(m.value)}")
+        elif isinstance(m, _metrics.Gauge):
+            lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn} {_fnum(m.value)}")
+        elif isinstance(m, _metrics.Histogram):
+            snap = m._snap()
+            lines.append(f"# TYPE {pn} histogram")
+            cum = 0
+            bounds = [*m.bounds, float("inf")]
+            labels = [*map(str, m.bounds), "+inf"]
+            for b, label in zip(bounds, labels):
+                cum += snap["buckets"][label]
+                line = f'{pn}_bucket{{le="{_fnum(b)}"}} {cum}'
+                ex = snap["exemplars"].get(label)
+                if ex is not None:
+                    line += (f' # {{trace_id="{ex["trace_id"]}"}} '
+                             f'{_fnum(ex["value"])} {ex["ts"]:.3f}')
+                lines.append(line)
+            lines.append(f"{pn}_sum {_fnum(snap['sum'])}")
+            lines.append(f"{pn}_count {snap['count']}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?'
+    r'\s+(?P<value>[^ #]+)'
+    r'(?:\s*#\s*\{(?P<exlabels>[^}]*)\}\s*(?P<exvalue>\S+)'
+    r'(?:\s+(?P<exts>\S+))?)?\s*$')
+
+
+def _labels(s):
+    out = {}
+    for part in (s or "").split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k.strip()] = v.strip().strip('"')
+    return out
+
+
+def parse_prometheus(text):
+    """Parse an exposition back into plain data::
+
+        {metric_name: {"type": ..., "value": ...}}                  scalars
+        {metric_name: {"type": "histogram", "buckets": {le: cum},
+                       "sum": ..., "count": ...,
+                       "exemplars": {le: {"trace_id", "value"}}}}
+
+    Counter ``_total`` / histogram series suffixes fold back onto the
+    base name. Raises ValueError on a malformed sample line — this is
+    the round-trip check, so garbage must not parse silently."""
+    out = {}
+
+    def base(name, kind):
+        return out.setdefault(name, {"type": kind} if kind != "histogram"
+                              else {"type": kind, "buckets": {},
+                                    "sum": None, "count": None,
+                                    "exemplars": {}})
+
+    types = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        name, value = m.group("name"), float(m.group("value"))
+        for suffix, field in (("_bucket", "buckets"), ("_sum", "sum"),
+                              ("_count", "count")):
+            stem = name[:-len(suffix)] if name.endswith(suffix) else None
+            if stem and types.get(stem) == "histogram":
+                h = base(stem, "histogram")
+                if field == "buckets":
+                    le = _labels(m.group("labels")).get("le")
+                    h["buckets"][le] = value
+                    if m.group("exvalue") is not None:
+                        h["exemplars"][le] = {
+                            **_labels(m.group("exlabels")),
+                            "value": float(m.group("exvalue"))}
+                else:
+                    h[field] = value
+                break
+        else:
+            if name.endswith("_total") and \
+                    types.get(name[:-len("_total")]) == "counter":
+                base(name[:-len("_total")], "counter")["value"] = value
+            else:
+                base(name, types.get(name, "gauge"))["value"] = value
+    return out
+
+
+class DeltaRates:
+    """Per-second rates between successive ``rates()`` calls: counters
+    and histogram counts/sums diffed against the previous snapshot.
+    First call primes the baseline and returns {}."""
+
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+        self._prev = None
+        self._prev_t = None
+        self._lock = threading.Lock()
+
+    def _flatten(self, snap):
+        flat = {}
+        for name, v in snap.items():
+            if isinstance(v, dict):
+                flat[name + ".count"] = v["count"]
+                flat[name + ".sum"] = v["sum"]
+            else:
+                flat[name] = v
+        return flat
+
+    def rates(self):
+        now = time.monotonic()
+        cur = self._flatten(_metrics.snapshot(self.prefix))
+        with self._lock:
+            prev, prev_t = self._prev, self._prev_t
+            self._prev, self._prev_t = cur, now
+        if prev is None:
+            return {}
+        dt = max(now - prev_t, 1e-9)
+        return {name: (cur[name] - prev.get(name, 0)) / dt
+                for name in cur
+                if isinstance(cur[name], (int, float))}
+
+
+def _slo_health(extra=None):
+    """/healthz body: liveness + the serving SLO gauges a router
+    health-checks (queue depth, live slots, KV pressure) and the
+    terminal counters whose first derivative is the alert."""
+    snap = _metrics.snapshot("serving.")
+    body = {"status": "ok", "ts": time.time(),
+            "slo": {k: snap[k] for k in
+                    ("serving.queue.depth", "serving.slots.running",
+                     "serving.kv.utilization") if k in snap},
+            "counters": {k: snap[k] for k in
+                         ("serving.completed", "serving.timeout",
+                          "serving.rejected", "serving.preempt",
+                          "serving.errors") if k in snap}}
+    if extra:
+        try:
+            body.update(extra() or {})
+        except Exception as e:  # noqa: BLE001 — health must not 500
+            body["status"] = "error"
+            body["error"] = f"{type(e).__name__}: {e}"
+    return body
+
+
+class MetricsServer:
+    """Threaded stdlib HTTP endpoint over the registry + trace ring.
+    Binds at construction (``port=0`` picks a free port — read
+    ``.port``); ``close()`` stops it. ``health_extra`` is an optional
+    zero-arg callable merged into /healthz (ServingEngine passes its
+    engine-state view)."""
+
+    def __init__(self, port=0, host="127.0.0.1", health_extra=None):
+        import http.server
+
+        server = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence per-request stderr
+                pass
+
+            def _send(self, code, body, ctype):
+                data = body.encode() if isinstance(body, str) else body
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                    if path == "/metrics":
+                        self._send(
+                            200, render_prometheus(),
+                            "application/openmetrics-text; version=1.0.0;"
+                            " charset=utf-8")
+                    elif path == "/metrics/delta":
+                        self._send(200, json.dumps(server._delta.rates()),
+                                   "application/json")
+                    elif path == "/healthz":
+                        body = _slo_health(server._health_extra)
+                        code = 200 if body["status"] == "ok" else 503
+                        self._send(code, json.dumps(body),
+                                   "application/json")
+                    elif path == "/traces":
+                        self._send(200,
+                                   json.dumps(_tracing.export_ring()),
+                                   "application/json")
+                    elif path.startswith("/traces/"):
+                        tid = path[len("/traces/"):]
+                        trace = _tracing.export_trace(tid)
+                        if not trace["traceEvents"]:
+                            self._send(404, json.dumps(
+                                {"error": f"unknown trace {tid!r}"}),
+                                "application/json")
+                        else:
+                            self._send(200, json.dumps(trace),
+                                       "application/json")
+                    else:
+                        self._send(404, json.dumps(
+                            {"error": f"no route {path!r}"}),
+                            "application/json")
+                except BrokenPipeError:  # scraper went away mid-write
+                    pass
+
+        self._health_extra = health_extra
+        self._delta = DeltaRates()
+        self._httpd = http.server.ThreadingHTTPServer((host, port),
+                                                      _Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="paddle-tpu-metrics-http", daemon=True)
+        self._thread.start()
+
+    def url(self, path="/metrics"):
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -36,9 +36,28 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "snapshot", "dump", "reset", "registry"]
+
+
+# -- histogram exemplars ---------------------------------------------------
+# profiler.tracing installs the ambient-trace probe at import; until
+# then (or with tracing disabled) observations pay one call returning
+# None. Keeping the hook here (instead of importing tracing) avoids an
+# import cycle: tracing needs counters from this module.
+
+def _no_trace():
+    return None
+
+
+_trace_id_fn = _no_trace
+
+
+def _set_trace_id_source(fn):
+    global _trace_id_fn
+    _trace_id_fn = fn
 
 
 class Counter:
@@ -104,10 +123,17 @@ _DEFAULT_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 class Histogram:
     """Fixed-bucket distribution: bucket[i] counts observations
-    <= bounds[i]; one overflow bucket catches the rest."""
+    <= bounds[i]; one overflow bucket catches the rest.
+
+    Each bucket retains one **exemplar** — the max-value observation
+    seen while a trace was active, with its trace_id and wall time —
+    so an SLO histogram (``serving.ttft_us``) points at an exportable
+    trace for exactly the sample that defined its tail
+    (profiler/tracing.py; rendered as OpenMetrics exemplars by
+    profiler/export.py)."""
 
     __slots__ = ("name", "bounds", "_buckets", "_count", "_sum", "_min",
-                 "_max", "_lock")
+                 "_max", "_exemplars", "_lock")
 
     def __init__(self, name, bounds=_DEFAULT_BOUNDS):
         self.name = name
@@ -117,9 +143,11 @@ class Histogram:
         self._sum = 0.0
         self._min = None
         self._max = None
+        self._exemplars = [None] * (len(self.bounds) + 1)
         self._lock = threading.Lock()
 
     def observe(self, v):
+        tid = _trace_id_fn()
         with self._lock:
             i = 0
             for b in self.bounds:
@@ -133,6 +161,10 @@ class Histogram:
                 self._min = v
             if self._max is None or v > self._max:
                 self._max = v
+            if tid is not None:
+                ex = self._exemplars[i]
+                if ex is None or v >= ex[0]:
+                    self._exemplars[i] = (v, tid, time.time())
 
     @property
     def count(self):
@@ -142,14 +174,52 @@ class Histogram:
     def sum(self):
         return self._sum
 
+    def percentile(self, q):
+        """Estimate the q-quantile (0..1) from bucket counts: linear
+        interpolation inside the covering bucket, edge buckets clamped
+        to the observed min/max. Exact at the bucket bounds; off by at
+        most one bucket width inside — good enough to see a tail move
+        without hand math over the bucket table."""
+        with self._lock:
+            return self._pct_locked(q)
+
+    def _pct_locked(self, q):
+        if not self._count:
+            return None
+        target = q * self._count
+        cum = 0
+        for i, n in enumerate(self._buckets):
+            if not n:
+                continue
+            # interpolate inside THIS bucket's own bounds (clamped to
+            # the observed range) — the previous non-empty bucket's
+            # upper edge is not a valid floor across empty buckets
+            lo = self.bounds[i - 1] if i > 0 else self._min
+            hi = self.bounds[i] if i < len(self.bounds) else self._max
+            lo = min(max(lo, self._min), self._max)
+            hi = min(max(hi, lo), self._max)
+            if cum + n >= target:
+                frac = (target - cum) / n
+                return lo + (hi - lo) * frac
+            cum += n
+        return self._max
+
     def _snap(self):
         with self._lock:
+            labels = [*map(str, self.bounds), "+inf"]
+            exemplars = {
+                labels[i]: {"value": ex[0], "trace_id": ex[1],
+                            "ts": ex[2]}
+                for i, ex in enumerate(self._exemplars)
+                if ex is not None}
             return {"count": self._count, "sum": self._sum,
                     "min": self._min, "max": self._max,
                     "avg": (self._sum / self._count) if self._count else None,
-                    "buckets": dict(zip(
-                        [*map(str, self.bounds), "+inf"],
-                        list(self._buckets)))}
+                    "p50": self._pct_locked(0.50),
+                    "p95": self._pct_locked(0.95),
+                    "p99": self._pct_locked(0.99),
+                    "buckets": dict(zip(labels, list(self._buckets))),
+                    "exemplars": exemplars}
 
     def _reset(self):
         with self._lock:
@@ -158,6 +228,7 @@ class Histogram:
             self._sum = 0.0
             self._min = None
             self._max = None
+            self._exemplars = [None] * (len(self.bounds) + 1)
 
 
 class Registry:
@@ -167,6 +238,7 @@ class Registry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics = {}
+        self._dump_seq = 0
 
     def _get(self, name, cls, **kw):
         m = self._metrics.get(name)
@@ -201,7 +273,10 @@ class Registry:
 
     def dump(self, path=None, prefix=None):
         """Human-readable table; optionally also written to ``path`` as
-        JSON (the snapshot) for machine consumption."""
+        JSON for machine consumption. The JSON envelope carries a
+        wall-clock ``ts`` and a process-monotone ``seq`` so successive
+        dumps from a gate or watcher diff/order cleanly; the metric
+        map itself sits under ``"metrics"``."""
         snap = self.snapshot(prefix)
         lines = ["{:<48} {}".format("metric", "value")]
         for name in sorted(snap):
@@ -209,14 +284,20 @@ class Registry:
             if isinstance(v, dict):
                 desc = (f"count={v['count']} sum={v['sum']:.6g}"
                         + (f" avg={v['avg']:.6g} min={v['min']:.6g}"
-                           f" max={v['max']:.6g}" if v["count"] else ""))
+                           f" max={v['max']:.6g} p50={v['p50']:.6g}"
+                           f" p95={v['p95']:.6g} p99={v['p99']:.6g}"
+                           if v["count"] else ""))
             else:
                 desc = str(v)
             lines.append("{:<48} {}".format(name, desc))
         text = "\n".join(lines)
         if path is not None:
+            with self._lock:
+                self._dump_seq += 1
+                seq = self._dump_seq
             with open(path, "w") as f:
-                json.dump(snap, f, indent=1, sort_keys=True)
+                json.dump({"ts": time.time(), "seq": seq,
+                           "metrics": snap}, f, indent=1, sort_keys=True)
         return text
 
     def reset(self):

@@ -60,6 +60,14 @@ class RequestHandle:
     def preempts(self):
         return self._req.preempts
 
+    @property
+    def trace_id(self):
+        """This request's trace id (None when tracing is disabled or
+        the trace was not sampled) — resolve it against the span ring
+        (`profiler.tracing.export_trace`) or the `/traces/<id>`
+        endpoint once the request is terminal."""
+        return self._req.trace_id
+
     def tokens(self):
         """Tokens generated so far (stable snapshot)."""
         with self._engine._lock:
@@ -119,6 +127,7 @@ class ServingEngine:
         self._thread = None
         self._closed = False
         self._error = None
+        self._metrics_server = None
 
     # -- submission ----------------------------------------------------
 
@@ -214,6 +223,38 @@ class ServingEngine:
                 self._sched.fail_all(e)
             resilience.degrade("serving.engine", exc=e)
 
+    # -- telemetry export ----------------------------------------------
+
+    def serve_metrics(self, port=0, host="127.0.0.1"):
+        """Attach a scrapeable telemetry endpoint to this engine
+        (idempotent; closed with the engine). Routes: ``/metrics``
+        (OpenMetrics text), ``/metrics/delta`` (per-second rates),
+        ``/healthz`` (SLO gauges + engine liveness — 503 once the
+        driver died or the engine closed), ``/traces`` and
+        ``/traces/<id>`` (Chrome/Perfetto span exports). ``port=0``
+        picks a free port; read ``.port`` on the returned server."""
+        with self._lock:
+            if self._metrics_server is None:
+                from ..profiler.export import MetricsServer
+                self._metrics_server = MetricsServer(
+                    port=port, host=host, health_extra=self._health_view)
+            return self._metrics_server
+
+    def _health_view(self):
+        with self._lock:
+            alive = self._error is None and not self._closed
+            view = {"engine": {
+                "closed": self._closed,
+                "queue": len(self._sched.queue),
+                "running": len(self._sched.running)}}
+            if self._error is not None:
+                view["engine"]["error"] = \
+                    f"{type(self._error).__name__}: {self._error}"
+        if not alive:
+            view["status"] = "draining" if self._error is None \
+                else "dead"
+        return view
+
     # -- lifecycle -----------------------------------------------------
 
     def close(self, cancel_pending=True, timeout=60):
@@ -236,6 +277,9 @@ class ServingEngine:
             if self._error is None:
                 while self._sched.has_work:
                     self._sched.step()
+            server, self._metrics_server = self._metrics_server, None
+        if server is not None:
+            server.close()
 
     def __enter__(self):
         return self

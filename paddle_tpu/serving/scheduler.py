@@ -37,6 +37,7 @@ from ..core import flags as flags_mod
 from ..core import resilience
 from ..inference.paged import PagedKVCache, validate_request
 from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
 from .bucketing import bucket_length
 
 __all__ = ["RequestStatus", "ServingRequest", "Scheduler",
@@ -67,7 +68,8 @@ class ServingRequest:
     __slots__ = ("rid", "prompt", "max_new_tokens", "deadline",
                  "on_token", "on_finish", "status", "generated", "slot",
                  "preempts", "admit_seq", "submitted_at", "admitted_at",
-                 "first_token_at", "last_token_at", "cancel_requested")
+                 "first_token_at", "last_token_at", "cancel_requested",
+                 "span")
 
     def __init__(self, rid, prompt, max_new_tokens, deadline=None,
                  on_token=None, on_finish=None):
@@ -87,6 +89,13 @@ class ServingRequest:
         self.first_token_at = None
         self.last_token_at = None
         self.cancel_requested = False
+        # root span of this request's trace: opened at submit, ended at
+        # the terminal status; the null span when unsampled/disabled
+        self.span = _tracing.NULL
+
+    @property
+    def trace_id(self):
+        return self.span.trace_id
 
     @property
     def done(self):
@@ -177,6 +186,9 @@ class Scheduler:
                              deadline=deadline, on_token=on_token,
                              on_finish=on_finish)
         self._next_rid += 1
+        req.span = _tracing.start_trace(
+            "serving.request", rid=req.rid, prompt_len=len(prompt),
+            max_new_tokens=int(max_new_tokens))
         self.queue.append(req)
         _g_queue.set(len(self.queue))
         return req
@@ -230,9 +242,10 @@ class Scheduler:
                 self._expire(req)
 
     def _expire(self, req):
-        resilience.degrade("serving.deadline",
-                           detail=f"rid={req.rid} "
-                                  f"tokens={len(req.generated)}")
+        with _tracing.attach(req.span):  # flight record gets trace_id
+            resilience.degrade("serving.deadline",
+                               detail=f"rid={req.rid} "
+                                      f"tokens={len(req.generated)}")
         self._finish(req, RequestStatus.TIMEOUT)
 
     def _prefill_ids(self, req):
@@ -274,15 +287,22 @@ class Scheduler:
             now = time.monotonic()
             if req.admitted_at is None:
                 req.admitted_at = now
-                _h_queue_wait.observe((now - req.submitted_at) * 1e6)
+                wait_us = (now - req.submitted_at) * 1e6
+                with _tracing.attach(req.span):  # exemplar -> trace_id
+                    _h_queue_wait.observe(wait_us)
+                _tracing.record_span("serving.queue_wait", req.span,
+                                     wait_us)
             self.running[slot] = req
             _m_admitted.inc()
             pad_to = bucket_length(ids_len, self.cache.block_size,
                                    self.bucket_cap,
                                    max_len=self.max_seq_len)
-            tok = int(self.model.paged_prefill(
-                self.cache, slot, self._prefill_ids(req),
-                temperature=self.temperature, pad_to=pad_to))
+            with _tracing.span("serving.prefill", parent=req.span,
+                               tokens=ids_len, pad_to=pad_to,
+                               reprefill=bool(req.generated)):
+                tok = int(self.model.paged_prefill(
+                    self.cache, slot, self._prefill_ids(req),
+                    temperature=self.temperature, pad_to=pad_to))
             self._last_tok[slot] = tok
             self._remaining[slot] = \
                 req.max_new_tokens - len(req.generated) - 1
@@ -328,14 +348,21 @@ class Scheduler:
         active = np.zeros((self.cache.max_batch,), bool)
         for slot in self.running:
             active[slot] = True
+        t_dec = time.perf_counter_ns()
         toks = np.asarray(self.model.paged_decode_step(
             self.cache, np.asarray(self._last_tok), active,
             temperature=self.temperature))
+        dec_us = (time.perf_counter_ns() - t_dec) / 1000.0
         out = []
         for slot, req in list(self.running.items()):
             t = int(toks[slot])
             self._last_tok[slot] = t
             self._remaining[slot] -= 1
+            # the decode dispatch is one batched program: each live
+            # request's trace gets a slice of that step's wall time
+            _tracing.record_span("serving.decode_step", req.span,
+                                 dec_us, token=len(req.generated),
+                                 batch=len(self.running))
             self._emit(req, t)
             out.append((req.rid, t))
             self._maybe_finish(slot)
@@ -353,18 +380,26 @@ class Scheduler:
         req.preempts += 1
         self.queue.insert(0, req)
         _m_preempt.inc()
-        resilience.degrade("serving.preempt",
-                           detail=f"rid={req.rid} "
-                                  f"len={len(req.prompt) + len(req.generated)}")
+        _tracing.record_span("serving.preempt", req.span, 0.0,
+                             generated=len(req.generated),
+                             preempts=req.preempts)
+        with _tracing.attach(req.span):  # flight record gets trace_id
+            resilience.degrade(
+                "serving.preempt",
+                detail=f"rid={req.rid} "
+                       f"len={len(req.prompt) + len(req.generated)}")
 
     def _emit(self, req, tok):
         req.generated.append(tok)
         now = time.monotonic()
-        if req.first_token_at is None:
-            req.first_token_at = now
-            _h_ttft.observe((now - req.submitted_at) * 1e6)
-        else:
-            _h_itl.observe((now - req.last_token_at) * 1e6)
+        # SLO observations run under the request's trace context so the
+        # histogram exemplar retained for the bucket names THIS trace
+        with _tracing.attach(req.span):
+            if req.first_token_at is None:
+                req.first_token_at = now
+                _h_ttft.observe((now - req.submitted_at) * 1e6)
+            else:
+                _h_itl.observe((now - req.last_token_at) * 1e6)
         req.last_token_at = now
         if req.on_token is not None:
             try:
@@ -387,6 +422,12 @@ class Scheduler:
             self.running.pop(req.slot, None)
             req.slot = -1
         req.status = status
+        _tracing.record_span("serving.terminal", req.span, 0.0,
+                             terminal=status,
+                             tokens=len(req.generated))
+        req.span.annotate(terminal=status, tokens=len(req.generated),
+                          preempts=req.preempts)
+        req.span.end(status)
         self.finished[req.rid] = req
         {RequestStatus.DONE: _m_done,
          RequestStatus.CANCELLED: _m_cancelled,

@@ -59,6 +59,13 @@ def test_inference_and_serving_map_to_their_tests():
     assert "tests/framework/test_serving.py" in t
 
 
+def test_profiler_and_trace_gate_map_to_tracing_tests():
+    t = suite_gate.targets_for(["paddle_tpu/profiler/tracing.py"])
+    assert "tests/framework/test_tracing.py" in t
+    t = suite_gate.targets_for(["tools/trace_gate.py"])
+    assert "tests/framework/test_tracing.py" in t
+
+
 def test_conftest_change_triggers_smoke():
     t = suite_gate.targets_for(["tests/conftest.py"])
     assert "tests/test_tensor.py" in t

@@ -58,7 +58,8 @@ _MAP = [
     ("paddle_tpu/vision/", ["tests/vision"]),
     ("paddle_tpu/amp/", ["tests/amp", "tests/test_amp.py"]),
     ("paddle_tpu/profiler/", ["tests/framework/test_profiler_protobuf.py",
-                              "tests/framework/test_telemetry.py"]),
+                              "tests/framework/test_telemetry.py",
+                              "tests/framework/test_tracing.py"]),
     ("paddle_tpu/jit/", ["tests/jit"]),
     ("bench.py", []),   # bench has no pytest surface; exercised by driver
     ("tools/metrics_gate.py", ["tests/framework/test_metrics_gate.py"]),
@@ -69,6 +70,7 @@ _MAP = [
     ("tools/chaos_gate.py", ["tests/framework/test_chaos.py",
                              "tests/distributed/test_checkpoint.py"]),
     ("tools/serving_gate.py", ["tests/framework/test_serving.py"]),
+    ("tools/trace_gate.py", ["tests/framework/test_tracing.py"]),
     ("tools/", []),
 ]
 # smoke that always runs when any paddle_tpu source changed
