@@ -172,6 +172,14 @@ define_flag("FLAGS_trace_sample", 1.0,
 define_flag("FLAGS_trace_ring", 4096,
             "span ring-buffer capacity (profiler/tracing.py): bounded "
             "memory — old spans age out; resize drops buffered history")
+define_flag("FLAGS_serving_prefix_cache", True,
+            "content-addressed prefix caching in the serving paged KV "
+            "pool (inference/paged.py): block-aligned prompt chunks are "
+            "rolling-hashed, shared read-only across requests with "
+            "refcounts + copy-on-write, reclaimed LRU on demand; the "
+            "scheduler admits cache-hitting requests at the cost of "
+            "their UNCOVERED tokens only; 0 reverts to private-blocks "
+            "behavior (read at Scheduler construction)")
 define_flag("FLAGS_serving_prefill_bucket_cap", 1024,
             "serving prefill padded lengths round up to power-of-two "
             "buckets capped here (bounds the warm jit-cache footprint to "

@@ -27,7 +27,9 @@ never recompiles.
 
 from __future__ import annotations
 
+import hashlib
 import math
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 import jax
@@ -41,10 +43,111 @@ from ..profiler import metrics as _metrics
 # re-prefill) — shared name with the serving layer's scheduler so both
 # engines report under one metric
 _PREEMPTS = _metrics.counter("serving.preempt")
+# prefix-cache economics (docs/SERVING.md "Prefix caching"): blocks
+# mapped from cache vs computed fresh at admission, copy-on-write
+# copies, and LRU evictions of cold cached blocks
+_PREFIX_HITS = _metrics.counter("serving.prefix.hit_blocks")
+_PREFIX_MISSES = _metrics.counter("serving.prefix.miss_blocks")
+_PREFIX_COW = _metrics.counter("serving.prefix.cow_copies")
+_PREFIX_EVICT = _metrics.counter("serving.prefix.evictions")
 
-__all__ = ["PagedKVCache", "paged_prefill_write", "paged_decode_attention",
-           "paged_decode_attention_dense", "ContinuousBatchingEngine",
-           "validate_request"]
+__all__ = ["PagedKVCache", "paged_prefill_write",
+           "paged_prefill_write_masked", "paged_decode_attention",
+           "paged_decode_attention_dense", "paged_prefix_attention_dense",
+           "ContinuousBatchingEngine", "validate_request",
+           "chunk_digests", "PrefixPlan", "CapacityError"]
+
+
+# ---------------------------------------------------------------------------
+# content addressing (prefix cache)
+# ---------------------------------------------------------------------------
+
+def chunk_digests(token_ids, block_size):
+    """Rolling content hashes of the FULL block-aligned chunks of
+    ``token_ids`` (canonicalized to int64; padding must never reach
+    here — hash real tokens only, see serving/bucketing.py). Each digest
+    folds in its parent's digest, so a chunk digest identifies the
+    entire prefix up to and including that chunk — two prompts share a
+    digest iff they share every token before it."""
+    ids = np.ascontiguousarray(np.asarray(token_ids).reshape(-1),
+                               dtype=np.int64)
+    out, parent = [], b""
+    for c in range(ids.size // block_size):
+        parent = hashlib.blake2b(
+            parent + ids[c * block_size:(c + 1) * block_size].tobytes(),
+            digest_size=16).digest()
+        out.append(parent)
+    return out
+
+
+def _partial_key(parent_digest, token_ids):
+    """Content key for a partially-filled tail block: the full-chunk
+    parent chain plus the partial tokens themselves."""
+    ids = np.ascontiguousarray(np.asarray(token_ids).reshape(-1),
+                               dtype=np.int64)
+    return hashlib.blake2b(parent_digest + b"|part|" + ids.tobytes(),
+                           digest_size=16).digest()
+
+
+class CapacityError:
+    """Falsy result of a failed ``ensure_capacity``/``prepare_append``:
+    tells the caller WHY growth was denied so "evict cold prefixes /
+    preempt and retry" (``blocks``) is distinguishable from "this
+    sequence can never fit" (``seq_limit``). Previously both collapsed
+    into a bare ``False`` and straight into preemption."""
+
+    __slots__ = ("reason", "detail")
+
+    BLOCKS = "blocks"          # pool exhausted — reclaimable later
+    SEQ_LIMIT = "seq_limit"    # max_blocks_per_seq — never fits
+
+    def __init__(self, reason, detail=""):
+        self.reason = reason
+        self.detail = detail
+
+    def __bool__(self):
+        return False
+
+    def __repr__(self):
+        return f"CapacityError({self.reason!r}, {self.detail!r})"
+
+
+@dataclass
+class PrefixPlan:
+    """Host-side admission plan from ``PagedKVCache.plan_prefix``: which
+    leading chunks of a prompt are already resident (and where), and how
+    much of the prompt is therefore covered. Pure data — computing a
+    plan has no side effects; ``alloc_slot_cached`` consumes it."""
+
+    ids: np.ndarray            # the (unpadded) token ids planned against
+    num_tokens: int
+    chunks_total: int          # ceil(num_tokens / block_size), >= 1
+    digests: list              # rolling digests of the full chunks
+    matched_full: int          # leading full chunks found in the index
+    matched_blocks: list       # their pool block ids, in chunk order
+    partial_block: int | None  # matched partially-filled tail block
+    partial_len: int           # tokens matched inside it
+    partial_shared: bool       # True: mapped read-only (no writes land
+    #                            in it); False: copy-on-write at admit
+    covered_tokens: int        # matched_full*block_size + partial_len
+
+    @property
+    def tail_start(self):
+        """First token position the prefill must COMPUTE. Full coverage
+        still recomputes the last token — its logits seed decoding."""
+        return self.covered_tokens if self.covered_tokens \
+            < self.num_tokens else self.num_tokens - 1
+
+    @property
+    def write_start(self):
+        """First token position the prefill may WRITE (never a shared
+        row; full coverage writes nothing)."""
+        return self.covered_tokens
+
+    @property
+    def hit_blocks(self):
+        return self.matched_full + (1 if self.partial_block is not None
+                                    else 0)
 
 
 class PagedKVCache:
@@ -52,7 +155,21 @@ class PagedKVCache:
 
     Device state (jit-carried): k_pools/v_pools (list per layer),
     block_tables [max_batch, max_blocks_per_seq] int32, seq_lens
-    [max_batch] int32. Host state: free-list of block ids.
+    [max_batch] int32. Host state: free-list of block ids, per-block
+    refcounts, and the content-addressed prefix index.
+
+    **Prefix sharing** (vLLM shared-block / SGLang RadixAttention
+    style): a block registered in the prefix index is immutable in its
+    registered rows and may back several slots at once (refcount > 1).
+    Appends past every sharer's seq_len are safe in place at refcount 1;
+    any write to a block with refcount > 1 copies it first
+    (``prepare_append`` / admission COW). ``free_slot`` only decrements
+    refcounts: registered blocks that reach zero park in an LRU of
+    reclaimable blocks instead of the free list, so a later identical
+    prefix still hits; allocation falls back to evicting that LRU
+    before it ever fails. Nothing here reads flags — an engine that
+    never registers chunks (``commit_prefix``) gets byte-for-byte the
+    pre-prefix-cache behavior.
     """
 
     def __init__(self, num_layers, num_kv_heads, head_dim, *, num_blocks,
@@ -81,6 +198,12 @@ class PagedKVCache:
         self.seq_lens = np.zeros((max_batch,), np.int32)
         self._slot_blocks = [[] for _ in range(max_batch)]
         self._live = [False] * max_batch
+        # prefix-cache state (inert until commit_prefix registers chunks)
+        self._refcount = np.zeros((num_blocks,), np.int32)
+        self._prefix_index = {}    # full-chunk digest -> block id
+        self._partial_index = {}   # partial-tail key  -> block id
+        self._block_keys = {}      # block id -> [(kind, key), ...]
+        self._cached_free = OrderedDict()  # refcount-0 registered, LRU
 
     # -- host-side management ---------------------------------------------
 
@@ -92,18 +215,84 @@ class PagedKVCache:
         return [i for i, l in enumerate(self._live) if not l]
 
     def num_free_blocks(self):
-        return len(self._free)
+        """Blocks allocatable RIGHT NOW: truly free plus reclaimable
+        cached (refcount-0 registered blocks the LRU can evict)."""
+        return len(self._free) + len(self._cached_free)
+
+    def num_cached_blocks(self):
+        """Reclaimable refcount-0 blocks held only by the prefix index."""
+        return len(self._cached_free)
+
+    def num_shared_blocks(self):
+        """Blocks currently backing more than one slot."""
+        return int((self._refcount > 1).sum())
+
+    def reclaimable_blocks(self, slot):
+        """How many of the slot's blocks freeing it would actually
+        return to the pool (refcount 1 — not shared with anyone)."""
+        return sum(1 for b in self._slot_blocks[slot]
+                   if self._refcount[b] == 1)
+
+    # -- block primitives --------------------------------------------------
+
+    def _take_block(self):
+        """Allocate one block (refcount 1): the free list first, then
+        LRU eviction of a cold cached block (its index entries drop —
+        this is the "evict cold prefixes before preempting anyone"
+        rung). None when both are empty."""
+        if self._free:
+            b = self._free.pop()
+        elif self._cached_free:
+            b, _ = self._cached_free.popitem(last=False)
+            for kind, key in self._block_keys.pop(b, ()):
+                idx = self._prefix_index if kind == "full" \
+                    else self._partial_index
+                if idx.get(key) == b:
+                    del idx[key]
+            _PREFIX_EVICT.inc()
+        else:
+            return None
+        self._refcount[b] = 1
+        return b
+
+    def _release_block(self, b):
+        """A block's refcount reached zero: park it reclaimable-cached
+        if the prefix index still wants it, else truly free it."""
+        if self._block_keys.get(b):
+            self._cached_free[b] = None  # most-recently-used end
+        else:
+            self._free.append(b)
+
+    def _ref_block(self, b):
+        self._refcount[b] += 1
+        if b in self._cached_free:
+            del self._cached_free[b]
+
+    def _deref_block(self, b):
+        self._refcount[b] -= 1
+        if self._refcount[b] <= 0:
+            self._refcount[b] = 0
+            self._release_block(b)
+
+    def _copy_block_rows(self, src, dst):
+        """Copy-on-write body: duplicate one pool block across every
+        layer (the K and V rows move together)."""
+        for i in range(self.num_layers):
+            self.k_pools[i] = self.k_pools[i].at[dst].set(
+                self.k_pools[i][src])
+            self.v_pools[i] = self.v_pools[i].at[dst].set(
+                self.v_pools[i][src])
 
     def alloc_slot(self, num_tokens):
         """Claim a slot + enough blocks for `num_tokens`; returns slot id
         or None if out of slots/blocks."""
         need = max(1, math.ceil(num_tokens / self.block_size))
         free = self.free_slots()
-        if not free or need > len(self._free) or \
+        if not free or need > self.num_free_blocks() or \
                 need > self.max_blocks_per_seq:
             return None
         slot = free[0]
-        blocks = [self._free.pop() for _ in range(need)]
+        blocks = [self._take_block() for _ in range(need)]
         self._slot_blocks[slot] = blocks
         self._live[slot] = True
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
@@ -113,25 +302,184 @@ class PagedKVCache:
         return slot
 
     def ensure_capacity(self, slot, new_len):
-        """Grow the slot's table if `new_len` tokens need another block.
-        Returns False if the pool is exhausted."""
+        """Grow the slot's table if `new_len` tokens need another block
+        (evicting cold cached blocks if the free list is dry). Returns
+        True, or a falsy :class:`CapacityError` naming WHY growth was
+        denied — ``blocks`` (pool exhausted; eviction/preemption can
+        help) vs ``seq_limit`` (``max_blocks_per_seq``; this sequence
+        can never fit, retrying is pointless)."""
         have = len(self._slot_blocks[slot])
         need = math.ceil(new_len / self.block_size)
         while have < need:
-            if not self._free or have >= self.max_blocks_per_seq:
-                return False
-            b = self._free.pop()
+            if have >= self.max_blocks_per_seq:
+                return CapacityError(
+                    CapacityError.SEQ_LIMIT,
+                    f"{new_len} tokens need {need} blocks > "
+                    f"max_blocks_per_seq {self.max_blocks_per_seq}")
+            b = self._take_block()
+            if b is None:
+                return CapacityError(
+                    CapacityError.BLOCKS,
+                    f"pool exhausted growing slot {slot} to {new_len} "
+                    f"tokens")
             self.block_tables[slot, have] = b
             self._slot_blocks[slot].append(b)
             have += 1
         return True
 
+    def prepare_append(self, slot, new_len):
+        """Make position ``new_len - 1`` writable for this slot: grow
+        the table if the position opens a new block, and copy-on-write
+        the target block if it is shared (a decode append into a
+        partially-filled shared block must never be visible to the
+        other sharers). Returns True or a falsy :class:`CapacityError`
+        (same contract as ``ensure_capacity``)."""
+        r = self.ensure_capacity(slot, new_len)
+        if not r:
+            return r
+        ci = (new_len - 1) // self.block_size
+        b = self._slot_blocks[slot][ci]
+        if self._refcount[b] > 1:
+            nb = self._take_block()
+            if nb is None:
+                return CapacityError(
+                    CapacityError.BLOCKS,
+                    f"pool exhausted copy-on-writing shared block {b}")
+            self._copy_block_rows(b, nb)
+            self._slot_blocks[slot][ci] = nb
+            self.block_tables[slot, ci] = nb
+            self._deref_block(b)
+            _PREFIX_COW.inc()
+        return True
+
     def free_slot(self, slot):
-        self._free.extend(reversed(self._slot_blocks[slot]))
+        for b in reversed(self._slot_blocks[slot]):
+            self._deref_block(b)
         self._slot_blocks[slot] = []
         self._live[slot] = False
         self.block_tables[slot] = 0
         self.seq_lens[slot] = 0
+
+    # -- prefix cache ------------------------------------------------------
+
+    def plan_prefix(self, token_ids):
+        """Match a prompt against the prefix index (pure — no side
+        effects): longest run of leading full chunks whose rolling
+        digests are resident, optionally extended by a partially-filled
+        tail block whose registered tokens prefix-match the remainder.
+        The partial block is mapped read-only when it exactly completes
+        the prompt (``partial_shared``), else it must be copied at
+        admission (writes would land mid-block — the "divergence /
+        extension inside a shared block" COW case)."""
+        ids = np.asarray(token_ids).reshape(-1)
+        n = int(ids.size)
+        bs = self.block_size
+        digests = chunk_digests(ids, bs)
+        matched, blocks = 0, []
+        for d in digests:
+            b = self._prefix_index.get(d)
+            if b is None:
+                break
+            blocks.append(b)
+            matched += 1
+        covered = matched * bs
+        partial_block, partial_len, partial_shared = None, 0, False
+        if covered < n:
+            # at the first uncovered chunk (divergence point or true
+            # tail), a registered partially-filled block whose tokens
+            # prefix-match the remainder still saves compute: mapped
+            # read-only when it exactly completes the prompt, copied
+            # (COW) when this prompt writes past its matched tokens
+            parent = digests[matched - 1] if matched else b""
+            rem = n - covered
+            for p in range(min(bs - 1, rem), 0, -1):
+                b = self._partial_index.get(
+                    _partial_key(parent, ids[covered:covered + p]))
+                if b is not None:
+                    partial_block, partial_len = b, p
+                    partial_shared = (p == rem)
+                    covered += p
+                    break
+        return PrefixPlan(
+            ids=ids, num_tokens=n,
+            chunks_total=max(1, math.ceil(n / bs)),
+            digests=digests, matched_full=matched,
+            matched_blocks=blocks, partial_block=partial_block,
+            partial_len=partial_len, partial_shared=partial_shared,
+            covered_tokens=covered)
+
+    def alloc_slot_cached(self, plan):
+        """Claim a slot for a planned prompt: matched blocks are mapped
+        read-only (refcount++), a matched-but-extended partial block is
+        copied (COW), and only the uncovered chunks allocate fresh
+        blocks. Returns the slot id or None (no slot / not enough
+        reclaimable blocks — the plan is untouched on failure)."""
+        free = self.free_slots()
+        if not free or plan.chunks_total > self.max_blocks_per_seq:
+            return None
+        shared = list(plan.matched_blocks)
+        cow_src = None
+        if plan.partial_block is not None:
+            if plan.partial_shared:
+                shared.append(plan.partial_block)
+            else:
+                cow_src = plan.partial_block
+        # pin everything we read before any eviction can run
+        for b in shared:
+            self._ref_block(b)
+        if cow_src is not None:
+            self._ref_block(cow_src)
+        fresh_needed = plan.chunks_total - len(shared)
+        if fresh_needed > len(self._free) + len(self._cached_free):
+            if cow_src is not None:
+                self._deref_block(cow_src)
+            for b in reversed(shared):
+                self._deref_block(b)
+            return None
+        fresh = [self._take_block() for _ in range(fresh_needed)]
+        if cow_src is not None:
+            self._copy_block_rows(cow_src, fresh[0])
+            self._deref_block(cow_src)
+            _PREFIX_COW.inc()
+        slot = free[0]
+        blocks = shared + fresh
+        self._slot_blocks[slot] = blocks
+        self._live[slot] = True
+        row = np.zeros((self.max_blocks_per_seq,), np.int32)
+        row[:len(blocks)] = blocks
+        self.block_tables[slot] = row
+        self.seq_lens[slot] = 0
+        # a COW-extended partial match counts as a HIT (its registered
+        # tokens were served from cache even though the block itself is
+        # a fresh copy) — keeps these counters consistent with the
+        # serving.prefill span's hit_blocks attr (= plan.hit_blocks)
+        hit = plan.hit_blocks
+        _PREFIX_HITS.inc(hit)
+        _PREFIX_MISSES.inc(plan.chunks_total - hit)
+        return slot
+
+    def commit_prefix(self, slot, plan):
+        """Register the freshly-prefilled chunks of this slot in the
+        prefix index (after the prefill wrote them — their rows are
+        immutable from here on: appends only ever touch rows past the
+        registered token count, and shared writes COW first). First
+        registration wins; an already-indexed digest keeps its block."""
+        blocks = self._slot_blocks[slot]
+        for i in range(plan.matched_full, len(plan.digests)):
+            d = plan.digests[i]
+            if d in self._prefix_index:
+                continue
+            b = blocks[i]
+            self._prefix_index[d] = b
+            self._block_keys.setdefault(b, []).append(("full", d))
+        rem = plan.num_tokens - len(plan.digests) * self.block_size
+        if rem > 0 and not plan.partial_shared:
+            parent = plan.digests[-1] if plan.digests else b""
+            key = _partial_key(parent, plan.ids[plan.num_tokens - rem:])
+            if key not in self._partial_index:
+                b = blocks[len(plan.digests)]
+                self._partial_index[key] = b
+                self._block_keys.setdefault(b, []).append(("part", key))
 
 
 # ---------------------------------------------------------------------------
@@ -149,6 +497,63 @@ def paged_prefill_write(k_pool, v_pool, block_row, k_new, v_new):
     vb = v_new.reshape(nb, bs, *v_new.shape[1:]).astype(v_pool.dtype)
     blocks = block_row[:nb]
     return k_pool.at[blocks].set(kb), v_pool.at[blocks].set(vb)
+
+
+def paged_prefill_write_masked(k_pool, v_pool, block_row, k_new, v_new,
+                               start, write_start, total_len):
+    """Write a prefill TAIL's KV into the pool: ``k_new``/``v_new``
+    [S, Hk, D] hold positions ``start .. start+S-1``; only positions in
+    ``[write_start, total_len)`` actually land (shared prefix rows and
+    bucket padding are masked to the null block 0 — padding must never
+    poison cached content). All operands static-shaped; start/
+    write_start/total_len are traced scalars."""
+    s = k_new.shape[0]
+    bs = k_pool.shape[1]
+    pos = start + jnp.arange(s, dtype=jnp.int32)
+    valid = (pos >= write_start) & (pos < total_len)
+    b_idx = jnp.where(valid, pos // bs, 0)
+    blocks = jnp.where(valid, block_row[b_idx], 0)
+    offs = jnp.where(valid, pos % bs, 0)
+    k_pool = k_pool.at[blocks, offs].set(
+        jnp.where(valid[:, None, None], k_new.astype(k_pool.dtype),
+                  k_pool[blocks, offs]))
+    v_pool = v_pool.at[blocks, offs].set(
+        jnp.where(valid[:, None, None], v_new.astype(v_pool.dtype),
+                  v_pool[blocks, offs]))
+    return k_pool, v_pool
+
+
+def paged_prefix_attention_dense(q, k_pool, v_pool, block_row, q_start,
+                                 total_len, scale=None):
+    """Chunked-prefill attention for the prefix-cache tail: queries
+    [S, Hq, D] sit at absolute positions ``q_start .. q_start+S-1`` and
+    attend the slot's whole paged context (cached prefix blocks + the
+    tail KV just written), causal by absolute position and masked to
+    ``total_len``. Same gather + group-folded GQA formulation as
+    `paged_decode_attention_dense`, generalized to S queries; padded
+    query rows produce junk that the caller never reads."""
+    s, hq, d = q.shape
+    _, bs, hk, _ = k_pool.shape
+    g = hq // hk
+    s_max = block_row.shape[0] * bs
+
+    k = k_pool[block_row].reshape(s_max, hk, d)
+    v = v_pool[block_row].reshape(s_max, hk, d)
+
+    sm_scale = jnp.float32(scale if scale is not None
+                           else 1.0 / math.sqrt(d))
+    qg = q.reshape(s, hk, g, d)
+    logits = jnp.einsum("sngd,tnd->sngt", qg, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    pos_q = q_start + jnp.arange(s, dtype=jnp.int32)
+    pos_k = jnp.arange(s_max, dtype=jnp.int32)
+    mask = (pos_k[None, :] <= pos_q[:, None]) & \
+        (pos_k[None, :] < total_len)
+    logits = jnp.where(mask[:, None, None, :], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(mask[:, None, None, :], probs, 0.0)
+    out = jnp.einsum("sngt,tnd->sngd", probs.astype(v.dtype), v)
+    return out.reshape(s, hq, d).astype(q.dtype)
 
 
 def paged_decode_write(k_pool, v_pool, block_tables, positions, k_new,
@@ -387,12 +792,20 @@ class ContinuousBatchingEngine:
         # (seq_lens is host metadata: no device fetch here)
         lens = self.cache.seq_lens
         for slot in list(self.running):
-            if not self.cache.ensure_capacity(slot, int(lens[slot]) + 1):
+            denied = self.cache.ensure_capacity(slot, int(lens[slot]) + 1)
+            if not denied:
+                req = self.running[slot]
+                if denied.reason == CapacityError.SEQ_LIMIT:
+                    # no amount of freeing helps — the sequence itself
+                    # outgrew the table (validate_request bounds this,
+                    # so only a caller bypassing it can get here)
+                    raise RuntimeError(
+                        f"request {req.rid} outgrew max_blocks_per_seq: "
+                        f"{denied.detail}")
                 # pool exhausted: preempt (free the blocks, requeue for
                 # re-prefill once others release pages) instead of
                 # silently truncating the sequence
                 if len(self.running) == 1:
-                    req = self.running[slot]
                     raise RuntimeError(
                         f"KV pool exhausted: request {req.rid} needs "
                         f"{math.ceil((int(lens[slot]) + 1) / self.cache.block_size)} "

@@ -362,6 +362,107 @@ class Llama(nn.Layer):
         cache.seq_lens[slot] = s
         return int(tok)
 
+    def paged_prefill_extend(self, cache, slot, ids, tail_start,
+                             write_start, temperature=0.0, pad_to=None):
+        """Prefix-cache prefill (inference/paged.py): the slot's block
+        table already maps cached KV for positions ``[0, tail_start)``
+        (mapped read-only at admission); compute ONLY the tail
+        ``ids[tail_start:]`` — embed, rope at the absolute offset, write
+        its KV into the pool (positions ``>= write_start`` only; a
+        fully-covered prompt recomputes just its last token's query and
+        writes nothing), and attend each tail token against the whole
+        paged context. Sets seq_len and returns the first sampled token,
+        exactly like ``paged_prefill`` — covered positions cost zero
+        prefill FLOPs.
+
+        ``pad_to`` buckets the TAIL length (serving/bucketing.py) so
+        warm cache-hit traffic traces a bounded set of extend programs;
+        padded rows write nothing (masked to the null block) and their
+        outputs are never read.
+        """
+        from ..core.random import next_key
+
+        ids = np.asarray(ids).reshape(-1)
+        total = ids.shape[0]
+        bs = cache.block_size
+        s_tail = total - tail_start
+        spad = -(-s_tail // bs) * bs
+        if pad_to is not None:
+            cap = cache.max_blocks_per_seq * bs
+            want = min(max(int(pad_to), spad), cap)
+            spad = -(-want // bs) * bs
+        tail = np.zeros((1, spad), np.int64)
+        tail[0, :s_tail] = ids[tail_start:]
+
+        if not hasattr(self, "_paged_extend_jit"):
+            rebind = self._param_rebind()
+            cfg = self.config
+            hq = cfg.num_heads
+            hk = cfg.num_kv_heads
+            hd = cfg.hidden_size // hq
+
+            def fn(param_arrays, tail_ids, t_start, w_start, t_total,
+                   row, k_pools, v_pools, key, temp):
+                from ..inference.paged import (
+                    paged_prefill_write_masked,
+                    paged_prefix_attention_dense)
+                from .generation import sample_token
+                from ..core.autograd import no_grad
+                rebind(param_arrays)
+                s = tail_ids.shape[1]
+                with no_grad():
+                    x = self.embed_tokens(Tensor(tail_ids))
+                    new_k, new_v = [], []
+                    for i, blk in enumerate(self.layers):
+                        attn = blk.self_attn
+                        h = blk.input_layernorm(x)
+                        q = attn.q_proj(h).reshape([1, s, hq, hd])
+                        k = attn.k_proj(h).reshape([1, s, hk, hd])
+                        v = attn.v_proj(h).reshape([1, s, hk, hd])
+                        q, k = apply_rope(q, k, theta=attn.rope_theta,
+                                          position_offset=t_start)
+                        kp, vp = paged_prefill_write_masked(
+                            k_pools[i], v_pools[i], row, k._data[0],
+                            v._data[0], t_start, w_start, t_total)
+                        out = paged_prefix_attention_dense(
+                            q._data[0], kp, vp, row, t_start, t_total)
+                        x = x + attn.o_proj(
+                            Tensor(out.reshape(1, s, hq * hd)))
+                        x = x + blk.mlp(blk.post_attention_layernorm(x))
+                        new_k.append(kp)
+                        new_v.append(vp)
+                    x = self.norm(x)
+                    if self.lm_head is not None:
+                        logits = self.lm_head(x)
+                    else:
+                        from .. import ops
+                        logits = ops.matmul(x, self.embed_tokens.weight,
+                                            transpose_y=True)
+                last = jnp.take_along_axis(
+                    logits._data, (t_total - 1 - t_start)[None, None,
+                                                          None],
+                    axis=1)[:, 0]
+                tok = jax.lax.cond(
+                    temp > 0,
+                    lambda: sample_token(last / jnp.maximum(temp, 1e-6),
+                                         temperature=1.0, key=key),
+                    lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
+                return tok[0], new_k, new_v
+            self._paged_extend_jit = jax.jit(fn)
+
+        arrs = self._param_arrays()
+        tok, ks, vs = self._paged_extend_jit(
+            arrs, jnp.asarray(tail), jnp.int32(tail_start),
+            jnp.int32(write_start), jnp.int32(total),
+            jnp.asarray(cache.block_tables[slot]),
+            cache.k_pools, cache.v_pools, next_key(),
+            jnp.float32(temperature))
+        self._param_rebind()(arrs)
+        cache.k_pools = list(ks)
+        cache.v_pools = list(vs)
+        cache.seq_lens[slot] = total
+        return int(tok)
+
     def paged_decode_step(self, cache, last_tokens, active,
                           temperature=0.0):
         """One decode step for every live slot: write the incoming token's

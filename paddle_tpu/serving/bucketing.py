@@ -19,6 +19,17 @@ Pinned by the compile-count test in tests/framework/test_serving.py and
 the no-recompile check in tools/serving_gate.py, both via the
 ``xla.compile.count`` metric (profiler.metrics' jax.monitoring
 listener).
+
+Interaction with prefix caching (``FLAGS_serving_prefix_cache``):
+chunk hashes are computed over the UNPADDED token ids before any
+bucketing — padding must never poison a content hash, or two prompts
+that merely share a bucket would alias. The padded KV the prefill
+writes past the true length is garbage but harmless: every reader
+masks by seq_len, sharers of a partially-filled block copy-on-write
+before their own tokens land, and decode appends overwrite those rows
+in place. Cache-hitting admissions bucket only their uncovered TAIL
+(the covered prefix is mapped, not computed), so the warm program set
+stays bounded by the same log2(cap) ladder.
 """
 
 from __future__ import annotations

@@ -114,13 +114,14 @@ class ServingEngine:
                  max_seq_len=2048, num_blocks=None, temperature=0.0,
                  eos_token_id=None, dtype=None,
                  prefill_token_budget=None, max_queue=None,
-                 bucket_cap=None, background=True):
+                 bucket_cap=None, prefix_cache=None, background=True):
         self._sched = Scheduler(
             model, max_batch=max_batch, block_size=block_size,
             max_seq_len=max_seq_len, num_blocks=num_blocks,
             temperature=temperature, eos_token_id=eos_token_id,
             dtype=dtype, prefill_token_budget=prefill_token_budget,
-            max_queue=max_queue, bucket_cap=bucket_cap)
+            max_queue=max_queue, bucket_cap=bucket_cap,
+            prefix_cache=prefix_cache)
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._background = background

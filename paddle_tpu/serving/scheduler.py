@@ -11,7 +11,12 @@ around every entry point). Each ``step()`` is one scheduling iteration:
    free blocks, and a per-step *prefill token budget*
    (``FLAGS_serving_prefill_budget``) so a burst of long prompts cannot
    starve running decodes; admitted prompts prefill at a bucketed
-   length (`serving.bucketing`) and stream their first token;
+   length (`serving.bucketing`) and stream their first token. With
+   prefix caching on (``FLAGS_serving_prefix_cache``), a prompt's
+   resident prefix blocks are mapped read-only instead of recomputed:
+   the budget is charged for the *uncovered* tail only, and the
+   prefill runs the tail-extend program (zero FLOPs for covered
+   blocks);
 3. **decode** — ONE jitted step for every live slot. Pool exhaustion
    preempts the newest-admitted victim (free blocks + requeue at the
    queue front for re-prefill) instead of truncating anyone —
@@ -35,7 +40,8 @@ import numpy as np
 
 from ..core import flags as flags_mod
 from ..core import resilience
-from ..inference.paged import PagedKVCache, validate_request
+from ..inference.paged import (CapacityError, PagedKVCache,
+                               validate_request)
 from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
 from .bucketing import bucket_length
@@ -124,6 +130,12 @@ _g_queue = _metrics.gauge("serving.queue.depth")
 _g_running = _metrics.gauge("serving.slots.running")
 _g_blocks = _metrics.gauge("serving.kv.blocks_used")
 _g_util = _metrics.gauge("serving.kv.utilization")
+# prefix-cache economics: tokens the prefill actually computed (padded;
+# covered tokens cost zero FLOPs — tools/prefix_gate.py pins this),
+# blocks currently backing >1 slot, and reclaimable cached blocks
+_m_prefix_computed = _metrics.counter("serving.prefix.computed_tokens")
+_g_shared = _metrics.gauge("serving.kv.shared_blocks")
+_g_cached = _metrics.gauge("serving.kv.cached_blocks")
 
 
 class Scheduler:
@@ -133,7 +145,7 @@ class Scheduler:
                  max_seq_len=2048, num_blocks=None, temperature=0.0,
                  eos_token_id=None, dtype=None,
                  prefill_token_budget=None, max_queue=None,
-                 bucket_cap=None):
+                 bucket_cap=None, prefix_cache=None):
         import jax.numpy as jnp
 
         cfg = model.config
@@ -158,6 +170,12 @@ class Scheduler:
         self.bucket_cap = (
             flags_mod.flag("FLAGS_serving_prefill_bucket_cap")
             if bucket_cap is None else int(bucket_cap))
+        # prefix caching: read ONCE at construction (mid-flight flag
+        # flips would mix shared and private accounting); off = the
+        # cache never registers a chunk and behaves exactly as before
+        self.prefix_cache = (
+            bool(flags_mod.flag("FLAGS_serving_prefix_cache"))
+            if prefix_cache is None else bool(prefix_cache))
         self.queue: list[ServingRequest] = []
         self.running: dict[int, ServingRequest] = {}  # slot -> request
         self.finished: dict[int, ServingRequest] = {}  # rid -> request
@@ -264,22 +282,39 @@ class Scheduler:
         head-of-line bypass — a small late prompt never jumps an older
         large one). Budgeted: cumulative prefill tokens per step stay
         under the budget, except the step's first admission, which is
-        always allowed so an over-budget prompt still makes progress."""
+        always allowed so an over-budget prompt still makes progress.
+
+        Cache-aware: admission cost is the UNCOVERED tokens only — a
+        request whose prefix is resident charges the budget for (and
+        computes) just its tail, so cache-hitting requests admit cheaply
+        and their TTFT collapses to a near-no-op. Hashing/planning works
+        on the raw ids; bucket padding happens after and never reaches a
+        chunk hash (serving/bucketing.py)."""
         out = []
         used = 0
         budget = self.prefill_token_budget
+        bs = self.cache.block_size
         while self.queue:
-            req = self.queue[0]
-            ids_len = len(req.prompt) + len(req.generated)
-            if used > 0 and budget and used + ids_len > budget:
-                break
             if len(self.running) >= self.cache.max_batch:
+                break  # before planning: don't hash prompts every
+                #        decode step while the batch stays full
+            req = self.queue[0]
+            ids = self._prefill_ids(req)
+            ids_len = len(ids)
+            plan = self.cache.plan_prefix(ids) if self.prefix_cache \
+                else None
+            covered = plan.covered_tokens if plan is not None else 0
+            # full coverage still computes the final token for its
+            # logits; everything covered is free
+            uncovered = max(ids_len - covered, 1)
+            if used > 0 and budget and used + uncovered > budget:
                 break
-            slot = self.cache.alloc_slot(ids_len)
+            slot = self.cache.alloc_slot_cached(plan) \
+                if plan is not None else self.cache.alloc_slot(ids_len)
             if slot is None:
                 break
             self.queue.pop(0)
-            used += ids_len
+            used += uncovered
             req.slot = slot
             req.status = RequestStatus.RUNNING
             req.admit_seq = self._next_admit_seq
@@ -294,15 +329,33 @@ class Scheduler:
                                      wait_us)
             self.running[slot] = req
             _m_admitted.inc()
-            pad_to = bucket_length(ids_len, self.cache.block_size,
-                                   self.bucket_cap,
-                                   max_len=self.max_seq_len)
-            with _tracing.span("serving.prefill", parent=req.span,
-                               tokens=ids_len, pad_to=pad_to,
-                               reprefill=bool(req.generated)):
-                tok = int(self.model.paged_prefill(
-                    self.cache, slot, self._prefill_ids(req),
-                    temperature=self.temperature, pad_to=pad_to))
+            if covered:
+                tail_start = plan.tail_start
+                pad_to = bucket_length(ids_len - tail_start, bs,
+                                       self.bucket_cap,
+                                       max_len=self.max_seq_len)
+                with _tracing.span("serving.prefill", parent=req.span,
+                                   tokens=ids_len, pad_to=pad_to,
+                                   reprefill=bool(req.generated),
+                                   covered=covered,
+                                   hit_blocks=plan.hit_blocks):
+                    tok = int(self.model.paged_prefill_extend(
+                        self.cache, slot, ids, tail_start,
+                        plan.write_start,
+                        temperature=self.temperature, pad_to=pad_to))
+            else:
+                pad_to = bucket_length(ids_len, bs, self.bucket_cap,
+                                       max_len=self.max_seq_len)
+                with _tracing.span("serving.prefill", parent=req.span,
+                                   tokens=ids_len, pad_to=pad_to,
+                                   reprefill=bool(req.generated),
+                                   covered=0, hit_blocks=0):
+                    tok = int(self.model.paged_prefill(
+                        self.cache, slot, ids,
+                        temperature=self.temperature, pad_to=pad_to))
+            if plan is not None:
+                _m_prefix_computed.inc(pad_to)
+                self.cache.commit_prefix(slot, plan)
             self._last_tok[slot] = tok
             self._remaining[slot] = \
                 req.max_new_tokens - len(req.generated) - 1
@@ -311,16 +364,41 @@ class Scheduler:
             self._maybe_finish(slot)
         return out
 
+    def _choose_victim(self):
+        """Newest-admitted victim (FCFS holds), but reclaimability-
+        aware: preempting a request whose blocks are all SHARED frees
+        nothing — skip past such victims to the newest one whose
+        eviction actually returns blocks to the pool."""
+        cands = sorted(self.running,
+                       key=lambda s: -self.running[s].admit_seq)
+        for s in cands:
+            if self.cache.reclaimable_blocks(s) > 0:
+                return s
+        return cands[0]
+
     def _decode(self):
         if not self.running:
             return []
-        # grow block tables; preempt the newest-admitted victim on pool
-        # exhaustion (never truncate)
+        # make each slot's next position writable: grow tables (cold
+        # cached prefixes are LRU-evicted before anything else —
+        # eviction always runs before preemption), copy-on-write shared
+        # blocks; preempt a victim on true pool exhaustion (never
+        # truncate)
         for slot in list(self.running):
             if slot not in self.running:  # preempted as a victim below
                 continue
-            while not self.cache.ensure_capacity(
-                    slot, int(self.cache.seq_lens[slot]) + 1):
+            while True:
+                denied = self.cache.prepare_append(
+                    slot, int(self.cache.seq_lens[slot]) + 1)
+                if denied:
+                    break
+                if denied.reason == CapacityError.SEQ_LIMIT:
+                    # retrying can never help — only a caller bypassing
+                    # validate_request's worst-case bound can get here
+                    req = self.running[slot]
+                    raise RuntimeError(
+                        f"serving: request {req.rid} outgrew "
+                        f"max_blocks_per_seq: {denied.detail}")
                 if len(self.running) == 1:
                     # unreachable since validate_request bounds each
                     # request's worst-case demand to the pool; keep as
@@ -335,11 +413,7 @@ class Scheduler:
                         f"{self.cache.num_blocks - 1} usable and no "
                         "other running request to preempt; increase "
                         "num_blocks or lower max_seq_len")
-                # true newest-victim: the growing slot is a candidate
-                # too — when IT is the newest it self-preempts rather
-                # than evicting an older request (FCFS holds)
-                victim = max(self.running,
-                             key=lambda s: self.running[s].admit_seq)
+                victim = self._choose_victim()
                 self._preempt(victim)
                 if victim == slot:
                     break  # grower preempted itself; re-prefills later
@@ -451,8 +525,12 @@ class Scheduler:
 
     def _update_gauges(self):
         usable = self.cache.num_blocks - 1
+        # num_free_blocks counts reclaimable cached blocks as free, so
+        # blocks_used is blocks pinned by LIVE requests (refcount > 0)
         used = usable - self.cache.num_free_blocks()
         _g_queue.set(len(self.queue))
         _g_running.set(len(self.running))
         _g_blocks.set(used)
         _g_util.set(round(used / usable, 4) if usable else 0.0)
+        _g_shared.set(self.cache.num_shared_blocks())
+        _g_cached.set(self.cache.num_cached_blocks())

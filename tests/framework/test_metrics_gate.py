@@ -26,6 +26,10 @@ def test_dispatch_overhead_in_budget_recorder_closed():
 
 
 def test_armed_profiler_ratio_bounded():
+    # order-independent since the gate arms timer_only=True: the XPlane
+    # device trace (whose cost scales with prior process history) is
+    # out of budget — this failed after the serving suite on the seed
+    # tree because jax.profiler.start_trace got ~40x more expensive
     _, per_op = metrics_gate.check_dispatch_overhead()
     assert metrics_gate.check_armed_ratio(per_op)
 

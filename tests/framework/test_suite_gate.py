@@ -53,9 +53,22 @@ def test_inference_and_serving_map_to_their_tests():
     t = suite_gate.targets_for(["paddle_tpu/inference/paged.py"])
     assert "tests/framework/test_paged_decode.py" in t
     assert "tests/framework/test_serving.py" in t
+    assert "tests/framework/test_prefix_cache.py" in t
     t = suite_gate.targets_for(["paddle_tpu/serving/scheduler.py"])
     assert "tests/framework/test_serving.py" in t
+    assert "tests/framework/test_prefix_cache.py" in t
     t = suite_gate.targets_for(["tools/serving_gate.py"])
+    assert "tests/framework/test_serving.py" in t
+
+
+def test_prefix_cache_surfaces_map_to_their_tests():
+    t = suite_gate.targets_for(["tools/prefix_gate.py"])
+    assert "tests/framework/test_prefix_cache.py" in t
+    # the extend program lives on the model: llama changes run the
+    # paged + prefix + serving pins
+    t = suite_gate.targets_for(["paddle_tpu/models/llama.py"])
+    assert "tests/framework/test_paged_decode.py" in t
+    assert "tests/framework/test_prefix_cache.py" in t
     assert "tests/framework/test_serving.py" in t
 
 

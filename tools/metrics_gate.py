@@ -14,8 +14,13 @@ Checks (all runnable under JAX_PLATFORMS=cpu, tier-1):
   2. recorder-off dispatch — median per-op wall time of a warm eager
      binary op stays under ``DISPATCH_BUDGET_US`` (generous: it catches
      a stray device sync or per-op trace, not scheduler jitter);
-  3. armed ratio — recording spans costs <= ``ARMED_RATIO`` x the
-     disabled path (spans are two clock reads + one dict append).
+  3. armed ratio — recording HOST spans costs <= ``ARMED_RATIO`` x the
+     disabled path (spans are two clock reads + one dict append). The
+     armed Profiler runs ``timer_only=True``: what the budget pins is
+     OUR span recording, not jax's XPlane device trace, whose per-op
+     cost scales with accumulated process history (live executables /
+     arrays) and made this check order-DEPENDENT — it failed whenever
+     the serving suite ran first in the same process.
 
 Budgets are env-overridable (METRICS_GATE_*). Exit 0 on pass, 1 on
 fail; `python tools/metrics_gate.py` prints one line per check.
@@ -84,7 +89,14 @@ def check_dispatch_overhead():
 
 def check_armed_ratio(disabled_us):
     import paddle_tpu.profiler as profiler
-    prof = profiler.Profiler()
+
+    # timer_only: arm the host-span recorder WITHOUT jax.profiler's
+    # XPlane device trace — the device trace's per-op cost grows with
+    # everything the process compiled/allocated before the gate ran
+    # (measured 3x fresh vs ~40x after the serving suite), which is
+    # jax's cost to bear, not a dispatch regression this gate should
+    # fail tier-1 over. Host-span overhead is order-independent.
+    prof = profiler.Profiler(timer_only=True)
     prof.start()
     try:
         armed_us = _per_op_us(600)

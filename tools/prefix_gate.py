@@ -1,0 +1,227 @@
+"""Prefix-cache gate: a fixed shared-prompt workload through
+`ServingEngine` with four pass/fail checks, in order of importance:
+
+  1. economics  — warm shared-prefix admissions map their covered
+     blocks instead of recomputing them: `serving.prefix.computed_
+     tokens` is counter-PINNED to the bucketed tail lengths alone
+     (zero prefill FLOPs for covered blocks), and the block hit rate
+     on the corpus stays >= ``PREFIX_GATE_HIT_RATE``;
+  2. bit-exactness — greedy outputs of every shared-prefix request
+     (including an exact duplicate, which exercises decode-append COW
+     into the shared tail block) are identical to uncontended
+     `ContinuousBatchingEngine` runs;
+  3. eviction   — cold cached prefixes are LRU-reclaimed under
+     allocation pressure (`serving.prefix.evictions` moves, nothing
+     preempts, and the pool drains back to its full free floor);
+  4. revert     — `prefix_cache=False` (the FLAGS_serving_prefix_cache
+     =0 path) serves the same corpus with identical tokens and ZERO
+     movement on every `serving.prefix.*` counter.
+
+Also reports the measured TTFT delta (cold full prefill vs warm hit)
+and the effective-KV-capacity multiplier (logical blocks mapped vs
+physical blocks pinned) — the "why" of the feature, printed per run.
+
+Exit 0 on pass, 1 on fail; one line per check. Runs under
+JAX_PLATFORMS=cpu (tier-1); wired into tools/suite_gate.py beside the
+serving/trace gates.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+HIT_RATE = float(os.environ.get("PREFIX_GATE_HIT_RATE", "0.6"))
+
+BLOCK, MAXSEQ, CAP = 8, 64, 32
+SYSTEM_LEN, N_SHARED = 24, 5  # 3 shared chunks per hitting request
+
+
+def _model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _corpus():
+    """[cold, warmup, q, q-duplicate, 3 more suffixes] — all share the
+    system prompt; the adjacent duplicates admit into one step and run
+    CONCURRENTLY, so the first decode append into their shared partial
+    tail block exercises copy-on-write."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    system = rng.integers(0, 255, (SYSTEM_LEN,)).astype("int64")
+    mk = lambda: np.concatenate(  # noqa: E731
+        [system, rng.integers(0, 255, (2,)).astype("int64")])
+    cold, warmup, q = mk(), mk(), mk()
+    # warmup runs as a duplicate pair too, so the extend program AND
+    # the COW copy both compile before the measured window
+    return [cold, warmup, warmup.copy(), q, q.copy()] + \
+        [mk() for _ in range(N_SHARED - 2)]
+
+
+def _refs(model, prompts):
+    from paddle_tpu.inference.paged import ContinuousBatchingEngine
+
+    refs = []
+    for p in prompts:
+        eng = ContinuousBatchingEngine(model, max_batch=2,
+                                       block_size=BLOCK,
+                                       max_seq_len=MAXSEQ,
+                                       temperature=0.0)
+        rid = eng.add_request(p, max_new_tokens=6)
+        refs.append(eng.run_to_completion()[rid])
+    return refs
+
+
+def check_economics_and_exactness(model, prompts, refs):
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.bucketing import bucket_length
+
+    eng = ServingEngine(model, max_batch=2, block_size=BLOCK,
+                        max_seq_len=MAXSEQ, temperature=0.0,
+                        bucket_cap=CAP, background=False)
+    # cold: the first request pays the full prefill and registers the
+    # system prompt's chunks
+    t0 = time.perf_counter()
+    h0 = eng.submit(prompts[0], max_new_tokens=6)
+    eng.step()
+    cold_ttft_ms = (time.perf_counter() - t0) * 1000.0
+    eng.drain()
+    # warm the tail-extend program and the COW copy (their one-off XLA
+    # compiles would otherwise dominate the measured warm TTFT)
+    warm_handles = [eng.submit(p, max_new_tokens=6)
+                    for p in prompts[1:3]]
+    eng.drain()
+    before = metrics.snapshot("serving.")
+    t0 = time.perf_counter()
+    handles = [eng.submit(p, max_new_tokens=6) for p in prompts[3:]]
+    eng.step()
+    warm_ttft_ms = (time.perf_counter() - t0) * 1000.0
+    peak_logical = sum(len(eng.cache._slot_blocks[s])
+                      for s in eng.scheduler.running)
+    peak_physical = (eng.cache.num_blocks - 1
+                     - eng.cache.num_free_blocks())
+    eng.drain()
+    after = metrics.snapshot("serving.")
+
+    hits = after["serving.prefix.hit_blocks"] - \
+        before["serving.prefix.hit_blocks"]
+    misses = after["serving.prefix.miss_blocks"] - \
+        before["serving.prefix.miss_blocks"]
+    computed = after["serving.prefix.computed_tokens"] - \
+        before["serving.prefix.computed_tokens"]
+    rate = hits / max(hits + misses, 1)
+
+    # the pin: every warm admission computes ONLY its bucketed tail —
+    # 2 uncovered tokens for the suffix requests, 1 recomputed token
+    # for the exact duplicate — never the covered system prompt
+    tail_bucket = bucket_length(2, BLOCK, CAP, max_len=MAXSEQ)
+    want_computed = len(handles) * tail_bucket
+    full_bucket = bucket_length(SYSTEM_LEN + 2, BLOCK, CAP,
+                                max_len=MAXSEQ)
+    exact = all(h.tokens() == r
+                for h, r in zip([h0] + warm_handles + handles, refs))
+    done = all(h.status == "DONE"
+               for h in [h0] + warm_handles + handles)
+    cows = after["serving.prefix.cow_copies"] - \
+        before["serving.prefix.cow_copies"]
+
+    ok = (computed == want_computed and rate >= HIT_RATE and exact
+          and done and cows >= 1)
+    print(f"[prefix-gate] economics: computed_tokens={computed} "
+          f"(pin {want_computed}; full prefills would be "
+          f"{len(handles) * full_bucket}) hit_rate={rate:.2f} "
+          f"(floor {HIT_RATE}) {'PASS' if ok else 'FAIL'}")
+    print(f"[prefix-gate] bit-exact: shared-vs-uncontended greedy "
+          f"match={exact} all DONE={done} cow_copies={cows} (want >=1) "
+          f"{'PASS' if ok else 'FAIL'}")
+    print(f"[prefix-gate] measured: cold TTFT {cold_ttft_ms:.1f}ms -> "
+          f"warm hit TTFT {warm_ttft_ms:.1f}ms; effective KV capacity "
+          f"{peak_logical} logical blocks on {peak_physical} physical "
+          f"(x{peak_logical / max(peak_physical, 1):.2f})")
+    return ok
+
+
+def check_eviction_floor(model):
+    import numpy as np
+
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import ServingEngine
+
+    rng = np.random.default_rng(1)
+    before = metrics.snapshot("serving.")
+    # 10 usable blocks: one finished request leaves 2 cached chunks;
+    # two 8-token/12-new requests peak at 5 blocks each — they fit
+    # exactly IF eviction reclaims the cold cache (no preemption)
+    eng = ServingEngine(model, max_batch=2, block_size=4, max_seq_len=32,
+                        num_blocks=11, temperature=0.0, background=False)
+    eng.submit(rng.integers(0, 255, (8,)).astype("int64"),
+               max_new_tokens=4)
+    eng.drain()
+    cached = eng.cache.num_cached_blocks()
+    hs = [eng.submit(rng.integers(0, 255, (8,)).astype("int64"),
+                     max_new_tokens=12) for _ in range(2)]
+    eng.drain()
+    after = metrics.snapshot("serving.")
+    evictions = after["serving.prefix.evictions"] - \
+        before["serving.prefix.evictions"]
+    preempts = after["serving.preempt"] - before["serving.preempt"]
+    usable = eng.cache.num_blocks - 1
+    free = eng.cache.num_free_blocks()
+    ok = (cached >= 2 and evictions >= 1 and preempts == 0
+          and free == usable and all(h.status == "DONE" for h in hs))
+    print(f"[prefix-gate] eviction: cached={cached} evictions="
+          f"{evictions} (want >=1) preempts={preempts} (want 0) "
+          f"free={free}/{usable} {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_flag_off_revert(model, prompts, refs):
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import ServingEngine
+
+    before = metrics.snapshot("serving.prefix.")
+    eng = ServingEngine(model, max_batch=2, block_size=BLOCK,
+                        max_seq_len=MAXSEQ, temperature=0.0,
+                        bucket_cap=CAP, background=False,
+                        prefix_cache=False)
+    handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.drain()
+    after = metrics.snapshot("serving.prefix.")
+    moved = {k for k in after if after[k] != before[k]}
+    exact = all(h.tokens() == r for h, r in zip(handles, refs))
+    no_cache = eng.cache.num_cached_blocks() == 0
+    ok = not moved and exact and no_cache
+    print(f"[prefix-gate] flag-off: prefix counters moved={sorted(moved)}"
+          f" (want none) tokens identical={exact} cached_blocks="
+          f"{eng.cache.num_cached_blocks()} {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def main():
+    model = _model()
+    prompts = _corpus()
+    refs = _refs(model, prompts)
+    ok1 = check_economics_and_exactness(model, prompts, refs)
+    ok2 = check_eviction_floor(model)
+    ok3 = check_flag_off_revert(model, prompts, refs)
+    if ok1 and ok2 and ok3:
+        print("[prefix-gate] PASS")
+        return 0
+    print("[prefix-gate] FAIL")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

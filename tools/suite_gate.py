@@ -38,9 +38,15 @@ _MAP = [
                          "tests/ops"]),
     ("paddle_tpu/core/resilience.py", ["tests/framework/test_chaos.py",
                                        "tests/framework/test_serving.py"]),
-    ("paddle_tpu/serving/", ["tests/framework/test_serving.py"]),
+    ("paddle_tpu/serving/", ["tests/framework/test_serving.py",
+                             "tests/framework/test_prefix_cache.py"]),
     ("paddle_tpu/inference/", ["tests/framework/test_paged_decode.py",
-                               "tests/framework/test_serving.py"]),
+                               "tests/framework/test_serving.py",
+                               "tests/framework/test_prefix_cache.py"]),
+    ("paddle_tpu/models/llama.py",
+     ["tests/framework/test_paged_decode.py",
+      "tests/framework/test_prefix_cache.py",
+      "tests/framework/test_serving.py"]),
     ("paddle_tpu/models/generation.py",
      ["tests/framework/test_serving.py",
       "tests/framework/test_paged_decode.py",
@@ -70,6 +76,7 @@ _MAP = [
     ("tools/chaos_gate.py", ["tests/framework/test_chaos.py",
                              "tests/distributed/test_checkpoint.py"]),
     ("tools/serving_gate.py", ["tests/framework/test_serving.py"]),
+    ("tools/prefix_gate.py", ["tests/framework/test_prefix_cache.py"]),
     ("tools/trace_gate.py", ["tests/framework/test_tracing.py"]),
     ("tools/", []),
 ]
