@@ -90,6 +90,13 @@ def main():
         streamed = list(handle.stream(timeout=300))
         print(f"serving: streamed {len(streamed)} tokens "
               f"(status={handle.status}) -> {streamed[:8]}...")
+        # per-request bill (profiler/accounting.py): who paid for which
+        # device step — queue/prefill/decode/compile split, attributed
+        # device ms, prefix-covered tokens
+        cost = handle.cost()
+        if cost is not None:
+            print(f"  cost: {cost.summary()}")
+        print(f"  {serving.accounting.goodput_line()}")
     from paddle_tpu.profiler import metrics
     snap = metrics.snapshot("serving.")
 
@@ -139,6 +146,15 @@ def main():
               f"{cold_ttft * 1000:.1f}ms vs {warm_wall * 1000:.1f}ms "
               f"for all {args.shared} warm requests together "
               f"(incl. one-off extend-program compile)")
+        # the bills make the cache visible per request: the cold
+        # request pays full prefill, warm ones are billed extend-only
+        # (covered tokens free) — and the goodput line totals the run
+        for name, h in [("cold", cold)] + \
+                [(f"warm{i}", h) for i, h in enumerate(shared)]:
+            c = h.cost()
+            if c is not None:
+                print(f"  cost[{name}]: {c.summary()}")
+        print(f"  {serving.accounting.goodput_line()}")
 
     # paged decode must agree with the dense-cache generate path
     prompt = rng.integers(3, model.config.vocab_size, size=6)

@@ -185,3 +185,33 @@ define_flag("FLAGS_serving_prefill_bucket_cap", 1024,
             "buckets capped here (bounds the warm jit-cache footprint to "
             "log2(cap) prefill programs); 0 disables bucketing (pad to "
             "block multiple only)")
+define_flag("FLAGS_serving_accounting", True,
+            "per-request cost attribution + engine goodput accounting "
+            "(profiler/accounting.py): each scheduler step's wall time "
+            "is apportioned across the concurrent requests in proportion "
+            "to tokens prefilled/decoded, compile time billed to the "
+            "triggering request, re-prefill billed to the preemption; "
+            "0 reverts to pre-accounting behavior byte-for-byte (read at "
+            "Scheduler construction, like FLAGS_serving_prefix_cache)")
+define_flag("FLAGS_slo_ttft_budget_us", 500000,
+            "TTFT SLO budget in microseconds (profiler/alerts.py burn-"
+            "rate rule slo.ttft_burn): observations above this bucket "
+            "boundary burn the error budget")
+define_flag("FLAGS_slo_itl_budget_us", 100000,
+            "inter-token-latency SLO budget in microseconds (alerts "
+            "rule slo.itl_burn)")
+define_flag("FLAGS_slo_target", 0.99,
+            "SLO target fraction (e.g. 0.99 = 99% of requests within "
+            "budget); the burn rate is bad-fraction / (1 - target)")
+define_flag("FLAGS_alert_burn_threshold", 1.0,
+            "burn-rate level at which slo.*_burn alerts fire (1.0 = "
+            "consuming the whole error budget at exactly the rate that "
+            "exhausts it over the SLO window)")
+define_flag("FLAGS_alert_interval_s", 10.0,
+            "min seconds between automatic alert-rule evaluations "
+            "(AlertManager.maybe_evaluate — the scheduler calls it per "
+            "step; the /alerts endpoint also nudges it); each interval "
+            "is one rolling delta window")
+define_flag("FLAGS_alert_queue_depth", 8,
+            "queue.growth alert floor: admission-queue depth must be at "
+            "least this (and growing) before the rule fires")

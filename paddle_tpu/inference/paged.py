@@ -233,6 +233,29 @@ class PagedKVCache:
         return sum(1 for b in self._slot_blocks[slot]
                    if self._refcount[b] == 1)
 
+    def occupancy(self):
+        """Pool occupancy breakdown (host metadata only — no device
+        reads). ``active`` blocks are pinned by live slots (refcount >
+        0), ``shared`` of those back more than one slot, ``cached_free``
+        are refcount-0 registered blocks the LRU can reclaim, ``free``
+        are truly free. active + cached_free + free == usable always."""
+        usable = self.num_blocks - 1
+        free = len(self._free)
+        cached = len(self._cached_free)
+        return {"usable": usable,
+                "active": usable - free - cached,
+                "shared": self.num_shared_blocks(),
+                "cached_free": cached,
+                "free": free}
+
+    def pool_bytes(self):
+        """Total HBM footprint of the K+V pools (static: allocated at
+        construction, independent of occupancy)."""
+        per_pool = (self.num_blocks * self.block_size *
+                    self.num_kv_heads * self.head_dim *
+                    jnp.dtype(self.dtype).itemsize)
+        return 2 * self.num_layers * per_pool
+
     # -- block primitives --------------------------------------------------
 
     def _take_block(self):

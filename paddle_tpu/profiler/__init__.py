@@ -21,7 +21,7 @@ from enum import Enum
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
            "load_profiler_result", "SortedKeys", "SummaryView", "metrics",
-           "tracing", "export"]
+           "tracing", "export", "accounting", "alerts"]
 
 
 class ProfilerState(Enum):
@@ -102,6 +102,10 @@ from . import metrics  # noqa: E402,F401
 # export surface (OpenMetrics text, /metrics HTTP endpoint); importing
 # tracing wires the histogram-exemplar probe into the registry
 from . import export, tracing  # noqa: E402,F401
+
+# cost attribution / goodput accounting + SLO burn-rate alert rules
+# (the serving scheduler drives them; summary() renders their views)
+from . import accounting, alerts  # noqa: E402,F401
 
 
 class RecordEvent:
@@ -217,6 +221,87 @@ def _slow_requests_view(serving_snap):
     for value, name, tid in rows[:8]:
         lines.append("{:<24} {:>14.1f}  {:<18} {}".format(
             name, value, tid, len(tracing.get_trace(tid))))
+    return lines
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+
+
+def _capacity_view(snap):
+    """"Capacity View" summary section: the KV-pool occupancy breakdown
+    (active / shared / cached-free / free blocks, pool HBM footprint)
+    plus the live-array HBM sample — the headroom numbers admission and
+    eviction decisions are made against (profiler/accounting.py)."""
+    # gate on ARMED accounting having stepped, not mere gauge
+    # registration — a disarmed serving run (FLAGS_serving_accounting=0)
+    # never sets these gauges and must not render a bogus zero pool
+    if not snap.get("serving.steps") or not snap.get("accounting.steps"):
+        return []
+    active = snap.get("serving.kv.active_blocks", 0)
+    shared = snap.get("serving.kv.shared_blocks", 0)
+    cached = snap.get("serving.kv.cached_blocks", 0)
+    free = snap.get("serving.kv.free_blocks", 0)
+    usable = active + cached + free
+    lines = ["", "{:-^72}".format(" Capacity View (KV pool / HBM) "),
+             "{:<26} {:>10} {}".format("resource", "value", "notes")]
+    rows = [
+        ("kv.active_blocks", active, "pinned by live requests"),
+        ("kv.shared_blocks", shared, "backing >1 slot (prefix cache)"),
+        ("kv.cached_free_blocks", cached, "reclaimable (LRU-evictable)"),
+        ("kv.free_blocks", free, "truly free"),
+        ("kv.usable_blocks", usable,
+         f"occupancy {active / usable:.1%}" if usable else ""),
+    ]
+    pool_b = snap.get("serving.kv.pool_bytes", 0)
+    if pool_b:
+        rows.append(("kv.pool_bytes", _fmt_bytes(pool_b),
+                     "static K+V pool footprint"))
+    live_b = snap.get("memory.live_bytes", 0)
+    if live_b:
+        rows.append(("hbm.live_bytes", _fmt_bytes(live_b),
+                     f"{snap.get('memory.live_arrays', 0)} live arrays"))
+    for name, value, note in rows:
+        lines.append("{:<26} {:>10} {}".format(name, value, note))
+    return lines
+
+
+def _goodput_view(snap):
+    """"Goodput" summary section: engine-level cost attribution rollup
+    — deadline-met tokens per attributed device-second, raw tokens/s,
+    MFU estimate, and where non-serving time went (compile, preemption
+    re-prefill waste, idle steps)."""
+    device_us = snap.get("accounting.device_us", 0)
+    if not device_us:
+        return []
+    device_s = device_us / 1e6
+    tokens = snap.get("accounting.tokens_emitted", 0)
+    good = snap.get("accounting.goodput_tokens", 0)
+    lines = ["", "{:-^72}".format(" Goodput (cost attribution) "),
+             "{:<30} {}".format("metric", "value")]
+    rows = [
+        ("goodput tokens/device-s", f"{good / device_s:.1f}"),
+        ("raw tokens/device-s", f"{tokens / device_s:.1f}"),
+        ("deadline-met tokens", f"{good} / {tokens} emitted"),
+        ("processed tokens (padded)",
+         f"{snap.get('accounting.tokens_processed', 0)}"),
+        ("device seconds", f"{device_s:.3f}"),
+        ("attributed_us", f"{snap.get('accounting.attributed_us', 0):.0f}"),
+        ("compile_us (billed direct)",
+         f"{snap.get('accounting.compile_us', 0):.0f}"),
+        ("reprefill_us (preempt waste)",
+         f"{snap.get('accounting.reprefill_us', 0):.0f}"),
+        ("idle_us (empty steps)",
+         f"{snap.get('accounting.idle_us', 0):.0f}"),
+    ]
+    mfu = snap.get("accounting.mfu", 0)
+    if mfu:
+        rows.insert(2, ("mfu estimate", f"{mfu:.3f}"))
+    for name, value in rows:
+        lines.append("{:<30} {}".format(name, value))
     return lines
 
 
@@ -493,6 +578,9 @@ class Profiler:
                     desc = str(v)
                 lines.append("{:<36} {}".format(name, desc))
             lines.extend(_slow_requests_view(serving))
+        full_snap = metrics.snapshot()
+        lines.extend(_capacity_view(full_snap))
+        lines.extend(_goodput_view(full_snap))
         lines.extend(_recent_incidents_view())
         if self._memory_samples:
             # MemoryView (reference profiler_statistic.py memory table)

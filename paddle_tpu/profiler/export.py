@@ -18,6 +18,8 @@ or Prometheus scraper needs it on a wire. Three pieces:
   ``/metrics``           OpenMetrics text (scrape me)
   ``/metrics/delta``     JSON per-second rates since the last delta call
   ``/healthz``           JSON liveness + the serving SLO gauges
+  ``/alerts``            JSON active/resolved SLO burn-rate incidents
+                         (profiler/alerts.py AlertManager, when attached)
   ``/traces``            whole span ring, Chrome/Perfetto JSON
   ``/traces/<trace_id>`` one trace, Chrome/Perfetto JSON (404 unknown)
   =====================  ==============================================
@@ -173,37 +175,65 @@ def parse_prometheus(text):
 
 class DeltaRates:
     """Per-second rates between successive ``rates()`` calls: counters
-    and histogram counts/sums diffed against the previous snapshot.
-    First call primes the baseline and returns {}."""
+    and histogram counts/sums (and, with ``include_buckets=True``,
+    per-bucket counts as ``name.le.<bound>`` — what the burn-rate alert
+    rules consume) diffed against the previous snapshot. First call
+    primes the baseline and returns {}.
 
-    def __init__(self, prefix=None):
+    Monotone series (counters, histogram counts/sums/buckets) clamp
+    negative deltas to zero: a fresh process scraping the same endpoint
+    — or a ``metrics.reset()`` between benchmark runs — resets the
+    underlying counter, and a counter reset must read as "no events
+    yet", never as a negative rate. Gauge deltas keep their sign (a
+    shrinking queue IS a negative derivative, and the queue-growth
+    alert rule relies on it)."""
+
+    def __init__(self, prefix=None, include_buckets=False):
         self.prefix = prefix
+        self.include_buckets = include_buckets
         self._prev = None
         self._prev_t = None
         self._lock = threading.Lock()
 
     def _flatten(self, snap):
-        flat = {}
+        """(flat values, set of monotone names)."""
+        flat, mono = {}, set()
+        kinds = _metrics.registry.kinds(self.prefix)
         for name, v in snap.items():
             if isinstance(v, dict):
                 flat[name + ".count"] = v["count"]
                 flat[name + ".sum"] = v["sum"]
+                mono.add(name + ".count")
+                mono.add(name + ".sum")
+                if self.include_buckets:
+                    for label, c in (v.get("buckets") or {}).items():
+                        key = f"{name}.le.{label}"
+                        flat[key] = c
+                        mono.add(key)
             else:
                 flat[name] = v
-        return flat
+                if kinds.get(name) is _metrics.Counter:
+                    mono.add(name)
+        return flat, mono
 
     def rates(self):
         now = time.monotonic()
-        cur = self._flatten(_metrics.snapshot(self.prefix))
+        cur, mono = self._flatten(_metrics.snapshot(self.prefix))
         with self._lock:
             prev, prev_t = self._prev, self._prev_t
             self._prev, self._prev_t = cur, now
         if prev is None:
             return {}
         dt = max(now - prev_t, 1e-9)
-        return {name: (cur[name] - prev.get(name, 0)) / dt
-                for name in cur
-                if isinstance(cur[name], (int, float))}
+        out = {}
+        for name, v in cur.items():
+            if not isinstance(v, (int, float)):
+                continue
+            d = v - prev.get(name, 0)
+            if name in mono and d < 0:
+                d = 0  # counter reset (fresh process / metrics.reset)
+            out[name] = d / dt
+        return out
 
 
 def _slo_health(extra=None):
@@ -230,12 +260,17 @@ def _slo_health(extra=None):
 
 class MetricsServer:
     """Threaded stdlib HTTP endpoint over the registry + trace ring.
-    Binds at construction (``port=0`` picks a free port — read
-    ``.port``); ``close()`` stops it. ``health_extra`` is an optional
-    zero-arg callable merged into /healthz (ServingEngine passes its
-    engine-state view)."""
+    Binds at construction (``port=0``, the default, binds an EPHEMERAL
+    port — read the actually-bound one from ``.port`` / ``.address`` /
+    ``url()``; never hardcode ports in tests or router configs);
+    ``close()`` stops it. ``health_extra`` is an optional zero-arg
+    callable merged into /healthz (ServingEngine passes its
+    engine-state view); ``alerts`` an optional
+    :class:`~paddle_tpu.profiler.alerts.AlertManager` served from
+    ``/alerts`` (each GET also nudges its rate-limited evaluation)."""
 
-    def __init__(self, port=0, host="127.0.0.1", health_extra=None):
+    def __init__(self, port=0, host="127.0.0.1", health_extra=None,
+                 alerts=None):
         import http.server
 
         server = self
@@ -268,6 +303,19 @@ class MetricsServer:
                         code = 200 if body["status"] == "ok" else 503
                         self._send(code, json.dumps(body),
                                    "application/json")
+                    elif path == "/alerts":
+                        mgr = server._alerts
+                        if mgr is None:
+                            # same body shape as the attached branch —
+                            # pollers index these keys unconditionally
+                            body = {"attached": False, "active": [],
+                                    "history": [], "rules": [],
+                                    "window_s": None}
+                        else:
+                            mgr.maybe_evaluate()
+                            body = {"attached": True, **mgr.as_dict()}
+                        self._send(200, json.dumps(body),
+                                   "application/json")
                     elif path == "/traces":
                         self._send(200,
                                    json.dumps(_tracing.export_ring()),
@@ -290,15 +338,23 @@ class MetricsServer:
                     pass
 
         self._health_extra = health_extra
+        self._alerts = alerts
         self._delta = DeltaRates()
         self._httpd = http.server.ThreadingHTTPServer((host, port),
                                                       _Handler)
         self._httpd.daemon_threads = True
+        # the ACTUALLY-BOUND address: with port=0 the kernel picks an
+        # ephemeral port, so callers must read it back from here
         self.host, self.port = self._httpd.server_address[:2]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name="paddle-tpu-metrics-http", daemon=True)
         self._thread.start()
+
+    @property
+    def address(self):
+        """``(host, port)`` as actually bound."""
+        return (self.host, self.port)
 
     def url(self, path="/metrics"):
         return f"http://{self.host}:{self.port}{path}"

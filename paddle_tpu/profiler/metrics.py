@@ -39,7 +39,8 @@ import threading
 import time
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
-           "histogram", "snapshot", "dump", "reset", "registry"]
+           "histogram", "snapshot", "dump", "reset", "registry",
+           "thread_compile_seconds"]
 
 
 # -- histogram exemplars ---------------------------------------------------
@@ -271,6 +272,14 @@ class Registry:
         return {name: m._snap() for name, m in items
                 if prefix is None or name.startswith(prefix)}
 
+    def kinds(self, prefix=None):
+        """{name: instrument class} for registered metrics — the public
+        way for consumers (export.DeltaRates) to tell counters from
+        gauges without reaching into registry internals."""
+        with self._lock:
+            return {name: type(m) for name, m in self._metrics.items()
+                    if prefix is None or name.startswith(prefix)}
+
     def dump(self, path=None, prefix=None):
         """Human-readable table; optionally also written to ``path`` as
         JSON for machine consumption. The JSON envelope carries a
@@ -323,6 +332,20 @@ reset = registry.reset
 
 _monitoring_installed = False
 
+# per-thread cumulative backend-compile seconds: XLA compiles run
+# synchronously on the dispatching thread, so a delta of THIS value
+# around a dispatch attributes exactly the compiles that dispatch
+# triggered — unlike the process-global histogram sum, which would
+# bill a concurrent engine's compile to whoever read the delta
+# (profiler/accounting.py relies on this for per-request billing)
+_thread_compile = threading.local()
+
+
+def thread_compile_seconds():
+    """Cumulative backend-compile seconds observed on the calling
+    thread (0.0 where the jax.monitoring listener is unavailable)."""
+    return getattr(_thread_compile, "seconds", 0.0)
+
 
 def _install_jax_monitoring():
     """Fold jax's own compile events into the registry. Idempotent; the
@@ -346,6 +369,8 @@ def _install_jax_monitoring():
             if event.endswith("backend_compile_duration"):
                 c_count.inc()
                 h_secs.observe(duration)
+                _thread_compile.seconds = getattr(
+                    _thread_compile, "seconds", 0.0) + duration
             elif event.endswith("jaxpr_trace_duration"):
                 c_trace.inc()
 

@@ -73,6 +73,17 @@ class RequestHandle:
         with self._engine._lock:
             return list(self._req.generated)
 
+    def cost(self):
+        """This request's :class:`~paddle_tpu.profiler.accounting.
+        CostReport` — queue/prefill/decode/compile split of the device
+        time attributed to it, token and prefix-coverage counts, and
+        (once terminal) deadline_met. A detached snapshot, safe to keep;
+        None when accounting is disarmed
+        (``FLAGS_serving_accounting=0``)."""
+        with self._engine._lock:
+            c = self._req.cost
+            return c.clone() if c is not None else None
+
     def cancel(self):
         self._engine.cancel(self)
 
@@ -114,14 +125,15 @@ class ServingEngine:
                  max_seq_len=2048, num_blocks=None, temperature=0.0,
                  eos_token_id=None, dtype=None,
                  prefill_token_budget=None, max_queue=None,
-                 bucket_cap=None, prefix_cache=None, background=True):
+                 bucket_cap=None, prefix_cache=None, accounting=None,
+                 background=True):
         self._sched = Scheduler(
             model, max_batch=max_batch, block_size=block_size,
             max_seq_len=max_seq_len, num_blocks=num_blocks,
             temperature=temperature, eos_token_id=eos_token_id,
             dtype=dtype, prefill_token_budget=prefill_token_budget,
             max_queue=max_queue, bucket_cap=bucket_cap,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, accounting=accounting)
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._background = background
@@ -192,6 +204,19 @@ class ServingEngine:
     def cache(self):
         return self._sched.cache
 
+    @property
+    def accounting(self):
+        """The engine's cost accountant (profiler/accounting.py): the
+        null accountant when disarmed. ``engine.accounting.
+        engine_report()`` / ``.goodput_line()`` aggregate goodput."""
+        return self._sched.accounting
+
+    @property
+    def alerts(self):
+        """The engine's AlertManager (None when accounting is
+        disarmed); also served from the MetricsServer's /alerts."""
+        return self._sched.alerts
+
     def step(self):
         """Run one scheduling iteration (foreground mode, or extra
         nudges in background mode)."""
@@ -231,14 +256,18 @@ class ServingEngine:
         (idempotent; closed with the engine). Routes: ``/metrics``
         (OpenMetrics text), ``/metrics/delta`` (per-second rates),
         ``/healthz`` (SLO gauges + engine liveness — 503 once the
-        driver died or the engine closed), ``/traces`` and
+        driver died or the engine closed), ``/alerts`` (SLO burn-rate
+        incidents from this engine's AlertManager), ``/traces`` and
         ``/traces/<id>`` (Chrome/Perfetto span exports). ``port=0``
-        picks a free port; read ``.port`` on the returned server."""
+        (the default) binds an ephemeral port — ALWAYS read the bound
+        one from ``.port``/``.url()`` on the returned server instead of
+        hardcoding (multi-replica routers discover replicas this way)."""
         with self._lock:
             if self._metrics_server is None:
                 from ..profiler.export import MetricsServer
                 self._metrics_server = MetricsServer(
-                    port=port, host=host, health_extra=self._health_view)
+                    port=port, host=host, health_extra=self._health_view,
+                    alerts=self._sched.alerts)
             return self._metrics_server
 
     def _health_view(self):

@@ -29,6 +29,14 @@ Every request terminates in exactly one of ``DONE`` / ``CANCELLED`` /
 goes to the always-on registry under ``serving.*`` (TTFT / inter-token
 latency histograms, queue/slot/KV-utilization gauges, admitted/decoded/
 preempted counters) and is surfaced by ``profiler.summary()``.
+
+With accounting armed (``FLAGS_serving_accounting``, default on), each
+step's measured wall time is apportioned across the requests that did
+work in it (``profiler/accounting.py``: tokens-proportional, compile
+billed to the triggering request, re-prefill billed to the preemption)
+into per-request ``CostReport``s and engine goodput, and the SLO
+burn-rate alert rules (``profiler/alerts.py``) are evaluated at step
+boundaries.
 """
 
 from __future__ import annotations
@@ -42,6 +50,8 @@ from ..core import flags as flags_mod
 from ..core import resilience
 from ..inference.paged import (CapacityError, PagedKVCache,
                                validate_request)
+from ..profiler import accounting as _accounting
+from ..profiler import alerts as _alerts
 from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
 from .bucketing import bucket_length
@@ -75,7 +85,7 @@ class ServingRequest:
                  "on_token", "on_finish", "status", "generated", "slot",
                  "preempts", "admit_seq", "submitted_at", "admitted_at",
                  "first_token_at", "last_token_at", "cancel_requested",
-                 "span")
+                 "span", "cost")
 
     def __init__(self, rid, prompt, max_new_tokens, deadline=None,
                  on_token=None, on_finish=None):
@@ -98,6 +108,8 @@ class ServingRequest:
         # root span of this request's trace: opened at submit, ended at
         # the terminal status; the null span when unsampled/disabled
         self.span = _tracing.NULL
+        # CostReport bound by the accountant at submit; None disarmed
+        self.cost = None
 
     @property
     def trace_id(self):
@@ -136,6 +148,12 @@ _g_util = _metrics.gauge("serving.kv.utilization")
 _m_prefix_computed = _metrics.counter("serving.prefix.computed_tokens")
 _g_shared = _metrics.gauge("serving.kv.shared_blocks")
 _g_cached = _metrics.gauge("serving.kv.cached_blocks")
+# per-THREAD cumulative backend-compile seconds (profiler.metrics'
+# jax.monitoring listener): deltas around a prefill/decode dispatch
+# attribute compile cost to the request that triggered it — a
+# concurrent engine's compile on another thread never leaks into this
+# scheduler's bills (profiler/accounting.py)
+_compile_s = _metrics.thread_compile_seconds
 
 
 class Scheduler:
@@ -145,7 +163,7 @@ class Scheduler:
                  max_seq_len=2048, num_blocks=None, temperature=0.0,
                  eos_token_id=None, dtype=None,
                  prefill_token_budget=None, max_queue=None,
-                 bucket_cap=None, prefix_cache=None):
+                 bucket_cap=None, prefix_cache=None, accounting=None):
         import jax.numpy as jnp
 
         cfg = model.config
@@ -176,6 +194,18 @@ class Scheduler:
         self.prefix_cache = (
             bool(flags_mod.flag("FLAGS_serving_prefix_cache"))
             if prefix_cache is None else bool(prefix_cache))
+        # cost attribution (profiler/accounting.py): read ONCE at
+        # construction like prefix_cache; disarmed = the preallocated
+        # null accountant, every hook a no-op — behavior byte-for-byte
+        # pre-accounting (tools/accounting_gate.py pins both)
+        armed = (bool(flags_mod.flag("FLAGS_serving_accounting"))
+                 if accounting is None else bool(accounting))
+        self.accounting = _accounting.Accountant(config=cfg) if armed \
+            else _accounting.NULL
+        # SLO burn-rate alert rules ride with accounting: evaluated at
+        # step boundaries (rate-limited by FLAGS_alert_interval_s) and
+        # served from the /alerts endpoint when serve_metrics attaches
+        self.alerts = _alerts.AlertManager() if armed else None
         self.queue: list[ServingRequest] = []
         self.running: dict[int, ServingRequest] = {}  # slot -> request
         self.finished: dict[int, ServingRequest] = {}  # rid -> request
@@ -207,6 +237,7 @@ class Scheduler:
         req.span = _tracing.start_trace(
             "serving.request", rid=req.rid, prompt_len=len(prompt),
             max_new_tokens=int(max_new_tokens))
+        self.accounting.attach(req)
         self.queue.append(req)
         _g_queue.set(len(self.queue))
         return req
@@ -227,12 +258,20 @@ class Scheduler:
         """One iteration: sweep -> admit -> decode. Returns the list of
         (rid, token) emitted this step (prefill first tokens included)."""
         t0 = time.monotonic()
+        self.accounting.step_begin()
         self._sweep()
         out = self._admit()
         out += self._decode()
         _m_steps.inc()
-        _h_step.observe((time.monotonic() - t0) * 1e6)
+        step_us = (time.monotonic() - t0) * 1e6
+        _h_step.observe(step_us)
+        # apportion this step's wall time across the requests that did
+        # work in it (profiler/accounting.py) BEFORE the gauges so the
+        # capacity view and the attribution agree on the step boundary
+        self.accounting.step_end(step_us)
         self._update_gauges()
+        if self.alerts is not None:
+            self.alerts.maybe_evaluate()
         return out
 
     def run_to_completion(self):
@@ -327,8 +366,10 @@ class Scheduler:
                     _h_queue_wait.observe(wait_us)
                 _tracing.record_span("serving.queue_wait", req.span,
                                      wait_us)
+                self.accounting.note_queue_wait(req, wait_us)
             self.running[slot] = req
             _m_admitted.inc()
+            comp0 = _compile_s()  # compile billed to THIS request
             if covered:
                 tail_start = plan.tail_start
                 pad_to = bucket_length(ids_len - tail_start, bs,
@@ -356,6 +397,13 @@ class Scheduler:
             if plan is not None:
                 _m_prefix_computed.inc(pad_to)
                 self.cache.commit_prefix(slot, plan)
+            # the prefill note carries only the COMPUTED (padded tail)
+            # tokens — covered prefix tokens are free in the
+            # apportionment, re-prefill bills to the preemption event
+            self.accounting.note_prefill(
+                req, pad_to, covered,
+                (_compile_s() - comp0) * 1e6,
+                reprefill=req.preempts > 0)
             self._last_tok[slot] = tok
             self._remaining[slot] = \
                 req.max_new_tokens - len(req.generated) - 1
@@ -422,11 +470,13 @@ class Scheduler:
         active = np.zeros((self.cache.max_batch,), bool)
         for slot in self.running:
             active[slot] = True
+        comp0 = _compile_s()  # decode compiles split across the batch
         t_dec = time.perf_counter_ns()
         toks = np.asarray(self.model.paged_decode_step(
             self.cache, np.asarray(self._last_tok), active,
             temperature=self.temperature))
         dec_us = (time.perf_counter_ns() - t_dec) / 1000.0
+        self.accounting.note_decode_compile((_compile_s() - comp0) * 1e6)
         out = []
         for slot, req in list(self.running.items()):
             t = int(toks[slot])
@@ -437,6 +487,7 @@ class Scheduler:
             _tracing.record_span("serving.decode_step", req.span,
                                  dec_us, token=len(req.generated),
                                  batch=len(self.running))
+            self.accounting.note_decode(req)
             self._emit(req, t)
             out.append((req.rid, t))
             self._maybe_finish(slot)
@@ -496,6 +547,7 @@ class Scheduler:
             self.running.pop(req.slot, None)
             req.slot = -1
         req.status = status
+        self.accounting.on_finish(req, status)
         _tracing.record_span("serving.terminal", req.span, 0.0,
                              terminal=status,
                              tokens=len(req.generated))
@@ -534,3 +586,6 @@ class Scheduler:
         _g_util.set(round(used / usable, 4) if usable else 0.0)
         _g_shared.set(self.cache.num_shared_blocks())
         _g_cached.set(self.cache.num_cached_blocks())
+        # armed accounting also keeps the occupancy-breakdown gauges
+        # (active/free/pool-bytes) + throttled HBM sampling fresh
+        self.accounting.update_capacity(self.cache)

@@ -1,0 +1,561 @@
+"""Goodput observatory: per-request cost attribution, capacity
+accounting, SLO burn-rate alerts, telemetry-export satellites.
+
+Pins the attribution contract (docs/OBSERVABILITY.md "Cost attribution
+& goodput"): per-step attributed time + directly-billed compile + idle
+sums to the measured step time (the closure property) — including
+steps with preemption and prefix-cache hits; re-prefill bills to the
+preemption event; covered tokens bill at extend-only cost;
+``FLAGS_serving_accounting=0`` reverts to pre-accounting behavior.
+Plus the alert rules (stall fires exactly once per episode), the
+DeltaRates counter-reset clamp, and the MetricsServer ephemeral-port
+contract.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import Llama, LlamaConfig
+from paddle_tpu.profiler import accounting, alerts, export, metrics
+from paddle_tpu.serving import ServingEngine
+
+
+@pytest.fixture(autouse=True)
+def _no_trace_pollution():
+    """Accounting tests drive compile-heavy serving traffic whose big
+    TTFTs would otherwise become the registry's max-value-ever
+    exemplars and outlive the span ring — poisoning the later
+    test_tracing exemplar-resolution pins (order-dependent). Tracing
+    is orthogonal to everything asserted here, so run untraced."""
+    saved = paddle.get_flags(["FLAGS_trace_enable"])
+    paddle.set_flags({"FLAGS_trace_enable": False})
+    yield
+    paddle.set_flags(saved)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _prompts(seed, sizes):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 255, (s,)).astype("int64") for s in sizes]
+
+
+def _assert_closure(acct, min_steps=1):
+    """Every logged step: attributed + compile + idle == measured."""
+    assert len(acct.step_log) >= min_steps
+    for rec in acct.step_log:
+        parts = rec["attributed_us"] + rec["compile_us"] + rec["idle_us"]
+        assert abs(parts - rec["step_us"]) <= \
+            max(1e-6 * rec["step_us"], 0.01), rec
+
+
+# -- attribution invariants ---------------------------------------------
+
+
+def test_closure_and_cost_report_basics(model):
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, bucket_cap=32, background=False)
+    hs = [eng.submit(p, max_new_tokens=5)
+          for p in _prompts(0, [5, 9, 12])]
+    eng.drain()
+    _assert_closure(eng.accounting, min_steps=3)
+    total_attr = 0.0
+    for h in hs:
+        c = h.cost()
+        assert h.status == "DONE" and c.status == "DONE"
+        assert c.tokens_emitted == 5
+        assert c.tokens_decoded == 4          # first token from prefill
+        assert c.tokens_prefilled >= 5        # padded to the bucket
+        assert c.queue_us >= 0 and c.ttft_us > 0
+        assert c.deadline_met is True         # DONE without a deadline
+        assert c.attributed_us > 0
+        # steps counts SCHEDULER steps, not notes: a request that
+        # prefills and decodes in one step bills one step; here each
+        # request sees its prefill step + one step per later decode
+        assert c.steps <= 1 + c.tokens_decoded
+        assert c.attributed_us == pytest.approx(
+            c.prefill_us + c.decode_us + c.compile_us + c.reprefill_us)
+        total_attr += c.attributed_us
+    # per-request attribution sums to the engine's attributed totals
+    acct = eng.accounting
+    assert total_attr == pytest.approx(
+        acct.attributed_us + acct.compile_us, rel=1e-6)
+    eng.close()
+
+
+def test_closure_across_preemption_and_reprefill_billing(model):
+    before = metrics.snapshot("serving.")["serving.preempt"]
+    eng = ServingEngine(model, max_batch=2, block_size=4, max_seq_len=32,
+                        num_blocks=8, temperature=0.0, background=False,
+                        prefix_cache=False)
+    hs = [eng.submit(p, max_new_tokens=12) for p in _prompts(1, [8, 8])]
+    eng.drain()
+    assert metrics.snapshot("serving.")["serving.preempt"] - before >= 1
+    _assert_closure(eng.accounting, min_steps=5)
+    victim = max(hs, key=lambda h: h.preempts)
+    c = victim.cost()
+    assert victim.preempts >= 1 and c.preempts >= 1
+    # the re-prefill is billed to the preemption, not to prefill_us
+    assert c.reprefill_us > 0
+    assert eng.accounting.reprefill_us > 0
+    other = min(hs, key=lambda h: h.preempts)
+    if other.preempts == 0:
+        assert other.cost().reprefill_us == 0
+    eng.close()
+
+
+def test_prefix_hits_billed_extend_only(model):
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, bucket_cap=32, background=False)
+    rng = np.random.default_rng(2)
+    system = rng.integers(0, 255, (24,)).astype("int64")
+    mk = lambda: np.concatenate(  # noqa: E731
+        [system, rng.integers(0, 255, (3,)).astype("int64")])
+    cold = eng.submit(mk(), max_new_tokens=4)
+    eng.drain()
+    warm = eng.submit(mk(), max_new_tokens=4)
+    eng.drain()
+    cc, wc = cold.cost(), warm.cost()
+    assert cc.covered_tokens == 0
+    assert wc.covered_tokens == 24            # the three shared chunks
+    # extend-only billing: the warm prefill note carries only the
+    # bucketed tail, not the covered prefix
+    assert wc.tokens_prefilled < cc.tokens_prefilled
+    assert wc.tokens_prefilled <= 8
+    _assert_closure(eng.accounting, min_steps=2)
+    eng.close()
+
+
+def test_flag_off_reverts_and_cost_none(model):
+    acc_before = metrics.snapshot("accounting.")
+    eng_on = ServingEngine(model, max_batch=2, block_size=8,
+                           max_seq_len=64, temperature=0.0,
+                           bucket_cap=32, background=False)
+    eng_off = ServingEngine(model, max_batch=2, block_size=8,
+                            max_seq_len=64, temperature=0.0,
+                            bucket_cap=32, background=False,
+                            accounting=False)
+    p = _prompts(3, [7])[0]
+    h_on = eng_on.submit(p, max_new_tokens=6)
+    eng_on.drain()
+    acc_mid = metrics.snapshot("accounting.")
+    h_off = eng_off.submit(p, max_new_tokens=6)
+    eng_off.drain()
+    acc_after = metrics.snapshot("accounting.")
+    # identical tokens either way; disarmed engine: cost() None, null
+    # accountant, no alert manager, and NOT ONE accounting counter moved
+    assert h_on.tokens() == h_off.tokens()
+    assert h_on.cost() is not None and h_off.cost() is None
+    assert eng_off.accounting is accounting.NULL
+    assert not eng_off.accounting.armed and eng_on.accounting.armed
+    assert eng_off.alerts is None and eng_on.alerts is not None
+    assert acc_mid != acc_before          # armed engine did account
+    assert acc_after == acc_mid           # disarmed engine was silent
+    assert eng_off.accounting.engine_report() is None
+    assert "disarmed" in eng_off.accounting.goodput_line()
+    eng_on.close()
+    eng_off.close()
+
+
+def test_flag_routing(model):
+    paddle.set_flags({"FLAGS_serving_accounting": False})
+    try:
+        eng = ServingEngine(model, max_batch=1, block_size=8,
+                            max_seq_len=64, temperature=0.0,
+                            background=False)
+        assert eng.accounting is accounting.NULL
+        eng.close()
+    finally:
+        paddle.set_flags({"FLAGS_serving_accounting": True})
+    eng = ServingEngine(model, max_batch=1, block_size=8, max_seq_len=64,
+                        temperature=0.0, background=False)
+    assert eng.accounting.armed
+    eng.close()
+
+
+def test_goodput_report_and_deadline_miss(model):
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, bucket_cap=32, background=False)
+    ok = eng.submit(_prompts(4, [6])[0], max_new_tokens=4,
+                    deadline_s=300.0)
+    eng.drain()
+    # an already-expired deadline: TIMEOUT at the first sweep
+    late = eng.submit(_prompts(4, [6])[0], max_new_tokens=4,
+                      deadline_s=0.0)
+    time.sleep(0.01)
+    eng.drain()
+    assert ok.status == "DONE" and late.status == "TIMEOUT"
+    assert ok.cost().deadline_met is True
+    assert late.cost().deadline_met is False
+    # a deadline-LESS cancel is not goodput but is NOT a deadline miss
+    missed_before = eng.accounting.missed_tokens
+    gone = eng.submit(_prompts(4, [6])[0], max_new_tokens=30)
+    eng.step()
+    gone.cancel()
+    eng.drain()
+    assert gone.status == "CANCELLED" and len(gone.tokens()) > 0
+    assert gone.cost().deadline_met is None
+    assert eng.accounting.missed_tokens == missed_before
+    # ...and neither is a cancel whose (generous) deadline never passed
+    gone2 = eng.submit(_prompts(4, [6])[0], max_new_tokens=30,
+                       deadline_s=600.0)
+    eng.step()
+    gone2.cancel()
+    eng.drain()
+    assert gone2.status == "CANCELLED"
+    assert gone2.cost().deadline_met is None
+    assert eng.accounting.missed_tokens == missed_before
+    rep = eng.accounting.engine_report()
+    assert rep["goodput_tokens"] == len(ok.tokens())
+    assert rep["tokens_per_device_s"] > 0
+    assert rep["goodput_tokens_per_device_s"] <= \
+        rep["tokens_per_device_s"]
+    assert rep["device_s"] > 0
+    assert rep["mfu"] is None or 0 < rep["mfu"] < 1
+    line = eng.accounting.goodput_line()
+    assert "deadline-met tok/s" in line
+    eng.close()
+
+
+def test_flops_and_peak_helpers():
+    cfg = LlamaConfig.tiny()
+    p = accounting.matmul_params(cfg)
+    # hand count: 2 layers * (qo: 2*64*64, kv: 2*64*2*16, mlp: 3*64*128)
+    # + lm head 256*64
+    per_layer = 2 * 64 * 64 + 2 * 64 * 2 * 16 + 3 * 64 * 128
+    assert p == 2 * per_layer + 256 * 64
+    assert accounting.flops_per_token(cfg) == 2.0 * p
+    assert accounting.matmul_params(object()) is None
+    assert accounting.flops_per_token(object()) is None
+
+
+# -- capacity accounting ------------------------------------------------
+
+
+def test_capacity_gauges_and_occupancy(model):
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, bucket_cap=32, background=False)
+    eng.submit(_prompts(5, [9])[0], max_new_tokens=4)
+    eng.drain()
+    occ = eng.cache.occupancy()
+    assert occ["active"] + occ["cached_free"] + occ["free"] == \
+        occ["usable"]
+    snap = metrics.snapshot("serving.kv.")
+    assert snap["serving.kv.active_blocks"] == occ["active"]
+    assert snap["serving.kv.free_blocks"] == occ["free"]
+    assert snap["serving.kv.pool_bytes"] == eng.cache.pool_bytes()
+    assert eng.cache.pool_bytes() > 0
+    eng.close()
+
+
+def test_capacity_view_gates_on_armed_accounting():
+    from paddle_tpu.profiler import _capacity_view
+
+    # serving ran but accounting never stepped (disarmed run in a
+    # fresh process): the view must NOT render a bogus all-zero pool
+    assert _capacity_view({"serving.steps": 5}) == []
+    assert _capacity_view({"accounting.steps": 5}) == []
+    rendered = _capacity_view({
+        "serving.steps": 5, "accounting.steps": 5,
+        "serving.kv.active_blocks": 3, "serving.kv.free_blocks": 10,
+        "serving.kv.cached_blocks": 1, "serving.kv.shared_blocks": 0})
+    assert any("kv.active_blocks" in ln for ln in rendered)
+
+
+def test_mfu_runs_on_processed_tokens():
+    cfg = LlamaConfig.tiny()
+    acct = accounting.Accountant(config=cfg, peak_flops=1e12)
+
+    class _Req:
+        rid = 0
+        cost = None
+        generated = []
+        preempts = 0
+        deadline = None
+        first_token_at = None
+        submitted_at = 0.0
+
+    req = _Req()
+    acct.attach(req)
+    acct.step_begin()
+    # one prefill computing 64 padded tokens, emitting 1
+    acct.note_prefill(req, 64, 0, 0.0, reprefill=False)
+    acct.step_end(1e6)  # exactly one device-second
+    rep = acct.engine_report()
+    assert rep["tokens"] == 1 and rep["tokens_processed"] == 64
+    # MFU counts the COMPUTED tokens' FLOPs, not the single emitted one
+    expect = 64 * accounting.flops_per_token(cfg) / 1e12
+    assert rep["mfu"] == pytest.approx(expect, rel=1e-6)
+
+
+def test_summary_sections_render(model):
+    import paddle_tpu.profiler as profiler
+
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, bucket_cap=32, background=False)
+    eng.submit(_prompts(6, [5])[0], max_new_tokens=3)
+    eng.drain()
+    eng.close()
+    s = profiler.Profiler(timer_only=True).summary()
+    assert "Capacity View" in s
+    assert "Goodput" in s
+    assert "kv.active_blocks" in s
+    assert "goodput tokens/device-s" in s
+
+
+# -- alert rules --------------------------------------------------------
+
+
+def _quiet_window(mgr):
+    """Prime/flush the manager's delta window so the next evaluate sees
+    only what the test does."""
+    mgr.evaluate()
+    time.sleep(0.02)
+
+
+def test_stall_fires_exactly_once_per_episode():
+    mgr = alerts.AlertManager()
+    g_run = metrics.gauge("serving.slots.running")
+    c_dec = metrics.counter("serving.decoded_tokens")
+    c_steps = metrics.counter("serving.steps")
+    prev = g_run.value
+    try:
+        _quiet_window(mgr)
+        g_run.set(2)
+        c_steps.inc()  # the scheduler IS stepping; decode is not
+        time.sleep(0.02)
+        first = mgr.evaluate()
+        assert any(i["rule"] == "decode.stall" for i in first)
+        c_steps.inc()
+        time.sleep(0.02)
+        second = mgr.evaluate()  # episode continues: no re-fire
+        assert not any(i["rule"] == "decode.stall" for i in second)
+        assert any(i["rule"] == "decode.stall" for i in mgr.active())
+        c_dec.inc(5)             # progress resumes -> resolve
+        c_steps.inc()
+        time.sleep(0.02)
+        mgr.evaluate()
+        assert not any(i["rule"] == "decode.stall"
+                       for i in mgr.active())
+        hist = [i for i in mgr.history() if i["rule"] == "decode.stall"]
+        assert len(hist) == 1 and "resolved" in hist[0]
+        # a NEW stall episode (stepping continues, progress stops) fires
+        # a NEW incident
+        c_steps.inc()
+        time.sleep(0.02)
+        refire = mgr.evaluate()
+        assert any(i["rule"] == "decode.stall" for i in refire)
+    finally:
+        g_run.set(prev)
+        time.sleep(0.02)
+        mgr.evaluate()
+
+
+def test_ttft_burn_fires_and_resolves():
+    mgr = alerts.AlertManager()
+    h = metrics.histogram("serving.ttft_us")
+    saved = paddle.get_flags(["FLAGS_slo_ttft_budget_us"])
+    paddle.set_flags({"FLAGS_slo_ttft_budget_us": 50000})
+    try:
+        _quiet_window(mgr)
+        for _ in range(10):
+            h.observe(4_000_000.0)  # way over budget
+        time.sleep(0.02)
+        fired = mgr.evaluate()
+        assert any(i["rule"] == "slo.ttft_burn" for i in fired)
+        inc = next(i for i in fired if i["rule"] == "slo.ttft_burn")
+        assert inc["value"] >= 1.0 and "burn" in inc["detail"]
+        # a quiet window (few/no samples) resolves
+        time.sleep(0.02)
+        mgr.evaluate()
+        assert not any(i["rule"] == "slo.ttft_burn"
+                       for i in mgr.active())
+        # all-fast traffic never fires
+        _quiet_window(mgr)
+        for _ in range(10):
+            h.observe(10.0)
+        time.sleep(0.02)
+        assert not any(i["rule"] == "slo.ttft_burn"
+                       for i in mgr.evaluate())
+        # a budget BETWEEN bucket bounds snaps UP (here 150000 ->
+        # 250000): in-SLO observations at 120ms must not read as burn
+        paddle.set_flags({"FLAGS_slo_ttft_budget_us": 150000})
+        _quiet_window(mgr)
+        for _ in range(10):
+            h.observe(120000.0)
+        time.sleep(0.02)
+        assert not any(i["rule"] == "slo.ttft_burn"
+                       for i in mgr.evaluate())
+    finally:
+        paddle.set_flags(saved)
+
+
+def test_queue_growth_rule():
+    mgr = alerts.AlertManager()
+    g = metrics.gauge("serving.queue.depth")
+    prev = g.value
+    try:
+        g.set(0)
+        _quiet_window(mgr)
+        g.set(64)  # deep AND grew over the window
+        time.sleep(0.02)
+        fired = mgr.evaluate()
+        assert any(i["rule"] == "queue.growth" for i in fired)
+        g.set(2)   # shallow again -> resolves
+        time.sleep(0.02)
+        mgr.evaluate()
+        assert not any(i["rule"] == "queue.growth"
+                       for i in mgr.active())
+    finally:
+        g.set(prev)
+
+
+def test_alert_emits_flight_record_once():
+    from paddle_tpu.distributed import watchdog
+
+    mgr = alerts.AlertManager()
+    g_run = metrics.gauge("serving.slots.running")
+    prev = g_run.value
+    try:
+        _quiet_window(mgr)
+        g_run.set(1)
+        metrics.counter("serving.steps").inc()
+        time.sleep(0.02)
+        n0 = sum(1 for r in watchdog.flight_recorder().records()
+                 if r["tag"] == "alert.decode.stall")
+        mgr.evaluate()
+        metrics.counter("serving.steps").inc()
+        time.sleep(0.02)
+        mgr.evaluate()  # still stalled: NO second record
+        n1 = sum(1 for r in watchdog.flight_recorder().records()
+                 if r["tag"] == "alert.decode.stall")
+        assert n1 == n0 + 1
+    finally:
+        g_run.set(prev)
+        time.sleep(0.02)
+        mgr.evaluate()
+
+
+def test_maybe_evaluate_rate_limited():
+    mgr = alerts.AlertManager()
+    mgr.evaluate()
+    saved = paddle.get_flags(["FLAGS_alert_interval_s"])
+    paddle.set_flags({"FLAGS_alert_interval_s": 3600.0})
+    try:
+        before = mgr._last
+        assert mgr.maybe_evaluate() == []
+        assert mgr._last == before  # no evaluation happened
+        # race-free under the lock too: an explicit min_interval makes
+        # the second of two back-to-back evaluations a no-op instead of
+        # a dt~0 window that would spuriously resolve active incidents
+        mgr.evaluate()
+        mid = mgr._last
+        assert mgr.evaluate(min_interval=3600.0) == []
+        assert mgr._last == mid
+    finally:
+        paddle.set_flags(saved)
+
+
+def test_alerts_endpoint(model):
+    eng = ServingEngine(model, max_batch=1, block_size=8, max_seq_len=64,
+                        temperature=0.0, background=False)
+    eng.submit(_prompts(7, [5])[0], max_new_tokens=3)
+    eng.drain()
+    srv = eng.serve_metrics()
+    body = json.loads(urllib.request.urlopen(
+        srv.url("/alerts"), timeout=10).read())
+    assert body["attached"] is True
+    assert {r["name"] for r in body["rules"]} == {
+        "slo.ttft_burn", "slo.itl_burn", "queue.growth", "decode.stall"}
+    assert isinstance(body["active"], list)
+    assert isinstance(body["history"], list)
+    eng.close()
+    # a bare server without a manager says so instead of 404ing
+    with export.MetricsServer() as bare:
+        body = json.loads(urllib.request.urlopen(
+            bare.url("/alerts"), timeout=10).read())
+        assert body["attached"] is False and body["rules"] == []
+        assert body["window_s"] is None  # same shape as when attached
+
+
+# -- DeltaRates satellites ----------------------------------------------
+
+
+def test_delta_rates_clamp_counter_reset():
+    c = metrics.counter("acct_test.reset_counter")
+    c.inc(100)
+    d = export.DeltaRates("acct_test.")
+    d.rates()  # prime
+    c._reset()  # fresh process / metrics.reset() over the same endpoint
+    time.sleep(0.01)
+    r = d.rates()
+    assert r["acct_test.reset_counter"] == 0  # clamped, NOT negative
+    c.inc(7)
+    time.sleep(0.01)
+    assert d.rates()["acct_test.reset_counter"] > 0
+
+
+def test_delta_rates_gauge_keeps_sign():
+    g = metrics.gauge("acct_test.level")
+    g.set(50)
+    d = export.DeltaRates("acct_test.")
+    d.rates()
+    g.set(10)  # gauges legitimately fall: derivative must stay negative
+    time.sleep(0.01)
+    assert d.rates()["acct_test.level"] < 0
+
+
+def test_delta_rates_histogram_buckets_opt_in():
+    h = metrics.histogram("acct_test.lat_us", bounds=(10, 100))
+    h.observe(5)
+    d = export.DeltaRates("acct_test.", include_buckets=True)
+    d.rates()
+    h.observe(5)
+    h.observe(500)
+    time.sleep(0.01)
+    r = d.rates()
+    assert r["acct_test.lat_us.le.10"] > 0
+    assert r["acct_test.lat_us.le.+inf"] > 0
+    assert r["acct_test.lat_us.le.100"] == 0
+    # default flatten stays bucket-free (the /metrics/delta wire shape)
+    d2 = export.DeltaRates("acct_test.")
+    d2.rates()
+    time.sleep(0.01)
+    assert not any(".le." in k for k in d2.rates())
+
+
+# -- MetricsServer ephemeral port (satellite) ---------------------------
+
+
+def test_metrics_server_ephemeral_port():
+    with export.MetricsServer() as a, export.MetricsServer() as b:
+        # port=0 default: kernel-assigned, distinct, and exposed
+        assert a.port > 0 and b.port > 0 and a.port != b.port
+        assert a.address == (a.host, a.port)
+        assert f":{a.port}" in a.url()
+        body = urllib.request.urlopen(a.url("/healthz"),
+                                      timeout=10).read()
+        assert b"status" in body
+
+
+def test_serve_metrics_returns_bound_server(model):
+    eng = ServingEngine(model, max_batch=1, block_size=8, max_seq_len=64,
+                        temperature=0.0, background=False)
+    srv = eng.serve_metrics()          # no hardcoded port anywhere
+    assert srv.port > 0
+    assert srv is eng.serve_metrics()  # idempotent: same server back
+    body = urllib.request.urlopen(srv.url("/metrics"),
+                                  timeout=10).read().decode()
+    assert body.rstrip().endswith("# EOF")
+    eng.close()

@@ -82,3 +82,24 @@ def test_profiler_and_trace_gate_map_to_tracing_tests():
 def test_conftest_change_triggers_smoke():
     t = suite_gate.targets_for(["tests/conftest.py"])
     assert "tests/test_tensor.py" in t
+
+
+def test_accounting_surfaces_map_to_their_tests():
+    t = suite_gate.targets_for(["paddle_tpu/profiler/accounting.py"])
+    assert "tests/framework/test_accounting.py" in t
+    assert "tests/framework/test_serving.py" in t  # scheduler wiring
+    t = suite_gate.targets_for(["paddle_tpu/profiler/alerts.py"])
+    assert "tests/framework/test_accounting.py" in t
+    # any profiler change (export.py, metrics.py) runs the accounting
+    # suite beside the tracing/telemetry pins
+    t = suite_gate.targets_for(["paddle_tpu/profiler/export.py"])
+    assert "tests/framework/test_accounting.py" in t
+    assert "tests/framework/test_tracing.py" in t
+    t = suite_gate.targets_for(["tools/accounting_gate.py"])
+    assert "tests/framework/test_accounting.py" in t
+
+
+def test_regression_ledger_tools_map_to_their_tests():
+    for f in ("tools/bench_ledger.py", "tools/regression_gate.py"):
+        t = suite_gate.targets_for([f])
+        assert "tests/framework/test_regression_ledger.py" in t, f

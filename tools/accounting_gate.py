@@ -1,0 +1,291 @@
+"""Accounting-layer gate: overhead budgets + the attribution contract.
+
+Cost attribution is compiled into the serving scheduler (the null
+accountant when disarmed), so this gate pins what the goodput
+observatory promised, in order of importance:
+
+  1. overhead    — the DISARMED per-step accounting surface (step_begin
+     + a batch of notes + step_end on the null accountant) stays under
+     ``ACCOUNTING_GATE_BUDGET_US`` (a few µs — measured like
+     tools/trace_gate.py measures disarmed spans); the ARMED per-note
+     path stays under ``ACCOUNTING_GATE_ARMED_US``;
+  2. closure     — on a live serving run *with preemption and prefix
+     hits*, every step's attributed + compile + idle time equals the
+     measured step time within epsilon, preempted victims carry
+     ``reprefill_us`` (billed to the preemption, not prefill), and
+     cache-hitting requests are billed extend-only tokens;
+  3. goodput     — the engine report yields a positive
+     tokens-per-device-second and deadline-met goodput, and
+     ``profiler.summary()`` renders the "Capacity View" and "Goodput"
+     sections with live data (capacity rows summing to the pool);
+  4. alerts      — ``/alerts`` serves the rule catalog over HTTP from
+     the engine's MetricsServer, and a forced decode stall fires the
+     stall rule exactly once for the episode;
+  5. ledger      — ``tools/regression_gate.py --self-test`` proves the
+     synthetic-regression detector, then the FULL measure-compare-
+     append mode runs against the real ledger (the automated path that
+     catches a genuine TTFT/headline regression).
+
+Budgets are env-overridable (ACCOUNTING_GATE_*). Exit 0 on pass, 1 on
+fail; one line per check. Runs under JAX_PLATFORMS=cpu (tier-1); wired
+into tools/suite_gate.py beside the serving/trace gates.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# one timing harness for every gate's overhead budget — a drifted copy
+# would make trace/accounting budgets silently non-comparable
+from trace_gate import _med_us  # noqa: E402
+
+BUDGET_US = float(os.environ.get("ACCOUNTING_GATE_BUDGET_US", "5"))
+ARMED_US = float(os.environ.get("ACCOUNTING_GATE_ARMED_US", "75"))
+# closure epsilon: relative to the step plus an absolute float floor
+EPS_REL = 1e-6
+EPS_ABS_US = 0.01
+
+
+def measure_disarmed_us():
+    """Median cost of one DISARMED per-step accounting surface: what
+    every scheduler step pays when FLAGS_serving_accounting=0. Shared
+    with tools/regression_gate.py's measurement corpus."""
+    from paddle_tpu.profiler import accounting
+
+    null = accounting.NULL
+
+    class _Req:  # the attributes the hooks would touch if they ran
+        cost = None
+        generated = ()
+
+    req = _Req()
+
+    def one_step():
+        null.step_begin()
+        null.note_decode(req)
+        null.note_decode(req)
+        null.note_decode_compile(0.0)
+        null.step_end(123.0)
+
+    return _med_us(one_step, 20_000)
+
+
+def check_overhead():
+    from paddle_tpu.profiler import accounting
+    from paddle_tpu.models import LlamaConfig
+
+    off_us = measure_disarmed_us()
+
+    acct = accounting.Accountant(config=LlamaConfig.tiny())
+
+    class _Req:
+        rid = 0
+        cost = None
+        generated = ()
+        preempts = 0
+
+    req = _Req()
+    acct.attach(req)
+
+    def one_armed_step():
+        acct.step_begin()
+        acct.note_decode(req)
+        acct.note_decode(req)
+        acct.step_end(123.0)
+
+    on_us = _med_us(one_armed_step, 5_000)
+    ok = off_us < BUDGET_US and on_us < ARMED_US
+    print(f"[accounting-gate] overhead: disarmed step={off_us:.3f}us "
+          f"(budget {BUDGET_US}us) armed step={on_us:.2f}us "
+          f"(budget {ARMED_US}us) {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def _serve_workload():
+    """A contended, cache-hitting workload: shared system prompt (prefix
+    hits), a tight pool (preemption), mixed deadlines."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Llama, LlamaConfig
+    from paddle_tpu.serving import ServingEngine
+
+    paddle.seed(0)
+    model = Llama(LlamaConfig.tiny())
+    model.eval()
+    rng = np.random.default_rng(0)
+
+    # phase 1: tight pool -> preemption + re-prefill
+    eng = ServingEngine(model, max_batch=2, block_size=4, max_seq_len=32,
+                        num_blocks=8, temperature=0.0, background=False,
+                        prefix_cache=False)
+    p = [rng.integers(0, 255, (8,)).astype("int64") for _ in range(2)]
+    h_pre = [eng.submit(pi, max_new_tokens=12) for pi in p]
+    eng.drain()
+    eng.close()
+
+    # phase 2: shared prefix -> hits billed extend-only
+    eng2 = ServingEngine(model, max_batch=2, block_size=8,
+                         max_seq_len=64, temperature=0.0,
+                         background=False, bucket_cap=32)
+    system = rng.integers(0, 255, (24,)).astype("int64")
+    import numpy as _np
+    cold = eng2.submit(_np.concatenate(
+        [system, rng.integers(0, 255, (3,)).astype("int64")]),
+        max_new_tokens=4, deadline_s=300.0)
+    eng2.drain()
+    warm = eng2.submit(_np.concatenate(
+        [system, rng.integers(0, 255, (3,)).astype("int64")]),
+        max_new_tokens=4, deadline_s=300.0)
+    eng2.drain()
+    return eng, h_pre, eng2, cold, warm
+
+
+def check_closure(eng, h_pre, eng2, cold, warm):
+    ok = True
+    for tag, acct in (("preempt", eng.accounting),
+                      ("prefix", eng2.accounting)):
+        bad = 0
+        for rec in acct.step_log:
+            parts = (rec["attributed_us"] + rec["compile_us"]
+                     + rec["idle_us"])
+            if abs(parts - rec["step_us"]) > \
+                    max(EPS_REL * rec["step_us"], EPS_ABS_US):
+                bad += 1
+        print(f"[accounting-gate] closure[{tag}]: "
+              f"{len(acct.step_log)} steps, {bad} violations "
+              f"{'PASS' if not bad else 'FAIL'}")
+        ok = ok and not bad and len(acct.step_log) > 0
+    victim = max(h_pre, key=lambda h: h.preempts)
+    vc = victim.cost()
+    reprefill_ok = victim.preempts >= 1 and vc.reprefill_us > 0
+    print(f"[accounting-gate] closure[reprefill]: victim preempts="
+          f"{victim.preempts} reprefill_us={vc.reprefill_us:.1f} "
+          f"{'PASS' if reprefill_ok else 'FAIL'}")
+    cc, wc = cold.cost(), warm.cost()
+    prefix_ok = (wc.covered_tokens > 0
+                 and wc.tokens_prefilled < cc.tokens_prefilled)
+    print(f"[accounting-gate] closure[prefix]: warm covered="
+          f"{wc.covered_tokens} computed={wc.tokens_prefilled} vs "
+          f"cold computed={cc.tokens_prefilled} "
+          f"{'PASS' if prefix_ok else 'FAIL'}")
+    return ok and reprefill_ok and prefix_ok
+
+
+def check_goodput(eng2):
+    import paddle_tpu.profiler as profiler
+
+    rep = eng2.accounting.engine_report()
+    rep_ok = (rep["tokens_per_device_s"] > 0
+              and rep["goodput_tokens"] > 0
+              and rep["goodput_tokens"] <= rep["tokens"])
+    summary = profiler.Profiler(timer_only=True).summary()
+    cap_ok = "Capacity View" in summary and "Goodput" in summary
+    occ = eng2.cache.occupancy()
+    sum_ok = (occ["active"] + occ["cached_free"] + occ["free"]
+              == occ["usable"])
+    ok = rep_ok and cap_ok and sum_ok
+    print(f"[accounting-gate] goodput: "
+          f"{rep['goodput_tokens_per_device_s']:.1f} deadline-met "
+          f"tok/s ({rep['tokens_per_device_s']:.1f} raw), summary "
+          f"sections={cap_ok}, occupancy sums={sum_ok} "
+          f"{'PASS' if ok else 'FAIL'}")
+    print(f"[accounting-gate]   {eng2.accounting.goodput_line()}")
+    return ok
+
+
+def check_alerts(eng2):
+    import json
+    import urllib.request
+
+    from paddle_tpu.profiler import metrics
+
+    srv = eng2.serve_metrics()
+    body = json.loads(urllib.request.urlopen(
+        srv.url("/alerts"), timeout=10).read())
+    rules = {r["name"] for r in body.get("rules", [])}
+    want = {"slo.ttft_burn", "slo.itl_burn", "queue.growth",
+            "decode.stall"}
+    http_ok = body.get("attached") and want <= rules
+    # force a stall episode: live slots, zero decode progress
+    mgr = eng2.alerts
+    mgr.evaluate()  # prime/flush the delta window
+    g = metrics.gauge("serving.slots.running")
+    steps = metrics.counter("serving.steps")
+    prev = g.value
+    g.set(2)
+    steps.inc()  # stepping, not decoding: a livelock, not an idle engine
+    time.sleep(0.05)
+    first = [i["rule"] for i in mgr.evaluate()]
+    steps.inc()
+    time.sleep(0.05)
+    second = [i["rule"] for i in mgr.evaluate()]  # still stalled: no re-fire
+    g.set(prev)
+    metrics.counter("serving.decoded_tokens").inc()  # progress resumes
+    time.sleep(0.05)
+    mgr.evaluate()
+    once = ("decode.stall" in first and "decode.stall" not in second
+            and not any(i["rule"] == "decode.stall"
+                        for i in mgr.active()))
+    ok = bool(http_ok) and once
+    print(f"[accounting-gate] alerts: /alerts rules={sorted(rules)} "
+          f"stall fired-once-per-episode={once} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_ledger():
+    """The detector self-test (synthetic regression MUST be flagged)
+    AND the full measure-compare-append mode against the real ledger —
+    this is the automated path that actually catches a real TTFT/
+    headline regression (docs/PERF.md 'Regression ledger')."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    p = subprocess.run(
+        [sys.executable, os.path.join(here, "regression_gate.py"),
+         "--self-test"], capture_output=True, text=True, timeout=120)
+    print(p.stdout.strip())
+    ok_self = p.returncode == 0
+    p2 = subprocess.run(
+        [sys.executable, os.path.join(here, "regression_gate.py")],
+        capture_output=True, text=True, timeout=300)
+    print(p2.stdout.strip())
+    if p2.returncode != 0 and p2.stderr.strip():
+        print(p2.stderr.strip())
+    ok_real = p2.returncode == 0
+    ok = ok_self and ok_real
+    print(f"[accounting-gate] ledger: self-test rc={p.returncode}, "
+          f"real-tree measure+compare rc={p2.returncode} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def main():
+    # live-data checks run FIRST: the armed-overhead bench loop below
+    # pumps synthetic notes through the registry counters, which would
+    # pollute the Goodput summary the goodput check renders
+    eng, h_pre, eng2, cold, warm = _serve_workload()
+    try:
+        ok2 = check_closure(eng, h_pre, eng2, cold, warm)
+        ok3 = check_goodput(eng2)
+        ok4 = check_alerts(eng2)
+    finally:
+        eng2.close()
+    ok1 = check_overhead()
+    ok5 = check_ledger()
+    if ok1 and ok2 and ok3 and ok4 and ok5:
+        print("[accounting-gate] PASS")
+        return 0
+    print("[accounting-gate] FAIL")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,150 @@
+"""Continuous-bench regression ledger: append-only JSONL of gate/bench
+measurements.
+
+Every BENCH_r0N.json in this repo is a point-in-time snapshot that
+nothing reads across runs — a PR that quietly shaved 10% off the
+headline would sail through review. The ledger fixes that: each
+gate/bench run appends ONE line (wall-clock ts, git SHA, a kind tag,
+and a flat metrics dict) to ``BENCH_LEDGER.jsonl``, and
+``tools/regression_gate.py`` compares the current run against the
+median of the last N same-kind entries with per-metric tolerances.
+
+Append-only by design: entries are never rewritten, a malformed line is
+skipped on read (a crashed writer must not poison history), and two
+processes appending concurrently each land a complete line (single
+``write`` of one line under O_APPEND semantics).
+
+CLI::
+
+    python tools/bench_ledger.py --show 10                # recent entries
+    python tools/bench_ledger.py --kind mybench \
+        --metrics '{"tokens_per_s": 37826.5}'             # append one
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+DEFAULT_PATH = os.path.join(REPO, "BENCH_LEDGER.jsonl")
+
+__all__ = ["append_entry", "entries", "last", "git_sha",
+           "bench_headline", "DEFAULT_PATH"]
+
+
+def git_sha(repo=REPO):
+    """Short HEAD sha, or 'unknown' outside a repo / without git."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=repo,
+            capture_output=True, text=True, timeout=10)
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else "unknown"
+    except Exception:  # noqa: BLE001 — ledger must work without git
+        return "unknown"
+
+
+def append_entry(kind, metrics, *, path=None, meta=None):
+    """Append one ledger line; returns the entry dict. ``metrics`` must
+    be a flat {name: number} dict (that is what the regression gate can
+    take medians over); non-numeric values are kept but ignored by
+    comparisons."""
+    entry = {"ts": time.time(),
+             "iso": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+             "git_sha": git_sha(),
+             "kind": str(kind),
+             "metrics": dict(metrics)}
+    if meta:
+        entry["meta"] = dict(meta)
+    line = json.dumps(entry, sort_keys=True)
+    with open(path or DEFAULT_PATH, "a") as f:
+        f.write(line + "\n")
+    return entry
+
+
+def entries(path=None, kind=None):
+    """Every parseable entry, oldest first (malformed lines skipped —
+    the ledger outlives crashed writers)."""
+    path = path or DEFAULT_PATH
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(e, dict) or "metrics" not in e:
+                continue
+            if kind is not None and e.get("kind") != kind:
+                continue
+            out.append(e)
+    return out
+
+
+def last(n=8, kind=None, path=None):
+    """The most recent ``n`` entries (oldest of them first)."""
+    return entries(path, kind)[-n:]
+
+
+def bench_headline(repo=REPO):
+    """The newest cached bench headline (tokens/s/chip, MFU, step time)
+    from the BENCH_r*.json round files — constant between bench runs,
+    so ledger medians pin it and any PR that moves it trips the
+    regression gate. {} when no bench file parses."""
+    best, best_round = None, -1
+    for p in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        try:
+            rnd = int(os.path.basename(p)[len("BENCH_r"):-len(".json")])
+            with open(p) as f:
+                parsed = json.load(f).get("parsed") or {}
+        except (ValueError, OSError):
+            continue
+        if "value" in parsed and rnd > best_round:
+            best, best_round = parsed, rnd
+    if not best:
+        return {}
+    out = {"headline_tokens_per_s": float(best["value"])}
+    if isinstance(best.get("mfu"), (int, float)):
+        out["headline_mfu"] = float(best["mfu"])
+    if isinstance(best.get("step_time_ms"), (int, float)):
+        out["headline_step_time_ms"] = float(best["step_time_ms"])
+    return out
+
+
+def main(argv):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--kind")
+    ap.add_argument("--metrics", help="flat JSON dict to append")
+    ap.add_argument("--path", default=None)
+    ap.add_argument("--show", nargs="?", const=10, type=int,
+                    default=None, help="print the last N entries")
+    args = ap.parse_args(argv)
+    if args.show is not None:
+        for e in last(args.show, args.kind, args.path):
+            print(json.dumps(e, sort_keys=True))
+        return 0
+    if args.kind and args.metrics:
+        e = append_entry(args.kind, json.loads(args.metrics),
+                         path=args.path)
+        print(f"bench-ledger: appended {e['kind']}@{e['git_sha']} "
+              f"({len(e['metrics'])} metrics)")
+        return 0
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

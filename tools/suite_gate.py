@@ -63,9 +63,15 @@ _MAP = [
     ("paddle_tpu/optimizer/", ["tests/optimizer"]),
     ("paddle_tpu/vision/", ["tests/vision"]),
     ("paddle_tpu/amp/", ["tests/amp", "tests/test_amp.py"]),
+    ("paddle_tpu/profiler/accounting.py",
+     ["tests/framework/test_accounting.py",
+      "tests/framework/test_serving.py"]),
+    ("paddle_tpu/profiler/alerts.py",
+     ["tests/framework/test_accounting.py"]),
     ("paddle_tpu/profiler/", ["tests/framework/test_profiler_protobuf.py",
                               "tests/framework/test_telemetry.py",
-                              "tests/framework/test_tracing.py"]),
+                              "tests/framework/test_tracing.py",
+                              "tests/framework/test_accounting.py"]),
     ("paddle_tpu/jit/", ["tests/jit"]),
     ("bench.py", []),   # bench has no pytest surface; exercised by driver
     ("tools/metrics_gate.py", ["tests/framework/test_metrics_gate.py"]),
@@ -78,6 +84,11 @@ _MAP = [
     ("tools/serving_gate.py", ["tests/framework/test_serving.py"]),
     ("tools/prefix_gate.py", ["tests/framework/test_prefix_cache.py"]),
     ("tools/trace_gate.py", ["tests/framework/test_tracing.py"]),
+    ("tools/accounting_gate.py", ["tests/framework/test_accounting.py"]),
+    ("tools/bench_ledger.py",
+     ["tests/framework/test_regression_ledger.py"]),
+    ("tools/regression_gate.py",
+     ["tests/framework/test_regression_ledger.py"]),
     ("tools/", []),
 ]
 # smoke that always runs when any paddle_tpu source changed
@@ -157,7 +168,39 @@ def run_gate(files):
               "Fix the tests or bypass explicitly with SUITE_GATE=0.")
         return 1
     print(f"suite-gate: green in {dt:.0f}s")
+    if not _regression_hook(dt, len(targets)):
+        return 1
     return 0
+
+
+def _regression_hook(wall_s, n_targets):
+    """Continuous-bench ledger wiring (tools/regression_gate.py): every
+    green gate run (1) proves the synthetic-regression detector via
+    --self-test — pure python, milliseconds, and BLOCKING: a commit
+    must not break the tooling that audits the next one — and (2)
+    appends this run's wall time to BENCH_LEDGER.jsonl, comparing
+    against the median of comparable runs (ADVISORY only: the target
+    set varies per diff). REGRESSION_GATE=0 skips both."""
+    if os.environ.get("REGRESSION_GATE") == "0":
+        return True
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.join(HERE, "regression_gate.py"),
+             "--self-test"], capture_output=True, text=True, timeout=120)
+        if p.returncode != 0:
+            print(p.stdout.strip())
+            print(p.stderr.strip())  # import/crash tracebacks land here
+            print("suite-gate: regression_gate --self-test FAILED — "
+                  "the regression detector itself is broken; commit "
+                  "blocked (bypass with REGRESSION_GATE=0)")
+            return False
+        sys.path.insert(0, HERE)
+        import regression_gate
+        regression_gate.record_suite(wall_s, n_targets)
+    except Exception as e:  # noqa: BLE001 — ledger trouble is advisory
+        print(f"suite-gate: ledger hook skipped ({type(e).__name__}: "
+              f"{e})")
+    return True
 
 
 _HOOK = """#!/bin/sh
