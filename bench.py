@@ -253,8 +253,64 @@ def bench_eager(tag="eager"):
         "eager_train_steps_per_s": round(steps / dt, 2),
     }
     out["defer_depth_curve_ops_per_s"] = _defer_depth_curve()
+    out["async_flush_ab_ms"] = _async_flush_ab()
     out["dispatch_breakdown_us"] = _dispatch_breakdown()
     out.update(_eager_vs_jit_budget())
+    _ledger_eager(out)
+    return out
+
+
+def _ledger_eager(out):
+    """Append the eager-gap trajectory to BENCH_LEDGER.jsonl (kind
+    ``eager_gap``): tools/regression_gate.py medians these with
+    direction-aware tolerances (ratio regresses UP, ops/s regresses
+    DOWN), so any PR that reopens the gap trips the gate. Advisory on
+    failure — the bench must print its line even without a writable
+    ledger."""
+    try:
+        import os
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import bench_ledger
+        bench_ledger.append_entry("eager_gap", {
+            k: out[k] for k in (
+                "eager_elementwise_ops_per_s", "eager_train_steps_per_s",
+                "eager_over_jit_ratio", "eager_tiny_gpt_step_ms")
+            if isinstance(out.get(k), (int, float))})
+    except Exception:  # noqa: BLE001 — ledger trouble is advisory
+        pass
+
+
+def _async_flush_ab(n=384):
+    """Async-vs-sync cap-flush A/B on the SAME dependent chain: wall
+    time of a loop that crosses DEFER_CAP several times, with the flush
+    worker pipelining chain execution under host capture vs
+    ``FLAGS_deferred_async=0`` inline flushes. The measured delta is
+    the PR-10 overlap win (the programs are identical by the partition
+    contract; only who waits changes)."""
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((256, 256), np.float32))
+    out = {}
+    for mode, flag in (("async", True), ("sync", False)):
+        prior = paddle.get_flags("FLAGS_deferred_async")[
+            "FLAGS_deferred_async"]
+        try:
+            paddle.set_flags({"FLAGS_deferred_async": flag})
+            y = x  # warm the chain-structure jit caches for this mode
+            for _ in range(n):
+                y = y * 1.0001 + 0.0001
+            _sync(y.sum())
+            t0 = time.perf_counter()
+            y = x
+            for _ in range(n):
+                y = y * 1.0001 + 0.0001
+            _sync(y.sum())
+            out[mode] = round((time.perf_counter() - t0) * 1e3, 3)
+        finally:
+            paddle.set_flags({"FLAGS_deferred_async": prior})
+    out["speedup"] = round(out["sync"] / out["async"], 3) \
+        if out.get("async") else None
     return out
 
 

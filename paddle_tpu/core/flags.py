@@ -116,6 +116,29 @@ define_flag("FLAGS_deferred_passes",
             "between capture and jit — smaller programs, canonical jit "
             "cache keys; PADDLE_TPU_PASSES=0 (or this flag) reverts to "
             "the verbatim capture-order compile")
+define_flag("FLAGS_deferred_fusion",
+            os.environ.get("PADDLE_TPU_FUSION", "1").lower()
+            not in ("0", "false", "off", "no"),
+            "extend the deferred-chain pass pipeline with the fusion "
+            "tier (paddle_tpu/passes: batch identical distinct-leaf "
+            "subtrees into one call, fuse single-consumer elementwise "
+            "runs into super-nodes); keys the jit cache under the "
+            "disjoint passes/v2 namespace so fused forms canonicalize; "
+            "PADDLE_TPU_FUSION=0 (or this flag) keeps the cleanup-only "
+            "passes/v1 pipeline")
+define_flag("FLAGS_deferred_async", True,
+            "async deferred-chain flush (core/deferred.py): a chain "
+            "hitting DEFER_CAP is submitted to the flush worker and its "
+            "outputs become futures resolved lazily at host reads, so "
+            "the host keeps capturing the next chain while the previous "
+            "one compiles/executes; failures degrade to the synchronous "
+            "ladder (async -> sync verbatim -> eager replay); 0 reverts "
+            "to fully synchronous flushes byte-for-byte", type=bool)
+define_flag("FLAGS_deferred_inflight", 4,
+            "bounded in-flight window for async deferred flushes: at "
+            "most this many submitted-unfinished chains before "
+            "submission blocks (backpressure, counted "
+            "deferred.async.window_full); min 1")
 define_flag("FLAGS_embedding_deterministic", 0,
             "deterministic embedding grad accumulation")
 define_flag("FLAGS_cudnn_deterministic", False,

@@ -143,9 +143,11 @@ class Tensor:
         return t
 
     def _meta(self):
-        """(shape, dtype) without materializing a deferred chain."""
+        """(shape, dtype) without materializing a deferred chain — or
+        resolving an async-flushed one (a non-array pending value is a
+        ChainFuture; the declared meta is exact by construction)."""
         pend = self._pending
-        if pend is not None and pend.value is None:
+        if pend is not None and not isinstance(pend.value, jax.Array):
             return pend.shape, pend.dtype
         return self._data.shape, self._data.dtype
 

@@ -12,15 +12,20 @@ flush to the verbatim (unoptimized) compile path.
 """
 
 from .ir import CONST, LEAF, NODE, Graph, GraphNode  # noqa: F401
+from .batch import (BatchedFn, BatchIdenticalSubtrees,  # noqa: F401
+                    BatchSlice)
 from .canon import Canonicalize  # noqa: F401
 from .cse import HashConsCSE  # noqa: F401
 from .dce import DeadCodeElim  # noqa: F401
 from .fold import ConstantFold  # noqa: F401
+from .fuse import FusedFn, FuseElementwise  # noqa: F401
 from .manager import (PassError, PassManager, default_manager,  # noqa: F401
                       default_passes)
 
 __all__ = [
     "CONST", "LEAF", "NODE", "Graph", "GraphNode",
     "Canonicalize", "ConstantFold", "HashConsCSE", "DeadCodeElim",
+    "BatchIdenticalSubtrees", "BatchedFn", "BatchSlice",
+    "FuseElementwise", "FusedFn",
     "PassError", "PassManager", "default_manager", "default_passes",
 ]

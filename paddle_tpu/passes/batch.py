@@ -1,0 +1,346 @@
+"""Batch structurally-identical independent subtrees into one call.
+
+CSE merges duplicated subtrees over the SAME inputs; this pass handles
+the sibling case it cannot touch — the same op tower applied to
+DIFFERENT leaves (``(a*2).tanh() + (b*2).tanh()``, a loop body mapped
+over per-branch tensors). N structurally identical, disjoint,
+leaf-rooted subtrees become ONE batched super-node that stacks each
+abstract input slot across the members along a fresh leading axis, runs
+the tower ONCE on the stacked arrays, and N cheap slice nodes that hand
+each member its lane back.
+
+Exactness: every member op is elementwise over its lane — stacking adds
+a leading batch axis that no op reduces over or reassociates across, so
+lane ``k`` of the batched computation applies the same ops to the same
+values as member ``k`` did, element for element (0-d inputs are
+reshaped to ``(N, 1, ..., 1)`` so they broadcast per-lane exactly as a
+scalar broadcasts per-member). IEEE ops are value-deterministic per
+element, so the results are bitwise identical.
+
+A subtree qualifies as a member only when:
+
+- every op is CORRECTLY ROUNDED per IEEE 754 (add/sub/mul/div/sqrt,
+  sign ops, min/max, rounding ops): their per-element result is a
+  function of the element value alone, independent of array extent.
+  Approximated transcendentals (exp, tanh, sigmoid, ...) are EXCLUDED
+  — XLA:CPU lowers them to vectorized polynomials whose scalar
+  remainder loop can round the last elements differently than the
+  vector body, so the same value in a ``(N, *S)`` stacked array and an
+  ``S`` member array may differ by 1 ulp (measured on exp). Fusing
+  them would break the bitwise contract;
+- every argument is a LEAF or a CONST (towers over concrete inputs —
+  node-boundary inputs would need static shape info the IR doesn't
+  carry) and every leaf exposes ``shape``/``dtype``;
+- every interior node has exactly one consumer, inside the subtree, and
+  is not a flush output (the root may be consumed anywhere);
+- abstract input slots agree in shape and dtype across members, and
+  CONST references agree by index (const values ride as jit arguments;
+  a differing const slot is a different structure);
+- members are pairwise disjoint.
+
+Groups need >= 2 members and >= 2 nodes per member — below that the
+stack/slice overhead buys nothing.
+"""
+
+from __future__ import annotations
+
+from .ir import CONST, LEAF, NODE, GraphNode
+
+_BATCH_TAG = "__batch1__"
+_SLICE_TAG = "__bslice1__"
+
+_EXACT_FNS = None
+
+
+def _exact_fns():
+    """The correctly-rounded op set (see module docstring): batching is
+    bitwise-safe only for ops whose per-element result cannot depend on
+    vectorization extent. Built lazily (jnp ufunc singletons — the same
+    identity-matching discipline as canon)."""
+    global _EXACT_FNS
+    if _EXACT_FNS is None:
+        import jax.numpy as jnp
+        _EXACT_FNS = frozenset({
+            jnp.add, jnp.subtract, jnp.multiply, jnp.divide, jnp.sqrt,
+            jnp.negative, jnp.abs, jnp.sign, jnp.maximum, jnp.minimum,
+            jnp.floor, jnp.ceil, jnp.trunc, jnp.round, jnp.square,
+        })
+    return _EXACT_FNS
+
+
+class BatchedFn:
+    """Runs ``ops`` (the shared tower, args referencing ("slot", s),
+    ("val", m) member results or ("const", c) shared 0-d constants) over
+    ``n_members`` lanes. Positional args are slot-major member leaves
+    (``args[s * n + k]`` = member k's array for slot s) followed by the
+    shared const arrays; each slot is stacked on a fresh leading axis,
+    0-d slots reshaped to broadcast per-lane (a shared 0-d const
+    broadcasts over ``(n, *S)`` as-is — identical per lane); returns
+    the stacked tower output (shape ``(n, *S)``)."""
+
+    __slots__ = ("ops", "n_members", "n_slots", "scalar_slots", "rank",
+                 "__name__")
+
+    def __init__(self, ops, n_members, n_slots, scalar_slots, rank):
+        self.ops = tuple(ops)
+        self.n_members = n_members
+        self.n_slots = n_slots
+        self.scalar_slots = frozenset(scalar_slots)
+        self.rank = rank
+        self.__name__ = f"batched[{n_members}x{len(self.ops)}]"
+
+    def __call__(self, *args):
+        import jax.numpy as jnp
+        n = self.n_members
+        cargs = args[self.n_slots * n:]
+        slots = []
+        for s in range(self.n_slots):
+            stacked = jnp.stack(args[s * n:(s + 1) * n])
+            if s in self.scalar_slots and self.rank:
+                stacked = stacked.reshape((n,) + (1,) * self.rank)
+            slots.append(stacked)
+        vals = []
+        for fn, spec, kw in self.ops:
+            argv = [slots[ix] if kind == "slot" else
+                    vals[ix] if kind == "val" else cargs[ix]
+                    for kind, ix in spec]
+            vals.append(fn(*argv, **kw))
+        return vals[-1]
+
+    def __repr__(self):
+        return f"BatchedFn(members={self.n_members}, ops={len(self.ops)})"
+
+
+class BatchSlice:
+    """Member ``k``'s lane of a batched super-node output."""
+
+    __slots__ = ("k", "__name__")
+
+    def __init__(self, k):
+        self.k = k
+        self.__name__ = f"bslice[{k}]"
+
+    def __call__(self, stacked):
+        return stacked[self.k]
+
+    def __repr__(self):
+        return f"BatchSlice({self.k})"
+
+
+def _consumers(graph):
+    n = len(graph.nodes)
+    count = [0] * n
+    for node in graph.nodes:
+        for kind, ix in node.args:
+            if kind == NODE:
+                count[ix] += 1
+    out_nodes = {ix for kind, ix in graph.outputs if kind == NODE}
+    return count, out_nodes
+
+
+def _subtree(graph, root, count, out_nodes):
+    """Member candidate rooted at ``root``: (sorted node indices) or
+    None when an interior node is shared/output or an arg is a NODE
+    boundary. Leaf-rooted towers only (see module docstring)."""
+    nodes = graph.nodes
+    exact = _exact_fns()
+    members, stack = set(), [root]
+    while stack:
+        i = stack.pop()
+        if i in members:
+            continue
+        if nodes[i].fn not in exact or nodes[i].kwargs:
+            return None  # not bitwise-safe under a batch axis
+        if i != root and (count[i] != 1 or i in out_nodes):
+            return None
+        members.add(i)
+        for kind, ix in nodes[i].args:
+            if kind == NODE:
+                if ix not in members:
+                    stack.append(ix)
+    # interior single-consumer + reachability-from-root together imply
+    # the consumer IS a member: the edge that discovered the node
+    return tuple(sorted(members))
+
+
+def _signature(graph, members, root):
+    """(key, slot_refs): the pattern abstracts LEAF refs to occurrence
+    slots (stacked per member) and CONST refs to shared const slots
+    whose GRAPH index is part of the key (consts are deduped by value
+    repr at linearize time, so index equality pins value equality —
+    members adding different scalars never batch together); slot_refs
+    lists the actual leaf indices in occurrence order. None when a leaf
+    has no shape/dtype."""
+    local = {j: m for m, j in enumerate(members)}
+    pattern, slot_refs, slot_meta = [], [], []
+    const_refs, const_slot = [], {}
+    for j in members:
+        node = graph.nodes[j]
+        spec = []
+        for kind, ix in node.args:
+            if kind == NODE:
+                spec.append(("val", local[ix]))
+            elif kind == CONST:
+                c = const_slot.get(ix)
+                if c is None:
+                    c = const_slot[ix] = len(const_refs)
+                    const_refs.append(ix)
+                spec.append(("const", c))
+            else:
+                leaf = graph.leaves[ix]
+                shape = getattr(leaf, "shape", None)
+                dtype = getattr(leaf, "dtype", None)
+                if shape is None or dtype is None:
+                    return None
+                s = len(slot_refs)
+                slot_refs.append(ix)
+                slot_meta.append((tuple(shape), str(dtype)))
+                spec.append(("slot", s))
+        try:
+            pattern.append((node.node_key, tuple(spec)))
+        except TypeError:
+            return None
+    return (tuple(pattern), tuple(slot_meta), tuple(const_refs)), \
+        tuple(slot_refs)
+
+
+class BatchIdenticalSubtrees:
+    """metric: passes.batch.merged (member subtrees merged beyond the
+    first of each group)"""
+
+    name = "batch"
+    metric_name = "passes.batch.merged"
+
+    def run(self, graph):
+        nodes = graph.nodes
+        if len(nodes) < 4:  # 2 members x 2 nodes minimum
+            return graph, 0
+        count, out_nodes = _consumers(graph)
+        # cheap O(n) pre-filter: a bottom-up structural hash with leaves
+        # abstracted — two batchable subtrees MUST collide here, so any
+        # root with a unique hash skips the expensive signature build
+        # (a linear chain's prefixes all differ in size, so the common
+        # eager shape pays one hash per node and nothing else)
+        exact = _exact_fns()
+        sh = []
+        for node in nodes:
+            if node.fn not in exact or node.kwargs:
+                sh.append(None)  # poisons every subtree containing it
+                continue
+            marks = []
+            for kind, ix in node.args:
+                if kind == NODE:
+                    marks.append(sh[ix])
+                elif kind == CONST:
+                    marks.append(("C", ix))
+                else:
+                    marks.append("L")
+            if None in marks:
+                sh.append(None)
+                continue
+            try:
+                sh.append(hash((node.node_key, tuple(marks))))
+            except TypeError:
+                sh.append(None)
+        freq = {}
+        for h in sh:
+            if h is not None:
+                freq[h] = freq.get(h, 0) + 1
+        groups = {}   # sig -> [(root, members, slot_refs)]
+        for root in range(len(nodes)):
+            if sh[root] is None or freq[sh[root]] < 2:
+                continue
+            sub = _subtree(graph, root, count, out_nodes)
+            if sub is None or len(sub) < 2:
+                continue
+            sig = _signature(graph, sub, root)
+            if sig is None:
+                continue
+            key, slot_refs = sig
+            try:
+                hash(key)
+            except TypeError:
+                continue
+            groups.setdefault(key, []).append((root, sub, slot_refs))
+        # deterministic: groups ordered by their first root index;
+        # members claimed greedily, disjoint from anything already taken
+        plans = []
+        taken = set()
+        for key, cands in sorted(groups.items(),
+                                 key=lambda kv: kv[1][0][0]):
+            chosen = []
+            for root, sub, slot_refs in cands:
+                if taken.isdisjoint(sub):
+                    chosen.append((root, sub, slot_refs))
+                    taken.update(sub)
+            if len(chosen) >= 2:
+                plans.append((key, chosen))
+            else:
+                for _, sub, _ in chosen:
+                    taken.difference_update(sub)
+        if not plans:
+            return graph, 0
+
+        merged = 0
+        # rebuild with insertion: batched + slice nodes land at the
+        # FIRST member root's position; all member subtree nodes drop
+        drop, emit_at = set(), {}
+        for key, chosen in plans:
+            for _, sub, _ in chosen:
+                drop.update(sub)
+            emit_at[min(r for r, _, _ in chosen)] = (key, chosen)
+            merged += len(chosen) - 1
+        index_map, alias, new_nodes = {}, {}, []
+
+        def remap(ref):
+            # old-index NODE ref -> new index (member roots to their
+            # slice node); args always point at earlier nodes, so both
+            # maps are complete by the time a consumer is emitted
+            kind, ix = ref
+            if kind != NODE:
+                return ref
+            if ix in alias:
+                return (NODE, alias[ix])
+            return (NODE, index_map[ix])
+
+        for i, node in enumerate(nodes):
+            plan = emit_at.get(i)
+            if plan is not None:
+                key, chosen = plan
+                (pattern, slot_meta, const_refs) = key
+                n = len(chosen)
+                chain_shapes = [s for s, _ in slot_meta if s != ()]
+                rank = len(chain_shapes[0]) if chain_shapes else 0
+                scalar_slots = tuple(s for s, (shp, _)
+                                     in enumerate(slot_meta) if shp == ())
+                ops = []
+                members0 = chosen[0][1]
+                for m, j in enumerate(members0):
+                    node_j = nodes[j]
+                    ops.append((node_j.fn, pattern[m][1], node_j.kwargs))
+                # slot-major args: slot s contributes each member's
+                # leaf, then the shared consts ride once at the end
+                args = []
+                for s in range(len(slot_meta)):
+                    for _, _, slot_refs in chosen:
+                        args.append((LEAF, slot_refs[s]))
+                args.extend((CONST, ix) for ix in const_refs)
+                bnode = GraphNode(
+                    BatchedFn(ops, n, len(slot_meta), scalar_slots,
+                              rank),
+                    (_BATCH_TAG, pattern, slot_meta, const_refs, n),
+                    {}, tuple(args))
+                b_ix = len(new_nodes)
+                new_nodes.append(bnode)
+                for k, (root, _, _) in enumerate(chosen):
+                    snode = GraphNode(BatchSlice(k), (_SLICE_TAG, k), {},
+                                      ((NODE, b_ix),))
+                    alias[root] = len(new_nodes)
+                    new_nodes.append(snode)
+            if i in drop:
+                continue
+            index_map[i] = len(new_nodes)
+            new_nodes.append(node.with_args(remap(a) for a in node.args))
+        return graph.replace(
+            nodes=new_nodes,
+            outputs=tuple(remap(o) for o in graph.outputs)), merged

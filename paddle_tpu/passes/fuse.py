@@ -1,0 +1,142 @@
+"""Elementwise fusion: group contiguous single-consumer runs into one
+fused super-node.
+
+``_eval_chain`` interprets the deferred chain one node at a time — under
+jit TRACING that is one python frame, one ``_fn_key``-sized cache-key
+entry and one argument-resolution list per op. A linear run where every
+intermediate feeds exactly its successor (the dominant eager shape:
+``y = y * a + b`` in a loop) carries no information the boundary nodes
+don't: this pass collapses each maximal such run into a single
+``GraphNode`` whose fn is a :class:`FusedFn` replaying the member ops in
+capture order over the run's external inputs.
+
+Exactness is trivial by construction: the fused fn applies THE SAME ops,
+in THE SAME order, to THE SAME operands — no reassociation, operand
+order pinned — so under jit tracing it emits the identical primitive
+sequence the unfused graph would (the XLA program is equal, hence the
+outputs bitwise equal). What changes is the host side: the graph the
+flush hashes, caches and interprets shrinks from O(chain ops) nodes to
+O(fused regions), and the ``passes/v2`` jit-cache namespace
+canonicalizes across fused forms (a chain and its refused equivalent
+share one key).
+
+Fusion conditions for absorbing node ``j`` into the region ending at its
+successor ``i``:
+
+- ``i`` consumes ``(NODE, j)`` (the run is connected);
+- ``j`` has exactly ONE consumer (nothing outside the region reads it);
+- ``j`` is not a flush output (an owner Tensor needs its value stamped).
+
+Regions of size 1 are left untouched (nothing to win).
+"""
+
+from __future__ import annotations
+
+from .ir import NODE, GraphNode
+
+# structural tag for fused node_keys — versioned so a future change to
+# FusedFn evaluation invalidates old passes/v2 cache keys by key shape
+_FUSE_TAG = "__fuse1__"
+EXT = "ext"
+INT = "int"
+
+
+class FusedFn:
+    """Callable replaying ``ops`` (``(fn, spec, kwargs)`` tuples, spec
+    referencing (EXT, k) external inputs or (INT, m) member results)
+    over positional external inputs; returns the last member's value.
+    Under jit tracing this inlines to exactly the member primitives."""
+
+    __slots__ = ("ops", "__name__")
+
+    def __init__(self, ops):
+        self.ops = tuple(ops)
+        self.__name__ = f"fused[{len(self.ops)}]"
+
+    def __call__(self, *ext):
+        vals = []
+        for fn, spec, kw in self.ops:
+            argv = [ext[ix] if kind == EXT else vals[ix]
+                    for kind, ix in spec]
+            vals.append(fn(*argv, **kw))
+        return vals[-1]
+
+    def __repr__(self):
+        return f"FusedFn(n={len(self.ops)})"
+
+
+def _consumer_stats(graph):
+    """(consumer_count, sole_consumer) per node index; outputs count as
+    an extra (external) consumer so they can never be absorbed."""
+    n = len(graph.nodes)
+    count = [0] * n
+    sole = [None] * n
+    for i, node in enumerate(graph.nodes):
+        for kind, ix in node.args:
+            if kind == NODE:
+                count[ix] += 1
+                sole[ix] = i
+    for kind, ix in graph.outputs:
+        if kind == NODE:
+            count[ix] += 2  # poison: an output is never interior
+    return count, sole
+
+
+class FuseElementwise:
+    """metric: passes.fuse.grouped (nodes absorbed into super-nodes)"""
+
+    name = "fuse"
+    metric_name = "passes.fuse.grouped"
+
+    def run(self, graph):
+        nodes = graph.nodes
+        if len(nodes) < 2:
+            return graph, 0
+        count, sole = _consumer_stats(graph)
+        # maximal single-consumer runs, greedy over topo order
+        regions, cur = [], [0]
+        for i in range(1, len(nodes)):
+            prev = cur[-1]
+            if sole[prev] == i and count[prev] == 1 \
+                    and (NODE, prev) in nodes[i].args:
+                cur.append(i)
+            else:
+                regions.append(cur)
+                cur = [i]
+        regions.append(cur)
+        if all(len(r) == 1 for r in regions):
+            return graph, 0
+        absorbed = 0
+        new_nodes = list(nodes)
+        for region in regions:
+            if len(region) == 1:
+                continue
+            local = {j: m for m, j in enumerate(region)}
+            ext_refs, ext_ix = [], {}
+            ops, keyspec = [], []
+            for j in region:
+                node = nodes[j]
+                spec = []
+                for ref in node.args:
+                    kind, ix = ref
+                    if kind == NODE and ix in local:
+                        spec.append((INT, local[ix]))
+                        continue
+                    k = ext_ix.get(ref)
+                    if k is None:
+                        k = ext_ix[ref] = len(ext_refs)
+                        ext_refs.append(ref)
+                    spec.append((EXT, k))
+                spec = tuple(spec)
+                ops.append((node.fn, spec, node.kwargs))
+                keyspec.append((node.node_key, spec))
+            fused = GraphNode(FusedFn(ops), (_FUSE_TAG, tuple(keyspec)),
+                              {}, tuple(ext_refs))
+            # the super-node takes the LAST member's slot: every external
+            # ref precedes the region (topo), every consumer follows it;
+            # interior members become husks DCE sweeps
+            new_nodes[region[-1]] = fused
+            absorbed += len(region) - 1
+        if not absorbed:
+            return graph, 0
+        return graph.replace(nodes=new_nodes), absorbed

@@ -16,7 +16,11 @@ Default order:
    dead husks, exposes duplicate structure)
 2. ``fold``   — const-only subtrees to folded leaves
 3. ``cse``    — hash-cons merge (benefits from canonical operand order)
-4. ``dce``    — one sweep collects everything the others orphaned
+4. ``batch``  — (fusion tier) identical distinct-leaf subtrees to one
+   batched call (after cse so same-input duplicates are already merged)
+5. ``fuse``   — (fusion tier) single-consumer runs to super-nodes
+   (last rewrite: it collapses the structure batch matches on)
+6. ``dce``    — one sweep collects everything the others orphaned
 
 Per-run cost lands in the ``passes.total_us`` histogram (the gate in
 tools/passes_gate.py budgets it); each pass's rewrite count lands in its
@@ -29,10 +33,12 @@ from __future__ import annotations
 import time
 
 from ..profiler import metrics as _metrics
+from .batch import BatchIdenticalSubtrees
 from .canon import Canonicalize
 from .cse import HashConsCSE
 from .dce import DeadCodeElim
 from .fold import ConstantFold
+from .fuse import FuseElementwise
 
 _C_RUNS = _metrics.counter("passes.runs")
 _C_ERRORS = _metrics.counter("passes.errors")
@@ -71,17 +77,37 @@ class PassManager:
         return graph
 
 
-def default_passes():
-    return [Canonicalize(), ConstantFold(), HashConsCSE(), DeadCodeElim()]
+def default_passes(fusion=False):
+    """The cleanup pipeline, optionally extended with the fusion tier.
+
+    Ordering rationale (docs/PASSES.md): batch runs AFTER cse (CSE
+    merges same-input duplicates first, so batch only sees genuinely
+    distinct-leaf towers — and canonical operand order makes towers
+    structurally comparable) and BEFORE fuse (fusion collapses the
+    per-node structure batch matches on); dce last sweeps every husk
+    the earlier tiers orphaned."""
+    ps = [Canonicalize(), ConstantFold(), HashConsCSE()]
+    if fusion:
+        ps += [BatchIdenticalSubtrees(), FuseElementwise()]
+    ps.append(DeadCodeElim())
+    return ps
 
 
 _DEFAULT = None
+_DEFAULT_FUSION = None
 
 
-def default_manager():
-    """Process-wide manager instance (passes are stateless; a benign
-    construction race just builds an equivalent pipeline)."""
-    global _DEFAULT
+def default_manager(fusion=False):
+    """Process-wide manager instances — one cleanup-only pipeline, one
+    with the fusion tier (passes are stateless; a benign construction
+    race just builds an equivalent pipeline). The flush picks by
+    ``FLAGS_deferred_fusion`` and keys the jit cache ``passes/v2`` for
+    the fusion pipeline so fused and unfused programs never collide."""
+    global _DEFAULT, _DEFAULT_FUSION
+    if fusion:
+        if _DEFAULT_FUSION is None:
+            _DEFAULT_FUSION = PassManager(default_passes(fusion=True))
+        return _DEFAULT_FUSION
     if _DEFAULT is None:
         _DEFAULT = PassManager(default_passes())
     return _DEFAULT

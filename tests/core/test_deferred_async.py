@@ -1,0 +1,263 @@
+"""Async deferred flush (core/deferred.py PR 10): the flush worker, the
+bounded in-flight window, ChainFuture laziness, and the two satellite
+fixes that ride along (true-LRU _JIT_CACHE, thread-local flush cause).
+
+The partition contract is the acceptance pin: async on and off cut the
+op stream into the SAME chains, so flipping ``FLAGS_deferred_async`` is
+byte-for-byte — and with it off, every ``deferred.async.*`` counter is
+silent."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import deferred
+from paddle_tpu.profiler import metrics
+from paddle_tpu.testing import faults
+
+
+def _rand(*s):
+    return np.random.default_rng(0).standard_normal(s).astype("float32")
+
+
+def _delta(before, after, key):
+    return after.get(key, 0) - before.get(key, 0)
+
+
+def _long_loop(x, n=3 * deferred.DEFER_CAP):
+    y = x
+    for _ in range(n):
+        y = y * 1.0001 + 0.0001
+    return y
+
+
+def test_async_crosses_cap_and_matches_sync_bitwise():
+    x = paddle.to_tensor(_rand(16, 16))
+    before = metrics.snapshot("deferred.")
+    on = _long_loop(x).numpy()
+    after = metrics.snapshot("deferred.")
+    assert _delta(before, after, "deferred.async.submitted") >= 2
+    assert _delta(before, after, "deferred.async.resolved") >= 2
+    assert _delta(before, after, "deferred.flush.cap") >= 2
+    paddle.set_flags({"FLAGS_deferred_async": False})
+    try:
+        b2 = metrics.snapshot("deferred.async.")
+        off = _long_loop(x).numpy()
+        a2 = metrics.snapshot("deferred.async.")
+    finally:
+        paddle.set_flags({"FLAGS_deferred_async": True})
+    assert on.tobytes() == off.tobytes(), "async flag must be invisible"
+    # counter silence with the flag off
+    assert all(a2.get(k, 0) == b2.get(k, 0) for k in a2), (b2, a2)
+
+
+def test_future_keeps_meta_lazy():
+    x = paddle.to_tensor(_rand(8, 8))
+    y = _long_loop(x, deferred.DEFER_CAP + 4)
+    # the over-cap segment was submitted; some upstream tensor in the
+    # live chain holds a ChainFuture — meta reads must not resolve it
+    assert y._pending is not None
+    assert y.shape == [8, 8] and y.ndim == 2
+    assert "float32" in str(y.dtype)
+    fut_vals = [v for v in (y._pending,) if v is not None]
+    assert fut_vals  # chain still pending after meta reads
+    y.numpy()
+
+
+def test_window_backpressure_counts_and_completes():
+    x = paddle.to_tensor(_rand(8, 8))
+    prev = paddle.get_flags(["FLAGS_deferred_inflight"])[
+        "FLAGS_deferred_inflight"]
+    paddle.set_flags({"FLAGS_deferred_inflight": 1})
+    try:
+        before = metrics.snapshot("deferred.async.")
+        # delay every worker execution so >1 submissions overlap
+        with faults.inject("deferred.async_exec", nth=1, exc=None,
+                           delay=0.02, count=64):
+            out = _long_loop(x, 4 * deferred.DEFER_CAP).numpy()
+        after = metrics.snapshot("deferred.async.")
+        assert _delta(before, after, "deferred.async.window_full") >= 1
+        assert _delta(before, after, "deferred.async.submitted") >= 3
+    finally:
+        paddle.set_flags({"FLAGS_deferred_inflight": prev})
+    paddle.set_flags({"FLAGS_deferred_async": False})
+    try:
+        ref = _long_loop(x, 4 * deferred.DEFER_CAP).numpy()
+    finally:
+        paddle.set_flags({"FLAGS_deferred_async": True})
+    assert out.tobytes() == ref.tobytes()
+
+
+def test_async_spans_recorded_under_trace():
+    from paddle_tpu.profiler import tracing
+    x = paddle.to_tensor(_rand(8, 8))
+    root = tracing.start_trace("test.async_flush")
+    assert root.recording
+    with root:
+        _long_loop(x).numpy()
+    root.end()
+    names = [r["name"] for r in tracing.get_trace(root.trace_id)]
+    assert "deferred.flush.async" in names, names
+
+
+def test_threaded_async_chains_isolated():
+    """Worker-pipelined chains from several threads never cross
+    streams (the DataLoader pattern, async edition). Sync references
+    are computed UP FRONT — flags are process-global, so flipping
+    FLAGS_deferred_async inside the workers would let one thread's
+    toggle leak into another's supposedly-async run."""
+    arrs, refs = {}, {}
+    paddle.set_flags({"FLAGS_deferred_async": False})
+    try:
+        for seed in range(4):
+            rng = np.random.default_rng(seed)
+            a = rng.standard_normal((8, 8)).astype("float32")
+            arrs[seed] = a
+            z = paddle.to_tensor(a)
+            for _ in range(2 * deferred.DEFER_CAP + 7):
+                z = z * 1.001 + float(seed) * 1e-4
+            refs[seed] = z.numpy()
+    finally:
+        paddle.set_flags({"FLAGS_deferred_async": True})
+    errs = []
+
+    def worker(seed):
+        try:
+            y = paddle.to_tensor(arrs[seed])
+            for _ in range(2 * deferred.DEFER_CAP + 7):
+                y = y * 1.001 + float(seed) * 1e-4
+            if y.numpy().tobytes() != refs[seed].tobytes():
+                raise AssertionError(f"seed {seed} diverged")
+        except Exception as e:  # noqa: BLE001
+            errs.append((seed, e))
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs, errs
+
+
+# ------------------------------------------------ satellite: true-LRU cache
+def test_jit_cache_lru_burst_survival():
+    """A hot chain structure that keeps HITTING must survive a burst of
+    one-shot structures that overflows the cache (the PR 3 _LAZY_FWD
+    treatment, chain-cache edition): FIFO eviction would drop it."""
+    x = paddle.to_tensor(_rand(4, 4))
+    hot = lambda: (x * 0.123).tanh().numpy()  # noqa: E731
+    hot()  # settle the hot entry
+    old_max = deferred._JIT_CACHE_MAX
+    deferred._JIT_CACHE_MAX = 8
+    try:
+        before = metrics.snapshot("deferred.")
+        for i in range(6):  # burst of distinct structures...
+            y = x
+            for k in range(i + 2):
+                y = (y + float(k)).abs()
+            y.numpy()
+            hot()  # ...with the hot chain touched BETWEEN one-shots
+        after = metrics.snapshot("deferred.")
+        # the hot structure never recompiled: every hot() call hit
+        assert _delta(before, after, "deferred.jit_cache.hit") >= 6
+        hot_compiles = _delta(before, after,
+                              "deferred.jit_cache.compiles")
+        assert hot_compiles <= 6 + 2  # one-shots only (+slack for cse)
+        assert _delta(before, after, "deferred.jit_cache.evictions") >= 1
+    finally:
+        deferred._JIT_CACHE_MAX = old_max
+
+
+def test_jit_cache_moves_to_end_on_hit():
+    with deferred._CACHE_LOCK:
+        deferred._JIT_CACHE.clear()
+    x = paddle.to_tensor(_rand(4, 4))
+    (x * 0.5).numpy()
+    first = next(iter(deferred._JIT_CACHE))
+    (x + 0.25).numpy()
+    assert next(iter(deferred._JIT_CACHE)) == first
+    (x * 0.5).numpy()  # hit: moves to MRU end
+    assert next(iter(deferred._JIT_CACHE)) != first
+
+
+# -------------------------------------- satellite: thread-local flush cause
+def test_flush_cause_is_thread_local():
+    """Two threads stamping different causes concurrently must each
+    label their OWN flush — the old module-global slot let a neighbour's
+    stamp leak in."""
+    barrier = threading.Barrier(2)
+    errs = []
+
+    def run(cause, n_ops):
+        try:
+            x = paddle.to_tensor(_rand(4, 4))
+            y = x
+            for _ in range(n_ops):
+                y = y * 1.01
+            barrier.wait()
+            # stamp, then (deterministically) flush on this thread
+            deferred.note_flush_cause(cause)
+            barrier.wait()
+            got = deferred._take_cause()
+            if got != cause:
+                raise AssertionError(
+                    f"cause leaked: wanted {cause}, got {got}")
+            deferred.note_flush_cause(cause)
+            y.numpy()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    t1 = threading.Thread(target=run, args=("op_boundary", 5))
+    t2 = threading.Thread(target=run, args=("cap", 7))
+    t1.start(); t2.start()
+    t1.join(); t2.join()
+    assert not errs, errs
+
+
+def test_flush_cause_weak_stamp_still_yields():
+    deferred.note_flush_cause("cap")
+    deferred.note_flush_cause("op_boundary", weak=True)  # must not win
+    assert deferred._take_cause() == "cap"
+    assert deferred._take_cause() == "data_read"  # reset after take
+    deferred.note_flush_cause("op_boundary", weak=True)
+    assert deferred._take_cause() == "op_boundary"
+
+
+# ----------------------------------------------------- degradation ladder
+def test_async_submit_failure_degrades_to_sync():
+    x = paddle.to_tensor(_rand(8, 8))
+    healthy = _long_loop(x, deferred.DEFER_CAP + 8).numpy()
+    b = metrics.snapshot()
+    with faults.inject("deferred.async_submit", count=8):
+        got = _long_loop(x, deferred.DEFER_CAP + 8).numpy()
+    a = metrics.snapshot()
+    assert got.tobytes() == healthy.tobytes()
+    assert _delta(b, a, "resilience.degrade.flush.async_submit") >= 1
+
+
+def test_async_resolve_failure_replays_sync():
+    x = paddle.to_tensor(_rand(8, 8))
+    healthy = _long_loop(x, deferred.DEFER_CAP + 8).numpy()
+    b = metrics.snapshot()
+    with faults.inject("deferred.async_resolve", count=8):
+        got = _long_loop(x, deferred.DEFER_CAP + 8).numpy()
+    a = metrics.snapshot()
+    assert got.tobytes() == healthy.tobytes()
+    assert _delta(b, a, "resilience.degrade.flush.async_resolve") >= 1
+
+
+def test_async_strict_mode_raises():
+    paddle.set_flags({"FLAGS_flush_degradation": False})
+    try:
+        x = paddle.to_tensor(_rand(8, 8))
+        with faults.inject("deferred.async_submit"):
+            with pytest.raises(faults.FaultInjected):
+                _long_loop(x, deferred.DEFER_CAP + 8).numpy()
+    finally:
+        paddle.set_flags({"FLAGS_flush_degradation": True})
+    # later chains unaffected
+    assert _long_loop(paddle.to_tensor(_rand(4, 4)), 8).numpy() \
+        .shape == (4, 4)

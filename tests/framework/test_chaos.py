@@ -250,6 +250,71 @@ def test_ladder_flight_records():
                for r in watchdog.flight_recorder().records())
 
 
+# -- async flush degradation (PR 10) ---------------------------------------
+
+def _cap_chain():
+    """A dependent loop that crosses DEFER_CAP twice: with async on the
+    over-cap segments go through the flush worker (submit -> exec ->
+    resolve), so every async fault site is on its path. The abs between
+    mul and add keeps the chain contraction-exact (no mul->add pair for
+    XLA to fuse into an FMA), so even the rung-2 eager replay is
+    bitwise (the ladder's documented fidelity caveat never applies)."""
+    x = paddle.to_tensor(_ARR)
+    y = x
+    for i in range(2 * deferred.DEFER_CAP + 9):
+        y = (y * 1.001).abs() + 0.01
+    return y
+
+
+_ASYNC_SITES = ("deferred.async_submit", "deferred.async_exec",
+                "deferred.async_resolve")
+
+
+@pytest.mark.parametrize("site", _ASYNC_SITES)
+def test_async_crash_at_every_site_bitwise(site):
+    """Crash-at-every-async-site matrix: whichever async rung fails —
+    submission, worker execution, host resolution — the recovery path
+    re-executes the SAME captured chains and the result is bitwise
+    identical to the healthy run."""
+    healthy = _cap_chain().numpy()
+    before = metrics.snapshot()
+    with faults.inject(site, count=16):
+        degraded = _cap_chain().numpy()
+    after = metrics.snapshot()
+    assert degraded.tobytes() == healthy.tobytes(), site
+    d = {k: v - before.get(k, 0) for k, v in after.items()
+         if isinstance(v, (int, float))}
+    rung = "async_submit" if site.endswith("submit") \
+        else "async_resolve"
+    assert d.get(f"resilience.degrade.flush.{rung}", 0) >= 1, (site, {
+        k: v for k, v in d.items() if k.startswith("resilience.")})
+
+
+def test_async_exec_crash_then_verbatim_crash_reaches_eager():
+    """Stacked failures walk the whole ladder: worker execution fails,
+    the sync replay's verbatim compile fails too -> eager op-by-op
+    replay, still bitwise (the corpus is contraction-stable)."""
+    healthy = _cap_chain().numpy()
+    before = metrics.snapshot()
+    with faults.inject("deferred.async_exec", count=16):
+        with faults.inject("deferred.compile", count=64):
+            degraded = _cap_chain().numpy()
+    after = metrics.snapshot()
+    assert degraded.tobytes() == healthy.tobytes()
+    d = {k: v - before.get(k, 0) for k, v in after.items()
+         if isinstance(v, (int, float))}
+    assert d.get("resilience.degrade.flush.async_resolve", 0) >= 1
+    assert d.get("resilience.degrade.flush.eager_replay", 0) >= 1
+    assert d.get("deferred.flush.eager_replay", 0) >= 1
+
+
+def test_async_degrades_are_flight_recorded():
+    with faults.inject("deferred.async_submit", count=16):
+        _cap_chain().numpy()
+    assert any(r["tag"] == "degrade/flush.async_submit"
+               for r in watchdog.flight_recorder().records())
+
+
 # -- crash-safe checkpoints ------------------------------------------------
 
 _CRASH_SITES = ("checkpoint.write_shards", "checkpoint.fsync",

@@ -1,0 +1,318 @@
+"""Fusion tier of the pass pipeline (paddle_tpu/passes: fuse + batch).
+
+Same two-layer pinning as test_passes.py: IR-level unit tests build
+``Graph``s directly and check each pass's contract (region selection,
+super-node wiring, batch grouping, the correctly-rounded-op whitelist),
+and public-API property tests assert the tier is invisible —
+``FLAGS_deferred_fusion`` on vs off produce BITWISE-identical results
+while the fused graphs get measurably smaller (counter-pinned), and the
+``passes/v2`` jit-cache namespace canonicalizes across fused forms.
+"""
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import passes
+from paddle_tpu.core import deferred
+from paddle_tpu.passes import (CONST, LEAF, NODE, BatchedFn, BatchSlice,
+                               FusedFn, Graph, GraphNode,
+                               default_manager, default_passes)
+from paddle_tpu.profiler import metrics
+
+
+def _rand(*s):
+    return np.random.default_rng(0).standard_normal(s).astype("float32")
+
+
+@contextlib.contextmanager
+def _flag(name, on):
+    prev = paddle.get_flags([name])[name]
+    paddle.set_flags({name: on})
+    try:
+        yield
+    finally:
+        paddle.set_flags({name: prev})
+
+
+def _both_ways(build):
+    with _flag("FLAGS_deferred_fusion", True):
+        on = build().numpy()
+    with _flag("FLAGS_deferred_fusion", False):
+        off = build().numpy()
+    return on, off
+
+
+def _assert_bitwise(a, b):
+    assert a.dtype == b.dtype and a.shape == b.shape
+    assert a.tobytes() == b.tobytes(), (a, b)
+
+
+def _delta(before, after, key):
+    return after.get(key, 0) - before.get(key, 0)
+
+
+def _n(fn, args, key=None):
+    return GraphNode(fn, key or (getattr(fn, "__name__", str(fn)), ()),
+                     {}, args)
+
+
+# ---------------------------------------------------------------- fuse unit
+def test_fuse_groups_single_consumer_run():
+    l0 = jnp.ones((3,), jnp.float32)
+    g = Graph([_n(jnp.multiply, ((LEAF, 0), (CONST, 0))),
+               _n(jnp.add, ((NODE, 0), (CONST, 1))),
+               _n(jnp.tanh, ((NODE, 1),))],
+              [l0], [2.0, 0.5], [(NODE, 2)], jnp.float32)
+    out, grouped = passes.FuseElementwise().run(g)
+    assert grouped == 2  # 3 nodes -> 1 super-node (+2 husks)
+    fused = out.nodes[2]
+    assert isinstance(fused.fn, FusedFn) and len(fused.fn.ops) == 3
+    assert fused.args == ((LEAF, 0), (CONST, 0), (CONST, 1))
+    swept = passes.DeadCodeElim().run(out)[0]
+    assert len(swept.nodes) == 1
+    swept.validate()
+    # the fused program computes the same values as the unfused graph
+    consts = [jnp.float32(2.0), jnp.float32(0.5)]
+    got = deferred._eval_chain(
+        [(n.fn, n.args, n.kwargs) for n in swept.nodes],
+        swept.leaves, consts)
+    ref = deferred._eval_chain(
+        [(n.fn, n.args, n.kwargs) for n in g.nodes], g.leaves, consts)
+    np.testing.assert_array_equal(np.asarray(got[0]),
+                                  np.asarray(ref[2]))
+
+
+def test_fuse_respects_multi_consumer_and_outputs():
+    l0 = jnp.ones((3,), jnp.float32)
+    # node0 feeds node1 AND node2: not absorbable
+    g = Graph([_n(jnp.tanh, ((LEAF, 0),)),
+               _n(jnp.abs, ((NODE, 0),)),
+               _n(jnp.add, ((NODE, 0), (NODE, 1)))],
+              [l0], [], [(NODE, 2)], jnp.float32)
+    out, grouped = passes.FuseElementwise().run(g)
+    # only the 1->2 edge is single-consumer... node1's sole consumer is
+    # node2 and node2 consumes it: [1,2] fuse, node0 stays
+    assert grouped == 1
+    assert isinstance(out.nodes[2].fn, FusedFn)
+    # an OUTPUT node is never absorbed as an interior member
+    g2 = Graph([_n(jnp.tanh, ((LEAF, 0),)),
+                _n(jnp.abs, ((NODE, 0),))],
+               [l0], [], [(NODE, 0), (NODE, 1)], jnp.float32)
+    out2, grouped2 = passes.FuseElementwise().run(g2)
+    assert grouped2 == 0  # node0 is an output: the run cannot absorb it
+
+
+# --------------------------------------------------------------- batch unit
+def test_batch_merges_identical_towers_and_slices():
+    a = jnp.asarray(_rand(4, 4))
+    b = jnp.asarray(_rand(4, 4) + 1.0)
+    g = Graph([_n(jnp.multiply, ((LEAF, 0), (CONST, 0)), key=("m", ())),
+               _n(jnp.abs, ((NODE, 0),), key=("a", ())),
+               _n(jnp.multiply, ((LEAF, 1), (CONST, 0)), key=("m", ())),
+               _n(jnp.abs, ((NODE, 2),), key=("a", ())),
+               _n(jnp.add, ((NODE, 1), (NODE, 3)), key=("+", ()))],
+              [a, b], [0.5], [(NODE, 4)], jnp.float32)
+    out, merged = passes.BatchIdenticalSubtrees().run(g)
+    assert merged == 1
+    assert isinstance(out.nodes[0].fn, BatchedFn)
+    assert isinstance(out.nodes[1].fn, BatchSlice)
+    assert isinstance(out.nodes[2].fn, BatchSlice)
+    out.validate()
+    got = deferred._eval_chain(
+        [(n.fn, n.args, n.kwargs) for n in out.nodes],
+        out.leaves, [jnp.float32(0.5)])
+    kind, ix = out.outputs[0]
+    ref = np.abs(np.asarray(a) * np.float32(0.5)) \
+        + np.abs(np.asarray(b) * np.float32(0.5))
+    np.testing.assert_array_equal(np.asarray(got[ix]), ref)
+
+
+def test_batch_excludes_approximated_ops():
+    a, b = jnp.asarray(_rand(4, 4)), jnp.asarray(_rand(4, 4) + 1.0)
+    # tanh towers: XLA:CPU polynomial rounding depends on array extent
+    # (the 1-ulp hazard) — the whitelist must keep them unbatched
+    g = Graph([_n(jnp.multiply, ((LEAF, 0), (CONST, 0)), key=("m", ())),
+               _n(jnp.tanh, ((NODE, 0),), key=("t", ())),
+               _n(jnp.multiply, ((LEAF, 1), (CONST, 0)), key=("m", ())),
+               _n(jnp.tanh, ((NODE, 2),), key=("t", ())),
+               _n(jnp.add, ((NODE, 1), (NODE, 3)), key=("+", ()))],
+              [a, b], [0.5], [(NODE, 4)], jnp.float32)
+    out, merged = passes.BatchIdenticalSubtrees().run(g)
+    assert merged == 0
+
+
+def test_batch_requires_matching_const_slots():
+    a, b = jnp.asarray(_rand(4, 4)), jnp.asarray(_rand(4, 4) + 1.0)
+    # same structure, DIFFERENT const index: must not batch (the const
+    # rides shared — a mismatch would compute the wrong member)
+    g = Graph([_n(jnp.multiply, ((LEAF, 0), (CONST, 0)), key=("m", ())),
+               _n(jnp.abs, ((NODE, 0),), key=("a", ())),
+               _n(jnp.multiply, ((LEAF, 1), (CONST, 1)), key=("m", ())),
+               _n(jnp.abs, ((NODE, 2),), key=("a", ())),
+               _n(jnp.add, ((NODE, 1), (NODE, 3)), key=("+", ()))],
+              [a, b], [0.5, 0.25], [(NODE, 4)], jnp.float32)
+    out, merged = passes.BatchIdenticalSubtrees().run(g)
+    assert merged == 0
+
+
+# -------------------------------------------- public-API bitwise properties
+_TOWERS = [
+    lambda a, b: ((a * 0.5 + 0.1).abs() * (b * 0.5 + 0.1).abs()),
+    lambda a, b: ((a * 2.0).tanh() + (b * 2.0).tanh()),
+    lambda a, b: ((a.abs() / 2.0).sqrt() + (b.abs() / 2.0).sqrt()),
+    lambda a, b: ((a * 0.25 - 0.125).square()
+                  + (b * 0.25 - 0.125).square()),
+    lambda a, b: (-(-(a * 1.5))).maximum(b * 1.5) + (a * 1.5).minimum(b),
+]
+
+
+@pytest.mark.parametrize("case", range(len(_TOWERS)))
+def test_fusion_tier_bitwise_equal(case):
+    arr = _rand(7, 5) * 0.4
+    arr[0, 0] = -0.0
+    arr[1, 0] = np.inf
+    arr2 = _rand(7, 5) + 0.5
+
+    def build():
+        return _TOWERS[case](paddle.to_tensor(arr),
+                             paddle.to_tensor(arr2))
+
+    on, off = _both_ways(build)
+    _assert_bitwise(on, off)
+    # and against the fully unoptimized path
+    with _flag("FLAGS_deferred_passes", False):
+        raw = build().numpy()
+    _assert_bitwise(on, raw)
+
+
+def test_deep_chain_fuses_and_matches():
+    arr = _rand(6, 6) * 0.3
+
+    def build():
+        y = paddle.to_tensor(arr)
+        for i in range(20):
+            y = y * 1.01 + 0.5 / (i + 1)
+        return y
+
+    before = metrics.snapshot("passes.")
+    on, off = _both_ways(build)
+    after = metrics.snapshot("passes.")
+    _assert_bitwise(on, off)
+    assert _delta(before, after, "passes.fuse.grouped") >= 15
+
+
+def test_batch_fires_through_public_api():
+    a = paddle.to_tensor(_rand(6, 6))
+    b = paddle.to_tensor(_rand(6, 6) + 1.0)
+    before = metrics.snapshot("passes.")
+    with _flag("FLAGS_deferred_fusion", True):
+        out = ((a * 0.5 + 0.25).abs() + (b * 0.5 + 0.25).abs()).numpy()
+    after = metrics.snapshot("passes.")
+    assert _delta(before, after, "passes.batch.merged") >= 1
+    with _flag("FLAGS_deferred_fusion", False):
+        ref = ((a * 0.5 + 0.25).abs() + (b * 0.5 + 0.25).abs()).numpy()
+    _assert_bitwise(out, ref)
+
+
+def test_fused_call_count_below_unfused_op_count():
+    """The acceptance check: the optimized graph the fused flush
+    compiles has FEWER nodes than the captured op count."""
+    from paddle_tpu.passes import Graph as G
+
+    arr = _rand(5, 5)
+    y = paddle.to_tensor(arr)
+    for i in range(16):
+        y = y * 1.01 + 0.25
+    nodes, leaves, consts = deferred._linearize(y._pending)
+    out_ixs = (len(nodes) - 1,)
+    g = G.from_linearized(nodes, leaves, consts, out_ixs, y._pending.dtype)
+    opt = default_manager(fusion=True).run(g)
+    assert len(opt.nodes) < len(nodes)
+    assert len(opt.nodes) <= 2
+    y.numpy()
+
+
+def test_v2_namespace_canonicalizes_across_fused_forms():
+    """Structurally equal chains from distinct python objects compile
+    ONCE under passes/v2 and hit after — and v1/v2 never collide."""
+    with deferred._CACHE_LOCK:
+        deferred._JIT_CACHE.clear()
+    before = metrics.snapshot("deferred.")
+    with _flag("FLAGS_deferred_fusion", True):
+        for seed in (11, 12):
+            t = paddle.to_tensor(np.random.default_rng(seed)
+                                 .standard_normal((6, 6))
+                                 .astype("float32"))
+            y = t
+            for i in range(8):
+                y = y * 0.9 + 0.125
+            y.numpy()
+    after = metrics.snapshot("deferred.")
+    assert _delta(before, after, "deferred.jit_cache.compiles") == 1
+    assert _delta(before, after, "deferred.jit_cache.hit") == 1
+    assert any(k[0] == "passes/v2" for k in deferred._JIT_CACHE)
+    # the same structure under the cleanup-only pipeline gets its own
+    # (disjoint) v1 entry — one more compile, no cross-namespace hit
+    with _flag("FLAGS_deferred_fusion", False):
+        t = paddle.to_tensor(_rand(6, 6))
+        y = t
+        for i in range(8):
+            y = y * 0.9 + 0.125
+        y.numpy()
+    after2 = metrics.snapshot("deferred.")
+    assert _delta(after, after2, "deferred.jit_cache.compiles") == 1
+    assert any(k[0] == "passes/v1" for k in deferred._JIT_CACHE)
+
+
+def test_fusion_flag_off_counter_silence():
+    a = paddle.to_tensor(_rand(4, 4))
+    before = metrics.snapshot("passes.")
+    with _flag("FLAGS_deferred_fusion", False):
+        y = a
+        for i in range(10):
+            y = y * 1.01 + 0.5
+        y.numpy()
+    after = metrics.snapshot("passes.")
+    assert _delta(before, after, "passes.fuse.grouped") == 0
+    assert _delta(before, after, "passes.batch.merged") == 0
+    assert _delta(before, after, "passes.runs") >= 1  # cleanup still ran
+
+
+def test_default_passes_order():
+    names = [p.name for p in default_passes(fusion=True)]
+    assert names == ["canon", "fold", "cse", "batch", "fuse", "dce"]
+    assert [p.name for p in default_passes()] == \
+        ["canon", "fold", "cse", "dce"]
+
+
+def test_randomized_fusion_property(seed=0):
+    """Randomized chains over the deferrable surface: fusion on vs off
+    bitwise (the PR 2 harness pattern, fusion-tier edition)."""
+    uns = [lambda t: t.tanh(), lambda t: t.abs(), lambda t: t * 0.5,
+           lambda t: t + 0.25, lambda t: t - 0.1, lambda t: t.square(),
+           lambda t: -t, lambda t: t * 1.0, lambda t: t.sigmoid()]
+    bins = [lambda x, y: x + y, lambda x, y: x * y,
+            lambda x, y: x.maximum(y)]
+    rng = np.random.default_rng(77)
+    for trial in range(6):
+        arr = rng.standard_normal((5, 5)).astype("float32") * 0.4
+        arr2 = rng.standard_normal((5, 5)).astype("float32") * 0.4
+        prog = [(int(k), int(i)) for k, i in zip(
+            rng.integers(0, 2, 14), rng.integers(0, 9, 14))]
+
+        def build():
+            vals = [paddle.to_tensor(arr), paddle.to_tensor(arr2)]
+            for k, i in prog:
+                if k == 0:
+                    vals.append(uns[i](vals[-1]))
+                else:
+                    vals.append(bins[i % 3](vals[-1],
+                                            vals[i % len(vals)]))
+            return vals[-1]
+
+        on, off = _both_ways(build)
+        _assert_bitwise(on, off)

@@ -100,6 +100,33 @@ def test_direction_policy():
     assert not regs
 
 
+def test_eager_gap_direction_policy():
+    """PR 10 satellite: the eager-gap trajectory is gate-pinned — the
+    ratio regresses UP (explicit rule: the generic suffixes would not
+    catch it), the ops/s throughput regresses DOWN."""
+    assert regression_gate.direction_and_tol("eager_over_jit_ratio") \
+        == ("up", regression_gate.RATE_TOL)
+    assert regression_gate.direction_and_tol(
+        "eager_elementwise_ops_per_s")[0] == "down"
+    assert regression_gate.direction_and_tol(
+        "eager_tiny_gpt_step_ms")[0] == "up"
+    history = [{"eager_over_jit_ratio": 2.0,
+                "eager_elementwise_ops_per_s": 4000.0}] * 5
+    regs, checked = regression_gate.compare(
+        {"eager_over_jit_ratio": 2.0 * (1 + regression_gate.RATE_TOL)
+         * 1.5,
+         "eager_elementwise_ops_per_s": 4000.0
+         * (1 - regression_gate.RATE_TOL) / 2}, history)
+    assert {r["metric"] for r in regs} == {
+        "eager_over_jit_ratio", "eager_elementwise_ops_per_s"}
+    gap = next(r for r in regs if r["metric"] == "eager_over_jit_ratio")
+    assert gap["direction"] == "up"
+    regs2, _ = regression_gate.compare(
+        {"eager_over_jit_ratio": 1.8,
+         "eager_elementwise_ops_per_s": 4100.0}, history)
+    assert not regs2  # an IMPROVED gap never trips the gate
+
+
 def test_compare_flags_both_directions():
     history = [{"step_ms": 100.0 + i, "tokens_per_s": 1000.0}
                for i in range(5)]

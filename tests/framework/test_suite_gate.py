@@ -103,3 +103,19 @@ def test_regression_ledger_tools_map_to_their_tests():
     for f in ("tools/bench_ledger.py", "tools/regression_gate.py"):
         t = suite_gate.targets_for([f])
         assert "tests/framework/test_regression_ledger.py" in t, f
+
+
+def test_fusion_surfaces_map_to_their_tests():
+    t = suite_gate.targets_for(["paddle_tpu/passes/fuse.py"])
+    assert "tests/framework/test_fusion.py" in t
+    assert "tests/framework/test_passes.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/passes/batch.py"])
+    assert "tests/framework/test_fusion.py" in t
+    # the async flush lives in core/deferred.py: its dedicated suites
+    # plus the chaos ladder run on any touch
+    t = suite_gate.targets_for(["paddle_tpu/core/deferred.py"])
+    assert "tests/core/test_deferred_async.py" in t
+    assert "tests/framework/test_chaos.py" in t
+    t = suite_gate.targets_for(["tools/fusion_gate.py"])
+    assert "tests/framework/test_fusion.py" in t
+    assert "tests/core/test_deferred_async.py" in t

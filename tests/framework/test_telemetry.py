@@ -240,9 +240,24 @@ def test_cap_flush_labeled_cap():
     y.numpy()
     d = _delta(before, metrics.snapshot())
     # the over-cap flush keeps its specific label — the op-boundary
-    # stamp in apply() is weak and must not clobber it
+    # stamp in apply() is weak and must not clobber it. Default mode
+    # submits the cap flush to the async worker (pipelined capture).
     assert d.get("deferred.flush.cap", 0) >= 1, d
-    assert d.get("deferred.reject.cap", 0) >= 1, d
+    assert d.get("deferred.async.submitted", 0) >= 1, d
+    # sync mode (FLAGS_deferred_async=0): same partition boundaries,
+    # same cap label, flushed inline — async counters stay silent
+    paddle.set_flags({"FLAGS_deferred_async": False})
+    try:
+        before = metrics.snapshot()
+        y = x
+        for _ in range(dmod.DEFER_CAP + 4):
+            y = y * 1.01
+        y.numpy()
+        d = _delta(before, metrics.snapshot())
+        assert d.get("deferred.flush.cap", 0) >= 1, d
+        assert d.get("deferred.async.submitted", 0) == 0, d
+    finally:
+        paddle.set_flags({"FLAGS_deferred_async": True})
 
 
 def test_noop_flush_does_not_leak_cause():

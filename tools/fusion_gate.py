@@ -1,0 +1,249 @@
+"""Equivalence + payoff gate for the fusion tier and the async flush.
+
+Runs a fixed corpus of chains across the PR-10 flag matrix and asserts,
+in order of importance:
+
+  1. equivalence — every corpus output is BITWISE identical across
+     fusion-on, fusion-off, passes-off, and async-off (the partition
+     contract: async only changes who waits, never what runs);
+  2. payoff — the corpus actually exercises the tier: non-zero
+     ``passes.fuse.grouped`` and ``passes.batch.merged``, fused call
+     count strictly below the unfused op count on a cap-length chain,
+     and ``deferred.async.submitted`` > 0 with async counters SILENT
+     when the flag is off;
+  3. backpressure — with a 1-slot in-flight window and a delayed worker
+     the ``deferred.async.window_full`` counter fires and the result is
+     still bitwise identical;
+  4. overhead — mean pass-pipeline cost per flush (``passes.total_us``)
+     stays under ``FUSION_GATE_BUDGET_US`` with the fusion tier on, and
+     the async cap-loop A/B wall time is printed (the eager-gap
+     evidence; advisory on a shared box).
+
+Budgets are env-overridable (FUSION_GATE_*). Exit 0 on pass, 1 on fail;
+`python tools/fusion_gate.py` prints one line per check. Runs under
+JAX_PLATFORMS=cpu (tier-1); wired into tools/suite_gate.py beside
+passes_gate. Measured eager numbers are appended to BENCH_LEDGER.jsonl
+(kind ``fusion_gate``) so the trajectory is regression-pinned.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BUDGET_US = float(os.environ.get("FUSION_GATE_BUDGET_US", "2000"))
+AB_LOOPS = int(os.environ.get("FUSION_GATE_AB_LOOPS", "256"))
+
+
+def _corpus(paddle, np):
+    arr = np.random.default_rng(9).standard_normal((8, 8)) \
+        .astype("float32") * 0.4
+    arr[0, 0] = -0.0
+    arr[0, 1] = np.inf
+    arr2 = np.random.default_rng(10).standard_normal((8, 8)) \
+        .astype("float32") * 0.4
+
+    def linear_run():  # the fuse-pass shape
+        y = paddle.to_tensor(arr)
+        for i in range(14):
+            y = y * 1.01 + 0.5 / (i + 1)
+        return y
+
+    def towers():  # the batch-pass shape (exact-op whitelist)
+        a, b = paddle.to_tensor(arr), paddle.to_tensor(arr2)
+        return (a * 0.5 + 0.25).abs() + (b * 0.5 + 0.25).abs()
+
+    def mixed():  # transcendental towers stay correct (unbatched)
+        a, b = paddle.to_tensor(arr), paddle.to_tensor(arr2)
+        return (a * 2.0).tanh() * (b * 2.0).tanh() + (-(-a)) * 1.0
+
+    def cap_crossing():  # async submit path, contraction-exact
+        y = paddle.to_tensor(arr)
+        for _ in range(150):
+            y = (y * 1.001).abs() + 0.01
+        return y
+
+    return [("linear_run", linear_run), ("towers", towers),
+            ("mixed", mixed), ("cap_crossing", cap_crossing)]
+
+
+_MODES = [  # (label, passes, fusion, async)
+    ("fused+async", True, True, True),
+    ("fusion-off", True, False, True),
+    ("passes-off", False, False, True),
+    ("async-off", True, True, False),
+]
+
+
+def check_equivalence_and_counters():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+
+    flags = ["FLAGS_deferred_passes", "FLAGS_deferred_fusion",
+             "FLAGS_deferred_async"]
+    prev = paddle.get_flags(flags)
+    before = metrics.snapshot()
+    async_silence_ok = True
+    ok = True
+    try:
+        for name, build in _corpus(paddle, np):
+            outs = {}
+            for label, p, f, a in _MODES:
+                paddle.set_flags({"FLAGS_deferred_passes": p,
+                                  "FLAGS_deferred_fusion": f,
+                                  "FLAGS_deferred_async": a})
+                if label == "async-off":
+                    b_async = metrics.snapshot("deferred.async.")
+                outs[label] = build().numpy()
+                if label == "async-off":
+                    a_async = metrics.snapshot("deferred.async.")
+                    async_silence_ok &= all(
+                        a_async.get(k, 0) == b_async.get(k, 0)
+                        for k in a_async)
+            base = outs["fused+async"]
+            same = all(base.tobytes() == o.tobytes()
+                       for o in outs.values())
+            ok &= same
+            print(f"[fusion-gate] equivalence {name}: "
+                  f"{'PASS' if same else 'FAIL (bitwise mismatch)'}")
+    finally:
+        paddle.set_flags(prev)
+    after = metrics.snapshot()
+
+    def delta(key):
+        b = before.get(key, 0)
+        return (after.get(key, 0) - b) if isinstance(b, (int, float)) \
+            else 0
+
+    fuse, batch = delta("passes.fuse.grouped"), delta("passes.batch.merged")
+    subs = delta("deferred.async.submitted")
+    res = delta("deferred.async.resolved")
+    payoff = fuse >= 1 and batch >= 1 and subs >= 1 and res >= 1
+    ok &= payoff
+    print(f"[fusion-gate] payoff: fuse.grouped={fuse} "
+          f"batch.merged={batch} async.submitted={subs} "
+          f"async.resolved={res} {'PASS' if payoff else 'FAIL'}")
+    ok &= async_silence_ok
+    print(f"[fusion-gate] async-off counter silence: "
+          f"{'PASS' if async_silence_ok else 'FAIL'}")
+    return ok, (before, after)
+
+
+def check_fused_call_count():
+    """A cap-length dependent chain must compile to FEWER nodes than it
+    captured (the fused-call-count < unfused-op-count acceptance)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import deferred
+    from paddle_tpu.passes import default_manager, Graph
+
+    y = paddle.to_tensor(np.ones((8, 8), np.float32))
+    root = None
+    for i in range(deferred.DEFER_CAP - 2):
+        y = y * 1.01 + 0.25
+    root = y._pending
+    nodes, leaves, consts = deferred._linearize(root)
+    out_ixs = (len(nodes) - 1,)
+    g = Graph.from_linearized(nodes, leaves, consts, out_ixs, root.dtype)
+    opt = default_manager(fusion=True).run(g)
+    y.numpy()
+    ok = len(opt.nodes) < len(nodes)
+    print(f"[fusion-gate] fused call count: {len(opt.nodes)} node(s) "
+          f"from {len(nodes)} captured ops "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_backpressure():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.testing import faults
+
+    prev = paddle.get_flags(["FLAGS_deferred_inflight"])
+    x = paddle.to_tensor(np.random.default_rng(2)
+                         .standard_normal((8, 8)).astype("float32"))
+
+    def loop():
+        y = x
+        for _ in range(220):
+            y = (y * 1.001).abs() + 0.01
+        return y.numpy()
+
+    ref = loop()
+    paddle.set_flags({"FLAGS_deferred_inflight": 1})
+    try:
+        before = metrics.snapshot("deferred.async.")
+        with faults.inject("deferred.async_exec", nth=1, exc=None,
+                           delay=0.01, count=64):
+            got = loop()
+        after = metrics.snapshot("deferred.async.")
+    finally:
+        paddle.set_flags(prev)
+    full = after.get("deferred.async.window_full", 0) \
+        - before.get("deferred.async.window_full", 0)
+    ok = full >= 1 and got.tobytes() == ref.tobytes()
+    print(f"[fusion-gate] backpressure: window_full={full} "
+          f"bitwise={'yes' if got.tobytes() == ref.tobytes() else 'NO'} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_overhead(snaps):
+    before, after = snaps
+    b = before.get("passes.total_us") or {"count": 0, "sum": 0.0}
+    a = after.get("passes.total_us") or {"count": 0, "sum": 0.0}
+    runs = a["count"] - b["count"]
+    mean_us = (a["sum"] - b["sum"]) / max(runs, 1)
+    ok = mean_us < BUDGET_US
+    print(f"[fusion-gate] overhead: {mean_us:.1f}us/flush over {runs} "
+          f"runs budget={BUDGET_US}us {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def measure_async_ab():
+    """Async-vs-sync wall time on the cap-crossing loop — ONE harness,
+    owned by bench.py `_async_flush_ab` (it warms per mode and
+    restores the caller's flag value); the gate only reports and
+    ledgers it. Advisory: shared-box wall clocks are noisy and a
+    single-core host has no parallelism to overlap, so the ledger
+    median is the pin, not a fixed threshold."""
+    import bench
+
+    out = bench._async_flush_ab(n=AB_LOOPS)
+    print(f"[fusion-gate] async A/B: sync={out['sync']:.1f}ms "
+          f"async={out['async']:.1f}ms speedup={out['speedup']:.2f}x "
+          f"(advisory)")
+    try:
+        import bench_ledger
+        bench_ledger.append_entry("fusion_gate", {
+            "cap_loop_sync_ms": round(out["sync"], 3),
+            "cap_loop_async_ms": round(out["async"], 3)})
+    except Exception as e:  # noqa: BLE001 — ledger trouble is advisory
+        print(f"[fusion-gate] ledger append skipped "
+              f"({type(e).__name__}: {e})")
+    return True
+
+
+def main():
+    ok1, snaps = check_equivalence_and_counters()
+    ok2 = check_fused_call_count()
+    ok3 = check_backpressure()
+    ok4 = check_overhead(snaps)
+    measure_async_ab()
+    if ok1 and ok2 and ok3 and ok4:
+        print("[fusion-gate] PASS")
+        return 0
+    print("[fusion-gate] FAIL")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

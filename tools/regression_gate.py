@@ -63,6 +63,11 @@ def direction_and_tol(name):
         # success sentinel (1.0 iff the probe request reached DONE):
         # ANY drop below the all-1.0 median is a failure, zero tolerance
         return ("down", 0.0)
+    if name == "eager_over_jit_ratio":
+        # the eager-gap headline (bench.py _eager_vs_jit_budget, kind
+        # "eager_gap"): a RATIO where larger is worse — the generic
+        # suffix rules would misread it, so it gets an explicit policy
+        return ("up", RATE_TOL)
     if name.startswith("headline_"):
         return ("down", HEADLINE_TOL) if "tokens_per_s" in name \
             or "mfu" in name else ("up", HEADLINE_TOL)

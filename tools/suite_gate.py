@@ -55,7 +55,12 @@ _MAP = [
     ("paddle_tpu/core/", ["tests/core", "tests/test_autograd.py",
                           "tests/test_tensor.py", "tests/framework"]),
     ("paddle_tpu/passes/", ["tests/framework/test_passes.py",
+                            "tests/framework/test_fusion.py",
                             "tests/core/test_deferred.py"]),
+    ("paddle_tpu/core/deferred.py",
+     ["tests/core/test_deferred.py", "tests/core/test_deferred_async.py",
+      "tests/framework/test_passes.py", "tests/framework/test_fusion.py",
+      "tests/framework/test_chaos.py"]),
     ("paddle_tpu/nn/", ["tests/nn", "tests/test_oracle_sweep_api.py"]),
     ("paddle_tpu/distributed/", ["tests/distributed"]),
     ("paddle_tpu/fleet/", ["tests/distributed"]),
@@ -77,6 +82,8 @@ _MAP = [
     ("tools/metrics_gate.py", ["tests/framework/test_metrics_gate.py"]),
     ("tools/passes_gate.py", ["tests/framework/test_passes.py",
                               "tests/core/test_deferred.py"]),
+    ("tools/fusion_gate.py", ["tests/framework/test_fusion.py",
+                              "tests/core/test_deferred_async.py"]),
     ("tools/dispatch_gate.py",
      ["tests/framework/test_dispatch_fastpath.py"]),
     ("tools/chaos_gate.py", ["tests/framework/test_chaos.py",
