@@ -126,14 +126,30 @@ define_flag("FLAGS_deferred_fusion",
             "disjoint passes/v2 namespace so fused forms canonicalize; "
             "PADDLE_TPU_FUSION=0 (or this flag) keeps the cleanup-only "
             "passes/v1 pipeline")
-define_flag("FLAGS_deferred_async", True,
+def deferred_async_default(cpu_count=None):
+    """Host-aware default for ``FLAGS_deferred_async``: off on a
+    single-core host, on everywhere else. The async flush worker buys
+    capture/execute OVERLAP, which needs a second core to run on — the
+    PR 10 A/B measured ~0.9x on the 1-core CI proxy (pure thread
+    handoff, nothing to overlap). An explicit setting always wins: the
+    ``FLAGS_deferred_async`` env var overrides at import (define_flag
+    reads it) and ``set_flags`` overrides at runtime; this function
+    only picks the default when nobody said anything."""
+    n = os.cpu_count() if cpu_count is None else cpu_count
+    return (n or 2) > 1
+
+
+define_flag("FLAGS_deferred_async", deferred_async_default(),
             "async deferred-chain flush (core/deferred.py): a chain "
             "hitting DEFER_CAP is submitted to the flush worker and its "
             "outputs become futures resolved lazily at host reads, so "
             "the host keeps capturing the next chain while the previous "
             "one compiles/executes; failures degrade to the synchronous "
             "ladder (async -> sync verbatim -> eager replay); 0 reverts "
-            "to fully synchronous flushes byte-for-byte", type=bool)
+            "to fully synchronous flushes byte-for-byte. Defaults OFF "
+            "on single-core hosts (no parallelism to overlap — "
+            "deferred_async_default); an explicit env/set_flags value "
+            "wins", type=bool)
 define_flag("FLAGS_deferred_inflight", 4,
             "bounded in-flight window for async deferred flushes: at "
             "most this many submitted-unfinished chains before "
@@ -238,3 +254,24 @@ define_flag("FLAGS_alert_interval_s", 10.0,
 define_flag("FLAGS_alert_queue_depth", 8,
             "queue.growth alert floor: admission-queue depth must be at "
             "least this (and growing) before the rule fires")
+define_flag("FLAGS_fleet", True,
+            "fleet observatory (profiler/fleet.py): arms replica "
+            "self-registration from ServingEngine.serve_metrics(store=) "
+            "and the FleetAggregator's registry reads; 0 (or passing no "
+            "store) is a byte-for-byte no-op — no heartbeat thread, no "
+            "fleet.* counter movement")
+define_flag("FLAGS_fleet_ttl_s", 15.0,
+            "replica heartbeat TTL seconds: a replica re-registers its "
+            "fleet-store entry every ttl/3; the aggregator treats a "
+            "heartbeat older than the TTL as down (replica.down fires, "
+            "the replica ages out of /fleet/replicas) and garbage-"
+            "collects entries stale beyond 3x the TTL")
+define_flag("FLAGS_fleet_scrape_timeout_s", 2.0,
+            "per-replica HTTP scrape timeout for the FleetAggregator; "
+            "a replica that cannot be scraped within it counts as a "
+            "scrape failure (staleness feeds replica.down)")
+define_flag("FLAGS_fleet_skew_ratio", 2.5,
+            "fleet.skew alert threshold: a replica whose TTFT p95 "
+            "exceeds this multiple of the fleet median p95 (both from "
+            "merged scrape buckets, with a min-sample floor) is flagged "
+            "as the slow outlier a router should de-weight")

@@ -128,6 +128,17 @@ class TCPStore:
                     raise TimeoutError(
                         f"TCPStore.wait timeout on {k!r}")
 
+    def try_get(self, key):
+        """``get`` that returns None instead of raising KeyError — the
+        fleet-registry member scan (profiler/fleet.py) probes a dense
+        key range where gaps are normal (deregistered replicas), and a
+        per-gap exception would dominate the scan."""
+        buf = ctypes.create_string_buffer(1 << 20)
+        with tracing.span("store.get", key=key):
+            n = self._lib.pt_store_get(self._client, key.encode(), buf,
+                                       len(buf))
+        return None if n < 0 else buf.raw[:n]
+
     def check(self, key):
         return bool(self._lib.pt_store_check(self._client, key.encode()))
 

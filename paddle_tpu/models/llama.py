@@ -11,6 +11,7 @@ elementwise. No KV-cache branching in the training path.
 from __future__ import annotations
 
 import dataclasses
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +21,9 @@ from .. import nn
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 from ..nn import functional as F
+
+# guards lazy creation of each model's paged-call lock (Llama._paged_lock)
+_PAGED_LOCK_INIT = threading.Lock()
 
 
 @dataclasses.dataclass
@@ -300,6 +304,25 @@ class Llama(nn.Layer):
     def _param_arrays(self):
         return tuple(p._data for _, p in self.named_parameters())
 
+    def _paged_lock(self):
+        """Per-model lock serializing the paged jit entry points. Their
+        trace path REBINDS the module's parameters to tracers and
+        restores them after the call — with several serving engines
+        sharing one model (in-process fleet replicas), an unsynchronized
+        cold-start races another thread's restore and leaks tracers into
+        the shared params. One uncontended acquire per warm call is
+        noise next to the dispatch itself. Created lazily in __dict__
+        (not through Layer attr tracking; models stay picklable until
+        first serve)."""
+        lock = self.__dict__.get("_paged_call_lock")
+        if lock is None:
+            with _PAGED_LOCK_INIT:
+                lock = self.__dict__.get("_paged_call_lock")
+                if lock is None:
+                    lock = threading.Lock()
+                    self.__dict__["_paged_call_lock"] = lock
+        return lock
+
     def paged_prefill(self, cache, slot, prompt_ids, temperature=0.0,
                       pad_to=None):
         """Run the prompt through the dense forward (causal), write its
@@ -349,12 +372,13 @@ class Llama(nn.Layer):
                 return tok[0], ks, vs
             self._paged_prefill_jit = jax.jit(fn)
 
-        arrs = self._param_arrays()
-        tok, ks, vs = self._paged_prefill_jit(
-            arrs, jnp.asarray(ids), jnp.int32(s),
-            next_key(), jnp.float32(temperature))
-        # tracing left tracers bound into the module params; restore
-        self._param_rebind()(arrs)
+        with self._paged_lock():
+            arrs = self._param_arrays()
+            tok, ks, vs = self._paged_prefill_jit(
+                arrs, jnp.asarray(ids), jnp.int32(s),
+                next_key(), jnp.float32(temperature))
+            # tracing left tracers bound into the module params; restore
+            self._param_rebind()(arrs)
         row = cache.block_tables[slot]
         for i in range(cache.num_layers):
             cache.k_pools[i], cache.v_pools[i] = paged_prefill_write(
@@ -450,14 +474,15 @@ class Llama(nn.Layer):
                 return tok[0], new_k, new_v
             self._paged_extend_jit = jax.jit(fn)
 
-        arrs = self._param_arrays()
-        tok, ks, vs = self._paged_extend_jit(
-            arrs, jnp.asarray(tail), jnp.int32(tail_start),
-            jnp.int32(write_start), jnp.int32(total),
-            jnp.asarray(cache.block_tables[slot]),
-            cache.k_pools, cache.v_pools, next_key(),
-            jnp.float32(temperature))
-        self._param_rebind()(arrs)
+        with self._paged_lock():
+            arrs = self._param_arrays()
+            tok, ks, vs = self._paged_extend_jit(
+                arrs, jnp.asarray(tail), jnp.int32(tail_start),
+                jnp.int32(write_start), jnp.int32(total),
+                jnp.asarray(cache.block_tables[slot]),
+                cache.k_pools, cache.v_pools, next_key(),
+                jnp.float32(temperature))
+            self._param_rebind()(arrs)
         cache.k_pools = list(ks)
         cache.v_pools = list(vs)
         cache.seq_lens[slot] = total
@@ -524,14 +549,15 @@ class Llama(nn.Layer):
                 return nxt, new_k, new_v
             self._paged_decode_jit = jax.jit(fn)
 
-        arrs = self._param_arrays()
-        toks, new_k, new_v = self._paged_decode_jit(
-            arrs, jnp.asarray(last_tokens, jnp.int32),
-            cache.k_pools, cache.v_pools, cache.block_tables,
-            jnp.asarray(cache.seq_lens), jnp.asarray(active),
-            next_key(),
-            jnp.float32(temperature))
-        self._param_rebind()(arrs)
+        with self._paged_lock():
+            arrs = self._param_arrays()
+            toks, new_k, new_v = self._paged_decode_jit(
+                arrs, jnp.asarray(last_tokens, jnp.int32),
+                cache.k_pools, cache.v_pools, cache.block_tables,
+                jnp.asarray(cache.seq_lens), jnp.asarray(active),
+                next_key(),
+                jnp.float32(temperature))
+            self._param_rebind()(arrs)
         cache.k_pools = list(new_k)
         cache.v_pools = list(new_v)
         act = np.asarray(active)

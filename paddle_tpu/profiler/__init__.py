@@ -21,7 +21,7 @@ from enum import Enum
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
            "load_profiler_result", "SortedKeys", "SummaryView", "metrics",
-           "tracing", "export", "accounting", "alerts"]
+           "tracing", "export", "accounting", "alerts", "fleet"]
 
 
 class ProfilerState(Enum):
@@ -106,6 +106,10 @@ from . import export, tracing  # noqa: E402,F401
 # cost attribution / goodput accounting + SLO burn-rate alert rules
 # (the serving scheduler drives them; summary() renders their views)
 from . import accounting, alerts  # noqa: E402,F401
+
+# fleet observatory: replica registry + cross-replica federation +
+# health scoring (ServingEngine.serve_metrics(store=) registers into it)
+from . import fleet  # noqa: E402,F401
 
 
 class RecordEvent:

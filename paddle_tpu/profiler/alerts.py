@@ -52,6 +52,7 @@ __all__ = ["AlertRule", "BurnRateRule", "QueueGrowthRule", "StallRule",
 _c_fired = _metrics.counter("alerts.fired")
 _c_resolved = _metrics.counter("alerts.resolved")
 _c_errors = _metrics.counter("alerts.rule_errors")
+_c_evals = _metrics.counter("alerts.evaluations")
 
 
 class AlertRule:
@@ -225,12 +226,21 @@ class AlertManager:
         read + compare when it's not time yet). The interval re-checks
         UNDER the lock — two racing nudges (a /alerts GET + a scheduler
         step) must not produce a near-zero window whose empty rates
-        would spuriously resolve active incidents."""
+        would spuriously resolve active incidents. The nudge acquires
+        the lock NON-blocking: concurrent scrapers (the fleet
+        aggregator + a human + a gate all polling /alerts) must not
+        convoy behind one evaluation and each pay — whoever loses the
+        race skips, the winner's evaluation covered the window."""
         interval = float(flags_mod.flag("FLAGS_alert_interval_s"))
         last = self._last
         if last is not None and time.monotonic() - last < interval:
             return []  # cheap unlocked fast path (per-step cost)
-        return self.evaluate(min_interval=interval)
+        if not self._lock.acquire(blocking=False):
+            return []  # a concurrent nudge is already evaluating
+        try:
+            return self._evaluate_locked(min_interval=interval)
+        finally:
+            self._lock.release()
 
     def evaluate(self, min_interval=0.0):
         """Run every rule over the window since the last evaluation.
@@ -238,41 +248,45 @@ class AlertManager:
         call, while incidents merely stay active, and when
         ``min_interval`` has not elapsed — the race-free rate limit)."""
         with self._lock:
-            now = time.monotonic()
-            dt = (now - self._last) if self._last is not None else 0.0
-            if min_interval and self._last is not None \
-                    and dt < min_interval:
-                return []  # lost the race to a concurrent evaluation
-            rates = self._delta.rates()
-            self._last = now
-            if not rates:
-                return []  # priming call: no window to judge yet
-            snap = _metrics.snapshot("serving.")
-            ctx = {"rates": rates, "snap": snap, "dt": dt}
-            fired = []
-            for rule in self.rules:
-                try:
-                    firing, info = rule.evaluate(ctx)
-                except Exception:  # noqa: BLE001 — a broken rule must not kill serving
-                    _c_errors.inc()
-                    firing, info = False, {}
-                active = self._active.get(rule.name)
-                if firing and active is None:
-                    inc = {"rule": rule.name, "severity": rule.severity,
-                           "since": time.time(), "count": 1, **info}
-                    self._active[rule.name] = inc
-                    fired.append(inc)
-                    _c_fired.inc()
-                    self._record(inc)
-                elif firing:
-                    active.update(info)
-                    active["count"] += 1
-                elif active is not None:
-                    active["resolved"] = time.time()
-                    self._history.append(active)
-                    del self._active[rule.name]
-                    _c_resolved.inc()
-            return fired
+            return self._evaluate_locked(min_interval)
+
+    def _evaluate_locked(self, min_interval=0.0):
+        now = time.monotonic()
+        dt = (now - self._last) if self._last is not None else 0.0
+        if min_interval and self._last is not None \
+                and dt < min_interval:
+            return []  # lost the race to a concurrent evaluation
+        rates = self._delta.rates()
+        self._last = now
+        _c_evals.inc()  # an actual window consumed (incl. priming)
+        if not rates:
+            return []  # priming call: no window to judge yet
+        snap = _metrics.snapshot("serving.")
+        ctx = {"rates": rates, "snap": snap, "dt": dt}
+        fired = []
+        for rule in self.rules:
+            try:
+                firing, info = rule.evaluate(ctx)
+            except Exception:  # noqa: BLE001 — a broken rule must not kill serving
+                _c_errors.inc()
+                firing, info = False, {}
+            active = self._active.get(rule.name)
+            if firing and active is None:
+                inc = {"rule": rule.name, "severity": rule.severity,
+                       "since": time.time(), "count": 1, **info}
+                self._active[rule.name] = inc
+                fired.append(inc)
+                _c_fired.inc()
+                self._record(inc)
+            elif firing:
+                active.update(info)
+                active["count"] += 1
+            elif active is not None:
+                active["resolved"] = time.time()
+                self._history.append(active)
+                del self._active[rule.name]
+                _c_resolved.inc()
+        return fired
 
     @staticmethod
     def _record(inc):

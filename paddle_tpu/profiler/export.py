@@ -18,6 +18,9 @@ or Prometheus scraper needs it on a wire. Three pieces:
   ``/metrics``           OpenMetrics text (scrape me)
   ``/metrics/delta``     JSON per-second rates since the last delta call
   ``/healthz``           JSON liveness + the serving SLO gauges
+  ``/readyz``            JSON routability: 200 only while the attached
+                         engine's lifecycle is READY (503 in WARMING /
+                         DRAINING / CLOSED) — distinct from liveness
   ``/alerts``            JSON active/resolved SLO burn-rate incidents
                          (profiler/alerts.py AlertManager, when attached)
   ``/traces``            whole span ring, Chrome/Perfetto JSON
@@ -25,11 +28,19 @@ or Prometheus scraper needs it on a wire. Three pieces:
   =====================  ==============================================
 
   ``ServingEngine.serve_metrics()`` attaches one to a live engine so
-  its ``/healthz`` reflects engine state (closed / died), which is
-  what a multi-replica router health-checks.
+  its ``/healthz`` reflects engine state (closed / died) and its
+  ``/readyz`` the drain lifecycle, which is what a multi-replica
+  router health-checks and drains against (profiler/fleet.py).
 
 ``parse_prometheus()`` round-trips the exposition for gates and tests
 (tools/trace_gate.py scrapes, parses, and diffs against snapshot()).
+It is label-aware: a sample carrying labels beyond ``le`` (the fleet
+aggregator's per-replica series) keys as ``name{k="v"}`` with the
+label dict preserved, so a merged fleet exposition round-trips too;
+``render_parsed()`` is the inverse — parsed/merged plain data back to
+exposition text. Every full (un-prefixed) render also carries one
+``replica_info`` gauge whose labels are this process's identity
+(profiler/metrics.replica_identity), so any scrape is attributable.
 """
 
 from __future__ import annotations
@@ -42,8 +53,8 @@ import time
 from . import metrics as _metrics
 from . import tracing as _tracing
 
-__all__ = ["render_prometheus", "parse_prometheus", "DeltaRates",
-           "MetricsServer"]
+__all__ = ["render_prometheus", "parse_prometheus", "render_parsed",
+           "DeltaRates", "MetricsServer"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -63,38 +74,87 @@ def _fnum(v):
     return repr(int(f)) if f == int(f) else repr(f)
 
 
-def render_prometheus(prefix=None):
+def _esc_label(v):
+    """Escape a label VALUE per the exposition format (backslash,
+    double quote, newline) — an operator-chosen replica_id must never
+    produce an exposition parse_prometheus rejects."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _unesc_label(v):
+    return v.replace("\\n", "\n").replace('\\"', '"') \
+        .replace("\\\\", "\\")
+
+
+def _labelblock(labels, **extra):
+    """``{k="v",...}`` block for a sample line (sorted-key canonical;
+    empty string when there are no labels at all). Values are escaped;
+    the parser unescapes — key canonicalization therefore happens on
+    the ESCAPED form on both sides, so render/parse keys agree."""
+    items = {**(labels or {}), **extra}
+    if not items:
+        return ""
+    body = ",".join(f'{k}="{_esc_label(v)}"'
+                    for k, v in sorted(items.items()))
+    return "{" + body + "}"
+
+
+def _identity_lines(labels=None):
+    """The ``replica_info`` gauge: value 1, identity as labels — the
+    OpenMetrics idiom (cf. Prometheus ``target_info``) for stamping
+    WHO produced a scrape without relabeling every series. Caller
+    labels win on collision (a renamed replica stays consistent with
+    its other series)."""
+    ident = _metrics.replica_identity()
+    merged = {k: ident[k] for k in
+              ("replica_id", "host", "pid", "start_ts")}
+    merged.update(labels or {})
+    return ["# TYPE replica_info gauge",
+            f"replica_info{_labelblock(merged)} 1"]
+
+
+def render_prometheus(prefix=None, labels=None):
     """OpenMetrics text for every registered metric (optionally one
-    ``prefix`` family). Ends with ``# EOF`` per the spec."""
+    ``prefix`` family). ``labels`` (a flat str dict) is stamped onto
+    EVERY sample line — the fleet aggregator uses it to render
+    per-replica series; the plain per-process exposition stays
+    unlabeled for back-compat. Full (un-prefixed) renders append the
+    ``replica_info`` identity gauge. Ends with ``# EOF`` per the
+    spec."""
     with _metrics.registry._lock:
         items = sorted(_metrics.registry._metrics.items())
     lines = []
+    lb = _labelblock(labels)
     for name, m in items:
         if prefix is not None and not name.startswith(prefix):
             continue
         pn = _pname(name)
         if isinstance(m, _metrics.Counter):
             lines.append(f"# TYPE {pn} counter")
-            lines.append(f"{pn}_total {_fnum(m.value)}")
+            lines.append(f"{pn}_total{lb} {_fnum(m.value)}")
         elif isinstance(m, _metrics.Gauge):
             lines.append(f"# TYPE {pn} gauge")
-            lines.append(f"{pn} {_fnum(m.value)}")
+            lines.append(f"{pn}{lb} {_fnum(m.value)}")
         elif isinstance(m, _metrics.Histogram):
             snap = m._snap()
             lines.append(f"# TYPE {pn} histogram")
             cum = 0
             bounds = [*m.bounds, float("inf")]
-            labels = [*map(str, m.bounds), "+inf"]
-            for b, label in zip(bounds, labels):
+            blabels = [*map(str, m.bounds), "+inf"]
+            for b, label in zip(bounds, blabels):
                 cum += snap["buckets"][label]
-                line = f'{pn}_bucket{{le="{_fnum(b)}"}} {cum}'
+                bb = _labelblock(labels, le=_fnum(b))
+                line = f"{pn}_bucket{bb} {cum}"
                 ex = snap["exemplars"].get(label)
                 if ex is not None:
                     line += (f' # {{trace_id="{ex["trace_id"]}"}} '
                              f'{_fnum(ex["value"])} {ex["ts"]:.3f}')
                 lines.append(line)
-            lines.append(f"{pn}_sum {_fnum(snap['sum'])}")
-            lines.append(f"{pn}_count {snap['count']}")
+            lines.append(f"{pn}_sum{lb} {_fnum(snap['sum'])}")
+            lines.append(f"{pn}_count{lb} {snap['count']}")
+    if prefix is None:
+        lines.extend(_identity_lines(labels))
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
@@ -108,32 +168,46 @@ _SAMPLE_RE = re.compile(
 
 
 def _labels(s):
+    """Parse a label block body. Values unescape the render-side
+    escapes (quote/backslash/newline); pathological values containing
+    bare ``,``/``}`` are beyond this parser — keep label values to
+    identifier-ish strings (replica ids, trace ids)."""
     out = {}
     for part in (s or "").split(","):
         if "=" in part:
             k, v = part.split("=", 1)
-            out[k.strip()] = v.strip().strip('"')
+            out[k.strip()] = _unesc_label(v.strip().strip('"'))
     return out
 
 
 def parse_prometheus(text):
     """Parse an exposition back into plain data::
 
-        {metric_name: {"type": ..., "value": ...}}                  scalars
-        {metric_name: {"type": "histogram", "buckets": {le: cum},
-                       "sum": ..., "count": ...,
-                       "exemplars": {le: {"trace_id", "value"}}}}
+        {key: {"type": ..., "name": base_name, "value": ...}}       scalars
+        {key: {"type": "histogram", "name": base_name,
+               "buckets": {le: cum}, "sum": ..., "count": ...,
+               "exemplars": {le: {"trace_id", "value"}}}}
 
-    Counter ``_total`` / histogram series suffixes fold back onto the
-    base name. Raises ValueError on a malformed sample line — this is
-    the round-trip check, so garbage must not parse silently."""
+    ``key`` is the base metric name for unlabeled series (back-compat:
+    everything the per-process /metrics serves), or
+    ``name{k="v",...}`` (sorted-key canonical, ``le`` excluded) for
+    labeled series — the fleet aggregator's per-replica federation —
+    whose entries additionally carry the ``labels`` dict. Counter
+    ``_total`` / histogram series suffixes fold back onto the base
+    name. Raises ValueError on a malformed sample line — this is the
+    round-trip check, so garbage must not parse silently."""
     out = {}
 
-    def base(name, kind):
-        return out.setdefault(name, {"type": kind} if kind != "histogram"
-                              else {"type": kind, "buckets": {},
-                                    "sum": None, "count": None,
-                                    "exemplars": {}})
+    def base(name, kind, labels):
+        key = name + _labelblock(labels) if labels else name
+        e = out.setdefault(key, {"type": kind, "name": name}
+                           if kind != "histogram"
+                           else {"type": kind, "name": name,
+                                 "buckets": {}, "sum": None,
+                                 "count": None, "exemplars": {}})
+        if labels:
+            e["labels"] = dict(labels)
+        return e
 
     types = {}
     for line in text.splitlines():
@@ -149,13 +223,14 @@ def parse_prometheus(text):
         if m is None:
             raise ValueError(f"unparseable sample line: {line!r}")
         name, value = m.group("name"), float(m.group("value"))
+        labels = _labels(m.group("labels"))
+        le = labels.pop("le", None)
         for suffix, field in (("_bucket", "buckets"), ("_sum", "sum"),
                               ("_count", "count")):
             stem = name[:-len(suffix)] if name.endswith(suffix) else None
             if stem and types.get(stem) == "histogram":
-                h = base(stem, "histogram")
+                h = base(stem, "histogram", labels)
                 if field == "buckets":
-                    le = _labels(m.group("labels")).get("le")
                     h["buckets"][le] = value
                     if m.group("exvalue") is not None:
                         h["exemplars"][le] = {
@@ -167,10 +242,55 @@ def parse_prometheus(text):
         else:
             if name.endswith("_total") and \
                     types.get(name[:-len("_total")]) == "counter":
-                base(name[:-len("_total")], "counter")["value"] = value
+                base(name[:-len("_total")], "counter",
+                     labels)["value"] = value
             else:
-                base(name, types.get(name, "gauge"))["value"] = value
+                base(name, types.get(name, "gauge"),
+                     labels)["value"] = value
     return out
+
+
+def _le_sort_key(le):
+    return float("inf") if le in ("+Inf", "+inf") else float(le)
+
+
+def render_parsed(parsed):
+    """Inverse of :func:`parse_prometheus`: plain parsed/merged data
+    back to OpenMetrics text. This is how the fleet aggregator serves
+    ``/fleet/metrics`` — per-replica labeled series and unlabeled
+    fleet aggregates in one exposition that parse_prometheus
+    round-trips (exemplars included; their wall-clock ``ts`` is not
+    retained by the parser, so a re-render omits it — the OpenMetrics
+    timestamp is optional)."""
+    lines, typed = [], set()
+    for key in sorted(parsed):
+        e = parsed[key]
+        name = e.get("name") or key
+        kind = e.get("type", "gauge")
+        labels = e.get("labels")
+        lb = _labelblock(labels)
+        if name not in typed:
+            lines.append(f"# TYPE {name} {kind}")
+            typed.add(name)
+        if kind == "counter":
+            lines.append(f"{name}_total{lb} {_fnum(e['value'])}")
+        elif kind == "histogram":
+            for le in sorted(e.get("buckets") or {}, key=_le_sort_key):
+                bb = _labelblock(labels, le=le)
+                line = f"{name}_bucket{bb} {_fnum(e['buckets'][le])}"
+                ex = (e.get("exemplars") or {}).get(le)
+                if ex is not None and ex.get("trace_id"):
+                    line += (f' # {{trace_id="{ex["trace_id"]}"}} '
+                             f'{_fnum(ex["value"])}')
+                lines.append(line)
+            if e.get("sum") is not None:
+                lines.append(f"{name}_sum{lb} {_fnum(e['sum'])}")
+            if e.get("count") is not None:
+                lines.append(f"{name}_count{lb} {_fnum(e['count'])}")
+        else:
+            lines.append(f"{name}{lb} {_fnum(e['value'])}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
 
 
 class DeltaRates:
@@ -267,10 +387,14 @@ class MetricsServer:
     callable merged into /healthz (ServingEngine passes its
     engine-state view); ``alerts`` an optional
     :class:`~paddle_tpu.profiler.alerts.AlertManager` served from
-    ``/alerts`` (each GET also nudges its rate-limited evaluation)."""
+    ``/alerts`` (each GET also nudges its rate-limited evaluation);
+    ``ready`` an optional zero-arg callable returning the ``/readyz``
+    body (must carry a boolean ``ready`` — ServingEngine passes its
+    drain-lifecycle view, docs/SERVING.md). Without one, ``/readyz``
+    reports ready (a bare metrics process is routable)."""
 
     def __init__(self, port=0, host="127.0.0.1", health_extra=None,
-                 alerts=None):
+                 alerts=None, ready=None):
         import http.server
 
         server = self
@@ -301,6 +425,11 @@ class MetricsServer:
                     elif path == "/healthz":
                         body = _slo_health(server._health_extra)
                         code = 200 if body["status"] == "ok" else 503
+                        self._send(code, json.dumps(body),
+                                   "application/json")
+                    elif path == "/readyz":
+                        body = server._ready_body()
+                        code = 200 if body.get("ready") else 503
                         self._send(code, json.dumps(body),
                                    "application/json")
                     elif path == "/alerts":
@@ -339,6 +468,7 @@ class MetricsServer:
 
         self._health_extra = health_extra
         self._alerts = alerts
+        self._ready = ready
         self._delta = DeltaRates()
         self._httpd = http.server.ThreadingHTTPServer((host, port),
                                                       _Handler)
@@ -350,6 +480,19 @@ class MetricsServer:
             target=self._httpd.serve_forever,
             name="paddle-tpu-metrics-http", daemon=True)
         self._thread.start()
+
+    def _ready_body(self):
+        """/readyz body: the attached lifecycle view, or standalone
+        readiness when nothing is attached. Never raises — a broken
+        view must read as NOT ready (a router should stop sending
+        traffic, not get a 500)."""
+        if self._ready is None:
+            return {"ready": True, "state": "READY", "attached": False}
+        try:
+            return self._ready()
+        except Exception as e:  # noqa: BLE001 — readiness must not 500
+            return {"ready": False, "state": "ERROR",
+                    "error": f"{type(e).__name__}: {e}"}
 
     @property
     def address(self):

@@ -35,12 +35,61 @@ is counted no matter which layer (deferred chains, lazy-vjp jits, user
 from __future__ import annotations
 
 import json
+import os
+import socket
 import threading
 import time
 
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "snapshot", "dump", "reset", "registry",
-           "thread_compile_seconds"]
+           "thread_compile_seconds", "replica_identity",
+           "set_replica_id"]
+
+
+# -- replica identity ------------------------------------------------------
+# once more than one serving process exists, a metrics dump or a scrape
+# is meaningless without knowing WHICH replica produced it. The identity
+# is process-scoped (the registry is process-global); fleet registration
+# (profiler/fleet.py) reuses it and may override replica_id per
+# registration when several replicas share a process (tests, gates).
+
+_START_TS = time.time()
+try:
+    _HOST = socket.gethostname()
+except Exception:  # noqa: BLE001 — identity must never break import
+    _HOST = "localhost"
+_replica_id = None
+_identity_lock = threading.Lock()
+
+
+def set_replica_id(replica_id):
+    """Override the process replica id (None restores the default
+    ``<host>-<pid>``). Fleet registration (profiler/fleet.Registrar)
+    adopts its replica_id here when nothing set one yet, so the
+    ``replica_info`` series and ``dump()`` envelope agree with the
+    registry name in the one-replica-per-process case."""
+    global _replica_id
+    with _identity_lock:
+        _replica_id = str(replica_id) if replica_id is not None else None
+
+
+def replica_id_overridden():
+    """True iff an explicit replica id is set (vs the host-pid
+    default) — fleet registration only adopts its name when not."""
+    with _identity_lock:
+        return _replica_id is not None
+
+
+def replica_identity():
+    """This process's replica identity: ``{replica_id, host, pid,
+    start_ts}`` — stamped into ``dump()``'s JSON envelope and exported
+    as the ``replica_info`` OpenMetrics series (profiler/export.py), so
+    ledger entries and scrapes stay attributable across a fleet."""
+    with _identity_lock:
+        rid = _replica_id
+    pid = os.getpid()
+    return {"replica_id": rid if rid is not None else f"{_HOST}-{pid}",
+            "host": _HOST, "pid": pid, "start_ts": _START_TS}
 
 
 # -- histogram exemplars ---------------------------------------------------
@@ -283,9 +332,11 @@ class Registry:
     def dump(self, path=None, prefix=None):
         """Human-readable table; optionally also written to ``path`` as
         JSON for machine consumption. The JSON envelope carries a
-        wall-clock ``ts`` and a process-monotone ``seq`` so successive
-        dumps from a gate or watcher diff/order cleanly; the metric
-        map itself sits under ``"metrics"``."""
+        wall-clock ``ts``, a process-monotone ``seq``, and the process
+        ``replica`` identity (:func:`replica_identity`) so successive
+        dumps from a gate or watcher diff/order cleanly AND stay
+        attributable once more than one process exists; the metric map
+        itself sits under ``"metrics"``."""
         snap = self.snapshot(prefix)
         lines = ["{:<48} {}".format("metric", "value")]
         for name in sorted(snap):
@@ -306,6 +357,7 @@ class Registry:
                 seq = self._dump_seq
             with open(path, "w") as f:
                 json.dump({"ts": time.time(), "seq": seq,
+                           "replica": replica_identity(),
                            "metrics": snap}, f, indent=1, sort_keys=True)
         return text
 

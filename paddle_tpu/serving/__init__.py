@@ -21,10 +21,12 @@ contract, and the metric catalog.
 """
 
 from .bucketing import bucket_length, bucket_lengths  # noqa: F401
-from .frontend import (QueueFullError, RequestHandle,  # noqa: F401
-                       RequestStatus, ServingEngine)
+from .frontend import (Lifecycle, NotReadyError,  # noqa: F401
+                       QueueFullError, RequestHandle, RequestStatus,
+                       ServingEngine)
 from .scheduler import Scheduler, ServingRequest  # noqa: F401
 
 __all__ = ["ServingEngine", "RequestHandle", "RequestStatus",
-           "QueueFullError", "Scheduler", "ServingRequest",
+           "QueueFullError", "Lifecycle", "NotReadyError",
+           "Scheduler", "ServingRequest",
            "bucket_length", "bucket_lengths"]

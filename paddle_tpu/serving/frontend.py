@@ -9,10 +9,18 @@ handler, HTTP worker pool, ...) calls from many threads:
   callback), waits for completion (``result()``), and cancels.
 - a background **driver thread** (default) runs scheduler steps while
   work exists and sleeps on a condition otherwise; ``background=False``
-  hands the stepping to the caller (``step()`` / ``drain()``) for
-  deterministic tests and gates.
+  hands the stepping to the caller (``step()`` / ``run_until_idle()``)
+  for deterministic tests and gates.
 - per-request deadlines ride on ``core.resilience.Deadline``; expired
   requests finish with status ``TIMEOUT`` at the next step boundary.
+- an explicit **lifecycle** (``WARMING -> READY -> DRAINING ->
+  CLOSED``) served from ``/readyz`` — distinct from ``/healthz``
+  liveness — with a graceful ``drain()``: admission stops
+  (``NotReadyError``), every in-flight request finishes with its
+  terminal status unchanged and outputs bit-identical to an undrained
+  run, readiness flips, and the replica deregisters from the fleet
+  registry (profiler/fleet.py). This is the drain contract a
+  multi-replica router rolls deploys against (docs/SERVING.md).
 
 One re-entrant lock guards all scheduler state, and the driver holds it
 for the duration of a scheduling iteration (prefill + decode are device
@@ -29,14 +37,39 @@ from __future__ import annotations
 
 import queue as queue_mod
 import threading
+import time
 
 from ..core import resilience
+from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
 from .scheduler import QueueFullError, RequestStatus, Scheduler
 
 __all__ = ["ServingEngine", "RequestHandle", "QueueFullError",
-           "RequestStatus"]
+           "RequestStatus", "Lifecycle", "NotReadyError"]
 
 _SENTINEL = object()
+
+
+class Lifecycle:
+    """Replica readiness states (/readyz; docs/SERVING.md "Drain
+    contract"): WARMING accepts local submits but tells routers "not
+    yet"; READY is routable; DRAINING finishes in-flight work while
+    rejecting new submits; CLOSED is terminal."""
+
+    WARMING = "WARMING"
+    READY = "READY"
+    DRAINING = "DRAINING"
+    CLOSED = "CLOSED"
+
+
+class NotReadyError(RuntimeError):
+    """Submission rejected because the engine is DRAINING or CLOSED —
+    the caller should route to another replica."""
+
+
+_c_drain_started = _metrics.counter("serving.drain.started")
+_c_drain_completed = _metrics.counter("serving.drain.completed")
+_g_lifecycle_ready = _metrics.gauge("serving.lifecycle.ready")
 
 
 class RequestHandle:
@@ -126,7 +159,8 @@ class ServingEngine:
                  eos_token_id=None, dtype=None,
                  prefill_token_budget=None, max_queue=None,
                  bucket_cap=None, prefix_cache=None, accounting=None,
-                 background=True):
+                 background=True, ready=True):
+        self._state = Lifecycle.WARMING
         self._sched = Scheduler(
             model, max_batch=max_batch, block_size=block_size,
             max_seq_len=max_seq_len, num_blocks=num_blocks,
@@ -141,6 +175,13 @@ class ServingEngine:
         self._closed = False
         self._error = None
         self._metrics_server = None
+        self._registrar = None
+        # ready=False holds the engine in WARMING (the operator warms
+        # prefill buckets through local submits first, then calls
+        # mark_ready()); routers see WARMING as not-routable on /readyz
+        if ready:
+            self._state = Lifecycle.READY
+        _g_lifecycle_ready.set(1 if ready else 0)
 
     # -- submission ----------------------------------------------------
 
@@ -172,6 +213,10 @@ class ServingEngine:
                 raise RuntimeError(
                     "ServingEngine died; no new submissions") \
                     from self._error
+            if self._state in (Lifecycle.DRAINING, Lifecycle.CLOSED):
+                raise NotReadyError(
+                    f"ServingEngine is {self._state}; not accepting "
+                    "new requests (route to another replica)")
             if deadline is None and deadline_s is not None:
                 deadline = resilience.Deadline.after(deadline_s)
             handle._req = self._sched.submit(
@@ -223,14 +268,119 @@ class ServingEngine:
         with self._lock:
             return self._sched.step()
 
-    def drain(self):
-        """Step until idle (foreground mode). Results arrive via the
-        handles."""
+    def run_until_idle(self):
+        """Step until the scheduler is idle (foreground mode). Results
+        arrive via the handles. Purely a stepping helper — admission
+        stays open and the lifecycle does not move (contrast
+        :meth:`drain`, the graceful shutdown)."""
         while True:
             with self._lock:
                 if not self._sched.has_work:
                     return
             self.step()
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def lifecycle(self):
+        """Current :class:`Lifecycle` state (served from /readyz)."""
+        return self._state
+
+    def mark_ready(self):
+        """WARMING -> READY (no-op in READY; raises past that — a
+        drained replica never becomes routable again)."""
+        with self._cond:
+            if self._state in (Lifecycle.DRAINING, Lifecycle.CLOSED):
+                raise RuntimeError(
+                    f"cannot mark_ready a {self._state} engine")
+            self._state = Lifecycle.READY
+            _g_lifecycle_ready.set(1)
+
+    def drain(self, timeout=60):
+        """Graceful shutdown of ADMISSION, not of the process: flips
+        READY -> DRAINING (new ``submit()`` raises
+        :class:`NotReadyError`; routers see /readyz go 503), lets
+        every in-flight request finish naturally — terminal statuses
+        unchanged, outputs bit-identical to an undrained run
+        (tools/fleet_gate.py pins zero dropped requests) — then flips
+        DRAINING -> CLOSED and deregisters from the fleet registry so
+        routers drop the replica immediately. The metrics endpoint
+        stays up for a final scrape; ``close()`` tears it down.
+        Idempotent; ``timeout`` bounds the in-flight wait in
+        background mode (TimeoutError past it, state stays DRAINING
+        so a retry can finish the job). If the ENGINE dies mid-drain
+        the drain is NOT graceful — the in-flight requests terminated
+        ERROR, so the engine error re-raises here (state still flips
+        CLOSED and the replica deregisters: a dead replica must leave
+        the registry either way, but it never reports a clean
+        ``serving.drain.completed``)."""
+        with self._cond:
+            if self._state == Lifecycle.CLOSED:
+                return
+            first = self._state != Lifecycle.DRAINING
+            self._state = Lifecycle.DRAINING
+            _g_lifecycle_ready.set(0)
+            inflight = self._sched.inflight()
+            span = _tracing.start_trace("serving.drain",
+                                        inflight=inflight) \
+                if first else _tracing.NULL
+            if first:
+                _c_drain_started.inc()
+            self._cond.notify_all()
+        if first:
+            self._record_drain("started", inflight)
+        # complete in-flight work: the background driver keeps
+        # stepping (DRAINING is not CLOSED); foreground steps inline
+        if self._thread is not None and self._thread.is_alive():
+            deadline = None if timeout is None \
+                else time.monotonic() + float(timeout)
+            with self._cond:
+                while self._sched.has_work and self._error is None:
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        span.end("timeout")
+                        raise TimeoutError(
+                            f"drain: {self._sched.inflight()} requests "
+                            f"still in flight after {timeout}s")
+                    self._cond.wait(0.02)
+        else:
+            with self._lock:
+                while self._sched.has_work and self._error is None:
+                    self._sched.step()
+        with self._cond:
+            was_closed = self._state == Lifecycle.CLOSED
+            self._state = Lifecycle.CLOSED
+            reg, self._registrar = self._registrar, None
+            err = self._error
+        if reg is not None:
+            reg.deregister()
+        if err is not None:
+            # the driver died mid-drain: requests terminated ERROR,
+            # not gracefully — never report a clean completion
+            span.annotate(completed=False)
+            span.end("error")
+            raise RuntimeError(
+                "drain: engine died before in-flight work could "
+                "finish") from err
+        if not was_closed:  # a concurrent drain lost the race: one edge
+            _c_drain_completed.inc()
+            self._record_drain("completed", 0)
+        # the span belongs to the FIRST drainer, which may not be the
+        # thread that won the CLOSED transition — end it regardless
+        span.annotate(completed=True)
+        span.end("CLOSED")
+
+    @staticmethod
+    def _record_drain(phase, inflight):
+        """Flight-record the drain edges so post-mortems show deploys
+        interleaved with the traffic around them."""
+        try:
+            from ..distributed import watchdog
+            watchdog.record_event(f"serving.drain.{phase}",
+                                  meta={"inflight": inflight},
+                                  status="lifecycle")
+        except Exception:  # noqa: BLE001 — telemetry must not block a drain
+            pass
 
     def _drive(self):
         try:
@@ -251,30 +401,57 @@ class ServingEngine:
 
     # -- telemetry export ----------------------------------------------
 
-    def serve_metrics(self, port=0, host="127.0.0.1"):
+    def serve_metrics(self, port=0, host="127.0.0.1", store=None,
+                      replica_id=None):
         """Attach a scrapeable telemetry endpoint to this engine
         (idempotent; closed with the engine). Routes: ``/metrics``
         (OpenMetrics text), ``/metrics/delta`` (per-second rates),
         ``/healthz`` (SLO gauges + engine liveness — 503 once the
-        driver died or the engine closed), ``/alerts`` (SLO burn-rate
+        driver died or the engine closed), ``/readyz`` (the drain
+        lifecycle — 503 unless READY), ``/alerts`` (SLO burn-rate
         incidents from this engine's AlertManager), ``/traces`` and
         ``/traces/<id>`` (Chrome/Perfetto span exports). ``port=0``
         (the default) binds an ephemeral port — ALWAYS read the bound
         one from ``.port``/``.url()`` on the returned server instead of
-        hardcoding (multi-replica routers discover replicas this way)."""
+        hardcoding (multi-replica routers discover replicas this way).
+
+        ``store`` (a ``distributed.store.TCPStore`` client) opts this
+        replica into the FLEET REGISTRY (profiler/fleet.py): the scrape
+        address + identity self-register under a TTL'd heartbeat, so a
+        FleetAggregator discovers, scrapes, and health-scores it;
+        ``drain()``/``close()`` deregister. With ``FLAGS_fleet=0`` or
+        no store this is a byte-for-byte no-op (no thread, fleet.*
+        counters silent)."""
         with self._lock:
             if self._metrics_server is None:
                 from ..profiler.export import MetricsServer
                 self._metrics_server = MetricsServer(
                     port=port, host=host, health_extra=self._health_view,
-                    alerts=self._sched.alerts)
-            return self._metrics_server
+                    alerts=self._sched.alerts, ready=self._ready_view)
+            srv = self._metrics_server
+            register = store is not None and self._registrar is None \
+                and self._state not in (Lifecycle.DRAINING,
+                                        Lifecycle.CLOSED)
+        if register:
+            from ..profiler import fleet as _fleet
+            if _fleet.armed(store):
+                reg = _fleet.Registrar(
+                    store, srv.url(""), replica_id=replica_id,
+                    status_fn=lambda: self._state)
+                reg.start()
+                with self._lock:
+                    if self._registrar is None:
+                        self._registrar = reg
+                    else:  # lost an unlikely double-attach race
+                        reg.deregister()
+        return srv
 
     def _health_view(self):
         with self._lock:
             alive = self._error is None and not self._closed
             view = {"engine": {
                 "closed": self._closed,
+                "lifecycle": self._state,
                 "queue": len(self._sched.queue),
                 "running": len(self._sched.running)}}
             if self._error is not None:
@@ -285,6 +462,21 @@ class ServingEngine:
                 else "dead"
         return view
 
+    def _ready_view(self):
+        """/readyz body: routability, distinct from /healthz liveness —
+        a DRAINING replica is alive (scrape it!) but must receive no
+        new traffic."""
+        with self._lock:
+            state = self._state
+            body = {"ready": state == Lifecycle.READY
+                    and self._error is None,
+                    "state": state, "attached": True,
+                    "inflight": self._sched.inflight()}
+            if self._error is not None:
+                body["error"] = \
+                    f"{type(self._error).__name__}: {self._error}"
+        return body
+
     # -- lifecycle -----------------------------------------------------
 
     def close(self, cancel_pending=True, timeout=60):
@@ -293,12 +485,17 @@ class ServingEngine:
         ``False`` drains them first."""
         with self._cond:
             self._closed = True
+            self._state = Lifecycle.CLOSED
+            _g_lifecycle_ready.set(0)
+            reg, self._registrar = self._registrar, None
             if cancel_pending:
                 for req in list(self._sched.queue):
                     req.cancel_requested = True
                 for req in list(self._sched.running.values()):
                     req.cancel_requested = True
             self._cond.notify_all()
+        if reg is not None:
+            reg.deregister()  # routers drop us before the join below
         if self._thread is not None:
             self._thread.join(timeout)
         # foreground mode (or a dead driver): flush remaining work so

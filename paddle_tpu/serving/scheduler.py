@@ -252,6 +252,11 @@ class Scheduler:
     def has_work(self):
         return bool(self.queue or self.running)
 
+    def inflight(self):
+        """Live (non-terminal) requests: queued + running — the number
+        a drain must let finish (frontend lifecycle, /readyz body)."""
+        return len(self.queue) + len(self.running)
+
     # -- the scheduling iteration -------------------------------------
 
     def step(self):

@@ -68,7 +68,7 @@ def test_closure_and_cost_report_basics(model):
                         temperature=0.0, bucket_cap=32, background=False)
     hs = [eng.submit(p, max_new_tokens=5)
           for p in _prompts(0, [5, 9, 12])]
-    eng.drain()
+    eng.run_until_idle()
     _assert_closure(eng.accounting, min_steps=3)
     total_attr = 0.0
     for h in hs:
@@ -100,7 +100,7 @@ def test_closure_across_preemption_and_reprefill_billing(model):
                         num_blocks=8, temperature=0.0, background=False,
                         prefix_cache=False)
     hs = [eng.submit(p, max_new_tokens=12) for p in _prompts(1, [8, 8])]
-    eng.drain()
+    eng.run_until_idle()
     assert metrics.snapshot("serving.")["serving.preempt"] - before >= 1
     _assert_closure(eng.accounting, min_steps=5)
     victim = max(hs, key=lambda h: h.preempts)
@@ -123,9 +123,9 @@ def test_prefix_hits_billed_extend_only(model):
     mk = lambda: np.concatenate(  # noqa: E731
         [system, rng.integers(0, 255, (3,)).astype("int64")])
     cold = eng.submit(mk(), max_new_tokens=4)
-    eng.drain()
+    eng.run_until_idle()
     warm = eng.submit(mk(), max_new_tokens=4)
-    eng.drain()
+    eng.run_until_idle()
     cc, wc = cold.cost(), warm.cost()
     assert cc.covered_tokens == 0
     assert wc.covered_tokens == 24            # the three shared chunks
@@ -148,10 +148,10 @@ def test_flag_off_reverts_and_cost_none(model):
                             accounting=False)
     p = _prompts(3, [7])[0]
     h_on = eng_on.submit(p, max_new_tokens=6)
-    eng_on.drain()
+    eng_on.run_until_idle()
     acc_mid = metrics.snapshot("accounting.")
     h_off = eng_off.submit(p, max_new_tokens=6)
-    eng_off.drain()
+    eng_off.run_until_idle()
     acc_after = metrics.snapshot("accounting.")
     # identical tokens either way; disarmed engine: cost() None, null
     # accountant, no alert manager, and NOT ONE accounting counter moved
@@ -189,12 +189,12 @@ def test_goodput_report_and_deadline_miss(model):
                         temperature=0.0, bucket_cap=32, background=False)
     ok = eng.submit(_prompts(4, [6])[0], max_new_tokens=4,
                     deadline_s=300.0)
-    eng.drain()
+    eng.run_until_idle()
     # an already-expired deadline: TIMEOUT at the first sweep
     late = eng.submit(_prompts(4, [6])[0], max_new_tokens=4,
                       deadline_s=0.0)
     time.sleep(0.01)
-    eng.drain()
+    eng.run_until_idle()
     assert ok.status == "DONE" and late.status == "TIMEOUT"
     assert ok.cost().deadline_met is True
     assert late.cost().deadline_met is False
@@ -203,7 +203,7 @@ def test_goodput_report_and_deadline_miss(model):
     gone = eng.submit(_prompts(4, [6])[0], max_new_tokens=30)
     eng.step()
     gone.cancel()
-    eng.drain()
+    eng.run_until_idle()
     assert gone.status == "CANCELLED" and len(gone.tokens()) > 0
     assert gone.cost().deadline_met is None
     assert eng.accounting.missed_tokens == missed_before
@@ -212,7 +212,7 @@ def test_goodput_report_and_deadline_miss(model):
                        deadline_s=600.0)
     eng.step()
     gone2.cancel()
-    eng.drain()
+    eng.run_until_idle()
     assert gone2.status == "CANCELLED"
     assert gone2.cost().deadline_met is None
     assert eng.accounting.missed_tokens == missed_before
@@ -247,7 +247,7 @@ def test_capacity_gauges_and_occupancy(model):
     eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
                         temperature=0.0, bucket_cap=32, background=False)
     eng.submit(_prompts(5, [9])[0], max_new_tokens=4)
-    eng.drain()
+    eng.run_until_idle()
     occ = eng.cache.occupancy()
     assert occ["active"] + occ["cached_free"] + occ["free"] == \
         occ["usable"]
@@ -305,7 +305,7 @@ def test_summary_sections_render(model):
     eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
                         temperature=0.0, bucket_cap=32, background=False)
     eng.submit(_prompts(6, [5])[0], max_new_tokens=3)
-    eng.drain()
+    eng.run_until_idle()
     eng.close()
     s = profiler.Profiler(timer_only=True).summary()
     assert "Capacity View" in s
@@ -467,11 +467,53 @@ def test_maybe_evaluate_rate_limited():
         paddle.set_flags(saved)
 
 
+def test_concurrent_scrapers_share_one_evaluation(model):
+    """Two scrapers hammering /alerts (the fleet aggregator + a human
+    + a gate polling the same replica) must not multiply evaluation
+    cost: the GET nudge respects FLAGS_alert_interval_s and loses
+    non-blocking to a concurrent evaluation instead of convoying —
+    at most ONE window is consumed no matter how many scrapers race."""
+    import threading
+
+    eng = ServingEngine(model, max_batch=1, block_size=8, max_seq_len=64,
+                        temperature=0.0, background=False)
+    eng.submit(_prompts(11, [5])[0], max_new_tokens=3)
+    eng.run_until_idle()
+    srv = eng.serve_metrics()
+    saved = paddle.get_flags(["FLAGS_alert_interval_s"])
+    paddle.set_flags({"FLAGS_alert_interval_s": 3600.0})
+    try:
+        eng.alerts.evaluate()  # consume whatever window was pending
+        before = metrics.snapshot("alerts.")["alerts.evaluations"]
+        errs = []
+
+        def scraper():
+            try:
+                for _ in range(10):
+                    urllib.request.urlopen(srv.url("/alerts"),
+                                           timeout=10).read()
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=scraper) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        after = metrics.snapshot("alerts.")["alerts.evaluations"]
+        # 20 concurrent GETs inside one interval: zero extra windows
+        assert after == before, (before, after)
+    finally:
+        paddle.set_flags(saved)
+        eng.close()
+
+
 def test_alerts_endpoint(model):
     eng = ServingEngine(model, max_batch=1, block_size=8, max_seq_len=64,
                         temperature=0.0, background=False)
     eng.submit(_prompts(7, [5])[0], max_new_tokens=3)
-    eng.drain()
+    eng.run_until_idle()
     srv = eng.serve_metrics()
     body = json.loads(urllib.request.urlopen(
         srv.url("/alerts"), timeout=10).read())

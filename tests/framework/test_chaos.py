@@ -252,6 +252,17 @@ def test_ladder_flight_records():
 
 # -- async flush degradation (PR 10) ---------------------------------------
 
+@pytest.fixture
+def _async_on():
+    """Arm the async flush explicitly: FLAGS_deferred_async defaults
+    OFF on single-core hosts (the CI proxy), and these tests exercise
+    the async worker's fault sites."""
+    saved = paddle.get_flags(["FLAGS_deferred_async"])
+    paddle.set_flags({"FLAGS_deferred_async": True})
+    yield
+    paddle.set_flags(saved)
+
+
 def _cap_chain():
     """A dependent loop that crosses DEFER_CAP twice: with async on the
     over-cap segments go through the flush worker (submit -> exec ->
@@ -271,7 +282,7 @@ _ASYNC_SITES = ("deferred.async_submit", "deferred.async_exec",
 
 
 @pytest.mark.parametrize("site", _ASYNC_SITES)
-def test_async_crash_at_every_site_bitwise(site):
+def test_async_crash_at_every_site_bitwise(site, _async_on):
     """Crash-at-every-async-site matrix: whichever async rung fails —
     submission, worker execution, host resolution — the recovery path
     re-executes the SAME captured chains and the result is bitwise
@@ -290,7 +301,7 @@ def test_async_crash_at_every_site_bitwise(site):
         k: v for k, v in d.items() if k.startswith("resilience.")})
 
 
-def test_async_exec_crash_then_verbatim_crash_reaches_eager():
+def test_async_exec_crash_then_verbatim_crash_reaches_eager(_async_on):
     """Stacked failures walk the whole ladder: worker execution fails,
     the sync replay's verbatim compile fails too -> eager op-by-op
     replay, still bitwise (the corpus is contraction-stable)."""
@@ -308,7 +319,7 @@ def test_async_exec_crash_then_verbatim_crash_reaches_eager():
     assert d.get("deferred.flush.eager_replay", 0) >= 1
 
 
-def test_async_degrades_are_flight_recorded():
+def test_async_degrades_are_flight_recorded(_async_on):
     with faults.inject("deferred.async_submit", count=16):
         _cap_chain().numpy()
     assert any(r["tag"] == "degrade/flush.async_submit"

@@ -1,0 +1,647 @@
+"""Fleet observatory (profiler/fleet.py): replica registry + TTL'd
+heartbeats, cross-replica metric federation, health scoring, and the
+drain-aware readiness lifecycle.
+
+Acceptance pins (ISSUE 11): merged /fleet/metrics counters equal the
+sum of per-replica values and histogram buckets merge bucket-wise with
+exemplars preserved; a killed heartbeat fires ``replica.down`` ONCE
+per episode and ages the replica out of ``/fleet/replicas``;
+``ServingEngine.drain()`` completes all in-flight requests bit-
+identically, rejects new submits, and walks /readyz through
+READY -> DRAINING -> CLOSED; ``health_score`` is pure/deterministic
+and ranks degraded replicas strictly below healthy ones; disarmed
+(``FLAGS_fleet=0`` / no store) is a counter-silent no-op.
+"""
+
+import json
+import os
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.models import Llama, LlamaConfig
+from paddle_tpu.profiler import export, fleet, metrics
+from paddle_tpu.serving import Lifecycle, NotReadyError, ServingEngine
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _no_trace_pollution():
+    """Run untraced (the test_accounting convention): fleet tests
+    drive compile-heavy serving traffic whose TTFTs must not become
+    max-value-ever exemplars for later suites. The one test that needs
+    traces re-enables tracing itself."""
+    saved = paddle.get_flags(["FLAGS_trace_enable"])
+    paddle.set_flags({"FLAGS_trace_enable": False})
+    yield
+    paddle.set_flags(saved)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+@pytest.fixture
+def store():
+    return TCPStore(is_master=True)
+
+
+def _prompts(seed, sizes):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 255, (s,)).astype("int64") for s in sizes]
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("bucket_cap", 32)
+    kw.setdefault("background", False)
+    return ServingEngine(model, **kw)
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+# -- replica identity (satellite) ------------------------------------------
+
+
+def test_dump_envelope_and_exposition_carry_identity(tmp_path=None):
+    ident = metrics.replica_identity()
+    assert ident["replica_id"] == f"{ident['host']}-{ident['pid']}"
+    assert ident["pid"] == os.getpid() and ident["start_ts"] > 0
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "dump.json")
+        metrics.dump(p)
+        with open(p) as f:
+            env = json.load(f)
+        assert env["replica"]["replica_id"] == ident["replica_id"]
+        assert set(env["replica"]) == {"replica_id", "host", "pid",
+                                       "start_ts"}
+    # replica_info rides every full exposition as an identity-labeled
+    # gauge; a prefix-filtered family render stays identity-free
+    parsed = export.parse_prometheus(export.render_prometheus())
+    info = [e for e in parsed.values() if e.get("name") == "replica_info"]
+    assert len(info) == 1
+    assert info[0]["labels"]["replica_id"] == ident["replica_id"]
+    assert "replica_info" not in export.render_prometheus("serving.")
+    try:
+        metrics.set_replica_id("custom-7")
+        assert metrics.replica_identity()["replica_id"] == "custom-7"
+    finally:
+        metrics.set_replica_id(None)
+    assert metrics.replica_identity()["replica_id"] == \
+        ident["replica_id"]
+
+
+# -- merged-exposition round-trip (satellite) ------------------------------
+
+
+_R1 = """\
+# TYPE serving_completed counter
+serving_completed_total 5
+# TYPE serving_queue_depth gauge
+serving_queue_depth 2
+# TYPE serving_ttft_us histogram
+serving_ttft_us_bucket{le="500"} 3 # {trace_id="aaa"} 450.0 1.0
+serving_ttft_us_bucket{le="+Inf"} 5 # {trace_id="bbb"} 900.0 2.0
+serving_ttft_us_sum 2800
+serving_ttft_us_count 5
+# EOF
+"""
+
+_R2 = """\
+# TYPE serving_completed counter
+serving_completed_total 7
+# TYPE serving_queue_depth gauge
+serving_queue_depth 1
+# TYPE serving_ttft_us histogram
+serving_ttft_us_bucket{le="500"} 6 # {trace_id="ccc"} 499.0 3.0
+serving_ttft_us_bucket{le="+Inf"} 7 # {trace_id="ddd"} 2500.0 4.0
+serving_ttft_us_sum 3700
+serving_ttft_us_count 7
+# EOF
+"""
+
+
+def test_merged_fleet_exposition_roundtrips():
+    """sum-of-counters, bucket-wise histogram merge, exemplar
+    survival, and label preservation — through a full render ->
+    parse -> merge -> render -> parse cycle."""
+    by = {"r1": export.parse_prometheus(_R1),
+          "r2": export.parse_prometheus(_R2)}
+    merged = fleet.merge_scrapes(by)
+    assert merged["serving_completed"]["value"] == 12
+    assert merged["serving_queue_depth"]["value"] == 3
+    h = merged["serving_ttft_us"]
+    assert h["buckets"] == {"500": 9, "+Inf": 12}
+    assert h["sum"] == 6500 and h["count"] == 12
+    # max-value exemplar per bucket survives, tagged with its origin
+    assert h["exemplars"]["500"]["trace_id"] == "ccc"
+    assert h["exemplars"]["500"]["replica_id"] == "r2"
+    assert h["exemplars"]["+Inf"]["trace_id"] == "ddd"
+    # one exposition: labeled per-replica series + unlabeled aggregate
+    expo = dict(merged)
+    for rid, parsed in by.items():
+        for key, e in parsed.items():
+            e2 = dict(e)
+            e2["labels"] = {"replica_id": rid}
+            expo[e["name"] + '{replica_id="' + rid + '"}'] = e2
+    back = export.parse_prometheus(export.render_parsed(expo))
+    assert back["serving_completed"]["value"] == 12
+    k1 = 'serving_completed{replica_id="r1"}'
+    assert back[k1]["value"] == 5
+    assert back[k1]["labels"] == {"replica_id": "r1"}
+    bh = back["serving_ttft_us"]
+    assert bh["buckets"] == {"500": 9, "+Inf": 12}
+    assert bh["exemplars"]["500"]["trace_id"] == "ccc"
+    hk2 = 'serving_ttft_us{replica_id="r2"}'
+    assert back[hk2]["buckets"] == {"500": 6, "+Inf": 7}
+    assert back[hk2]["exemplars"]["+Inf"]["trace_id"] == "ddd"
+
+
+def test_percentile_from_buckets():
+    # CUMULATIVE buckets (the exposition form): 10 obs <= 1, 10 more
+    # in (1, 2], none in (2, 4] or beyond
+    buckets = {"1": 10, "2": 20, "4": 20, "+Inf": 20}
+    # p50 -> target 10 = exactly the le=1 cumulative: upper edge of
+    # the first bucket
+    assert fleet.percentile_from_buckets(buckets, 0.50) == \
+        pytest.approx(1.0)
+    # p75 -> target 15: halfway through the (1, 2] bucket
+    assert fleet.percentile_from_buckets(buckets, 0.75) == \
+        pytest.approx(1.5)
+    # p100 lands at the top of the last POPULATED bucket
+    assert fleet.percentile_from_buckets(buckets, 1.0) == \
+        pytest.approx(2.0)
+    # observations in +inf clamp to the last finite bound (the
+    # exposition carries no max)
+    assert fleet.percentile_from_buckets({"1": 10, "+Inf": 12}, 1.0) \
+        == pytest.approx(1.0)
+    assert fleet.percentile_from_buckets({}, 0.5) is None
+    assert fleet.percentile_from_buckets({"1": 0, "+Inf": 0}, 0.5) is None
+
+
+# -- health scoring --------------------------------------------------------
+
+
+def test_health_score_pure_deterministic_and_bounded():
+    healthy = {"queue_depth": 0, "kv_utilization": 0.0,
+               "ttft_burn": 0.0, "itl_burn": 0.0, "compile_share": 0.0,
+               "heartbeat_age_s": 0.0, "ttl_s": 15.0}
+    s = fleet.health_score(healthy)
+    assert s == fleet.health_score(dict(healthy))  # deterministic
+    assert s == 1.0
+    assert fleet.health_score({}) == 1.0  # missing keys read healthy
+
+
+def test_health_score_ranks_degraded_below_healthy():
+    base = {"queue_depth": 1, "kv_utilization": 0.3, "ttft_burn": 0.0,
+            "itl_burn": 0.0, "compile_share": 0.05,
+            "heartbeat_age_s": 0.0, "ttl_s": 15.0}
+    healthy = fleet.health_score(base)
+    burning = fleet.health_score({**base, "ttft_burn": 4.0})
+    stalled = fleet.health_score({**base, "queue_depth": 40,
+                                  "itl_burn": 2.0})
+    full_kv = fleet.health_score({**base, "kv_utilization": 0.97})
+    assert burning < healthy and stalled < healthy and full_kv < healthy
+    # more burn is strictly worse
+    assert fleet.health_score({**base, "ttft_burn": 8.0}) < burning
+
+
+def test_health_score_freshness_decay():
+    base = {"ttl_s": 9.0}
+    assert fleet.health_score({**base, "heartbeat_age_s": 0.0}) == 1.0
+    # within one beat period (ttl/3): no penalty
+    assert fleet.health_score({**base, "heartbeat_age_s": 2.9}) == 1.0
+    mid = fleet.health_score({**base, "heartbeat_age_s": 6.0})
+    assert 0.0 < mid < 1.0
+    late = fleet.health_score({**base, "heartbeat_age_s": 8.5})
+    assert 0.0 < late < mid
+    # at/past the TTL: route to zero
+    assert fleet.health_score({**base, "heartbeat_age_s": 9.0}) == 0.0
+    assert fleet.health_score({**base, "heartbeat_age_s": 99.0}) == 0.0
+
+
+def test_snapshot_from_scrape():
+    parsed = export.parse_prometheus(_R2)
+    snap = fleet.snapshot_from_scrape(parsed, heartbeat_age_s=1.0,
+                                      ttl_s=15.0, uptime_s=100.0)
+    assert snap["queue_depth"] == 1
+    # budget 500000us snaps to +Inf (no finite bound >= it in _R2's
+    # tiny bucket set): everything within budget, zero burn
+    assert snap["ttft_burn"] == 0.0
+    assert snap["heartbeat_age_s"] == 1.0 and snap["ttl_s"] == 15.0
+    # a tight budget makes the 1/7 over-500us observations burn
+    saved = paddle.get_flags(["FLAGS_slo_ttft_budget_us"])
+    try:
+        paddle.set_flags({"FLAGS_slo_ttft_budget_us": 400})
+        snap2 = fleet.snapshot_from_scrape(parsed, uptime_s=100.0)
+        assert snap2["ttft_burn"] == pytest.approx(
+            (1 / 7) / (1 - 0.99), rel=1e-6)
+    finally:
+        paddle.set_flags(saved)
+
+
+# -- registry / registrar --------------------------------------------------
+
+
+def test_registrar_registers_heartbeats_and_deregisters(store):
+    before = metrics.snapshot("fleet.")
+    reg = fleet.Registrar(store, "http://127.0.0.1:1",
+                          replica_id="ra", ttl_s=0.6,
+                          status_fn=lambda: "READY")
+    reg.start()
+    members = fleet.read_members(store)
+    assert len(members) == 1
+    m = members[0]
+    assert m["replica_id"] == "ra" and m["url"] == "http://127.0.0.1:1"
+    assert m["state"] == "READY" and m["git_sha"]
+    assert {"host", "pid", "start_ts", "heartbeat_ts",
+            "ttl_s"} <= set(m)
+    hb0 = m["heartbeat_ts"]
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        cur = fleet.read_members(store)[0]["heartbeat_ts"]
+        if cur > hb0:
+            break
+        time.sleep(0.05)
+    assert fleet.read_members(store)[0]["heartbeat_ts"] > hb0
+    reg.deregister()
+    assert fleet.read_members(store) == []
+    after = metrics.snapshot("fleet.")
+    assert after["fleet.registered"] - before["fleet.registered"] == 1
+    assert after["fleet.heartbeats"] > before["fleet.heartbeats"]
+    assert after["fleet.deregistered"] - \
+        before["fleet.deregistered"] == 1
+
+
+def test_disarmed_is_counter_silent_noop(model, store):
+    """FLAGS_fleet=0 (or no store): serve_metrics behaves exactly as
+    before the fleet layer existed — no registration, no heartbeat
+    thread, fleet.* counters silent."""
+    assert fleet.armed(None) is False
+    saved = paddle.get_flags(["FLAGS_fleet"])
+    paddle.set_flags({"FLAGS_fleet": False})
+    try:
+        assert fleet.armed(store) is False
+        before = metrics.snapshot("fleet.")
+        eng = _engine(model)
+        srv = eng.serve_metrics(store=store, replica_id="nope")
+        assert eng._registrar is None
+        assert fleet.read_members(store) == []
+        h = eng.submit(_prompts(0, [6])[0], max_new_tokens=3)
+        eng.run_until_idle()
+        assert h.status == "DONE"
+        eng.drain()  # drain still works, just nothing to deregister
+        eng.close()
+        after = metrics.snapshot("fleet.")
+        assert after == before, "fleet counters must stay silent"
+        assert srv is not None
+    finally:
+        paddle.set_flags(saved)
+
+
+# -- federation end-to-end (acceptance) ------------------------------------
+
+
+def test_two_replica_federation_and_heartbeat_death(model, store):
+    paddle.set_flags({"FLAGS_fleet_ttl_s": 0.6})
+    try:
+        e1 = _engine(model)
+        e2 = _engine(model)
+        e1.serve_metrics(store=store, replica_id="r1")
+        e2.serve_metrics(store=store, replica_id="r2")
+        for e in (e1, e2):
+            for p in _prompts(1, [5, 9]):
+                e.submit(p, max_new_tokens=3)
+            e.run_until_idle()
+        agg = fleet.FleetAggregator(store=store)
+        st = agg.refresh(force=True)
+        assert {r["replica_id"] for r in st["replicas"]} == {"r1", "r2"}
+        per, merged = st["per_replica"], st["merged"]
+        # counters merge by sum of what each replica's scrape reported
+        for key in ("serving_completed", "serving_admitted",
+                    "serving_decoded_tokens"):
+            assert merged[key]["value"] == pytest.approx(
+                sum(p[key]["value"] for p in per.values())), key
+        # histograms merge bucket-wise
+        for le, cum in merged["serving_ttft_us"]["buckets"].items():
+            assert cum == pytest.approx(sum(
+                p["serving_ttft_us"]["buckets"][le]
+                for p in per.values())), le
+        assert merged["serving_ttft_us"]["count"] == pytest.approx(sum(
+            p["serving_ttft_us"]["count"] for p in per.values()))
+        # the merged exposition round-trips over the fleet server
+        with fleet.FleetServer(agg) as fs:
+            text = urllib.request.urlopen(
+                fs.url("/fleet/metrics"), timeout=10).read().decode()
+            back = export.parse_prometheus(text)
+            assert back["serving_completed"]["value"] == \
+                merged["serving_completed"]["value"]
+            k = 'serving_completed{replica_id="r1"}'
+            assert back[k]["value"] == \
+                per["r1"]["serving_completed"]["value"]
+            body = _get_json(fs.url("/fleet/replicas"))
+            assert {r["replica_id"] for r in body["replicas"]} == \
+                {"r1", "r2"}
+            assert body["fleet"]["replicas_live"] == 2
+            assert "slo_ttft_p95_us" in body["fleet"]
+            for r in body["replicas"]:
+                assert 0.0 <= r["health"] <= 1.0
+
+            # kill r2's heartbeat: the per-replica fault site fails
+            # every beat from now on
+            fired0 = metrics.snapshot("fleet.")["fleet.alerts.fired"]
+            faults.arm("fleet.heartbeat.r2", nth=1, count=10 ** 6)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                st = agg.refresh(force=True)
+                if {r["replica_id"] for r in st["replicas"]} == {"r1"}:
+                    break
+                time.sleep(0.1)
+            # aged out of /fleet/replicas ...
+            body = _get_json(fs.url("/fleet/replicas"))
+            assert {r["replica_id"] for r in body["replicas"]} == \
+                {"r1"}
+            # ... and replica.down fired ONCE for the episode
+            alerts = _get_json(fs.url("/fleet/alerts"))
+            downs = [i for i in alerts["aggregator"]["active"]
+                     if i["rule"] == "replica.down"
+                     and i["replica_id"] == "r2"]
+            assert len(downs) == 1
+            agg.refresh(force=True)  # stays one episode across sweeps
+            agg.refresh(force=True)
+            fired = metrics.snapshot("fleet.")["fleet.alerts.fired"]
+            assert fired - fired0 == 1
+            # heartbeat resumes -> the incident resolves, r2 returns
+            faults.disarm("fleet.heartbeat.r2")
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                st = agg.refresh(force=True)
+                if {r["replica_id"] for r in st["replicas"]} == \
+                        {"r1", "r2"}:
+                    break
+                time.sleep(0.1)
+            assert {r["replica_id"] for r in st["replicas"]} == \
+                {"r1", "r2"}
+            assert not [i for i in agg.active_alerts()
+                        if i["rule"] == "replica.down"]
+        e1.close()
+        e2.close()
+    finally:
+        paddle.set_flags({"FLAGS_fleet_ttl_s": 15.0})
+
+
+def test_label_values_escape_and_roundtrip():
+    c = metrics.counter("fleettest.esc")
+    c.inc(2)
+    labels = {"replica_id": 'eu"1\\x'}
+    text = export.render_prometheus(prefix="fleettest.", labels=labels)
+    parsed = export.parse_prometheus(text)
+    entry = [e for e in parsed.values()
+             if e.get("name") == "fleettest_esc"][0]
+    assert entry["value"] == c.value
+    assert entry["labels"] == labels  # unescaped back to the raw value
+    # and the re-render agrees byte-for-byte on the sample line
+    again = export.parse_prometheus(export.render_parsed(parsed))
+    assert [e for e in again.values()
+            if e.get("name") == "fleettest_esc"][0]["labels"] == labels
+
+
+def test_registrar_adopts_process_identity(store):
+    default = metrics.replica_identity()["replica_id"]
+    reg = fleet.Registrar(store, "http://127.0.0.1:1",
+                          replica_id="named-7", ttl_s=5.0)
+    reg.start()
+    try:
+        # replica_info / dump() now agree with the registry name ...
+        assert metrics.replica_identity()["replica_id"] == "named-7"
+        # ... but never clobber an explicit operator override
+        reg2 = fleet.Registrar(store, "http://127.0.0.1:2",
+                               replica_id="second", ttl_s=5.0)
+        reg2.start()
+        assert metrics.replica_identity()["replica_id"] == "named-7"
+        reg2.deregister()
+        assert metrics.replica_identity()["replica_id"] == "named-7"
+    finally:
+        reg.deregister()
+    assert metrics.replica_identity()["replica_id"] == default
+
+
+def test_permanently_dead_replica_keeps_incident_active(store):
+    """A replica that dies for good fires replica.down ONCE and the
+    incident STAYS active even after the registry GC removes its
+    entry — resolution requires a live reappearance, not mere
+    disappearance (the fleet is still short a replica)."""
+    slot = int(store.add(fleet.SEQ_KEY, 1))
+    store.set(fleet.MEMBER_KEY_FMT.format(slot), json.dumps({
+        "replica_id": "ghost", "url": "http://127.0.0.1:1",
+        "heartbeat_ts": time.time() - 100.0, "ttl_s": 0.5,
+        "slot": slot, "host": "x", "pid": 1, "start_ts": 0.0}))
+    agg = fleet.FleetAggregator(store=store, ttl_s=0.5)
+    agg.refresh(force=True)
+    downs = [i for i in agg.active_alerts()
+             if i["rule"] == "replica.down"]
+    assert len(downs) == 1 and downs[0]["replica_id"] == "ghost"
+    # the entry was stale beyond 3x ttl: GC removed it from the scan
+    assert store.try_get(fleet.MEMBER_KEY_FMT.format(slot)) is None
+    fired = metrics.snapshot("fleet.")["fleet.alerts.fired"]
+    agg.refresh(force=True)
+    agg.refresh(force=True)
+    still = [i for i in agg.active_alerts()
+             if i["rule"] == "replica.down"]
+    assert len(still) == 1, "incident must survive the GC"
+    assert metrics.snapshot("fleet.")["fleet.alerts.fired"] == fired
+
+
+def test_aggregator_static_replicas_and_trace_federation(model):
+    """Storeless (static URL list) discovery + /fleet/traces/<id>
+    federated lookup stitching a replica-tagged trace."""
+    paddle.set_flags({"FLAGS_trace_enable": True})
+    eng = _engine(model)
+    eng.serve_metrics()
+    h = eng.submit(_prompts(2, [6])[0], max_new_tokens=3)
+    eng.run_until_idle()
+    assert h.status == "DONE" and h.trace_id
+    srv = eng._metrics_server
+    agg = fleet.FleetAggregator(
+        replicas=[{"replica_id": "solo", "url": srv.url("")}])
+    st = agg.refresh(force=True)
+    assert [r["replica_id"] for r in st["replicas"]] == ["solo"]
+    with fleet.FleetServer(agg) as fs:
+        trace = _get_json(fs.url(f"/fleet/traces/{h.trace_id}"))
+        assert trace["trace_id"] == h.trace_id
+        assert trace["replicas"] == ["solo"]
+        names = {ev["name"] for ev in trace["traceEvents"]}
+        assert "serving.request" in names
+        assert all(ev["args"]["replica_id"] == "solo"
+                   for ev in trace["traceEvents"])
+        code = None
+        try:
+            urllib.request.urlopen(fs.url("/fleet/traces/nope"),
+                                   timeout=10)
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 404
+    eng.close()
+
+
+# -- drain lifecycle (acceptance) ------------------------------------------
+
+
+def test_drain_completes_inflight_bit_identical_and_flips_readyz(model):
+    prompts = _prompts(3, [6, 10, 7])
+    # undrained reference run
+    ref_eng = _engine(model)
+    refs = []
+    for p in prompts:
+        h = ref_eng.submit(p, max_new_tokens=6)
+        ref_eng.run_until_idle()
+        refs.append(h.tokens())
+    ref_eng.close()
+
+    eng = _engine(model)
+    srv = eng.serve_metrics()
+    assert eng.lifecycle == Lifecycle.READY
+    assert _get_json(srv.url("/readyz"))["state"] == "READY"
+    states_seen = []
+    handles = [eng.submit(p, max_new_tokens=6,
+                          on_token=lambda t: states_seen.append(
+                              eng.lifecycle))
+               for p in prompts]
+    before = metrics.snapshot("serving.")
+    eng.drain()
+    after = metrics.snapshot("serving.")
+    # every in-flight request finished, statuses + outputs unchanged
+    for h, ref in zip(handles, refs):
+        assert h.status == "DONE"
+        assert h.tokens() == ref
+    # tokens emitted while draining observed the DRAINING state
+    assert Lifecycle.DRAINING in states_seen
+    assert eng.lifecycle == Lifecycle.CLOSED
+    assert after["serving.drain.started"] - \
+        before["serving.drain.started"] == 1
+    assert after["serving.drain.completed"] - \
+        before["serving.drain.completed"] == 1
+    # new submissions are rejected ...
+    with pytest.raises(NotReadyError):
+        eng.submit(prompts[0], max_new_tokens=2)
+    # ... /readyz is 503/CLOSED, /healthz still live for a final scrape
+    try:
+        urllib.request.urlopen(srv.url("/readyz"), timeout=10)
+        code = 200
+    except urllib.error.HTTPError as e:
+        code = e.code
+        assert json.loads(e.read())["state"] == "CLOSED"
+    assert code == 503
+    assert _get_json(srv.url("/healthz"))["status"] == "ok"
+    eng.drain()  # idempotent
+    assert metrics.snapshot("serving.")["serving.drain.completed"] == \
+        after["serving.drain.completed"]
+    eng.close()
+
+
+def test_concurrent_replicas_share_one_model_cold_start(model):
+    """Two BACKGROUND engines over one model, submitting from cold
+    concurrently: the paged jit entry points rebind module params to
+    tracers during trace and restore after, so without the per-model
+    paged-call lock (models/llama.py) one driver's restore leaks the
+    other's tracers into the shared params (UnexpectedTracerError —
+    reproduced pre-fix). The in-process fleet pattern makes this a
+    first-class topology."""
+    import jax
+
+    fresh = Llama(LlamaConfig.tiny())  # cold: no jits built yet
+    fresh.eval()
+    engines = [ServingEngine(fresh, max_batch=2, block_size=8,
+                             max_seq_len=64, temperature=0.0,
+                             bucket_cap=32) for _ in (1, 2)]
+    rng = np.random.default_rng(8)
+    try:
+        handles = []
+        for e in engines:
+            for _ in range(2):
+                n = int(rng.integers(4, 16))
+                handles.append(e.submit(
+                    rng.integers(0, 255, (n,)).astype("int64"),
+                    max_new_tokens=4))
+        for h in handles:
+            h.result(timeout=300)
+        assert all(h.status == "DONE" for h in handles)
+        # restore left concrete arrays (not tracers) in the params
+        assert not any(
+            isinstance(p._data, jax.core.Tracer)
+            for _, p in fresh.named_parameters())
+    finally:
+        for e in engines:
+            e.close()
+
+
+def test_drain_raises_when_engine_dies(model):
+    """A drain during which the engine dies is NOT graceful: the
+    in-flight requests terminated ERROR, so drain() re-raises instead
+    of reporting a clean completion (the zero-dropped contract must
+    never be claimed falsely) — but the replica still goes CLOSED."""
+    eng = ServingEngine(model, max_batch=2, block_size=8,
+                        max_seq_len=64, temperature=0.0, bucket_cap=32,
+                        background=True)
+    eng._sched.step = lambda: (_ for _ in ()).throw(
+        RuntimeError("device exploded"))
+    h = eng.submit(_prompts(6, [6])[0], max_new_tokens=4)
+    with pytest.raises(RuntimeError):
+        h.result(timeout=120)
+    before = metrics.snapshot("serving.")["serving.drain.completed"]
+    with pytest.raises(RuntimeError, match="engine died"):
+        eng.drain(timeout=120)
+    assert eng.lifecycle == Lifecycle.CLOSED
+    assert metrics.snapshot("serving.")["serving.drain.completed"] \
+        == before
+    eng.close()
+
+
+def test_drain_background_driver_and_warming_state(model):
+    eng = ServingEngine(model, max_batch=2, block_size=8,
+                        max_seq_len=64, temperature=0.0, bucket_cap=32,
+                        background=True, ready=False)
+    assert eng.lifecycle == Lifecycle.WARMING
+    srv = eng.serve_metrics()
+    body = None
+    try:
+        urllib.request.urlopen(srv.url("/readyz"), timeout=10)
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read())
+    assert body and body["state"] == "WARMING"
+    # WARMING accepts local (warmup) submits; routers just don't route
+    h0 = eng.submit(_prompts(4, [6])[0], max_new_tokens=2)
+    assert h0.result(timeout=120) is not None
+    eng.mark_ready()
+    assert eng.lifecycle == Lifecycle.READY
+    hs = [eng.submit(p, max_new_tokens=5) for p in _prompts(5, [6, 9])]
+    eng.drain(timeout=120)
+    assert eng.lifecycle == Lifecycle.CLOSED
+    for h in hs:
+        assert h.status == "DONE" and len(h.tokens()) == 5
+    with pytest.raises(RuntimeError):
+        eng.mark_ready()  # a drained replica never becomes routable
+    eng.close()

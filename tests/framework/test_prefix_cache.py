@@ -183,7 +183,7 @@ def test_shared_prefix_hit_and_greedy_bit_exact(model):
     eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
                         temperature=0.0, background=False)
     handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
-    eng.drain()
+    eng.run_until_idle()
     after = _snap()
     for h, ref in zip(handles, refs):
         assert h.status == RequestStatus.DONE
@@ -208,7 +208,7 @@ def test_cow_on_shared_tail_append_bit_exact(model):
                         temperature=0.0, background=False)
     h1 = eng.submit(p, max_new_tokens=8)
     h2 = eng.submit(p.copy(), max_new_tokens=8)
-    eng.drain()
+    eng.run_until_idle()
     after = _snap()
     assert h1.tokens() == ref
     assert h2.tokens() == ref
@@ -234,7 +234,7 @@ def test_cow_on_divergence_extension_bit_exact(model):
     ha = eng.submit(a, max_new_tokens=6)
     eng.step()  # admit + register a's chunks before b plans
     hb = eng.submit(b, max_new_tokens=6)
-    eng.drain()
+    eng.run_until_idle()
     after = _snap()
     assert ha.tokens() == ref_a
     assert hb.tokens() == ref_b
@@ -264,7 +264,7 @@ def test_bucket_padding_never_poisons_hashes(model):
     assert plan.digests[1] not in eng.cache._prefix_index
     assert plan.partial_len == 2 and not plan.partial_shared
     hb = eng.submit(b, max_new_tokens=6)
-    eng.drain()
+    eng.run_until_idle()
     assert hb.tokens() == ref_b
     assert ha.status == RequestStatus.DONE
 
@@ -281,7 +281,7 @@ def test_admission_budget_counts_uncovered_tokens(model):
                         temperature=0.0, prefill_token_budget=8,
                         background=False)
     eng.submit(mk(), max_new_tokens=2)
-    eng.drain()  # warm: registers the system prompt's 3 chunks
+    eng.run_until_idle()  # warm: registers the system prompt's 3 chunks
     eng.submit(mk(), max_new_tokens=2)
     eng.submit(mk(), max_new_tokens=2)
     eng.step()
@@ -290,7 +290,7 @@ def test_admission_budget_counts_uncovered_tokens(model):
         r for r in eng.scheduler.finished.values()
         if r.status == RequestStatus.DONE]) >= 3
     assert len(eng.scheduler.queue) == 0
-    eng.drain()
+    eng.run_until_idle()
 
 
 # -- eviction-before-preemption ordering --------------------------------
@@ -311,11 +311,11 @@ def test_eviction_runs_before_preemption(model):
     eng = ServingEngine(model, max_batch=2, block_size=4, max_seq_len=32,
                         num_blocks=11, temperature=0.0, background=False)
     eng.submit(a, max_new_tokens=4)
-    eng.drain()
+    eng.run_until_idle()
     assert eng.cache.num_cached_blocks() == 2
     h1 = eng.submit(p1, max_new_tokens=12)
     h2 = eng.submit(p2, max_new_tokens=12)
-    eng.drain()
+    eng.run_until_idle()
     after = _snap()
     assert h1.tokens() == refs[0] and h2.tokens() == refs[1]
     assert after["serving.prefix.evictions"] >= \
@@ -346,7 +346,7 @@ def test_oversubscribed_mixed_shared_unique(model):
                         temperature=0.0, background=False)
     handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
     handles[5].cancel()
-    eng.drain()
+    eng.run_until_idle()
     for i, h in enumerate(handles):
         assert h.status in RequestStatus.TERMINAL
         if i == 5:
@@ -375,7 +375,7 @@ def test_flag_off_reverts_to_private_blocks(model):
                         temperature=0.0, background=False,
                         prefix_cache=False)
     handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
-    eng.drain()
+    eng.run_until_idle()
     after = _snap()
     for h, ref in zip(handles, refs):
         assert h.status == RequestStatus.DONE

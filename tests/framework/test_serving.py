@@ -78,7 +78,7 @@ def test_streaming_order_and_equivalence(model):
     eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
                         temperature=0.0, background=False)
     handles = [eng.submit(p, max_new_tokens=8) for p in prompts]
-    eng.drain()
+    eng.run_until_idle()
     for h, ref in zip(handles, refs):
         assert h.status == RequestStatus.DONE
         assert h.tokens() == ref
@@ -93,7 +93,7 @@ def test_streaming_callback(model):
     eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
                         temperature=0.0, background=False)
     h = eng.submit(p, max_new_tokens=6, on_token=got.append)
-    eng.drain()
+    eng.run_until_idle()
     assert got == ref == h.tokens()
 
 
@@ -170,7 +170,7 @@ def test_preempt_reprefill_identical_greedy(model):
                         num_blocks=8, temperature=0.0, background=False)
     h1 = eng.submit(p1, max_new_tokens=12)
     h2 = eng.submit(p2, max_new_tokens=12)
-    eng.drain()
+    eng.run_until_idle()
     assert metrics.snapshot("serving.")["serving.preempt"] > before
     assert h1.status == h2.status == RequestStatus.DONE
     assert h1.tokens() == r1
@@ -194,7 +194,7 @@ def test_prefill_budget_limits_admissions(model):
     assert len(eng.scheduler.queue) == 1
     eng.step()
     assert len(eng.scheduler.running) == 2
-    eng.drain()
+    eng.run_until_idle()
 
 
 def test_oversubscribed_fcfs_and_terminal_statuses(model):
@@ -213,7 +213,7 @@ def test_oversubscribed_fcfs_and_terminal_statuses(model):
         dl = 0.0 if i in (2, 5) else None
         handles.append(eng.submit(p, max_new_tokens=6, deadline_s=dl))
     handles[6].cancel()  # cancelled while still queued
-    eng.drain()
+    eng.run_until_idle()
     for i, h in enumerate(handles):
         if i in (2, 5):
             assert h.status == RequestStatus.TIMEOUT
@@ -239,7 +239,7 @@ def test_queue_bound_rejects(model):
     with pytest.raises(QueueFullError):
         eng.submit(p3, max_new_tokens=4)
     assert metrics.snapshot("serving.")["serving.rejected"] == before + 1
-    eng.drain()
+    eng.run_until_idle()
 
 
 def test_submit_validation(model):
@@ -322,12 +322,12 @@ def test_bucketing_holds_compile_count(model):
     for n in (5, 9, 17):  # buckets 8, 16, 32
         eng.submit(rng.integers(0, 255, (n,)).astype("int64"),
                    max_new_tokens=3)
-        eng.drain()
+        eng.run_until_idle()
     warm = metrics.snapshot()["xla.compile.count"]
     for n in (3, 7, 10, 15, 20, 30):  # same buckets, new lengths
         eng.submit(rng.integers(0, 255, (n,)).astype("int64"),
                    max_new_tokens=3)
-    eng.drain()
+    eng.run_until_idle()
     assert metrics.snapshot()["xla.compile.count"] == warm
 
 
@@ -340,7 +340,7 @@ def test_slo_metrics_and_summary_view(model):
     eng = ServingEngine(model, max_batch=1, block_size=8, max_seq_len=64,
                         temperature=0.0, background=False)
     h = eng.submit(p, max_new_tokens=5)
-    eng.drain()
+    eng.run_until_idle()
     assert h.status == RequestStatus.DONE
     after = metrics.snapshot("serving.")
     assert after["serving.admitted"] == before["serving.admitted"] + 1

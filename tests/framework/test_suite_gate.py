@@ -105,6 +105,25 @@ def test_regression_ledger_tools_map_to_their_tests():
         assert "tests/framework/test_regression_ledger.py" in t, f
 
 
+def test_fleet_surfaces_map_to_their_tests():
+    t = suite_gate.targets_for(["paddle_tpu/profiler/fleet.py"])
+    assert "tests/framework/test_fleet_observatory.py" in t
+    t = suite_gate.targets_for(["tools/fleet_gate.py"])
+    assert "tests/framework/test_fleet_observatory.py" in t
+    # the drain lifecycle lives in the serving frontend; the registry
+    # scan helper lives on the store — both run the fleet pins
+    t = suite_gate.targets_for(["paddle_tpu/serving/frontend.py"])
+    assert "tests/framework/test_fleet_observatory.py" in t
+    assert "tests/framework/test_serving.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/distributed/store.py"])
+    assert "tests/framework/test_fleet_observatory.py" in t
+    # export.py (label-aware parse, /readyz) runs fleet beside the
+    # tracing/accounting pins
+    t = suite_gate.targets_for(["paddle_tpu/profiler/export.py"])
+    assert "tests/framework/test_fleet_observatory.py" in t
+    assert "tests/framework/test_tracing.py" in t
+
+
 def test_fusion_surfaces_map_to_their_tests():
     t = suite_gate.targets_for(["paddle_tpu/passes/fuse.py"])
     assert "tests/framework/test_fusion.py" in t

@@ -232,22 +232,27 @@ def test_train_loop_lazy_hits_and_flush_counters():
 
 def test_cap_flush_labeled_cap():
     from paddle_tpu.core import deferred as dmod
-    x = paddle.to_tensor(_rand(4, 4))
-    before = metrics.snapshot()
-    y = x
-    for _ in range(dmod.DEFER_CAP + 4):
-        y = y * 1.01  # each op a unique node: chain grows to the cap
-    y.numpy()
-    d = _delta(before, metrics.snapshot())
-    # the over-cap flush keeps its specific label — the op-boundary
-    # stamp in apply() is weak and must not clobber it. Default mode
-    # submits the cap flush to the async worker (pipelined capture).
-    assert d.get("deferred.flush.cap", 0) >= 1, d
-    assert d.get("deferred.async.submitted", 0) >= 1, d
-    # sync mode (FLAGS_deferred_async=0): same partition boundaries,
-    # same cap label, flushed inline — async counters stay silent
-    paddle.set_flags({"FLAGS_deferred_async": False})
+    saved = paddle.get_flags(["FLAGS_deferred_async"])
+    # async mode armed EXPLICITLY: the flag defaults off on single-core
+    # hosts now (core.flags.deferred_async_default), and this test pins
+    # both modes regardless of the host
+    paddle.set_flags({"FLAGS_deferred_async": True})
     try:
+        x = paddle.to_tensor(_rand(4, 4))
+        before = metrics.snapshot()
+        y = x
+        for _ in range(dmod.DEFER_CAP + 4):
+            y = y * 1.01  # each op a unique node: chain grows to cap
+        y.numpy()
+        d = _delta(before, metrics.snapshot())
+        # the over-cap flush keeps its specific label — the op-boundary
+        # stamp in apply() is weak and must not clobber it. Async mode
+        # submits the cap flush to the flush worker (pipelined capture).
+        assert d.get("deferred.flush.cap", 0) >= 1, d
+        assert d.get("deferred.async.submitted", 0) >= 1, d
+        # sync mode (FLAGS_deferred_async=0): same partition boundaries,
+        # same cap label, flushed inline — async counters stay silent
+        paddle.set_flags({"FLAGS_deferred_async": False})
         before = metrics.snapshot()
         y = x
         for _ in range(dmod.DEFER_CAP + 4):
@@ -257,7 +262,7 @@ def test_cap_flush_labeled_cap():
         assert d.get("deferred.flush.cap", 0) >= 1, d
         assert d.get("deferred.async.submitted", 0) == 0, d
     finally:
-        paddle.set_flags({"FLAGS_deferred_async": True})
+        paddle.set_flags(saved)
 
 
 def test_noop_flush_does_not_leak_cause():

@@ -164,7 +164,7 @@ def test_serving_request_yields_complete_trace(model):
                         temperature=0.0, background=False)
     h = eng.submit(rng.integers(0, 255, (6,)).astype("int64"),
                    max_new_tokens=5)
-    eng.drain()
+    eng.run_until_idle()
     eng.close()
     assert h.status == "DONE" and h.trace_id is not None
     tr = tracing.get_trace(h.trace_id)
@@ -196,7 +196,7 @@ def test_preempted_request_trace_records_preempt_and_reprefill(model):
                     max_new_tokens=12)
     h2 = eng.submit(rng.integers(0, 255, (8,)).astype("int64"),
                     max_new_tokens=12)
-    eng.drain()
+    eng.run_until_idle()
     eng.close()
     assert h1.status == h2.status == "DONE"
     preempted = [h for h in (h1, h2) if h.preempts > 0]
@@ -222,7 +222,7 @@ def test_slo_exemplars_resolve_to_exportable_traces(model):
                         temperature=0.0, background=False)
     h = eng.submit(rng.integers(0, 255, (6,)).astype("int64"),
                    max_new_tokens=5)
-    eng.drain()
+    eng.run_until_idle()
     eng.close()
     assert h.status == "DONE"
     snap = metrics.snapshot("serving.")
@@ -373,7 +373,7 @@ def test_engine_healthz_reports_dead_after_close(model):
     assert eng.serve_metrics() is srv  # idempotent
     eng.submit(rng.integers(0, 255, (5,)).astype("int64"),
                max_new_tokens=2)
-    eng.drain()
+    eng.run_until_idle()
     hz = json.loads(urllib.request.urlopen(
         srv.url("/healthz"), timeout=10).read())
     assert hz["status"] == "ok" and hz["engine"]["closed"] is False

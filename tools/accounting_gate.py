@@ -129,7 +129,7 @@ def _serve_workload():
                         prefix_cache=False)
     p = [rng.integers(0, 255, (8,)).astype("int64") for _ in range(2)]
     h_pre = [eng.submit(pi, max_new_tokens=12) for pi in p]
-    eng.drain()
+    eng.run_until_idle()
     eng.close()
 
     # phase 2: shared prefix -> hits billed extend-only
@@ -141,11 +141,11 @@ def _serve_workload():
     cold = eng2.submit(_np.concatenate(
         [system, rng.integers(0, 255, (3,)).astype("int64")]),
         max_new_tokens=4, deadline_s=300.0)
-    eng2.drain()
+    eng2.run_until_idle()
     warm = eng2.submit(_np.concatenate(
         [system, rng.integers(0, 255, (3,)).astype("int64")]),
         max_new_tokens=4, deadline_s=300.0)
-    eng2.drain()
+    eng2.run_until_idle()
     return eng, h_pre, eng2, cold, warm
 
 

@@ -14,6 +14,13 @@ skipped on read (a crashed writer must not poison history), and two
 processes appending concurrently each land a complete line (single
 ``write`` of one line under O_APPEND semantics).
 
+Known kinds (each writer documents its metrics): ``regression_gate``
+(tools/regression_gate.py measure mode), ``suite_gate`` (pre-commit
+wall time, advisory), ``eager_gap`` (bench.py eager-vs-jit rung),
+``fusion_gate`` (tools/fusion_gate.py async A/B), ``fleet_gate``
+(tools/fleet_gate.py aggregator refresh + federation checks). The
+ledger itself is schema-free — any kind/metrics pair appends.
+
 CLI::
 
     python tools/bench_ledger.py --show 10                # recent entries

@@ -1,0 +1,267 @@
+"""Fleet-observatory gate: 2 in-process replicas + a FleetAggregator
+through five pass/fail checks, in order of importance:
+
+  1. federation — /fleet/metrics counter values equal the sum of the
+     per-replica scrape values and merged histogram bucket counts
+     equal bucket-wise sums, round-tripped through a real HTTP GET +
+     ``export.parse_prometheus``;
+  2. drain      — ``ServingEngine.drain()`` finishes every in-flight
+     request (zero dropped: all DONE, outputs bit-identical to an
+     undrained run), flips ``/readyz`` READY -> CLOSED, and rejects
+     new submits;
+  3. health     — a degraded replica (heartbeat killed via
+     ``testing/faults``) scores strictly below the healthy one, and
+     the pure ``health_score`` ranks a burning/stalled snapshot
+     strictly below a healthy snapshot;
+  4. overhead   — one aggregator refresh (discover + scrape 2
+     replicas + merge + judge) stays under ``FLEET_GATE_BUDGET_MS``;
+  5. disarmed   — ``FLAGS_fleet=0`` makes serve_metrics(store=...) a
+     no-op with every ``fleet.*`` counter silent.
+
+Budgets are env-overridable (FLEET_GATE_*). Exit 0 on pass, 1 on
+fail; one line per check. Runs under JAX_PLATFORMS=cpu (tier-1 as
+tests/framework/test_fleet_observatory.py); wired into tools/suite_gate.py beside
+the serving/trace/accounting gates, and appends a ``fleet_gate``
+entry to the continuous-bench ledger (tools/bench_ledger.py).
+"""
+
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+BUDGET_MS = float(os.environ.get("FLEET_GATE_BUDGET_MS", "750"))
+TTL_S = float(os.environ.get("FLEET_GATE_TTL_S", "3.0"))
+
+
+def _model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    from paddle_tpu.serving import ServingEngine
+
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("bucket_cap", 32)
+    kw.setdefault("background", False)
+    return ServingEngine(model, **kw)
+
+
+def _prompts(seed, sizes):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 255, (s,)).astype("int64") for s in sizes]
+
+
+def _boot_fleet(model):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.profiler import fleet
+
+    paddle.set_flags({"FLAGS_fleet_ttl_s": TTL_S})
+    store = TCPStore(is_master=True)
+    engines = []
+    for i in (1, 2):
+        eng = _engine(model)
+        eng.serve_metrics(store=store, replica_id=f"r{i}")
+        for p in _prompts(i, [5, 9]):
+            eng.submit(p, max_new_tokens=3)
+        eng.run_until_idle()
+        engines.append(eng)
+    return store, engines, fleet.FleetAggregator(store=store)
+
+
+def check_federation(agg):
+    import json
+    import urllib.request
+
+    from paddle_tpu.profiler import export, fleet
+
+    st = agg.refresh(force=True)
+    per, merged = st["per_replica"], st["merged"]
+    ok = len(st["replicas"]) == 2
+    for key in ("serving_completed", "serving_admitted",
+                "serving_decoded_tokens"):
+        want = sum(p[key]["value"] for p in per.values())
+        ok = ok and abs(merged[key]["value"] - want) < 1e-9
+    buckets_ok = all(
+        abs(cum - sum(p["serving_ttft_us"]["buckets"][le]
+                      for p in per.values())) < 1e-9
+        for le, cum in merged["serving_ttft_us"]["buckets"].items())
+    with fleet.FleetServer(agg) as fs:
+        text = urllib.request.urlopen(fs.url("/fleet/metrics"),
+                                      timeout=10).read().decode()
+        back = export.parse_prometheus(text)
+        http_ok = back["serving_completed"]["value"] == \
+            merged["serving_completed"]["value"] and \
+            back['serving_completed{replica_id="r1"}']["value"] == \
+            per["r1"]["serving_completed"]["value"]
+        body = json.loads(urllib.request.urlopen(
+            fs.url("/fleet/replicas"), timeout=10).read())
+        view_ok = body["fleet"]["replicas_live"] == 2
+    ok = ok and buckets_ok and http_ok and view_ok
+    print(f"[fleet-gate] federation: replicas=2 counter-sums={ok} "
+          f"bucket-wise={buckets_ok} http-roundtrip={http_ok} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_drain(model):
+    import json
+    import urllib.error
+    import urllib.request
+
+    from paddle_tpu.serving import NotReadyError
+
+    prompts = _prompts(7, [6, 10, 7, 5])
+    ref_eng = _engine(model)
+    refs = []
+    for p in prompts:
+        h = ref_eng.submit(p, max_new_tokens=6)
+        ref_eng.run_until_idle()
+        refs.append(h.tokens())
+    ref_eng.close()
+    eng = _engine(model)
+    srv = eng.serve_metrics()
+    ready0 = json.loads(urllib.request.urlopen(
+        srv.url("/readyz"), timeout=10).read())["state"]
+    handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.drain()
+    dropped = sum(1 for h in handles if h.status != "DONE")
+    identical = all(h.tokens() == r for h, r in zip(handles, refs))
+    rejected = False
+    try:
+        eng.submit(prompts[0], max_new_tokens=2)
+    except NotReadyError:
+        rejected = True
+    try:
+        urllib.request.urlopen(srv.url("/readyz"), timeout=10)
+        ready1, code = "READY", 200
+    except urllib.error.HTTPError as e:
+        code = e.code
+        ready1 = json.loads(e.read())["state"]
+    eng.close()
+    ok = ready0 == "READY" and dropped == 0 and identical and \
+        rejected and code == 503 and ready1 == "CLOSED"
+    print(f"[fleet-gate] drain: readyz {ready0}->{ready1}({code}) "
+          f"dropped={dropped} (want 0) bit-identical={identical} "
+          f"submit-rejected={rejected} {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_health(agg):
+    from paddle_tpu.profiler import fleet
+    from paddle_tpu.testing import faults
+
+    # pure-function ranking: burning/stalled strictly below healthy
+    base = {"queue_depth": 1, "kv_utilization": 0.3, "ttft_burn": 0.0,
+            "itl_burn": 0.0, "compile_share": 0.05,
+            "heartbeat_age_s": 0.0, "ttl_s": TTL_S}
+    healthy_s = fleet.health_score(base)
+    burning_s = fleet.health_score({**base, "ttft_burn": 4.0,
+                                    "queue_depth": 40})
+    pure_ok = burning_s < healthy_s and \
+        fleet.health_score(base) == healthy_s
+    # live ranking: kill r2's heartbeat (testing/faults), wait into
+    # the freshness-decay window, r2 must score strictly below r1
+    faults.arm("fleet.heartbeat.r2", nth=1, count=10 ** 6)
+    try:
+        time.sleep(2.0 * TTL_S / 3.0)
+        st = agg.refresh(force=True)
+        scores = {r["replica_id"]: r["health"] for r in st["replicas"]}
+        live_ok = "r1" in scores and \
+            scores.get("r2", -1.0) < scores["r1"]
+    finally:
+        faults.disarm("fleet.heartbeat.r2")
+    ok = pure_ok and live_ok
+    print(f"[fleet-gate] health: burning {burning_s:.3f} < healthy "
+          f"{healthy_s:.3f} ({pure_ok}); degraded-replica scores "
+          f"{scores} ({live_ok}) {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_overhead(agg):
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        agg.refresh(force=True)
+        times.append((time.perf_counter() - t0) * 1000.0)
+    med = statistics.median(times)
+    ok = med < BUDGET_MS
+    print(f"[fleet-gate] overhead: refresh median {med:.1f}ms over "
+          f"{len(times)} sweeps budget={BUDGET_MS}ms "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok, med
+
+
+def check_disarmed(model):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.profiler import fleet, metrics
+
+    saved = paddle.get_flags(["FLAGS_fleet"])
+    paddle.set_flags({"FLAGS_fleet": False})
+    try:
+        store = TCPStore(is_master=True)
+        before = metrics.snapshot("fleet.")
+        eng = _engine(model)
+        eng.serve_metrics(store=store, replica_id="silent")
+        eng.submit(_prompts(9, [6])[0], max_new_tokens=3)
+        eng.run_until_idle()
+        eng.drain()
+        eng.close()
+        members = fleet.read_members(store)
+        after = metrics.snapshot("fleet.")
+        ok = after == before and members == []
+    finally:
+        paddle.set_flags(saved)
+    print(f"[fleet-gate] disarmed: members={len(members)} (want 0) "
+          f"counter-silent={after == before} {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def main():
+    model = _model()
+    store, engines, agg = _boot_fleet(model)
+    ok1 = check_federation(agg)
+    ok2 = check_drain(model)
+    ok3 = check_health(agg)
+    ok4, refresh_ms = check_overhead(agg)
+    for eng in engines:
+        eng.close()
+    ok5 = check_disarmed(model)
+    ok = ok1 and ok2 and ok3 and ok4 and ok5
+    try:
+        import bench_ledger
+        bench_ledger.append_entry("fleet_gate", {
+            "fleet_refresh_ms": round(refresh_ms, 3),
+            "fleet_replicas": 2.0,
+            "fleet_federation_ok": 1.0 if ok1 else 0.0,
+            "fleet_drain_ok": 1.0 if ok2 else 0.0})
+        print(f"[fleet-gate] ledger: appended fleet_gate "
+              f"(refresh {refresh_ms:.1f}ms)")
+    except Exception as e:  # noqa: BLE001 — ledger trouble is advisory
+        print(f"[fleet-gate] ledger append skipped "
+              f"({type(e).__name__}: {e})")
+    print(f"[fleet-gate] {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

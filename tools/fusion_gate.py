@@ -167,7 +167,11 @@ def check_backpressure():
     from paddle_tpu.profiler import metrics
     from paddle_tpu.testing import faults
 
-    prev = paddle.get_flags(["FLAGS_deferred_inflight"])
+    # arm async EXPLICITLY: the flag defaults OFF on single-core hosts
+    # (core.flags.deferred_async_default) and this check exercises the
+    # async worker's window
+    prev = paddle.get_flags(["FLAGS_deferred_inflight",
+                             "FLAGS_deferred_async"])
     x = paddle.to_tensor(np.random.default_rng(2)
                          .standard_normal((8, 8)).astype("float32"))
 
@@ -177,6 +181,7 @@ def check_backpressure():
             y = (y * 1.001).abs() + 0.01
         return y.numpy()
 
+    paddle.set_flags({"FLAGS_deferred_async": True})
     ref = loop()
     paddle.set_flags({"FLAGS_deferred_inflight": 1})
     try:

@@ -97,12 +97,12 @@ def check_economics_and_exactness(model, prompts, refs):
     h0 = eng.submit(prompts[0], max_new_tokens=6)
     eng.step()
     cold_ttft_ms = (time.perf_counter() - t0) * 1000.0
-    eng.drain()
+    eng.run_until_idle()
     # warm the tail-extend program and the COW copy (their one-off XLA
     # compiles would otherwise dominate the measured warm TTFT)
     warm_handles = [eng.submit(p, max_new_tokens=6)
                     for p in prompts[1:3]]
-    eng.drain()
+    eng.run_until_idle()
     before = metrics.snapshot("serving.")
     t0 = time.perf_counter()
     handles = [eng.submit(p, max_new_tokens=6) for p in prompts[3:]]
@@ -112,7 +112,7 @@ def check_economics_and_exactness(model, prompts, refs):
                       for s in eng.scheduler.running)
     peak_physical = (eng.cache.num_blocks - 1
                      - eng.cache.num_free_blocks())
-    eng.drain()
+    eng.run_until_idle()
     after = metrics.snapshot("serving.")
 
     hits = after["serving.prefix.hit_blocks"] - \
@@ -168,11 +168,11 @@ def check_eviction_floor(model):
                         num_blocks=11, temperature=0.0, background=False)
     eng.submit(rng.integers(0, 255, (8,)).astype("int64"),
                max_new_tokens=4)
-    eng.drain()
+    eng.run_until_idle()
     cached = eng.cache.num_cached_blocks()
     hs = [eng.submit(rng.integers(0, 255, (8,)).astype("int64"),
                      max_new_tokens=12) for _ in range(2)]
-    eng.drain()
+    eng.run_until_idle()
     after = metrics.snapshot("serving.")
     evictions = after["serving.prefix.evictions"] - \
         before["serving.prefix.evictions"]
@@ -197,7 +197,7 @@ def check_flag_off_revert(model, prompts, refs):
                         bucket_cap=CAP, background=False,
                         prefix_cache=False)
     handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
-    eng.drain()
+    eng.run_until_idle()
     after = metrics.snapshot("serving.prefix.")
     moved = {k for k in after if after[k] != before[k]}
     exact = all(h.tokens() == r for h, r in zip(handles, refs))

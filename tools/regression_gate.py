@@ -133,14 +133,14 @@ def measure():
     for n in (5, 9, 17):
         eng.submit(rng.integers(0, 255, (n,)).astype("int64"),
                    max_new_tokens=4)
-        eng.drain()
+        eng.run_until_idle()
     before = metrics.snapshot("serving.")
     t0 = time.perf_counter()
     h = eng.submit(rng.integers(0, 255, (6,)).astype("int64"),
                    max_new_tokens=8)
     eng.step()
     ttft_ms = (time.perf_counter() - t0) * 1000.0
-    eng.drain()
+    eng.run_until_idle()
     after = metrics.snapshot("serving.")
     steps = after["serving.step_us"]["count"] - \
         before["serving.step_us"]["count"]

@@ -53,13 +53,13 @@ def check_no_warm_recompiles(model):
     for n in (5, 9, 17):  # warm buckets 8, 16, 32
         eng.submit(rng.integers(0, 255, (n,)).astype("int64"),
                    max_new_tokens=4)
-        eng.drain()
+        eng.run_until_idle()
     warm = metrics.snapshot()["xla.compile.count"]
     t0 = time.perf_counter()
     handles = [eng.submit(rng.integers(0, 255, (n,)).astype("int64"),
                           max_new_tokens=6)
                for n in (3, 7, 10, 14, 20, 25, 30, 12)]
-    eng.drain()
+    eng.run_until_idle()
     dt = time.perf_counter() - t0
     compiles = metrics.snapshot()["xla.compile.count"] - warm
     done = all(h.status == "DONE" for h in handles)
@@ -92,7 +92,7 @@ def check_preemption(model):
                         num_blocks=8, temperature=0.0, background=False)
     h1 = eng.submit(p1, max_new_tokens=12)
     h2 = eng.submit(p2, max_new_tokens=12)
-    eng.drain()
+    eng.run_until_idle()
     preempts = metrics.snapshot("serving.")["serving.preempt"] - before
     match = h1.tokens() == refs[0] and h2.tokens() == refs[1]
     ok = preempts >= 1 and match and \
@@ -115,14 +115,14 @@ def check_latency(model):
     # warm the bucket + decode program
     eng.submit(rng.integers(0, 255, (6,)).astype("int64"),
                max_new_tokens=4)
-    eng.drain()
+    eng.run_until_idle()
     before = metrics.snapshot("serving.")
     t0 = time.perf_counter()
     h = eng.submit(rng.integers(0, 255, (6,)).astype("int64"),
                    max_new_tokens=8)
     eng.step()
     ttft_ms = (time.perf_counter() - t0) * 1000.0
-    eng.drain()
+    eng.run_until_idle()
     after = metrics.snapshot("serving.")
     steps = after["serving.step_us"]["count"] - \
         before["serving.step_us"]["count"]
@@ -151,7 +151,7 @@ def check_reclamation(model):
     eng.step()
     h1.cancel()
     time.sleep(0.06)
-    eng.drain()
+    eng.run_until_idle()
     usable = eng.cache.num_blocks - 1
     free = eng.cache.num_free_blocks()
     ok = free == usable and h1.status == "CANCELLED" and \

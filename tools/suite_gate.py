@@ -39,14 +39,16 @@ _MAP = [
     ("paddle_tpu/core/resilience.py", ["tests/framework/test_chaos.py",
                                        "tests/framework/test_serving.py"]),
     ("paddle_tpu/serving/", ["tests/framework/test_serving.py",
-                             "tests/framework/test_prefix_cache.py"]),
+                             "tests/framework/test_prefix_cache.py",
+                             "tests/framework/test_fleet_observatory.py"]),
     ("paddle_tpu/inference/", ["tests/framework/test_paged_decode.py",
                                "tests/framework/test_serving.py",
                                "tests/framework/test_prefix_cache.py"]),
     ("paddle_tpu/models/llama.py",
      ["tests/framework/test_paged_decode.py",
       "tests/framework/test_prefix_cache.py",
-      "tests/framework/test_serving.py"]),
+      "tests/framework/test_serving.py",
+      "tests/framework/test_fleet_observatory.py"]),
     ("paddle_tpu/models/generation.py",
      ["tests/framework/test_serving.py",
       "tests/framework/test_paged_decode.py",
@@ -73,10 +75,15 @@ _MAP = [
       "tests/framework/test_serving.py"]),
     ("paddle_tpu/profiler/alerts.py",
      ["tests/framework/test_accounting.py"]),
+    ("paddle_tpu/profiler/fleet.py",
+     ["tests/framework/test_fleet_observatory.py"]),
     ("paddle_tpu/profiler/", ["tests/framework/test_profiler_protobuf.py",
                               "tests/framework/test_telemetry.py",
                               "tests/framework/test_tracing.py",
-                              "tests/framework/test_accounting.py"]),
+                              "tests/framework/test_accounting.py",
+                              "tests/framework/test_fleet_observatory.py"]),
+    ("paddle_tpu/distributed/store.py",
+     ["tests/framework/test_fleet_observatory.py", "tests/framework/test_chaos.py"]),
     ("paddle_tpu/jit/", ["tests/jit"]),
     ("bench.py", []),   # bench has no pytest surface; exercised by driver
     ("tools/metrics_gate.py", ["tests/framework/test_metrics_gate.py"]),
@@ -92,6 +99,7 @@ _MAP = [
     ("tools/prefix_gate.py", ["tests/framework/test_prefix_cache.py"]),
     ("tools/trace_gate.py", ["tests/framework/test_tracing.py"]),
     ("tools/accounting_gate.py", ["tests/framework/test_accounting.py"]),
+    ("tools/fleet_gate.py", ["tests/framework/test_fleet_observatory.py"]),
     ("tools/bench_ledger.py",
      ["tests/framework/test_regression_ledger.py"]),
     ("tools/regression_gate.py",

@@ -87,7 +87,7 @@ def _serve_one():
                         temperature=0.0, background=False)
     handle = eng.submit(rng.integers(0, 255, (6,)).astype("int64"),
                         max_new_tokens=5)
-    eng.drain()
+    eng.run_until_idle()
     return eng, handle
 
 
