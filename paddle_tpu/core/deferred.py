@@ -462,6 +462,22 @@ def _build_chain_jf(descr, n_leaves, out_ixs):
     return jf
 
 
+def _maybe_aot_wrap(jf, label):
+    """Route a FRESH chain program through the persistent AOT compile
+    cache (serving/aot_cache.py) — a new process with a warm cache
+    replays its steady-state chains without one XLA compile. Wrapped
+    unconditionally, like the llama entry points: AOTFunction checks
+    arming per call (one epoch-memoized flag read), so a chain built
+    before the operator configures the cache dir still participates
+    once armed, and the disarmed path forwards straight to the plain
+    jitted callable — byte-for-byte pre-cache."""
+    try:
+        from ..serving.aot_cache import wrap
+        return wrap(jf, tag=label)
+    except Exception:  # noqa: BLE001 — caching must never break a flush
+        return jf
+
+
 def _timed_first_call(jf, args):
     """First call of a fresh jf pays trace+compile: time it (the
     jax.monitoring listener in profiler.metrics counts the true backend
@@ -835,8 +851,10 @@ def _exec_verbatim(nodes, leaves, consts, out_ixs, dtype, rec=None):
     jf = _jit_cache_get(key)
     fresh = jf is None
     if fresh:
-        jf = _build_chain_jf([(e.fn, spec, e.kwargs) for e, spec in nodes],
-                             len(leaves), out_ixs)
+        jf = _maybe_aot_wrap(
+            _build_chain_jf([(e.fn, spec, e.kwargs) for e, spec in nodes],
+                            len(leaves), out_ixs),
+            "deferred.verbatim")
         jf, fresh = _jit_cache_insert(key, jf)
     if not fresh:
         _C_JIT_HIT.inc()
@@ -893,9 +911,11 @@ def _exec_optimized(nodes, leaves, consts, out_ixs, dtype, rec=None):
         jf = _jit_cache_get(key)
         fresh = jf is None
         if fresh:
-            jf = _build_chain_jf(
-                [(n.fn, n.args, n.kwargs) for n in g.nodes],
-                len(g.leaves), node_outs)
+            jf = _maybe_aot_wrap(
+                _build_chain_jf(
+                    [(n.fn, n.args, n.kwargs) for n in g.nodes],
+                    len(g.leaves), node_outs),
+                f"deferred.{key[0]}")
             jf, fresh = _jit_cache_insert(key, jf)
         if not fresh:
             _C_JIT_HIT.inc()

@@ -270,6 +270,34 @@ define_flag("FLAGS_fleet_scrape_timeout_s", 2.0,
             "per-replica HTTP scrape timeout for the FleetAggregator; "
             "a replica that cannot be scraped within it counts as a "
             "scrape failure (staleness feeds replica.down)")
+define_flag("FLAGS_serving_aot_cache", True,
+            "persistent AOT compile cache (serving/aot_cache.py): the "
+            "serving-path jit entry points (llama paged prefill buckets "
+            "/ extend / decode, deferred-chain programs) lower().compile"
+            "() through an on-disk store of serialized XLA executables, "
+            "so a fresh process with a warm cache boots zero-compile; "
+            "armed only when FLAGS_aot_cache_dir names a directory; 0 "
+            "reverts to plain jax.jit byte-for-byte with jit.aot.* "
+            "counter silence")
+define_flag("FLAGS_aot_cache_dir",
+            os.environ.get("PADDLE_TPU_AOT_CACHE", ""),
+            "directory of the persistent AOT compile cache (empty = "
+            "disarmed); also settable via the PADDLE_TPU_AOT_CACHE env "
+            "var. Entries are crc32-guarded and staged+os.replace-"
+            "committed (checkpoint-v2 discipline); corrupt entries "
+            "quarantine to *.corrupt-N and recompile")
+define_flag("FLAGS_serving_router", True,
+            "multi-replica router (serving/router.py): weights request "
+            "placement by fleet health scores, refuses non-READY "
+            "replicas, retries failed submits on the next-best replica "
+            "and fails over requests whose replica died; 0 (read at "
+            "Router construction, like FLAGS_serving_accounting) makes "
+            "Router a byte-for-byte pass-through to its first replica "
+            "with router.* counter silence")
+define_flag("FLAGS_router_max_failovers", 3,
+            "max times the router will re-submit one request after its "
+            "replica died mid-flight before the engine error propagates "
+            "(a completed request is NEVER re-submitted)")
 define_flag("FLAGS_fleet_skew_ratio", 2.5,
             "fleet.skew alert threshold: a replica whose TTFT p95 "
             "exceeds this multiple of the fleet median p95 (both from "

@@ -26,6 +26,20 @@ from ..nn import functional as F
 _PAGED_LOCK_INIT = threading.Lock()
 
 
+def _aot_wrap(jitted, tag):
+    """Route a serving-path jit entry point through the persistent AOT
+    compile cache (serving/aot_cache.py): a fresh process with a warm
+    cache loads the serialized executable instead of compiling. The
+    wrapper forwards straight to ``jitted`` until a cache dir is
+    configured (FLAGS_serving_aot_cache / FLAGS_aot_cache_dir), so the
+    production default is byte-for-byte plain jax.jit."""
+    try:
+        from ..serving.aot_cache import wrap
+        return wrap(jitted, tag)
+    except Exception:  # noqa: BLE001 — a broken cache layer must not block serving
+        return jitted
+
+
 @dataclasses.dataclass
 class LlamaConfig:
     vocab_size: int = 32000
@@ -370,7 +384,8 @@ class Llama(nn.Layer):
                 ks = [k._data[0] for k, _ in sink]
                 vs = [v._data[0] for _, v in sink]
                 return tok[0], ks, vs
-            self._paged_prefill_jit = jax.jit(fn)
+            self._paged_prefill_jit = _aot_wrap(jax.jit(fn),
+                                                "llama.paged_prefill")
 
         with self._paged_lock():
             arrs = self._param_arrays()
@@ -472,7 +487,8 @@ class Llama(nn.Layer):
                                          temperature=1.0, key=key),
                     lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
                 return tok[0], new_k, new_v
-            self._paged_extend_jit = jax.jit(fn)
+            self._paged_extend_jit = _aot_wrap(jax.jit(fn),
+                                               "llama.paged_extend")
 
         with self._paged_lock():
             arrs = self._param_arrays()
@@ -547,7 +563,8 @@ class Llama(nn.Layer):
                                          temperature=1.0, key=key),
                     lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
                 return nxt, new_k, new_v
-            self._paged_decode_jit = jax.jit(fn)
+            self._paged_decode_jit = _aot_wrap(jax.jit(fn),
+                                               "llama.paged_decode")
 
         with self._paged_lock():
             arrs = self._param_arrays()

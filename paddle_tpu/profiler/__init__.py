@@ -309,6 +309,44 @@ def _goodput_view(snap):
     return lines
 
 
+def _cold_start_view(snap):
+    """"Cold start" summary section: the persistent AOT compile cache
+    (serving/aot_cache.py) — hits/misses/stores against the on-disk
+    executable store, payload bytes moved, deserialize latency, and
+    the compile seconds hits did NOT pay. Renders only once the cache
+    touched disk (armed runs); a disarmed process shows nothing."""
+    hits = snap.get("jit.aot.hits", 0)
+    misses = snap.get("jit.aot.misses", 0)
+    stores = snap.get("jit.aot.stores", 0)
+    if not (hits or misses or stores):
+        return []
+    lines = ["", "{:-^72}".format(" Cold start (AOT compile cache) "),
+             "{:<30} {}".format("metric", "value")]
+    rows = [
+        ("aot hits / misses", f"{hits} / {misses}"),
+        ("aot stores", f"{stores}"),
+        ("compile seconds saved",
+         f"{snap.get('jit.aot.saved_us', 0) / 1e6:.3f}s"),
+        ("payload bytes moved",
+         _fmt_bytes(snap.get("jit.aot.bytes", 0))),
+    ]
+    q = snap.get("jit.aot.quarantined", 0)
+    if q:
+        rows.append(("entries quarantined", f"{q} (see *.corrupt-N)"))
+    load = snap.get("jit.aot.load_us")
+    if isinstance(load, dict) and load.get("count"):
+        rows.append(("load latency p50/p95",
+                     f"{load['p50']:.0f}us / {load['p95']:.0f}us"))
+    comp = snap.get("xla.compile.seconds")
+    if isinstance(comp, dict):
+        rows.append(("xla compiles this process",
+                     f"{snap.get('xla.compile.count', 0)} "
+                     f"({comp.get('sum', 0.0):.3f}s)"))
+    for name, value in rows:
+        lines.append("{:<30} {}".format(name, value))
+    return lines
+
+
 def _recent_incidents_view(limit=10):
     """"Recent incidents" summary section: the watchdog flight-recorder
     ring (degrade / preempt / retry / quarantine events recorded by
@@ -585,6 +623,7 @@ class Profiler:
         full_snap = metrics.snapshot()
         lines.extend(_capacity_view(full_snap))
         lines.extend(_goodput_view(full_snap))
+        lines.extend(_cold_start_view(full_snap))
         lines.extend(_recent_incidents_view())
         if self._memory_samples:
             # MemoryView (reference profiler_statistic.py memory table)

@@ -68,6 +68,10 @@ _c_tokens = _metrics.counter("accounting.tokens_emitted")
 _c_processed = _metrics.counter("accounting.tokens_processed")
 _c_goodput = _metrics.counter("accounting.goodput_tokens")
 _c_missed = _metrics.counter("accounting.deadline_missed_tokens")
+# compile seconds the AOT cache saved (serving/aot_cache.py): an
+# INFORMATIONAL axis beside the closure — saved time never happened,
+# so it is not part of attributed + compile + idle == step_us
+_c_aot_saved = _metrics.counter("accounting.aot_saved_us")
 _g_mfu = _metrics.gauge("accounting.mfu")
 _g_active = _metrics.gauge("serving.kv.active_blocks")
 _g_free = _metrics.gauge("serving.kv.free_blocks")
@@ -84,7 +88,8 @@ class CostReport:
     request's own wall-clock latencies."""
 
     __slots__ = ("rid", "status", "queue_us", "prefill_us",
-                 "reprefill_us", "decode_us", "compile_us", "ttft_us",
+                 "reprefill_us", "decode_us", "compile_us",
+                 "aot_saved_us", "ttft_us",
                  "tokens_prefilled", "tokens_decoded", "tokens_emitted",
                  "covered_tokens", "preempts", "steps", "deadline_met")
 
@@ -96,6 +101,9 @@ class CostReport:
         self.reprefill_us = 0.0     # attributed preemption re-prefill share
         self.decode_us = 0.0        # attributed decode-step shares
         self.compile_us = 0.0       # XLA compiles this request triggered
+        self.aot_saved_us = 0.0     # compile time an AOT-cache hit avoided
+        #                             (informational: NOT in attributed_us —
+        #                             saved time was never on the device)
         self.ttft_us = None
         self.tokens_prefilled = 0   # computed (padded) prefill tokens
         self.tokens_decoded = 0     # batched decode steps participated in
@@ -208,13 +216,15 @@ def detect_peak_flops():
 class _Note:
     """One unit of per-step work awaiting apportionment."""
 
-    __slots__ = ("req", "kind", "tokens", "compile_us")
+    __slots__ = ("req", "kind", "tokens", "compile_us", "aot_saved_us")
 
-    def __init__(self, req, kind, tokens, compile_us=0.0):
+    def __init__(self, req, kind, tokens, compile_us=0.0,
+                 aot_saved_us=0.0):
         self.req = req
         self.kind = kind          # "prefill" | "reprefill" | "decode"
         self.tokens = tokens
         self.compile_us = compile_us
+        self.aot_saved_us = aot_saved_us
 
 
 # how often (seconds) update_capacity re-scans jax.live_arrays() — the
@@ -240,6 +250,7 @@ class Accountant:
         self.device_us = 0.0
         self.attributed_us = 0.0
         self.compile_us = 0.0
+        self.aot_saved_us = 0.0
         self.reprefill_us = 0.0
         self.idle_us = 0.0
         self.tokens_emitted = 0    # tokens streamed to callers
@@ -251,6 +262,7 @@ class Accountant:
         self.step_log = deque(maxlen=step_log_cap)
         self._notes = []
         self._decode_compile_us = 0.0
+        self._decode_aot_saved_us = 0.0
         self._last_hbm_sample = 0.0
         self._lock = threading.Lock()  # guards engine_report vs step_end
 
@@ -263,20 +275,25 @@ class Accountant:
     def step_begin(self):
         self._notes = []
         self._decode_compile_us = 0.0
+        self._decode_aot_saved_us = 0.0
 
     def note_queue_wait(self, req, wait_us):
         if req.cost is not None:
             req.cost.queue_us = float(wait_us)
 
     def note_prefill(self, req, computed_tokens, covered, compile_us,
-                     reprefill):
+                     reprefill, aot_saved_us=0.0):
         """A prefill ran for ``req`` this step: ``computed_tokens`` is
         the padded tail it actually computed (covered prefix tokens are
         NOT in it — they are free), ``compile_us`` any XLA compile its
-        dispatch triggered (billed direct to this request)."""
+        dispatch triggered (billed direct to this request), and
+        ``aot_saved_us`` any compile time an AOT-cache hit AVOIDED
+        (credited to this request, kept outside the closure sum —
+        saved time never ran on the device)."""
         kind = "reprefill" if reprefill else "prefill"
         self._notes.append(_Note(req, kind, max(int(computed_tokens), 1),
-                                 float(compile_us)))
+                                 float(compile_us),
+                                 float(aot_saved_us)))
         c = req.cost
         if c is not None:
             c.tokens_prefilled += int(computed_tokens)
@@ -297,6 +314,13 @@ class Accountant:
         if compile_us > 0.0:
             self._decode_compile_us += float(compile_us)
 
+    def note_decode_aot_saved(self, saved_us):
+        """Compile time an AOT-cache hit avoided around the batched
+        decode dispatch: split across this step's decode participants,
+        like :meth:`note_decode_compile` (informational axis)."""
+        if saved_us > 0.0:
+            self._decode_aot_saved_us += float(saved_us)
+
     def step_end(self, step_us):
         """Apportion the measured step wall time: direct compile bills
         first (clamped to the step), the remainder splits across notes
@@ -311,11 +335,17 @@ class Accountant:
             for n in notes:
                 if n.kind == "decode":
                     n.compile_us += share
-        elif self._decode_compile_us > 0.0:
+        if dec_notes and self._decode_aot_saved_us > 0.0:
+            share = self._decode_aot_saved_us / dec_notes
+            for n in notes:
+                if n.kind == "decode":
+                    n.aot_saved_us += share
+        if not dec_notes and self._decode_compile_us > 0.0:
             # no decode participants (can't happen today): keep closure
             # by treating it as part of the idle remainder
             pass
         total_compile = sum(n.compile_us for n in notes)
+        total_saved = sum(n.aot_saved_us for n in notes)
         scale = 1.0
         if total_compile > step_us:
             # jax's compile clock can disagree with our step clock at
@@ -342,6 +372,9 @@ class Accountant:
                 else:
                     c.decode_us += share
                 c.compile_us += bill
+                # savings bill UNSCALED: they are not wall time of this
+                # step, so the closure clamp never applies to them
+                c.aot_saved_us += n.aot_saved_us
                 if id(c) not in stepped:
                     stepped.add(id(c))
                     c.steps += 1
@@ -357,6 +390,7 @@ class Accountant:
             self.device_us += step_us
             self.attributed_us += attributed
             self.compile_us += direct
+            self.aot_saved_us += total_saved
             self.reprefill_us += reprefill
             self.idle_us += idle
             self.tokens_emitted += emitted
@@ -364,11 +398,14 @@ class Accountant:
         self.step_log.append({"step_us": step_us,
                               "attributed_us": attributed,
                               "compile_us": direct, "idle_us": idle,
+                              "aot_saved_us": total_saved,
                               "notes": len(notes)})
         _c_steps.inc()
         _c_device_us.inc(step_us)
         _c_attributed_us.inc(attributed)
         _c_compile_us.inc(direct)
+        if total_saved:
+            _c_aot_saved.inc(total_saved)
         _c_reprefill_us.inc(reprefill)
         _c_idle_us.inc(idle)
         if notes:
@@ -376,6 +413,7 @@ class Accountant:
             _c_processed.inc(total_tokens)
         self._notes = []
         self._decode_compile_us = 0.0
+        self._decode_aot_saved_us = 0.0
 
     def on_finish(self, req, status):
         """Finalize the request's report at its terminal status and
@@ -464,6 +502,7 @@ class Accountant:
                    "requests_finished": self.requests_finished,
                    "attributed_us": self.attributed_us,
                    "compile_us": self.compile_us,
+                   "aot_saved_us": self.aot_saved_us,
                    "reprefill_us": self.reprefill_us,
                    "idle_us": self.idle_us}
         tps = tokens / device_s if device_s > 0 else 0.0
@@ -513,13 +552,16 @@ class _NullAccountant(Accountant):
         pass
 
     def note_prefill(self, req, computed_tokens, covered, compile_us,
-                     reprefill):
+                     reprefill, aot_saved_us=0.0):
         pass
 
     def note_decode(self, req):
         pass
 
     def note_decode_compile(self, compile_us):
+        pass
+
+    def note_decode_aot_saved(self, saved_us):
         pass
 
     def step_end(self, step_us):

@@ -5,8 +5,14 @@ of `inference.paged`: an iteration-level continuous-batching scheduler
 (admission control + prefill budgeting + preemption instead of
 truncation), a thread-safe streaming frontend with per-request
 deadlines and cancellation, prefill length bucketing for a bounded
-warm jit-cache footprint, and SLO telemetry in the always-on metrics
-registry (``serving.*``, surfaced by ``profiler.summary()``).
+warm jit-cache footprint, SLO telemetry in the always-on metrics
+registry (``serving.*``, surfaced by ``profiler.summary()``), and the
+zero-cold-start control plane: a persistent AOT compile cache
+(``aot_cache`` — a fresh process with a warm cache boots without one
+XLA compile), an explicit ``ServingEngine.warmup()`` gate
+(WARMING -> READY), and an SLO-weighted multi-replica ``Router``
+(``router`` — health-weighted placement, drain redistribution,
+exactly-once failover).
 
     from paddle_tpu.serving import ServingEngine
 
@@ -20,13 +26,18 @@ See docs/SERVING.md for the scheduling policy, the preemption
 contract, and the metric catalog.
 """
 
+from . import aot_cache  # noqa: F401
 from .bucketing import bucket_length, bucket_lengths  # noqa: F401
 from .frontend import (Lifecycle, NotReadyError,  # noqa: F401
                        QueueFullError, RequestHandle, RequestStatus,
                        ServingEngine)
+from .router import (NoReplicaAvailable, RoutedHandle,  # noqa: F401
+                     Router, RouterReplica)
 from .scheduler import Scheduler, ServingRequest  # noqa: F401
 
 __all__ = ["ServingEngine", "RequestHandle", "RequestStatus",
            "QueueFullError", "Lifecycle", "NotReadyError",
            "Scheduler", "ServingRequest",
+           "Router", "RouterReplica", "RoutedHandle",
+           "NoReplicaAvailable", "aot_cache",
            "bucket_length", "bucket_lengths"]

@@ -15,12 +15,20 @@ handler, HTTP worker pool, ...) calls from many threads:
   requests finish with status ``TIMEOUT`` at the next step boundary.
 - an explicit **lifecycle** (``WARMING -> READY -> DRAINING ->
   CLOSED``) served from ``/readyz`` — distinct from ``/healthz``
-  liveness — with a graceful ``drain()``: admission stops
-  (``NotReadyError``), every in-flight request finishes with its
-  terminal status unchanged and outputs bit-identical to an undrained
-  run, readiness flips, and the replica deregisters from the fleet
-  registry (profiler/fleet.py). This is the drain contract a
-  multi-replica router rolls deploys against (docs/SERVING.md).
+  liveness. ``submit()`` is accepted ONLY in READY: a WARMING engine
+  rejects with ``NotReadyError`` exactly like a DRAINING one, so a
+  request can never be billed a cold compile that ``warmup()`` should
+  have paid — ``/readyz`` and submit semantics agree. ``warmup()``
+  precompiles the bounded serving program set (every prefill bucket +
+  the decode step; with the AOT cache armed this loads-or-stores
+  serialized executables, so the NEXT process boots zero-compile)
+  and flips WARMING -> READY. A graceful ``drain()``: admission
+  stops (``NotReadyError``), every in-flight request finishes with
+  its terminal status unchanged and outputs bit-identical to an
+  undrained run, readiness flips, and the replica deregisters from
+  the fleet registry (profiler/fleet.py). This is the drain contract
+  the multi-replica router (serving/router.py) rolls deploys
+  against (docs/SERVING.md).
 
 One re-entrant lock guards all scheduler state, and the driver holds it
 for the duration of a scheduling iteration (prefill + decode are device
@@ -39,9 +47,12 @@ import queue as queue_mod
 import threading
 import time
 
+import numpy as np
+
 from ..core import resilience
 from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
+from .bucketing import bucket_lengths
 from .scheduler import QueueFullError, RequestStatus, Scheduler
 
 __all__ = ["ServingEngine", "RequestHandle", "QueueFullError",
@@ -52,9 +63,10 @@ _SENTINEL = object()
 
 class Lifecycle:
     """Replica readiness states (/readyz; docs/SERVING.md "Drain
-    contract"): WARMING accepts local submits but tells routers "not
-    yet"; READY is routable; DRAINING finishes in-flight work while
-    rejecting new submits; CLOSED is terminal."""
+    contract" / "Cold start & routing"): WARMING precompiles and
+    rejects submits (``warmup()`` -> READY); READY is routable;
+    DRAINING finishes in-flight work while rejecting new submits;
+    CLOSED is terminal."""
 
     WARMING = "WARMING"
     READY = "READY"
@@ -63,12 +75,17 @@ class Lifecycle:
 
 
 class NotReadyError(RuntimeError):
-    """Submission rejected because the engine is DRAINING or CLOSED —
-    the caller should route to another replica."""
+    """Submission rejected because the engine is not READY (WARMING,
+    DRAINING, or CLOSED) — the caller should route to another replica
+    (or finish ``warmup()`` first)."""
 
 
 _c_drain_started = _metrics.counter("serving.drain.started")
 _c_drain_completed = _metrics.counter("serving.drain.completed")
+_c_warmup_programs = _metrics.counter("serving.warmup.programs")
+_h_warmup_us = _metrics.histogram(
+    "serving.warmup_us",
+    bounds=(10000, 100000, 500000, 1000000, 5000000, 30000000))
 _g_lifecycle_ready = _metrics.gauge("serving.lifecycle.ready")
 
 
@@ -176,9 +193,9 @@ class ServingEngine:
         self._error = None
         self._metrics_server = None
         self._registrar = None
-        # ready=False holds the engine in WARMING (the operator warms
-        # prefill buckets through local submits first, then calls
-        # mark_ready()); routers see WARMING as not-routable on /readyz
+        # ready=False holds the engine in WARMING: submit() raises
+        # NotReadyError until warmup() (or mark_ready()) flips READY;
+        # routers see WARMING as not-routable on /readyz
         if ready:
             self._state = Lifecycle.READY
         _g_lifecycle_ready.set(1 if ready else 0)
@@ -213,10 +230,16 @@ class ServingEngine:
                 raise RuntimeError(
                     "ServingEngine died; no new submissions") \
                     from self._error
-            if self._state in (Lifecycle.DRAINING, Lifecycle.CLOSED):
+            if self._state != Lifecycle.READY:
+                # WARMING rejects like DRAINING: a request must never
+                # silently pay the cold compiles warmup() owes
+                # (/readyz and submit agree — test_router.py pins it)
+                hint = "call warmup() first" \
+                    if self._state == Lifecycle.WARMING \
+                    else "route to another replica"
                 raise NotReadyError(
                     f"ServingEngine is {self._state}; not accepting "
-                    "new requests (route to another replica)")
+                    f"new requests ({hint})")
             if deadline is None and deadline_s is not None:
                 deadline = resilience.Deadline.after(deadline_s)
             handle._req = self._sched.submit(
@@ -285,6 +308,72 @@ class ServingEngine:
     def lifecycle(self):
         """Current :class:`Lifecycle` state (served from /readyz)."""
         return self._state
+
+    def warmup(self):
+        """Precompile the bounded serving program set — every prefill
+        bucket the config can produce (``bucket_lengths``: the
+        log2(cap) ladder) plus the batched decode step — then flip
+        WARMING -> READY. This is the cold-start gate: constructed
+        with ``ready=False``, an engine rejects submits until warmup
+        finishes, so live traffic NEVER pays a first-bucket compile.
+        With the AOT cache armed (serving/aot_cache.py) each program
+        loads from the on-disk store when warm (zero XLA compiles —
+        tools/router_gate.py pins a warm second process) or compiles
+        once and is stored for the next process.
+
+        Runs the real jit entry points against throwaway slots (freed
+        afterward; no requests exist in WARMING, so the pool is
+        untouched by traffic). Idempotent — re-running in READY just
+        revisits warm programs; raises past DRAINING like
+        ``mark_ready``. Returns the number of programs visited."""
+        with self._lock:
+            if self._state in (Lifecycle.DRAINING, Lifecycle.CLOSED):
+                raise RuntimeError(
+                    f"cannot warmup a {self._state} engine")
+            sched = self._sched
+            cache = sched.cache
+            buckets = bucket_lengths(cache.block_size, sched.bucket_cap,
+                                     sched.max_seq_len)
+            t0 = time.perf_counter_ns()
+            n = 0
+            decoded = False
+            with _tracing.span("serving.warmup", buckets=len(buckets)):
+                for b in buckets:
+                    slot = cache.alloc_slot(b)
+                    if slot is None:
+                        continue  # pool smaller than the ladder tail
+                    try:
+                        ids = np.zeros((b,), np.int64)
+                        sched.model.paged_prefill(
+                            cache, slot, ids,
+                            temperature=sched.temperature, pad_to=b)
+                        n += 1
+                        if not decoded:
+                            # one decode step warms the (single) decode
+                            # program; the next-position write past the
+                            # allocated blocks lands in the null block,
+                            # the bucketing convention
+                            active = np.zeros((cache.max_batch,), bool)
+                            active[slot] = True
+                            sched.model.paged_decode_step(
+                                cache, np.zeros((cache.max_batch,),
+                                                np.int64), active,
+                                temperature=sched.temperature)
+                            decoded = True
+                            n += 1
+                    finally:
+                        cache.free_slot(slot)
+            _c_warmup_programs.inc(n)
+            _h_warmup_us.observe((time.perf_counter_ns() - t0) / 1000.0)
+        try:
+            from ..distributed import watchdog
+            watchdog.record_event("serving.warmup",
+                                  meta={"programs": n}, status="lifecycle")
+        except Exception:  # noqa: BLE001 — telemetry must not block boot
+            pass
+        if self._state == Lifecycle.WARMING:
+            self.mark_ready()
+        return n
 
     def mark_ready(self):
         """WARMING -> READY (no-op in READY; raises past that — a

@@ -1,0 +1,441 @@
+"""SLO-weighted multi-replica router: the fleet front door.
+
+PR 11's fleet observatory made a set of serving replicas observable —
+registry, health scores, drain-aware ``/readyz`` — but nothing
+consumed it: telemetry was a dashboard, not a control loop. This
+module closes the loop. A :class:`Router` sits in front of N replicas
+and turns those signals into placement decisions:
+
+- **discovery** — replicas are added directly (in-process
+  ``ServingEngine``s — the topology every test/gate/fleet-demo in
+  this repo runs) and/or discovered from the ``TCPStore`` fleet
+  registry (``profiler/fleet.read_members``): a registry payload binds
+  to the engine with the same ``replica_id`` and contributes its
+  heartbeat age to the weight, so a replica whose heartbeat died
+  routes toward zero BEFORE it formally ages out. Registry entries
+  with no bound engine are visible in :meth:`view` but not
+  submittable (cross-host submit rides the rpc layer — ROADMAP);
+- **readiness** — a replica that is not READY on the drain lifecycle
+  (``/readyz`` semantics: WARMING, DRAINING, CLOSED, or dead) is
+  refused outright: a drain REDISTRIBUTES, the draining replica
+  finishes its in-flight work (zero dropped — the PR 11 drain
+  contract) while new traffic lands elsewhere;
+- **weighting** — candidates are ranked by
+  ``health_score(snapshot) / (1 + inflight)``: the PURE fleet health
+  formula (``profiler/fleet.health_score``: queue depth, KV headroom,
+  heartbeat freshness) over the replica's live scheduler state,
+  damped by its in-flight load — equal replicas round-robin, a
+  degraded replica sheds traffic in proportion, a silent one goes to
+  zero;
+- **retry** — a failed submit (``NotReadyError``, ``QueueFullError``,
+  a dead engine) moves to the next-best replica (counted
+  ``router.retried``, degraded ``resilience.degrade.router.retry``);
+  when every candidate refuses, the sweep retries under the
+  ``core/resilience`` ``router.submit`` policy (jittered backoff)
+  before :class:`NoReplicaAvailable` propagates (counted
+  ``router.rejected``);
+- **failover** — if a replica DIES mid-flight (its requests
+  terminate ``ERROR``), :class:`RoutedHandle` re-submits the request
+  to the next-best replica (counted ``router.failover``, degraded +
+  flight-recorded) up to ``FLAGS_router_max_failovers`` times. A
+  request that reached ANY clean terminal status (DONE / CANCELLED /
+  TIMEOUT) is NEVER re-submitted — every request lands exactly once
+  (tests/framework/test_router.py drives the matrix under injected
+  replica death).
+
+Every routed submit records a ``serving.route`` span onto the
+request's own trace (replica, attempt count, candidates), so a
+request's journey — route -> queue -> prefill -> decode -> terminal —
+reads as one trace. Counters: ``router.{routed,retried,failover,
+rejected}``.
+
+``FLAGS_serving_router=0`` (read at Router construction, the
+``FLAGS_serving_accounting`` convention) makes the router a
+byte-for-byte pass-through to its first replica's engine — identical
+handles, zero ``router.*`` counter movement (tools/router_gate.py
+pins the silence).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+from ..core import flags as flags_mod
+from ..core import resilience
+from ..profiler import fleet as _fleet
+from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
+from ..testing import faults as _faults
+from .frontend import Lifecycle, NotReadyError
+from .scheduler import QueueFullError, RequestStatus
+
+__all__ = ["Router", "RouterReplica", "RoutedHandle",
+           "NoReplicaAvailable"]
+
+_c_routed = _metrics.counter("router.routed")
+_c_retried = _metrics.counter("router.retried")
+_c_failover = _metrics.counter("router.failover")
+_c_rejected = _metrics.counter("router.rejected")
+_g_routable = _metrics.gauge("router.replicas.routable")
+
+
+class NoReplicaAvailable(RuntimeError):
+    """No READY replica accepted the request — shed load upstream or
+    scale out."""
+
+
+class RouterReplica:
+    """One replica as the router sees it: an in-process engine (the
+    submit target), and/or a fleet-registry payload whose heartbeat
+    age and state feed the weight."""
+
+    __slots__ = ("replica_id", "engine", "url", "member")
+
+    def __init__(self, replica_id, engine=None, url=None, member=None):
+        self.replica_id = str(replica_id)
+        self.engine = engine
+        self.url = url
+        self.member = member  # latest fleet/member/<n> payload, if any
+
+    def ready(self):
+        """READY on the drain lifecycle. In-process engines answer
+        directly (what their /readyz serves); registry-only replicas
+        answer by their last heartbeat state; url-only replicas get a
+        real GET."""
+        if self.engine is not None:
+            return (self.engine.lifecycle == Lifecycle.READY
+                    and self.engine._error is None)
+        if self.member is not None:
+            return self.member.get("state") == Lifecycle.READY
+        if self.url:
+            try:
+                with urllib.request.urlopen(
+                        self.url.rstrip("/") + "/readyz", timeout=2.0) as r:
+                    return json.loads(r.read()).get("ready") is True
+            except Exception:  # noqa: BLE001 — unreachable = not routable
+                return False
+        return False
+
+    def snapshot(self):
+        """The :func:`profiler.fleet.health_score` input, built from
+        live scheduler state (queue depth, KV utilization) plus the
+        registry heartbeat age when discovered via store."""
+        snap = {}
+        if self.engine is not None:
+            sched = self.engine.scheduler
+            cache = sched.cache
+            usable = cache.num_blocks - 1
+            used = usable - cache.num_free_blocks()
+            snap["queue_depth"] = len(sched.queue)
+            snap["kv_utilization"] = used / usable if usable else 0.0
+        m = self.member
+        if m is not None and "heartbeat_ts" in m:
+            snap["heartbeat_age_s"] = max(
+                time.time() - float(m["heartbeat_ts"]), 0.0)
+            snap["ttl_s"] = float(m.get("ttl_s", 0.0))
+        return snap
+
+    def health(self):
+        return _fleet.health_score(self.snapshot())
+
+    def inflight(self):
+        if self.engine is not None:
+            return self.engine.scheduler.inflight()
+        return 0
+
+
+class RoutedHandle:
+    """Caller-side view of one routed request. Forwards to the live
+    replica's :class:`~paddle_tpu.serving.RequestHandle`; if that
+    replica dies (status ``ERROR``), ``result()``/``stream()``
+    transparently fail over to the next-best replica — a clean
+    terminal status is final and never re-submitted."""
+
+    __slots__ = ("_router", "_prompt", "_mnt", "_kw", "_replica",
+                 "_handle", "_failovers", "_lock")
+
+    def __init__(self, router, prompt, max_new_tokens, kw, replica,
+                 handle):
+        self._router = router
+        self._prompt = prompt
+        self._mnt = max_new_tokens
+        self._kw = kw
+        self._replica = replica
+        self._handle = handle
+        self._failovers = 0
+        self._lock = threading.Lock()
+
+    @property
+    def replica_id(self):
+        return self._replica.replica_id
+
+    @property
+    def status(self):
+        return self._handle.status
+
+    @property
+    def rid(self):
+        return self._handle.rid
+
+    @property
+    def trace_id(self):
+        return self._handle.trace_id
+
+    def tokens(self):
+        return self._handle.tokens()
+
+    def cost(self):
+        return self._handle.cost()
+
+    def cancel(self):
+        self._handle.cancel()
+
+    def result(self, timeout=None):
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        while True:
+            left = None if deadline is None \
+                else max(deadline - time.monotonic(), 0.001)
+            try:
+                return self._handle.result(timeout=left)
+            except TimeoutError:
+                raise
+            except Exception as e:  # noqa: BLE001 — engine fatal error
+                # Exception, NOT BaseException: a KeyboardInterrupt must
+                # interrupt, never morph into a failover re-submit
+                self._failover_or_raise(e)
+
+    def stream(self, timeout=None):
+        """Yield tokens like ``RequestHandle.stream``; on replica death
+        the stream fails over and suppresses the re-generated prefix,
+        so the caller sees each position exactly once (exact
+        continuation relies on deterministic sampling — greedy
+        decode, the same contract as preemption re-prefill)."""
+        yielded = 0
+        skip = 0
+        while True:
+            try:
+                for tok in self._handle.stream(timeout=timeout):
+                    if skip > 0:
+                        skip -= 1
+                        continue
+                    yielded += 1
+                    yield tok
+                return
+            except Exception as e:  # noqa: BLE001 — engine fatal error;
+                # NOT BaseException: an abandoned generator's
+                # GeneratorExit must close the stream, not re-submit
+                # work the caller walked away from
+                self._failover_or_raise(e)
+                skip = yielded
+
+    def _failover_or_raise(self, exc):
+        """Re-submit ONLY a request whose replica died under it: clean
+        terminal statuses are final (exactly-once), and the failover
+        budget bounds a dying fleet."""
+        with self._lock:
+            h = self._handle
+            if h.status != RequestStatus.ERROR:
+                raise exc
+            limit = int(flags_mod.flag("FLAGS_router_max_failovers"))
+            if self._failovers >= limit:
+                raise exc
+            self._failovers += 1
+            dead = self._replica.replica_id
+            _c_failover.inc()
+            resilience.degrade(
+                "router.failover",
+                detail=f"replica={dead} rid={h.rid} "
+                       f"attempt={self._failovers}", exc=exc)
+            self._replica, self._handle = self._router._submit_once(
+                self._prompt, self._mnt, self._kw, exclude={dead})
+
+
+class Router:
+    """See module docstring. Thread-safe; construct once per front
+    door. ``replicas`` is an iterable of :class:`RouterReplica` (or
+    use :meth:`add_replica`); ``store`` opts into TCPStore registry
+    discovery (rate-limited by ``min_refresh_s``, like the
+    aggregator's sweep)."""
+
+    def __init__(self, replicas=None, store=None, min_refresh_s=1.0):
+        self._armed = bool(flags_mod.flag("FLAGS_serving_router"))
+        self._lock = threading.Lock()
+        self._replicas = {}
+        self._order = []  # insertion order: the disarmed primary
+        self.store = store if store is not None \
+            and bool(flags_mod.flag("FLAGS_fleet")) else None
+        self.min_refresh_s = float(min_refresh_s)
+        self._scan_state = {}
+        self._last_refresh = None
+        for rep in replicas or []:
+            self._add(rep)
+
+    # -- membership -----------------------------------------------------
+
+    def _add(self, rep):
+        with self._lock:
+            if rep.replica_id not in self._replicas:
+                self._order.append(rep.replica_id)
+            self._replicas[rep.replica_id] = rep
+
+    def add_replica(self, replica_id, engine=None, url=None):
+        """Register (or re-bind) a replica; returns its record. An
+        engine bound to an already-discovered registry entry merges
+        with it (the heartbeat keeps feeding the weight)."""
+        with self._lock:
+            rep = self._replicas.get(str(replica_id))
+            if rep is not None:
+                if engine is not None:
+                    rep.engine = engine
+                if url is not None:
+                    rep.url = url
+                return rep
+        rep = RouterReplica(replica_id, engine=engine, url=url)
+        self._add(rep)
+        return rep
+
+    def remove_replica(self, replica_id):
+        with self._lock:
+            self._replicas.pop(str(replica_id), None)
+            try:
+                self._order.remove(str(replica_id))
+            except ValueError:
+                pass
+
+    def refresh(self, force=False):
+        """Registry discovery sweep (rate-limited): bind fresh member
+        payloads to known replicas by ``replica_id``; unknown ids
+        appear as registry-only records (not submittable)."""
+        if self.store is None:
+            return
+        now = time.monotonic()
+        if not force and self._last_refresh is not None \
+                and now - self._last_refresh < self.min_refresh_s:
+            return
+        self._last_refresh = now
+        try:
+            members = _fleet.read_members(self.store, self._scan_state)
+        except Exception as e:  # noqa: BLE001 — a flaky store must not stop routing
+            resilience.degrade("router.discovery", exc=e)
+            return
+        seen = set()
+        for p in members:
+            rid = str(p["replica_id"])
+            seen.add(rid)
+            with self._lock:
+                rep = self._replicas.get(rid)
+            if rep is None:
+                rep = RouterReplica(rid, url=p.get("url"), member=p)
+                self._add(rep)
+            else:
+                rep.member = p
+        # a deregistered replica (drain/close deletes its entry) keeps
+        # its LAST payload: a stale heartbeat_ts decays it to zero
+        # weight, and an engine-bound record still answers ready()
+        # directly — the registry's absence must not resurrect it
+        return seen
+
+    # -- placement ------------------------------------------------------
+
+    def _candidates(self, exclude=()):
+        self.refresh()
+        with self._lock:
+            reps = [self._replicas[rid] for rid in self._order
+                    if rid not in exclude]
+        cands = [r for r in reps if r.engine is not None and r.ready()]
+        _g_routable.set(len(cands))
+        # health over load: equal replicas round-robin via the inflight
+        # damping, a zero-health (silent/burning) replica sorts last
+        cands.sort(key=lambda r: -(r.health() / (1.0 + r.inflight())))
+        return cands
+
+    def _submit_once(self, prompt, max_new_tokens, kw, exclude=()):
+        t0 = time.perf_counter_ns()
+        cands = self._candidates(exclude)
+        for i, rep in enumerate(cands):
+            try:
+                _faults.site("router.submit")
+                _faults.site(f"router.submit.{rep.replica_id}")
+                h = rep.engine.submit(prompt, max_new_tokens, **kw)
+            except (NotReadyError, QueueFullError,
+                    RuntimeError) as e:
+                _c_retried.inc()
+                resilience.degrade(
+                    "router.retry",
+                    detail=f"replica={rep.replica_id}", exc=e)
+                continue
+            _c_routed.inc()
+            req = getattr(h, "_req", None)
+            if req is not None:
+                _tracing.record_span(
+                    "serving.route", req.span,
+                    (time.perf_counter_ns() - t0) / 1000.0,
+                    replica=rep.replica_id, attempt=i + 1,
+                    candidates=len(cands))
+            return rep, h
+        raise NoReplicaAvailable(
+            f"router: no READY replica accepted the request "
+            f"({len(cands)} candidate(s), {len(exclude)} excluded)")
+
+    def submit(self, prompt_ids, max_new_tokens=32, **kw):
+        """Route one request; returns a :class:`RoutedHandle` (or,
+        disarmed, the primary engine's plain handle). Sweeps refused
+        by every candidate retry under the ``router.submit``
+        resilience policy before :class:`NoReplicaAvailable`."""
+        if not self._armed:
+            return self._primary().engine.submit(
+                prompt_ids, max_new_tokens, **kw)
+        out = None
+        try:
+            pol = resilience.policy("router.submit", max_attempts=3,
+                                    retry_on=(NoReplicaAvailable,))
+            for attempt in resilience.attempts(pol):
+                with attempt:
+                    out = self._submit_once(prompt_ids, max_new_tokens,
+                                            kw)
+        except NoReplicaAvailable:
+            _c_rejected.inc()
+            raise
+        rep, h = out
+        return RoutedHandle(self, prompt_ids, max_new_tokens, kw, rep, h)
+
+    def _primary(self):
+        with self._lock:
+            for rid in self._order:
+                rep = self._replicas[rid]
+                if rep.engine is not None:
+                    return rep
+        raise NoReplicaAvailable("router: no replica has an engine")
+
+    # -- operations -----------------------------------------------------
+
+    def drain(self, replica_id, timeout=60):
+        """Drain one replica through the PR 11 contract: its in-flight
+        requests finish (zero dropped), its readiness flips, and —
+        because :meth:`_candidates` refuses non-READY replicas — new
+        traffic redistributes to the rest. The record stays (a closed
+        replica scores unroutable); ``remove_replica`` forgets it."""
+        with self._lock:
+            rep = self._replicas.get(str(replica_id))
+        if rep is None or rep.engine is None:
+            raise KeyError(f"router: no engine for replica "
+                           f"{replica_id!r}")
+        rep.engine.drain(timeout=timeout)
+
+    def view(self):
+        """Observability body: every known replica's readiness, health,
+        and load — what a /router/replicas endpoint would serve."""
+        self.refresh()
+        with self._lock:
+            reps = [self._replicas[rid] for rid in self._order]
+        return [{"replica_id": r.replica_id,
+                 "submittable": r.engine is not None,
+                 "ready": r.ready(), "health": r.health(),
+                 "inflight": r.inflight(),
+                 "state": (r.engine.lifecycle if r.engine is not None
+                           else (r.member or {}).get("state"))}
+                for r in reps]

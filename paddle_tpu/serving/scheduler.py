@@ -154,6 +154,19 @@ _g_cached = _metrics.gauge("serving.kv.cached_blocks")
 # concurrent engine's compile on another thread never leaks into this
 # scheduler's bills (profiler/accounting.py)
 _compile_s = _metrics.thread_compile_seconds
+# same delta discipline for compile seconds the AOT cache SAVED
+# (serving/aot_cache.py): a dispatch that loaded a serialized
+# executable bills the avoided compile as aot_saved_us — informational
+# (never part of the closure sum), but per-request like compile itself
+_aot_saved_s = None
+
+
+def _saved_s():
+    global _aot_saved_s
+    if _aot_saved_s is None:
+        from .aot_cache import thread_saved_seconds
+        _aot_saved_s = thread_saved_seconds
+    return _aot_saved_s()
 
 
 class Scheduler:
@@ -375,6 +388,7 @@ class Scheduler:
             self.running[slot] = req
             _m_admitted.inc()
             comp0 = _compile_s()  # compile billed to THIS request
+            saved0 = _saved_s()   # ...and so are AOT-cache savings
             if covered:
                 tail_start = plan.tail_start
                 pad_to = bucket_length(ids_len - tail_start, bs,
@@ -408,7 +422,8 @@ class Scheduler:
             self.accounting.note_prefill(
                 req, pad_to, covered,
                 (_compile_s() - comp0) * 1e6,
-                reprefill=req.preempts > 0)
+                reprefill=req.preempts > 0,
+                aot_saved_us=(_saved_s() - saved0) * 1e6)
             self._last_tok[slot] = tok
             self._remaining[slot] = \
                 req.max_new_tokens - len(req.generated) - 1
@@ -476,12 +491,14 @@ class Scheduler:
         for slot in self.running:
             active[slot] = True
         comp0 = _compile_s()  # decode compiles split across the batch
+        saved0 = _saved_s()
         t_dec = time.perf_counter_ns()
         toks = np.asarray(self.model.paged_decode_step(
             self.cache, np.asarray(self._last_tok), active,
             temperature=self.temperature))
         dec_us = (time.perf_counter_ns() - t_dec) / 1000.0
         self.accounting.note_decode_compile((_compile_s() - comp0) * 1e6)
+        self.accounting.note_decode_aot_saved((_saved_s() - saved0) * 1e6)
         out = []
         for slot, req in list(self.running.items()):
             t = int(toks[slot])

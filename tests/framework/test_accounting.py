@@ -137,6 +137,30 @@ def test_prefix_hits_billed_extend_only(model):
     eng.close()
 
 
+def test_aot_saved_is_informational_and_outside_the_closure(model):
+    """ISSUE 12: compile-seconds-saved (AOT cache hits) bill per
+    request as ``CostReport.aot_saved_us`` — an INFORMATIONAL axis.
+    The closure property is untouched: saved time never ran on the
+    device, so attributed + compile + idle still equals the measured
+    step exactly, and step_log/engine_report carry the saved column.
+    (tests/framework/test_router.py drives the armed-cache case where
+    aot_saved_us > 0; here the default-disarmed path pins the zeros
+    and the surfaces.)"""
+    eng = ServingEngine(model, max_batch=2, block_size=8, max_seq_len=64,
+                        temperature=0.0, bucket_cap=32, background=False)
+    h = eng.submit(_prompts(21, [7])[0], max_new_tokens=3)
+    eng.run_until_idle()
+    _assert_closure(eng.accounting, min_steps=2)
+    c = h.cost()
+    assert c.aot_saved_us == 0.0              # no cache armed
+    assert c.attributed_us == pytest.approx(
+        c.prefill_us + c.decode_us + c.compile_us + c.reprefill_us)
+    assert "aot_saved_us" in c.as_dict()
+    assert all("aot_saved_us" in rec for rec in eng.accounting.step_log)
+    assert eng.accounting.engine_report()["aot_saved_us"] == 0.0
+    eng.close()
+
+
 def test_flag_off_reverts_and_cost_none(model):
     acc_before = metrics.snapshot("accounting.")
     eng_on = ServingEngine(model, max_batch=2, block_size=8,

@@ -632,11 +632,14 @@ def test_drain_background_driver_and_warming_state(model):
     except urllib.error.HTTPError as e:
         body = json.loads(e.read())
     assert body and body["state"] == "WARMING"
-    # WARMING accepts local (warmup) submits; routers just don't route
-    h0 = eng.submit(_prompts(4, [6])[0], max_new_tokens=2)
-    assert h0.result(timeout=120) is not None
+    # WARMING rejects submits exactly like DRAINING (ISSUE 12: /readyz
+    # and submit semantics agree — warmup()/mark_ready() opens the door)
+    with pytest.raises(NotReadyError):
+        eng.submit(_prompts(4, [6])[0], max_new_tokens=2)
     eng.mark_ready()
     assert eng.lifecycle == Lifecycle.READY
+    h0 = eng.submit(_prompts(4, [6])[0], max_new_tokens=2)
+    assert h0.result(timeout=120) is not None
     hs = [eng.submit(p, max_new_tokens=5) for p in _prompts(5, [6, 9])]
     eng.drain(timeout=120)
     assert eng.lifecycle == Lifecycle.CLOSED

@@ -138,3 +138,24 @@ def test_fusion_surfaces_map_to_their_tests():
     t = suite_gate.targets_for(["tools/fusion_gate.py"])
     assert "tests/framework/test_fusion.py" in t
     assert "tests/core/test_deferred_async.py" in t
+
+
+def test_router_and_aot_surfaces_map_to_their_tests():
+    # the control-plane modules (ISSUE 12) run the router suite beside
+    # the serving pins
+    t = suite_gate.targets_for(["paddle_tpu/serving/aot_cache.py"])
+    assert "tests/framework/test_router.py" in t
+    assert "tests/framework/test_serving.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/serving/router.py"])
+    assert "tests/framework/test_router.py" in t
+    t = suite_gate.targets_for(["tools/router_gate.py"])
+    assert "tests/framework/test_router.py" in t
+    # llama's jit entry points and the deferred-chain namespaces are
+    # AOT-wrapped: both run the router suite on any touch
+    t = suite_gate.targets_for(["paddle_tpu/models/llama.py"])
+    assert "tests/framework/test_router.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/core/deferred.py"])
+    assert "tests/framework/test_router.py" in t
+    # compile-seconds-saved billing lives in accounting
+    t = suite_gate.targets_for(["paddle_tpu/profiler/accounting.py"])
+    assert "tests/framework/test_router.py" in t

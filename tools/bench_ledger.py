@@ -18,8 +18,11 @@ Known kinds (each writer documents its metrics): ``regression_gate``
 (tools/regression_gate.py measure mode), ``suite_gate`` (pre-commit
 wall time, advisory), ``eager_gap`` (bench.py eager-vs-jit rung),
 ``fusion_gate`` (tools/fusion_gate.py async A/B), ``fleet_gate``
-(tools/fleet_gate.py aggregator refresh + federation checks). The
-ledger itself is schema-free — any kind/metrics pair appends.
+(tools/fleet_gate.py aggregator refresh + federation checks),
+``router_gate`` (tools/router_gate.py zero-cold-start: cold vs warm
+process compile seconds, AOT hit counts, traffic-shift/failover
+bits). The ledger itself is schema-free — any kind/metrics pair
+appends.
 
 CLI::
 

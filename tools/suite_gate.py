@@ -40,7 +40,8 @@ _MAP = [
                                        "tests/framework/test_serving.py"]),
     ("paddle_tpu/serving/", ["tests/framework/test_serving.py",
                              "tests/framework/test_prefix_cache.py",
-                             "tests/framework/test_fleet_observatory.py"]),
+                             "tests/framework/test_fleet_observatory.py",
+                             "tests/framework/test_router.py"]),
     ("paddle_tpu/inference/", ["tests/framework/test_paged_decode.py",
                                "tests/framework/test_serving.py",
                                "tests/framework/test_prefix_cache.py"]),
@@ -48,7 +49,8 @@ _MAP = [
      ["tests/framework/test_paged_decode.py",
       "tests/framework/test_prefix_cache.py",
       "tests/framework/test_serving.py",
-      "tests/framework/test_fleet_observatory.py"]),
+      "tests/framework/test_fleet_observatory.py",
+      "tests/framework/test_router.py"]),
     ("paddle_tpu/models/generation.py",
      ["tests/framework/test_serving.py",
       "tests/framework/test_paged_decode.py",
@@ -62,7 +64,8 @@ _MAP = [
     ("paddle_tpu/core/deferred.py",
      ["tests/core/test_deferred.py", "tests/core/test_deferred_async.py",
       "tests/framework/test_passes.py", "tests/framework/test_fusion.py",
-      "tests/framework/test_chaos.py"]),
+      "tests/framework/test_chaos.py",
+      "tests/framework/test_router.py"]),
     ("paddle_tpu/nn/", ["tests/nn", "tests/test_oracle_sweep_api.py"]),
     ("paddle_tpu/distributed/", ["tests/distributed"]),
     ("paddle_tpu/fleet/", ["tests/distributed"]),
@@ -72,7 +75,8 @@ _MAP = [
     ("paddle_tpu/amp/", ["tests/amp", "tests/test_amp.py"]),
     ("paddle_tpu/profiler/accounting.py",
      ["tests/framework/test_accounting.py",
-      "tests/framework/test_serving.py"]),
+      "tests/framework/test_serving.py",
+      "tests/framework/test_router.py"]),
     ("paddle_tpu/profiler/alerts.py",
      ["tests/framework/test_accounting.py"]),
     ("paddle_tpu/profiler/fleet.py",
@@ -100,6 +104,7 @@ _MAP = [
     ("tools/trace_gate.py", ["tests/framework/test_tracing.py"]),
     ("tools/accounting_gate.py", ["tests/framework/test_accounting.py"]),
     ("tools/fleet_gate.py", ["tests/framework/test_fleet_observatory.py"]),
+    ("tools/router_gate.py", ["tests/framework/test_router.py"]),
     ("tools/bench_ledger.py",
      ["tests/framework/test_regression_ledger.py"]),
     ("tools/regression_gate.py",
