@@ -34,6 +34,11 @@ def main():
                          "(prefix-cache demo)")
     ap.add_argument("--export", action="store_true",
                     help="also demo jit.save/load of the forward")
+    ap.add_argument("--overload", action="store_true",
+                    help="demo the overload control plane: flood the "
+                         "engine past capacity with mixed priorities "
+                         "and watch shedding, fast rejection, and the "
+                         "brownout stage (docs/SERVING.md)")
     args = ap.parse_args()
 
     import jax
@@ -155,6 +160,66 @@ def main():
             if c is not None:
                 print(f"  cost[{name}]: {c.summary()}")
         print(f"  {serving.accounting.goodput_line()}")
+
+    if args.overload:
+        # --- overload control plane (serving/overload.py) ------------
+        # flood a 2-slot engine ~8x past capacity: HIGH-priority
+        # requests keep their deadlines while the LOW class sheds with
+        # a retry-after hint, and a provably-unmeetable deadline is
+        # rejected at submit instead of paying prefill then timing out
+        from paddle_tpu.serving import AdmissionRejected, overload
+
+        with ServingEngine(model, max_batch=2, block_size=8,
+                           max_seq_len=128, temperature=0.0,
+                           bucket_cap=64, max_queue=32,
+                           background=False) as eng:
+            for _ in range(3):  # prime the EWMA service-time model
+                eng.submit(rng.integers(3, model.config.vocab_size,
+                                        size=5), max_new_tokens=2)
+                eng.run_until_idle()
+            ov = eng.scheduler.overload
+            ov.min_queue, ov.queue_frac = 3, 0.125  # demo watermarks
+            handles = []
+            for i in range(16):
+                pri = overload.HIGH if i < 4 else (
+                    overload.NORMAL if i < 8 else overload.LOW)
+                prompt = rng.integers(3, model.config.vocab_size,
+                                      size=6 + i % 4)
+                handles.append((pri, eng.submit(
+                    prompt, max_new_tokens=8, priority=pri,
+                    deadline_s=300.0 if pri == overload.HIGH
+                    else None)))
+            eng.run_until_idle()
+            by = {}
+            for pri, h in handles:
+                by.setdefault(pri, []).append(h)
+            for pri, name in ((overload.HIGH, "HIGH"),
+                              (overload.NORMAL, "NORMAL"),
+                              (overload.LOW, "LOW")):
+                hs = by.get(pri, [])
+                statuses = [h.status for h in hs]
+                line = f"overload: {name:<6} " + " ".join(statuses)
+                sheds = [h for h in hs if h.status == "SHED"]
+                if sheds and sheds[0].retry_after_s:
+                    line += (f"  (retry after "
+                             f"~{sheds[0].retry_after_s * 1e3:.0f}ms)")
+                print(line)
+            try:
+                eng.submit(rng.integers(3, model.config.vocab_size,
+                                        size=48),
+                           max_new_tokens=8, deadline_s=1e-4)
+            except AdmissionRejected as e:
+                print(f"overload: unmeetable deadline rejected at "
+                      f"submit — predicted TTFT "
+                      f"{e.predicted_ttft_s * 1e3:.1f}ms, retry after "
+                      f"~{e.retry_after_s * 1e3:.0f}ms (reason="
+                      f"{e.reason})")
+            snap = metrics.snapshot()
+            print(f"overload: shed={snap['serving.shed']} "
+                  f"admission.rejected="
+                  f"{snap['serving.admission.rejected']} "
+                  f"brownout.stage={snap['serving.brownout.stage']}")
+            print(f"  {eng.accounting.goodput_line()}")
 
     # paged decode must agree with the dense-cache generate path
     prompt = rng.integers(3, model.config.vocab_size, size=6)

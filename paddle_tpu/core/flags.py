@@ -298,6 +298,83 @@ define_flag("FLAGS_router_max_failovers", 3,
             "max times the router will re-submit one request after its "
             "replica died mid-flight before the engine error propagates "
             "(a completed request is NEVER re-submitted)")
+define_flag("FLAGS_serving_admission", True,
+            "deadline-aware admission + priority load shedding "
+            "(serving/overload.py): an EWMA service-time model predicts "
+            "queue-wait + TTFT at submit(), provably-unmeetable "
+            "deadlines reject immediately with AdmissionRejected "
+            "(carrying retry_after_s) instead of paying prefill then "
+            "timing out, and under pressure watermarks the scheduler "
+            "sheds lowest-priority/newest QUEUED requests to terminal "
+            "status SHED; 0 reverts shedding + predictive rejection "
+            "byte-for-byte with serving.shed / admission.predicted_"
+            "ttft_us silence (read at Scheduler construction, the "
+            "FLAGS_serving_accounting convention). NOTE: brownout-"
+            "stage submit rejections ride FLAGS_serving_brownout and "
+            "count serving.admission.rejected even with this flag off "
+            "— all-flags-off is fully counter-silent (gate-pinned)")
+define_flag("FLAGS_admission_optimism", 0.5,
+            "admission-rejection conservatism: a deadline is treated as "
+            "provably unmeetable only when predicted_ttft * optimism "
+            "still exceeds it — at 0.5 even HALF the EWMA prediction "
+            "must bust the deadline, so estimate error rejects late, "
+            "never eagerly")
+define_flag("FLAGS_shed_min_queue", 16,
+            "load shedding / brownout floor: overload pressure is 0 "
+            "while fewer requests than this are queued — a full KV pool "
+            "with an empty queue is a busy engine keeping up, not "
+            "overload (shedding only ever removes QUEUED requests)")
+define_flag("FLAGS_shed_queue_frac", 0.75,
+            "queue-depth pressure watermark as a fraction of "
+            "FLAGS_serving_max_queue: depth past frac*max_queue reads "
+            "as pressure >= 1.0 (shed territory)")
+define_flag("FLAGS_shed_kv_frac", 0.95,
+            "KV-occupancy pressure watermark: active/usable blocks past "
+            "this fraction reads as pressure >= 1.0 (with a queued "
+            "backlog; see FLAGS_shed_min_queue)")
+define_flag("FLAGS_shed_wait_s", 30.0,
+            "predicted-queue-wait pressure watermark in seconds: an "
+            "EWMA-predicted drain time past this reads as pressure >= "
+            "1.0")
+define_flag("FLAGS_serving_brownout", True,
+            "brownout ladder (serving/overload.py): an edge-triggered, "
+            "hysteresis-guarded controller walks ordered degradation "
+            "stages under SUSTAINED overload pressure — 1: clamp "
+            "effective max_new_tokens, 2: reject low-priority submits, "
+            "3: admit only the top priority class — exposed as the "
+            "serving.brownout.stage gauge with flight-recorded "
+            "transitions; 0 reverts byte-for-byte (read at Scheduler "
+            "construction)")
+define_flag("FLAGS_brownout_enter_steps", 3,
+            "consecutive scheduler steps at pressure >= 1.0 before the "
+            "brownout ladder escalates one stage (sustained-overload "
+            "guard: a single spiky step never browns out)")
+define_flag("FLAGS_brownout_exit_steps", 6,
+            "consecutive steps at pressure <= FLAGS_brownout_exit_"
+            "pressure before the ladder de-escalates one stage "
+            "(hysteresis: recovery is deliberately slower than entry "
+            "so the stage never flaps)")
+define_flag("FLAGS_brownout_exit_pressure", 0.7,
+            "pressure level that counts toward brownout exit; the band "
+            "between this and 1.0 holds the current stage (neither "
+            "counter advances)")
+define_flag("FLAGS_brownout_clamp_tokens", 16,
+            "brownout stage >= 1 clamps each submit's effective "
+            "max_new_tokens to at most this (counted serving.brownout."
+            "clamped); 0 disables the clamp stage")
+define_flag("FLAGS_router_breaker", True,
+            "per-replica circuit breakers in the multi-replica router "
+            "(serving/router.py over core.resilience.CircuitBreaker): "
+            "repeated submit failures open a replica's breaker and "
+            "traffic skips it until a half-open probe succeeds; 0 "
+            "reverts byte-for-byte with router.breaker.* counter "
+            "silence (read at Router construction)")
+define_flag("FLAGS_breaker_failures", 5,
+            "core.resilience.CircuitBreaker default: consecutive "
+            "recorded failures that open a closed breaker")
+define_flag("FLAGS_breaker_reset_s", 30.0,
+            "core.resilience.CircuitBreaker default: seconds an open "
+            "breaker waits before allowing one half-open probe")
 define_flag("FLAGS_fleet_skew_ratio", 2.5,
             "fleet.skew alert threshold: a replica whose TTFT p95 "
             "exceeds this multiple of the fleet median p95 (both from "

@@ -39,14 +39,15 @@ from __future__ import annotations
 
 import functools
 import random
+import threading
 import time
 
 from . import flags as flags_mod
 from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
 
-__all__ = ["RetryPolicy", "Deadline", "policy", "retry", "retry_call",
-           "attempts", "degrade"]
+__all__ = ["RetryPolicy", "Deadline", "CircuitBreaker", "policy",
+           "retry", "retry_call", "attempts", "degrade"]
 
 # monkeypatch seam for tests (and the chaos gate) — backoff sleeps go
 # through here so a scenario can run wall-clock-free
@@ -265,6 +266,145 @@ class Deadline:
 
     def __repr__(self):  # pragma: no cover - debug aid
         return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+# -- circuit breaker -------------------------------------------------------
+
+class CircuitBreaker:
+    """Generic closed/open/half-open failure isolator.
+
+    Retry policies answer "try this call again"; a breaker answers the
+    opposite question — "stop offering work to a dependency that keeps
+    failing, and probe it before trusting it again." States:
+
+    - **closed** (healthy): ``allow()`` is True; ``record_failure``
+      counts consecutive failures and OPENS the breaker at
+      ``failure_threshold``; any ``record_success`` resets the count.
+    - **open**: ``allow()`` is False (callers skip the dependency)
+      until ``reset_s`` has elapsed, then the breaker goes half-open.
+    - **half-open**: exactly ONE caller gets ``allow()`` True (the
+      probe); its ``record_success`` closes the breaker, its
+      ``record_failure`` re-opens it (a fresh ``reset_s`` wait).
+      Concurrent callers are refused while the probe is in flight.
+
+    Thread-safe. ``failure_threshold``/``reset_s`` default from
+    ``FLAGS_breaker_failures``/``FLAGS_breaker_reset_s`` at
+    construction. ``counter_prefix`` (e.g. ``"router.breaker"``) opts
+    into registry counters ``<prefix>.{opened,closed,probes,skipped}``;
+    None keeps the breaker registry-silent (the serving router passes a
+    prefix only when ``FLAGS_router_breaker`` armed it, preserving the
+    flags-off counter-silence contract). ``record_failure`` returns
+    True exactly when THIS call transitioned the breaker to open, so
+    callers can log/degrade once per episode, not per failure.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    __slots__ = ("name", "failure_threshold", "reset_s", "_state",
+                 "_failures", "_opened_at", "_probe_inflight", "_lock",
+                 "_counters")
+
+    def __init__(self, name, failure_threshold=None, reset_s=None,
+                 counter_prefix=None):
+        self.name = str(name)
+        self.failure_threshold = (
+            int(flags_mod.flag("FLAGS_breaker_failures"))
+            if failure_threshold is None else int(failure_threshold))
+        self.reset_s = (
+            float(flags_mod.flag("FLAGS_breaker_reset_s"))
+            if reset_s is None else float(reset_s))
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._lock = threading.Lock()
+        self._counters = None if counter_prefix is None else tuple(
+            _metrics.counter(f"{counter_prefix}.{leaf}")
+            for leaf in ("opened", "closed", "probes", "skipped"))
+
+    def _count(self, idx):
+        if self._counters is not None:
+            self._counters[idx].inc()
+
+    @property
+    def state(self):
+        with self._lock:
+            if self._state == self.OPEN and \
+                    time.monotonic() - self._opened_at >= self.reset_s:
+                return self.HALF_OPEN  # next allow() will probe
+            return self._state
+
+    def allow(self):
+        """May the caller offer work to the dependency right now?
+        True in closed state and for the single half-open probe; False
+        while open (counted ``skipped`` — the short-circuit) and while
+        another probe is in flight."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if time.monotonic() - self._opened_at < self.reset_s:
+                    self._count(3)
+                    return False
+                self._state = self.HALF_OPEN
+                self._probe_inflight = False
+            if self._probe_inflight:
+                self._count(3)
+                return False
+            self._probe_inflight = True
+            self._count(2)
+            return True
+
+    def release_probe(self):
+        """Release an in-flight half-open probe WITHOUT a verdict: the
+        dependency answered with a structured POLICY refusal (alive,
+        but not accepting this work right now), so neither failure nor
+        recovery is proven. The probe slot frees — state stays
+        half-open and the next caller may probe again immediately —
+        instead of wedging every future ``allow()`` behind a probe
+        that will never report. No-op in other states."""
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                self._probe_inflight = False
+
+    def record_success(self):
+        """The offered work succeeded. Returns True when this call
+        CLOSED a half-open breaker (the probe came back healthy)."""
+        with self._lock:
+            self._failures = 0
+            if self._state == self.HALF_OPEN:
+                self._state = self.CLOSED
+                self._probe_inflight = False
+                self._count(1)
+                return True
+            return False
+
+    def record_failure(self):
+        """The offered work failed. Returns True exactly when this
+        call OPENED the breaker (threshold crossed, or a half-open
+        probe failed) — the edge a caller should degrade/log on."""
+        with self._lock:
+            now = time.monotonic()
+            if self._state == self.HALF_OPEN:
+                self._state = self.OPEN
+                self._opened_at = now
+                self._probe_inflight = False
+                self._count(0)
+                return True
+            self._failures += 1
+            if self._state == self.CLOSED \
+                    and self._failures >= self.failure_threshold:
+                self._state = self.OPEN
+                self._opened_at = now
+                self._count(0)
+                return True
+            return False
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return (f"CircuitBreaker({self.name!r}, state={self.state}, "
+                f"failures={self._failures})")
 
 
 # -- degradation events ----------------------------------------------------

@@ -309,6 +309,47 @@ def _goodput_view(snap):
     return lines
 
 
+def _overload_view(snap):
+    """"Overload" summary section: the admission/shed/brownout control
+    plane (serving/overload.py) plus the router's per-replica circuit
+    breakers — what the engine refused, dropped, or degraded to keep
+    the surviving traffic inside its SLOs. Renders only once any of it
+    acted (armed runs under pressure); a disarmed or uncontended
+    process shows nothing."""
+    shed = snap.get("serving.shed", 0)
+    rejected = snap.get("serving.admission.rejected", 0)
+    stage = snap.get("serving.brownout.stage", 0)
+    transitions = snap.get("serving.brownout.transitions", 0)
+    clamped = snap.get("serving.brownout.clamped", 0)
+    opened = snap.get("router.breaker.opened", 0)
+    skipped = snap.get("router.breaker.skipped", 0)
+    if not (shed or rejected or stage or transitions or clamped
+            or opened):
+        return []
+    lines = ["",
+             "{:-^72}".format(" Overload (admission / shed / brownout) "),
+             "{:<30} {}".format("metric", "value")]
+    rows = [
+        ("brownout stage", f"{stage} (transitions {transitions})"),
+        ("shed requests", f"{shed}"),
+        ("admission rejected", f"{rejected}"),
+        ("max_new_tokens clamped", f"{clamped}"),
+    ]
+    pred = snap.get("admission.predicted_ttft_us")
+    if isinstance(pred, dict) and pred.get("count"):
+        rows.append(("predicted TTFT p50/p95",
+                     f"{pred['p50']:.0f}us / {pred['p95']:.0f}us "
+                     f"({pred['count']} predictions)"))
+    if opened or skipped:
+        rows.append(("breaker opened / closed",
+                     f"{opened} / "
+                     f"{snap.get('router.breaker.closed', 0)}"))
+        rows.append(("breaker short-circuits", f"{skipped}"))
+    for name, value in rows:
+        lines.append("{:<30} {}".format(name, value))
+    return lines
+
+
 def _cold_start_view(snap):
     """"Cold start" summary section: the persistent AOT compile cache
     (serving/aot_cache.py) — hits/misses/stores against the on-disk
@@ -623,6 +664,7 @@ class Profiler:
         full_snap = metrics.snapshot()
         lines.extend(_capacity_view(full_snap))
         lines.extend(_goodput_view(full_snap))
+        lines.extend(_overload_view(full_snap))
         lines.extend(_cold_start_view(full_snap))
         lines.extend(_recent_incidents_view())
         if self._memory_samples:

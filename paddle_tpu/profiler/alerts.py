@@ -26,6 +26,10 @@ Rule catalog (docs/OBSERVABILITY.md "Alerts"):
   death is /healthz's signal.) Fires exactly once per stall episode —
   the incident stays active until progress resumes, then resolves; a
   later stall opens a fresh incident.
+- ``shed.rate`` — the overload controller (serving/overload.py) shed
+  load over the window (``serving.shed`` moved): capacity is being
+  exceeded and low-priority traffic dropped. Once per shedding
+  episode, worst recent queue-wait exemplar stamped.
 
 Firing is edge-triggered: an incident is recorded ONCE at the
 transition into firing (a watchdog flight record tagged
@@ -47,7 +51,7 @@ from . import metrics as _metrics
 from . import tracing as _tracing
 
 __all__ = ["AlertRule", "BurnRateRule", "QueueGrowthRule", "StallRule",
-           "AlertManager", "default_rules"]
+           "ShedRateRule", "AlertManager", "default_rules"]
 
 _c_fired = _metrics.counter("alerts.fired")
 _c_resolved = _metrics.counter("alerts.resolved")
@@ -188,6 +192,33 @@ class StallRule(AlertRule):
                        f"over {ctx['dt']:.1f}s — engine stalled")}
 
 
+class ShedRateRule(AlertRule):
+    """The overload controller is actively shedding load
+    (serving/overload.py): the ``serving.shed`` counter moved over the
+    window. Any nonzero rate pages — shedding is correct behavior
+    under overload, but an operator must know capacity is being
+    exceeded while it happens. Edge-triggered like every rule: one
+    incident per shedding episode (the flight record stamps the worst
+    RECENT queue-wait exemplar's trace — the concrete request class
+    that was waiting while sheds ran), resolved when sheds stop."""
+
+    name = "shed.rate"
+    severity = "page"
+
+    def evaluate(self, ctx):
+        rate = ctx["rates"].get("serving.shed", 0.0)
+        if rate <= 0.0:
+            return False, {}
+        return True, {
+            "value": round(rate, 3),
+            "trace_id": _worst_exemplar(ctx["snap"],
+                                        "serving.queue_wait_us",
+                                        _exemplar_age(ctx)),
+            "detail": (f"shedding {rate:.2f} req/s over "
+                       f"{ctx['dt']:.1f}s — demand exceeds capacity, "
+                       "low-priority traffic is being dropped")}
+
+
 def default_rules():
     return [
         BurnRateRule("slo.ttft_burn", "serving.ttft_us",
@@ -196,6 +227,7 @@ def default_rules():
                      "FLAGS_slo_itl_budget_us"),
         QueueGrowthRule(),
         StallRule(),
+        ShedRateRule(),
     ]
 
 

@@ -12,7 +12,10 @@ zero-cold-start control plane: a persistent AOT compile cache
 XLA compile), an explicit ``ServingEngine.warmup()`` gate
 (WARMING -> READY), and an SLO-weighted multi-replica ``Router``
 (``router`` — health-weighted placement, drain redistribution,
-exactly-once failover).
+exactly-once failover), and the overload control plane (``overload``
+— deadline-aware admission that fails fast with ``AdmissionRejected``,
+priority load shedding to terminal status ``SHED``, a hysteresis-
+guarded brownout ladder, and per-replica router circuit breakers).
 
     from paddle_tpu.serving import ServingEngine
 
@@ -27,17 +30,18 @@ contract, and the metric catalog.
 """
 
 from . import aot_cache  # noqa: F401
+from . import overload  # noqa: F401
 from .bucketing import bucket_length, bucket_lengths  # noqa: F401
-from .frontend import (Lifecycle, NotReadyError,  # noqa: F401
-                       QueueFullError, RequestHandle, RequestStatus,
-                       ServingEngine)
+from .frontend import (AdmissionRejected, Lifecycle,  # noqa: F401
+                       NotReadyError, QueueFullError, RequestHandle,
+                       RequestStatus, ServingEngine)
 from .router import (NoReplicaAvailable, RoutedHandle,  # noqa: F401
                      Router, RouterReplica)
 from .scheduler import Scheduler, ServingRequest  # noqa: F401
 
 __all__ = ["ServingEngine", "RequestHandle", "RequestStatus",
-           "QueueFullError", "Lifecycle", "NotReadyError",
-           "Scheduler", "ServingRequest",
+           "QueueFullError", "AdmissionRejected", "Lifecycle",
+           "NotReadyError", "Scheduler", "ServingRequest",
            "Router", "RouterReplica", "RoutedHandle",
-           "NoReplicaAvailable", "aot_cache",
+           "NoReplicaAvailable", "aot_cache", "overload",
            "bucket_length", "bucket_lengths"]

@@ -53,10 +53,12 @@ from ..core import resilience
 from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
 from .bucketing import bucket_lengths
-from .scheduler import QueueFullError, RequestStatus, Scheduler
+from .scheduler import (AdmissionRejected, QueueFullError,
+                        RequestStatus, Scheduler)
 
 __all__ = ["ServingEngine", "RequestHandle", "QueueFullError",
-           "RequestStatus", "Lifecycle", "NotReadyError"]
+           "AdmissionRejected", "RequestStatus", "Lifecycle",
+           "NotReadyError"]
 
 _SENTINEL = object()
 
@@ -111,6 +113,22 @@ class RequestHandle:
         return self._req.preempts
 
     @property
+    def priority(self):
+        """This request's priority class (serving/overload.py: smaller
+        = more important; overload.NORMAL when the caller passed
+        none)."""
+        return self._req.priority
+
+    @property
+    def retry_after_s(self):
+        """Back-off hint in seconds, set when this request was
+        load-SHED (status ``SHED``) by the overload controller — the
+        predicted time until the queue drains enough for a retry to
+        stand a chance. None otherwise (including when the service-time
+        model was not yet primed)."""
+        return self._req.retry_after_s
+
+    @property
     def trace_id(self):
         """This request's trace id (None when tracing is disabled or
         the trace was not sampled) — resolve it against the span ring
@@ -140,7 +158,8 @@ class RequestHandle:
     def stream(self, timeout=None):
         """Yield tokens as they are produced; ends when the request
         reaches a terminal status (check ``.status`` for CANCELLED /
-        TIMEOUT). If the ENGINE died the stream raises its fatal error
+        TIMEOUT / SHED — a shed request streamed nothing and carries
+        ``retry_after_s``). If the ENGINE died the stream raises its fatal error
         instead of ending — truncated output must never look complete.
         ``timeout`` bounds the wait per token (queue.Empty past it)."""
         while True:
@@ -176,6 +195,7 @@ class ServingEngine:
                  eos_token_id=None, dtype=None,
                  prefill_token_budget=None, max_queue=None,
                  bucket_cap=None, prefix_cache=None, accounting=None,
+                 admission=None, brownout=None,
                  background=True, ready=True):
         self._state = Lifecycle.WARMING
         self._sched = Scheduler(
@@ -184,7 +204,8 @@ class ServingEngine:
             temperature=temperature, eos_token_id=eos_token_id,
             dtype=dtype, prefill_token_budget=prefill_token_budget,
             max_queue=max_queue, bucket_cap=bucket_cap,
-            prefix_cache=prefix_cache, accounting=accounting)
+            prefix_cache=prefix_cache, accounting=accounting,
+            admission=admission, brownout=brownout)
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._background = background
@@ -203,14 +224,21 @@ class ServingEngine:
     # -- submission ----------------------------------------------------
 
     def submit(self, prompt_ids, max_new_tokens=32, *, deadline_s=None,
-               deadline=None, on_token=None):
+               deadline=None, priority=None, on_token=None):
         """Enqueue a request; returns a RequestHandle immediately.
 
         ``deadline_s`` (relative seconds) or ``deadline`` (a
         ``resilience.Deadline``) bounds total latency: expiry finishes
         the request with status TIMEOUT at the next step boundary and
-        frees its blocks. ``on_token(token)`` is called per generated
-        token from the stepping thread — keep it fast.
+        frees its blocks — and with the overload plane armed
+        (``FLAGS_serving_admission``) a deadline the EWMA service-time
+        model proves unmeetable raises ``AdmissionRejected`` HERE,
+        with a ``retry_after_s``, instead of queueing doomed work.
+        ``priority`` is an int class (serving/overload.py: smaller =
+        more important, default ``overload.NORMAL``) — the shed order
+        under pressure and the brownout ladder's admission floor.
+        ``on_token(token)`` is called per generated token from the
+        stepping thread — keep it fast.
         """
         handle = RequestHandle(self)
 
@@ -244,7 +272,8 @@ class ServingEngine:
                 deadline = resilience.Deadline.after(deadline_s)
             handle._req = self._sched.submit(
                 prompt_ids, max_new_tokens, deadline=deadline,
-                on_token=_sink_token, on_finish=_sink_finish)
+                priority=priority, on_token=_sink_token,
+                on_finish=_sink_finish)
             if self._background and self._thread is None:
                 self._thread = threading.Thread(
                     target=self._drive, name="paddle-tpu-serving",

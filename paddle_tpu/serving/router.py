@@ -33,7 +33,16 @@ and turns those signals into placement decisions:
   when every candidate refuses, the sweep retries under the
   ``core/resilience`` ``router.submit`` policy (jittered backoff)
   before :class:`NoReplicaAvailable` propagates (counted
-  ``router.rejected``);
+  ``router.rejected``) — carrying per-replica refusal reasons and the
+  smallest ``retry_after_s`` any structured rejection suggested;
+- **circuit breakers** (``FLAGS_router_breaker``, read at
+  construction) — each replica gets a
+  ``core.resilience.CircuitBreaker``: repeated submit failures OPEN it
+  and the sweep skips that replica outright (no submit attempt, no
+  per-sweep hammering of a dying engine) until the reset window
+  elapses and a single half-open probe request succeeds, which closes
+  it. Counted ``router.breaker.{opened,closed,probes,skipped}``,
+  opens degraded + flight-recorded;
 - **failover** — if a replica DIES mid-flight (its requests
   terminate ``ERROR``), :class:`RoutedHandle` re-submits the request
   to the next-best replica (counted ``router.failover``, degraded +
@@ -70,7 +79,8 @@ from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
 from ..testing import faults as _faults
 from .frontend import Lifecycle, NotReadyError
-from .scheduler import QueueFullError, RequestStatus
+from .scheduler import (AdmissionRejected, QueueFullError,
+                        RequestStatus)
 
 __all__ = ["Router", "RouterReplica", "RoutedHandle",
            "NoReplicaAvailable"]
@@ -84,7 +94,22 @@ _g_routable = _metrics.gauge("router.replicas.routable")
 
 class NoReplicaAvailable(RuntimeError):
     """No READY replica accepted the request — shed load upstream or
-    scale out."""
+    scale out. Diagnosable from the exception alone: ``reasons`` maps
+    each considered ``replica_id`` to why it refused (``NoEngine``,
+    ``NotReady(<state>)``, ``Dead``, ``breaker-open``, or the refusing
+    exception's type name, e.g. ``QueueFullError`` /
+    ``AdmissionRejected``), and ``retry_after_s`` carries the smallest
+    back-off any structured rejection suggested (None when none
+    did)."""
+
+    def __init__(self, message, *, reasons=None, retry_after_s=None):
+        self.reasons = dict(reasons or {})
+        self.retry_after_s = retry_after_s
+        if self.reasons:
+            message += " [" + ", ".join(
+                f"{rid}: {why}"
+                for rid, why in sorted(self.reasons.items())) + "]"
+        super().__init__(message)
 
 
 class RouterReplica:
@@ -263,6 +288,14 @@ class Router:
 
     def __init__(self, replicas=None, store=None, min_refresh_s=1.0):
         self._armed = bool(flags_mod.flag("FLAGS_serving_router"))
+        # per-replica circuit breakers (core.resilience.CircuitBreaker,
+        # read at construction like FLAGS_serving_router itself):
+        # repeated submit failures open a replica's breaker and the
+        # candidate sweep skips it until a half-open probe succeeds;
+        # disarmed = no breaker objects at all, router.breaker.* silent
+        self._breaker_armed = self._armed and bool(
+            flags_mod.flag("FLAGS_router_breaker"))
+        self._breakers = {}
         self._lock = threading.Lock()
         self._replicas = {}
         self._order = []  # insertion order: the disarmed primary
@@ -301,6 +334,10 @@ class Router:
     def remove_replica(self, replica_id):
         with self._lock:
             self._replicas.pop(str(replica_id), None)
+            # drop the breaker too: a re-registered id must not inherit
+            # a dead incarnation's open breaker, and churned ids must
+            # not accumulate state across a lifetime of deploys
+            self._breakers.pop(str(replica_id), None)
             try:
                 self._order.remove(str(replica_id))
             except ValueError:
@@ -341,22 +378,64 @@ class Router:
 
     # -- placement ------------------------------------------------------
 
-    def _candidates(self, exclude=()):
+    def _candidates(self, exclude=(), reasons=None):
+        """READY, engine-bound replicas ranked health-over-load.
+        ``reasons`` (a dict, mutated) collects why every OTHER known
+        replica was refused — the per-replica diagnosis
+        :class:`NoReplicaAvailable` carries."""
         self.refresh()
         with self._lock:
             reps = [self._replicas[rid] for rid in self._order
                     if rid not in exclude]
-        cands = [r for r in reps if r.engine is not None and r.ready()]
+        cands = []
+        for r in reps:
+            if r.engine is None:
+                if reasons is not None:
+                    reasons[r.replica_id] = "NoEngine"
+            elif not r.ready():
+                if reasons is not None:
+                    reasons[r.replica_id] = (
+                        "Dead" if r.engine._error is not None
+                        else f"NotReady({r.engine.lifecycle})")
+            else:
+                cands.append(r)
         _g_routable.set(len(cands))
         # health over load: equal replicas round-robin via the inflight
         # damping, a zero-health (silent/burning) replica sorts last
         cands.sort(key=lambda r: -(r.health() / (1.0 + r.inflight())))
         return cands
 
+    def _breaker(self, replica_id):
+        b = self._breakers.get(replica_id)
+        if b is None:
+            with self._lock:
+                b = self._breakers.setdefault(
+                    replica_id, resilience.CircuitBreaker(
+                        f"router.{replica_id}",
+                        counter_prefix="router.breaker"))
+        return b
+
     def _submit_once(self, prompt, max_new_tokens, kw, exclude=()):
         t0 = time.perf_counter_ns()
-        cands = self._candidates(exclude)
+        reasons = {}
+        cands = self._candidates(exclude, reasons)
+        retry_after = None
         for i, rep in enumerate(cands):
+            br = self._breaker(rep.replica_id) \
+                if self._breaker_armed else None
+            if br is not None:
+                try:
+                    _faults.site("router.breaker")
+                    allowed = br.allow()
+                except Exception as e:  # noqa: BLE001 — fail OPEN: a broken
+                    # breaker must not stop routing to a healthy replica
+                    resilience.degrade(
+                        "router.breaker",
+                        detail=f"replica={rep.replica_id}", exc=e)
+                    allowed = True
+                if not allowed:
+                    reasons[rep.replica_id] = "breaker-open"
+                    continue
             try:
                 _faults.site("router.submit")
                 _faults.site(f"router.submit.{rep.replica_id}")
@@ -367,7 +446,40 @@ class Router:
                 resilience.degrade(
                     "router.retry",
                     detail=f"replica={rep.replica_id}", exc=e)
+                reasons[rep.replica_id] = type(e).__name__
+                ra = getattr(e, "retry_after_s", None)
+                if ra is not None:
+                    retry_after = ra if retry_after is None \
+                        else min(retry_after, ra)
+                # the breaker isolates FAILING replicas, not busy ones:
+                # structured policy rejections (not-ready lifecycle,
+                # queue backpressure, overload admission) come from a
+                # HEALTHY engine doing its job — opening on them would
+                # blackhole the top-priority traffic the replica still
+                # accepts. Only unexpected failures count; a policy
+                # refusal releases any consumed half-open probe slot
+                # (no verdict) so recovery can never wedge behind it.
+                if br is not None:
+                    if isinstance(e, (NotReadyError, QueueFullError,
+                                      AdmissionRejected)):
+                        br.release_probe()
+                    elif br.record_failure():
+                        resilience.degrade(
+                            "router.breaker.open",
+                            detail=f"replica={rep.replica_id} after "
+                                   f"{br.failure_threshold} failures")
                 continue
+            except BaseException:
+                # caller-side errors (e.g. a validation ValueError)
+                # propagate untouched — but never leak a consumed
+                # probe slot on the way out
+                if br is not None:
+                    br.release_probe()
+                raise
+            if br is not None:
+                # a half-open probe that lands here closes the breaker
+                # (router.breaker.closed counts the edge)
+                br.record_success()
             _c_routed.inc()
             req = getattr(h, "_req", None)
             if req is not None:
@@ -379,7 +491,8 @@ class Router:
             return rep, h
         raise NoReplicaAvailable(
             f"router: no READY replica accepted the request "
-            f"({len(cands)} candidate(s), {len(exclude)} excluded)")
+            f"({len(cands)} candidate(s), {len(exclude)} excluded)",
+            reasons=reasons, retry_after_s=retry_after)
 
     def submit(self, prompt_ids, max_new_tokens=32, **kw):
         """Route one request; returns a :class:`RoutedHandle` (or,

@@ -25,8 +25,9 @@ around every entry point). Each ``step()`` is one scheduling iteration:
    and the prefill's sampled token is the next new token.
 
 Every request terminates in exactly one of ``DONE`` / ``CANCELLED`` /
-``TIMEOUT`` (or ``ERROR`` if the engine itself died). SLO telemetry
-goes to the always-on registry under ``serving.*`` (TTFT / inter-token
+``TIMEOUT`` / ``SHED`` (or ``ERROR`` if the engine itself died). SLO
+telemetry goes to the always-on registry under ``serving.*`` (TTFT /
+inter-token
 latency histograms, queue/slot/KV-utilization gauges, admitted/decoded/
 preempted counters) and is surfaced by ``profiler.summary()``.
 
@@ -37,6 +38,14 @@ billed to the triggering request, re-prefill billed to the preemption)
 into per-request ``CostReport``s and engine goodput, and the SLO
 burn-rate alert rules (``profiler/alerts.py``) are evaluated at step
 boundaries.
+
+With the overload control plane armed (``FLAGS_serving_admission`` /
+``FLAGS_serving_brownout``; ``serving/overload.py``), ``submit()``
+additionally rejects provably-unmeetable deadlines immediately
+(``AdmissionRejected`` with a ``retry_after_s``), each step sheds
+lowest-priority/newest queued requests past the pressure watermarks
+(terminal status ``SHED``, blocks never allocated), and a brownout
+ladder degrades service gracefully under sustained overload.
 """
 
 from __future__ import annotations
@@ -54,15 +63,28 @@ from ..profiler import accounting as _accounting
 from ..profiler import alerts as _alerts
 from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
+from . import overload as _overload
 from .bucketing import bucket_length
+from .overload import AdmissionRejected
 
 __all__ = ["RequestStatus", "ServingRequest", "Scheduler",
-           "QueueFullError"]
+           "QueueFullError", "AdmissionRejected"]
 
 
 class QueueFullError(RuntimeError):
     """Admission queue at FLAGS_serving_max_queue: backpressure — the
-    caller should retry later or shed load upstream."""
+    caller should retry later or shed load upstream. Carries structured
+    fields (``queue_depth``, ``max_queue``, ``retry_after_s`` — the
+    overload controller's predicted drain time, None when disarmed or
+    unprimed) so routers and clients back off by data, not by parsing
+    the message."""
+
+    def __init__(self, message, *, queue_depth=None, max_queue=None,
+                 retry_after_s=None):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.max_queue = max_queue
+        self.retry_after_s = retry_after_s
 
 
 class RequestStatus:
@@ -71,9 +93,10 @@ class RequestStatus:
     DONE = "DONE"
     CANCELLED = "CANCELLED"
     TIMEOUT = "TIMEOUT"
+    SHED = "SHED"
     ERROR = "ERROR"
 
-    TERMINAL = (DONE, CANCELLED, TIMEOUT, ERROR)
+    TERMINAL = (DONE, CANCELLED, TIMEOUT, SHED, ERROR)
 
 
 class ServingRequest:
@@ -85,10 +108,12 @@ class ServingRequest:
                  "on_token", "on_finish", "status", "generated", "slot",
                  "preempts", "admit_seq", "submitted_at", "admitted_at",
                  "first_token_at", "last_token_at", "cancel_requested",
-                 "span", "cost")
+                 "span", "cost", "priority", "est_tokens",
+                 "retry_after_s")
 
     def __init__(self, rid, prompt, max_new_tokens, deadline=None,
-                 on_token=None, on_finish=None):
+                 on_token=None, on_finish=None,
+                 priority=_overload.NORMAL):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -110,6 +135,13 @@ class ServingRequest:
         self.span = _tracing.NULL
         # CostReport bound by the accountant at submit; None disarmed
         self.cost = None
+        # overload control plane (serving/overload.py): priority class
+        # (smaller = more important), the controller's estimated
+        # uncovered-prefill tokens, and — set only when this request is
+        # load-SHED — the predicted back-off seconds for the caller
+        self.priority = priority
+        self.est_tokens = 0
+        self.retry_after_s = None
 
     @property
     def trace_id(self):
@@ -130,6 +162,7 @@ _m_done = _metrics.counter("serving.completed")
 _m_cancelled = _metrics.counter("serving.cancelled")
 _m_timeout = _metrics.counter("serving.timeout")
 _m_rejected = _metrics.counter("serving.rejected")
+_m_shed = _metrics.counter("serving.shed")
 _m_errors = _metrics.counter("serving.errors")
 _m_cb_errors = _metrics.counter("serving.callback_errors")
 _m_steps = _metrics.counter("serving.steps")
@@ -176,7 +209,8 @@ class Scheduler:
                  max_seq_len=2048, num_blocks=None, temperature=0.0,
                  eos_token_id=None, dtype=None,
                  prefill_token_budget=None, max_queue=None,
-                 bucket_cap=None, prefix_cache=None, accounting=None):
+                 bucket_cap=None, prefix_cache=None, accounting=None,
+                 admission=None, brownout=None):
         import jax.numpy as jnp
 
         cfg = model.config
@@ -219,6 +253,19 @@ class Scheduler:
         # step boundaries (rate-limited by FLAGS_alert_interval_s) and
         # served from the /alerts endpoint when serve_metrics attaches
         self.alerts = _alerts.AlertManager() if armed else None
+        # overload control plane (serving/overload.py): deadline-aware
+        # admission + priority shedding (FLAGS_serving_admission) and
+        # the brownout ladder (FLAGS_serving_brownout), read ONCE at
+        # construction like prefix_cache/accounting; both off = the
+        # preallocated null controller, behavior byte-for-byte
+        # pre-overload (tools/overload_gate.py pins the revert)
+        adm = (bool(flags_mod.flag("FLAGS_serving_admission"))
+               if admission is None else bool(admission))
+        brw = (bool(flags_mod.flag("FLAGS_serving_brownout"))
+               if brownout is None else bool(brownout))
+        self.overload = _overload.OverloadController(
+            admission=adm, brownout=brw) if (adm or brw) \
+            else _overload.NULL
         self.queue: list[ServingRequest] = []
         self.running: dict[int, ServingRequest] = {}  # slot -> request
         self.finished: dict[int, ServingRequest] = {}  # rid -> request
@@ -230,22 +277,37 @@ class Scheduler:
     # -- submission / cancellation ------------------------------------
 
     def submit(self, prompt_ids, max_new_tokens=32, *, deadline=None,
-               on_token=None, on_finish=None):
+               priority=None, on_token=None, on_finish=None):
         """Validate + enqueue; returns the ServingRequest. Raises
         ValueError on malformed or never-servable input (never corrupts
-        the cache, never hangs admission) and QueueFullError past the
-        admission bound."""
+        the cache, never hangs admission), QueueFullError past the
+        admission bound, and — overload control armed —
+        AdmissionRejected for a provably-unmeetable deadline or a
+        priority the brownout ladder's current stage refuses (both
+        BEFORE any queueing: fail fast, never pay prefill for a
+        request that cannot finish). ``priority`` is an int class,
+        smaller = more important (default overload.NORMAL)."""
         prompt = validate_request(prompt_ids, max_new_tokens,
                                   self.max_seq_len, self.cache,
                                   who="serving.submit")
+        pri = _overload.NORMAL if priority is None else int(priority)
         if self.max_queue and len(self.queue) >= self.max_queue:
             _m_rejected.inc()
             raise QueueFullError(
                 f"serving.submit: admission queue full "
-                f"({len(self.queue)} >= {self.max_queue})")
+                f"({len(self.queue)} >= {self.max_queue})",
+                queue_depth=len(self.queue), max_queue=self.max_queue,
+                retry_after_s=self.overload.queue_retry_after(self))
+        # the overload gate: brownout priority floor + predictive
+        # deadline rejection; also clamps max_new_tokens at stage >= 1
+        # and estimates this prompt's uncovered-prefill tokens (the
+        # quantity the pressure/wait predictions sum over)
+        est, max_new_tokens = self.overload.admit(
+            self, prompt, int(max_new_tokens), deadline, pri)
         req = ServingRequest(self._next_rid, prompt, max_new_tokens,
                              deadline=deadline, on_token=on_token,
-                             on_finish=on_finish)
+                             on_finish=on_finish, priority=pri)
+        req.est_tokens = est
         self._next_rid += 1
         req.span = _tracing.start_trace(
             "serving.request", rid=req.rid, prompt_len=len(prompt),
@@ -278,6 +340,11 @@ class Scheduler:
         t0 = time.monotonic()
         self.accounting.step_begin()
         self._sweep()
+        # overload control (serving/overload.py): pressure -> brownout
+        # ladder update -> shed lowest-priority/newest queued requests
+        # while over the watermarks — BEFORE admission, so a step never
+        # prefills work it is about to shed
+        self.overload.control(self)
         out = self._admit()
         out += self._decode()
         _m_steps.inc()
@@ -322,6 +389,25 @@ class Scheduler:
                                detail=f"rid={req.rid} "
                                       f"tokens={len(req.generated)}")
         self._finish(req, RequestStatus.TIMEOUT)
+
+    def shed(self, req, retry_after_s=None):
+        """Load-shed a QUEUED request (the overload controller's
+        victim): terminal status SHED, blocks never allocated, handle
+        closed with ``retry_after_s`` as the back-off hint. Survivors
+        are untouched — shedding never changes a running request's
+        schedule, so their greedy outputs stay bit-identical to an
+        uncontended run (the preemption pin, extended)."""
+        self.queue.remove(req)
+        req.retry_after_s = retry_after_s
+        _tracing.record_span("serving.shed", req.span, 0.0,
+                             priority=req.priority,
+                             queue_depth=len(self.queue))
+        with _tracing.attach(req.span):  # flight record gets trace_id
+            resilience.degrade(
+                "serving.shed",
+                detail=f"rid={req.rid} priority={req.priority} "
+                       f"queue={len(self.queue)}")
+        self._finish(req, RequestStatus.SHED)
 
     def _prefill_ids(self, req):
         # mirror of ContinuousBatchingEngine._prefill_ids — the
@@ -389,6 +475,7 @@ class Scheduler:
             _m_admitted.inc()
             comp0 = _compile_s()  # compile billed to THIS request
             saved0 = _saved_s()   # ...and so are AOT-cache savings
+            t_pf = time.perf_counter_ns()
             if covered:
                 tail_start = plan.tail_start
                 pad_to = bucket_length(ids_len - tail_start, bs,
@@ -413,6 +500,8 @@ class Scheduler:
                     tok = int(self.model.paged_prefill(
                         self.cache, slot, ids,
                         temperature=self.temperature, pad_to=pad_to))
+            pf_us = (time.perf_counter_ns() - t_pf) / 1000.0
+            comp_us = (_compile_s() - comp0) * 1e6
             if plan is not None:
                 _m_prefix_computed.inc(pad_to)
                 self.cache.commit_prefix(slot, plan)
@@ -420,10 +509,14 @@ class Scheduler:
             # tokens — covered prefix tokens are free in the
             # apportionment, re-prefill bills to the preemption event
             self.accounting.note_prefill(
-                req, pad_to, covered,
-                (_compile_s() - comp0) * 1e6,
+                req, pad_to, covered, comp_us,
                 reprefill=req.preempts > 0,
                 aot_saved_us=(_saved_s() - saved0) * 1e6)
+            # the admission model's EWMA sees the COMPILE-FREE cost per
+            # computed token — a cold bucket's compile must not poison
+            # the steady-state service-time estimate
+            self.overload.observe_prefill(pad_to,
+                                          max(pf_us - comp_us, 0.0))
             self._last_tok[slot] = tok
             self._remaining[slot] = \
                 req.max_new_tokens - len(req.generated) - 1
@@ -433,12 +526,20 @@ class Scheduler:
         return out
 
     def _choose_victim(self):
-        """Newest-admitted victim (FCFS holds), but reclaimability-
-        aware: preempting a request whose blocks are all SHARED frees
-        nothing — skip past such victims to the newest one whose
+        """Victim choice: with the overload plane armed, lowest
+        priority first, newest within a class (equal priorities reduce
+        to the legacy newest-admitted order, so default-priority
+        traffic is byte-for-byte unchanged); disarmed, pure
+        newest-admitted (FCFS holds). Either way reclaimability-aware:
+        preempting a request whose blocks are all SHARED frees
+        nothing — skip past such victims to the first one whose
         eviction actually returns blocks to the pool."""
-        cands = sorted(self.running,
-                       key=lambda s: -self.running[s].admit_seq)
+        if self.overload.shedding:
+            key = lambda s: (-self.running[s].priority,  # noqa: E731
+                             -self.running[s].admit_seq)
+        else:
+            key = lambda s: -self.running[s].admit_seq  # noqa: E731
+        cands = sorted(self.running, key=key)
         for s in cands:
             if self.cache.reclaimable_blocks(s) > 0:
                 return s
@@ -497,8 +598,10 @@ class Scheduler:
             self.cache, np.asarray(self._last_tok), active,
             temperature=self.temperature))
         dec_us = (time.perf_counter_ns() - t_dec) / 1000.0
-        self.accounting.note_decode_compile((_compile_s() - comp0) * 1e6)
+        dec_comp_us = (_compile_s() - comp0) * 1e6
+        self.accounting.note_decode_compile(dec_comp_us)
         self.accounting.note_decode_aot_saved((_saved_s() - saved0) * 1e6)
+        self.overload.observe_decode(max(dec_us - dec_comp_us, 0.0))
         out = []
         for slot, req in list(self.running.items()):
             t = int(toks[slot])
@@ -580,6 +683,7 @@ class Scheduler:
         {RequestStatus.DONE: _m_done,
          RequestStatus.CANCELLED: _m_cancelled,
          RequestStatus.TIMEOUT: _m_timeout,
+         RequestStatus.SHED: _m_shed,
          RequestStatus.ERROR: _m_errors}[status].inc()
         if req.on_finish is not None:
             try:

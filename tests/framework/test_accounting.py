@@ -543,7 +543,8 @@ def test_alerts_endpoint(model):
         srv.url("/alerts"), timeout=10).read())
     assert body["attached"] is True
     assert {r["name"] for r in body["rules"]} == {
-        "slo.ttft_burn", "slo.itl_burn", "queue.growth", "decode.stall"}
+        "slo.ttft_burn", "slo.itl_burn", "queue.growth", "decode.stall",
+        "shed.rate"}
     assert isinstance(body["active"], list)
     assert isinstance(body["history"], list)
     eng.close()

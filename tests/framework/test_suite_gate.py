@@ -159,3 +159,25 @@ def test_router_and_aot_surfaces_map_to_their_tests():
     # compile-seconds-saved billing lives in accounting
     t = suite_gate.targets_for(["paddle_tpu/profiler/accounting.py"])
     assert "tests/framework/test_router.py" in t
+
+
+def test_overload_surfaces_map_to_their_tests():
+    # the overload control plane (ISSUE 13): the module itself, the
+    # scheduler/frontend/router wiring, the CircuitBreaker home, the
+    # shed.rate alert rule, and the gate all run the overload suite
+    t = suite_gate.targets_for(["paddle_tpu/serving/overload.py"])
+    assert "tests/framework/test_overload.py" in t
+    assert "tests/framework/test_serving.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/serving/scheduler.py"])
+    assert "tests/framework/test_overload.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/serving/router.py"])
+    assert "tests/framework/test_overload.py" in t
+    assert "tests/framework/test_router.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/core/resilience.py"])
+    assert "tests/framework/test_overload.py" in t
+    assert "tests/framework/test_chaos.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/profiler/alerts.py"])
+    assert "tests/framework/test_overload.py" in t
+    assert "tests/framework/test_accounting.py" in t
+    t = suite_gate.targets_for(["tools/overload_gate.py"])
+    assert "tests/framework/test_overload.py" in t

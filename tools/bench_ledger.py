@@ -21,8 +21,10 @@ wall time, advisory), ``eager_gap`` (bench.py eager-vs-jit rung),
 (tools/fleet_gate.py aggregator refresh + federation checks),
 ``router_gate`` (tools/router_gate.py zero-cold-start: cold vs warm
 process compile seconds, AOT hit counts, traffic-shift/failover
-bits). The ledger itself is schema-free — any kind/metrics pair
-appends.
+bits), ``overload_gate`` (tools/overload_gate.py: high-priority
+goodput fraction under ~8x oversubscription, shed/reject counts,
+breaker + flags-off check bits). The ledger itself is schema-free —
+any kind/metrics pair appends.
 
 CLI::
 

@@ -1,0 +1,318 @@
+"""Overload gate: the admission/shed/brownout control plane (ISSUE 13)
+through five pass/fail checks, in order of importance:
+
+  1. overload-survival — drive ~8x the engine's slot capacity with
+     mixed priorities and deadlines; the engine never crashes, every
+     request reaches a clean terminal status, the TOP priority class
+     meets >= ``OVERLOAD_GATE_GOODPUT`` of its deadlines while the low
+     class sheds (``serving.shed`` > 0);
+  2. retry-after — every SHED handle and every structured rejection
+     (``AdmissionRejected`` for a provably-unmeetable deadline,
+     ``QueueFullError`` past the bound) carries a positive
+     ``retry_after_s``;
+  3. survivor-exactness — the surviving requests of the contended
+     mixed-priority run produce greedy outputs bit-identical to an
+     uncontended ``ContinuousBatchingEngine`` reference (the PR 5/8
+     preemption pin, extended to shedding);
+  4. breaker-shift — with submits to one replica failing (the
+     ``router.submit.<rid>`` fault site), its circuit breaker opens
+     after ``FLAGS_breaker_failures`` failures and routed traffic
+     skips it WITHOUT further submit attempts; past the reset window a
+     half-open probe succeeds and the replica is routable again;
+  5. flags-off — ``FLAGS_serving_admission=0 FLAGS_serving_brownout=0
+     FLAGS_router_breaker=0`` reverts byte-for-byte: the same corpus
+     completes DONE with outputs identical to the uncontended
+     reference, priority/deadline kwargs are inert, and
+     ``serving.shed`` / ``serving.admission.*`` /
+     ``serving.brownout.*`` / ``admission.*`` / ``router.breaker.*``
+     stay counter-silent.
+
+Exit 0 on pass, 1 on fail; one line per check. Runs under
+JAX_PLATFORMS=cpu (tier-1, like tests/framework/test_overload.py);
+wired into tools/suite_gate.py beside the serving/router gates, and
+appends an ``overload_gate`` entry (high-priority goodput fraction,
+shed/reject counts, check bits) to the continuous-bench ledger
+(tools/bench_ledger.py).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+GOODPUT_FLOOR = float(os.environ.get("OVERLOAD_GATE_GOODPUT", "0.9"))
+BREAKER_RESET_S = float(os.environ.get("OVERLOAD_GATE_RESET_S", "0.3"))
+
+
+def _model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    from paddle_tpu.serving import ServingEngine
+
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("bucket_cap", 32)
+    kw.setdefault("background", False)
+    return ServingEngine(model, **kw)
+
+
+def _prompts(seed, sizes):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 255, (s,)).astype("int64") for s in sizes]
+
+
+def _prime(eng, n=3, seed=99):
+    for p in _prompts(seed, [5] * n):
+        eng.submit(p, max_new_tokens=2)
+        eng.run_until_idle()
+    assert eng.scheduler.overload.model.primed
+
+
+def _refs(model, prompts, n):
+    from paddle_tpu.inference.paged import ContinuousBatchingEngine
+
+    out = []
+    for p in prompts:
+        eng = ContinuousBatchingEngine(model, max_batch=2, block_size=8,
+                                       max_seq_len=64, temperature=0.0)
+        rid = eng.add_request(p, max_new_tokens=n)
+        out.append(list(eng.run_to_completion()[rid]))
+    return out
+
+
+# the contended corpus: ~8x the 2 decode slots, HIGH first (FCFS keeps
+# them at the queue head), generous deadlines for the protected class
+_SIZES = [5, 7, 6, 9, 5, 8, 7, 6, 9, 5, 8, 7, 6, 9, 5, 7]
+
+
+def run_contended(model, prompts, refs):
+    """The shared oversubscription scenario for checks 1-3. Returns
+    (handles, priorities, shed_count_delta, engine_crashed)."""
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import overload
+
+    eng = _engine(model, max_queue=32)
+    _prime(eng)
+    ov = eng.scheduler.overload
+    ov.min_queue = 3
+    ov.queue_frac = 0.125  # shed past 4 queued (32 * 0.125)
+    shed0 = metrics.snapshot("serving.shed")["serving.shed"]
+    pris = [overload.HIGH if i < 4 else
+            (overload.NORMAL if i < 8 else overload.LOW)
+            for i in range(len(prompts))]
+    handles, crashed = [], False
+    try:
+        for p, pri in zip(prompts, pris):
+            handles.append(eng.submit(
+                p, max_new_tokens=4, priority=pri,
+                deadline_s=300.0 if pri == overload.HIGH else None))
+        eng.run_until_idle()
+    except Exception as e:  # noqa: BLE001 — the gate reports, never raises
+        crashed = True
+        print(f"[overload-gate] engine crashed: {type(e).__name__}: {e}")
+    shed = metrics.snapshot("serving.shed")["serving.shed"] - shed0
+    eng.close()
+    return handles, pris, shed, crashed
+
+
+def check_survival(model, handles, pris, shed, crashed):
+    from paddle_tpu.serving import overload
+
+    terminal = all(h.status in ("DONE", "CANCELLED", "TIMEOUT", "SHED",
+                                "ERROR") for h in handles)
+    high = [h for h, p in zip(handles, pris) if p == overload.HIGH]
+    met = [h for h in high if h.status == "DONE"
+           and (h.cost() is None or h.cost().deadline_met is not False)]
+    frac = len(met) / max(len(high), 1)
+    low_shed = sum(1 for h, p in zip(handles, pris)
+                   if p == overload.LOW and h.status == "SHED")
+    ok = (not crashed and terminal and frac >= GOODPUT_FLOOR
+          and shed > 0 and low_shed > 0)
+    print(f"[overload-gate] survival: crashed={crashed} "
+          f"all-terminal={terminal} high-goodput={frac:.2f} "
+          f"(want >= {GOODPUT_FLOOR}) shed={shed} low-shed={low_shed} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok, frac
+
+
+def check_retry_after(model, handles):
+    from paddle_tpu.serving import AdmissionRejected, QueueFullError
+
+    shed_hs = [h for h in handles if h.status == "SHED"]
+    shed_ok = all(h.retry_after_s is not None and h.retry_after_s > 0
+                  for h in shed_hs)
+    # structured rejections on a fresh primed engine
+    eng = _engine(model, max_queue=1)
+    _prime(eng)
+    adm_ra = qf_ra = None
+    try:
+        eng.submit(_prompts(31, [30])[0], max_new_tokens=4,
+                   deadline_s=1e-6)
+    except AdmissionRejected as e:
+        adm_ra = e.retry_after_s
+    eng.submit(_prompts(32, [5])[0], max_new_tokens=2)  # fill the queue
+    try:
+        eng.submit(_prompts(32, [6])[0], max_new_tokens=2)
+    except QueueFullError as e:
+        qf_ra = e.retry_after_s
+    eng.run_until_idle()
+    eng.close()
+    ok = (shed_ok and len(shed_hs) > 0
+          and adm_ra is not None and adm_ra > 0
+          and qf_ra is not None and qf_ra > 0)
+    print(f"[overload-gate] retry-after: shed-carry={shed_ok} "
+          f"({len(shed_hs)} shed) admission={adm_ra} queue-full={qf_ra} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_survivor_exactness(handles, refs):
+    done = [(h, r) for h, r in zip(handles, refs) if h.status == "DONE"]
+    exact = all(h.tokens() == r for h, r in done)
+    ok = exact and len(done) >= 4
+    print(f"[overload-gate] survivor-exactness: {len(done)} survivors "
+          f"bit-identical={exact} {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_breaker_shift(model):
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import Router
+    from paddle_tpu.testing import faults
+
+    saved = paddle.get_flags(["FLAGS_breaker_failures",
+                              "FLAGS_breaker_reset_s"])
+    paddle.set_flags({"FLAGS_breaker_failures": 2,
+                      "FLAGS_breaker_reset_s": BREAKER_RESET_S})
+    try:
+        e1 = _engine(model)
+        e2 = _engine(model)
+        router = Router()
+        router.add_replica("o1", engine=e1)
+        router.add_replica("o2", engine=e2)
+        opened0 = metrics.snapshot("router.breaker.").get(
+            "router.breaker.opened", 0)
+        faults.arm("router.submit.o1", nth=1, count=10 ** 6)
+        try:
+            for p in _prompts(33, [5, 5]):
+                router.submit(p, max_new_tokens=2)
+            opened = metrics.snapshot("router.breaker.")[
+                "router.breaker.opened"] - opened0
+            hits0 = faults.hits("router.submit.o1")
+            shifted = [router.submit(p, max_new_tokens=2)
+                       for p in _prompts(34, [5, 6, 7, 5])]
+            no_hammer = faults.hits("router.submit.o1") == hits0
+            all_o2 = all(h.replica_id == "o2" for h in shifted)
+        finally:
+            faults.disarm("router.submit.o1")
+        time.sleep(BREAKER_RESET_S + 0.05)
+        closed0 = metrics.snapshot("router.breaker.").get(
+            "router.breaker.closed", 0)
+        probe = router.submit(_prompts(35, [5])[0], max_new_tokens=2)
+        reclosed = metrics.snapshot("router.breaker.")[
+            "router.breaker.closed"] - closed0 == 1
+        for eng in (e1, e2):
+            eng.run_until_idle()
+        done = probe.status == "DONE" and \
+            all(h.status == "DONE" for h in shifted)
+        ok = opened == 1 and no_hammer and all_o2 and reclosed and done
+        print(f"[overload-gate] breaker-shift: opened={opened} (want 1) "
+              f"skip-no-submit={no_hammer} all-on-healthy={all_o2} "
+              f"probe-reclosed={reclosed} all-done={done} "
+              f"{'PASS' if ok else 'FAIL'}")
+        e1.close()
+        e2.close()
+        return ok
+    finally:
+        paddle.set_flags(saved)
+
+
+def check_flags_off(model, refs):
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import Router, overload
+
+    saved = paddle.get_flags(["FLAGS_serving_admission",
+                              "FLAGS_serving_brownout",
+                              "FLAGS_router_breaker"])
+    paddle.set_flags({"FLAGS_serving_admission": False,
+                      "FLAGS_serving_brownout": False,
+                      "FLAGS_router_breaker": False})
+    prefixes = ("serving.shed", "serving.admission.",
+                "serving.brownout.", "admission.", "router.breaker.")
+    try:
+        before = {p: metrics.snapshot(p) for p in prefixes}
+        eng = _engine(model, max_queue=32)
+        is_null = eng.scheduler.overload is overload.NULL
+        router = Router()
+        router.add_replica("f1", engine=eng)
+        no_breakers = router._breaker_armed is False
+        prompts = _prompts(30, _SIZES)
+        hs = [router.submit(p, max_new_tokens=4, priority=overload.LOW,
+                            deadline_s=300.0) for p in prompts]
+        eng.run_until_idle()
+        all_done = all(h.status == "DONE" for h in hs)
+        exact = all(h.tokens() == r for h, r in zip(hs, refs))
+        silent = all(metrics.snapshot(p) == before[p] for p in prefixes)
+        eng.close()
+    finally:
+        paddle.set_flags(saved)
+    ok = is_null and no_breakers and all_done and exact and silent
+    print(f"[overload-gate] flags-off: null-controller={is_null} "
+          f"no-breakers={no_breakers} all-done={all_done} "
+          f"bit-identical={exact} counter-silent={silent} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def main():
+    model = _model()
+    prompts = _prompts(30, _SIZES)
+    refs = _refs(model, prompts, 4)
+    handles, pris, shed, crashed = run_contended(model, prompts, refs)
+    ok1, frac = check_survival(model, handles, pris, shed, crashed)
+    ok2 = check_retry_after(model, handles)
+    ok3 = check_survivor_exactness(handles, refs)
+    ok4 = check_breaker_shift(model)
+    ok5 = check_flags_off(model, refs)
+    ok = ok1 and ok2 and ok3 and ok4 and ok5
+    try:
+        from paddle_tpu.profiler import metrics
+        import bench_ledger
+        snap = metrics.snapshot()
+        bench_ledger.append_entry("overload_gate", {
+            "high_goodput_frac": round(frac, 3),
+            "shed": float(shed),
+            "admission_rejected": float(
+                snap.get("serving.admission.rejected", 0)),
+            "breaker_ok": 1.0 if ok4 else 0.0,
+            "flags_off_ok": 1.0 if ok5 else 0.0})
+        print(f"[overload-gate] ledger: appended overload_gate "
+              f"(goodput {frac:.2f}, shed {shed})")
+    except Exception as e:  # noqa: BLE001 — ledger trouble is advisory
+        print(f"[overload-gate] ledger append skipped "
+              f"({type(e).__name__}: {e})")
+    print(f"[overload-gate] {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

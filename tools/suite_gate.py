@@ -37,11 +37,13 @@ _MAP = [
                          "tests/test_special_ops.py", "tests/test_ops.py",
                          "tests/ops"]),
     ("paddle_tpu/core/resilience.py", ["tests/framework/test_chaos.py",
-                                       "tests/framework/test_serving.py"]),
+                                       "tests/framework/test_serving.py",
+                                       "tests/framework/test_overload.py"]),
     ("paddle_tpu/serving/", ["tests/framework/test_serving.py",
                              "tests/framework/test_prefix_cache.py",
                              "tests/framework/test_fleet_observatory.py",
-                             "tests/framework/test_router.py"]),
+                             "tests/framework/test_router.py",
+                             "tests/framework/test_overload.py"]),
     ("paddle_tpu/inference/", ["tests/framework/test_paged_decode.py",
                                "tests/framework/test_serving.py",
                                "tests/framework/test_prefix_cache.py"]),
@@ -78,7 +80,8 @@ _MAP = [
       "tests/framework/test_serving.py",
       "tests/framework/test_router.py"]),
     ("paddle_tpu/profiler/alerts.py",
-     ["tests/framework/test_accounting.py"]),
+     ["tests/framework/test_accounting.py",
+      "tests/framework/test_overload.py"]),
     ("paddle_tpu/profiler/fleet.py",
      ["tests/framework/test_fleet_observatory.py"]),
     ("paddle_tpu/profiler/", ["tests/framework/test_profiler_protobuf.py",
@@ -105,6 +108,7 @@ _MAP = [
     ("tools/accounting_gate.py", ["tests/framework/test_accounting.py"]),
     ("tools/fleet_gate.py", ["tests/framework/test_fleet_observatory.py"]),
     ("tools/router_gate.py", ["tests/framework/test_router.py"]),
+    ("tools/overload_gate.py", ["tests/framework/test_overload.py"]),
     ("tools/bench_ledger.py",
      ["tests/framework/test_regression_ledger.py"]),
     ("tools/regression_gate.py",
