@@ -170,6 +170,86 @@ def bench_llama_decode(config, max_batch, prompt_len, new_tokens, tag,
     }
 
 
+def bench_decode_tiers(max_new=24):
+    """Decode speed tiers on the serving scheduler (docs/SERVING.md
+    "Decode speed tiers"): the same corpus decoded base vs
+    self-speculative (FLAGS_serving_spec) vs int8-KV
+    (FLAGS_kv_cache_dtype) — wall tokens/s per mode, the speculative
+    tokens-per-step multiple (step-count ratio on the repetitive
+    corpus), and the draft acceptance rate. Appends kind
+    ``decode_tiers`` to BENCH_LEDGER.jsonl; tools/regression_gate.py
+    medians it with direction-aware tolerances (_per_s/_per_step/_rate
+    regress DOWN)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Llama, LlamaConfig
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.spec import repetitive_prompts
+
+    paddle.seed(0)
+    model = Llama(LlamaConfig.tiny())
+    model.eval()
+    # the SAME high-acceptance corpus tools/spec_gate.py pins (greedy
+    # continuation self-repetitive for the seed-0 tiny model)
+    prompts = repetitive_prompts()
+
+    def run(**kw):
+        eng = ServingEngine(model, max_batch=2, block_size=8,
+                            max_seq_len=64, temperature=0.0,
+                            bucket_cap=32, background=False,
+                            dtype=jnp.float32, **kw)
+        for p in prompts:  # warm every program outside the timed
+            # window (max_new 6: deep enough that the speculative
+            # sweep actually engages and compiles during warmup)
+            eng.submit(p, max_new_tokens=6)
+            eng.run_until_idle()
+        s0 = metrics.snapshot("serving.")
+        t0 = time.perf_counter()
+        toks = 0
+        for p in prompts:  # batch-1: steps map 1:1 to decode sweeps
+            h = eng.submit(p, max_new_tokens=max_new)
+            eng.run_until_idle()
+            toks += len(h.tokens())
+        dt = time.perf_counter() - t0
+        s1 = metrics.snapshot("serving.")
+        eng.close()
+        return toks / dt, s1["serving.steps"] - s0["serving.steps"], \
+            s0, s1
+
+    base_tps, base_steps, _, _ = run()
+    # s0/s1 bracket the timed window only, so the ledgered accept rate
+    # is measured over the same tokens as the throughput numbers (the
+    # warmup submissions also speculate and would dilute it)
+    spec_tps, spec_steps, b, a = run(spec=True)
+    quant_tps, _, _, _ = run(kv_cache_dtype="int8")
+    proposed = a.get("serving.spec.proposed", 0) - \
+        b.get("serving.spec.proposed", 0)
+    accepted = a.get("serving.spec.accepted", 0) - \
+        b.get("serving.spec.accepted", 0)
+    out = {
+        "tag": "decode_tiers_tiny",
+        "decode_base_tokens_per_s": round(base_tps, 1),
+        "decode_spec_tokens_per_s": round(spec_tps, 1),
+        "decode_quant_tokens_per_s": round(quant_tps, 1),
+        "spec_decode_tokens_per_step": round(
+            base_steps / max(spec_steps, 1), 3),
+        "spec_accept_rate": round(accepted / max(proposed, 1), 3),
+    }
+    try:
+        import os
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import bench_ledger
+        bench_ledger.append_entry("decode_tiers", {
+            k: v for k, v in out.items()
+            if isinstance(v, (int, float))})
+    except Exception:  # noqa: BLE001 — ledger trouble is advisory
+        pass
+    return out
+
+
 def bench_vit_train(factory, batch, steps, tag, image=224):
     import paddle_tpu as paddle
     from paddle_tpu import nn, optimizer
@@ -1022,6 +1102,7 @@ def main():
         ladder["llama_decode_smoke"] = _try(
             bench_llama_decode, LlamaConfig.tiny(), 2, 8, 8,
             "llama_tiny_decode", dtype="float32")
+        ladder["decode_tiers"] = _try(bench_decode_tiers)
         fp8_cfg = GPTConfig.tiny()
         fp8_cfg.use_fp8 = True
         ladder["gpt_fp8_smoke"] = _try(

@@ -39,6 +39,14 @@ def main():
                          "engine past capacity with mixed priorities "
                          "and watch shedding, fast rejection, and the "
                          "brownout stage (docs/SERVING.md)")
+    ap.add_argument("--spec", action="store_true",
+                    help="demo self-speculative decoding "
+                         "(FLAGS_serving_spec): the same corpus "
+                         "decoded with and without prompt-lookup "
+                         "drafts — bit-identical tokens, fewer steps; "
+                         "prints acceptance rate and the tokens/step "
+                         "delta from the registry (docs/SERVING.md "
+                         "'Decode speed tiers')")
     args = ap.parse_args()
 
     import jax
@@ -220,6 +228,48 @@ def main():
                   f"{snap['serving.admission.rejected']} "
                   f"brownout.stage={snap['serving.brownout.stage']}")
             print(f"  {eng.accounting.goodput_line()}")
+
+    if args.spec:
+        # --- decode speed tiers: self-speculative decoding ------------
+        # (FLAGS_serving_spec, docs/SERVING.md "Decode speed tiers"):
+        # prompt-lookup drafts verified in one batched multi-position
+        # sweep — greedy outputs bit-identical, fewer scheduler steps.
+        # The corpus is the SAME repetitive family tools/spec_gate.py
+        # pins (high acceptance for the seed-0 tiny model).
+        from paddle_tpu.serving.spec import repetitive_prompts
+        rep = repetitive_prompts()
+
+        def run_tier(spec):
+            outs, steps = [], 0
+            with ServingEngine(model, max_batch=2, block_size=8,
+                               max_seq_len=64, temperature=0.0,
+                               bucket_cap=32, background=False,
+                               spec=spec) as eng:
+                s0 = metrics.snapshot("serving.")
+                for p in rep:
+                    h = eng.submit(p, max_new_tokens=24)
+                    eng.run_until_idle()
+                    outs.append(h.tokens())
+                steps = metrics.snapshot("serving.")["serving.steps"] \
+                    - s0["serving.steps"]
+            return outs, steps
+
+        b = metrics.snapshot("serving.spec.")
+        base_outs, base_steps = run_tier(False)
+        spec_outs, spec_steps = run_tier(True)
+        a = metrics.snapshot("serving.spec.")
+        proposed = a["serving.spec.proposed"] - \
+            b["serving.spec.proposed"]
+        accepted = a["serving.spec.accepted"] - \
+            b["serving.spec.accepted"]
+        assert spec_outs == base_outs, "speculative decode must be " \
+            "bit-identical to plain greedy decode"
+        print(f"spec decode: {base_steps} -> {spec_steps} steps for "
+              f"the same {sum(len(o) for o in base_outs)} tokens "
+              f"({base_steps / max(spec_steps, 1):.2f}x tokens/step), "
+              f"drafts accepted {accepted}/{proposed} "
+              f"(rate {accepted / max(proposed, 1):.2f}); outputs "
+              f"bit-identical")
 
     # paged decode must agree with the dense-cache generate path
     prompt = rng.integers(3, model.config.vocab_size, size=6)

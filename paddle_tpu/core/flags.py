@@ -375,6 +375,37 @@ define_flag("FLAGS_breaker_failures", 5,
 define_flag("FLAGS_breaker_reset_s", 30.0,
             "core.resilience.CircuitBreaker default: seconds an open "
             "breaker waits before allowing one half-open probe")
+define_flag("FLAGS_kv_cache_dtype", "",
+            "serving KV-cache block storage dtype (inference/paged.py): "
+            "'int8' stores the paged K/V pools as int8 with per-(row, "
+            "kv-head) absmax scales beside the pool (the quantization."
+            "AbsmaxObserver formula), roughly DOUBLING the usable block "
+            "pool for the same HBM — engines auto-size num_blocks by "
+            "the honest byte ratio and occupancy()/pool_bytes() report "
+            "it; '' (default) keeps full-precision pools byte-for-byte "
+            "with serving.kv.quant.* silence (read at engine "
+            "construction, the FLAGS_serving_prefix_cache convention)")
+define_flag("FLAGS_serving_spec", False,
+            "self-speculative decoding in the serving scheduler "
+            "(serving/spec.py + Scheduler._decode_spec): a prompt-"
+            "lookup n-gram proposer drafts up to FLAGS_serving_spec_"
+            "tokens tokens per request (no second model) and ONE "
+            "batched multi-position paged sweep verifies them, "
+            "accepting the longest greedy-matching prefix and rolling "
+            "back rejected rows' blocks before the next step; greedy "
+            "outputs stay bit-identical to non-speculative decode "
+            "(tools/spec_gate.py pins it) and the tier only engages at "
+            "temperature 0; 0 (default) reverts byte-for-byte with "
+            "serving.spec.* counter silence (read at Scheduler "
+            "construction)")
+define_flag("FLAGS_serving_spec_tokens", 4,
+            "max draft tokens proposed per request per speculative "
+            "step (the verify sweep is one static program of 1 + this "
+            "many positions; min 1)")
+define_flag("FLAGS_serving_spec_ngram", 3,
+            "longest trailing n-gram the prompt-lookup proposer "
+            "matches against the request's own context (falls back to "
+            "shorter n-grams down to 1 before giving up)")
 define_flag("FLAGS_fleet_skew_ratio", 2.5,
             "fleet.skew alert threshold: a replica whose TTFT p95 "
             "exceeds this multiple of the fleet median p95 (both from "

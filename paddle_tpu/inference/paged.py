@@ -54,8 +54,44 @@ _PREFIX_EVICT = _metrics.counter("serving.prefix.evictions")
 __all__ = ["PagedKVCache", "paged_prefill_write",
            "paged_prefill_write_masked", "paged_decode_attention",
            "paged_decode_attention_dense", "paged_prefix_attention_dense",
+           "paged_spec_write", "paged_spec_attention_dense",
            "ContinuousBatchingEngine", "validate_request",
-           "chunk_digests", "PrefixPlan", "CapacityError"]
+           "chunk_digests", "PrefixPlan", "CapacityError",
+           "resolve_kv_dtype", "quant_block_ratio"]
+
+
+# ---------------------------------------------------------------------------
+# int8 KV block storage (FLAGS_kv_cache_dtype; docs/SERVING.md
+# "Decode speed tiers")
+# ---------------------------------------------------------------------------
+
+def resolve_kv_dtype(kv_cache_dtype):
+    """Normalize an engine's ``kv_cache_dtype`` setting (a ctor kwarg
+    or the ``FLAGS_kv_cache_dtype`` string): ``None`` for full-
+    precision pools, ``"int8"`` for quantized block storage. The cache
+    itself never reads flags — engines resolve at construction (the
+    FLAGS_serving_prefix_cache convention) and pass the result down."""
+    v = str(kv_cache_dtype or "").strip().lower()
+    if v in ("", "none", "auto", "0", "off", "false"):
+        return None
+    if v == "int8":
+        return "int8"
+    raise ValueError(
+        f"kv_cache_dtype: unsupported value {kv_cache_dtype!r} "
+        f"(expected '' or 'int8')")
+
+
+def quant_block_ratio(head_dim, dtype):
+    """Honest bytes-per-block ratio of a ``dtype`` pool over an int8
+    pool INCLUDING its per-(row, head) float32 scales — the effective-
+    capacity multiplier ``FLAGS_kv_cache_dtype=int8`` buys (engines
+    auto-size ``num_blocks`` by it; ``serving.kv.quant.capacity_
+    multiplier`` reports it). Block size and head count divide out:
+    each head-row costs ``head_dim * itemsize`` bytes full-precision
+    vs ``head_dim + 4`` quantized, so the ratio is ~1.9x at head_dim
+    64, asymptoting to 2x as head_dim grows (the scale overhead is
+    4/head_dim)."""
+    return head_dim * jnp.dtype(dtype).itemsize / (head_dim + 4)
 
 
 # ---------------------------------------------------------------------------
@@ -174,7 +210,7 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_kv_heads, head_dim, *, num_blocks,
                  block_size=16, max_blocks_per_seq, max_batch,
-                 dtype=jnp.bfloat16):
+                 dtype=jnp.bfloat16, kv_dtype=None):
         self.num_layers = num_layers
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
@@ -183,9 +219,29 @@ class PagedKVCache:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.max_batch = max_batch
         self.dtype = dtype
+        # ``kv_dtype="int8"`` (FLAGS_kv_cache_dtype, resolved by the
+        # engine): pools store int8 rows with per-(token-slot, kv-head)
+        # float32 absmax scales beside them (quantization.quantize_rows
+        # — the AbsmaxObserver formula); ``dtype`` stays the COMPUTE
+        # dtype the attention dequantizes into. Every block-level
+        # mechanism (tables, refcounts, prefix index, COW, LRU) is
+        # dtype-blind, so prefix sharing carries over unchanged.
+        self.kv_dtype = resolve_kv_dtype(kv_dtype)
+        self.quantized = self.kv_dtype == "int8"
         shape = (num_blocks, block_size, num_kv_heads, head_dim)
-        self.k_pools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
-        self.v_pools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        store_dt = jnp.int8 if self.quantized else dtype
+        self.k_pools = [jnp.zeros(shape, store_dt)
+                        for _ in range(num_layers)]
+        self.v_pools = [jnp.zeros(shape, store_dt)
+                        for _ in range(num_layers)]
+        if self.quantized:
+            sshape = (num_blocks, block_size, num_kv_heads)
+            self.k_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(num_layers)]
+            self.v_scales = [jnp.zeros(sshape, jnp.float32)
+                             for _ in range(num_layers)]
+        else:
+            self.k_scales = self.v_scales = None
         # block 0 is reserved as the null block so fresh table entries are
         # valid indices; the length mask hides its contents
         self._free = list(range(num_blocks - 1, 0, -1))
@@ -250,11 +306,18 @@ class PagedKVCache:
 
     def pool_bytes(self):
         """Total HBM footprint of the K+V pools (static: allocated at
-        construction, independent of occupancy)."""
+        construction, independent of occupancy). Quantized pools count
+        their int8 rows PLUS the float32 scale arrays — the multiplier
+        ``occupancy()`` shows must never be paid for twice in hidden
+        bytes (tools/spec_gate.py pins consistency)."""
+        item = 1 if self.quantized else jnp.dtype(self.dtype).itemsize
         per_pool = (self.num_blocks * self.block_size *
-                    self.num_kv_heads * self.head_dim *
-                    jnp.dtype(self.dtype).itemsize)
-        return 2 * self.num_layers * per_pool
+                    self.num_kv_heads * self.head_dim * item)
+        total = 2 * self.num_layers * per_pool
+        if self.quantized:
+            total += (2 * self.num_layers * self.num_blocks *
+                      self.block_size * self.num_kv_heads * 4)
+        return total
 
     # -- block primitives --------------------------------------------------
 
@@ -299,12 +362,19 @@ class PagedKVCache:
 
     def _copy_block_rows(self, src, dst):
         """Copy-on-write body: duplicate one pool block across every
-        layer (the K and V rows move together)."""
+        layer (the K and V rows move together; quantized pools copy
+        the scale rows with them — an int8 copy is bit-exact, so
+        shared-vs-private content stays identical)."""
         for i in range(self.num_layers):
             self.k_pools[i] = self.k_pools[i].at[dst].set(
                 self.k_pools[i][src])
             self.v_pools[i] = self.v_pools[i].at[dst].set(
                 self.v_pools[i][src])
+            if self.quantized:
+                self.k_scales[i] = self.k_scales[i].at[dst].set(
+                    self.k_scales[i][src])
+                self.v_scales[i] = self.v_scales[i].at[dst].set(
+                    self.v_scales[i][src])
 
     def alloc_slot(self, num_tokens):
         """Claim a slot + enough blocks for `num_tokens`; returns slot id
@@ -374,6 +444,54 @@ class PagedKVCache:
             self._deref_block(b)
             _PREFIX_COW.inc()
         return True
+
+    def prepare_append_range(self, slot, new_len):
+        """Speculative-decode form of :meth:`prepare_append`: make EVERY
+        position in ``[seq_len, new_len)`` writable — grow the table to
+        ``ceil(new_len / block_size)`` blocks and copy-on-write every
+        shared block the range touches (a draft row must never land in
+        a block another slot can read). Returns True or a falsy
+        :class:`CapacityError`; on error the slot's fresh growth is
+        rolled back (completed COWs keep — they are content-identical
+        and the plain decode path would COW them anyway)."""
+        have0 = len(self._slot_blocks[slot])
+        r = self.ensure_capacity(slot, new_len)
+        if not r:
+            self.truncate_blocks(slot, have0)
+            return r
+        lo = int(self.seq_lens[slot]) // self.block_size
+        hi = (new_len - 1) // self.block_size
+        for ci in range(lo, hi + 1):
+            b = self._slot_blocks[slot][ci]
+            if self._refcount[b] > 1:
+                nb = self._take_block()
+                if nb is None:
+                    self.truncate_blocks(slot, have0)
+                    return CapacityError(
+                        CapacityError.BLOCKS,
+                        f"pool exhausted copy-on-writing shared block "
+                        f"{b} for speculative range")
+                self._copy_block_rows(b, nb)
+                self._slot_blocks[slot][ci] = nb
+                self.block_tables[slot, ci] = nb
+                self._deref_block(b)
+                _PREFIX_COW.inc()
+        return True
+
+    def truncate_blocks(self, slot, keep):
+        """Roll the slot's table back to its first ``keep`` blocks (the
+        speculative reject path: rejected draft rows' freshly-grown
+        blocks return to the pool — private blocks to the free list,
+        registered ones park reclaimable). Rows already written into
+        KEPT blocks past ``seq_lens`` need no scrub: every reader masks
+        by seq_len and the next append overwrites them."""
+        blocks = self._slot_blocks[slot]
+        if keep >= len(blocks):
+            return
+        for b in reversed(blocks[keep:]):
+            self._deref_block(b)
+        del blocks[keep:]
+        self.block_tables[slot, keep:] = 0
 
     def free_slot(self, slot):
         for b in reversed(self._slot_blocks[slot]):
@@ -522,6 +640,28 @@ def paged_prefill_write(k_pool, v_pool, block_row, k_new, v_new):
     return k_pool.at[blocks].set(kb), v_pool.at[blocks].set(vb)
 
 
+def paged_prefill_write_q(k_pool, v_pool, k_scale, v_scale, block_row,
+                          k_new, v_new):
+    """Quantized :func:`paged_prefill_write`: rows quantize per
+    (position, kv-head) with the absmax formula
+    (``quantization.quantize_rows``) before landing; scales land in
+    the per-block scale arrays. Returns (k_pool, v_pool, k_scale,
+    v_scale)."""
+    from ..quantization import quantize_rows
+    s = k_new.shape[0]
+    bs = k_pool.shape[1]
+    nb = s // bs
+    kq, ks = quantize_rows(k_new)
+    vq, vs = quantize_rows(v_new)
+    kb = kq.reshape(nb, bs, *kq.shape[1:])
+    vb = vq.reshape(nb, bs, *vq.shape[1:])
+    ksb = ks.reshape(nb, bs, -1)
+    vsb = vs.reshape(nb, bs, -1)
+    blocks = block_row[:nb]
+    return (k_pool.at[blocks].set(kb), v_pool.at[blocks].set(vb),
+            k_scale.at[blocks].set(ksb), v_scale.at[blocks].set(vsb))
+
+
 def paged_prefill_write_masked(k_pool, v_pool, block_row, k_new, v_new,
                                start, write_start, total_len):
     """Write a prefill TAIL's KV into the pool: ``k_new``/``v_new``
@@ -546,8 +686,50 @@ def paged_prefill_write_masked(k_pool, v_pool, block_row, k_new, v_new,
     return k_pool, v_pool
 
 
+def paged_prefill_write_masked_q(k_pool, v_pool, k_scale, v_scale,
+                                 block_row, k_new, v_new, start,
+                                 write_start, total_len):
+    """Quantized :func:`paged_prefill_write_masked`: the same validity
+    masking (shared prefix rows and bucket padding go to the null
+    block), rows quantized per (position, kv-head) on the way in.
+    Returns (k_pool, v_pool, k_scale, v_scale)."""
+    from ..quantization import quantize_rows
+    s = k_new.shape[0]
+    bs = k_pool.shape[1]
+    pos = start + jnp.arange(s, dtype=jnp.int32)
+    valid = (pos >= write_start) & (pos < total_len)
+    b_idx = jnp.where(valid, pos // bs, 0)
+    blocks = jnp.where(valid, block_row[b_idx], 0)
+    offs = jnp.where(valid, pos % bs, 0)
+    kq, ks = quantize_rows(k_new)
+    vq, vs = quantize_rows(v_new)
+    k_pool = k_pool.at[blocks, offs].set(
+        jnp.where(valid[:, None, None], kq, k_pool[blocks, offs]))
+    v_pool = v_pool.at[blocks, offs].set(
+        jnp.where(valid[:, None, None], vq, v_pool[blocks, offs]))
+    k_scale = k_scale.at[blocks, offs].set(
+        jnp.where(valid[:, None], ks, k_scale[blocks, offs]))
+    v_scale = v_scale.at[blocks, offs].set(
+        jnp.where(valid[:, None], vs, v_scale[blocks, offs]))
+    return k_pool, v_pool, k_scale, v_scale
+
+
+def _gather_kv(pool, index, scale, dtype):
+    """Pool gather for the dense attention paths: full-precision pools
+    gather as-is; quantized pools (``scale`` not None) dequantize the
+    gathered rows into the compute ``dtype`` — THE dequant point of
+    the int8 KV tier (XLA fuses it into the attention that follows,
+    so no dequantized pool ever materializes in HBM)."""
+    g = pool[index]
+    if scale is None:
+        return g
+    from ..quantization import dequantize_rows
+    return dequantize_rows(g, scale[index], dtype)
+
+
 def paged_prefix_attention_dense(q, k_pool, v_pool, block_row, q_start,
-                                 total_len, scale=None):
+                                 total_len, scale=None, k_scale=None,
+                                 v_scale=None):
     """Chunked-prefill attention for the prefix-cache tail: queries
     [S, Hq, D] sit at absolute positions ``q_start .. q_start+S-1`` and
     attend the slot's whole paged context (cached prefix blocks + the
@@ -560,8 +742,10 @@ def paged_prefix_attention_dense(q, k_pool, v_pool, block_row, q_start,
     g = hq // hk
     s_max = block_row.shape[0] * bs
 
-    k = k_pool[block_row].reshape(s_max, hk, d)
-    v = v_pool[block_row].reshape(s_max, hk, d)
+    k = _gather_kv(k_pool, block_row, k_scale, q.dtype).reshape(
+        s_max, hk, d)
+    v = _gather_kv(v_pool, block_row, v_scale, q.dtype).reshape(
+        s_max, hk, d)
 
     sm_scale = jnp.float32(scale if scale is not None
                            else 1.0 / math.sqrt(d))
@@ -599,8 +783,34 @@ def paged_decode_write(k_pool, v_pool, block_tables, positions, k_new,
     return k_pool, v_pool
 
 
+def paged_decode_write_q(k_pool, v_pool, k_scale, v_scale, block_tables,
+                         positions, k_new, v_new, active):
+    """Quantized :func:`paged_decode_write`: one row per slot, scale
+    per (slot, kv-head), inactive slots to the null block. Returns
+    (k_pool, v_pool, k_scale, v_scale)."""
+    from ..quantization import quantize_rows
+    bs = k_pool.shape[1]
+    b_idx = positions // bs
+    offs = positions % bs
+    rows = jnp.arange(block_tables.shape[0], dtype=jnp.int32)
+    blocks = jnp.where(active, block_tables[rows, b_idx], 0)
+    offs = jnp.where(active, offs, 0)
+    kq, ks = quantize_rows(k_new)
+    vq, vs = quantize_rows(v_new)
+    k_pool = k_pool.at[blocks, offs].set(
+        jnp.where(active[:, None, None], kq, k_pool[blocks, offs]))
+    v_pool = v_pool.at[blocks, offs].set(
+        jnp.where(active[:, None, None], vq, v_pool[blocks, offs]))
+    k_scale = k_scale.at[blocks, offs].set(
+        jnp.where(active[:, None], ks, k_scale[blocks, offs]))
+    v_scale = v_scale.at[blocks, offs].set(
+        jnp.where(active[:, None], vs, v_scale[blocks, offs]))
+    return k_pool, v_pool, k_scale, v_scale
+
+
 def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens,
-                           scale=None, use_kernel=None):
+                           scale=None, use_kernel=None, k_scale=None,
+                           v_scale=None):
     """Masked decode attention over the paged cache.
 
     q [B, Hq, D] (one query token per slot); returns [B, Hq, D].
@@ -611,6 +821,10 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens,
     (tests/kernels/test_paged_attention.py runs the kernel in interpret
     mode one-vs-other).
     """
+    if k_scale is not None:
+        # the Pallas kernel has no dequant fusion yet: quantized pools
+        # route to the dense reference on every backend
+        use_kernel = False
     if use_kernel is None:
         try:
             use_kernel = jax.default_backend() != "cpu"
@@ -622,21 +836,23 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens,
         return paged_decode_attention_kernel(
             q, k_pool, v_pool, block_tables, seq_lens, scale=scale)
     return paged_decode_attention_dense(q, k_pool, v_pool, block_tables,
-                                        seq_lens, scale=scale)
+                                        seq_lens, scale=scale,
+                                        k_scale=k_scale, v_scale=v_scale)
 
 
 def paged_decode_attention_dense(q, k_pool, v_pool, block_tables, seq_lens,
-                                 scale=None):
+                                 scale=None, k_scale=None, v_scale=None):
     """Dense XLA reference for `paged_decode_attention`: gathers each
-    slot's blocks (materializing [B, S_max, Hk, D]), masks positions
-    >= seq_len, GQA group-folded (no KV expansion)."""
+    slot's blocks (materializing [B, S_max, Hk, D]; quantized pools
+    dequantize in the gather), masks positions >= seq_len, GQA
+    group-folded (no KV expansion)."""
     b, hq, d = q.shape
     nb_pool, bs, hk, _ = k_pool.shape
     g = hq // hk
     s_max = block_tables.shape[1] * bs
 
-    k = k_pool[block_tables]  # [B, nb, bs, Hk, D]
-    v = v_pool[block_tables]
+    k = _gather_kv(k_pool, block_tables, k_scale, q.dtype)
+    v = _gather_kv(v_pool, block_tables, v_scale, q.dtype)
     k = k.reshape(b, s_max, hk, d)
     v = v.reshape(b, s_max, hk, d)
 
@@ -654,6 +870,100 @@ def paged_decode_attention_dense(q, k_pool, v_pool, block_tables, seq_lens,
     probs = jnp.where(mask[:, None, None, :], probs, 0.0)
     out = jnp.einsum("bngt,btnd->bngd", probs.astype(v.dtype), v)
     return out.reshape(b, hq, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# speculative multi-position sweep (docs/SERVING.md "Decode speed tiers")
+# ---------------------------------------------------------------------------
+
+def paged_spec_write(k_pool, v_pool, block_tables, start_lens, k_new,
+                     v_new, n_inputs, active, k_scale=None, v_scale=None):
+    """Scatter S candidate tokens' KV per slot for the speculative
+    verify sweep: ``k_new``/``v_new`` [B, S, Hk, D] land at absolute
+    positions ``start_lens[b] + i``. Only the first ``n_inputs[b]``
+    positions of an active slot are real — the rest (draft padding,
+    inactive slots) are masked to the reserved null block 0, the
+    bucketing convention. Quantized pools (scales passed) quantize
+    per row on the way in. Returns the updated pools (+ scales)."""
+    b, s = k_new.shape[:2]
+    bs = k_pool.shape[1]
+    pos = start_lens[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    valid = active[:, None] & \
+        (jnp.arange(s, dtype=jnp.int32)[None, :] < n_inputs[:, None])
+    b_idx = jnp.where(valid, pos // bs, 0)
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    blocks = jnp.where(valid, block_tables[rows, b_idx], 0)
+    offs = jnp.where(valid, pos % bs, 0)
+    blocks_f = blocks.reshape(-1)
+    offs_f = offs.reshape(-1)
+    valid_f = valid.reshape(-1)
+    if k_scale is not None:
+        from ..quantization import quantize_rows
+        kq, ks = quantize_rows(k_new)
+        vq, vs = quantize_rows(v_new)
+        kf = kq.reshape(b * s, *kq.shape[2:])
+        vf = vq.reshape(b * s, *vq.shape[2:])
+        ksf = ks.reshape(b * s, -1)
+        vsf = vs.reshape(b * s, -1)
+        k_pool = k_pool.at[blocks_f, offs_f].set(
+            jnp.where(valid_f[:, None, None], kf,
+                      k_pool[blocks_f, offs_f]))
+        v_pool = v_pool.at[blocks_f, offs_f].set(
+            jnp.where(valid_f[:, None, None], vf,
+                      v_pool[blocks_f, offs_f]))
+        k_scale = k_scale.at[blocks_f, offs_f].set(
+            jnp.where(valid_f[:, None], ksf,
+                      k_scale[blocks_f, offs_f]))
+        v_scale = v_scale.at[blocks_f, offs_f].set(
+            jnp.where(valid_f[:, None], vsf,
+                      v_scale[blocks_f, offs_f]))
+        return k_pool, v_pool, k_scale, v_scale
+    kf = k_new.reshape(b * s, *k_new.shape[2:]).astype(k_pool.dtype)
+    vf = v_new.reshape(b * s, *v_new.shape[2:]).astype(v_pool.dtype)
+    k_pool = k_pool.at[blocks_f, offs_f].set(
+        jnp.where(valid_f[:, None, None], kf, k_pool[blocks_f, offs_f]))
+    v_pool = v_pool.at[blocks_f, offs_f].set(
+        jnp.where(valid_f[:, None, None], vf, v_pool[blocks_f, offs_f]))
+    return k_pool, v_pool
+
+
+def paged_spec_attention_dense(q, k_pool, v_pool, block_tables,
+                               start_lens, active, scale=None,
+                               k_scale=None, v_scale=None):
+    """Batched multi-position attention for the speculative verify
+    sweep: queries [B, S, Hq, D] sit at absolute positions
+    ``start_lens[b] + i`` and attend each slot's whole paged context
+    causally by absolute position — query i sees exactly the keys a
+    sequential decode step at that position would (pos_k <= pos_q), so
+    greedy acceptance is bit-equivalent to stepping one token at a
+    time. The S=1 case degenerates to `paged_decode_attention_dense`'s
+    formulation. Inactive slots are fully masked (junk-free zeros);
+    padded draft rows produce junk the host never reads."""
+    b, s, hq, d = q.shape
+    _, bs, hk, _ = k_pool.shape
+    g = hq // hk
+    s_max = block_tables.shape[1] * bs
+
+    k = _gather_kv(k_pool, block_tables, k_scale, q.dtype).reshape(
+        b, s_max, hk, d)
+    v = _gather_kv(v_pool, block_tables, v_scale, q.dtype).reshape(
+        b, s_max, hk, d)
+
+    sm_scale = jnp.float32(scale if scale is not None
+                           else 1.0 / math.sqrt(d))
+    qg = q.reshape(b, s, hk, g, d)
+    logits = jnp.einsum("bsngd,btnd->bsngt", qg, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    pos_q = start_lens[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    pos_k = jnp.arange(s_max, dtype=jnp.int32)
+    mask = (pos_k[None, None, :] <= pos_q[:, :, None]) & \
+        active[:, None, None]
+    logits = jnp.where(mask[:, :, None, None, :], logits,
+                       jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    probs = jnp.where(mask[:, :, None, None, :], probs, 0.0)
+    out = jnp.einsum("bsngt,btnd->bsngd", probs.astype(v.dtype), v)
+    return out.reshape(b, s, hq, d).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -692,6 +1002,23 @@ def validate_request(prompt_ids, max_new_tokens, max_seq_len, cache,
             "max_new_tokens")
     return prompt
 
+def sized_num_blocks(num_blocks, max_batch, max_blocks_per_seq, kv_dtype,
+                     head_dim, dtype):
+    """Default pool sizing shared by both engines: the classic
+    ``max_batch * max_blocks_per_seq`` (+1 reserved null) block budget
+    at full precision; int8 storage fits :func:`quant_block_ratio`
+    times as many blocks in the SAME HBM bytes — the capacity
+    multiplier the quantized tier exists for (``occupancy()`` reports
+    it, ``pool_bytes()`` stays ~flat). An explicit ``num_blocks``
+    always wins."""
+    if num_blocks is not None:
+        return num_blocks
+    base = max_batch * max_blocks_per_seq
+    if kv_dtype == "int8":
+        base = int(base * quant_block_ratio(head_dim, dtype))
+    return base + 1
+
+
 @dataclass
 class _Request:
     rid: int
@@ -712,20 +1039,30 @@ class ContinuousBatchingEngine:
 
     def __init__(self, model, *, max_batch=8, block_size=16,
                  max_seq_len=2048, num_blocks=None, temperature=0.0,
-                 eos_token_id=None, dtype=jnp.bfloat16):
+                 eos_token_id=None, dtype=jnp.bfloat16,
+                 kv_cache_dtype=None):
         cfg = model.config
         self.model = model
         self.eos_token_id = eos_token_id
         self.temperature = temperature
         self.max_seq_len = max_seq_len
         mbps = math.ceil(max_seq_len / block_size)
-        if num_blocks is None:
-            num_blocks = max_batch * mbps + 1  # +1: reserved null block
+        # int8 KV storage (read ONCE at construction, like the serving
+        # scheduler's flag-resolved kwargs): default pool sizing grows
+        # by the honest byte ratio, so the same HBM budget serves ~2x
+        # the sequences
+        if kv_cache_dtype is None:
+            from ..core import flags as _flags
+            kv_cache_dtype = _flags.flag("FLAGS_kv_cache_dtype")
+        kv_dtype = resolve_kv_dtype(kv_cache_dtype)
+        hd = cfg.hidden_size // cfg.num_heads
+        num_blocks = sized_num_blocks(
+            num_blocks, max_batch, mbps, kv_dtype, hd, dtype)
         self.cache = PagedKVCache(
-            cfg.num_layers, cfg.num_kv_heads,
-            cfg.hidden_size // cfg.num_heads, num_blocks=num_blocks,
+            cfg.num_layers, cfg.num_kv_heads, hd,
+            num_blocks=num_blocks,
             block_size=block_size, max_blocks_per_seq=mbps,
-            max_batch=max_batch, dtype=dtype)
+            max_batch=max_batch, dtype=dtype, kv_dtype=kv_dtype)
         self.waiting: list[_Request] = []
         self.running: dict[int, _Request] = {}  # slot -> request
         self.finished: dict[int, _Request] = {}

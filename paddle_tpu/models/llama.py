@@ -396,8 +396,18 @@ class Llama(nn.Layer):
             self._param_rebind()(arrs)
         row = cache.block_tables[slot]
         for i in range(cache.num_layers):
-            cache.k_pools[i], cache.v_pools[i] = paged_prefill_write(
-                cache.k_pools[i], cache.v_pools[i], row, ks[i], vs[i])
+            if cache.quantized:
+                from ..inference.paged import paged_prefill_write_q
+                (cache.k_pools[i], cache.v_pools[i],
+                 cache.k_scales[i], cache.v_scales[i]) = \
+                    paged_prefill_write_q(
+                        cache.k_pools[i], cache.v_pools[i],
+                        cache.k_scales[i], cache.v_scales[i],
+                        row, ks[i], vs[i])
+            else:
+                cache.k_pools[i], cache.v_pools[i] = paged_prefill_write(
+                    cache.k_pools[i], cache.v_pools[i], row, ks[i],
+                    vs[i])
         cache.seq_lens[slot] = s
         return int(tok)
 
@@ -432,6 +442,30 @@ class Llama(nn.Layer):
             spad = -(-want // bs) * bs
         tail = np.zeros((1, spad), np.int64)
         tail[0, :s_tail] = ids[tail_start:]
+
+        if cache.quantized:
+            # int8 pools thread their scale arrays through the program
+            # and dequantize at the gathers; its own jit + AOT tag so a
+            # model can serve quantized and full-precision caches
+            # side by side
+            if getattr(self, "_paged_extend_q8_jit", None) is None:
+                self._paged_extend_q8_jit = self._build_extend_q8()
+            with self._paged_lock():
+                arrs = self._param_arrays()
+                tok, ks, vs, kss, vss = self._paged_extend_q8_jit(
+                    arrs, jnp.asarray(tail), jnp.int32(tail_start),
+                    jnp.int32(write_start), jnp.int32(total),
+                    jnp.asarray(cache.block_tables[slot]),
+                    cache.k_pools, cache.v_pools,
+                    cache.k_scales, cache.v_scales, next_key(),
+                    jnp.float32(temperature))
+                self._param_rebind()(arrs)
+            cache.k_pools = list(ks)
+            cache.v_pools = list(vs)
+            cache.k_scales = list(kss)
+            cache.v_scales = list(vss)
+            cache.seq_lens[slot] = total
+            return int(tok)
 
         if not hasattr(self, "_paged_extend_jit"):
             rebind = self._param_rebind()
@@ -504,6 +538,69 @@ class Llama(nn.Layer):
         cache.seq_lens[slot] = total
         return int(tok)
 
+    def _build_extend_q8(self):
+        """Quantized twin of the `_paged_extend_jit` program
+        (FLAGS_kv_cache_dtype=int8): identical structure, but tail KV
+        quantizes per (position, kv-head) on write
+        (`paged_prefill_write_masked_q`) and the prefix attention
+        dequantizes in its gather."""
+        rebind = self._param_rebind()
+        cfg = self.config
+        hq = cfg.num_heads
+        hk = cfg.num_kv_heads
+        hd = cfg.hidden_size // hq
+
+        def fn(param_arrays, tail_ids, t_start, w_start, t_total, row,
+               k_pools, v_pools, k_scales, v_scales, key, temp):
+            from ..core.autograd import no_grad
+            from ..inference.paged import (paged_prefill_write_masked_q,
+                                           paged_prefix_attention_dense)
+            from .generation import sample_token
+            rebind(param_arrays)
+            s = tail_ids.shape[1]
+            with no_grad():
+                x = self.embed_tokens(Tensor(tail_ids))
+                new_k, new_v, new_ks, new_vs = [], [], [], []
+                for i, blk in enumerate(self.layers):
+                    attn = blk.self_attn
+                    h = blk.input_layernorm(x)
+                    q = attn.q_proj(h).reshape([1, s, hq, hd])
+                    k = attn.k_proj(h).reshape([1, s, hk, hd])
+                    v = attn.v_proj(h).reshape([1, s, hk, hd])
+                    q, k = apply_rope(q, k, theta=attn.rope_theta,
+                                      position_offset=t_start)
+                    kp, vp, ksc, vsc = paged_prefill_write_masked_q(
+                        k_pools[i], v_pools[i], k_scales[i],
+                        v_scales[i], row, k._data[0], v._data[0],
+                        t_start, w_start, t_total)
+                    out = paged_prefix_attention_dense(
+                        q._data[0], kp, vp, row, t_start, t_total,
+                        k_scale=ksc, v_scale=vsc)
+                    x = x + attn.o_proj(
+                        Tensor(out.reshape(1, s, hq * hd)))
+                    x = x + blk.mlp(blk.post_attention_layernorm(x))
+                    new_k.append(kp)
+                    new_v.append(vp)
+                    new_ks.append(ksc)
+                    new_vs.append(vsc)
+                x = self.norm(x)
+                if self.lm_head is not None:
+                    logits = self.lm_head(x)
+                else:
+                    from .. import ops
+                    logits = ops.matmul(x, self.embed_tokens.weight,
+                                        transpose_y=True)
+            last = jnp.take_along_axis(
+                logits._data, (t_total - 1 - t_start)[None, None, None],
+                axis=1)[:, 0]
+            tok = jax.lax.cond(
+                temp > 0,
+                lambda: sample_token(last / jnp.maximum(temp, 1e-6),
+                                     temperature=1.0, key=key),
+                lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
+            return tok[0], new_k, new_v, new_ks, new_vs
+        return _aot_wrap(jax.jit(fn), "llama.paged_extend.q8")
+
     def paged_decode_step(self, cache, last_tokens, active,
                           temperature=0.0):
         """One decode step for every live slot: write the incoming token's
@@ -511,6 +608,27 @@ class Llama(nn.Layer):
         seq_len+1), sample the next token. Single static-shape jitted
         program; updates `cache` pools/lens in place."""
         from ..core.random import next_key
+
+        if cache.quantized:
+            if getattr(self, "_paged_decode_q8_jit", None) is None:
+                self._paged_decode_q8_jit = self._build_decode_q8()
+            with self._paged_lock():
+                arrs = self._param_arrays()
+                toks, nk, nv, nks, nvs = self._paged_decode_q8_jit(
+                    arrs, jnp.asarray(last_tokens, jnp.int32),
+                    cache.k_pools, cache.v_pools, cache.k_scales,
+                    cache.v_scales, cache.block_tables,
+                    jnp.asarray(cache.seq_lens), jnp.asarray(active),
+                    next_key(), jnp.float32(temperature))
+                self._param_rebind()(arrs)
+            cache.k_pools = list(nk)
+            cache.v_pools = list(nv)
+            cache.k_scales = list(nks)
+            cache.v_scales = list(nvs)
+            act = np.asarray(active)
+            cache.seq_lens = np.where(act, cache.seq_lens + 1,
+                                      cache.seq_lens).astype(np.int32)
+            return toks
 
         if not hasattr(self, "_paged_decode_jit"):
             rebind = self._param_rebind()
@@ -581,6 +699,173 @@ class Llama(nn.Layer):
         cache.seq_lens = np.where(act, cache.seq_lens + 1,
                                   cache.seq_lens).astype(np.int32)
         return toks
+
+    def _build_decode_q8(self):
+        """Quantized twin of the `_paged_decode_jit` program: the
+        incoming token's KV quantizes on write (`paged_decode_write_q`)
+        and the attention dequantizes in its gather (dense path — the
+        Pallas kernel has no dequant fusion yet)."""
+        rebind = self._param_rebind()
+        cfg = self.config
+        hq = cfg.num_heads
+        hk = cfg.num_kv_heads
+        hd = cfg.hidden_size // hq
+
+        def fn(param_arrays, toks, k_pools, v_pools, k_scales, v_scales,
+               tables, lens, active, key, temp):
+            from ..core.autograd import no_grad
+            from ..inference.paged import (paged_decode_attention,
+                                           paged_decode_write_q)
+            from .generation import sample_token
+            rebind(param_arrays)
+            b = toks.shape[0]
+            with no_grad():
+                x = self.embed_tokens(Tensor(toks[:, None]))
+                new_k, new_v, new_ks, new_vs = [], [], [], []
+                for i, blk in enumerate(self.layers):
+                    attn = blk.self_attn
+                    h = blk.input_layernorm(x)
+                    q = attn.q_proj(h).reshape([b, 1, hq, hd])
+                    k = attn.k_proj(h).reshape([b, 1, hk, hd])
+                    v = attn.v_proj(h).reshape([b, 1, hk, hd])
+                    q, k = apply_rope(q, k, theta=attn.rope_theta,
+                                      position_offset=lens)
+                    kp, vp, ksc, vsc = paged_decode_write_q(
+                        k_pools[i], v_pools[i], k_scales[i],
+                        v_scales[i], tables, lens, k._data[:, 0],
+                        v._data[:, 0], active)
+                    out = paged_decode_attention(
+                        q._data[:, 0], kp, vp, tables,
+                        jnp.where(active, lens + 1, lens),
+                        k_scale=ksc, v_scale=vsc)
+                    x = x + attn.o_proj(
+                        Tensor(out.reshape(b, 1, hq * hd)))
+                    x = x + blk.mlp(blk.post_attention_layernorm(x))
+                    new_k.append(kp)
+                    new_v.append(vp)
+                    new_ks.append(ksc)
+                    new_vs.append(vsc)
+                x = self.norm(x)
+                if self.lm_head is not None:
+                    logits = self.lm_head(x)
+                else:
+                    from .. import ops
+                    logits = ops.matmul(x, self.embed_tokens.weight,
+                                        transpose_y=True)
+            last = logits._data[:, 0]
+            nxt = jax.lax.cond(
+                temp > 0,
+                lambda: sample_token(last / jnp.maximum(temp, 1e-6),
+                                     temperature=1.0, key=key),
+                lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
+            return nxt, new_k, new_v, new_ks, new_vs
+        return _aot_wrap(jax.jit(fn), "llama.paged_decode.q8")
+
+    # -- self-speculative decode (docs/SERVING.md "Decode speed tiers") --
+
+    def _build_spec_jit(self, quantized):
+        """The speculative VERIFY program: one batched multi-position
+        sweep over every live slot. For slot ``b``, input positions
+        ``seq_lens[b] + i`` carry ``toks[b, i]`` (the last emitted
+        token, then the proposed drafts); each position's KV is written
+        (rows past ``n_inputs[b]`` masked to the null block) and its
+        query attends the whole paged context causally by absolute
+        position — so ``out[b, i]`` is exactly the greedy token a
+        sequential decode would emit after consuming input ``i``.
+        Greedy only (the scheduler gates speculation on temperature 0);
+        host-side acceptance decides how many rows survive."""
+        rebind = self._param_rebind()
+        cfg = self.config
+        hq = cfg.num_heads
+        hk = cfg.num_kv_heads
+        hd = cfg.hidden_size // hq
+
+        def fn(param_arrays, toks, lens, n_inputs, active, tables,
+               k_pools, v_pools, k_scales, v_scales):
+            from ..core.autograd import no_grad
+            from ..inference.paged import (paged_spec_attention_dense,
+                                           paged_spec_write)
+            rebind(param_arrays)
+            b, s = toks.shape
+            with no_grad():
+                x = self.embed_tokens(Tensor(toks))
+                new_k, new_v, new_ks, new_vs = [], [], [], []
+                for i, blk in enumerate(self.layers):
+                    attn = blk.self_attn
+                    h = blk.input_layernorm(x)
+                    q = attn.q_proj(h).reshape([b, s, hq, hd])
+                    k = attn.k_proj(h).reshape([b, s, hk, hd])
+                    v = attn.v_proj(h).reshape([b, s, hk, hd])
+                    q, k = apply_rope(q, k, theta=attn.rope_theta,
+                                      position_offset=lens)
+                    if quantized:
+                        kp, vp, ksc, vsc = paged_spec_write(
+                            k_pools[i], v_pools[i], tables, lens,
+                            k._data, v._data, n_inputs, active,
+                            k_scale=k_scales[i], v_scale=v_scales[i])
+                        out = paged_spec_attention_dense(
+                            q._data, kp, vp, tables, lens, active,
+                            k_scale=ksc, v_scale=vsc)
+                        new_ks.append(ksc)
+                        new_vs.append(vsc)
+                    else:
+                        kp, vp = paged_spec_write(
+                            k_pools[i], v_pools[i], tables, lens,
+                            k._data, v._data, n_inputs, active)
+                        out = paged_spec_attention_dense(
+                            q._data, kp, vp, tables, lens, active)
+                    x = x + attn.o_proj(
+                        Tensor(out.reshape(b, s, hq * hd)))
+                    x = x + blk.mlp(blk.post_attention_layernorm(x))
+                    new_k.append(kp)
+                    new_v.append(vp)
+                x = self.norm(x)
+                if self.lm_head is not None:
+                    logits = self.lm_head(x)
+                else:
+                    from .. import ops
+                    logits = ops.matmul(x, self.embed_tokens.weight,
+                                        transpose_y=True)
+            nxt = jnp.argmax(logits._data, axis=-1).astype(jnp.int32)
+            return nxt, new_k, new_v, new_ks, new_vs
+        tag = "llama.paged_spec.q8" if quantized else "llama.paged_spec"
+        return _aot_wrap(jax.jit(fn), tag)
+
+    def paged_spec_step(self, cache, last_tokens, draft_tokens, n_inputs,
+                        active):
+        """Speculative verify sweep: write the KV of ``1 + k``
+        candidate tokens per active slot (``last_tokens[b]`` then
+        ``draft_tokens[b]``) at positions ``seq_lens[b] ..`` and return
+        [B, 1 + k] greedy next tokens — ``out[b, i]`` is the token
+        sequential greedy decode would emit after consuming input
+        ``i``. ``n_inputs[b]`` (= 1 + real drafts) masks padding
+        writes. Pools update in place; ``seq_lens`` do NOT advance —
+        the caller (scheduler ``_decode_spec``) accepts the longest
+        matching prefix and rolls rejected rows back."""
+        attr = "_paged_spec_q8_jit" if cache.quantized \
+            else "_paged_spec_jit"
+        if getattr(self, attr, None) is None:
+            setattr(self, attr, self._build_spec_jit(cache.quantized))
+        toks = np.concatenate(
+            [np.asarray(last_tokens).reshape(-1, 1),
+             np.asarray(draft_tokens)], axis=1)
+        with self._paged_lock():
+            arrs = self._param_arrays()
+            nxt, nk, nv, nks, nvs = getattr(self, attr)(
+                arrs, jnp.asarray(toks, jnp.int32),
+                jnp.asarray(cache.seq_lens),
+                jnp.asarray(n_inputs, jnp.int32),
+                jnp.asarray(active), cache.block_tables,
+                cache.k_pools, cache.v_pools,
+                cache.k_scales if cache.quantized else [],
+                cache.v_scales if cache.quantized else [])
+            self._param_rebind()(arrs)
+        cache.k_pools = list(nk)
+        cache.v_pools = list(nv)
+        if cache.quantized:
+            cache.k_scales = list(nks)
+            cache.v_scales = list(nvs)
+        return np.asarray(nxt)
 
     def forward_hidden(self, input_ids, kv_sink=None):
         """Decoder stack output (post final RMSNorm), before the head."""

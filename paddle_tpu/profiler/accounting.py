@@ -91,7 +91,8 @@ class CostReport:
                  "reprefill_us", "decode_us", "compile_us",
                  "aot_saved_us", "ttft_us",
                  "tokens_prefilled", "tokens_decoded", "tokens_emitted",
-                 "covered_tokens", "preempts", "steps", "deadline_met")
+                 "covered_tokens", "spec_proposed", "spec_accepted",
+                 "preempts", "steps", "deadline_met")
 
     def __init__(self, rid):
         self.rid = rid
@@ -109,6 +110,8 @@ class CostReport:
         self.tokens_decoded = 0     # batched decode steps participated in
         self.tokens_emitted = 0     # tokens streamed (prefill + decode)
         self.covered_tokens = 0     # prefix-cache tokens served for free
+        self.spec_proposed = 0      # draft tokens verified for this request
+        self.spec_accepted = 0      # ...of which greedy decode accepted
         self.preempts = 0
         self.steps = 0              # scheduler steps this request was billed
         self.deadline_met = None    # None: no deadline; else bool
@@ -145,7 +148,9 @@ class CostReport:
                 f"tokens={self.tokens_emitted} "
                 f"prefilled={self.tokens_prefilled} "
                 f"covered={self.covered_tokens} "
-                f"preempts={self.preempts}{dl}")
+                + (f"spec={self.spec_accepted}/{self.spec_proposed} "
+                   if self.spec_proposed else "")
+                + f"preempts={self.preempts}{dl}")
 
     def __repr__(self):
         return f"CostReport({self.summary()})"
@@ -216,15 +221,17 @@ def detect_peak_flops():
 class _Note:
     """One unit of per-step work awaiting apportionment."""
 
-    __slots__ = ("req", "kind", "tokens", "compile_us", "aot_saved_us")
+    __slots__ = ("req", "kind", "tokens", "compile_us", "aot_saved_us",
+                 "emitted")
 
     def __init__(self, req, kind, tokens, compile_us=0.0,
-                 aot_saved_us=0.0):
+                 aot_saved_us=0.0, emitted=1):
         self.req = req
         self.kind = kind          # "prefill" | "reprefill" | "decode"
-        self.tokens = tokens
+        self.tokens = tokens      # apportionment weight (computed positions)
         self.compile_us = compile_us
         self.aot_saved_us = aot_saved_us
+        self.emitted = emitted    # tokens streamed to the caller
 
 
 # how often (seconds) update_capacity re-scans jax.live_arrays() — the
@@ -308,6 +315,24 @@ class Accountant:
             c.tokens_decoded += 1
             c.tokens_emitted += 1
 
+    def note_spec(self, req, emitted, proposed, accepted):
+        """``req`` participated in this step's speculative verify sweep
+        (scheduler ``_decode_spec``): the device computed ``1 +
+        proposed`` positions for it — THE apportionment weight, so
+        wasted (rejected) draft positions bill real device time to the
+        request that speculated them — and ``emitted`` tokens (1 +
+        accepted drafts, eos-truncated) streamed to the caller. A
+        spec step with zero proposals never reaches here (the
+        scheduler falls back to the plain decode note)."""
+        self._notes.append(_Note(req, "decode", 1 + int(proposed),
+                                 emitted=int(emitted)))
+        c = req.cost
+        if c is not None:
+            c.tokens_decoded += int(emitted)
+            c.tokens_emitted += int(emitted)
+            c.spec_proposed += int(proposed)
+            c.spec_accepted += int(accepted)
+
     def note_decode_compile(self, compile_us):
         """XLA compile observed around the batched decode dispatch
         (engine warmup): split across this step's decode participants."""
@@ -382,10 +407,11 @@ class Accountant:
             if n.kind == "reprefill":
                 reprefill += share
         idle = step_us - attributed - direct if not notes else 0.0
-        # every note streams exactly ONE token to its caller; the
-        # token-proportional weights (padded prefill tails) are a
-        # different axis, tracked as "processed"
-        emitted = len(notes)
+        # emitted counts tokens STREAMED to callers (a speculative
+        # decode note streams 1 + accepted per request); the token-
+        # proportional weights (padded prefill tails, computed spec
+        # positions) are a different axis, tracked as "processed"
+        emitted = sum(n.emitted for n in notes)
         with self._lock:
             self.device_us += step_us
             self.attributed_us += attributed
@@ -556,6 +582,9 @@ class _NullAccountant(Accountant):
         pass
 
     def note_decode(self, req):
+        pass
+
+    def note_spec(self, req, emitted, proposed, accepted):
         pass
 
     def note_decode_compile(self, compile_us):

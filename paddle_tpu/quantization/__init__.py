@@ -28,7 +28,47 @@ from ..core.tensor import Tensor
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
            "BaseObserver", "BaseQuanter", "quanter",
            "AbsmaxObserver", "AbsMaxChannelWiseWeightObserver",
-           "PercentileObserver", "quanted_linear"]
+           "PercentileObserver", "quanted_linear",
+           "QMAX_INT8", "absmax_row_scales", "quantize_rows",
+           "dequantize_rows"]
+
+
+# ---------------------------------------------------------------------------
+# int8 row quantization primitives (jit-safe, no module state)
+#
+# The AbsmaxObserver formula (scale = absmax / qmax) vectorized over the
+# last axis: one scale per leading-index "row". This is the math the
+# serving KV-cache tier reuses (inference/paged.py,
+# FLAGS_kv_cache_dtype=int8 — one scale per (token-slot, kv-head) row
+# beside the int8 block pool; docs/PERF.md "Decode speed tiers").
+# ---------------------------------------------------------------------------
+
+QMAX_INT8 = 127.0
+_SCALE_FLOOR = 1e-8  # an all-zero row quantizes (and dequantizes) to 0
+
+
+def absmax_row_scales(x, qmax=QMAX_INT8):
+    """Per-row absmax scales over the LAST axis of ``x`` — shape
+    ``x.shape[:-1]`` float32. Scale floor keeps all-zero rows finite."""
+    a = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    return jnp.maximum(a / qmax, _SCALE_FLOOR)
+
+
+def quantize_rows(x, qmax=QMAX_INT8):
+    """-> (int8 array of ``x.shape``, float32 scales of
+    ``x.shape[:-1]``): symmetric per-row absmax quantization, the
+    round-trip error bounded by ``scale / 2`` per element
+    (tests/framework/test_quantization.py pins the bound)."""
+    s = absmax_row_scales(x, qmax)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
+                 -qmax, qmax).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_rows(q, scales, dtype=jnp.float32):
+    """Inverse of :func:`quantize_rows` (``scales`` broadcast over the
+    last axis); returns ``dtype``."""
+    return (q.astype(jnp.float32) * scales[..., None]).astype(dtype)
 
 
 # ---------------------------------------------------------------------------

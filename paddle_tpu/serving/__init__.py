@@ -31,6 +31,7 @@ contract, and the metric catalog.
 
 from . import aot_cache  # noqa: F401
 from . import overload  # noqa: F401
+from . import spec  # noqa: F401
 from .bucketing import bucket_length, bucket_lengths  # noqa: F401
 from .frontend import (AdmissionRejected, Lifecycle,  # noqa: F401
                        NotReadyError, QueueFullError, RequestHandle,

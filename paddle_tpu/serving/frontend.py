@@ -195,7 +195,8 @@ class ServingEngine:
                  eos_token_id=None, dtype=None,
                  prefill_token_budget=None, max_queue=None,
                  bucket_cap=None, prefix_cache=None, accounting=None,
-                 admission=None, brownout=None,
+                 admission=None, brownout=None, kv_cache_dtype=None,
+                 spec=None, spec_tokens=None,
                  background=True, ready=True):
         self._state = Lifecycle.WARMING
         self._sched = Scheduler(
@@ -205,7 +206,9 @@ class ServingEngine:
             dtype=dtype, prefill_token_budget=prefill_token_budget,
             max_queue=max_queue, bucket_cap=bucket_cap,
             prefix_cache=prefix_cache, accounting=accounting,
-            admission=admission, brownout=brownout)
+            admission=admission, brownout=brownout,
+            kv_cache_dtype=kv_cache_dtype, spec=spec,
+            spec_tokens=spec_tokens)
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._background = background
@@ -390,6 +393,23 @@ class ServingEngine:
                                 temperature=sched.temperature)
                             decoded = True
                             n += 1
+                            if sched.spec:
+                                # the speculative verify sweep is one
+                                # more static program — warm it too so
+                                # the first live spec step never
+                                # compiles (junk writes land past the
+                                # slot or in the null block; the slot
+                                # is freed below)
+                                sk = sched.spec_tokens
+                                sched.model.paged_spec_step(
+                                    cache,
+                                    np.zeros((cache.max_batch,),
+                                             np.int64),
+                                    np.zeros((cache.max_batch, sk),
+                                             np.int64),
+                                    np.full((cache.max_batch,), 1 + sk,
+                                            np.int64), active)
+                                n += 1
                     finally:
                         cache.free_slot(slot)
             _c_warmup_programs.inc(n)

@@ -22,7 +22,12 @@ around every entry point). Each ``step()`` is one scheduling iteration:
    queue front for re-prefill) instead of truncating anyone —
    ``serving.preempt`` counts it, and greedy outputs stay bit-identical
    to an uncontended run because re-prefill replays prompt+generated
-   and the prefill's sampled token is the next new token.
+   and the prefill's sampled token is the next new token. With
+   speculation armed (``FLAGS_serving_spec``, greedy only), the step
+   instead runs ONE batched multi-position verify sweep over
+   prompt-lookup drafts (``_decode_spec``; docs/SERVING.md "Decode
+   speed tiers") — several tokens per request per step, still
+   bit-identical, rejected rows rolled back.
 
 Every request terminates in exactly one of ``DONE`` / ``CANCELLED`` /
 ``TIMEOUT`` / ``SHED`` (or ``ERROR`` if the engine itself died). SLO
@@ -58,12 +63,14 @@ import numpy as np
 from ..core import flags as flags_mod
 from ..core import resilience
 from ..inference.paged import (CapacityError, PagedKVCache,
-                               validate_request)
+                               quant_block_ratio, resolve_kv_dtype,
+                               sized_num_blocks, validate_request)
 from ..profiler import accounting as _accounting
 from ..profiler import alerts as _alerts
 from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
 from . import overload as _overload
+from . import spec as _spec
 from .bucketing import bucket_length
 from .overload import AdmissionRejected
 
@@ -181,6 +188,21 @@ _g_util = _metrics.gauge("serving.kv.utilization")
 _m_prefix_computed = _metrics.counter("serving.prefix.computed_tokens")
 _g_shared = _metrics.gauge("serving.kv.shared_blocks")
 _g_cached = _metrics.gauge("serving.kv.cached_blocks")
+# decode speed tiers (docs/SERVING.md "Decode speed tiers"): draft
+# tokens proposed/accepted/rejected by the speculative verify sweep,
+# its per-step acceptance rate, and the quantized-pool facts (bits +
+# honest effective-capacity multiplier). All silent when both flags
+# are off — tools/spec_gate.py pins the silence.
+_m_spec_proposed = _metrics.counter("serving.spec.proposed")
+_m_spec_accepted = _metrics.counter("serving.spec.accepted")
+_m_spec_rejected = _metrics.counter("serving.spec.rejected")
+_m_spec_steps = _metrics.counter("serving.spec.steps")
+_h_spec_accept = _metrics.histogram(
+    "serving.spec.accept_rate",
+    bounds=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+_g_kv_quant_bits = _metrics.gauge("serving.kv.quant.bits")
+_g_kv_quant_mult = _metrics.gauge(
+    "serving.kv.quant.capacity_multiplier")
 # per-THREAD cumulative backend-compile seconds (profiler.metrics'
 # jax.monitoring listener): deltas around a prefill/decode dispatch
 # attribute compile cost to the request that triggered it — a
@@ -210,7 +232,8 @@ class Scheduler:
                  eos_token_id=None, dtype=None,
                  prefill_token_budget=None, max_queue=None,
                  bucket_cap=None, prefix_cache=None, accounting=None,
-                 admission=None, brownout=None):
+                 admission=None, brownout=None, kv_cache_dtype=None,
+                 spec=None, spec_tokens=None):
         import jax.numpy as jnp
 
         cfg = model.config
@@ -219,14 +242,38 @@ class Scheduler:
         self.eos_token_id = eos_token_id
         self.max_seq_len = max_seq_len
         mbps = math.ceil(max_seq_len / block_size)
-        if num_blocks is None:
-            num_blocks = max_batch * mbps + 1  # +1: reserved null block
+        # int8 KV block storage (FLAGS_kv_cache_dtype, read ONCE at
+        # construction like prefix_cache): default pool sizing grows by
+        # the honest byte ratio — the same HBM budget holds ~2x the
+        # blocks, compounding the prefix cache's capacity multiplier
+        kv_dtype = resolve_kv_dtype(
+            flags_mod.flag("FLAGS_kv_cache_dtype")
+            if kv_cache_dtype is None else kv_cache_dtype)
+        hd = cfg.hidden_size // cfg.num_heads
+        compute_dt = dtype if dtype is not None else jnp.bfloat16
+        num_blocks = sized_num_blocks(
+            num_blocks, max_batch, mbps, kv_dtype, hd, compute_dt)
         self.cache = PagedKVCache(
-            cfg.num_layers, cfg.num_kv_heads,
-            cfg.hidden_size // cfg.num_heads, num_blocks=num_blocks,
+            cfg.num_layers, cfg.num_kv_heads, hd,
+            num_blocks=num_blocks,
             block_size=block_size, max_blocks_per_seq=mbps,
-            max_batch=max_batch,
-            dtype=dtype if dtype is not None else jnp.bfloat16)
+            max_batch=max_batch, dtype=compute_dt, kv_dtype=kv_dtype)
+        if self.cache.quantized:
+            _g_kv_quant_bits.set(8)
+            _g_kv_quant_mult.set(round(
+                quant_block_ratio(hd, compute_dt), 4))
+        # self-speculative decoding (FLAGS_serving_spec, read ONCE at
+        # construction): greedy-only — sampled decode has no cheap
+        # accept rule that keeps outputs distribution-exact, so any
+        # temperature > 0 disables the tier (documented flag matrix)
+        armed_spec = (bool(flags_mod.flag("FLAGS_serving_spec"))
+                      if spec is None else bool(spec))
+        self.spec_tokens = max(int(
+            flags_mod.flag("FLAGS_serving_spec_tokens")
+            if spec_tokens is None else spec_tokens), 1)
+        self.spec_ngram = max(
+            int(flags_mod.flag("FLAGS_serving_spec_ngram")), 1)
+        self.spec = armed_spec and temperature == 0.0
         self.prefill_token_budget = (
             flags_mod.flag("FLAGS_serving_prefill_budget")
             if prefill_token_budget is None else int(prefill_token_budget))
@@ -545,9 +592,33 @@ class Scheduler:
                 return s
         return cands[0]
 
+    def _timed_decode_dispatch(self, dispatch):
+        """Run one batched decode program under the shared
+        instrumentation contract — compile + AOT-saved deltas billed
+        through the accountant, pure device time fed to overload
+        control — so the plain and speculative paths can never drift
+        apart in what they report. Returns (program output, wall us)."""
+        comp0 = _compile_s()
+        saved0 = _saved_s()
+        t_dec = time.perf_counter_ns()
+        out = dispatch()
+        dec_us = (time.perf_counter_ns() - t_dec) / 1000.0
+        dec_comp_us = (_compile_s() - comp0) * 1e6
+        self.accounting.note_decode_compile(dec_comp_us)
+        self.accounting.note_decode_aot_saved((_saved_s() - saved0) * 1e6)
+        self.overload.observe_decode(max(dec_us - dec_comp_us, 0.0))
+        return out, dec_us
+
     def _decode(self):
         if not self.running:
             return []
+        if self.spec:
+            out = self._decode_spec()
+            if out is not None:
+                return out
+            # nothing proposed (or speculative capacity unavailable):
+            # this step runs the plain single-token path below —
+            # bit-equivalent, just not multiplied
         # make each slot's next position writable: grow tables (cold
         # cached prefixes are LRU-evicted before anything else —
         # eviction always runs before preemption), copy-on-write shared
@@ -591,17 +662,11 @@ class Scheduler:
         active = np.zeros((self.cache.max_batch,), bool)
         for slot in self.running:
             active[slot] = True
-        comp0 = _compile_s()  # decode compiles split across the batch
-        saved0 = _saved_s()
-        t_dec = time.perf_counter_ns()
-        toks = np.asarray(self.model.paged_decode_step(
-            self.cache, np.asarray(self._last_tok), active,
-            temperature=self.temperature))
-        dec_us = (time.perf_counter_ns() - t_dec) / 1000.0
-        dec_comp_us = (_compile_s() - comp0) * 1e6
-        self.accounting.note_decode_compile(dec_comp_us)
-        self.accounting.note_decode_aot_saved((_saved_s() - saved0) * 1e6)
-        self.overload.observe_decode(max(dec_us - dec_comp_us, 0.0))
+        # decode compiles split across the batch
+        toks, dec_us = self._timed_decode_dispatch(
+            lambda: np.asarray(self.model.paged_decode_step(
+                self.cache, np.asarray(self._last_tok), active,
+                temperature=self.temperature)))
         out = []
         for slot, req in list(self.running.items()):
             t = int(toks[slot])
@@ -616,6 +681,119 @@ class Scheduler:
             self._emit(req, t)
             out.append((req.rid, t))
             self._maybe_finish(slot)
+        _m_decoded.inc(len(out))
+        return out
+
+    def _decode_spec(self):
+        """One speculative decode iteration (docs/SERVING.md "Decode
+        speed tiers"): propose up to ``spec_tokens`` draft tokens per
+        running request from its OWN context (prompt-lookup n-grams,
+        serving/spec.py), verify all of them in ONE batched
+        multi-position paged sweep (``Llama.paged_spec_step``), accept
+        the longest greedy-matching prefix per request, and roll
+        rejected rows' blocks back. Greedy outputs are bit-identical
+        to plain decode because every emitted token IS the sweep's own
+        argmax — drafts only decide how many of those argmaxes one
+        step may keep.
+
+        Returns the (rid, token) list, or None to fall back to the
+        plain path for this step: nothing proposed anywhere, or the
+        pool cannot hold the speculative rows right now (the plain
+        path then evicts/preempts its way forward; speculation simply
+        re-engages when space returns — preemption and prefix hits
+        compose, test-pinned)."""
+        k = self.spec_tokens
+        bs = self.cache.block_size
+        drafts = {}
+        any_proposed = False
+        for slot, req in self.running.items():
+            cap = min(k, int(self._remaining[slot]) - 1)
+            d = _spec.propose_draft(self._prefill_ids(req), cap,
+                                    self.spec_ngram) \
+                if cap > 0 else np.empty((0,), np.int64)
+            drafts[slot] = d
+            any_proposed = any_proposed or d.size > 0
+        if not any_proposed:
+            return None
+        # capacity: every slot needs positions [len, len + 1 + drafts)
+        # writable (growth + COW of every touched shared block). Track
+        # pre-grow block counts so a mid-loop failure rolls EVERY slot
+        # back — the plain path must start from an untouched table.
+        grown = []
+        failed = None
+        for slot in list(self.running):
+            old = len(self.cache._slot_blocks[slot])
+            need = int(self.cache.seq_lens[slot]) + 1 + \
+                int(drafts[slot].size)
+            r = self.cache.prepare_append_range(slot, need)
+            if not r:
+                failed = r
+                break
+            grown.append((slot, old))
+        if failed is not None:
+            for slot, old in grown:
+                self.cache.truncate_blocks(slot, old)
+            return None
+        draft_mat = np.zeros((self.cache.max_batch, k), np.int64)
+        n_inputs = np.zeros((self.cache.max_batch,), np.int64)
+        active = np.zeros((self.cache.max_batch,), bool)
+        for slot, d in drafts.items():
+            active[slot] = True
+            n_inputs[slot] = 1 + d.size
+            draft_mat[slot, :d.size] = d
+        outs, dec_us = self._timed_decode_dispatch(
+            lambda: np.asarray(self.model.paged_spec_step(
+                self.cache, np.asarray(self._last_tok), draft_mat,
+                n_inputs, active)))
+        out = []
+        for slot, req in list(self.running.items()):
+            g = outs[slot]
+            proposed = int(drafts[slot].size)
+            # accept while each draft equals the model's own previous
+            # argmax — then the emitted run is g[0..m], exactly what m+1
+            # sequential steps would have produced
+            m = 0
+            while m < proposed and int(draft_mat[slot, m]) == int(g[m]):
+                m += 1
+            emitted = [int(g[i]) for i in range(m + 1)]
+            if self.eos_token_id is not None:
+                for j, t in enumerate(emitted):
+                    if t == self.eos_token_id:
+                        # sequential decode stops here: later accepted
+                        # rows must not survive
+                        emitted = emitted[:j + 1]
+                        m = j
+                        break
+            # inputs consumed = len(emitted) (last_tok + m drafts):
+            # their KV rows are exactly the ones sequential decode
+            # would have written; roll the rest back
+            new_seq = int(self.cache.seq_lens[slot]) + len(emitted)
+            self.cache.seq_lens[slot] = new_seq
+            self.cache.truncate_blocks(
+                slot, max(math.ceil(new_seq / bs), 1))
+            self._last_tok[slot] = emitted[-1]
+            self._remaining[slot] -= len(emitted)
+            _m_spec_proposed.inc(proposed)
+            _m_spec_accepted.inc(m)
+            _m_spec_rejected.inc(proposed - m)
+            if proposed:
+                with _tracing.attach(req.span):  # exemplar -> trace_id
+                    _h_spec_accept.observe(m / proposed)
+            _tracing.record_span("serving.decode_step", req.span,
+                                 dec_us, token=len(req.generated),
+                                 batch=len(self.running),
+                                 spec_proposed=proposed,
+                                 spec_accepted=m)
+            if proposed:
+                self.accounting.note_spec(req, emitted=len(emitted),
+                                          proposed=proposed, accepted=m)
+            else:
+                self.accounting.note_decode(req)
+            for t in emitted:
+                self._emit(req, t)
+                out.append((req.rid, t))
+            self._maybe_finish(slot)
+        _m_spec_steps.inc()
         _m_decoded.inc(len(out))
         return out
 

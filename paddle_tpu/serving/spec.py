@@ -1,0 +1,78 @@
+"""Self-speculative draft proposal: prompt-lookup (n-gram) decoding.
+
+The cheapest useful draft model is the request's own context: natural
+and code text repeat themselves (boilerplate, quoted spans, loops), and
+greedy LLM continuations degenerate into repetition outright — so "find
+the most recent earlier occurrence of the trailing n-gram and propose
+what followed it" predicts the model's own next tokens far more often
+than chance, for zero extra parameters and zero device work. This is
+the prompt-lookup / n-gram speculation family (PLD, vLLM's
+`speculative_model="[ngram]"`), chosen here over a learned draft model
+so the tier-1 CPU path can run it and no second set of weights needs
+loading, sharding, or versioning.
+
+The scheduler (``Scheduler._decode_spec``) calls :func:`propose_draft`
+per running request, verifies all drafts in ONE batched multi-position
+paged sweep (``Llama.paged_spec_step``), accepts the longest
+greedy-matching prefix, and rolls rejected rows back — greedy outputs
+stay bit-identical to non-speculative decode because every accepted
+token IS the model's own argmax (``tools/spec_gate.py`` pins it).
+Proposal cost is pure host-side numpy on a context that is at most
+``max_seq_len`` long.
+
+Flags: ``FLAGS_serving_spec`` (master, default off),
+``FLAGS_serving_spec_tokens`` (k), ``FLAGS_serving_spec_ngram``
+(longest match tried). See docs/SERVING.md "Decode speed tiers".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["propose_draft", "REPETITIVE_CORPUS", "repetitive_prompts"]
+
+# The high-acceptance evaluation corpus shared by tools/spec_gate.py,
+# bench.py's decode_tiers rung, and examples/serve_llm.py --spec:
+# (seed, size) pairs whose greedy continuation (for the seed-0 tiny
+# model) is self-repetitive, so prompt-lookup drafts keep matching.
+# Found empirically; deterministic (greedy decode is a pure function
+# of weights + prompt). Retune HERE if the tiny model or its seed
+# changes — the consumers all import it, so the gate floor, the
+# decode_tiers ledger rung, and the demo stay comparable.
+REPETITIVE_CORPUS = ((9, 9), (12, 9), (12, 12), (14, 6))
+
+
+def repetitive_prompts():
+    """Materialise :data:`REPETITIVE_CORPUS` as int prompt arrays."""
+    return [np.random.default_rng(seed).integers(3, 250, size=size)
+            for seed, size in REPETITIVE_CORPUS]
+
+
+def propose_draft(context, max_tokens, ngram_max=3):
+    """Propose up to ``max_tokens`` draft tokens continuing ``context``
+    (1-D int array: prompt + everything generated so far).
+
+    Tries the trailing ``n``-gram for ``n = ngram_max .. 1``: the MOST
+    RECENT prior occurrence wins (recency tracks the current phrase
+    better than frequency), and the tokens that followed it become the
+    draft. Returns an int64 array, possibly empty (no repetition to
+    exploit — the scheduler then falls back to plain decode for slots
+    with nothing to verify). Pure and deterministic."""
+    ids = np.ascontiguousarray(np.asarray(context).reshape(-1),
+                               dtype=np.int64)
+    n = int(ids.size)
+    if n < 2 or max_tokens <= 0:
+        return np.empty((0,), np.int64)
+    for g in range(min(int(ngram_max), n - 1), 0, -1):
+        tail = ids[n - g:]
+        windows = np.lib.stride_tricks.sliding_window_view(ids, g)
+        matches = np.flatnonzero((windows == tail).all(axis=1))
+        # the last window IS the tail; only strictly-prior occurrences
+        # have a continuation to steal
+        matches = matches[matches < n - g]
+        if matches.size:
+            j = int(matches[-1])
+            cont = ids[j + g:j + g + int(max_tokens)]
+            if cont.size:
+                return cont.copy()
+    return np.empty((0,), np.int64)

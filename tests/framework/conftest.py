@@ -1,0 +1,39 @@
+"""Shared serving-test scaffolding for the decode speed tiers.
+
+tools/spec_gate.py, tests/framework/test_spec_decode.py, and
+tests/framework/test_quantization.py all pin their floors against the
+SAME engine configuration — one tiny float32 Llama served with
+max_batch=4, block_size=8, max_seq_len=64, bucket_cap=32, greedy. The
+two test files take it from here so a config tweak cannot silently
+make them measure different engines (the gate, a standalone tool,
+keeps its own copy of the same literals and its docstring pins them
+to this file).
+"""
+
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture(scope="session")
+def tiny_llama():
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def tiny_engine(model, **kw):
+    """The pinned serving-test engine (greedy, float32, synchronous)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving import ServingEngine
+
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("bucket_cap", 32)
+    return ServingEngine(model, temperature=0.0, background=False,
+                         dtype=jnp.float32, **kw)

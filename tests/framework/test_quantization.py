@@ -150,6 +150,161 @@ def test_quanter_registry_by_name():
     assert cfg.activation is FakeQuanterWithAbsMaxObserver
 
 
+# ---------------------------------------------------------------------------
+# int8 KV-cache tier (FLAGS_kv_cache_dtype, ISSUE 14): the serving paged
+# pool reuses the absmax observer math above as vectorized row scales —
+# quantization.quantize_rows/dequantize_rows (docs/PERF.md "Decode speed
+# tiers"). These tests pin the round-trip bound, the honest capacity
+# multiplier, prefix sharing/preemption under quantized pools, and the
+# flag-off byte-for-byte revert.
+# ---------------------------------------------------------------------------
+
+def test_kv_quant_roundtrip_error_bound():
+    import jax.numpy as jnp
+
+    from paddle_tpu.quantization import (absmax_row_scales,
+                                         dequantize_rows, quantize_rows)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(6, 16, 2, 32).astype("float32") * 3.0)
+    q, s = quantize_rows(x)
+    assert np.asarray(q).dtype == np.int8
+    assert np.asarray(s).shape == (6, 16, 2)
+    dq = np.asarray(dequantize_rows(q, s))
+    err = np.abs(np.asarray(x) - dq)
+    # symmetric round-to-nearest: per-element error <= scale / 2
+    bound = np.asarray(s)[..., None] * 0.5 + 1e-7
+    assert (err <= bound).all(), err.max()
+    # the scale IS the AbsmaxObserver formula (absmax / qmax)
+    np.testing.assert_allclose(
+        np.asarray(absmax_row_scales(x)),
+        np.maximum(np.abs(np.asarray(x)).max(-1) / 127.0, 1e-8),
+        rtol=1e-6)
+    # all-zero rows survive the scale floor exactly
+    zq, zs = quantize_rows(jnp.zeros((3, 2, 8), jnp.float32))
+    assert (np.asarray(dequantize_rows(zq, zs)) == 0).all()
+
+
+def test_resolve_kv_dtype_and_block_ratio():
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.paged import (quant_block_ratio,
+                                            resolve_kv_dtype)
+    assert resolve_kv_dtype("") is None
+    assert resolve_kv_dtype(None) is None
+    assert resolve_kv_dtype("auto") is None
+    assert resolve_kv_dtype("int8") == "int8"
+    assert resolve_kv_dtype("INT8") == "int8"
+    with pytest.raises(ValueError):
+        resolve_kv_dtype("fp8")
+    # bf16 -> int8+scales at head_dim 64: 128 bytes -> 68 per head-row
+    r = quant_block_ratio(64, jnp.bfloat16)
+    assert abs(r - 128.0 / 68.0) < 1e-9
+    # the multiplier grows toward 2x with head_dim
+    assert quant_block_ratio(128, jnp.bfloat16) > r
+
+
+# tiny_llama fixture + the pinned engine config come from conftest.py
+# (shared with test_spec_decode.py and pinned by tools/spec_gate.py)
+from conftest import tiny_engine  # noqa: E402
+
+
+def _serve(model, prompts, max_new=8, **kw):
+    eng = tiny_engine(model, **kw)
+    hs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    eng.run_until_idle()
+    outs = [h.tokens() for h in hs]
+    eng.close()
+    return outs, eng
+
+
+def test_kv_quant_effective_capacity(tiny_llama):
+    """occupancy() reports the multiplied usable pool at int8 while
+    pool_bytes() stays ~flat — the capacity multiplier is real blocks,
+    not hidden bytes."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving import Scheduler
+    fp = Scheduler(tiny_llama, max_batch=2, block_size=8,
+                   max_seq_len=64, dtype=jnp.float32)
+    q8 = Scheduler(tiny_llama, max_batch=2, block_size=8,
+                   max_seq_len=64, dtype=jnp.float32,
+                   kv_cache_dtype="int8")
+    assert not fp.cache.quantized and q8.cache.quantized
+    assert q8.cache.occupancy()["usable"] >= \
+        1.5 * fp.cache.occupancy()["usable"]
+    # same HBM budget (the int8 pool may be slightly under after the
+    # floor division, never over by more than a block of scales)
+    assert q8.cache.pool_bytes() <= 1.05 * fp.cache.pool_bytes()
+    assert q8.cache.pool_bytes() >= 0.75 * fp.cache.pool_bytes()
+    occ = q8.cache.occupancy()
+    assert occ["active"] + occ["cached_free"] + occ["free"] \
+        == occ["usable"]
+
+
+def test_kv_quant_serving_round_trip_and_gauges(tiny_llama):
+    from paddle_tpu.profiler import metrics
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(3, 250, size=s) for s in (9, 5, 13)]
+    outs, _ = _serve(tiny_llama, prompts, kv_cache_dtype="int8")
+    assert all(len(o) == 8 for o in outs)
+    snap = metrics.snapshot("serving.kv.quant.")
+    assert snap["serving.kv.quant.bits"] == 8
+    assert snap["serving.kv.quant.capacity_multiplier"] > 1.4
+    # deterministic: the same int8 engine config reproduces exactly
+    outs2, _ = _serve(tiny_llama, prompts, kv_cache_dtype="int8")
+    assert outs == outs2
+
+
+def test_kv_quant_flag_off_byte_identical_and_silent(tiny_llama):
+    """kv_cache_dtype='' routes through the pre-PR full-precision code
+    (same pools, same programs) and moves no serving.kv.quant.*
+    gauge."""
+    from paddle_tpu.profiler import metrics
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(3, 250, size=s) for s in (7, 11)]
+    base, _ = _serve(tiny_llama, prompts)          # flag default (off)
+    before = metrics.snapshot("serving.kv.quant.")
+    explicit, eng = _serve(tiny_llama, prompts, kv_cache_dtype="")
+    assert explicit == base
+    assert not eng.cache.quantized and eng.cache.k_scales is None
+    assert metrics.snapshot("serving.kv.quant.") == before
+
+
+def test_kv_quant_prefix_sharing_bit_identical(tiny_llama):
+    """Shared-prefix admissions under int8 pools: COW/refcount logic is
+    dtype-blind, outputs bit-identical to uncontended int8 runs."""
+    from paddle_tpu.profiler import metrics
+    rng = np.random.default_rng(2)
+    system = rng.integers(3, 250, size=24)
+    suffixes = [rng.integers(3, 250, size=4) for _ in range(3)]
+    prompts = [np.concatenate([system, sf]) for sf in suffixes]
+    # uncontended references: one engine per prompt
+    refs = [_serve(tiny_llama, [p], kv_cache_dtype="int8")[0][0]
+            for p in prompts]
+    before = metrics.snapshot("serving.prefix.")
+    shared, _ = _serve(tiny_llama, prompts, kv_cache_dtype="int8")
+    after = metrics.snapshot("serving.prefix.")
+    assert shared == refs
+    assert after["serving.prefix.hit_blocks"] > \
+        before["serving.prefix.hit_blocks"]
+
+
+def test_kv_quant_preemption_bit_identical(tiny_llama):
+    """Pool exhaustion under int8: preempt + re-prefill reproduces the
+    uncontended outputs exactly (the PR 5 pin, quantized)."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(3, 250, size=s) for s in (9, 8)]
+    refs = [_serve(tiny_llama, [p], max_new=10,
+                   kv_cache_dtype="int8")[0][0] for p in prompts]
+    from paddle_tpu.profiler import metrics
+    p0 = metrics.snapshot()["serving.preempt"]
+    # 5 usable blocks: two growing requests cannot both fit
+    tight, _ = _serve(tiny_llama, prompts, max_new=10,
+                      kv_cache_dtype="int8", max_batch=2, num_blocks=6)
+    assert tight == refs
+    assert metrics.snapshot()["serving.preempt"] > p0
+
+
 def test_int8_weights_close_to_fp32(fp32_model_and_data):
     """Per-channel dequantized weights reconstruct fp32 within int8 step."""
     model, *_ = fp32_model_and_data

@@ -161,6 +161,28 @@ def test_router_and_aot_surfaces_map_to_their_tests():
     assert "tests/framework/test_router.py" in t
 
 
+def test_spec_and_quant_surfaces_map_to_their_tests():
+    # the decode speed tiers (ISSUE 14): the proposer module and the
+    # scheduler run the spec suite; the paged engine and the
+    # quantization package run both new suites; the gate runs both
+    t = suite_gate.targets_for(["paddle_tpu/serving/spec.py"])
+    assert "tests/framework/test_spec_decode.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/serving/scheduler.py"])
+    assert "tests/framework/test_spec_decode.py" in t
+    assert "tests/framework/test_serving.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/inference/paged.py"])
+    assert "tests/framework/test_spec_decode.py" in t
+    assert "tests/framework/test_quantization.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/quantization/__init__.py"])
+    assert "tests/framework/test_quantization.py" in t
+    assert "tests/framework/test_spec_decode.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/models/llama.py"])
+    assert "tests/framework/test_spec_decode.py" in t
+    t = suite_gate.targets_for(["tools/spec_gate.py"])
+    assert "tests/framework/test_spec_decode.py" in t
+    assert "tests/framework/test_quantization.py" in t
+
+
 def test_overload_surfaces_map_to_their_tests():
     # the overload control plane (ISSUE 13): the module itself, the
     # scheduler/frontend/router wiring, the CircuitBreaker home, the

@@ -23,8 +23,12 @@ wall time, advisory), ``eager_gap`` (bench.py eager-vs-jit rung),
 process compile seconds, AOT hit counts, traffic-shift/failover
 bits), ``overload_gate`` (tools/overload_gate.py: high-priority
 goodput fraction under ~8x oversubscription, shed/reject counts,
-breaker + flags-off check bits). The ledger itself is schema-free —
-any kind/metrics pair appends.
+breaker + flags-off check bits), ``spec_gate`` (tools/spec_gate.py
+decode speed tiers: speculative tokens/step multiple, draft
+acceptance rate, int8 KV capacity multiplier, equivalence bits),
+``decode_tiers`` (bench.py decode rung: base vs speculative vs
+quantized tokens/s on the serving scheduler). The ledger itself is
+schema-free — any kind/metrics pair appends.
 
 CLI::
 

@@ -72,7 +72,11 @@ def direction_and_tol(name):
         return ("down", HEADLINE_TOL) if "tokens_per_s" in name \
             or "mfu" in name else ("up", HEADLINE_TOL)
     # throughput suffixes FIRST: "tokens_per_s" also ends with "_s"
-    if name.endswith(("_per_s", "_rate", "_mfu")) or name == "mfu":
+    # (_per_step: the speculative decode multiple; _mult: the int8 KV
+    # capacity multiplier — both larger-is-better, kind spec_gate /
+    # decode_tiers)
+    if name.endswith(("_per_s", "_rate", "_mfu",
+                      "_per_step", "_mult")) or name == "mfu":
         return ("down", RATE_TOL)
     if name.endswith(("_us", "_ms", "_s", "_seconds", "_ns")):
         return ("up", TIME_TOL)

@@ -154,9 +154,38 @@ def _prompts(seed, sizes):
     return [rng.integers(0, 255, (s,)).astype("int64") for s in sizes]
 
 
+def _backdate_heartbeat(store, replica_id, age_s):
+    """Rewrite a replica's registry entry with a heartbeat_ts ``age_s``
+    in the past — the deterministic form of "its heartbeat died a
+    while ago". Call only with the replica's beat loop already dead
+    (fault-armed), or the next beat would overwrite the back-dated
+    entry."""
+    import json
+
+    from paddle_tpu.profiler import fleet
+
+    for p in fleet.read_members(store):
+        if str(p.get("replica_id")) == replica_id:
+            p["heartbeat_ts"] = time.time() - age_s
+            store.set(fleet.MEMBER_KEY_FMT.format(p["slot"]),
+                      json.dumps(p))
+            return
+    raise RuntimeError(f"replica {replica_id} not in the registry")
+
+
 def check_traffic_shift(model):
-    """Kill one replica's registry heartbeat; after the freshness
-    window the router must place everything on the healthy one."""
+    """Kill one replica's registry heartbeat; once its freshness is
+    gone the router must place everything on the healthy one.
+
+    The decay is made DETERMINISTIC by advancing the heartbeat clock
+    instead of racing real time: the fault stops future beats, one
+    beat period of settling lets any in-flight beat land, then g2's
+    registry entry is back-dated a full TTL — freshness (and so
+    health) is exactly 0.0. The previous sleep-only version was
+    timing-flaky at the decay margin (CHANGES.md PR 13 "Known"): a
+    killed-but-still-freshish heartbeat could leave g2's decayed
+    score above g1's inflight-damped rank for the later submits of
+    the burst."""
     import paddle_tpu as paddle
     from paddle_tpu.distributed.store import TCPStore
     from paddle_tpu.serving import Router
@@ -179,7 +208,8 @@ def check_traffic_shift(model):
     spread = {h.replica_id for h in before}
     faults.arm("fleet.heartbeat.g2", nth=1, count=10 ** 6)
     try:
-        time.sleep(2.0 * TTL_S / 3.0)
+        time.sleep(TTL_S / 3.0 + 0.2)  # any in-flight beat lands
+        _backdate_heartbeat(store, "g2", TTL_S)
         router.refresh(force=True)
         h2 = router._replicas["g2"].health()
         h1 = router._replicas["g1"].health()
@@ -190,11 +220,11 @@ def check_traffic_shift(model):
     finally:
         faults.disarm("fleet.heartbeat.g2")
     landed = [h.replica_id for h in after]
-    ok = (spread == {"g1", "g2"} and h2 < h1
+    ok = (spread == {"g1", "g2"} and h2 == 0.0 and h2 < h1
           and all(r == "g1" for r in landed)
           and all(h.status == "DONE" for h in before + after))
     print(f"[router-gate] traffic-shift: balanced={sorted(spread)} "
-          f"degraded g2 health {h2:.3f} < g1 {h1:.3f}; "
+          f"degraded g2 health {h2:.3f} (want 0.0) < g1 {h1:.3f}; "
           f"post-degrade placement={landed} (want all g1) "
           f"{'PASS' if ok else 'FAIL'}")
     for eng in (e1, e2):

@@ -1,0 +1,215 @@
+"""Decode-speed-tiers gate (ISSUE 14): speculative decoding + the
+int8-quantized KV pool through four pass/fail checks, in order of
+importance:
+
+  1. greedy-equivalence — spec-on outputs are BIT-IDENTICAL to
+     spec-off on a mixed corpus (random prompts, shared prefixes,
+     several lengths), and the tiers COMPOSE: spec-on over int8 pools
+     equals spec-off over int8 pools;
+  2. speedup — on the repetitive (high-acceptance) corpus the
+     speculative path finishes in at most 1/SPEC_GATE_TPS_FLOOR of
+     the spec-off step count, i.e. decoded-tokens-per-step >=
+     SPEC_GATE_TPS_FLOOR (default 1.5x), with the acceptance counters
+     agreeing (accepted > 0, rejected == proposed - accepted);
+  3. quantized-capacity — FLAGS_kv_cache_dtype=int8 auto-sizing
+     reports >= SPEC_GATE_CAP_FLOOR x the usable blocks of the
+     full-precision pool at ~the same pool_bytes (the multiplier is
+     real blocks, not hidden bytes), and an int8 engine serves a
+     corpus to DONE deterministically;
+  4. disarmed — both flags off is a byte-for-byte revert with
+     serving.spec.* / serving.kv.quant.* counter silence.
+
+Exit 0 on pass, 1 on fail; one line per check. Runs under
+JAX_PLATFORMS=cpu (tier-1, like tests/framework/test_spec_decode.py);
+wired into tools/suite_gate.py beside the serving gates, and appends a
+``spec_gate`` entry (tokens/step, acceptance rate, capacity
+multiplier, check bits) to the continuous-bench ledger
+(tools/bench_ledger.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+TPS_FLOOR = float(os.environ.get("SPEC_GATE_TPS_FLOOR", "1.5"))
+CAP_FLOOR = float(os.environ.get("SPEC_GATE_CAP_FLOOR", "1.5"))
+
+# the high-acceptance corpus (prompts whose greedy continuation is
+# self-repetitive for the seed-0 tiny model) lives beside the proposer
+# as paddle_tpu.serving.spec.REPETITIVE_CORPUS so this gate, bench.py's
+# decode_tiers rung, and examples/serve_llm.py --spec measure the SAME
+# prompts; test_spec_decode.py pins the same family
+
+
+def _model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    # the same pinned config as tests/framework/conftest.py
+    # tiny_engine — keep them in lockstep so the gate floors and the
+    # test pins measure the same engine
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving import ServingEngine
+
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("bucket_cap", 32)
+    return ServingEngine(model, temperature=0.0, background=False,
+                         dtype=jnp.float32, **kw)
+
+
+def _run(model, prompts, max_new=10, **kw):
+    from paddle_tpu.profiler import metrics
+
+    eng = _engine(model, **kw)
+    s0 = metrics.snapshot("serving.")
+    hs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    eng.run_until_idle()
+    s1 = metrics.snapshot("serving.")
+    outs = [h.tokens() for h in hs]
+    eng.close()
+    steps = s1["serving.steps"] - s0["serving.steps"]
+    return outs, steps, s0, s1
+
+
+def _prompts(seed, sizes):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [rng.integers(3, 250, size=s) for s in sizes]
+
+
+def check_equivalence(model):
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    system = rng.integers(3, 250, size=24)
+    mixed = _prompts(0, [9, 5, 14, 7]) + \
+        [np.concatenate([system, rng.integers(3, 250, size=4)])
+         for _ in range(2)]
+    base, _, _, _ = _run(model, mixed)
+    spec, _, _, _ = _run(model, mixed, spec=True)
+    q8, _, _, _ = _run(model, mixed, kv_cache_dtype="int8")
+    q8s, _, _, _ = _run(model, mixed, kv_cache_dtype="int8", spec=True)
+    ok = spec == base and q8s == q8
+    print(f"[spec-gate] greedy-equivalence: spec-on==spec-off="
+          f"{spec == base} over {len(mixed)} prompts; int8 compose="
+          f"{q8s == q8} {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_speedup(model):
+    """Per-request (batch-1) runs so steps map 1:1 to decode sweeps:
+    spec-off emits exactly one decode token per step, so
+    tokens-per-step multiple == step-count ratio."""
+    from paddle_tpu.serving.spec import repetitive_prompts
+
+    prompts = repetitive_prompts()
+    tot_off = tot_on = 0
+    outs_off, outs_on = [], []
+    from paddle_tpu.profiler import metrics
+
+    b = metrics.snapshot("serving.spec.")
+    for p in prompts:
+        o, steps, _, _ = _run(model, [p], max_new=24)
+        outs_off.append(o)
+        tot_off += steps
+    for p in prompts:
+        o, steps, _, _ = _run(model, [p], max_new=24, spec=True)
+        outs_on.append(o)
+        tot_on += steps
+    a = metrics.snapshot("serving.spec.")
+    proposed = a["serving.spec.proposed"] - b["serving.spec.proposed"]
+    accepted = a["serving.spec.accepted"] - b["serving.spec.accepted"]
+    rejected = a["serving.spec.rejected"] - b["serving.spec.rejected"]
+    mult = tot_off / max(tot_on, 1)
+    accept_rate = accepted / max(proposed, 1)
+    ok = (outs_on == outs_off and mult >= TPS_FLOOR and accepted > 0
+          and rejected == proposed - accepted)
+    print(f"[spec-gate] speedup: {tot_off} -> {tot_on} steps on the "
+          f"repetitive corpus = {mult:.2f}x tokens/step (floor "
+          f"{TPS_FLOOR}); drafts accepted {accepted}/{proposed} "
+          f"(rate {accept_rate:.2f}), bit-identical="
+          f"{outs_on == outs_off} {'PASS' if ok else 'FAIL'}")
+    return ok, mult, accept_rate
+
+
+def check_quant_capacity(model):
+    fp = _engine(model, max_batch=2)
+    q8 = _engine(model, max_batch=2, kv_cache_dtype="int8")
+    u_fp = fp.cache.occupancy()["usable"]
+    u_q8 = q8.cache.occupancy()["usable"]
+    bytes_ratio = q8.cache.pool_bytes() / fp.cache.pool_bytes()
+    fp.close()
+    q8.close()
+    prompts = _prompts(5, [9, 6, 12])
+    a, _, _, _ = _run(model, prompts, kv_cache_dtype="int8")
+    b, _, _, _ = _run(model, prompts, kv_cache_dtype="int8")
+    mult = u_q8 / max(u_fp, 1)
+    ok = (mult >= CAP_FLOOR and 0.75 <= bytes_ratio <= 1.05
+          and a == b and all(len(o) == 10 for o in a))
+    print(f"[spec-gate] quantized-capacity: usable {u_fp} -> {u_q8} "
+          f"blocks = {mult:.2f}x (floor {CAP_FLOOR}) at "
+          f"{bytes_ratio:.2f}x pool bytes (want ~1); int8 serve "
+          f"deterministic-DONE={a == b} {'PASS' if ok else 'FAIL'}")
+    return ok, mult
+
+
+def check_disarmed(model):
+    from paddle_tpu.profiler import metrics
+
+    prompts = _prompts(6, [8, 6])
+    base, _, _, _ = _run(model, prompts)
+    spec_b = metrics.snapshot("serving.spec.")
+    quant_b = metrics.snapshot("serving.kv.quant.")
+    # explicit both-off must route through the identical code
+    off, _, _, _ = _run(model, prompts, spec=False, kv_cache_dtype="")
+    spec_silent = metrics.snapshot("serving.spec.") == spec_b
+    quant_silent = metrics.snapshot("serving.kv.quant.") == quant_b
+    ok = off == base and spec_silent and quant_silent
+    print(f"[spec-gate] disarmed: byte-identical={off == base} "
+          f"spec-silent={spec_silent} quant-silent={quant_silent} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def main():
+    model = _model()
+    ok1 = check_equivalence(model)
+    ok2, mult, accept_rate = check_speedup(model)
+    ok3, cap_mult = check_quant_capacity(model)
+    ok4 = check_disarmed(model)
+    ok = ok1 and ok2 and ok3 and ok4
+    try:
+        import bench_ledger
+        bench_ledger.append_entry("spec_gate", {
+            "spec_decode_tokens_per_step": round(mult, 3),
+            "spec_accept_rate": round(accept_rate, 3),
+            "kv_quant_capacity_mult": round(cap_mult, 3),
+            "spec_equivalence_ok": 1.0 if ok1 else 0.0,
+            "spec_disarmed_ok": 1.0 if ok4 else 0.0})
+        print(f"[spec-gate] ledger: appended spec_gate "
+              f"({mult:.2f}x tokens/step, {cap_mult:.2f}x capacity)")
+    except Exception as e:  # noqa: BLE001 — ledger trouble is advisory
+        print(f"[spec-gate] ledger append skipped "
+              f"({type(e).__name__}: {e})")
+    print(f"[spec-gate] {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,10 @@ _MAP = [
     ("paddle_tpu/core/resilience.py", ["tests/framework/test_chaos.py",
                                        "tests/framework/test_serving.py",
                                        "tests/framework/test_overload.py"]),
+    ("paddle_tpu/serving/spec.py",
+     ["tests/framework/test_spec_decode.py"]),
+    ("paddle_tpu/serving/scheduler.py",
+     ["tests/framework/test_spec_decode.py"]),
     ("paddle_tpu/serving/", ["tests/framework/test_serving.py",
                              "tests/framework/test_prefix_cache.py",
                              "tests/framework/test_fleet_observatory.py",
@@ -46,13 +50,19 @@ _MAP = [
                              "tests/framework/test_overload.py"]),
     ("paddle_tpu/inference/", ["tests/framework/test_paged_decode.py",
                                "tests/framework/test_serving.py",
-                               "tests/framework/test_prefix_cache.py"]),
+                               "tests/framework/test_prefix_cache.py",
+                               "tests/framework/test_spec_decode.py",
+                               "tests/framework/test_quantization.py"]),
+    ("paddle_tpu/quantization/",
+     ["tests/framework/test_quantization.py",
+      "tests/framework/test_spec_decode.py"]),
     ("paddle_tpu/models/llama.py",
      ["tests/framework/test_paged_decode.py",
       "tests/framework/test_prefix_cache.py",
       "tests/framework/test_serving.py",
       "tests/framework/test_fleet_observatory.py",
-      "tests/framework/test_router.py"]),
+      "tests/framework/test_router.py",
+      "tests/framework/test_spec_decode.py"]),
     ("paddle_tpu/models/generation.py",
      ["tests/framework/test_serving.py",
       "tests/framework/test_paged_decode.py",
@@ -109,6 +119,8 @@ _MAP = [
     ("tools/fleet_gate.py", ["tests/framework/test_fleet_observatory.py"]),
     ("tools/router_gate.py", ["tests/framework/test_router.py"]),
     ("tools/overload_gate.py", ["tests/framework/test_overload.py"]),
+    ("tools/spec_gate.py", ["tests/framework/test_spec_decode.py",
+                            "tests/framework/test_quantization.py"]),
     ("tools/bench_ledger.py",
      ["tests/framework/test_regression_ledger.py"]),
     ("tools/regression_gate.py",
