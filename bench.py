@@ -250,6 +250,101 @@ def bench_decode_tiers(max_new=24):
     return out
 
 
+def _mesh_serve_child(n_devices):
+    """One ``mesh_serve`` measurement at a fixed host-device count —
+    runs in a SUBPROCESS (``bench.py --mesh-child N``) because
+    ``--xla_force_host_platform_device_count`` must be set before jax
+    initializes. Serves the mesh-friendly tiny Llama
+    (``LlamaConfig.tiny_tp``) at ``FLAGS_serving_mesh=1xN`` (1x1 = the
+    disarmed single-device baseline) and prints one JSON line."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Llama, LlamaConfig
+    from paddle_tpu.serving import ServingEngine
+
+    paddle.seed(0)
+    model = Llama(LlamaConfig.tiny_tp())
+    model.eval()
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(3, 250, size=s) for s in (9, 14, 7, 21)]
+    eng = ServingEngine(model, max_batch=4, block_size=8, max_seq_len=64,
+                        temperature=0.0, bucket_cap=32, background=False,
+                        dtype=jnp.float32, mesh=f"1x{n_devices}")
+    for p in prompts:  # warm every program outside the timed window
+        eng.submit(p, max_new_tokens=4)
+        eng.run_until_idle()
+    t0 = time.perf_counter()
+    hs = [eng.submit(p, max_new_tokens=16) for p in prompts]
+    eng.run_until_idle()
+    dt = time.perf_counter() - t0
+    toks = sum(len(h.tokens()) for h in hs)
+    eng.close()
+    print(json.dumps({"devices": int(n_devices), "tokens": toks,
+                      "elapsed_s": round(dt, 4),
+                      "tokens_per_s": round(toks / dt, 2)}))
+
+
+def bench_mesh_serve(device_counts=(1, 2, 4, 8), timeout_s=600):
+    """Mesh-sharded serving rung (docs/SERVING.md "Mesh-sharded
+    serving"): tokens/s and tokens/s/device of the tiny-TP Llama at
+    1/2/4/8 forced host devices (``FLAGS_serving_mesh=1xN`` over
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``), one
+    subprocess per count. Appends kind ``mesh_serve`` to
+    BENCH_LEDGER.jsonl; tools/regression_gate.py medians the
+    ``*_per_s`` metrics with the existing down-is-worse rate rules.
+    NOTE: forced host devices SHARE the physical cores, so the CPU
+    proxy shows sharding OVERHEAD, not speedup — the portable signal
+    is that the sharded rungs stay within tolerance of their own
+    history (the chip shows the real scaling; ROADMAP TPU flywheel)."""
+    import os
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = {"tag": "mesh_serve_tiny_tp"}
+    for n in device_counts:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PJRT_LIBRARY_PATH", None)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={n}"])
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.join(here, "bench.py"),
+                 "--mesh-child", str(n)],
+                cwd=here, env=env, capture_output=True, text=True,
+                timeout=timeout_s)
+            row = None
+            for line in reversed((p.stdout or "").splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    row = json.loads(line)
+                    break
+            if row is None:
+                raise RuntimeError(
+                    f"child rc={p.returncode}: "
+                    f"{(p.stderr or '')[-300:]}")
+        except Exception as e:  # noqa: BLE001 — a dead rung reports, not raises
+            out[f"mesh_d{n}_error"] = f"{type(e).__name__}: {e}"[:200]
+            continue
+        tps = row["tokens_per_s"]
+        out[f"mesh_d{n}_tokens_per_s"] = tps
+        out[f"mesh_d{n}_tokens_per_device_per_s"] = round(tps / n, 2)
+    try:
+        import os
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import bench_ledger
+        bench_ledger.append_entry("mesh_serve", {
+            k: v for k, v in out.items() if isinstance(v, (int, float))})
+    except Exception:  # noqa: BLE001 — ledger trouble is advisory
+        pass
+    return out
+
+
 def bench_vit_train(factory, batch, steps, tag, image=224):
     import paddle_tpu as paddle
     from paddle_tpu import nn, optimizer
@@ -1103,6 +1198,7 @@ def main():
             bench_llama_decode, LlamaConfig.tiny(), 2, 8, 8,
             "llama_tiny_decode", dtype="float32")
         ladder["decode_tiers"] = _try(bench_decode_tiers)
+        ladder["mesh_serve"] = _try(bench_mesh_serve)
         fp8_cfg = GPTConfig.tiny()
         fp8_cfg.use_fp8 = True
         ladder["gpt_fp8_smoke"] = _try(
@@ -1143,4 +1239,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--mesh-child" in sys.argv:
+        _mesh_serve_child(int(sys.argv[sys.argv.index("--mesh-child") + 1]))
+    else:
+        main()

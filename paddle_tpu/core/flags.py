@@ -406,6 +406,20 @@ define_flag("FLAGS_serving_spec_ngram", 3,
             "longest trailing n-gram the prompt-lookup proposer "
             "matches against the request's own context (falls back to "
             "shorter n-grams down to 1 before giving up)")
+define_flag("FLAGS_serving_mesh", "",
+            "serving device mesh as 'DATAxMODEL' (serving/mesh.py): "
+            "e.g. '1x8' tensor-parallels the served Llama over 8 "
+            "devices — attention heads, MLP hidden dims and the paged "
+            "KV pool's kv-head axis shard along the model axis via "
+            "NamedSharding (shard_map attention where "
+            "capability.has_jax_shard_map), while the data axis "
+            "partitions scheduler slots/blocks into capacity slices. "
+            "Axis sizes must divide jax.device_count() and the model "
+            "axis must divide num_heads/num_kv_heads/intermediate_size "
+            "(structured MeshAxisError otherwise). '' or '1x1' "
+            "(default) is byte-for-byte single-device serving with "
+            "serving.mesh.* counter silence (read at Scheduler "
+            "construction, the FLAGS_serving_prefix_cache convention)")
 define_flag("FLAGS_fleet_skew_ratio", 2.5,
             "fleet.skew alert threshold: a replica whose TTFT p95 "
             "exceeds this multiple of the fleet median p95 (both from "

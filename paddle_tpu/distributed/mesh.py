@@ -17,6 +17,55 @@ from jax.sharding import Mesh as JaxMesh
 _global_mesh = None
 
 
+class MeshAxisError(ValueError):
+    """A requested mesh shape cannot be laid out on the visible devices.
+
+    Structured: ``axis`` (the offending axis name, or None when the
+    TOTAL product is the problem), ``size`` (the requested extent) and
+    ``device_count`` — so callers (serving/mesh.py, tests, operators
+    reading a traceback) see WHICH axis broke instead of a jax
+    IndexError from deep inside ``Mesh`` construction."""
+
+    def __init__(self, message, *, axis=None, size=None,
+                 device_count=None):
+        super().__init__(message)
+        self.axis = axis
+        self.size = size
+        self.device_count = device_count
+
+
+def validate_mesh_axes(shape, dim_names, device_count=None):
+    """Validate a logical mesh shape against the visible device count
+    BEFORE any jax ``Mesh`` construction: every axis size must be a
+    positive integer that divides ``jax.device_count()``, and the total
+    product must not exceed it. Raises :class:`MeshAxisError` naming
+    the first offending axis (jax's own failure mode is an opaque
+    reshape/index error deep inside ``Mesh``)."""
+    if device_count is None:
+        device_count = jax.device_count()
+    names = list(dim_names) if dim_names is not None else \
+        [f"d{i}" for i in range(len(shape))]
+    total = 1
+    for name, size in zip(names, shape):
+        size = int(size)
+        if size < 1:
+            raise MeshAxisError(
+                f"mesh axis {name!r} has non-positive size {size}",
+                axis=name, size=size, device_count=device_count)
+        if device_count % size != 0:
+            raise MeshAxisError(
+                f"mesh axis {name!r} size {size} does not divide the "
+                f"visible device count {device_count}",
+                axis=name, size=size, device_count=device_count)
+        total *= size
+    if total > device_count:
+        raise MeshAxisError(
+            f"mesh shape {'x'.join(str(int(s)) for s in shape)} needs "
+            f"{total} devices but only {device_count} are visible",
+            axis=None, size=total, device_count=device_count)
+    return total
+
+
 class ProcessMesh:
     def __init__(self, mesh, dim_names=None, shape=None):
         """``mesh``: nested list / ndarray of device (process) ids, or a
@@ -109,12 +158,21 @@ class ProcessMesh:
 
 def init_mesh(shape, dim_names):
     """Build a ProcessMesh over all visible devices with the given logical
-    shape; `-1` infers one dimension."""
+    shape; `-1` infers one dimension. Axis sizes are validated against
+    ``jax.device_count()`` up front (:func:`validate_mesh_axes`) so a
+    bad shape raises a :class:`MeshAxisError` naming the axis instead
+    of failing deep inside jax ``Mesh`` construction."""
     n = jax.device_count()
     shape = list(shape)
     if -1 in shape:
         known = int(np.prod([s for s in shape if s != -1]))
+        if known < 1 or n % known != 0:
+            raise MeshAxisError(
+                f"cannot infer the -1 axis: the known axes' product "
+                f"{known} does not divide the visible device count {n}",
+                axis=None, size=known, device_count=n)
         shape[shape.index(-1)] = n // known
+    validate_mesh_axes(shape, dim_names, n)
     ids = np.arange(int(np.prod(shape))).reshape(shape)
     return ProcessMesh(ids, dim_names)
 

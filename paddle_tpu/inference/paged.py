@@ -53,7 +53,8 @@ _PREFIX_EVICT = _metrics.counter("serving.prefix.evictions")
 
 __all__ = ["PagedKVCache", "paged_prefill_write",
            "paged_prefill_write_masked", "paged_decode_attention",
-           "paged_decode_attention_dense", "paged_prefix_attention_dense",
+           "paged_decode_attention_dense", "paged_decode_attention_tp",
+           "paged_prefix_attention_dense",
            "paged_spec_write", "paged_spec_attention_dense",
            "ContinuousBatchingEngine", "validate_request",
            "chunk_digests", "PrefixPlan", "CapacityError",
@@ -210,7 +211,8 @@ class PagedKVCache:
 
     def __init__(self, num_layers, num_kv_heads, head_dim, *, num_blocks,
                  block_size=16, max_blocks_per_seq, max_batch,
-                 dtype=jnp.bfloat16, kv_dtype=None):
+                 dtype=jnp.bfloat16, kv_dtype=None, pool_sharding=None,
+                 scale_sharding=None, num_slices=1):
         self.num_layers = num_layers
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
@@ -219,6 +221,30 @@ class PagedKVCache:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.max_batch = max_batch
         self.dtype = dtype
+        # mesh-sharded serving (serving/mesh.py): ``pool_sharding`` /
+        # ``scale_sharding`` lay the device pools out over a mesh
+        # (kv-head axis split across model shards); ``num_slices``
+        # (the mesh's data extent) partitions HOST capacity — slots
+        # and blocks divide into slices, allocation binds a slot to
+        # its slice's blocks, and occupancy() reports per-slice. At
+        # the default 1 every slice helper degenerates to the legacy
+        # single-pool behavior byte-for-byte.
+        self.num_slices = max(int(num_slices), 1)
+        if self.num_slices > max_batch:
+            raise ValueError(
+                f"PagedKVCache: num_slices {self.num_slices} exceeds "
+                f"max_batch {max_batch} — every slice needs at least "
+                f"one decode slot")
+        if self.num_slices > num_blocks - 1:
+            raise ValueError(
+                f"PagedKVCache: num_slices {self.num_slices} exceeds "
+                f"the {num_blocks - 1} usable blocks")
+        if self.num_slices > 1:
+            self._block_owner = np.full((num_blocks,), -1, np.int32)
+            self._block_owner[1:] = (np.arange(1, num_blocks)
+                                     - 1) % self.num_slices
+        else:
+            self._block_owner = None
         # ``kv_dtype="int8"`` (FLAGS_kv_cache_dtype, resolved by the
         # engine): pools store int8 rows with per-(token-slot, kv-head)
         # float32 absmax scales beside them (quantization.quantize_rows
@@ -230,15 +256,20 @@ class PagedKVCache:
         self.quantized = self.kv_dtype == "int8"
         shape = (num_blocks, block_size, num_kv_heads, head_dim)
         store_dt = jnp.int8 if self.quantized else dtype
-        self.k_pools = [jnp.zeros(shape, store_dt)
+
+        def _pool(sh, dt, sharding):
+            z = jnp.zeros(sh, dt)
+            return z if sharding is None else jax.device_put(z, sharding)
+
+        self.k_pools = [_pool(shape, store_dt, pool_sharding)
                         for _ in range(num_layers)]
-        self.v_pools = [jnp.zeros(shape, store_dt)
+        self.v_pools = [_pool(shape, store_dt, pool_sharding)
                         for _ in range(num_layers)]
         if self.quantized:
             sshape = (num_blocks, block_size, num_kv_heads)
-            self.k_scales = [jnp.zeros(sshape, jnp.float32)
+            self.k_scales = [_pool(sshape, jnp.float32, scale_sharding)
                              for _ in range(num_layers)]
-            self.v_scales = [jnp.zeros(sshape, jnp.float32)
+            self.v_scales = [_pool(sshape, jnp.float32, scale_sharding)
                              for _ in range(num_layers)]
         else:
             self.k_scales = self.v_scales = None
@@ -270,10 +301,46 @@ class PagedKVCache:
     def free_slots(self):
         return [i for i, l in enumerate(self._live) if not l]
 
-    def num_free_blocks(self):
+    # -- mesh capacity slices (serving/mesh.py) ----------------------------
+
+    def slice_of_slot(self, slot):
+        """The capacity slice a decode slot belongs to (contiguous,
+        balanced groups); 0 for the unsliced cache."""
+        return slot * self.num_slices // self.max_batch
+
+    def _slice_of_block(self, b):
+        return int(self._block_owner[b]) if self._block_owner is not None \
+            else 0
+
+    def _slice_free_count(self, slice_id):
+        """Allocatable blocks (free + reclaimable-cached) owned by one
+        slice — the per-slice form of :meth:`num_free_blocks`."""
+        if self.num_slices <= 1:
+            return len(self._free) + len(self._cached_free)
+        own = self._block_owner
+        return (sum(1 for b in self._free if own[b] == slice_id)
+                + sum(1 for b in self._cached_free if own[b] == slice_id))
+
+    def binding_slice(self):
+        """The slice the NEXT admission would bind to — the one the
+        admission/shed watermarks should read (serving/overload.py):
+        among slices with a free slot, the one with the most
+        allocatable blocks (lowest id on ties). None for the unsliced
+        cache (aggregate semantics, byte-for-byte pre-mesh)."""
+        if self.num_slices <= 1:
+            return None
+        free = self.free_slots()
+        cand = sorted({self.slice_of_slot(s) for s in free}) if free \
+            else range(self.num_slices)
+        return max(cand, key=self._slice_free_count)
+
+    def num_free_blocks(self, slice=None):
         """Blocks allocatable RIGHT NOW: truly free plus reclaimable
-        cached (refcount-0 registered blocks the LRU can evict)."""
-        return len(self._free) + len(self._cached_free)
+        cached (refcount-0 registered blocks the LRU can evict).
+        ``slice`` restricts to one capacity slice's blocks."""
+        if slice is None or self.num_slices <= 1:
+            return len(self._free) + len(self._cached_free)
+        return self._slice_free_count(slice)
 
     def num_cached_blocks(self):
         """Reclaimable refcount-0 blocks held only by the prefix index."""
@@ -289,12 +356,24 @@ class PagedKVCache:
         return sum(1 for b in self._slot_blocks[slot]
                    if self._refcount[b] == 1)
 
-    def occupancy(self):
+    def occupancy(self, slice=None):
         """Pool occupancy breakdown (host metadata only — no device
         reads). ``active`` blocks are pinned by live slots (refcount >
         0), ``shared`` of those back more than one slot, ``cached_free``
         are refcount-0 registered blocks the LRU can reclaim, ``free``
-        are truly free. active + cached_free + free == usable always."""
+        are truly free. active + cached_free + free == usable always —
+        per slice and in aggregate (``slice=i`` restricts to one mesh
+        capacity slice's blocks; per-slice values sum EXACTLY to the
+        aggregate, tests/framework/test_mesh_serving.py pins it)."""
+        if slice is not None and self.num_slices > 1:
+            own = self._block_owner
+            usable = int((own == slice).sum())  # null block owns -1
+            free = sum(1 for b in self._free if own[b] == slice)
+            cached = sum(1 for b in self._cached_free if own[b] == slice)
+            shared = int(((self._refcount > 1) & (own == slice)).sum())
+            return {"usable": usable, "active": usable - free - cached,
+                    "shared": shared, "cached_free": cached,
+                    "free": free}
         usable = self.num_blocks - 1
         free = len(self._free)
         cached = len(self._cached_free)
@@ -304,12 +383,22 @@ class PagedKVCache:
                 "cached_free": cached,
                 "free": free}
 
-    def pool_bytes(self):
+    def occupancy_slices(self):
+        """Per-slice occupancy dicts, index == slice id (a single
+        aggregate entry for the unsliced cache)."""
+        if self.num_slices <= 1:
+            return [self.occupancy()]
+        return [self.occupancy(slice=i) for i in range(self.num_slices)]
+
+    def pool_bytes(self, slice=None):
         """Total HBM footprint of the K+V pools (static: allocated at
         construction, independent of occupancy). Quantized pools count
         their int8 rows PLUS the float32 scale arrays — the multiplier
         ``occupancy()`` shows must never be paid for twice in hidden
-        bytes (tools/spec_gate.py pins consistency)."""
+        bytes (tools/spec_gate.py pins consistency). ``slice=i``
+        reports one mesh capacity slice's proportional share (by its
+        usable-block count; the reserved null block rides the
+        aggregate only)."""
         item = 1 if self.quantized else jnp.dtype(self.dtype).itemsize
         per_pool = (self.num_blocks * self.block_size *
                     self.num_kv_heads * self.head_dim * item)
@@ -317,26 +406,52 @@ class PagedKVCache:
         if self.quantized:
             total += (2 * self.num_layers * self.num_blocks *
                       self.block_size * self.num_kv_heads * 4)
+        if slice is not None and self.num_slices > 1:
+            usable = int((self._block_owner == slice).sum())
+            return int(total * usable / max(self.num_blocks - 1, 1))
         return total
 
     # -- block primitives --------------------------------------------------
 
-    def _take_block(self):
+    def _drop_cached(self, b):
+        """Evict one reclaimable cached block: its prefix-index entries
+        drop (the "evict cold prefixes before preempting anyone"
+        rung)."""
+        del self._cached_free[b]
+        for kind, key in self._block_keys.pop(b, ()):
+            idx = self._prefix_index if kind == "full" \
+                else self._partial_index
+            if idx.get(key) == b:
+                del idx[key]
+        _PREFIX_EVICT.inc()
+
+    def _take_block(self, slice_id=None):
         """Allocate one block (refcount 1): the free list first, then
-        LRU eviction of a cold cached block (its index entries drop —
-        this is the "evict cold prefixes before preempting anyone"
-        rung). None when both are empty."""
-        if self._free:
-            b = self._free.pop()
-        elif self._cached_free:
-            b, _ = self._cached_free.popitem(last=False)
-            for kind, key in self._block_keys.pop(b, ()):
-                idx = self._prefix_index if kind == "full" \
-                    else self._partial_index
-                if idx.get(key) == b:
-                    del idx[key]
-            _PREFIX_EVICT.inc()
+        LRU eviction of a cold cached block. None when both are empty.
+        ``slice_id`` (sliced caches) restricts allocation to one
+        capacity slice's blocks — the unsliced path is byte-for-byte
+        the legacy pop/LRU order."""
+        b = None
+        if self.num_slices <= 1 or slice_id is None:
+            if self._free:
+                b = self._free.pop()
+            elif self._cached_free:
+                b = next(iter(self._cached_free))
+                self._drop_cached(b)
         else:
+            own = self._block_owner
+            for i in range(len(self._free) - 1, -1, -1):
+                if own[self._free[i]] == slice_id:
+                    b = self._free.pop(i)
+                    break
+            if b is None:
+                for cb in self._cached_free:  # LRU order
+                    if own[cb] == slice_id:
+                        b = cb
+                        break
+                if b is not None:
+                    self._drop_cached(b)
+        if b is None:
             return None
         self._refcount[b] = 1
         return b
@@ -376,16 +491,36 @@ class PagedKVCache:
                 self.v_scales[i] = self.v_scales[i].at[dst].set(
                     self.v_scales[i][src])
 
+    def _choose_slot(self):
+        """Admission slot choice: the first free slot (legacy FCFS
+        order), or — sliced — the first free slot in the slice with
+        the most allocatable blocks (the least-loaded-slice placement
+        the per-slice watermarks read via :meth:`binding_slice`)."""
+        free = self.free_slots()
+        if not free:
+            return None
+        if self.num_slices <= 1:
+            return free[0]
+        best = None
+        for s in free:
+            cap = self._slice_free_count(self.slice_of_slot(s))
+            if best is None or cap > best[0]:
+                best = (cap, s)
+        return best[1]
+
     def alloc_slot(self, num_tokens):
-        """Claim a slot + enough blocks for `num_tokens`; returns slot id
+        """Claim a slot + enough blocks for `num_tokens` (from the
+        slot's capacity slice, on a sliced cache); returns slot id
         or None if out of slots/blocks."""
         need = max(1, math.ceil(num_tokens / self.block_size))
-        free = self.free_slots()
-        if not free or need > self.num_free_blocks() or \
-                need > self.max_blocks_per_seq:
+        slot = self._choose_slot()
+        if slot is None or need > self.max_blocks_per_seq:
             return None
-        slot = free[0]
-        blocks = [self._take_block() for _ in range(need)]
+        sl = self.slice_of_slot(slot)
+        if need > self.num_free_blocks(
+                sl if self.num_slices > 1 else None):
+            return None
+        blocks = [self._take_block(sl) for _ in range(need)]
         self._slot_blocks[slot] = blocks
         self._live[slot] = True
         row = np.zeros((self.max_blocks_per_seq,), np.int32)
@@ -409,7 +544,7 @@ class PagedKVCache:
                     CapacityError.SEQ_LIMIT,
                     f"{new_len} tokens need {need} blocks > "
                     f"max_blocks_per_seq {self.max_blocks_per_seq}")
-            b = self._take_block()
+            b = self._take_block(self.slice_of_slot(slot))
             if b is None:
                 return CapacityError(
                     CapacityError.BLOCKS,
@@ -433,7 +568,7 @@ class PagedKVCache:
         ci = (new_len - 1) // self.block_size
         b = self._slot_blocks[slot][ci]
         if self._refcount[b] > 1:
-            nb = self._take_block()
+            nb = self._take_block(self.slice_of_slot(slot))
             if nb is None:
                 return CapacityError(
                     CapacityError.BLOCKS,
@@ -464,7 +599,7 @@ class PagedKVCache:
         for ci in range(lo, hi + 1):
             b = self._slot_blocks[slot][ci]
             if self._refcount[b] > 1:
-                nb = self._take_block()
+                nb = self._take_block(self.slice_of_slot(slot))
                 if nb is None:
                     self.truncate_blocks(slot, have0)
                     return CapacityError(
@@ -555,9 +690,10 @@ class PagedKVCache:
         copied (COW), and only the uncovered chunks allocate fresh
         blocks. Returns the slot id or None (no slot / not enough
         reclaimable blocks — the plan is untouched on failure)."""
-        free = self.free_slots()
-        if not free or plan.chunks_total > self.max_blocks_per_seq:
+        slot = self._choose_slot()
+        if slot is None or plan.chunks_total > self.max_blocks_per_seq:
             return None
+        sl = self.slice_of_slot(slot) if self.num_slices > 1 else None
         shared = list(plan.matched_blocks)
         cow_src = None
         if plan.partial_block is not None:
@@ -565,24 +701,26 @@ class PagedKVCache:
                 shared.append(plan.partial_block)
             else:
                 cow_src = plan.partial_block
-        # pin everything we read before any eviction can run
+        # pin everything we read before any eviction can run (matched
+        # blocks may live in ANY slice — prefix sharing crosses slice
+        # boundaries read-only; only FRESH blocks bind to the slot's
+        # slice)
         for b in shared:
             self._ref_block(b)
         if cow_src is not None:
             self._ref_block(cow_src)
         fresh_needed = plan.chunks_total - len(shared)
-        if fresh_needed > len(self._free) + len(self._cached_free):
+        if fresh_needed > self.num_free_blocks(sl):
             if cow_src is not None:
                 self._deref_block(cow_src)
             for b in reversed(shared):
                 self._deref_block(b)
             return None
-        fresh = [self._take_block() for _ in range(fresh_needed)]
+        fresh = [self._take_block(sl) for _ in range(fresh_needed)]
         if cow_src is not None:
             self._copy_block_rows(cow_src, fresh[0])
             self._deref_block(cow_src)
             _PREFIX_COW.inc()
-        slot = free[0]
         blocks = shared + fresh
         self._slot_blocks[slot] = blocks
         self._live[slot] = True
@@ -870,6 +1008,53 @@ def paged_decode_attention_dense(q, k_pool, v_pool, block_tables, seq_lens,
     probs = jnp.where(mask[:, None, None, :], probs, 0.0)
     out = jnp.einsum("bngt,btnd->bngd", probs.astype(v.dtype), v)
     return out.reshape(b, hq, d).astype(q.dtype)
+
+
+def paged_decode_attention_tp(q, k_pool, v_pool, block_tables, seq_lens,
+                              mesh, scale=None, k_scale=None,
+                              v_scale=None):
+    """Tensor-parallel decode attention under an explicit
+    ``jax.shard_map`` (docs/SERVING.md "Mesh-sharded serving"): the
+    kv-head axis of the pools and the q-head axis of the queries split
+    along the mesh's ``model`` axis, and each shard runs the plain
+    :func:`paged_decode_attention` on its LOCAL heads — gathering only
+    its own pool shard and routing the Pallas kernel
+    (kernels/pallas/paged_attention.py) per shard on TPU. Attention is
+    embarrassingly parallel over heads (GQA groups never cross a
+    kv-head), so the body needs NO collective; the all_gather /
+    psum_scatter pair lives at the o_proj boundary, where GSPMD puts
+    it. Only called when ``capability.has_jax_shard_map`` (the stable
+    entry point) — everywhere else the same layout rides NamedSharding
+    inputs + GSPMD propagation (``ServingMesh.shard_map_armed``)."""
+    from jax.sharding import PartitionSpec as P
+
+    jm = mesh.jax_mesh
+    head = P(None, "model", None)
+    pool = P(None, None, "model", None)
+    rep = P()
+
+    if k_scale is not None:
+        srow = P(None, None, "model")
+
+        def local(qq, kp, vp, ksc, vsc, tbl, lens):
+            return paged_decode_attention(qq, kp, vp, tbl, lens,
+                                          scale=scale, k_scale=ksc,
+                                          v_scale=vsc)
+
+        f = jax.shard_map(local, mesh=jm,
+                          in_specs=(head, pool, pool, srow, srow,
+                                    rep, rep),
+                          out_specs=head)
+        return f(q, k_pool, v_pool, k_scale, v_scale, block_tables,
+                 seq_lens)
+
+    def local(qq, kp, vp, tbl, lens):
+        return paged_decode_attention(qq, kp, vp, tbl, lens, scale=scale)
+
+    f = jax.shard_map(local, mesh=jm,
+                      in_specs=(head, pool, pool, rep, rep),
+                      out_specs=head)
+    return f(q, k_pool, v_pool, block_tables, seq_lens)
 
 
 # ---------------------------------------------------------------------------

@@ -87,6 +87,18 @@ class LlamaConfig:
                            intermediate_size=128, num_layers=2, num_heads=4,
                            num_kv_heads=2, max_position_embeddings=64)
 
+    @staticmethod
+    def tiny_tp():
+        """Mesh-friendly tiny config (docs/SERVING.md "Mesh-sharded
+        serving"): 8 q and kv heads so the serving mesh's model axis
+        can split 1..8 ways — ``tiny()``'s 4/2 heads cap it at 2.
+        tools/mesh_gate.py, bench.py's ``mesh_serve`` rung, and
+        tests/framework/test_mesh_serving.py all serve THIS config."""
+        return LlamaConfig(vocab_size=256, hidden_size=64,
+                           intermediate_size=128, num_layers=2,
+                           num_heads=8, num_kv_heads=8,
+                           max_position_embeddings=64)
+
 
 def apply_rope(q, k, theta=10000.0, position_offset=0):
     """Rotary embedding on [b, s, h, d] Tensors (capability of the
@@ -318,6 +330,55 @@ class Llama(nn.Layer):
     def _param_arrays(self):
         return tuple(p._data for _, p in self.named_parameters())
 
+    # every jitted serving entry point this model caches; cleared when
+    # the serving mesh changes so programs re-lower against the new
+    # shardings (and re-fingerprint in the AOT cache under the new tag)
+    _PAGED_JIT_ATTRS = ("_paged_prefill_jit", "_paged_extend_jit",
+                       "_paged_extend_q8_jit", "_paged_decode_jit",
+                       "_paged_decode_q8_jit", "_paged_spec_jit",
+                       "_paged_spec_q8_jit")
+
+    def serving_mesh(self):
+        """The ServingMesh this model's serving params are laid out
+        on, or None (single-device serving)."""
+        return self.__dict__.get("_serving_mesh")
+
+    def apply_serving_mesh(self, mesh):
+        """Lay the model out for mesh-sharded serving
+        (serving/mesh.py; docs/SERVING.md "Mesh-sharded serving"):
+        every parameter is ``device_put`` with its ``NamedSharding``
+        along the mesh's model axis (column-parallel q/k/v/gate/up,
+        row-parallel o/down, everything else replicated) and the
+        cached paged jit entry points drop so they re-lower sharded —
+        their AOT tags fold the mesh shape in (``_aot_tag``), so a
+        1x8 executable can never be served from a 1x1 cache entry.
+        Idempotent for the same mesh spec; ``mesh=None`` is a no-op
+        (a previously-meshed model keeps its layout — construct a
+        fresh model for single-device serving)."""
+        if mesh is None:
+            return
+        import jax
+
+        mesh.validate_model(self.config)
+        cur = self.__dict__.get("_serving_mesh")
+        if cur is not None and cur.spec == mesh.spec:
+            self.__dict__["_serving_mesh"] = mesh
+            return
+        with self._paged_lock():
+            for n, p in self.named_parameters():
+                p._data = jax.device_put(p._data, mesh.param_sharding(n))
+            self.__dict__["_serving_mesh"] = mesh
+            for attr in self._PAGED_JIT_ATTRS:
+                self.__dict__.pop(attr, None)
+
+    def _aot_tag(self, base):
+        """AOT-cache tag for a serving program: the mesh spec folds in
+        so fingerprints differ across mesh shapes even where the
+        lowered text happens to agree (tests/framework/
+        test_mesh_serving.py pins the distinction)."""
+        mesh = self.__dict__.get("_serving_mesh")
+        return base if mesh is None else f"{base}.mesh{mesh.spec}"
+
     def _paged_lock(self):
         """Per-model lock serializing the paged jit entry points. Their
         trace path REBINDS the module's parameters to tracers and
@@ -384,8 +445,8 @@ class Llama(nn.Layer):
                 ks = [k._data[0] for k, _ in sink]
                 vs = [v._data[0] for _, v in sink]
                 return tok[0], ks, vs
-            self._paged_prefill_jit = _aot_wrap(jax.jit(fn),
-                                                "llama.paged_prefill")
+            self._paged_prefill_jit = _aot_wrap(
+                jax.jit(fn), self._aot_tag("llama.paged_prefill"))
 
         with self._paged_lock():
             arrs = self._param_arrays()
@@ -521,8 +582,8 @@ class Llama(nn.Layer):
                                          temperature=1.0, key=key),
                     lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
                 return tok[0], new_k, new_v
-            self._paged_extend_jit = _aot_wrap(jax.jit(fn),
-                                               "llama.paged_extend")
+            self._paged_extend_jit = _aot_wrap(
+                jax.jit(fn), self._aot_tag("llama.paged_extend"))
 
         with self._paged_lock():
             arrs = self._param_arrays()
@@ -599,7 +660,8 @@ class Llama(nn.Layer):
                                      temperature=1.0, key=key),
                 lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
             return tok[0], new_k, new_v, new_ks, new_vs
-        return _aot_wrap(jax.jit(fn), "llama.paged_extend.q8")
+        return _aot_wrap(jax.jit(fn),
+                         self._aot_tag("llama.paged_extend.q8"))
 
     def paged_decode_step(self, cache, last_tokens, active,
                           temperature=0.0):
@@ -636,10 +698,18 @@ class Llama(nn.Layer):
             hq = cfg.num_heads
             hk = cfg.num_kv_heads
             hd = cfg.hidden_size // hq
+            # mesh-sharded serving: captured at build time — the jit is
+            # rebuilt (apply_serving_mesh clears it) when the mesh
+            # changes. With stable shard_map available the attention
+            # runs explicitly sharded per kv-head; otherwise the same
+            # layout rides the NamedSharding inputs + GSPMD.
+            mesh = self.__dict__.get("_serving_mesh")
+            use_tp = mesh is not None and mesh.shard_map_armed
 
             def fn(param_arrays, toks, k_pools, v_pools, tables, lens,
                    active, key, temp):
                 from ..inference.paged import (paged_decode_attention,
+                                               paged_decode_attention_tp,
                                                paged_decode_write)
                 from .generation import sample_token
                 from ..core.autograd import no_grad
@@ -659,9 +729,14 @@ class Llama(nn.Layer):
                         kp, vp = paged_decode_write(
                             k_pools[i], v_pools[i], tables, lens,
                             k._data[:, 0], v._data[:, 0], active)
-                        out = paged_decode_attention(
-                            q._data[:, 0], kp, vp, tables,
-                            jnp.where(active, lens + 1, lens))
+                        if use_tp:
+                            out = paged_decode_attention_tp(
+                                q._data[:, 0], kp, vp, tables,
+                                jnp.where(active, lens + 1, lens), mesh)
+                        else:
+                            out = paged_decode_attention(
+                                q._data[:, 0], kp, vp, tables,
+                                jnp.where(active, lens + 1, lens))
                         x = x + attn.o_proj(
                             Tensor(out.reshape(b, 1, hq * hd)))
                         x = x + blk.mlp(blk.post_attention_layernorm(x))
@@ -681,8 +756,8 @@ class Llama(nn.Layer):
                                          temperature=1.0, key=key),
                     lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
                 return nxt, new_k, new_v
-            self._paged_decode_jit = _aot_wrap(jax.jit(fn),
-                                               "llama.paged_decode")
+            self._paged_decode_jit = _aot_wrap(
+                jax.jit(fn), self._aot_tag("llama.paged_decode"))
 
         with self._paged_lock():
             arrs = self._param_arrays()
@@ -710,11 +785,14 @@ class Llama(nn.Layer):
         hq = cfg.num_heads
         hk = cfg.num_kv_heads
         hd = cfg.hidden_size // hq
+        mesh = self.__dict__.get("_serving_mesh")
+        use_tp = mesh is not None and mesh.shard_map_armed
 
         def fn(param_arrays, toks, k_pools, v_pools, k_scales, v_scales,
                tables, lens, active, key, temp):
             from ..core.autograd import no_grad
             from ..inference.paged import (paged_decode_attention,
+                                           paged_decode_attention_tp,
                                            paged_decode_write_q)
             from .generation import sample_token
             rebind(param_arrays)
@@ -734,10 +812,16 @@ class Llama(nn.Layer):
                         k_pools[i], v_pools[i], k_scales[i],
                         v_scales[i], tables, lens, k._data[:, 0],
                         v._data[:, 0], active)
-                    out = paged_decode_attention(
-                        q._data[:, 0], kp, vp, tables,
-                        jnp.where(active, lens + 1, lens),
-                        k_scale=ksc, v_scale=vsc)
+                    if use_tp:
+                        out = paged_decode_attention_tp(
+                            q._data[:, 0], kp, vp, tables,
+                            jnp.where(active, lens + 1, lens), mesh,
+                            k_scale=ksc, v_scale=vsc)
+                    else:
+                        out = paged_decode_attention(
+                            q._data[:, 0], kp, vp, tables,
+                            jnp.where(active, lens + 1, lens),
+                            k_scale=ksc, v_scale=vsc)
                     x = x + attn.o_proj(
                         Tensor(out.reshape(b, 1, hq * hd)))
                     x = x + blk.mlp(blk.post_attention_layernorm(x))
@@ -759,7 +843,8 @@ class Llama(nn.Layer):
                                      temperature=1.0, key=key),
                 lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
             return nxt, new_k, new_v, new_ks, new_vs
-        return _aot_wrap(jax.jit(fn), "llama.paged_decode.q8")
+        return _aot_wrap(jax.jit(fn),
+                         self._aot_tag("llama.paged_decode.q8"))
 
     # -- self-speculative decode (docs/SERVING.md "Decode speed tiers") --
 
@@ -829,7 +914,7 @@ class Llama(nn.Layer):
             nxt = jnp.argmax(logits._data, axis=-1).astype(jnp.int32)
             return nxt, new_k, new_v, new_ks, new_vs
         tag = "llama.paged_spec.q8" if quantized else "llama.paged_spec"
-        return _aot_wrap(jax.jit(fn), tag)
+        return _aot_wrap(jax.jit(fn), self._aot_tag(tag))
 
     def paged_spec_step(self, cache, last_tokens, draft_tokens, n_inputs,
                         active):

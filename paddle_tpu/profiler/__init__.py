@@ -270,6 +270,25 @@ def _capacity_view(snap):
                      f"{snap.get('memory.live_arrays', 0)} live arrays"))
     for name, value, note in rows:
         lines.append("{:<26} {:>10} {}".format(name, value, note))
+    # mesh-sharded serving (FLAGS_serving_mesh): the per-slice
+    # breakdown rides slice-labeled gauges (serving.kv.*{slice="i"});
+    # absent on single-device engines, and per-slice sums equal the
+    # aggregates above (tests/framework/test_mesh_serving.py)
+    slices = {}
+    for key, v in snap.items():
+        if key.startswith("serving.kv.") and '{slice="' in key:
+            base, _, lab = key.partition("{")
+            sid = lab.split('"')[1]
+            slices.setdefault(sid, {})[base.rsplit(".", 1)[-1]] = v
+    for sid in sorted(slices, key=lambda s: (len(s), s)):
+        d = slices[sid]
+        lines.append(
+            "{:<26} {:>10} {}".format(
+                f"kv.slice[{sid}]",
+                d.get("active_blocks", 0),
+                f"active (free {d.get('free_blocks', 0)}, shared "
+                f"{d.get('shared_blocks', 0)}, cached "
+                f"{d.get('cached_blocks', 0)})"))
     return lines
 
 

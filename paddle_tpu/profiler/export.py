@@ -74,12 +74,10 @@ def _fnum(v):
     return repr(int(f)) if f == int(f) else repr(f)
 
 
-def _esc_label(v):
-    """Escape a label VALUE per the exposition format (backslash,
-    double quote, newline) — an operator-chosen replica_id must never
-    produce an exposition parse_prometheus rejects."""
-    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
-        .replace("\n", "\\n")
+# canonical label-value escaping lives in metrics (this module depends
+# on it; the reverse import would cycle) — an operator-chosen
+# replica_id must never produce an exposition parse_prometheus rejects
+_esc_label = _metrics._esc_label_value
 
 
 def _unesc_label(v):
@@ -91,13 +89,14 @@ def _labelblock(labels, **extra):
     """``{k="v",...}`` block for a sample line (sorted-key canonical;
     empty string when there are no labels at all). Values are escaped;
     the parser unescapes — key canonicalization therefore happens on
-    the ESCAPED form on both sides, so render/parse keys agree."""
+    the ESCAPED form on both sides, so render/parse keys agree.
+    Delegates to ``metrics._label_body`` so registry keys
+    (``metrics.label_key``) and rendered sample lines share one
+    implementation."""
     items = {**(labels or {}), **extra}
     if not items:
         return ""
-    body = ",".join(f'{k}="{_esc_label(v)}"'
-                    for k, v in sorted(items.items()))
-    return "{" + body + "}"
+    return "{" + _metrics._label_body(items) + "}"
 
 
 def _identity_lines(labels=None):
@@ -124,21 +123,35 @@ def render_prometheus(prefix=None, labels=None):
     spec."""
     with _metrics.registry._lock:
         items = sorted(_metrics.registry._metrics.items())
-    lines = []
+    lines, typed = [], set()
     lb = _labelblock(labels)
     for name, m in items:
         if prefix is not None and not name.startswith(prefix):
             continue
-        pn = _pname(name)
+        # labeled instruments (per-slice KV gauges, metrics.label_key
+        # registry keys) render their own labels MERGED with the
+        # caller's stamp — the stamp wins on collision, matching the
+        # replica_info precedence
+        own = getattr(m, "labels", None)
+        mlb = _labelblock({**own, **(labels or {})}) if own else lb
+        pn = _pname(m.name)
+        # TYPE once per family: labeled slices of one gauge share a
+        # base name across registry keys, and OpenMetrics rejects
+        # repeated metric-family metadata
+        typeline = pn not in typed
+        typed.add(pn)
         if isinstance(m, _metrics.Counter):
-            lines.append(f"# TYPE {pn} counter")
-            lines.append(f"{pn}_total{lb} {_fnum(m.value)}")
+            if typeline:
+                lines.append(f"# TYPE {pn} counter")
+            lines.append(f"{pn}_total{mlb} {_fnum(m.value)}")
         elif isinstance(m, _metrics.Gauge):
-            lines.append(f"# TYPE {pn} gauge")
-            lines.append(f"{pn}{lb} {_fnum(m.value)}")
+            if typeline:
+                lines.append(f"# TYPE {pn} gauge")
+            lines.append(f"{pn}{mlb} {_fnum(m.value)}")
         elif isinstance(m, _metrics.Histogram):
             snap = m._snap()
-            lines.append(f"# TYPE {pn} histogram")
+            if typeline:
+                lines.append(f"# TYPE {pn} histogram")
             cum = 0
             bounds = [*m.bounds, float("inf")]
             blabels = [*map(str, m.bounds), "+inf"]

@@ -66,8 +66,9 @@ from . import metrics as _metrics
 from . import tracing as _tracing
 
 __all__ = ["Registrar", "FleetAggregator", "FleetServer", "armed",
-           "read_members", "merge_scrapes", "percentile_from_buckets",
-           "health_score", "snapshot_from_scrape", "git_sha",
+           "read_members", "merge_scrapes", "label_replica",
+           "percentile_from_buckets", "health_score",
+           "snapshot_from_scrape", "git_sha",
            "SEQ_KEY", "MEMBER_KEY_FMT"]
 
 SEQ_KEY = "fleet/seq"
@@ -299,7 +300,11 @@ def merge_scrapes(by_replica):
     come out of the merged buckets), ``sum``/``count`` add, and each
     bucket keeps the max-value exemplar across replicas (tagged with
     its origin ``replica_id``). Labeled series and ``replica_info``
-    are per-replica by definition and do not aggregate."""
+    are per-origin by definition and do not aggregate — that covers
+    both replica-labeled series AND the mesh-serving per-slice series
+    (``serving.kv.*{slice="i"}``), which :func:`label_replica`
+    instead re-labels with their origin replica for the federated
+    exposition."""
     merged = {}
     for rid in sorted(by_replica):
         for key, e in by_replica[rid].items():
@@ -328,6 +333,27 @@ def merge_scrapes(by_replica):
             else:
                 m["value"] = m.get("value", 0) + e.get("value", 0)
     return merged
+
+
+def label_replica(parsed, rid):
+    """Re-key one replica's parsed scrape with its ``replica_id``
+    label: unlabeled series gain ``{replica_id="rid"}``; series that
+    already carry labels (the mesh-serving per-slice KV gauges,
+    ``serving.kv.*{slice="i"}``) keep their own labels and gain
+    ``replica_id`` beside them — without this, two replicas' slice
+    series would collide in the federated exposition. ``replica_info``
+    rides as-is (its labels ARE the identity)."""
+    out = {}
+    for key, e in parsed.items():
+        name = e.get("name", key)
+        if name == "replica_info":
+            out[key] = e
+            continue
+        labels = {**(e.get("labels") or {}), "replica_id": rid}
+        e2 = _deep_hist(e) if e.get("type") == "histogram" else dict(e)
+        e2["labels"] = labels
+        out[name + _export._labelblock(labels)] = e2
+    return out
 
 
 def percentile_from_buckets(buckets, q):
@@ -718,16 +744,7 @@ class FleetAggregator:
             fleet = dict(st["fleet"])
         expo = {}
         for rid in sorted(per_replica):
-            for key, e in per_replica[rid].items():
-                name = e.get("name", key)
-                if e.get("labels"):
-                    expo[key] = e  # replica_info rides as-is
-                    continue
-                labels = {"replica_id": rid}
-                e2 = _deep_hist(e) if e.get("type") == "histogram" \
-                    else dict(e)
-                e2["labels"] = labels
-                expo[name + _export._labelblock(labels)] = e2
+            expo.update(label_replica(per_replica[rid], rid))
         expo.update(merged)
         for k, v in fleet.items():
             expo[f"fleet_{k}"] = {"type": "gauge", "name": f"fleet_{k}",

@@ -43,7 +43,36 @@ import time
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "snapshot", "dump", "reset", "registry",
            "thread_compile_seconds", "replica_identity",
-           "set_replica_id"]
+           "set_replica_id", "label_key"]
+
+
+def _esc_label_value(v):
+    """Label-value escaping per the exposition format (backslash,
+    double quote, newline). The canonical implementation lives here —
+    ``profiler.export`` aliases it (export depends on this module, so
+    the reverse import would cycle)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _label_body(labels):
+    """Sorted-key, escaped ``k="v",...`` body of a label block — the
+    one canonical form shared by :func:`label_key` and the exposition
+    renderer (``profiler.export._labelblock``)."""
+    return ",".join(f'{k}="{_esc_label_value(v)}"'
+                    for k, v in sorted(labels.items()))
+
+
+def label_key(name, labels):
+    """Canonical registry key for a labeled series:
+    ``name{k="v",...}`` with sorted keys and escaped values — the same
+    label-block canonicalization ``profiler.export`` renders and
+    parses (modulo its dot->underscore metric-name mangling), so a
+    labeled gauge round-trips through a scrape with its labels
+    intact."""
+    if not labels:
+        return name
+    return name + "{" + _label_body(labels) + "}"
 
 
 # -- replica identity ------------------------------------------------------
@@ -137,12 +166,22 @@ class Counter:
 
 
 class Gauge:
-    """Last-write-wins level (cache sizes, live bytes, ...)."""
+    """Last-write-wins level (cache sizes, live bytes, ...).
 
-    __slots__ = ("name", "_value", "_lock")
+    ``labels`` (optional, a flat str dict) makes this a LABELED series:
+    the registry keys it as ``name{k="v",...}`` (the exposition-format
+    key ``profiler.export.parse_prometheus`` produces), the exporter
+    renders the label block on the sample line, and fleet federation
+    treats it like a replica-labeled series — per-origin by definition,
+    never summed into a fleet aggregate. The mesh-sharded serving
+    layer's per-slice KV gauges (``serving.kv.*{slice="i"}``) are the
+    first user (docs/OBSERVABILITY.md)."""
 
-    def __init__(self, name):
+    __slots__ = ("name", "labels", "_value", "_lock")
+
+    def __init__(self, name, labels=None):
         self.name = name
+        self.labels = dict(labels) if labels else None
         self._value = 0
         self._lock = threading.Lock()
 
@@ -306,8 +345,27 @@ class Registry:
     def counter(self, name):
         return self._get(name, Counter)
 
-    def gauge(self, name):
-        return self._get(name, Gauge)
+    def gauge(self, name, labels=None):
+        """Get-or-create a gauge; ``labels`` (flat str dict) registers
+        a LABELED series keyed ``name{k="v",...}`` — the canonical form
+        ``profiler.export`` renders and parses, so a snapshot/scrape of
+        a labeled gauge round-trips with its labels intact. The
+        instrument's ``.name`` stays the BASE name; only the registry
+        key carries the label block."""
+        if not labels:
+            return self._get(name, Gauge)
+        key = label_key(name, labels)
+        m = self._metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(key)
+                if m is None:
+                    m = self._metrics[key] = Gauge(name, labels=labels)
+        if not isinstance(m, Gauge):
+            raise TypeError(
+                f"metric {key!r} already registered as "
+                f"{type(m).__name__}, not Gauge")
+        return m
 
     def histogram(self, name, bounds=_DEFAULT_BOUNDS):
         return self._get(name, Histogram, bounds=bounds)

@@ -30,6 +30,7 @@ contract, and the metric catalog.
 """
 
 from . import aot_cache  # noqa: F401
+from . import mesh  # noqa: F401
 from . import overload  # noqa: F401
 from . import spec  # noqa: F401
 from .bucketing import bucket_length, bucket_lengths  # noqa: F401
@@ -44,5 +45,5 @@ __all__ = ["ServingEngine", "RequestHandle", "RequestStatus",
            "QueueFullError", "AdmissionRejected", "Lifecycle",
            "NotReadyError", "Scheduler", "ServingRequest",
            "Router", "RouterReplica", "RoutedHandle",
-           "NoReplicaAvailable", "aot_cache", "overload",
+           "NoReplicaAvailable", "aot_cache", "overload", "mesh",
            "bucket_length", "bucket_lengths"]

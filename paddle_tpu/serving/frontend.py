@@ -196,7 +196,7 @@ class ServingEngine:
                  prefill_token_budget=None, max_queue=None,
                  bucket_cap=None, prefix_cache=None, accounting=None,
                  admission=None, brownout=None, kv_cache_dtype=None,
-                 spec=None, spec_tokens=None,
+                 spec=None, spec_tokens=None, mesh=None,
                  background=True, ready=True):
         self._state = Lifecycle.WARMING
         self._sched = Scheduler(
@@ -208,7 +208,7 @@ class ServingEngine:
             prefix_cache=prefix_cache, accounting=accounting,
             admission=admission, brownout=brownout,
             kv_cache_dtype=kv_cache_dtype, spec=spec,
-            spec_tokens=spec_tokens)
+            spec_tokens=spec_tokens, mesh=mesh)
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._background = background

@@ -364,7 +364,11 @@ class OverloadController:
         parts = [0.0]
         if sched.max_queue:
             parts.append(q / max(self.queue_frac * sched.max_queue, 1.0))
-        occ = sched.cache.occupancy()
+        # mesh-sliced caches: the KV watermark reads the BINDING slice
+        # (the one the next admission would land on) — aggregate
+        # headroom is a lie when the binding slice is full. Unsliced
+        # caches return None -> the aggregate, byte-for-byte pre-mesh.
+        occ = sched.cache.occupancy(slice=sched.cache.binding_slice())
         if occ["usable"]:
             parts.append((occ["active"] / occ["usable"]) / self.kv_frac)
         if self.model.primed:

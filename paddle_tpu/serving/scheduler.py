@@ -69,6 +69,7 @@ from ..profiler import accounting as _accounting
 from ..profiler import alerts as _alerts
 from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
+from . import mesh as _mesh
 from . import overload as _overload
 from . import spec as _spec
 from .bucketing import bucket_length
@@ -233,7 +234,7 @@ class Scheduler:
                  prefill_token_budget=None, max_queue=None,
                  bucket_cap=None, prefix_cache=None, accounting=None,
                  admission=None, brownout=None, kv_cache_dtype=None,
-                 spec=None, spec_tokens=None):
+                 spec=None, spec_tokens=None, mesh=None):
         import jax.numpy as jnp
 
         cfg = model.config
@@ -242,6 +243,16 @@ class Scheduler:
         self.eos_token_id = eos_token_id
         self.max_seq_len = max_seq_len
         mbps = math.ceil(max_seq_len / block_size)
+        # mesh-sharded serving (FLAGS_serving_mesh, read ONCE at
+        # construction like prefix_cache): the model axis tensor-
+        # parallels params + KV pools via NamedSharding, the data axis
+        # partitions slots/blocks into capacity slices; None (the
+        # default '' / '1x1') is byte-for-byte single-device serving
+        # with serving.mesh.* silence (serving/mesh.py)
+        self.mesh = _mesh.resolve_serving_mesh(mesh)
+        if self.mesh is not None:
+            self.model.apply_serving_mesh(self.mesh)
+            _mesh.note_engine(self.mesh)
         # int8 KV block storage (FLAGS_kv_cache_dtype, read ONCE at
         # construction like prefix_cache): default pool sizing grows by
         # the honest byte ratio — the same HBM budget holds ~2x the
@@ -257,7 +268,21 @@ class Scheduler:
             cfg.num_layers, cfg.num_kv_heads, hd,
             num_blocks=num_blocks,
             block_size=block_size, max_blocks_per_seq=mbps,
-            max_batch=max_batch, dtype=compute_dt, kv_dtype=kv_dtype)
+            max_batch=max_batch, dtype=compute_dt, kv_dtype=kv_dtype,
+            pool_sharding=(self.mesh.kv_pool_sharding()
+                           if self.mesh is not None else None),
+            scale_sharding=(self.mesh.kv_scale_sharding()
+                            if self.mesh is not None else None),
+            num_slices=(self.mesh.data if self.mesh is not None else 1))
+        # per-slice KV gauges (slice-id label; docs/OBSERVABILITY.md):
+        # registered only when the mesh is armed, so the disarmed
+        # exposition is byte-for-byte pre-mesh
+        self._slice_gauges = [
+            {k: _metrics.gauge(f"serving.kv.{k}", labels={"slice": str(i)})
+             for k in ("active_blocks", "free_blocks", "shared_blocks",
+                       "cached_blocks")}
+            for i in range(self.cache.num_slices)] \
+            if self.mesh is not None else []
         if self.cache.quantized:
             _g_kv_quant_bits.set(8)
             _g_kv_quant_mult.set(round(
@@ -890,6 +915,16 @@ class Scheduler:
         _g_util.set(round(used / usable, 4) if usable else 0.0)
         _g_shared.set(self.cache.num_shared_blocks())
         _g_cached.set(self.cache.num_cached_blocks())
+        # mesh-armed engines also publish the per-slice breakdown
+        # (slice-labeled gauges; per-slice sums == the aggregates
+        # above, pinned by tests/framework/test_mesh_serving.py)
+        if self._slice_gauges:
+            for i, occ in enumerate(self.cache.occupancy_slices()):
+                g = self._slice_gauges[i]
+                g["active_blocks"].set(occ["active"])
+                g["free_blocks"].set(occ["free"])
+                g["shared_blocks"].set(occ["shared"])
+                g["cached_blocks"].set(occ["cached_free"])
         # armed accounting also keeps the occupancy-breakdown gauges
         # (active/free/pool-bytes) + throttled HBM sampling fresh
         self.accounting.update_capacity(self.cache)

@@ -24,6 +24,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 window (ROADMAP.md runs "
+        "pytest -m 'not slow'); covered by the tools/ gates instead")
+
+
 @pytest.fixture(autouse=True)
 def _seed_all():
     import paddle_tpu as paddle

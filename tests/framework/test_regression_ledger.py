@@ -100,6 +100,22 @@ def test_direction_policy():
     assert not regs
 
 
+def test_mesh_serve_direction_policy():
+    """PR 15 satellite: the mesh_serve rung's tokens/s and
+    tokens/s/device ride the EXISTING down-is-worse rate rules (the
+    `_per_s` suffix) — no bespoke policy to rot."""
+    assert regression_gate.direction_and_tol("mesh_d8_tokens_per_s") \
+        == ("down", regression_gate.RATE_TOL)
+    assert regression_gate.direction_and_tol(
+        "mesh_d8_tokens_per_device_per_s") \
+        == ("down", regression_gate.RATE_TOL)
+    history = [{"mesh_d8_tokens_per_s": 100.0}] * 5
+    regs, _ = regression_gate.compare(
+        {"mesh_d8_tokens_per_s":
+         100.0 * (1 - regression_gate.RATE_TOL) * 0.9}, history)
+    assert [r["metric"] for r in regs] == ["mesh_d8_tokens_per_s"]
+
+
 def test_eager_gap_direction_policy():
     """PR 10 satellite: the eager-gap trajectory is gate-pinned — the
     ratio regresses UP (explicit rule: the generic suffixes would not

@@ -203,3 +203,22 @@ def test_overload_surfaces_map_to_their_tests():
     assert "tests/framework/test_accounting.py" in t
     t = suite_gate.targets_for(["tools/overload_gate.py"])
     assert "tests/framework/test_overload.py" in t
+
+
+def test_mesh_serving_surfaces_map_to_their_tests():
+    # mesh-sharded serving (ISSUE 15): the mesh module, the sliced
+    # cache, the sharded llama entry points, the training-side mesh
+    # validation, and the gate all run the mesh suite
+    t = suite_gate.targets_for(["paddle_tpu/serving/mesh.py"])
+    assert "tests/framework/test_mesh_serving.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/serving/scheduler.py"])
+    assert "tests/framework/test_mesh_serving.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/inference/paged.py"])
+    assert "tests/framework/test_mesh_serving.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/models/llama.py"])
+    assert "tests/framework/test_mesh_serving.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/distributed/mesh.py"])
+    assert "tests/framework/test_mesh_serving.py" in t
+    assert "tests/distributed" in t
+    t = suite_gate.targets_for(["tools/mesh_gate.py"])
+    assert "tests/framework/test_mesh_serving.py" in t

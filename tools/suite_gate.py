@@ -43,16 +43,20 @@ _MAP = [
      ["tests/framework/test_spec_decode.py"]),
     ("paddle_tpu/serving/scheduler.py",
      ["tests/framework/test_spec_decode.py"]),
+    ("paddle_tpu/serving/mesh.py",
+     ["tests/framework/test_mesh_serving.py"]),
     ("paddle_tpu/serving/", ["tests/framework/test_serving.py",
                              "tests/framework/test_prefix_cache.py",
                              "tests/framework/test_fleet_observatory.py",
                              "tests/framework/test_router.py",
-                             "tests/framework/test_overload.py"]),
+                             "tests/framework/test_overload.py",
+                             "tests/framework/test_mesh_serving.py"]),
     ("paddle_tpu/inference/", ["tests/framework/test_paged_decode.py",
                                "tests/framework/test_serving.py",
                                "tests/framework/test_prefix_cache.py",
                                "tests/framework/test_spec_decode.py",
-                               "tests/framework/test_quantization.py"]),
+                               "tests/framework/test_quantization.py",
+                               "tests/framework/test_mesh_serving.py"]),
     ("paddle_tpu/quantization/",
      ["tests/framework/test_quantization.py",
       "tests/framework/test_spec_decode.py"]),
@@ -62,7 +66,8 @@ _MAP = [
       "tests/framework/test_serving.py",
       "tests/framework/test_fleet_observatory.py",
       "tests/framework/test_router.py",
-      "tests/framework/test_spec_decode.py"]),
+      "tests/framework/test_spec_decode.py",
+      "tests/framework/test_mesh_serving.py"]),
     ("paddle_tpu/models/generation.py",
      ["tests/framework/test_serving.py",
       "tests/framework/test_paged_decode.py",
@@ -79,6 +84,8 @@ _MAP = [
       "tests/framework/test_chaos.py",
       "tests/framework/test_router.py"]),
     ("paddle_tpu/nn/", ["tests/nn", "tests/test_oracle_sweep_api.py"]),
+    ("paddle_tpu/distributed/mesh.py",
+     ["tests/framework/test_mesh_serving.py", "tests/distributed"]),
     ("paddle_tpu/distributed/", ["tests/distributed"]),
     ("paddle_tpu/fleet/", ["tests/distributed"]),
     ("paddle_tpu/kernels/", ["tests/kernels"]),
@@ -121,6 +128,7 @@ _MAP = [
     ("tools/overload_gate.py", ["tests/framework/test_overload.py"]),
     ("tools/spec_gate.py", ["tests/framework/test_spec_decode.py",
                             "tests/framework/test_quantization.py"]),
+    ("tools/mesh_gate.py", ["tests/framework/test_mesh_serving.py"]),
     ("tools/bench_ledger.py",
      ["tests/framework/test_regression_ledger.py"]),
     ("tools/regression_gate.py",
