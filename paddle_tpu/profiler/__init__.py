@@ -21,7 +21,8 @@ from enum import Enum
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
            "load_profiler_result", "SortedKeys", "SummaryView", "metrics",
-           "tracing", "export", "accounting", "alerts", "fleet"]
+           "tracing", "export", "accounting", "alerts", "fleet",
+           "scorecard", "summary_text"]
 
 
 class ProfilerState(Enum):
@@ -110,6 +111,11 @@ from . import accounting, alerts  # noqa: E402,F401
 # fleet observatory: replica registry + cross-replica federation +
 # health scoring (ServingEngine.serve_metrics(store=) registers into it)
 from . import fleet  # noqa: E402,F401
+
+# scenario scoreboard: loadgen scenarios graded against a multi-replica
+# fleet through scenario-scoped Windows (engines only pulled in once a
+# FleetHarness is actually built)
+from . import scorecard  # noqa: E402,F401
 
 
 class RecordEvent:
@@ -407,6 +413,54 @@ def _cold_start_view(snap):
     return lines
 
 
+def _scorecard_view():
+    """"Scenario scorecard" summary section: the latest fleet-invariant
+    scoreboard published by profiler/scorecard.py (run_scenario /
+    record) — per-phase arrivals, goodput, windowed TTFT p95, prefix
+    hit-rate, and each invariant's verdict. Empty until a scenario ran
+    in this process. Lazy import: scorecard pulls serving modules the
+    summary must not force-load."""
+    try:
+        from . import scorecard
+        return scorecard.summary_lines()
+    except Exception:  # noqa: BLE001 — summary must render regardless
+        return []
+
+
+def summary_text():
+    """The registry-driven half of :meth:`Profiler.summary` — the
+    serving/SLO table plus every always-on section (capacity, goodput,
+    overload, cold start, scenario scorecard, incidents) — WITHOUT a
+    Profiler instance or op events. This is what the MetricsServer's
+    ``/summary`` endpoint serves, so an operator reads the human view
+    with curl instead of a Python shell."""
+    lines = []
+    serving = metrics.snapshot("serving.")
+    if serving and serving.get("serving.steps"):
+        lines.append("{:-^72}".format(" Serving / SLO View "))
+        lines.append("{:<36} {}".format("metric", "value"))
+        for name in sorted(serving):
+            v = serving[name]
+            if isinstance(v, dict):
+                desc = f"count={v['count']}"
+                if v["count"]:
+                    desc += (f" avg={v['avg']:.6g} min={v['min']:.6g}"
+                             f" max={v['max']:.6g} p50={v['p50']:.6g}"
+                             f" p95={v['p95']:.6g} p99={v['p99']:.6g}")
+            else:
+                desc = str(v)
+            lines.append("{:<36} {}".format(name, desc))
+        lines.extend(_slow_requests_view(serving))
+    full_snap = metrics.snapshot()
+    lines.extend(_capacity_view(full_snap))
+    lines.extend(_goodput_view(full_snap))
+    lines.extend(_overload_view(full_snap))
+    lines.extend(_cold_start_view(full_snap))
+    lines.extend(_scorecard_view())
+    lines.extend(_recent_incidents_view())
+    return "\n".join(lines)
+
+
 def _recent_incidents_view(limit=10):
     """"Recent incidents" summary section: the watchdog flight-recorder
     ring (degrade / preempt / retry / quarantine events recorded by
@@ -685,6 +739,7 @@ class Profiler:
         lines.extend(_goodput_view(full_snap))
         lines.extend(_overload_view(full_snap))
         lines.extend(_cold_start_view(full_snap))
+        lines.extend(_scorecard_view())
         lines.extend(_recent_incidents_view())
         if self._memory_samples:
             # MemoryView (reference profiler_statistic.py memory table)

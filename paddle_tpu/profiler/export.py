@@ -23,6 +23,9 @@ or Prometheus scraper needs it on a wire. Three pieces:
                          DRAINING / CLOSED) — distinct from liveness
   ``/alerts``            JSON active/resolved SLO burn-rate incidents
                          (profiler/alerts.py AlertManager, when attached)
+  ``/summary``           the profiler.summary_text() human view (plain
+                         text; serving/SLO, capacity, overload, and
+                         scenario-scorecard sections included)
   ``/traces``            whole span ring, Chrome/Perfetto JSON
   ``/traces/<trace_id>`` one trace, Chrome/Perfetto JSON (404 unknown)
   =====================  ==============================================
@@ -263,8 +266,9 @@ def parse_prometheus(text):
     return out
 
 
-def _le_sort_key(le):
-    return float("inf") if le in ("+Inf", "+inf") else float(le)
+# canonical implementation lives beside the bucket-percentile math in
+# profiler.metrics (the Window needs both; metrics can't import us)
+_le_sort_key = _metrics._le_sort_key
 
 
 def render_parsed(parsed):
@@ -458,6 +462,13 @@ class MetricsServer:
                             body = {"attached": True, **mgr.as_dict()}
                         self._send(200, json.dumps(body),
                                    "application/json")
+                    elif path == "/summary":
+                        # the human view (scorecard section included)
+                        # without a Python shell; lazy import — the
+                        # profiler package imports this module
+                        from . import summary_text
+                        self._send(200, summary_text(),
+                                   "text/plain; charset=utf-8")
                     elif path == "/traces":
                         self._send(200,
                                    json.dumps(_tracing.export_ring()),

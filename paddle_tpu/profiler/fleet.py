@@ -356,34 +356,11 @@ def label_replica(parsed, rid):
     return out
 
 
-def percentile_from_buckets(buckets, q):
-    """q-quantile (0..1) from a CUMULATIVE bucket map ``{le_label:
-    cumulative_count}`` (the exposition/merged form): linear
-    interpolation inside the covering bucket, 0-floored (an exposition
-    carries no observed min) and clamped to the last finite bound for
-    the +inf bucket. None on an empty histogram. Pure — the fleet SLO
-    percentiles and the skew rule are deterministic on a fixed
-    merged scrape."""
-    items = sorted((_export._le_sort_key(le), c)
-                   for le, c in (buckets or {}).items())
-    if not items:
-        return None
-    total = items[-1][1]
-    if not total:
-        return None
-    target = q * total
-    prev_bound, prev_cum, last_finite = 0.0, 0, 0.0
-    for bound, cum in items:
-        finite = bound != float("inf")
-        if cum >= target:
-            n = cum - prev_cum
-            frac = (target - prev_cum) / n if n else 1.0
-            hi = bound if finite else max(prev_bound, last_finite)
-            return prev_bound + (hi - prev_bound) * frac
-        if finite:
-            last_finite = bound
-        prev_bound, prev_cum = (bound if finite else prev_bound), cum
-    return last_finite
+# the bucket-interpolation math lives in profiler/metrics.py now (the
+# scenario Window needs it too, and metrics is the import-cycle-safe
+# home); re-exported here because the fleet observatory published it
+# first and callers/tests pin this name
+percentile_from_buckets = _metrics.percentile_from_buckets
 
 
 # -- health scoring (pure) -------------------------------------------------

@@ -43,7 +43,8 @@ import time
 __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge",
            "histogram", "snapshot", "dump", "reset", "registry",
            "thread_compile_seconds", "replica_identity",
-           "set_replica_id", "label_key"]
+           "set_replica_id", "label_key", "Window", "window_delta",
+           "cumulative_buckets", "percentile_from_buckets"]
 
 
 def _esc_label_value(v):
@@ -436,6 +437,184 @@ histogram = registry.histogram
 snapshot = registry.snapshot
 dump = registry.dump
 reset = registry.reset
+
+
+# -- scenario-scoped measurement: Window over the always-on registry -------
+# The registry is process-global and always on; a load scenario that
+# wants "TTFT p95 during THIS burst phase" must not reset() it (other
+# phases, gates, and the exporter read the same counters). A Window is
+# a snapshot-diff: open it at phase start, freeze it at phase end, and
+# every read sees exactly the slice of activity between the two — the
+# measurement primitive profiler/scorecard.py and the fleet-load gate
+# are built on (docs/OBSERVABILITY.md "Scenario observatory").
+
+
+def _le_sort_key(le):
+    """Numeric sort key for a bucket's ``le`` label. Canonical home —
+    ``profiler.export`` and ``profiler.fleet`` alias this (both depend
+    on this module, so the reverse import would cycle)."""
+    return float("inf") if le in ("+Inf", "+inf") else float(le)
+
+
+def cumulative_buckets(buckets):
+    """Per-bucket ``{le: count}`` (the snapshot form) to CUMULATIVE
+    ``{le: cum_count}`` (the exposition/merged form
+    :func:`percentile_from_buckets` consumes), ordered by bound."""
+    items = sorted((_le_sort_key(le), le, c)
+                   for le, c in (buckets or {}).items())
+    out, cum = {}, 0
+    for _, le, c in items:
+        cum += c
+        out[le] = cum
+    return out
+
+
+def percentile_from_buckets(buckets, q):
+    """q-quantile (0..1) from a CUMULATIVE bucket map ``{le_label:
+    cumulative_count}`` (the exposition/merged form): linear
+    interpolation inside the covering bucket, 0-floored (an exposition
+    carries no observed min) and clamped to the last finite bound for
+    the +inf bucket. None on an empty histogram. Pure — fleet SLO
+    percentiles, the skew rule, and Window percentiles are
+    deterministic on fixed bucket maps. (Hoisted from profiler/fleet.py
+    — the ONE bucket-interpolation implementation; fleet re-exports
+    it.)"""
+    items = sorted((_le_sort_key(le), c)
+                   for le, c in (buckets or {}).items())
+    if not items:
+        return None
+    total = items[-1][1]
+    if not total:
+        return None
+    target = q * total
+    prev_bound, prev_cum, last_finite = 0.0, 0, 0.0
+    for bound, cum in items:
+        finite = bound != float("inf")
+        if cum >= target:
+            n = cum - prev_cum
+            frac = (target - prev_cum) / n if n else 1.0
+            hi = bound if finite else max(prev_bound, last_finite)
+            return prev_bound + (hi - prev_bound) * frac
+        if finite:
+            last_finite = bound
+        prev_bound, prev_cum = (bound if finite else prev_bound), cum
+    return last_finite
+
+
+def _hist_delta(cur, prev):
+    """Windowed slice of one histogram snapshot dict. Buckets/count/sum
+    are exact diffs (closure: window + pre-window == total, bucket by
+    bucket); min/max are not recoverable from two snapshots so the
+    delta reports the window's bucket-derived percentiles instead and
+    leaves min/max None. A reset() between the snapshots makes a diff
+    go negative — the window then treats ``cur`` as a fresh start."""
+    pb = prev.get("buckets") if isinstance(prev, dict) else None
+    buckets = {le: c - (pb.get(le, 0) if pb else 0)
+               for le, c in cur["buckets"].items()}
+    count = cur["count"] - (prev["count"] if isinstance(prev, dict) else 0)
+    total = cur["sum"] - (prev["sum"] if isinstance(prev, dict) else 0)
+    if count < 0 or any(v < 0 for v in buckets.values()):
+        buckets = dict(cur["buckets"])
+        count, total = cur["count"], cur["sum"]
+    cum = cumulative_buckets(buckets)
+    return {"count": count, "sum": total,
+            "avg": (total / count) if count else None,
+            "min": None, "max": None,
+            "p50": percentile_from_buckets(cum, 0.50),
+            "p95": percentile_from_buckets(cum, 0.95),
+            "p99": percentile_from_buckets(cum, 0.99),
+            "buckets": buckets}
+
+
+def window_delta(before, after):
+    """Pure snapshot diff ``after - before`` over two :func:`snapshot`
+    maps: scalars (counters AND gauges) become numeric deltas,
+    histograms become windowed dicts (:func:`_hist_delta` — bucket-wise
+    diffs plus window percentiles). Metrics born after ``before`` diff
+    against zero. Scalar deltas are SIGNED (gauges legitimately fall;
+    a counter going negative means a reset() landed between the
+    snapshots — the one case where closure cannot hold, because data
+    was destroyed). Exemplars are point-in-time, not diffable, and are
+    dropped."""
+    out = {}
+    for name, cur in after.items():
+        prev = before.get(name)
+        if isinstance(cur, dict):
+            out[name] = _hist_delta(cur, prev)
+        else:
+            prev_v = prev if isinstance(prev, (int, float)) else 0
+            out[name] = cur - prev_v
+    return out
+
+
+class Window:
+    """Scenario-scoped view of the registry: ``Window(prefix)`` pins a
+    base snapshot; :meth:`freeze` pins the end; every read diffs the
+    two (or diffs live against the base while unfrozen). Global state
+    is never reset — any number of overlapping windows observe the
+    same registry, each seeing exactly its own slice.
+
+        w = metrics.Window("serving.")
+        ... drive one scenario phase ...
+        w.freeze()
+        w.value("serving.admitted")            # counter delta
+        w.percentile("serving.ttft_us", 0.95)  # windowed p95
+    """
+
+    def __init__(self, prefix=None, label=None):
+        self.prefix = prefix
+        self.label = label
+        self.start_ts = time.time()
+        self.end_ts = None
+        self._base = registry.snapshot(prefix)
+        self._end = None
+
+    def freeze(self):
+        """Pin the window's end snapshot (idempotent); reads stop
+        tracking the live registry. Returns self for chaining."""
+        if self._end is None:
+            self._end = registry.snapshot(self.prefix)
+            self.end_ts = time.time()
+        return self
+
+    @property
+    def frozen(self):
+        return self._end is not None
+
+    def elapsed_s(self):
+        return (self.end_ts or time.time()) - self.start_ts
+
+    def base(self):
+        """The base snapshot (plain data, already isolated)."""
+        return self._base
+
+    def delta(self):
+        """``window_delta(base, end-or-live)`` — the full windowed
+        view: scalar deltas + histogram slices."""
+        end = self._end if self._end is not None \
+            else registry.snapshot(self.prefix)
+        return window_delta(self._base, end)
+
+    def value(self, name, default=0):
+        """Scalar delta of one counter/gauge (``default`` when the
+        metric never appeared)."""
+        v = self.delta().get(name, default)
+        return v if isinstance(v, (int, float)) else default
+
+    def hist(self, name):
+        """Windowed histogram dict for ``name`` (None when absent or
+        not a histogram)."""
+        v = self.delta().get(name)
+        return v if isinstance(v, dict) else None
+
+    def percentile(self, name, q):
+        """Windowed q-quantile of histogram ``name`` — exactly the
+        observations that landed inside this window. None when the
+        window saw none."""
+        h = self.hist(name)
+        if not h:
+            return None
+        return percentile_from_buckets(cumulative_buckets(h["buckets"]), q)
 
 
 # -- XLA compile telemetry (jax.monitoring) --------------------------------

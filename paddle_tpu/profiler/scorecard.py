@@ -1,0 +1,452 @@
+"""Fleet-invariant scoreboard: run a loadgen scenario against an
+in-process multi-replica fleet and grade each phase through
+scenario-scoped metric Windows.
+
+The control planes each shipped with their own contract — drains drop
+nothing (PR 11), failover lands every request exactly once (PR 12),
+the shed ladder protects high-priority goodput (PR 13), the prefix
+cache turns shared openings into block hits (PR 8) — but every gate
+proved its contract in isolation, on a hand-rolled corpus. This module
+composes them: a :class:`FleetHarness` (N ``ServingEngine`` replicas
+behind the ``Router``, overload plane armed) is driven by a
+``serving.loadgen`` scenario, each phase measured by its own
+``metrics.Window`` (never a registry reset — phases see exactly their
+own slice), and the invariants are evaluated per phase:
+
+- ``all_terminal``   every accepted request reaches a terminal status
+                     (nothing is ever silently lost) — every phase;
+- ``goodput_floor``  HIGH-class DONE fraction >= floor — any phase
+                     that carried HIGH arrivals;
+- ``zero_drop``      no accepted request ends ERROR or unresolved —
+                     phases with a ``drain:<rid>`` action;
+- ``exactly_once``   failover count == requests that moved replicas,
+                     each landing DONE — phases with a ``kill:<rid>``
+                     action;
+- ``prefix_hit_rate`` windowed block hit-rate >= floor — phases whose
+                     workload has shared-prefix locality.
+
+Plus per-phase TTFT/ITL windowed percentiles and SLO burn (the same
+bad-fraction/(1-target) math as profiler/alerts.py, over the window's
+bucket deltas). The result is a structured per-phase scorecard dict:
+:func:`record` keeps the latest for ``profiler.summary()``'s
+"Scenario scorecard" section, :func:`fleet_load_metrics` flattens it
+for the ``fleet_load`` ledger kind (tools/bench_ledger.py), and
+``tools/fleet_load_gate.py`` turns it into CI pass/fail.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..core import flags as flags_mod
+from . import metrics
+
+__all__ = ["FleetHarness", "run_scenario", "record", "latest",
+           "fleet_load_metrics", "summary_lines", "slo_burn",
+           "DEFAULT_FLOORS"]
+
+# pass/fail floors the gate (and any caller) can override per run
+DEFAULT_FLOORS = {
+    "high_goodput": 0.9,      # HIGH-class DONE fraction under shed
+    "prefix_hit_rate": 0.3,   # windowed block hit-rate under locality
+}
+
+_TERMINAL = ("DONE", "CANCELLED", "TIMEOUT", "SHED", "ERROR")
+_CLEAN = ("DONE", "CANCELLED", "TIMEOUT", "SHED")
+
+_c_runs = metrics.counter("scorecard.runs")
+_c_failed = metrics.counter("scorecard.invariant_failures")
+_g_last_ok = metrics.gauge("scorecard.last_ok")
+
+_lock = threading.Lock()
+_last_card = None
+
+
+class FleetHarness:
+    """N in-process replicas behind one Router — the PR 11-13 stack as
+    a test fixture. Engines run in BACKGROUND mode (failover and drain
+    need a live driver thread under each replica); greedy decode keeps
+    outputs deterministic regardless of thread interleaving."""
+
+    def __init__(self, model, n_replicas=2, rid_prefix="sc", **engine_kw):
+        from ..serving import Router, ServingEngine
+
+        engine_kw.setdefault("max_batch", 2)
+        engine_kw.setdefault("block_size", 8)
+        engine_kw.setdefault("max_seq_len", 64)
+        engine_kw.setdefault("temperature", 0.0)
+        engine_kw.setdefault("bucket_cap", 32)
+        engine_kw.setdefault("max_queue", 64)
+        engine_kw.setdefault("background", True)
+        self.router = Router()
+        self.engines = {}
+        for i in range(int(n_replicas)):
+            rid = f"{rid_prefix}{i}"
+            eng = ServingEngine(model, **engine_kw)
+            self.engines[rid] = eng
+            self.router.add_replica(rid, engine=eng)
+        self._killed = set()
+        self._pending = []
+
+    def shed_tune(self, min_queue=3, queue_frac=0.125):
+        """Drop every replica's shed trip-point so a storm actually
+        sheds at test scale (the defaults are sized for production
+        queues) — same knobs tools/overload_gate.py turns."""
+        for eng in self.engines.values():
+            ov = eng.scheduler.overload
+            ov.min_queue = min_queue
+            ov.queue_frac = queue_frac
+
+    def prime(self, prompt_lens=(5, 9), max_new_tokens=2, seed=97):
+        """Warm every replica's jit programs and the overload plane's
+        service-time model, so phase windows measure steady-state
+        serving rather than first-compile noise."""
+        import numpy as np
+
+        rng = np.random.default_rng(seed)
+        for eng in self.engines.values():
+            for n in prompt_lens:
+                h = eng.submit(rng.integers(0, 255, (n,)).astype("int64"),
+                               max_new_tokens=max_new_tokens)
+                h.result(timeout=300)
+
+    def kill(self, rid):
+        """Replica death the way a crashed device manifests: the next
+        scheduler step raises, the driver thread dies, in-flight
+        requests terminate ERROR — and RoutedHandle failover takes it
+        from there (the same injection tests/framework/test_router.py
+        pins)."""
+        eng = self.engines[rid]
+        self._killed.add(rid)
+        eng._sched.step = lambda: (_ for _ in ()).throw(
+            RuntimeError(f"injected replica death: {rid}"))
+
+    def drain(self, rid, timeout=120):
+        """Graceful drain through the Router (PR 11 contract: in-flight
+        finishes, readiness flips, new traffic redistributes)."""
+        self.router.drain(rid, timeout=timeout)
+
+    def drain_async(self, rid, timeout=120):
+        """Start a drain WITHOUT blocking the arrival stream — the
+        "drain mid-storm" shape: readiness flips immediately (the
+        drained replica stops taking new traffic) while the storm's
+        remaining arrivals keep submitting and redistribute live.
+        :meth:`join_pending` collects the outcome."""
+        box = {}
+
+        def _run():
+            try:
+                self.drain(rid, timeout=timeout)
+            except Exception as e:  # noqa: BLE001 — reported at join
+                box["error"] = e
+
+        t = threading.Thread(target=_run, daemon=True,
+                             name=f"scorecard-drain-{rid}")
+        t.start()
+        self._pending.append((t, box))
+
+    def join_pending(self, timeout=300):
+        """Wait for async actions; returns their errors (empty =
+        every pending drain completed cleanly)."""
+        errs = []
+        for t, box in self._pending:
+            t.join(timeout)
+            if t.is_alive():
+                errs.append(TimeoutError(
+                    f"pending action {t.name} still running"))
+            elif "error" in box:
+                errs.append(box["error"])
+        self._pending = []
+        return errs
+
+    def close(self):
+        for rid, eng in self.engines.items():
+            try:
+                eng.close()
+            except RuntimeError:
+                if rid not in self._killed:
+                    raise
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def slo_burn(hist_delta, budget_us, target=None):
+    """Error-budget burn over ONE window's histogram delta — the
+    alerts math (bad-fraction / (1 - target), budget snapped UP to the
+    nearest bucket bound) applied to a scenario slice instead of a
+    rate window. None when the window saw no observations."""
+    if not hist_delta or not hist_delta.get("count"):
+        return None
+    if target is None:
+        target = float(flags_mod.flag("FLAGS_slo_target"))
+    cum = metrics.cumulative_buckets(hist_delta["buckets"])
+    total = hist_delta["count"]
+    bounds = sorted((metrics._le_sort_key(le), c) for le, c in cum.items())
+    cutoff = min((b for b, _ in bounds if b >= float(budget_us)),
+                 default=float("inf"))
+    good = max((c for b, c in bounds if b <= cutoff), default=0)
+    bad_frac = max(0.0, 1.0 - good / total)
+    return bad_frac / max(1.0 - target, 1e-9)
+
+
+def _do_action(harness, action):
+    if not action:
+        return
+    verb, _, rid = str(action).partition(":")
+    if verb == "kill":
+        harness.kill(rid)
+    elif verb == "drain":
+        harness.drain_async(rid)
+    else:
+        raise ValueError(f"unknown scenario action {action!r}")
+
+
+def _pct_block(win, name):
+    h = win.hist(name)
+    if not h or not h.get("count"):
+        return None
+    return {"count": h["count"], "p50": h["p50"], "p95": h["p95"],
+            "p99": h["p99"]}
+
+
+def _run_phase(harness, phase, precs, floors, vocab, timeout_s):
+    """Drive one phase's records through the router, firing the
+    phase action at the arrival midpoint, then wait every accepted
+    handle to its terminal status BEFORE freezing the window — the
+    window covers the phase's decode work, not just its arrivals."""
+    from ..serving import loadgen
+
+    win = metrics.Window(label=phase.name)
+    placed, submitted = {}, [0]
+    midpoint = max(len(precs) // 2, 1)
+
+    def _submit(rec):
+        h = harness.router.submit(
+            loadgen.prompt_ids(rec, vocab),
+            max_new_tokens=rec.max_new_tokens,
+            priority=rec.priority, deadline_s=rec.deadline_s)
+        placed[id(h)] = h.replica_id
+        return h
+
+    def _between():
+        submitted[0] += 1
+        if submitted[0] == midpoint:
+            _do_action(harness, phase.action)
+
+    outcomes = loadgen.replay(precs, _submit, between=_between)
+    handles = [(rec, out) for rec, out in outcomes
+               if not isinstance(out, Exception)]
+    rejected = [(rec, out) for rec, out in outcomes
+                if isinstance(out, Exception)]
+    for _, h in handles:
+        try:
+            h.result(timeout=timeout_s)
+        except Exception:  # noqa: BLE001 — a SHED/TIMEOUT/exhausted-
+            # failover terminal is an OUTCOME the scorecard grades,
+            # never a harness crash
+            pass
+    action_errors = harness.join_pending()
+    win.freeze()
+    return _grade_phase(phase, win, handles, rejected, placed, floors,
+                        action_errors)
+
+
+def _grade_phase(phase, win, handles, rejected, placed, floors,
+                 action_errors=()):
+    statuses = {}
+    for _, h in handles:
+        statuses[h.status] = statuses.get(h.status, 0) + 1
+    moved = sum(1 for _, h in handles
+                if placed.get(id(h)) not in (None, h.replica_id))
+    high = [(rec, h) for rec, h in handles if rec.priority == 0]
+    high_done = sum(1 for _, h in high if h.status == "DONE")
+    goodput = (high_done / len(high)) if high else None
+    hits = win.value("serving.prefix.hit_blocks")
+    misses = win.value("serving.prefix.miss_blocks")
+    hit_rate = hits / (hits + misses) if (hits + misses) else None
+    ttft = win.hist("serving.ttft_us")
+    itl = win.hist("serving.itl_us")
+    card = {
+        "phase": phase.name,
+        "action": phase.action,
+        "arrivals": len(handles) + len(rejected),
+        "accepted": len(handles),
+        "rejected": len(rejected),
+        "statuses": statuses,
+        "shed": win.value("serving.shed"),
+        "failover": win.value("router.failover"),
+        "moved": moved,
+        "high_goodput": goodput,
+        "prefix_hit_rate": hit_rate,
+        "prefix_hits": hits,
+        "prefix_misses": misses,
+        "ttft_us": _pct_block(win, "serving.ttft_us"),
+        "itl_us": _pct_block(win, "serving.itl_us"),
+        "ttft_burn": slo_burn(
+            ttft, flags_mod.flag("FLAGS_slo_ttft_budget_us")),
+        "itl_burn": slo_burn(
+            itl, flags_mod.flag("FLAGS_slo_itl_budget_us")),
+        "elapsed_s": round(win.elapsed_s(), 4),
+        "action_errors": [repr(e) for e in action_errors],
+    }
+    inv = {}
+    lost = sum(1 for _, h in handles if h.status not in _TERMINAL)
+    inv["all_terminal"] = {"ok": lost == 0, "value": lost, "floor": 0}
+    if high:
+        floor = floors["high_goodput"]
+        inv["goodput_floor"] = {"ok": goodput >= floor,
+                                "value": round(goodput, 4),
+                                "floor": floor}
+    verb = str(phase.action or "").partition(":")[0]
+    if verb == "drain":
+        # zero-drop: every accepted request ends clean AND the drain
+        # itself completed gracefully (a died-mid-drain engine raises)
+        dropped = sum(1 for _, h in handles if h.status not in _CLEAN)
+        inv["zero_drop"] = {"ok": dropped == 0 and not action_errors,
+                            "value": dropped, "floor": 0}
+    if verb == "kill":
+        errors = sum(1 for _, h in handles if h.status == "ERROR")
+        ok = (card["failover"] == moved and moved >= 1 and errors == 0)
+        inv["exactly_once"] = {
+            "ok": ok, "value": {"failover": card["failover"],
+                                "moved": moved, "errors": errors},
+            "floor": "failover == moved >= 1, no ERROR terminals"}
+    if phase.workload.locality > 0:
+        floor = floors["prefix_hit_rate"]
+        inv["prefix_hit_rate"] = {
+            "ok": hit_rate is not None and hit_rate >= floor,
+            "value": None if hit_rate is None else round(hit_rate, 4),
+            "floor": floor}
+    card["invariants"] = inv
+    card["ok"] = all(v["ok"] for v in inv.values())
+    return card
+
+
+def run_scenario(harness, scenario, seed=0, *, floors=None, vocab=255,
+                 timeout_s=300):
+    """Schedule ``scenario`` at ``seed``, drive it phase by phase
+    through ``harness``, and return the structured scorecard::
+
+        {"scenario", "seed", "ok", "phases": [per-phase cards],
+         "invariants": {name: worst-case verdict across phases}}
+
+    The card is also :func:`record`-ed so ``profiler.summary()`` shows
+    it and the ``scorecard.*`` counters move."""
+    floors = {**DEFAULT_FLOORS, **(floors or {})}
+    records = scenario.schedule(seed)
+    by_phase = {}
+    for r in records:
+        by_phase.setdefault(r.phase, []).append(r)
+    phase_cards = [
+        _run_phase(harness, phase, by_phase.get(phase.name, []),
+                   floors, vocab, timeout_s)
+        for phase in scenario.phases]
+    rollup = {}
+    for pc in phase_cards:
+        for name, v in pc["invariants"].items():
+            cur = rollup.get(name)
+            if cur is None or (cur["ok"] and not v["ok"]):
+                rollup[name] = {**v, "phase": pc["phase"]}
+    card = {"scenario": scenario.name, "seed": int(seed),
+            "floors": floors, "phases": phase_cards,
+            "invariants": rollup,
+            "ok": all(pc["ok"] for pc in phase_cards)}
+    record(card)
+    return card
+
+
+def record(card):
+    """Publish a scorecard: keep it for :func:`latest` /
+    ``profiler.summary()`` and move the always-on ``scorecard.*``
+    counters (runs, invariant failures, last-run verdict)."""
+    global _last_card
+    with _lock:
+        _last_card = card
+    _c_runs.inc()
+    failed = sum(1 for pc in card.get("phases", [])
+                 for v in pc.get("invariants", {}).values()
+                 if not v["ok"])
+    if failed:
+        _c_failed.inc(failed)
+    _g_last_ok.set(1 if card.get("ok") else 0)
+    return card
+
+
+def latest():
+    """The most recent scorecard published in this process (None
+    before any :func:`run_scenario`/:func:`record`)."""
+    with _lock:
+        return _last_card
+
+
+def fleet_load_metrics(card):
+    """Flatten a scorecard into the ``fleet_load`` ledger shape
+    (tools/bench_ledger.py): floors-facing numbers only, worst-case
+    across phases, all flat floats so regression medians work."""
+    phases = card.get("phases", [])
+    goodputs = [pc["high_goodput"] for pc in phases
+                if pc.get("high_goodput") is not None]
+    # only phases GRADED on locality count toward the ledger floor: a
+    # no-locality phase legitimately reads 0.0 (all cold misses) and
+    # would poison the min
+    hit_rates = [pc["prefix_hit_rate"] for pc in phases
+                 if "prefix_hit_rate" in pc.get("invariants", {})
+                 and pc.get("prefix_hit_rate") is not None]
+    p95s = [pc["ttft_us"]["p95"] for pc in phases
+            if pc.get("ttft_us") and pc["ttft_us"].get("p95") is not None]
+    dropped = sum(pc["invariants"].get("zero_drop", {}).get("value", 0)
+                  for pc in phases)
+    out = {"scenario_ok": 1.0 if card.get("ok") else 0.0,
+           "phases": float(len(phases)),
+           "arrivals": float(sum(pc["arrivals"] for pc in phases)),
+           "accepted": float(sum(pc["accepted"] for pc in phases)),
+           "shed": float(sum(pc["shed"] for pc in phases)),
+           "failover": float(sum(pc["failover"] for pc in phases)),
+           "dropped": float(dropped)}
+    if goodputs:
+        out["high_goodput_frac"] = round(min(goodputs), 4)
+    if hit_rates:
+        out["prefix_hit_rate"] = round(min(hit_rates), 4)
+    if p95s:
+        out["ttft_p95_us"] = round(max(p95s), 1)
+    return out
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3g}"
+    return str(v)
+
+
+def summary_lines():
+    """The "Scenario scorecard" section for ``profiler.summary()`` —
+    empty (section hidden) until a scorecard ran in this process."""
+    card = latest()
+    if not card:
+        return []
+    lines = ["", "{:-^72}".format(" Scenario scorecard "),
+             "scenario {!r} seed {} — {}".format(
+                 card["scenario"], card["seed"],
+                 "PASS" if card["ok"] else "FAIL"),
+             "{:<10} {:>5} {:>5} {:>5} {:>8} {:>9} {:>9}  {}".format(
+                 "phase", "arr", "acc", "shed", "goodput", "ttft_p95",
+                 "hit_rate", "invariants")]
+    for pc in card["phases"]:
+        inv = " ".join(
+            f"{name}={'ok' if v['ok'] else 'FAIL'}"
+            for name, v in pc["invariants"].items())
+        ttft = pc.get("ttft_us") or {}
+        lines.append(
+            "{:<10} {:>5} {:>5} {:>5} {:>8} {:>9} {:>9}  {}".format(
+                pc["phase"][:10], pc["arrivals"], pc["accepted"],
+                pc["shed"], _fmt(pc["high_goodput"]),
+                _fmt(ttft.get("p95")), _fmt(pc["prefix_hit_rate"]),
+                inv))
+    return lines

@@ -1,0 +1,422 @@
+"""Trace-replay load generation: the workload side of the scenario
+observatory (ROADMAP "Production traffic simulator").
+
+Every gate before this drove hand-rolled corpora of a dozen prompts;
+the fleet behaviors that matter at scale — diurnal ramps, burst
+storms, heavy-tailed prompt lengths, shared-prefix locality, tenant
+skew — were unexercised. This module generates them, deterministically:
+
+- **Arrival processes** (``poisson`` / ``burst`` / ``ramp`` /
+  ``diurnal``), composable as :class:`Phase` s of a :class:`Scenario`.
+  Every arrival offset is a PURE function of ``(seed, index)`` (each
+  random draw comes from its own ``numpy`` PCG64 stream keyed on
+  exactly those two values), so two runs — or two processes — produce
+  byte-identical schedules (tests/framework/test_loadgen.py pins this).
+- **Heavy-tailed length samplers**: bounded-Pareto prompt/output
+  lengths (a few giants among many dwarfs — the shape that actually
+  stresses prefill budgeting and preemption).
+- **Locality & mix knobs**: shared-prefix locality (a fraction of
+  requests open with one of ``num_prefixes`` common prefixes —
+  zipf-skewed, so the prefix cache sees realistic reuse), tenant skew,
+  and a priority mix aligned with the overload plane's classes.
+- **Trace records** (:class:`TraceRecord`): the JSONL interchange
+  format — arrival offset, prompt spec, priority, deadline — so a
+  RECORDED production trace and a synthetic one drive the exact same
+  replay path (:func:`save_trace` / :func:`load_trace` round-trip,
+  :func:`replay` drives any submit callable in offset order).
+
+The scoreboard that consumes this lives in ``profiler/scorecard.py``;
+the CI gate in ``tools/fleet_load_gate.py``. Nothing here touches an
+engine: records are data, and :func:`replay` takes a callable.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import numpy as np
+
+__all__ = ["TraceRecord", "WorkloadSpec", "Phase", "Scenario",
+           "arrival_offsets", "poisson_offsets", "burst_offsets",
+           "ramp_offsets", "diurnal_offsets", "bounded_pareto",
+           "prompt_ids", "prefix_tokens", "save_trace", "load_trace",
+           "dumps_trace", "loads_trace", "replay"]
+
+# stream-domain salts: every independent draw family gets its own
+# lane so adding a knob never perturbs another knob's stream
+_SALT_GAP = 1
+_SALT_PLEN = 2
+_SALT_OUT = 3
+_SALT_LOCAL = 4
+_SALT_PREFIX = 5
+_SALT_TENANT = 6
+_SALT_PRI = 7
+_SALT_TAIL = 8
+_SALT_JITTER = 9
+# prefix token content depends on prefix_id ONLY (never the scenario
+# seed): two scenarios hitting prefix 3 share bytes, like two tenants
+# sharing a system prompt
+_PREFIX_CONTENT_SALT = 0x5EED
+
+
+def _rng(seed, index, salt):
+    """One PCG64 stream per (seed, index, salt) — the determinism
+    contract: any sampled quantity is a pure function of exactly these
+    three ints, reproducible across runs, processes, and platforms
+    (numpy SeedSequence is specified, not OS-dependent)."""
+    return np.random.default_rng([int(seed), int(index), int(salt)])
+
+
+def _u(seed, index, salt):
+    """One uniform (0, 1] draw from that stream (never exactly 0 —
+    safe as a Pareto/exponential denominator)."""
+    return 1.0 - float(_rng(seed, index, salt).random())
+
+
+# -- arrival processes -----------------------------------------------------
+
+def poisson_offsets(n, rate_rps, seed, start=0.0):
+    """Homogeneous Poisson arrivals: i.i.d. exponential gaps at
+    ``rate_rps``. Offsets are a prefix-sum of per-index pure draws, so
+    offset[i] is itself a pure function of (seed, i)."""
+    out, t = [], float(start)
+    for i in range(int(n)):
+        t += -math.log(_u(seed, i, _SALT_GAP)) / float(rate_rps)
+        out.append(t)
+    return out
+
+
+def burst_offsets(n, duration_s, seed, start=0.0):
+    """A storm: ``n`` arrivals compressed into ``duration_s``, evenly
+    spaced with sub-spacing jitter (monotone by construction — replay
+    order equals index order)."""
+    n = int(n)
+    space = float(duration_s) / max(n, 1)
+    return [float(start) + space * i
+            + space * 0.5 * _u(seed, i, _SALT_JITTER)
+            for i in range(n)]
+
+
+def ramp_offsets(n, duration_s, seed, start=0.0):
+    """Linearly increasing intensity from ~0 to peak over
+    ``duration_s`` (inverse-CDF of a triangular density: offsets go as
+    sqrt(u), jittered within their slot)."""
+    n = int(n)
+    out = []
+    for i in range(n):
+        u = (i + 0.5 * _u(seed, i, _SALT_JITTER)) / max(n, 1)
+        out.append(float(start) + float(duration_s) * math.sqrt(u))
+    return out
+
+
+def diurnal_offsets(n, period_s, seed, start=0.0, depth=0.8):
+    """One day-shaped cycle: intensity ``1 + depth*sin`` over
+    ``period_s``, arrivals by inverse-CDF (bisection — deterministic).
+    ``depth`` in [0, 1): 0 is flat, near 1 swings from near-silent
+    trough to double-rate peak."""
+    n = int(n)
+    period = float(period_s)
+    depth = float(depth)
+
+    def cdf(t):  # integral of (1 + depth*sin(2*pi*t/P)) / P, in [0,1]
+        w = 2.0 * math.pi / period
+        return (t + depth * (1.0 - math.cos(w * t)) / w) / period
+
+    out = []
+    for i in range(n):
+        u = (i + 0.5 * _u(seed, i, _SALT_JITTER)) / max(n, 1)
+        lo, hi = 0.0, period
+        for _ in range(40):  # ~1e-12 * period resolution
+            mid = 0.5 * (lo + hi)
+            if cdf(mid) < u:
+                lo = mid
+            else:
+                hi = mid
+        out.append(float(start) + 0.5 * (lo + hi))
+    return out
+
+
+_ARRIVALS = {"poisson": poisson_offsets, "burst": burst_offsets,
+             "ramp": ramp_offsets, "diurnal": diurnal_offsets}
+
+
+def arrival_offsets(kind, n, scale, seed, start=0.0, **kw):
+    """Dispatch one arrival process by name. ``scale`` is the kind's
+    natural second positional (``rate_rps`` for poisson,
+    ``duration_s`` for burst/ramp, ``period_s`` for diurnal). Unknown
+    kinds raise — a typo'd scenario must not silently fall back to
+    anything."""
+    try:
+        fn = _ARRIVALS[kind]
+    except KeyError:
+        raise ValueError(f"unknown arrival process {kind!r}; "
+                         f"one of {sorted(_ARRIVALS)}") from None
+    return fn(n, scale, seed, start=start, **kw)
+
+
+# -- samplers --------------------------------------------------------------
+
+def bounded_pareto(u, alpha, lo, hi):
+    """Inverse-CDF of the bounded Pareto on [lo, hi] with tail index
+    ``alpha`` (smaller alpha = heavier tail) for one uniform draw
+    ``u`` in (0, 1]. Pure math — the caller owns the stream."""
+    lo, hi = float(lo), float(hi)
+    if hi <= lo:
+        return lo
+    la, ha = lo ** alpha, hi ** alpha
+    return (-(u * ha - u * la - ha) / (ha * la)) ** (-1.0 / alpha)
+
+
+def _weighted_choice(u, weights):
+    """Pick a key from ``{key: weight}`` by one uniform draw, keys in
+    sorted order (dict insertion order must not leak into schedules)."""
+    items = sorted(weights.items(), key=lambda kv: str(kv[0]))
+    total = float(sum(w for _, w in items))
+    acc = 0.0
+    for k, w in items:
+        acc += w / total
+        if u <= acc:
+            return k
+    return items[-1][0]
+
+
+class WorkloadSpec:
+    """Per-phase workload shape: length distributions, shared-prefix
+    locality, tenant skew, priority mix. All knobs have serving-shaped
+    defaults; everything is sampled through the (seed, index) streams,
+    never from shared RNG state."""
+
+    def __init__(self, *,
+                 prompt_len=(4, 48), prompt_alpha=1.2,
+                 max_new_tokens=(2, 8), output_alpha=1.5,
+                 locality=0.0, num_prefixes=4, prefix_len=8,
+                 tenants=None, priority_mix=None, deadlines=None,
+                 vocab=255):
+        self.prompt_len = (int(prompt_len[0]), int(prompt_len[1]))
+        self.prompt_alpha = float(prompt_alpha)
+        self.max_new_tokens = (int(max_new_tokens[0]),
+                               int(max_new_tokens[1]))
+        self.output_alpha = float(output_alpha)
+        self.locality = float(locality)
+        self.num_prefixes = int(num_prefixes)
+        self.prefix_len = int(prefix_len)
+        # zipf-ish tenant skew by default: one hot tenant, a warm one,
+        # a long cold tail
+        self.tenants = dict(tenants) if tenants else \
+            {"t0": 4.0, "t1": 2.0, "t2": 1.0}
+        # priorities use the overload plane's classes (HIGH=0 .. LOW=2)
+        self.priority_mix = dict(priority_mix) if priority_mix else \
+            {0: 0.25, 1: 0.5, 2: 0.25}
+        # per-priority deadline (None = no deadline for that class)
+        self.deadlines = dict(deadlines) if deadlines else \
+            {0: 300.0, 1: None, 2: None}
+        self.vocab = int(vocab)
+
+    def sample(self, seed, index):
+        """All non-arrival fields of record ``index``: lengths, prefix
+        assignment, tenant, priority — each from its own stream."""
+        lo, hi = self.prompt_len
+        plen = int(round(bounded_pareto(
+            _u(seed, index, _SALT_PLEN), self.prompt_alpha, lo, hi)))
+        olo, ohi = self.max_new_tokens
+        new = int(round(bounded_pareto(
+            _u(seed, index, _SALT_OUT), self.output_alpha, olo, ohi)))
+        prefix_id, prefix_len = None, 0
+        if self.locality > 0 and self.num_prefixes > 0 and \
+                _u(seed, index, _SALT_LOCAL) <= self.locality:
+            # zipf-skewed prefix popularity: weight 1/(1+rank)
+            weights = {pid: 1.0 / (1 + pid)
+                       for pid in range(self.num_prefixes)}
+            prefix_id = _weighted_choice(
+                _u(seed, index, _SALT_PREFIX), weights)
+            prefix_len = min(self.prefix_len, max(plen - 1, 1))
+        tenant = _weighted_choice(_u(seed, index, _SALT_TENANT),
+                                  self.tenants)
+        priority = _weighted_choice(_u(seed, index, _SALT_PRI),
+                                    self.priority_mix)
+        return {"prompt_len": max(plen, 1), "max_new_tokens": max(new, 1),
+                "prefix_id": prefix_id, "prefix_len": prefix_len,
+                "tenant": str(tenant), "priority": int(priority),
+                "deadline_s": self.deadlines.get(priority)}
+
+
+# -- trace records ---------------------------------------------------------
+
+_FIELDS = ("offset_s", "prompt_len", "max_new_tokens", "priority",
+           "deadline_s", "tenant", "prefix_id", "prefix_len", "seed",
+           "index", "phase")
+
+
+class TraceRecord:
+    """One arrival: WHEN (``offset_s`` from scenario start), WHAT
+    (prompt spec: length, shared-prefix assignment, materialization
+    seed), and UNDER WHICH CONTRACT (priority, deadline, tenant).
+    Plain data — ``as_dict``/``from_dict`` round-trip through JSONL
+    byte-identically (sorted keys), which is what lets a recorded
+    production trace replace a synthetic schedule."""
+
+    __slots__ = _FIELDS
+
+    def __init__(self, offset_s, prompt_len, max_new_tokens=4,
+                 priority=1, deadline_s=None, tenant="t0",
+                 prefix_id=None, prefix_len=0, seed=0, index=0,
+                 phase=""):
+        self.offset_s = float(offset_s)
+        self.prompt_len = int(prompt_len)
+        self.max_new_tokens = int(max_new_tokens)
+        self.priority = int(priority)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.tenant = str(tenant)
+        self.prefix_id = None if prefix_id is None else int(prefix_id)
+        self.prefix_len = int(prefix_len)
+        self.seed = int(seed)
+        self.index = int(index)
+        self.phase = str(phase)
+
+    def as_dict(self):
+        return {f: getattr(self, f) for f in _FIELDS}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**{f: d[f] for f in _FIELDS if f in d})
+
+    def __eq__(self, other):
+        return isinstance(other, TraceRecord) and \
+            self.as_dict() == other.as_dict()
+
+    def __repr__(self):
+        return (f"TraceRecord(offset_s={self.offset_s:.4f}, "
+                f"prompt_len={self.prompt_len}, pri={self.priority}, "
+                f"tenant={self.tenant!r}, prefix={self.prefix_id}, "
+                f"phase={self.phase!r})")
+
+
+def prefix_tokens(prefix_id, prefix_len, vocab=255):
+    """The shared prefix's token content — a function of ``prefix_id``
+    ONLY, so every request (any scenario, any seed) opening with
+    prefix ``k`` presents identical leading tokens and the paged
+    engine's prefix cache can share their KV blocks."""
+    rng = np.random.default_rng([_PREFIX_CONTENT_SALT, int(prefix_id)])
+    return rng.integers(0, int(vocab), (int(prefix_len),)).astype("int64")
+
+
+def prompt_ids(record, vocab=255):
+    """Materialize a record's prompt: shared prefix (if assigned) +
+    a per-record tail. Deterministic — same record, same tokens."""
+    tail_len = record.prompt_len - record.prefix_len
+    tail = _rng(record.seed, record.index, _SALT_TAIL).integers(
+        0, int(vocab), (max(tail_len, 0),)).astype("int64")
+    if record.prefix_id is None or record.prefix_len <= 0:
+        return tail
+    return np.concatenate(
+        [prefix_tokens(record.prefix_id, record.prefix_len, vocab), tail])
+
+
+# -- scenarios -------------------------------------------------------------
+
+class Phase:
+    """One leg of a scenario: ``n`` arrivals from one arrival process,
+    drawn against one :class:`WorkloadSpec`. ``arrival_kw`` feeds the
+    process (``rate_rps`` for poisson; ``duration_s`` for burst/ramp;
+    ``period_s`` for diurnal). ``action`` is an opaque tag the
+    scoreboard interprets mid-phase (e.g. ``"kill:r1"`` /
+    ``"drain:r0"``) — data, not behavior, so it replays from JSONL."""
+
+    def __init__(self, name, n, arrival="poisson", workload=None,
+                 action=None, **arrival_kw):
+        self.name = str(name)
+        self.n = int(n)
+        self.arrival = str(arrival)
+        self.workload = workload or WorkloadSpec()
+        self.action = action
+        self.arrival_kw = dict(arrival_kw)
+
+    def offsets(self, seed, start=0.0):
+        kw = dict(self.arrival_kw)
+        if self.arrival == "poisson":
+            scale = kw.pop("rate_rps", 50.0)
+        elif self.arrival in ("burst", "ramp"):
+            scale = kw.pop("duration_s", 0.1)
+        else:
+            scale = kw.pop("period_s", 1.0)
+        return arrival_offsets(self.arrival, self.n, scale, seed,
+                               start=start, **kw)
+
+
+class Scenario:
+    """A named composition of phases. ``schedule(seed)`` lays the
+    phases end-to-end on one clock and returns the flat
+    ``list[TraceRecord]`` in arrival order — the ONLY thing the replay
+    path consumes, so a loaded JSONL trace is a first-class schedule."""
+
+    def __init__(self, name, phases):
+        self.name = str(name)
+        self.phases = list(phases)
+
+    def schedule(self, seed):
+        records, t0, index = [], 0.0, 0
+        for phase in self.phases:
+            offs = phase.offsets(seed, start=t0)
+            for off in offs:
+                fields = phase.workload.sample(seed, index)
+                records.append(TraceRecord(
+                    offset_s=off, seed=seed, index=index,
+                    phase=phase.name, **fields))
+                index += 1
+            t0 = max([t0, *offs]) if offs else t0
+        return records
+
+
+# -- JSONL trace IO --------------------------------------------------------
+
+def dumps_trace(records):
+    """Records to JSONL text (sorted keys, one record per line) — the
+    byte-identity surface the determinism tests pin."""
+    return "".join(json.dumps(r.as_dict(), sort_keys=True) + "\n"
+                   for r in records)
+
+
+def loads_trace(text):
+    return [TraceRecord.from_dict(json.loads(line))
+            for line in text.splitlines() if line.strip()]
+
+
+def save_trace(records, path):
+    with open(path, "w") as f:
+        f.write(dumps_trace(records))
+
+
+def load_trace(path):
+    with open(path) as f:
+        return loads_trace(f.read())
+
+
+# -- replay ----------------------------------------------------------------
+
+def replay(records, submit, *, between=None, time_scale=0.0):
+    """Drive ``submit(record)`` in arrival order. ``time_scale``
+    stretches the recorded offsets into real sleeps (0.0 — the gate
+    default — replays as-fast-as-possible: offset ORDER is the
+    contract, wall time is not); ``between`` is called after each
+    submit (foreground engines use it to take scheduler steps, so
+    arrivals interleave with decode like they would under real load).
+
+    Returns ``[(record, handle_or_exception), ...]``: a submit that
+    raises (AdmissionRejected, QueueFullError, NoReplicaAvailable) is
+    an OUTCOME under load, not a replay failure."""
+    import time as _time
+
+    out, prev = [], None
+    for rec in sorted(records, key=lambda r: (r.offset_s, r.index)):
+        if time_scale > 0.0 and prev is not None and \
+                rec.offset_s > prev:
+            _time.sleep((rec.offset_s - prev) * time_scale)
+        prev = rec.offset_s
+        try:
+            out.append((rec, submit(rec)))
+        except Exception as e:  # noqa: BLE001 — rejection is data here
+            out.append((rec, e))
+        if between is not None:
+            between()
+    return out

@@ -1,0 +1,375 @@
+"""Scenario observatory (ISSUE 16): trace-replay load generation
+(serving/loadgen.py), scenario-scoped metric Windows
+(profiler/metrics.py), and the fleet-invariant scoreboard
+(profiler/scorecard.py).
+
+Acceptance pins: arrival offsets are pure functions of (seed, index) —
+two runs AND two processes produce byte-identical JSONL schedules;
+trace records round-trip through JSONL losslessly (a recorded trace is
+a first-class schedule); tenant/priority mixes land within tolerance
+of their knobs; ``Window`` deltas obey closure (window + pre-window ==
+total, exact on counters and bucket-by-bucket on histograms) without
+ever resetting the registry; a composed burst + replica-kill + drain
++ locality scenario against a 3-replica in-process fleet holds the
+four fleet invariants (high-priority goodput floor, exactly-once
+failover, zero-drop drain, prefix hit-rate floor); the scorecard
+surfaces through ``profiler.summary()`` and the MetricsServer
+``/summary`` endpoint.
+"""
+
+import hashlib
+import json
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import Llama, LlamaConfig
+from paddle_tpu.profiler import export, metrics, scorecard
+from paddle_tpu.serving import loadgen
+
+
+@pytest.fixture(autouse=True)
+def _no_trace_pollution():
+    saved = paddle.get_flags(["FLAGS_trace_enable"])
+    paddle.set_flags({"FLAGS_trace_enable": False})
+    yield
+    paddle.set_flags(saved)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _scenario():
+    """The reference composed scenario used by the determinism pins —
+    one phase per arrival process, locality + mixed priorities."""
+    mixed = loadgen.WorkloadSpec(priority_mix={0: 0.25, 1: 0.5, 2: 0.25})
+    local = loadgen.WorkloadSpec(locality=0.8, num_prefixes=3,
+                                 prefix_len=24, prompt_len=(26, 30))
+    return loadgen.Scenario("pin", [
+        loadgen.Phase("a", 8, arrival="poisson", rate_rps=100.0,
+                      workload=mixed),
+        loadgen.Phase("b", 8, arrival="burst", duration_s=0.05,
+                      workload=local),
+        loadgen.Phase("c", 8, arrival="ramp", duration_s=0.2,
+                      workload=mixed),
+        loadgen.Phase("d", 8, arrival="diurnal", period_s=1.0,
+                      workload=mixed),
+    ])
+
+
+# -- arrival processes -------------------------------------------------
+
+
+def test_arrival_processes_are_monotone_and_bounded():
+    for kind, scale in (("poisson", 50.0), ("burst", 0.1),
+                        ("ramp", 0.5), ("diurnal", 2.0)):
+        offs = loadgen.arrival_offsets(kind, 32, scale, seed=3, start=1.0)
+        assert len(offs) == 32
+        assert all(b >= a for a, b in zip(offs, offs[1:])), kind
+        assert offs[0] >= 1.0, kind
+    # burst/ramp/diurnal live inside their window
+    for kind in ("burst", "ramp", "diurnal"):
+        offs = loadgen.arrival_offsets(kind, 16, 0.25, seed=3)
+        assert max(offs) <= 0.25 + 1e-9, kind
+
+
+def test_unknown_arrival_kind_raises():
+    with pytest.raises(ValueError, match="unknown arrival"):
+        loadgen.arrival_offsets("lognormal", 4, 1.0, seed=0)
+
+
+def test_bounded_pareto_stays_in_bounds_and_is_heavy_tailed():
+    us = [(i + 1) / 101.0 for i in range(100)]
+    xs = [loadgen.bounded_pareto(u, 1.1, 4, 48) for u in us]
+    assert all(4 <= x <= 48 for x in xs)
+    # heavy tail: most mass near lo, a few giants near hi
+    assert sum(1 for x in xs if x < 10) > 60
+    assert any(x > 30 for x in xs)
+
+
+# -- determinism (satellite c) -----------------------------------------
+
+
+def test_schedule_is_byte_identical_across_runs():
+    sc = _scenario()
+    a = loadgen.dumps_trace(sc.schedule(7))
+    b = loadgen.dumps_trace(sc.schedule(7))
+    assert a == b
+    assert a != loadgen.dumps_trace(sc.schedule(8))  # seed-sensitive
+
+
+def test_offsets_are_pure_functions_of_seed_and_index():
+    # offset[i] does not depend on how many arrivals precede it
+    long = loadgen.poisson_offsets(20, 50.0, seed=5)
+    short = loadgen.poisson_offsets(5, 50.0, seed=5)
+    assert long[:5] == short
+
+
+_SUBPROC = r"""
+import hashlib, sys
+sys.path.insert(0, {repo!r})
+from tests.framework.test_loadgen import _scenario
+from paddle_tpu.serving import loadgen
+text = loadgen.dumps_trace(_scenario().schedule(7))
+print(hashlib.sha256(text.encode()).hexdigest())
+"""
+
+
+def test_schedule_is_byte_identical_across_processes(repo_root=None):
+    import os
+    repo = repo_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    here = hashlib.sha256(
+        loadgen.dumps_trace(_scenario().schedule(7)).encode()).hexdigest()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", _SUBPROC.format(repo=repo)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip().splitlines()[-1] == here
+
+
+# -- trace records & replay (satellite f) ------------------------------
+
+
+def test_trace_jsonl_round_trip_is_lossless():
+    recs = _scenario().schedule(11)
+    text = loadgen.dumps_trace(recs)
+    back = loadgen.loads_trace(text)
+    assert back == recs
+    assert loadgen.dumps_trace(back) == text  # byte-stable re-dump
+    # every line is standalone JSON with sorted keys
+    line = text.splitlines()[0]
+    assert list(json.loads(line)) == sorted(json.loads(line))
+
+
+def test_save_load_trace_round_trips_via_file(tmp_path):
+    recs = _scenario().schedule(2)
+    p = tmp_path / "trace.jsonl"
+    loadgen.save_trace(recs, str(p))
+    assert loadgen.load_trace(str(p)) == recs
+
+
+def test_replay_orders_by_offset_and_keeps_rejections_as_data():
+    recs = [loadgen.TraceRecord(offset_s=o, prompt_len=4, index=i)
+            for i, o in enumerate([0.3, 0.1, 0.2])]
+    seen, ticks = [], [0]
+
+    def submit(rec):
+        if rec.index == 2:
+            raise RuntimeError("queue full")
+        seen.append(rec.index)
+        return f"h{rec.index}"
+
+    out = loadgen.replay(recs, submit, between=lambda: ticks.__setitem__(
+        0, ticks[0] + 1))
+    assert seen == [1, 0]                      # offset order, not list order
+    assert [r.index for r, _ in out] == [1, 2, 0]
+    assert isinstance(out[1][1], RuntimeError)  # rejection is an outcome
+    assert ticks[0] == 3                       # between fires per arrival
+
+
+def test_prompt_ids_materialize_shared_prefixes():
+    spec = loadgen.WorkloadSpec(locality=1.0, num_prefixes=1,
+                                prefix_len=16, prompt_len=(20, 24))
+    recs = _records_from(spec, n=6, seed=13)
+    toks = [loadgen.prompt_ids(r) for r in recs]
+    for r, t in zip(recs, toks):
+        assert len(t) == r.prompt_len
+        assert r.prefix_id == 0 and r.prefix_len == 16
+    # same prefix_id => identical leading tokens, distinct tails
+    heads = {t[:16].tobytes() for t in toks}
+    assert len(heads) == 1
+    assert len({t.tobytes() for t in toks}) == len(toks)
+    # prefix content is a function of prefix_id only, not the seed
+    assert np.array_equal(loadgen.prefix_tokens(0, 16),
+                          loadgen.prefix_tokens(0, 16))
+
+
+def _records_from(spec, n, seed):
+    ph = loadgen.Phase("p", n, arrival="burst", duration_s=0.01,
+                       workload=spec)
+    return loadgen.Scenario("s", [ph]).schedule(seed)
+
+
+def test_tenant_and_priority_mix_land_within_tolerance():
+    spec = loadgen.WorkloadSpec(tenants={"hot": 8.0, "warm": 1.0,
+                                         "cold": 1.0},
+                                priority_mix={0: 0.2, 1: 0.6, 2: 0.2})
+    recs = _records_from(spec, n=600, seed=23)
+    tenants = [r.tenant for r in recs]
+    assert abs(tenants.count("hot") / 600 - 0.8) < 0.08
+    pris = [r.priority for r in recs]
+    assert abs(pris.count(1) / 600 - 0.6) < 0.08
+    assert abs(pris.count(0) / 600 - 0.2) < 0.06
+    # HIGH class carries its deadline, the rest default to none
+    assert all((r.deadline_s is not None) == (r.priority == 0)
+               for r in recs)
+
+
+# -- Window: scenario-scoped measurement (tentpole part 2) -------------
+
+
+def test_window_delta_closure_on_counters_gauges_histograms():
+    c = metrics.counter("lgwin.ctr")
+    g = metrics.gauge("lgwin.g")
+    h = metrics.histogram("lgwin.h", bounds=(1, 2, 4, 8))
+    s0 = metrics.registry.snapshot("lgwin.")
+    c.inc(3)
+    g.set(10)
+    for v in (0.5, 3.0, 100.0):
+        h.observe(v)
+    s1 = metrics.registry.snapshot("lgwin.")
+    c.inc(2)
+    g.set(4)
+    for v in (1.5, 7.0):
+        h.observe(v)
+    s2 = metrics.registry.snapshot("lgwin.")
+    d01 = metrics.window_delta(s0, s1)
+    d12 = metrics.window_delta(s1, s2)
+    d02 = metrics.window_delta(s0, s2)
+    # scalar closure, signed (the gauge legitimately FELL)
+    assert (d01["lgwin.ctr"], d12["lgwin.ctr"]) == (3, 2)
+    assert d02["lgwin.ctr"] == 5
+    assert d12["lgwin.g"] == -6
+    assert d01["lgwin.g"] + d12["lgwin.g"] == d02["lgwin.g"]
+    # histogram closure: count, sum, and EVERY bucket add up exactly
+    ha, hb, hab = d01["lgwin.h"], d12["lgwin.h"], d02["lgwin.h"]
+    assert ha["count"] + hb["count"] == hab["count"] == 5
+    assert ha["sum"] + hb["sum"] == hab["sum"]
+    assert set(ha["buckets"]) == set(hab["buckets"])
+    for le in hab["buckets"]:
+        assert ha["buckets"][le] + hb["buckets"][le] == hab["buckets"][le]
+
+
+def test_window_percentiles_see_only_their_slice():
+    h = metrics.histogram("lgwin.slice", bounds=(1, 2, 4, 8))
+    for _ in range(10):
+        h.observe(0.5)            # pre-window mass in the lowest bucket
+    win = metrics.Window(label="slice")
+    for _ in range(4):
+        h.observe(7.0)            # in-window mass in (4, 8]
+    win.freeze()
+    assert win.frozen and win.elapsed_s() >= 0.0
+    wh = win.hist("lgwin.slice")
+    assert wh["count"] == 4
+    p50 = win.percentile("lgwin.slice", 0.5)
+    assert 4.0 < p50 <= 8.0       # window sees ONLY the tail slice
+    assert h.percentile(0.5) <= 1.0   # the total is still low-heavy
+    # observations after freeze() do not leak into the window
+    h.observe(0.5)
+    assert win.hist("lgwin.slice")["count"] == 4
+
+
+def test_percentile_from_buckets_is_the_single_shared_copy():
+    from paddle_tpu.profiler import fleet
+    assert fleet.percentile_from_buckets is metrics.percentile_from_buckets
+    # target 0.25*4=1 falls halfway into the (1, 4] bucket: 1 + 0.5*3
+    cum = {"1": 0, "4": 2, "+inf": 4}
+    assert metrics.percentile_from_buckets(cum, 0.25) == pytest.approx(2.5)
+    assert metrics.percentile_from_buckets({}, 0.5) is None
+
+
+def test_slo_burn_over_window_delta():
+    # all observations inside budget -> zero burn
+    assert scorecard.slo_burn(
+        {"count": 2, "buckets": {"1": 0, "4": 2, "+inf": 0}},
+        budget_us=4, target=0.5) == 0.0
+    # half the observations blow the budget at target 0.5 -> burn 1.0
+    assert scorecard.slo_burn(
+        {"count": 2, "buckets": {"1": 1, "+inf": 1}},
+        budget_us=1, target=0.5) == pytest.approx(1.0)
+    assert scorecard.slo_burn({"count": 0, "buckets": {}}, 1) is None
+
+
+# -- the composed fleet scenario (tentpole parts 1+3) ------------------
+
+
+def test_composed_scenario_holds_the_fleet_invariants(model):
+    mixed = loadgen.WorkloadSpec(
+        prompt_len=(4, 14), prompt_alpha=1.1, max_new_tokens=(6, 12),
+        priority_mix={0: 0.25, 1: 0.5, 2: 0.25},
+        deadlines={0: 300.0, 1: None, 2: None})
+    local = loadgen.WorkloadSpec(
+        prompt_len=(26, 30), max_new_tokens=(2, 3), locality=1.0,
+        num_prefixes=2, prefix_len=24, priority_mix={1: 1.0})
+    sc = loadgen.Scenario("composed", [
+        loadgen.Phase("storm", 24, arrival="burst", duration_s=0.02,
+                      workload=mixed),
+        loadgen.Phase("kill", 10, arrival="burst", duration_s=0.02,
+                      workload=mixed, action="kill:lg2"),
+        loadgen.Phase("local", 18, arrival="poisson", rate_rps=200.0,
+                      workload=local),
+        loadgen.Phase("drain", 10, arrival="burst", duration_s=0.02,
+                      workload=mixed, action="drain:lg0"),
+    ])
+    with scorecard.FleetHarness(model, n_replicas=3, rid_prefix="lg",
+                                max_queue=24) as harness:
+        harness.prime()
+        harness.shed_tune()
+        card = scorecard.run_scenario(harness, sc, seed=16)
+
+    assert card["ok"], card["invariants"]
+    by = {pc["phase"]: pc for pc in card["phases"]}
+    # 1) goodput floor under overload (PR 13): every HIGH arrival DONE
+    assert by["storm"]["invariants"]["goodput_floor"]["ok"]
+    assert by["storm"]["high_goodput"] >= 0.9
+    # 2) exactly-once failover (PR 12): the kill moved requests, each
+    #    landing exactly once, none terminal ERROR
+    eo = by["kill"]["invariants"]["exactly_once"]
+    assert eo["ok"] and eo["value"]["moved"] >= 1
+    assert eo["value"]["failover"] == eo["value"]["moved"]
+    # 3) zero-drop drain (PR 11), mid-storm, drain completing cleanly
+    zd = by["drain"]["invariants"]["zero_drop"]
+    assert zd["ok"] and zd["value"] == 0
+    assert by["drain"]["action_errors"] == []
+    # 4) prefix hit-rate under locality (PR 8), through the Window
+    pr = by["local"]["invariants"]["prefix_hit_rate"]
+    assert pr["ok"] and by["local"]["prefix_hit_rate"] >= 0.3
+    # every phase measured its own slice: windows saw TTFT traffic
+    assert all(pc["ttft_us"]["count"] > 0 for pc in card["phases"])
+    # the card published: latest(), ledger shape, summary section
+    assert scorecard.latest() is card
+    m = scorecard.fleet_load_metrics(card)
+    assert m["scenario_ok"] == 1.0 and m["dropped"] == 0.0
+    assert m["high_goodput_frac"] >= 0.9
+    assert m["prefix_hit_rate"] >= 0.3 and m["ttft_p95_us"] > 0
+    lines = "\n".join(scorecard.summary_lines())
+    assert "Scenario scorecard" in lines and "storm" in lines
+    assert metrics.registry.snapshot()["scorecard.last_ok"] == 1
+
+
+# -- /summary endpoint (satellite b) -----------------------------------
+
+
+def test_metrics_server_serves_profiler_summary():
+    scorecard.record({
+        "scenario": "endpoint_pin", "seed": 1, "ok": True,
+        "floors": dict(scorecard.DEFAULT_FLOORS), "invariants": {},
+        "phases": [{
+            "phase": "probe", "action": None, "arrivals": 2,
+            "accepted": 2, "rejected": 0, "statuses": {"DONE": 2},
+            "shed": 0, "failover": 0, "moved": 0, "high_goodput": 1.0,
+            "prefix_hit_rate": None, "prefix_hits": 0,
+            "prefix_misses": 0, "ttft_us": None, "itl_us": None,
+            "ttft_burn": None, "itl_burn": None, "elapsed_s": 0.1,
+            "action_errors": [],
+            "invariants": {"all_terminal": {"ok": True, "value": 0,
+                                            "floor": 0}},
+            "ok": True}]})
+    with export.MetricsServer() as srv:
+        body = urllib.request.urlopen(
+            srv.url("/summary"), timeout=10).read().decode()
+    assert "Scenario scorecard" in body
+    assert "endpoint_pin" in body and "probe" in body
+    from paddle_tpu import profiler
+    assert "Scenario scorecard" in profiler.summary_text()

@@ -222,3 +222,26 @@ def test_mesh_serving_surfaces_map_to_their_tests():
     assert "tests/distributed" in t
     t = suite_gate.targets_for(["tools/mesh_gate.py"])
     assert "tests/framework/test_mesh_serving.py" in t
+
+
+def test_loadgen_and_scorecard_surfaces_map_to_their_tests():
+    # the scenario observatory (ISSUE 16): the workload engine, the
+    # scorecard, the Window home (profiler/metrics.py), and the gate
+    # all run the loadgen suite; the scorecard/gate also run the
+    # router + overload suites whose contracts they re-prove
+    t = suite_gate.targets_for(["paddle_tpu/serving/loadgen.py"])
+    assert "tests/framework/test_loadgen.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/profiler/scorecard.py"])
+    assert "tests/framework/test_loadgen.py" in t
+    assert "tests/framework/test_router.py" in t
+    assert "tests/framework/test_overload.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/profiler/metrics.py"])
+    assert "tests/framework/test_loadgen.py" in t
+    assert "tests/framework/test_fleet_observatory.py" in t
+    t = suite_gate.targets_for(["paddle_tpu/profiler/fleet.py"])
+    # percentile_from_buckets is re-exported from metrics: fleet pins
+    assert "tests/framework/test_fleet_observatory.py" in t
+    t = suite_gate.targets_for(["tools/fleet_load_gate.py"])
+    assert "tests/framework/test_loadgen.py" in t
+    assert "tests/framework/test_router.py" in t
+    assert "tests/framework/test_overload.py" in t

@@ -27,8 +27,13 @@ breaker + flags-off check bits), ``spec_gate`` (tools/spec_gate.py
 decode speed tiers: speculative tokens/step multiple, draft
 acceptance rate, int8 KV capacity multiplier, equivalence bits),
 ``decode_tiers`` (bench.py decode rung: base vs speculative vs
-quantized tokens/s on the serving scheduler). The ledger itself is
-schema-free — any kind/metrics pair appends.
+quantized tokens/s on the serving scheduler), ``fleet_load``
+(tools/fleet_load_gate.py scenario observatory: per-scenario rollup of
+the worst phase — scenario_ok/gate_ok pass bits, arrivals/accepted/
+shed/failover/dropped counts, min high_goodput_frac, min
+prefix_hit_rate, max ttft_p95_us — every number read through
+scenario-scoped profiler.metrics Windows, never a registry reset).
+The ledger itself is schema-free — any kind/metrics pair appends.
 
 CLI::
 

@@ -1,0 +1,202 @@
+"""Fleet-load gate: the scenario observatory (ISSUE 16) end to end —
+a composed loadgen scenario (burst storm under shed + replica kill
+mid-storm + drain mid-storm + shared-prefix locality) driven against a
+3-replica in-process fleet (Router + overload plane, the PR 11-13
+stack), graded by profiler/scorecard.py through scenario-scoped
+metric Windows. Five pass/fail checks:
+
+  1. storm-shed    — the burst storm actually sheds (``serving.shed``
+                     > 0 inside the storm's Window) while the HIGH
+                     class holds >= ``FLEET_LOAD_GOODPUT`` (default
+                     0.9) DONE fraction — the PR 13 goodput contract
+                     at 10x slot oversubscription;
+  2. failover      — a replica killed mid-storm: every accepted
+                     request still lands exactly once (failover count
+                     == requests that moved, no ERROR terminals) —
+                     the PR 12 contract under load;
+  3. drain         — a replica drained mid-storm: zero dropped
+                     requests (every accepted request reaches a clean
+                     terminal, the drain completes gracefully, new
+                     arrivals redistribute live) — the PR 11 contract
+                     under load;
+  4. locality      — the shared-prefix scenario's windowed block
+                     hit-rate >= ``FLEET_LOAD_HIT_RATE`` (default
+                     0.3) — the PR 8 prefix cache showing up at the
+                     fleet level;
+  5. determinism   — the same (scenario, seed) schedules
+                     byte-identically twice (the loadgen purity
+                     contract the whole harness rests on).
+
+Every number is read through a per-phase ``metrics.Window`` — the
+global registry is never reset. Appends a ``fleet_load`` entry
+(scenario_ok, worst-phase goodput/hit-rate, shed/failover/drop
+counts, worst TTFT p95) to the continuous-bench ledger
+(tools/bench_ledger.py) and prints the scorecard section that
+``profiler.summary()`` / the MetricsServer ``/summary`` endpoint
+serve. Exit 0 on pass, 1 on fail; runs under JAX_PLATFORMS=cpu
+(tier-1, like tests/framework/test_loadgen.py); wired into
+tools/suite_gate.py beside the router/overload gates.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+GOODPUT_FLOOR = float(os.environ.get("FLEET_LOAD_GOODPUT", "0.9"))
+HIT_RATE_FLOOR = float(os.environ.get("FLEET_LOAD_HIT_RATE", "0.3"))
+SEED = int(os.environ.get("FLEET_LOAD_SEED", "16"))
+
+
+def _model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def build_scenario():
+    """The composed scenario: storm -> kill mid-storm -> locality ->
+    drain mid-storm. Mixed-priority bursts oversubscribe the fleet's
+    6 decode slots ~5x so the shed ladder engages; the locality phase
+    opens every prompt with one of two 24-token shared prefixes (3
+    full KV blocks at block_size=8) so prefix sharing is visible at
+    the block counters."""
+    from paddle_tpu.serving import loadgen
+
+    mixed = loadgen.WorkloadSpec(
+        prompt_len=(4, 14), prompt_alpha=1.1,
+        max_new_tokens=(6, 12), locality=0.0,
+        priority_mix={0: 0.25, 1: 0.5, 2: 0.25},
+        deadlines={0: 300.0, 1: None, 2: None})
+    local = loadgen.WorkloadSpec(
+        prompt_len=(26, 30), max_new_tokens=(2, 3),
+        locality=1.0, num_prefixes=2, prefix_len=24,
+        priority_mix={1: 1.0})
+    return loadgen.Scenario("fleet_load", [
+        loadgen.Phase("storm", 36, arrival="burst", duration_s=0.02,
+                      workload=mixed),
+        loadgen.Phase("kill", 10, arrival="burst", duration_s=0.02,
+                      workload=mixed, action="kill:fl2"),
+        loadgen.Phase("locality", 16, arrival="poisson", rate_rps=200.0,
+                      workload=local),
+        loadgen.Phase("drain", 12, arrival="burst", duration_s=0.02,
+                      workload=mixed, action="drain:fl0"),
+    ])
+
+
+def check_determinism(scenario):
+    from paddle_tpu.serving import loadgen
+
+    a = loadgen.dumps_trace(scenario.schedule(SEED))
+    b = loadgen.dumps_trace(scenario.schedule(SEED))
+    other = loadgen.dumps_trace(scenario.schedule(SEED + 1))
+    ok = a == b and a != other
+    print(f"[fleet-load-gate] determinism: byte-identical={a == b} "
+          f"seed-sensitive={a != other} ({len(a.splitlines())} records) "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def _phase(card, name):
+    return next(pc for pc in card["phases"] if pc["phase"] == name)
+
+
+def check_storm(card):
+    pc = _phase(card, "storm")
+    inv = pc["invariants"]
+    goodput = pc["high_goodput"]
+    ok = (pc["shed"] > 0 and inv["goodput_floor"]["ok"]
+          and inv["all_terminal"]["ok"])
+    print(f"[fleet-load-gate] storm-shed: shed={pc['shed']} "
+          f"high-goodput={goodput:.2f} (want >= {GOODPUT_FLOOR}) "
+          f"all-terminal={inv['all_terminal']['ok']} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_failover(card):
+    pc = _phase(card, "kill")
+    v = pc["invariants"].get("exactly_once", {"ok": False, "value": {}})
+    ok = v["ok"] and pc["invariants"]["all_terminal"]["ok"]
+    print(f"[fleet-load-gate] failover: {v['value']} "
+          f"(want failover == moved >= 1, no ERROR) "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_drain(card, harness):
+    from paddle_tpu.serving import Lifecycle
+
+    pc = _phase(card, "drain")
+    v = pc["invariants"].get("zero_drop", {"ok": False, "value": -1})
+    closed = harness.engines["fl0"].lifecycle == Lifecycle.CLOSED
+    ok = v["ok"] and closed and pc["accepted"] > 0
+    print(f"[fleet-load-gate] drain: dropped={v['value']} "
+          f"accepted={pc['accepted']} drained-closed={closed} "
+          f"action-errors={pc['action_errors']} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_locality(card):
+    pc = _phase(card, "locality")
+    v = pc["invariants"].get("prefix_hit_rate", {"ok": False})
+    rate = pc["prefix_hit_rate"]
+    ok = v["ok"]
+    print(f"[fleet-load-gate] locality: hit-rate="
+          f"{-1.0 if rate is None else rate:.3f} "
+          f"(want >= {HIT_RATE_FLOOR}; hits={pc['prefix_hits']} "
+          f"misses={pc['prefix_misses']}) {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def main():
+    from paddle_tpu.profiler import scorecard
+
+    scenario = build_scenario()
+    ok_det = check_determinism(scenario)
+
+    model = _model()
+    harness = scorecard.FleetHarness(model, n_replicas=3,
+                                     rid_prefix="fl", max_queue=24)
+    harness.prime()
+    harness.shed_tune()
+    card = scorecard.run_scenario(
+        harness, scenario, seed=SEED,
+        floors={"high_goodput": GOODPUT_FLOOR,
+                "prefix_hit_rate": HIT_RATE_FLOOR})
+    ok1 = check_storm(card)
+    ok2 = check_failover(card)
+    ok3 = check_drain(card, harness)
+    ok4 = check_locality(card)
+    harness.close()
+    ok = ok1 and ok2 and ok3 and ok4 and ok_det
+
+    try:
+        import bench_ledger
+        m = scorecard.fleet_load_metrics(card)
+        m["gate_ok"] = 1.0 if ok else 0.0
+        bench_ledger.append_entry("fleet_load", m,
+                                  meta={"scenario": card["scenario"],
+                                        "seed": card["seed"]})
+        print(f"[fleet-load-gate] ledger: appended fleet_load "
+              f"({len(m)} metrics)")
+    except Exception as e:  # noqa: BLE001 — ledger trouble is advisory
+        print(f"[fleet-load-gate] ledger append skipped "
+              f"({type(e).__name__}: {e})")
+
+    print("\n".join(scorecard.summary_lines()))
+    print(f"[fleet-load-gate] {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

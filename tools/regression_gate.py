@@ -71,6 +71,15 @@ def direction_and_tol(name):
     if name.startswith("headline_"):
         return ("down", HEADLINE_TOL) if "tokens_per_s" in name \
             or "mfu" in name else ("up", HEADLINE_TOL)
+    if "goodput" in name or "hit_rate" in name:
+        # quality floors (kind fleet_load / overload_gate): fractions in
+        # [0, 1] where a DROP is the regression — no time/rate suffix to
+        # key off (e.g. high_goodput_frac), so match by substring
+        return ("down", RATE_TOL)
+    if name.endswith("_ok"):
+        # pass/fail sentinels (scenario_ok, gate_ok — kind fleet_load):
+        # any drop below an all-1.0 median is a failure, zero tolerance
+        return ("down", 0.0)
     # throughput suffixes FIRST: "tokens_per_s" also ends with "_s"
     # (_per_step: the speculative decode multiple; _mult: the int8 KV
     # capacity multiplier — both larger-is-better, kind spec_gate /

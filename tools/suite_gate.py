@@ -45,6 +45,8 @@ _MAP = [
      ["tests/framework/test_spec_decode.py"]),
     ("paddle_tpu/serving/mesh.py",
      ["tests/framework/test_mesh_serving.py"]),
+    ("paddle_tpu/serving/loadgen.py",
+     ["tests/framework/test_loadgen.py"]),
     ("paddle_tpu/serving/", ["tests/framework/test_serving.py",
                              "tests/framework/test_prefix_cache.py",
                              "tests/framework/test_fleet_observatory.py",
@@ -101,6 +103,13 @@ _MAP = [
       "tests/framework/test_overload.py"]),
     ("paddle_tpu/profiler/fleet.py",
      ["tests/framework/test_fleet_observatory.py"]),
+    ("paddle_tpu/profiler/metrics.py",
+     ["tests/framework/test_loadgen.py",
+      "tests/framework/test_fleet_observatory.py"]),
+    ("paddle_tpu/profiler/scorecard.py",
+     ["tests/framework/test_loadgen.py",
+      "tests/framework/test_router.py",
+      "tests/framework/test_overload.py"]),
     ("paddle_tpu/profiler/", ["tests/framework/test_profiler_protobuf.py",
                               "tests/framework/test_telemetry.py",
                               "tests/framework/test_tracing.py",
@@ -129,6 +138,10 @@ _MAP = [
     ("tools/spec_gate.py", ["tests/framework/test_spec_decode.py",
                             "tests/framework/test_quantization.py"]),
     ("tools/mesh_gate.py", ["tests/framework/test_mesh_serving.py"]),
+    ("tools/fleet_load_gate.py",
+     ["tests/framework/test_loadgen.py",
+      "tests/framework/test_router.py",
+      "tests/framework/test_overload.py"]),
     ("tools/bench_ledger.py",
      ["tests/framework/test_regression_ledger.py"]),
     ("tools/regression_gate.py",
