@@ -420,6 +420,21 @@ define_flag("FLAGS_serving_mesh", "",
             "(default) is byte-for-byte single-device serving with "
             "serving.mesh.* counter silence (read at Scheduler "
             "construction, the FLAGS_serving_prefix_cache convention)")
+define_flag("FLAGS_serving_disagg", False,
+            "disaggregated prefill/decode serving (serving/disagg.py): "
+            "the two-stage pipeline routes each request to a prefill-"
+            "role replica (bucket-ladder only, stops at first token), "
+            "exports the prompt's finished KV blocks through the "
+            "serving/kv_transfer.py crc-framed plane keyed by prefix "
+            "digests, imports them into a decode-role replica's pool "
+            "and admits the request straight into the batched decode "
+            "step with ZERO re-prefill; greedy outputs stay bit-"
+            "identical to co-located serving (fp32 and int8 pools — "
+            "tools/disagg_gate.py pins it) and ANY transfer failure "
+            "fails open to co-located serving on the prefill replica; "
+            "0 (default) reverts byte-for-byte with serving.disagg.* "
+            "counter silence (read at DisaggPipeline construction, the "
+            "FLAGS_serving_prefix_cache convention)")
 define_flag("FLAGS_fleet_skew_ratio", 2.5,
             "fleet.skew alert threshold: a replica whose TTFT p95 "
             "exceeds this multiple of the fleet median p95 (both from "

@@ -89,7 +89,8 @@ class CostReport:
 
     __slots__ = ("rid", "status", "queue_us", "prefill_us",
                  "reprefill_us", "decode_us", "compile_us",
-                 "aot_saved_us", "ttft_us",
+                 "aot_saved_us", "ttft_us", "transfer_us",
+                 "transfer_bytes",
                  "tokens_prefilled", "tokens_decoded", "tokens_emitted",
                  "covered_tokens", "spec_proposed", "spec_accepted",
                  "preempts", "steps", "deadline_met")
@@ -106,6 +107,10 @@ class CostReport:
         #                             (informational: NOT in attributed_us —
         #                             saved time was never on the device)
         self.ttft_us = None
+        self.transfer_us = 0.0      # disaggregated KV handoff wall time
+        #                             (informational, like aot_saved_us:
+        #                             fabric time, not device-step time)
+        self.transfer_bytes = 0     # KV bytes moved for the handoff
         self.tokens_prefilled = 0   # computed (padded) prefill tokens
         self.tokens_decoded = 0     # batched decode steps participated in
         self.tokens_emitted = 0     # tokens streamed (prefill + decode)
@@ -332,6 +337,18 @@ class Accountant:
             c.tokens_emitted += int(emitted)
             c.spec_proposed += int(proposed)
             c.spec_accepted += int(accepted)
+
+    def note_transfer(self, req, transfer_us, transfer_bytes):
+        """``req`` arrived via a disaggregated KV handoff
+        (``Scheduler.admit_handoff``): bill the fabric time and bytes
+        to its cost report. Informational like ``aot_saved_us`` — the
+        transfer ran on the wire, not the device, so it stays outside
+        the step-closure sum; the decode replica carries it because
+        that is where the handed-off request lands."""
+        c = req.cost
+        if c is not None:
+            c.transfer_us += float(transfer_us)
+            c.transfer_bytes += int(transfer_bytes)
 
     def note_decode_compile(self, compile_us):
         """XLA compile observed around the batched decode dispatch
@@ -585,6 +602,9 @@ class _NullAccountant(Accountant):
         pass
 
     def note_spec(self, req, emitted, proposed, accepted):
+        pass
+
+    def note_transfer(self, req, transfer_us, transfer_bytes):
         pass
 
     def note_decode_compile(self, compile_us):

@@ -135,12 +135,17 @@ class Registrar:
     TTL."""
 
     def __init__(self, store, url, replica_id=None, ttl_s=None,
-                 status_fn=None):
+                 status_fn=None, role=None):
         ident = _metrics.replica_identity()
         self.store = store
         self.url = url
         self.replica_id = str(replica_id) if replica_id is not None \
             else ident["replica_id"]
+        # serving role for disaggregated prefill/decode placement
+        # (serving/disagg.py): "prefill", "decode", or "mixed". Default
+        # "mixed" keeps existing fleets untouched — a mixed replica is a
+        # candidate for every stage.
+        self.role = "mixed" if role is None else str(role)
         self.ttl_s = float(flags_mod.flag("FLAGS_fleet_ttl_s")
                            if ttl_s is None else ttl_s)
         self._status_fn = status_fn
@@ -156,6 +161,7 @@ class Registrar:
              "start_ts": self._ident["start_ts"],
              "git_sha": git_sha(), "url": self.url,
              "ttl_s": self.ttl_s, "slot": self._slot,
+             "role": self.role,
              "heartbeat_ts": time.time()}
         if self._status_fn is not None:
             try:
@@ -567,6 +573,9 @@ class FleetAggregator:
                 uptime_s=max(now_wall - float(p.get("start_ts",
                                                     now_wall)), 1e-3))
             live.append({**p, "heartbeat_age_s": round(age, 3),
+                         # serving role for disaggregated placement —
+                         # pre-role payloads (old replicas) read "mixed"
+                         "role": p.get("role", "mixed"),
                          "health": health_score(snap),
                          "health_snapshot": snap})
             parsed_by[rid] = parsed

@@ -1,0 +1,321 @@
+"""Disaggregated prefill/decode serving: the two-stage pipeline.
+
+Prefill is compute-bound and decode is memory-bound; co-locating them
+on every replica wastes both. This module splits them: a
+:class:`DisaggPipeline` sits on top of the multi-replica
+:class:`~paddle_tpu.serving.router.Router` and serves each request in
+two stages over role-specialized replicas —
+
+1. **prefill stage** — the stage-aware candidate sweep
+   (``Router.stage_candidates("prefill")``: same-role + ``mixed``
+   replicas, ranked health-over-load) picks a prefill replica, which
+   runs ONLY the bucket ladder (``submit(prefill_only=True)``): the
+   request finishes ``DONE`` at its first token with the prompt's KV
+   blocks registered in the prefix cache, ready for export;
+2. **transfer** — ``kv_transfer.export_prefix`` serializes exactly
+   those blocks (int8 data + scale rows together on quantized pools)
+   into a crc-framed payload, which streams to the decode replica
+   through a :class:`LocalTransport` (in-process pools) or
+   :class:`RpcTransport` (the distributed/rpc.py channel) under the
+   ``disagg.transfer`` retry policy and fault site;
+3. **decode stage** — ``kv_transfer.import_prefix`` lands the blocks
+   into the decode replica's pool and registers the same digests, and
+   ``submit_handoff`` admits the request straight into the batched
+   decode step: ``plan_prefix`` reports full coverage,
+   ``alloc_slot_cached`` maps the imported blocks, and ZERO prefill
+   compute runs on the decode replica. The returned handle streams the
+   FULL sequence (the prefill-sampled first token re-emits through
+   it), so callers cannot tell the stages apart from co-located
+   serving — greedy outputs are bit-identical (tools/disagg_gate.py
+   pins it, fp32 and int8 pools).
+
+**Fail-open ladder** — a broken fabric must never lose a request. Any
+failure past the prefill stage (export refused, transfer fault, import
+rejected, decode-side admission refused, or simply no decode-stage
+candidate) degrades to CO-LOCATED serving on the prefill replica: its
+prefix cache still holds the prompt's blocks, so the fallback submit
+re-plans to full coverage and pays no extra prefill compute. Counted
+``serving.disagg.fallbacks``, degraded + flight-recorded
+(``resilience.degrade("disagg.fallback")``). Only when the fallback
+ALSO refuses does :class:`~.router.NoReplicaAvailable` propagate —
+carrying stage-keyed reasons (``no-prefill-replica`` /
+``no-decode-replica`` / ``transfer-failed``) next to the per-replica
+ones, with the smallest ``retry_after_s`` any structured rejection
+suggested.
+
+**Tracing** — the prefill request's ``serving.request`` root trace is
+the request's ONE trace: the transfer records a ``serving.transfer``
+child span (bytes, blocks, destination replica) and the decode stage
+opens a ``serving.decode_stage`` child on the SAME trace via the
+picklable span context (``trace_parent``), so route -> prefill ->
+transfer -> decode -> terminal reads as one cross-replica trace.
+``CostReport`` bills each stage to the replica that did the work: the
+prefill replica carries queue + prefill time, the decode replica
+carries decode time plus the informational ``transfer_us`` /
+``transfer_bytes`` axes.
+
+``FLAGS_serving_disagg=0`` (read at construction, the
+``FLAGS_serving_router`` convention) makes the pipeline a byte-for-byte
+pass-through to ``Router.submit`` — identical handles, zero
+``serving.disagg.*`` counter movement (tools/disagg_gate.py pins the
+silence).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..core import flags as flags_mod
+from ..core import resilience
+from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
+from ..testing import faults as _faults
+from . import kv_transfer
+from .kv_transfer import TransferError
+from .router import NoReplicaAvailable
+from .scheduler import HandoffError, QueueFullError
+from .frontend import NotReadyError
+
+__all__ = ["DisaggPipeline", "LocalTransport", "RpcTransport",
+           "register_rpc_engine"]
+
+_c_handoffs = _metrics.counter("serving.disagg.handoffs")
+_c_transfer_bytes = _metrics.counter("serving.disagg.transfer_bytes")
+_c_transfer_us = _metrics.counter("serving.disagg.transfer_us")
+_c_fallbacks = _metrics.counter("serving.disagg.fallbacks")
+
+
+class LocalTransport:
+    """In-process fabric: the frame lands straight into the decode
+    replica's pool. The topology every test/gate in this repo runs —
+    and the semantics :class:`RpcTransport` must match, since the frame
+    bytes are identical either way."""
+
+    def send(self, replica, frame):
+        if replica.engine is None:
+            raise TransferError(
+                f"transport: replica {replica.replica_id} has no "
+                f"engine to import into")
+        return kv_transfer.import_prefix(replica.engine.cache, frame)
+
+
+# rpc-visible import targets: an engine must be registered here (by the
+# process that owns it) before an RpcTransport can land frames into it
+_RPC_ENGINES = {}
+
+
+def register_rpc_engine(name, engine):
+    """Expose ``engine``'s pool as an rpc import target under ``name``
+    (conventionally its replica_id). The decode-side process calls this
+    once; ``_rpc_import`` resolves the name inside the rpc handler."""
+    _RPC_ENGINES[str(name)] = engine
+    return engine
+
+
+def _rpc_import(name, frame):
+    """Remote half of :class:`RpcTransport` — runs on the decode host
+    via ``distributed.rpc``. Loud KeyError on an unregistered target
+    (the caller's retry/fallback ladder handles it)."""
+    eng = _RPC_ENGINES.get(str(name))
+    if eng is None:
+        raise TransferError(
+            f"rpc import: no engine registered as {name!r} "
+            f"(call disagg.register_rpc_engine on the decode host)")
+    return kv_transfer.import_prefix(eng.cache, frame)
+
+
+class RpcTransport:
+    """Cross-host fabric: the frame ships over the distributed/rpc.py
+    channel (PR 4/6 — length-prefixed, crc-checked, trace-stitched) to
+    ``_rpc_import`` on the worker that owns the decode replica.
+    ``worker_of`` maps a replica_id to its rpc worker name (default:
+    the replica_id IS the worker name). Admission itself still needs an
+    engine-bound replica record (cross-host submit rides the rpc layer
+    — ROADMAP); this transport is the block-streaming half."""
+
+    def __init__(self, worker_of=None, timeout=60.0):
+        self._worker_of = worker_of or (lambda rid: rid)
+        self.timeout = float(timeout)
+
+    def send(self, replica, frame):
+        from ..distributed import rpc as _rpc
+        return _rpc.rpc_sync(
+            self._worker_of(replica.replica_id), _rpc_import,
+            args=(replica.replica_id, bytes(frame)),
+            timeout=self.timeout)
+
+
+class DisaggPipeline:
+    """See module docstring. Construct once per front door, over a
+    :class:`~.router.Router` whose replicas carry roles
+    (``add_replica(..., role=...)`` or the fleet registry ``role``
+    field). ``transport`` defaults to :class:`LocalTransport`;
+    ``prefill_timeout_s`` bounds the wait for the prefill stage's
+    first token."""
+
+    def __init__(self, router, transport=None, prefill_timeout_s=120.0):
+        self._armed = bool(flags_mod.flag("FLAGS_serving_disagg"))
+        self.router = router
+        self.transport = transport if transport is not None \
+            else LocalTransport()
+        self.prefill_timeout_s = float(prefill_timeout_s)
+
+    # -- stepping (foreground engines: tests/gates) ---------------------
+
+    def run_until_idle(self):
+        """Drive every foreground (``background=False``) engine-bound
+        replica until idle — the deterministic stepping helper gates
+        use. Background engines drive themselves."""
+        while True:
+            busy = False
+            for v in self.router.view():
+                rep = self.router._replicas.get(v["replica_id"])
+                if rep is None or rep.engine is None:
+                    continue
+                eng = rep.engine
+                if not eng._background and eng.has_work:
+                    eng.run_until_idle()
+                    busy = True
+            if not busy:
+                return
+
+    # -- submission -----------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens=32, *, deadline_s=None,
+               deadline=None, priority=None, on_token=None):
+        """Serve one request disaggregated; returns the decode-stage
+        handle (or, on fallback, the co-located handle — callers see
+        one handle streaming the full sequence either way). Disarmed,
+        a byte-for-byte ``Router.submit`` pass-through."""
+        if not self._armed:
+            return self.router.submit(
+                prompt_ids, max_new_tokens, deadline_s=deadline_s,
+                deadline=deadline, priority=priority, on_token=on_token)
+        if deadline is None and deadline_s is not None:
+            deadline = resilience.Deadline.after(deadline_s)
+
+        # -- stage 1: prefill ------------------------------------------
+        reasons = {}
+        retry_after = None
+        cands = self.router.stage_candidates("prefill", reasons=reasons)
+        if not cands:
+            reasons["no-prefill-replica"] = \
+                "no READY prefill-stage candidate"
+            raise NoReplicaAvailable(
+                "disagg: prefill stage starved", reasons=reasons,
+                retry_after_s=retry_after)
+        prefill_rep = None
+        phandle = None
+        for rep in cands:
+            try:
+                _faults.site("disagg.prefill")
+                phandle = rep.engine.submit(
+                    prompt_ids, max_new_tokens, deadline=deadline,
+                    priority=priority, prefill_only=True)
+                prefill_rep = rep
+                break
+            except (NotReadyError, QueueFullError, RuntimeError) as e:
+                reasons[rep.replica_id] = type(e).__name__
+                ra = getattr(e, "retry_after_s", None)
+                if ra is not None:
+                    retry_after = ra if retry_after is None \
+                        else min(retry_after, ra)
+        if prefill_rep is None:
+            reasons["no-prefill-replica"] = \
+                f"all {len(cands)} prefill candidate(s) refused"
+            raise NoReplicaAvailable(
+                "disagg: prefill stage starved", reasons=reasons,
+                retry_after_s=retry_after)
+        if not prefill_rep.engine._background:
+            prefill_rep.engine.run_until_idle()
+        toks = phandle.result(timeout=self.prefill_timeout_s)
+        preq = phandle._req
+        if not toks:
+            # the prefill stage terminated without a first token
+            # (cancelled / timed out / shed): nothing to hand off and
+            # nothing to fall back to — surface the terminal handle
+            return phandle
+        first_token = toks[0]
+        root = preq.span
+        ctx = root.context() if root.recording else None
+
+        # -- stage 2: transfer + decode admission ----------------------
+        err = None
+        try:
+            t0 = time.perf_counter_ns()
+            frame, exported = kv_transfer.export_prefix(
+                prefill_rep.engine.cache, prompt_ids)
+            dec_reasons = {}
+            dcands = self.router.stage_candidates(
+                "decode", exclude={prefill_rep.replica_id},
+                reasons=dec_reasons)
+            if not dcands:
+                reasons.update(dec_reasons)
+                reasons["no-decode-replica"] = \
+                    "no READY decode-stage candidate"
+                raise TransferError("disagg: decode stage starved")
+            pol = resilience.policy("disagg.transfer", max_attempts=2,
+                                    retry_on=(TransferError,
+                                              ConnectionError,
+                                              TimeoutError))
+            for rep in dcands:
+                try:
+                    def _send(rep=rep):
+                        _faults.site("disagg.transfer")
+                        return self.transport.send(rep, frame)
+                    imported = resilience.retry_call(_send, policy=pol)
+                    handle = rep.engine.submit_handoff(
+                        prompt_ids, first_token, max_new_tokens,
+                        deadline=deadline, priority=priority,
+                        on_token=on_token, trace_parent=ctx,
+                        transfer_us=(time.perf_counter_ns() - t0)
+                        / 1000.0,
+                        transfer_bytes=exported.nbytes)
+                except (TransferError, HandoffError, NotReadyError,
+                        QueueFullError, ConnectionError, TimeoutError,
+                        RuntimeError) as e:
+                    reasons[rep.replica_id] = type(e).__name__
+                    err = e
+                    continue
+                dur_us = (time.perf_counter_ns() - t0) / 1000.0
+                _c_handoffs.inc()
+                _c_transfer_bytes.inc(exported.nbytes)
+                _c_transfer_us.inc(dur_us)
+                _tracing.record_span(
+                    "serving.transfer", root, dur_us,
+                    nbytes=exported.nbytes, blocks=exported.blocks,
+                    src=prefill_rep.replica_id, dst=rep.replica_id)
+                return handle
+            reasons["transfer-failed"] = \
+                f"all {len(dcands)} decode candidate(s) refused " \
+                f"({type(err).__name__ if err else 'unknown'})"
+            raise err if err is not None else TransferError(
+                "disagg: transfer failed")
+        except (TransferError, HandoffError, NotReadyError,
+                QueueFullError, ConnectionError, TimeoutError,
+                RuntimeError) as e:
+            # -- fail open: co-located serving on the prefill replica.
+            # Its prefix cache still holds the prompt's blocks, so the
+            # fallback re-plans to full coverage — no re-prefill, no
+            # lost request, a broken fabric degrades instead of failing
+            _c_fallbacks.inc()
+            resilience.degrade(
+                "disagg.fallback",
+                detail=f"prefill={prefill_rep.replica_id} "
+                       f"rid={preq.rid}", exc=e)
+            try:
+                return prefill_rep.engine.submit(
+                    prompt_ids, max_new_tokens, deadline=deadline,
+                    priority=priority, on_token=on_token)
+            except (NotReadyError, QueueFullError, RuntimeError) as fe:
+                reasons[prefill_rep.replica_id] = type(fe).__name__
+                reasons.setdefault("transfer-failed",
+                                   type(e).__name__)
+                ra = getattr(fe, "retry_after_s", None)
+                if ra is not None:
+                    retry_after = ra if retry_after is None \
+                        else min(retry_after, ra)
+                raise NoReplicaAvailable(
+                    "disagg: transfer failed and co-located fallback "
+                    "refused", reasons=reasons,
+                    retry_after_s=retry_after) from fe

@@ -53,12 +53,18 @@ from ..core import resilience
 from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
 from .bucketing import bucket_lengths
-from .scheduler import (AdmissionRejected, QueueFullError,
-                        RequestStatus, Scheduler)
+from .scheduler import (AdmissionRejected, HandoffError,
+                        QueueFullError, RequestStatus, Scheduler)
 
 __all__ = ["ServingEngine", "RequestHandle", "QueueFullError",
            "AdmissionRejected", "RequestStatus", "Lifecycle",
-           "NotReadyError"]
+           "NotReadyError", "HandoffError"]
+
+# replica roles (disaggregated serving, serving/disagg.py): the fleet
+# registry carries the role so a stage-aware router can rank prefill
+# and decode candidates separately; "mixed" (the default) serves both
+# stages co-located — existing fleets are untouched
+ROLES = ("mixed", "prefill", "decode")
 
 _SENTINEL = object()
 
@@ -197,8 +203,16 @@ class ServingEngine:
                  bucket_cap=None, prefix_cache=None, accounting=None,
                  admission=None, brownout=None, kv_cache_dtype=None,
                  spec=None, spec_tokens=None, mesh=None,
-                 background=True, ready=True):
+                 background=True, ready=True, role=None):
         self._state = Lifecycle.WARMING
+        # disaggregation role (serving/disagg.py): advertised through
+        # the fleet registry and the stage-aware router; "mixed" is
+        # byte-for-byte the pre-disagg engine
+        self.role = "mixed" if role is None else str(role)
+        if self.role not in ROLES:
+            raise ValueError(
+                f"ServingEngine: unknown role {role!r} "
+                f"(expected one of {ROLES})")
         self._sched = Scheduler(
             model, max_batch=max_batch, block_size=block_size,
             max_seq_len=max_seq_len, num_blocks=num_blocks,
@@ -227,7 +241,8 @@ class ServingEngine:
     # -- submission ----------------------------------------------------
 
     def submit(self, prompt_ids, max_new_tokens=32, *, deadline_s=None,
-               deadline=None, priority=None, on_token=None):
+               deadline=None, priority=None, on_token=None,
+               prefill_only=False):
         """Enqueue a request; returns a RequestHandle immediately.
 
         ``deadline_s`` (relative seconds) or ``deadline`` (a
@@ -242,6 +257,10 @@ class ServingEngine:
         under pressure and the brownout ladder's admission floor.
         ``on_token(token)`` is called per generated token from the
         stepping thread — keep it fast.
+        ``prefill_only`` (disaggregated serving, serving/disagg.py)
+        runs ONLY the prefill stage: the request finishes ``DONE`` at
+        its first token with the prompt's KV blocks registered for
+        ``kv_transfer.export_prefix`` — requires the prefix cache.
         """
         handle = RequestHandle(self)
 
@@ -276,14 +295,70 @@ class ServingEngine:
             handle._req = self._sched.submit(
                 prompt_ids, max_new_tokens, deadline=deadline,
                 priority=priority, on_token=_sink_token,
-                on_finish=_sink_finish)
-            if self._background and self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._drive, name="paddle-tpu-serving",
-                    daemon=True)
-                self._thread.start()
+                on_finish=_sink_finish, prefill_only=prefill_only)
+            self._ensure_driver()
             self._cond.notify_all()
         return handle
+
+    def submit_handoff(self, prompt_ids, first_token,
+                       max_new_tokens=32, *, deadline_s=None,
+                       deadline=None, priority=None, on_token=None,
+                       trace_parent=None, transfer_us=0.0,
+                       transfer_bytes=0):
+        """Disaggregated decode-stage admission (serving/disagg.py):
+        the prompt's KV blocks were imported into this engine's pool
+        (``kv_transfer.import_prefix``) and ``first_token`` came from
+        the prefill replica — admit straight into the batched decode
+        step, zero prefill compute here. Same lifecycle gate as
+        :meth:`submit`; the handle streams the FULL sequence (the
+        first token re-emits through it). Raises
+        :class:`~.scheduler.HandoffError` when the imported prefix
+        does not cover the prompt or no slot/blocks are free — the
+        pipeline falls back to co-located serving."""
+        handle = RequestHandle(self)
+
+        def _sink_token(req, tok):
+            handle._q.put(tok)
+            if on_token is not None:
+                on_token(tok)
+
+        def _sink_finish(req):
+            handle._q.put(_SENTINEL)
+            handle._done.set()
+
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("ServingEngine is closed")
+            if self._error is not None:
+                raise RuntimeError(
+                    "ServingEngine died; no new submissions") \
+                    from self._error
+            if self._state != Lifecycle.READY:
+                hint = "call warmup() first" \
+                    if self._state == Lifecycle.WARMING \
+                    else "route to another replica"
+                raise NotReadyError(
+                    f"ServingEngine is {self._state}; not accepting "
+                    f"new requests ({hint})")
+            if deadline is None and deadline_s is not None:
+                deadline = resilience.Deadline.after(deadline_s)
+            handle._req = self._sched.admit_handoff(
+                prompt_ids, first_token, max_new_tokens,
+                deadline=deadline, priority=priority,
+                on_token=_sink_token, on_finish=_sink_finish,
+                trace_parent=trace_parent, transfer_us=transfer_us,
+                transfer_bytes=transfer_bytes)
+            self._ensure_driver()
+            self._cond.notify_all()
+        return handle
+
+    def _ensure_driver(self):
+        # caller holds the lock
+        if self._background and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._drive, name="paddle-tpu-serving",
+                daemon=True)
+            self._thread.start()
 
     def cancel(self, handle):
         with self._cond:
@@ -368,7 +443,35 @@ class ServingEngine:
                                      sched.max_seq_len)
             t0 = time.perf_counter_ns()
             n = 0
-            decoded = False
+            # role-specialized warm sets (disaggregated serving):
+            # prefill replicas run ONLY the bucket ladder (they never
+            # decode), decode replicas warm ONLY the decode/spec
+            # programs (handoffs never prefill here) — mixed warms both
+            decoded = self.role == "prefill"
+            if self.role == "decode":
+                buckets = []
+                slot = cache.alloc_slot(cache.block_size)
+                if slot is not None:
+                    try:
+                        active = np.zeros((cache.max_batch,), bool)
+                        active[slot] = True
+                        sched.model.paged_decode_step(
+                            cache, np.zeros((cache.max_batch,),
+                                            np.int64), active,
+                            temperature=sched.temperature)
+                        n += 1
+                        if sched.spec:
+                            sk = sched.spec_tokens
+                            sched.model.paged_spec_step(
+                                cache,
+                                np.zeros((cache.max_batch,), np.int64),
+                                np.zeros((cache.max_batch, sk),
+                                         np.int64),
+                                np.full((cache.max_batch,), 1 + sk,
+                                        np.int64), active)
+                            n += 1
+                    finally:
+                        cache.free_slot(slot)
             with _tracing.span("serving.warmup", buckets=len(buckets)):
                 for b in buckets:
                     slot = cache.alloc_slot(b)
@@ -575,7 +678,7 @@ class ServingEngine:
             if _fleet.armed(store):
                 reg = _fleet.Registrar(
                     store, srv.url(""), replica_id=replica_id,
-                    status_fn=lambda: self._state)
+                    status_fn=lambda: self._state, role=self.role)
                 reg.start()
                 with self._lock:
                     if self._registrar is None:

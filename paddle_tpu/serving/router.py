@@ -100,7 +100,10 @@ class NoReplicaAvailable(RuntimeError):
     exception's type name, e.g. ``QueueFullError`` /
     ``AdmissionRejected``), and ``retry_after_s`` carries the smallest
     back-off any structured rejection suggested (None when none
-    did)."""
+    did). Disaggregated two-stage sweeps (serving/disagg.py) add
+    stage-level entries — ``no-prefill-replica`` /
+    ``no-decode-replica`` / ``transfer-failed`` — so the exception
+    alone says which stage starved."""
 
     def __init__(self, message, *, reasons=None, retry_after_s=None):
         self.reasons = dict(reasons or {})
@@ -117,13 +120,30 @@ class RouterReplica:
     submit target), and/or a fleet-registry payload whose heartbeat
     age and state feed the weight."""
 
-    __slots__ = ("replica_id", "engine", "url", "member")
+    __slots__ = ("replica_id", "engine", "url", "member", "_role")
 
-    def __init__(self, replica_id, engine=None, url=None, member=None):
+    def __init__(self, replica_id, engine=None, url=None, member=None,
+                 role=None):
         self.replica_id = str(replica_id)
         self.engine = engine
         self.url = url
         self.member = member  # latest fleet/member/<n> payload, if any
+        self._role = None if role is None else str(role)
+
+    @property
+    def role(self):
+        """Serving role for disaggregated placement: explicit value
+        wins, else the fleet-registry payload, else the bound engine's
+        own role, else ``"mixed"`` (a candidate for every stage — the
+        pre-disaggregation default, so existing fleets are
+        untouched)."""
+        if self._role is not None:
+            return self._role
+        if self.member is not None and self.member.get("role"):
+            return str(self.member["role"])
+        if self.engine is not None:
+            return getattr(self.engine, "role", "mixed")
+        return "mixed"
 
     def ready(self):
         """READY on the drain lifecycle. In-process engines answer
@@ -315,10 +335,12 @@ class Router:
                 self._order.append(rep.replica_id)
             self._replicas[rep.replica_id] = rep
 
-    def add_replica(self, replica_id, engine=None, url=None):
+    def add_replica(self, replica_id, engine=None, url=None, role=None):
         """Register (or re-bind) a replica; returns its record. An
         engine bound to an already-discovered registry entry merges
-        with it (the heartbeat keeps feeding the weight)."""
+        with it (the heartbeat keeps feeding the weight). ``role``
+        pins the serving role explicitly (else it resolves from the
+        registry payload / engine — see :attr:`RouterReplica.role`)."""
         with self._lock:
             rep = self._replicas.get(str(replica_id))
             if rep is not None:
@@ -326,8 +348,11 @@ class Router:
                     rep.engine = engine
                 if url is not None:
                     rep.url = url
+                if role is not None:
+                    rep._role = str(role)
                 return rep
-        rep = RouterReplica(replica_id, engine=engine, url=url)
+        rep = RouterReplica(replica_id, engine=engine, url=url,
+                            role=role)
         self._add(rep)
         return rep
 
@@ -378,18 +403,25 @@ class Router:
 
     # -- placement ------------------------------------------------------
 
-    def _candidates(self, exclude=(), reasons=None):
+    def _candidates(self, exclude=(), reasons=None, stage=None):
         """READY, engine-bound replicas ranked health-over-load.
         ``reasons`` (a dict, mutated) collects why every OTHER known
         replica was refused — the per-replica diagnosis
-        :class:`NoReplicaAvailable` carries."""
+        :class:`NoReplicaAvailable` carries. ``stage`` (``"prefill"``
+        / ``"decode"``, disaggregated serving) filters by role: a
+        stage accepts same-role and ``mixed`` replicas, never the
+        opposite specialist — a prefill-only replica must not take
+        decode traffic and vice versa."""
         self.refresh()
         with self._lock:
             reps = [self._replicas[rid] for rid in self._order
                     if rid not in exclude]
         cands = []
         for r in reps:
-            if r.engine is None:
+            if stage is not None and r.role not in ("mixed", stage):
+                if reasons is not None:
+                    reasons[r.replica_id] = f"WrongRole({r.role})"
+            elif r.engine is None:
                 if reasons is not None:
                     reasons[r.replica_id] = "NoEngine"
             elif not r.ready():
@@ -399,11 +431,21 @@ class Router:
                         else f"NotReady({r.engine.lifecycle})")
             else:
                 cands.append(r)
-        _g_routable.set(len(cands))
+        if stage is None:
+            _g_routable.set(len(cands))
         # health over load: equal replicas round-robin via the inflight
         # damping, a zero-health (silent/burning) replica sorts last
         cands.sort(key=lambda r: -(r.health() / (1.0 + r.inflight())))
         return cands
+
+    def stage_candidates(self, stage, exclude=(), reasons=None):
+        """Ranked candidates for one disaggregation stage
+        (``"prefill"`` / ``"decode"``): the :meth:`_candidates` sweep
+        with role filtering. serving/disagg.py's two-stage pipeline
+        calls this once per stage and carries the refusal reasons into
+        its stage-keyed :class:`NoReplicaAvailable`."""
+        return self._candidates(exclude=exclude, reasons=reasons,
+                                stage=str(stage))
 
     def _breaker(self, replica_id):
         b = self._breakers.get(replica_id)
@@ -548,7 +590,7 @@ class Router:
         return [{"replica_id": r.replica_id,
                  "submittable": r.engine is not None,
                  "ready": r.ready(), "health": r.health(),
-                 "inflight": r.inflight(),
+                 "inflight": r.inflight(), "role": r.role,
                  "state": (r.engine.lifecycle if r.engine is not None
                            else (r.member or {}).get("state"))}
                 for r in reps]

@@ -76,7 +76,15 @@ from .bucketing import bucket_length
 from .overload import AdmissionRejected
 
 __all__ = ["RequestStatus", "ServingRequest", "Scheduler",
-           "QueueFullError", "AdmissionRejected"]
+           "QueueFullError", "AdmissionRejected", "HandoffError"]
+
+
+class HandoffError(RuntimeError):
+    """A disaggregated handoff admission failed on the decode side:
+    the imported prefix does not fully cover the prompt, or the
+    replica is out of slots/blocks right now. Raised BEFORE the
+    request exists — serving/disagg.py catches it and fails open to
+    co-located serving (the request is never lost)."""
 
 
 class QueueFullError(RuntimeError):
@@ -117,7 +125,7 @@ class ServingRequest:
                  "preempts", "admit_seq", "submitted_at", "admitted_at",
                  "first_token_at", "last_token_at", "cancel_requested",
                  "span", "cost", "priority", "est_tokens",
-                 "retry_after_s")
+                 "retry_after_s", "prefill_only")
 
     def __init__(self, rid, prompt, max_new_tokens, deadline=None,
                  on_token=None, on_finish=None,
@@ -150,6 +158,10 @@ class ServingRequest:
         self.priority = priority
         self.est_tokens = 0
         self.retry_after_s = None
+        # disaggregated serving (serving/disagg.py): a prefill-stage
+        # request finishes DONE at its first token — the decode stage
+        # runs on another replica after the KV handoff
+        self.prefill_only = False
 
     @property
     def trace_id(self):
@@ -349,7 +361,8 @@ class Scheduler:
     # -- submission / cancellation ------------------------------------
 
     def submit(self, prompt_ids, max_new_tokens=32, *, deadline=None,
-               priority=None, on_token=None, on_finish=None):
+               priority=None, on_token=None, on_finish=None,
+               prefill_only=False):
         """Validate + enqueue; returns the ServingRequest. Raises
         ValueError on malformed or never-servable input (never corrupts
         the cache, never hangs admission), QueueFullError past the
@@ -358,10 +371,23 @@ class Scheduler:
         priority the brownout ladder's current stage refuses (both
         BEFORE any queueing: fail fast, never pay prefill for a
         request that cannot finish). ``priority`` is an int class,
-        smaller = more important (default overload.NORMAL)."""
+        smaller = more important (default overload.NORMAL).
+
+        ``prefill_only`` is the disaggregation prefill stage (serving/
+        disagg.py): the request runs ONLY the bucket-ladder prefill and
+        finishes ``DONE`` at its first token, leaving the prompt's KV
+        blocks registered in the prefix index — exactly the state
+        ``serving/kv_transfer.export_prefix`` serializes. It requires
+        the prefix cache (without ``commit_prefix`` the blocks would
+        free on finish and there would be nothing to hand off)."""
         prompt = validate_request(prompt_ids, max_new_tokens,
                                   self.max_seq_len, self.cache,
                                   who="serving.submit")
+        if prefill_only and not self.prefix_cache:
+            raise ValueError(
+                "serving.submit: prefill_only requires the prefix "
+                "cache (FLAGS_serving_prefix_cache) — finished blocks "
+                "must stay registered for export")
         pri = _overload.NORMAL if priority is None else int(priority)
         if self.max_queue and len(self.queue) >= self.max_queue:
             _m_rejected.inc()
@@ -380,6 +406,7 @@ class Scheduler:
                              deadline=deadline, on_token=on_token,
                              on_finish=on_finish, priority=pri)
         req.est_tokens = est
+        req.prefill_only = bool(prefill_only)
         self._next_rid += 1
         req.span = _tracing.start_trace(
             "serving.request", rid=req.rid, prompt_len=len(prompt),
@@ -387,6 +414,85 @@ class Scheduler:
         self.accounting.attach(req)
         self.queue.append(req)
         _g_queue.set(len(self.queue))
+        return req
+
+    def admit_handoff(self, prompt_ids, first_token, max_new_tokens=32,
+                      *, deadline=None, priority=None, on_token=None,
+                      on_finish=None, trace_parent=None,
+                      transfer_us=0.0, transfer_bytes=0):
+        """Disaggregated decode-stage admission (serving/disagg.py):
+        the prompt's KV blocks were just imported (``serving/
+        kv_transfer.import_prefix``) and ``first_token`` was sampled by
+        the prefill replica — map the imported blocks read-only and
+        enter the batched decode step directly. NO prefill program runs
+        on this replica (``serving.prefix.computed_tokens`` stays
+        silent; tools/disagg_gate.py pins zero prefill dispatches).
+
+        The first token re-emits HERE so the request's stream/handle
+        carries the full sequence, and greedy decode from the imported
+        rows is bit-identical to co-located serving. Raises
+        :class:`HandoffError` (pool untouched) when the prefix is not
+        fully resident or the replica has no slot/blocks — the caller
+        fails open to co-located serving.
+
+        ``trace_parent`` (a span ``context()`` dict off the prefill
+        replica's ``serving.request`` root) stitches this stage's spans
+        into the SAME cross-replica trace; ``transfer_us``/``transfer_
+        bytes`` bill the fabric hop to this request's CostReport."""
+        prompt = validate_request(prompt_ids, max_new_tokens,
+                                  self.max_seq_len, self.cache,
+                                  who="serving.admit_handoff")
+        if not self.prefix_cache:
+            raise HandoffError(
+                "serving.admit_handoff: prefix cache disarmed — "
+                "imported blocks cannot be admitted")
+        plan = self.cache.plan_prefix(prompt)
+        if plan.covered_tokens != plan.num_tokens:
+            raise HandoffError(
+                f"serving.admit_handoff: imported prefix covers "
+                f"{plan.covered_tokens}/{plan.num_tokens} tokens")
+        if len(self.running) >= self.cache.max_batch:
+            raise HandoffError(
+                "serving.admit_handoff: no free decode slot")
+        slot = self.cache.alloc_slot_cached(plan)
+        if slot is None:
+            raise HandoffError(
+                "serving.admit_handoff: out of slots/blocks")
+        pri = _overload.NORMAL if priority is None else int(priority)
+        req = ServingRequest(self._next_rid, prompt,
+                             int(max_new_tokens), deadline=deadline,
+                             on_token=on_token, on_finish=on_finish,
+                             priority=pri)
+        self._next_rid += 1
+        # stitch into the prefill replica's trace when a context rode
+        # the handoff; a fresh root otherwise (unsampled/off upstream)
+        child = _tracing.span("serving.decode_stage",
+                              parent=trace_parent, rid=req.rid,
+                              prompt_len=len(prompt))
+        req.span = child if child.recording else _tracing.start_trace(
+            "serving.request", rid=req.rid, prompt_len=len(prompt),
+            max_new_tokens=int(max_new_tokens), stage="decode")
+        self.accounting.attach(req)
+        self.accounting.note_transfer(req, transfer_us, transfer_bytes)
+        req.status = RequestStatus.RUNNING
+        req.slot = slot
+        req.admit_seq = self._next_admit_seq
+        self._next_admit_seq += 1
+        req.admitted_at = time.monotonic()
+        self.running[slot] = req
+        _m_admitted.inc()
+        # imported blocks fully cover the prompt: the decode step's
+        # append lands at position len(prompt) (first_token's KV row),
+        # exactly the state a local prefill would have left
+        self.cache.seq_lens[slot] = plan.num_tokens
+        self._last_tok[slot] = int(first_token)
+        self._remaining[slot] = int(max_new_tokens) - 1
+        _tracing.record_span("serving.handoff_admit", req.span, 0.0,
+                             hit_blocks=plan.hit_blocks,
+                             transfer_bytes=int(transfer_bytes))
+        self._emit(req, int(first_token))
+        self._maybe_finish(slot)
+        self._update_gauges()
         return req
 
     def cancel(self, req):
@@ -592,6 +698,11 @@ class Scheduler:
             self._last_tok[slot] = tok
             self._remaining[slot] = \
                 req.max_new_tokens - len(req.generated) - 1
+            if req.prefill_only:
+                # disagg prefill stage: stop at the first token — the
+                # decode stage continues from the handed-off blocks on
+                # another replica (serving/disagg.py)
+                self._remaining[slot] = 0
             self._emit(req, tok)
             out.append((req.rid, tok))
             self._maybe_finish(slot)

@@ -32,7 +32,10 @@ quantized tokens/s on the serving scheduler), ``fleet_load``
 the worst phase — scenario_ok/gate_ok pass bits, arrivals/accepted/
 shed/failover/dropped counts, min high_goodput_frac, min
 prefix_hit_rate, max ttft_p95_us — every number read through
-scenario-scoped profiler.metrics Windows, never a registry reset).
+scenario-scoped profiler.metrics Windows, never a registry reset),
+``disagg`` (tools/disagg_gate.py disaggregated serving: handoff and
+fallback counts, transfer bytes/us, bit-equivalence / zero-reprefill
+/ fail-open / disarmed check bits).
 The ledger itself is schema-free — any kind/metrics pair appends.
 
 CLI::

@@ -1,0 +1,272 @@
+"""Disaggregated serving gate: the prefill/decode split (ISSUE 17)
+through four pass/fail checks, in order of importance:
+
+  1. bit-equivalence — greedy outputs through the two-stage pipeline
+     (prefill-role replica -> kv_transfer frame -> decode-role
+     replica) are BIT-IDENTICAL to co-located serving, fp32 AND int8
+     pools, including shared-prefix traffic (two prompts sharing a
+     block-aligned prefix hand off against the same imported blocks);
+  2. zero re-prefill — the decode replica runs ZERO prefill programs:
+     its model's ``paged_prefill``/``paged_prefill_extend`` entry
+     points are wrapped and counted (the engines use two same-seed
+     model instances, so the count isolates the decode side), and
+     every handed-off request's CostReport bills 0 prefilled tokens
+     while carrying the fabric's ``transfer_bytes``;
+  3. fail-open — a persistently injected ``disagg.transfer`` fault
+     degrades every request to co-located serving on the prefill
+     replica: zero handoffs, one fallback per request, every request
+     DONE with outputs still bit-identical to the reference — a
+     broken fabric must never lose a request;
+  4. disarmed — ``FLAGS_serving_disagg=0`` is a byte-for-byte
+     ``Router.submit`` pass-through with ``serving.disagg.*`` counter
+     silence.
+
+Exit 0 on pass, 1 on fail; one line per check. Runs under
+JAX_PLATFORMS=cpu (tier-1, like tests/framework/test_disagg.py);
+wired into tools/suite_gate.py beside the serving gates, and appends
+a ``disagg`` entry (handoffs, transfer bytes/us, fallbacks, check
+bits) to the continuous-bench ledger (tools/bench_ledger.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# three prompts, the second sharing the first's full leading block
+# (block_size=8) so the shared-prefix handoff path dedups on import
+PROMPT_SIZES = ((1, 13), (1, 9, 17), (40, 60))
+MAX_NEW = 8
+
+
+def _model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _engine(model, role="mixed", **kw):
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving import ServingEngine
+
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("bucket_cap", 32)
+    return ServingEngine(model, temperature=0.0, background=False,
+                         dtype=jnp.float32, prefix_cache=True,
+                         role=role, **kw)
+
+
+def _prompts():
+    out = []
+    for spec in PROMPT_SIZES:
+        if len(spec) == 2:
+            out.append(list(range(spec[0], spec[1])))
+        else:
+            out.append(list(range(spec[0], spec[1]))
+                       + list(range(spec[1], spec[2])))
+    # the shared prefix: prompt 1 is a strict extension of prompt 0's
+    # first block, so its handoff dedups against the resident import
+    out[1] = out[0][:8] + [101, 102, 103, 104, 105]
+    return out
+
+
+class _CountingModel:
+    """Wrap a model so every prefill-program dispatch is counted —
+    process-global metrics cannot isolate one engine, a wrapper can."""
+
+    def __init__(self, model):
+        self._m = model
+        self.prefill_calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self._m, name)
+
+    def paged_prefill(self, *a, **kw):
+        self.prefill_calls += 1
+        return self._m.paged_prefill(*a, **kw)
+
+    def paged_prefill_extend(self, *a, **kw):
+        self.prefill_calls += 1
+        return self._m.paged_prefill_extend(*a, **kw)
+
+
+def _reference(prompts, **kw):
+    ref = _engine(_model(), **kw)
+    out = []
+    for p in prompts:
+        h = ref.submit(p, max_new_tokens=MAX_NEW)
+        ref.run_until_idle()
+        out.append(h.result(timeout=60))
+    ref.close()
+    return out
+
+
+def _disagg_run(prompts, **kw):
+    """One disaggregated fleet pass: returns (outputs, statuses,
+    costs, decode_prefill_calls)."""
+    from paddle_tpu.serving import Router
+    from paddle_tpu.serving.disagg import DisaggPipeline
+
+    dec_model = _CountingModel(_model())
+    pre = _engine(_model(), role="prefill", **kw)
+    dec = _engine(dec_model, role="decode", **kw)
+    router = Router()
+    router.add_replica("pre", engine=pre)
+    router.add_replica("dec", engine=dec)
+    pipe = DisaggPipeline(router)
+    outs, statuses, costs = [], [], []
+    for p in prompts:
+        h = pipe.submit(p, max_new_tokens=MAX_NEW)
+        pipe.run_until_idle()
+        outs.append(h.result(timeout=60))
+        statuses.append(h.status)
+        costs.append(h.cost())
+    calls = dec_model.prefill_calls
+    pre.close()
+    dec.close()
+    return outs, statuses, costs, calls
+
+
+def check_bit_equivalence():
+    prompts = _prompts()
+    results = {}
+    for label, kw in (("fp32", {}), ("int8",
+                                     {"kv_cache_dtype": "int8"})):
+        want = _reference(prompts, **kw)
+        got, statuses, _, _ = _disagg_run(prompts, **kw)
+        results[label] = (got == want
+                          and all(s == "DONE" for s in statuses))
+    ok = results["fp32"] and results["int8"]
+    print(f"[disagg-gate] bit-equivalence: fp32={results['fp32']} "
+          f"int8={results['int8']} ({len(prompts)} prompts incl. "
+          f"shared prefix) {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_zero_reprefill():
+    from paddle_tpu.profiler import metrics
+
+    before = metrics.snapshot().get("serving.disagg.handoffs", 0)
+    prompts = _prompts()
+    _, statuses, costs, decode_prefills = _disagg_run(prompts)
+    handoffs = metrics.snapshot().get("serving.disagg.handoffs", 0) \
+        - before
+    billed_prefill = sum(c.tokens_prefilled for c in costs if c)
+    billed_bytes = sum(c.transfer_bytes for c in costs if c)
+    ok = (decode_prefills == 0 and handoffs == len(prompts)
+          and billed_prefill == 0 and billed_bytes > 0
+          and all(s == "DONE" for s in statuses))
+    print(f"[disagg-gate] zero-reprefill: decode-replica prefill "
+          f"dispatches={decode_prefills} (want 0), handoffs="
+          f"{handoffs}/{len(prompts)}, decode-side billed prefill "
+          f"tokens={billed_prefill} (want 0), transfer_bytes="
+          f"{billed_bytes} {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_fail_open():
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.testing import faults
+
+    prompts = _prompts()
+    want = _reference(prompts)
+    snap0 = metrics.snapshot()
+    with faults.inject("disagg.transfer", nth=1, count=10_000):
+        got, statuses, _, _ = _disagg_run(prompts)
+    snap1 = metrics.snapshot()
+    fallbacks = snap1.get("serving.disagg.fallbacks", 0) \
+        - snap0.get("serving.disagg.fallbacks", 0)
+    handoffs = snap1.get("serving.disagg.handoffs", 0) \
+        - snap0.get("serving.disagg.handoffs", 0)
+    clean = all(s == "DONE" for s in statuses)
+    ok = (clean and got == want and handoffs == 0
+          and fallbacks == len(prompts))
+    print(f"[disagg-gate] fail-open: injected transfer fault -> "
+          f"fallbacks={fallbacks}/{len(prompts)}, handoffs={handoffs} "
+          f"(want 0), all-DONE={clean}, bit-identical="
+          f"{got == want} {'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def check_disarmed():
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import Router
+    from paddle_tpu.serving.disagg import DisaggPipeline
+
+    saved = paddle.get_flags(["FLAGS_serving_disagg"])
+    try:
+        paddle.set_flags({"FLAGS_serving_disagg": False})
+        before = metrics.snapshot("serving.disagg.")
+        pre = _engine(_model(), role="prefill")
+        dec = _engine(_model(), role="decode")
+        router = Router()
+        router.add_replica("pre", engine=pre)
+        router.add_replica("dec", engine=dec)
+        pipe = DisaggPipeline(router)
+        h = pipe.submit(_prompts()[0], max_new_tokens=MAX_NEW)
+        pre.run_until_idle()
+        dec.run_until_idle()
+        toks = h.result(timeout=60)
+        silent = metrics.snapshot("serving.disagg.") == before
+        passthrough = hasattr(h, "replica_id")  # a router handle
+        pre.close()
+        dec.close()
+    finally:
+        paddle.set_flags(saved)
+    ok = h.status == "DONE" and silent and passthrough and bool(toks)
+    print(f"[disagg-gate] disarmed: counter-silent={silent} "
+          f"router-passthrough={passthrough} status={h.status} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+
+    paddle.set_flags({"FLAGS_serving_router": True,
+                      "FLAGS_serving_disagg": True})
+    ok1 = check_bit_equivalence()
+    ok2 = check_zero_reprefill()
+    ok3 = check_fail_open()
+    ok4 = check_disarmed()
+    ok = ok1 and ok2 and ok3 and ok4
+    snap = metrics.snapshot()
+    try:
+        import bench_ledger
+        bench_ledger.append_entry("disagg", {
+            "handoffs": float(snap.get("serving.disagg.handoffs", 0)),
+            "transfer_bytes": float(
+                snap.get("serving.disagg.transfer_bytes", 0)),
+            "transfer_us": float(
+                snap.get("serving.disagg.transfer_us", 0.0)),
+            "fallbacks": float(
+                snap.get("serving.disagg.fallbacks", 0)),
+            "bit_equivalence_ok": 1.0 if ok1 else 0.0,
+            "zero_reprefill_ok": 1.0 if ok2 else 0.0,
+            "fail_open_ok": 1.0 if ok3 else 0.0,
+            "disarmed_ok": 1.0 if ok4 else 0.0})
+        print("[disagg-gate] ledger: appended disagg "
+              f"(handoffs={snap.get('serving.disagg.handoffs', 0)}, "
+              f"fallbacks={snap.get('serving.disagg.fallbacks', 0)})")
+    except Exception as e:  # noqa: BLE001 — ledger trouble is advisory
+        print(f"[disagg-gate] ledger append skipped "
+              f"({type(e).__name__}: {e})")
+    print(f"[disagg-gate] {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

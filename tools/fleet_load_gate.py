@@ -3,7 +3,7 @@ a composed loadgen scenario (burst storm under shed + replica kill
 mid-storm + drain mid-storm + shared-prefix locality) driven against a
 3-replica in-process fleet (Router + overload plane, the PR 11-13
 stack), graded by profiler/scorecard.py through scenario-scoped
-metric Windows. Five pass/fail checks:
+metric Windows. Six pass/fail checks:
 
   1. storm-shed    — the burst storm actually sheds (``serving.shed``
                      > 0 inside the storm's Window) while the HIGH
@@ -25,7 +25,13 @@ metric Windows. Five pass/fail checks:
                      fleet level;
   5. determinism   — the same (scenario, seed) schedules
                      byte-identically twice (the loadgen purity
-                     contract the whole harness rests on).
+                     contract the whole harness rests on);
+  6. disagg        — a prefill/decode role pair behind the ISSUE 17
+                     two-stage pipeline takes a shared-prefix burst:
+                     every request reaches a clean terminal, real
+                     handoffs happen, and anything the fabric could
+                     not hand off fell OPEN to co-located serving
+                     (handoffs + fallbacks == arrivals).
 
 Every number is read through a per-phase ``metrics.Window`` — the
 global registry is never reset. Appends a ``fleet_load`` entry
@@ -158,6 +164,74 @@ def check_locality(card):
     return ok
 
 
+def check_disagg():
+    """Disaggregated serving under a shared-prefix burst (ISSUE 17):
+    a prefill-role + decode-role pair behind the two-stage pipeline
+    takes a loadgen burst; every accepted request must reach a clean
+    terminal with at least one real handoff, and every request the
+    fabric could not hand off (decode slots exhausted mid-burst) must
+    fail OPEN to co-located serving — handoffs + fallbacks == n.
+    Counters read through a scoped ``metrics.Window``, the scenario
+    discipline."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import Router, ServingEngine, loadgen
+    from paddle_tpu.serving.disagg import DisaggPipeline
+
+    saved = paddle.get_flags(["FLAGS_serving_router",
+                              "FLAGS_serving_disagg"])
+    paddle.set_flags({"FLAGS_serving_router": True,
+                      "FLAGS_serving_disagg": True})
+    try:
+        spec = loadgen.WorkloadSpec(
+            prompt_len=(12, 20), max_new_tokens=(3, 6), locality=1.0,
+            num_prefixes=2, prefix_len=8, priority_mix={1: 1.0})
+        phase = loadgen.Phase("disagg_burst", 12, arrival="burst",
+                              duration_s=0.02, workload=spec)
+        records = loadgen.Scenario("disagg", [phase]).schedule(SEED)
+
+        def _eng(role):
+            return ServingEngine(_model(), temperature=0.0,
+                                 background=False, dtype=jnp.float32,
+                                 max_batch=4, block_size=8,
+                                 max_seq_len=64, bucket_cap=32,
+                                 prefix_cache=True, role=role)
+
+        pre, dec = _eng("prefill"), _eng("decode")
+        router = Router()
+        router.add_replica("dg-pre", engine=pre)
+        router.add_replica("dg-dec", engine=dec)
+        pipe = DisaggPipeline(router)
+        win = metrics.Window("serving.disagg.")
+        handles = [pipe.submit(loadgen.prompt_ids(r),
+                               max_new_tokens=r.max_new_tokens)
+                   for r in records]
+        pipe.run_until_idle()
+        statuses = [h.result(timeout=60) and h.status for h in handles]
+        win.freeze()
+        pre.close()
+        dec.close()
+    finally:
+        paddle.set_flags(saved)
+    handoffs = win.value("serving.disagg.handoffs")
+    fallbacks = win.value("serving.disagg.fallbacks")
+    clean = all(s == "DONE" for s in statuses)
+    ok = (clean and handoffs > 0
+          and handoffs + fallbacks == len(records))
+    print(f"[fleet-load-gate] disagg: handoffs={handoffs} "
+          f"fallbacks={fallbacks} (want handoffs+fallbacks="
+          f"{len(records)}, handoffs > 0) all-DONE={clean} "
+          f"transfer-bytes={win.value('serving.disagg.transfer_bytes')}"
+          f" {'PASS' if ok else 'FAIL'}")
+    return ok, {"disagg_handoffs": float(handoffs),
+                "disagg_fallbacks": float(fallbacks),
+                "disagg_transfer_bytes":
+                    float(win.value("serving.disagg.transfer_bytes")),
+                "disagg_ok": 1.0 if ok else 0.0}
+
+
 def main():
     from paddle_tpu.profiler import scorecard
 
@@ -178,11 +252,13 @@ def main():
     ok3 = check_drain(card, harness)
     ok4 = check_locality(card)
     harness.close()
-    ok = ok1 and ok2 and ok3 and ok4 and ok_det
+    ok5, disagg_metrics = check_disagg()
+    ok = ok1 and ok2 and ok3 and ok4 and ok5 and ok_det
 
     try:
         import bench_ledger
         m = scorecard.fleet_load_metrics(card)
+        m.update(disagg_metrics)
         m["gate_ok"] = 1.0 if ok else 0.0
         bench_ledger.append_entry("fleet_load", m,
                                   meta={"scenario": card["scenario"],

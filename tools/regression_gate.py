@@ -80,6 +80,16 @@ def direction_and_tol(name):
         # pass/fail sentinels (scenario_ok, gate_ok — kind fleet_load):
         # any drop below an all-1.0 median is a failure, zero tolerance
         return ("down", 0.0)
+    if "transfer_bytes" in name:
+        # disaggregated handoff payload size (kind disagg): GROWTH is
+        # the regression — a fatter frame per handoff means scale rows
+        # duplicated or dead weight riding the fabric
+        return ("up", RATE_TOL)
+    if "handoff" in name:
+        # disaggregated handoff count (kind disagg): a DROP means
+        # requests silently degraded to co-located fallback — the
+        # fabric stopped doing its job without failing the gate
+        return ("down", RATE_TOL)
     # throughput suffixes FIRST: "tokens_per_s" also ends with "_s"
     # (_per_step: the speculative decode multiple; _mult: the int8 KV
     # capacity multiplier — both larger-is-better, kind spec_gate /

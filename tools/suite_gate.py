@@ -47,12 +47,18 @@ _MAP = [
      ["tests/framework/test_mesh_serving.py"]),
     ("paddle_tpu/serving/loadgen.py",
      ["tests/framework/test_loadgen.py"]),
+    ("paddle_tpu/serving/kv_transfer.py",
+     ["tests/framework/test_disagg.py"]),
+    ("paddle_tpu/serving/disagg.py",
+     ["tests/framework/test_disagg.py"]),
+    ("tools/disagg_gate.py", ["tests/framework/test_disagg.py"]),
     ("paddle_tpu/serving/", ["tests/framework/test_serving.py",
                              "tests/framework/test_prefix_cache.py",
                              "tests/framework/test_fleet_observatory.py",
                              "tests/framework/test_router.py",
                              "tests/framework/test_overload.py",
-                             "tests/framework/test_mesh_serving.py"]),
+                             "tests/framework/test_mesh_serving.py",
+                             "tests/framework/test_disagg.py"]),
     ("paddle_tpu/inference/", ["tests/framework/test_paged_decode.py",
                                "tests/framework/test_serving.py",
                                "tests/framework/test_prefix_cache.py",
