@@ -250,6 +250,86 @@ def bench_decode_tiers(max_new=24):
     return out
 
 
+def bench_quant_kernels(iters=20):
+    """Pallas serving-kernel tier (docs/PERF.md): the dequant-fused
+    paged decode attention vs the dense reference, and the in-register
+    int8 weight matmul vs the XLA dequant-then-matmul form — per-step
+    wall time each, plus the pallas/reference ratios. HONEST CPU NOTE:
+    on CPU the Pallas kernels run in interpret mode, so the absolute
+    times and ratios measure interpret overhead, NOT the TPU win — the
+    ledger tracks them only to catch the kernel path getting
+    structurally slower (tools/regression_gate.py gives the ratio
+    names an explicit larger-is-worse rule). Appends kind
+    ``quant_kernels`` to BENCH_LEDGER.jsonl."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.inference.paged import paged_decode_attention_dense
+    from paddle_tpu.kernels.pallas.paged_attention import (
+        paged_decode_attention_kernel)
+    from paddle_tpu.kernels.pallas.quant_matmul import quant_matmul
+    from paddle_tpu.quantization import quantize_rows
+
+    rng = np.random.default_rng(0)
+    B, HQ, HK, D, BS, MBPS = 4, 8, 4, 64, 8, 8
+    NB = 1 + B * MBPS
+    q = jnp.asarray(rng.standard_normal((B, HQ, D)), jnp.float32)
+    k, ks = quantize_rows(jnp.asarray(
+        rng.standard_normal((NB, BS, HK, D)), jnp.float32))
+    v, vs = quantize_rows(jnp.asarray(
+        rng.standard_normal((NB, BS, HK, D)), jnp.float32))
+    tables = jnp.asarray(
+        rng.permutation(np.arange(1, NB)).reshape(B, MBPS).astype(
+            np.int32))
+    lens = jnp.asarray(np.array([13, 41, 8, 62], np.int32))
+
+    def timed(fn):
+        fn()  # compile outside the window
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn()
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / iters * 1e6
+
+    dense_us = timed(lambda: paged_decode_attention_dense(
+        q, k, v, tables, lens, k_scale=ks, v_scale=vs))
+    pallas_us = timed(lambda: paged_decode_attention_kernel(
+        q, k, v, tables, lens, k_scale=ks, v_scale=vs))
+
+    x = jnp.asarray(rng.standard_normal((8, 256)), jnp.float32)
+    w = jnp.asarray(rng.integers(-127, 128, (256, 512)), jnp.int8)
+    s = jnp.asarray(rng.uniform(0.01, 0.1, (512,)), jnp.float32)
+    xla_mm = jax.jit(lambda xx, ww, ss: xx @ (
+        ww.astype(jnp.float32) * ss[None, :]))
+    xla_us = timed(lambda: xla_mm(x, w, s))
+    qmm_us = timed(lambda: quant_matmul(x, w, s))
+
+    out = {
+        "tag": "quant_kernels_tiny",
+        "backend": jax.default_backend(),
+        "quant_decode_dense_us": round(dense_us, 1),
+        "quant_decode_pallas_us": round(pallas_us, 1),
+        "quant_matmul_xla_us": round(xla_us, 1),
+        "quant_matmul_pallas_us": round(qmm_us, 1),
+        "quant_decode_pallas_over_dense": round(
+            pallas_us / max(dense_us, 1e-9), 3),
+        "quant_matmul_pallas_over_xla": round(
+            qmm_us / max(xla_us, 1e-9), 3),
+    }
+    try:
+        import os
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        import bench_ledger
+        bench_ledger.append_entry("quant_kernels", {
+            k2: v for k2, v in out.items()
+            if isinstance(v, (int, float))})
+    except Exception:  # noqa: BLE001 — ledger trouble is advisory
+        pass
+    return out
+
+
 def _mesh_serve_child(n_devices):
     """One ``mesh_serve`` measurement at a fixed host-device count —
     runs in a SUBPROCESS (``bench.py --mesh-child N``) because
@@ -1198,6 +1278,7 @@ def main():
             bench_llama_decode, LlamaConfig.tiny(), 2, 8, 8,
             "llama_tiny_decode", dtype="float32")
         ladder["decode_tiers"] = _try(bench_decode_tiers)
+        ladder["quant_kernels"] = _try(bench_quant_kernels)
         ladder["mesh_serve"] = _try(bench_mesh_serve)
         fp8_cfg = GPTConfig.tiny()
         fp8_cfg.use_fp8 = True

@@ -420,6 +420,19 @@ define_flag("FLAGS_serving_mesh", "",
             "(default) is byte-for-byte single-device serving with "
             "serving.mesh.* counter silence (read at Scheduler "
             "construction, the FLAGS_serving_prefix_cache convention)")
+define_flag("FLAGS_paged_kernel", "auto",
+            "paged-attention decode kernel routing (inference/paged.py "
+            "paged_decode_attention; docs/PERF.md 'Pallas serving-"
+            "kernel tier'): 'auto' (default) routes to the fused Pallas "
+            "kernel on TPU — including dequant-fused int8 pools and the "
+            "chunked long-context variant — and to the dense XLA "
+            "reference on CPU; 'pallas' forces the kernel everywhere "
+            "(interpret mode on CPU — tier-1 testable); 'dense' forces "
+            "the dense reference byte-for-byte with serving.kernel.* "
+            "counter silence. Read ONCE at engine construction (the "
+            "FLAGS_serving_prefix_cache convention); also gates the "
+            "int8 weight-matmul kernel behind ConvertedInt8Linear "
+            "(read at conversion)")
 define_flag("FLAGS_serving_disagg", False,
             "disaggregated prefill/decode serving (serving/disagg.py): "
             "the two-stage pipeline routes each request to a prefill-"

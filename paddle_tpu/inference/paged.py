@@ -50,6 +50,18 @@ _PREFIX_HITS = _metrics.counter("serving.prefix.hit_blocks")
 _PREFIX_MISSES = _metrics.counter("serving.prefix.miss_blocks")
 _PREFIX_COW = _metrics.counter("serving.prefix.cow_copies")
 _PREFIX_EVICT = _metrics.counter("serving.prefix.evictions")
+# kernel-route observability (docs/OBSERVABILITY.md): which attention
+# tier `paged_decode_attention` actually routed — pallas moves whenever
+# the fused kernel is taken (interpret ADDITIONALLY moves when it will
+# run in interpret mode, i.e. a CPU host), dense moves on the auto-mode
+# dense fallback. Forced `FLAGS_paged_kernel=dense` short-circuits
+# BEFORE all three (byte-for-byte revert, counter silence —
+# tools/kernel_gate.py pins it). Increments happen at trace/call time:
+# one movement per compiled program layer, which is exactly the "did
+# the kernel route in" bit the gate asserts.
+_KERN_PALLAS = _metrics.counter("serving.kernel.pallas")
+_KERN_DENSE = _metrics.counter("serving.kernel.dense")
+_KERN_INTERPRET = _metrics.counter("serving.kernel.interpret")
 
 __all__ = ["PagedKVCache", "paged_prefill_write",
            "paged_prefill_write_masked", "paged_decode_attention",
@@ -58,7 +70,56 @@ __all__ = ["PagedKVCache", "paged_prefill_write",
            "paged_spec_write", "paged_spec_attention_dense",
            "ContinuousBatchingEngine", "validate_request",
            "chunk_digests", "PrefixPlan", "CapacityError",
-           "resolve_kv_dtype", "quant_block_ratio"]
+           "resolve_kv_dtype", "quant_block_ratio",
+           "resolve_paged_kernel", "kernel_route"]
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel routing (FLAGS_paged_kernel; docs/PERF.md "Pallas
+# serving-kernel tier")
+# ---------------------------------------------------------------------------
+
+_KERNEL_MODES = ("auto", "pallas", "dense")
+# contexts at least this many pages long route to the chunked
+# flash-decode variant (kernels/pallas/paged_attention.py) — short
+# tables pay per-page grid steps that are already cheap
+_CHUNK_MIN_PAGES = 16
+
+
+def resolve_paged_kernel(mode=None):
+    """Normalize an engine's paged-kernel routing mode (a ctor kwarg or
+    the ``FLAGS_paged_kernel`` string): ``auto`` | ``pallas`` |
+    ``dense``. Engines resolve ONCE at construction (the
+    FLAGS_serving_prefix_cache convention) and pass the result down —
+    this function never reads flags when handed an explicit mode."""
+    if mode is None:
+        from ..core import flags as flags_mod
+        mode = flags_mod.flag("FLAGS_paged_kernel")
+    m = str(mode or "auto").strip().lower()
+    if m not in _KERNEL_MODES:
+        raise ValueError(
+            f"FLAGS_paged_kernel must be one of {_KERNEL_MODES}, "
+            f"got {mode!r}")
+    return m
+
+
+def kernel_route(mode=None):
+    """The route a resolved mode will actually take on this backend —
+    ``"pallas"`` / ``"interpret"`` / ``"dense"`` — for the decode_step
+    span's route attribute and the serving summary."""
+    m = resolve_paged_kernel(mode)
+    if m == "dense":
+        return "dense"
+    try:
+        cpu = jax.default_backend() == "cpu"
+    except RuntimeError:  # pragma: no cover
+        cpu = True
+    if m == "pallas":
+        # the kernels' own interpret pick (PADDLE_PALLAS_FORCE_COMPILE
+        # forces real Mosaic lowering even on a CPU host)
+        from ..kernels.pallas.paged_attention import _interpret
+        return "interpret" if _interpret() else "pallas"
+    return "dense" if cpu else "pallas"
 
 
 # ---------------------------------------------------------------------------
@@ -948,34 +1009,51 @@ def paged_decode_write_q(k_pool, v_pool, k_scale, v_scale, block_tables,
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, seq_lens,
                            scale=None, use_kernel=None, k_scale=None,
-                           v_scale=None):
-    """Masked decode attention over the paged cache.
+                           v_scale=None, kernel_mode=None):
+    """Masked decode attention over the paged cache — THE kernel
+    routing point (docs/PERF.md "Pallas serving-kernel tier").
 
     q [B, Hq, D] (one query token per slot); returns [B, Hq, D].
-    On TPU routes to the fused Pallas kernel (`kernels/pallas/
-    paged_attention.py` — in-kernel page gathers, no materialized
-    gathered KV); on CPU defaults to the dense XLA reference path below
-    (gather + masked softmax), which the kernel is tested against
-    (tests/kernels/test_paged_attention.py runs the kernel in interpret
-    mode one-vs-other).
+    Routing (``kernel_mode``: the engine's construction-resolved
+    ``FLAGS_paged_kernel``; the legacy ``use_kernel`` bool maps to
+    pallas/dense): ``auto`` takes the fused Pallas kernel on TPU —
+    full-precision AND int8 pools (the kernel carries the scale rows
+    and dequantizes in VMEM), the chunked flash-decode variant past
+    ``_CHUNK_MIN_PAGES`` — and the dense XLA reference below on CPU;
+    ``pallas`` forces the kernel everywhere (interpret mode on CPU,
+    tier-1 testable); ``dense`` forces the reference byte-for-byte
+    with serving.kernel.* counter silence. The pallas/dense/interpret
+    route counters move at the routing decision
+    (tools/kernel_gate.py pins movement and silence).
     """
-    if k_scale is not None:
-        # the Pallas kernel has no dequant fusion yet: quantized pools
-        # route to the dense reference on every backend
-        use_kernel = False
-    if use_kernel is None:
-        try:
-            use_kernel = jax.default_backend() != "cpu"
-        except RuntimeError:  # pragma: no cover
-            use_kernel = False
-    if use_kernel:
-        from ..kernels.pallas.paged_attention import (
-            paged_decode_attention_kernel)
-        return paged_decode_attention_kernel(
-            q, k_pool, v_pool, block_tables, seq_lens, scale=scale)
-    return paged_decode_attention_dense(q, k_pool, v_pool, block_tables,
-                                        seq_lens, scale=scale,
-                                        k_scale=k_scale, v_scale=v_scale)
+    if kernel_mode is None and use_kernel is not None:
+        kernel_mode = "pallas" if use_kernel else "dense"
+    mode = resolve_paged_kernel(kernel_mode)
+    if mode == "dense" or (k_scale is None) != (v_scale is None):
+        # forced dense: the pre-kernel path, byte-for-byte, before any
+        # counter moves (mismatched scales never happens from engines;
+        # route it dense so the reference raises the shape error)
+        return paged_decode_attention_dense(
+            q, k_pool, v_pool, block_tables, seq_lens, scale=scale,
+            k_scale=k_scale, v_scale=v_scale)
+    route = kernel_route(mode)
+    if route == "dense":
+        _KERN_DENSE.inc()
+        return paged_decode_attention_dense(
+            q, k_pool, v_pool, block_tables, seq_lens, scale=scale,
+            k_scale=k_scale, v_scale=v_scale)
+    _KERN_PALLAS.inc()
+    if route == "interpret":
+        _KERN_INTERPRET.inc()
+    from ..kernels.pallas.paged_attention import (
+        paged_decode_attention_chunked, paged_decode_attention_kernel)
+    if block_tables.shape[1] >= _CHUNK_MIN_PAGES:
+        return paged_decode_attention_chunked(
+            q, k_pool, v_pool, block_tables, seq_lens, scale=scale,
+            k_scale=k_scale, v_scale=v_scale)
+    return paged_decode_attention_kernel(
+        q, k_pool, v_pool, block_tables, seq_lens, scale=scale,
+        k_scale=k_scale, v_scale=v_scale)
 
 
 def paged_decode_attention_dense(q, k_pool, v_pool, block_tables, seq_lens,
@@ -1012,7 +1090,7 @@ def paged_decode_attention_dense(q, k_pool, v_pool, block_tables, seq_lens,
 
 def paged_decode_attention_tp(q, k_pool, v_pool, block_tables, seq_lens,
                               mesh, scale=None, k_scale=None,
-                              v_scale=None):
+                              v_scale=None, kernel_mode=None):
     """Tensor-parallel decode attention under an explicit
     ``jax.shard_map`` (docs/SERVING.md "Mesh-sharded serving"): the
     kv-head axis of the pools and the q-head axis of the queries split
@@ -1039,7 +1117,8 @@ def paged_decode_attention_tp(q, k_pool, v_pool, block_tables, seq_lens,
         def local(qq, kp, vp, ksc, vsc, tbl, lens):
             return paged_decode_attention(qq, kp, vp, tbl, lens,
                                           scale=scale, k_scale=ksc,
-                                          v_scale=vsc)
+                                          v_scale=vsc,
+                                          kernel_mode=kernel_mode)
 
         f = jax.shard_map(local, mesh=jm,
                           in_specs=(head, pool, pool, srow, srow,
@@ -1049,7 +1128,8 @@ def paged_decode_attention_tp(q, k_pool, v_pool, block_tables, seq_lens,
                  seq_lens)
 
     def local(qq, kp, vp, tbl, lens):
-        return paged_decode_attention(qq, kp, vp, tbl, lens, scale=scale)
+        return paged_decode_attention(qq, kp, vp, tbl, lens, scale=scale,
+                                      kernel_mode=kernel_mode)
 
     f = jax.shard_map(local, mesh=jm,
                       in_specs=(head, pool, pool, rep, rep),

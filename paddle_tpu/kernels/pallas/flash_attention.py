@@ -490,7 +490,11 @@ def _seg_specs_kvmajor(bq, bk):
 
 
 def _sem(n):
-    return pltpu.CompilerParams(
+    # jax renamed TPUCompilerParams -> CompilerParams; accept either so
+    # the varlen kernels run on every jax this repo supports
+    params = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return params(
         dimension_semantics=("parallel",) * 3 + ("arbitrary",) * (n - 3))
 
 
@@ -512,6 +516,14 @@ def _gspmd_wrap(fn, rule, repl, arg_keeps=None, out_keeps=None):
     """
     from jax.experimental.custom_partitioning import custom_partitioning
     from jax.sharding import NamedSharding, PartitionSpec
+
+    from ...distributed.capability import has_partitioning_sharding_rule
+    if not has_partitioning_sharding_rule():
+        # this jax predates the ``sharding_rule`` kwarg — no Shardy rule
+        # can be registered, so skip the wrap entirely. Single-device
+        # (every CPU test run) never consults the rule; multi-device
+        # GSPMD on such a jax already can't partition Mosaic kernels.
+        return fn
 
     cp = custom_partitioning(fn)
 

@@ -664,19 +664,30 @@ class Llama(nn.Layer):
                          self._aot_tag("llama.paged_extend.q8"))
 
     def paged_decode_step(self, cache, last_tokens, active,
-                          temperature=0.0):
+                          temperature=0.0, kernel_mode=None):
         """One decode step for every live slot: write the incoming token's
         KV at position seq_len, attend against the paged cache (masked to
         seq_len+1), sample the next token. Single static-shape jitted
-        program; updates `cache` pools/lens in place."""
+        program; updates `cache` pools/lens in place.
+
+        ``kernel_mode`` is the engine's construction-resolved
+        ``FLAGS_paged_kernel`` (auto|pallas|dense) — it picks the
+        attention route inside the traced program, so the decode jits
+        cache PER MODE (engines with different routing can share one
+        model without serving each other's programs)."""
         from ..core.random import next_key
+        from ..inference.paged import resolve_paged_kernel
+
+        mode = resolve_paged_kernel(kernel_mode)
 
         if cache.quantized:
-            if getattr(self, "_paged_decode_q8_jit", None) is None:
-                self._paged_decode_q8_jit = self._build_decode_q8()
+            jits = self.__dict__.setdefault("_paged_decode_q8_jit", {})
+            if jits.get(mode) is None:
+                jits[mode] = self._build_decode_q8(mode)
+            step = jits[mode]
             with self._paged_lock():
                 arrs = self._param_arrays()
-                toks, nk, nv, nks, nvs = self._paged_decode_q8_jit(
+                toks, nk, nv, nks, nvs = step(
                     arrs, jnp.asarray(last_tokens, jnp.int32),
                     cache.k_pools, cache.v_pools, cache.k_scales,
                     cache.v_scales, cache.block_tables,
@@ -692,7 +703,8 @@ class Llama(nn.Layer):
                                       cache.seq_lens).astype(np.int32)
             return toks
 
-        if not hasattr(self, "_paged_decode_jit"):
+        jits = self.__dict__.setdefault("_paged_decode_jit", {})
+        if jits.get(mode) is None:
             rebind = self._param_rebind()
             cfg = self.config
             hq = cfg.num_heads
@@ -732,11 +744,13 @@ class Llama(nn.Layer):
                         if use_tp:
                             out = paged_decode_attention_tp(
                                 q._data[:, 0], kp, vp, tables,
-                                jnp.where(active, lens + 1, lens), mesh)
+                                jnp.where(active, lens + 1, lens), mesh,
+                                kernel_mode=mode)
                         else:
                             out = paged_decode_attention(
                                 q._data[:, 0], kp, vp, tables,
-                                jnp.where(active, lens + 1, lens))
+                                jnp.where(active, lens + 1, lens),
+                                kernel_mode=mode)
                         x = x + attn.o_proj(
                             Tensor(out.reshape(b, 1, hq * hd)))
                         x = x + blk.mlp(blk.post_attention_layernorm(x))
@@ -756,12 +770,14 @@ class Llama(nn.Layer):
                                          temperature=1.0, key=key),
                     lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
                 return nxt, new_k, new_v
-            self._paged_decode_jit = _aot_wrap(
-                jax.jit(fn), self._aot_tag("llama.paged_decode"))
+            tag = "llama.paged_decode" + (
+                "" if mode == "auto" else f".k-{mode}")
+            jits[mode] = _aot_wrap(jax.jit(fn), self._aot_tag(tag))
+        step = jits[mode]
 
         with self._paged_lock():
             arrs = self._param_arrays()
-            toks, new_k, new_v = self._paged_decode_jit(
+            toks, new_k, new_v = step(
                 arrs, jnp.asarray(last_tokens, jnp.int32),
                 cache.k_pools, cache.v_pools, cache.block_tables,
                 jnp.asarray(cache.seq_lens), jnp.asarray(active),
@@ -775,11 +791,13 @@ class Llama(nn.Layer):
                                   cache.seq_lens).astype(np.int32)
         return toks
 
-    def _build_decode_q8(self):
+    def _build_decode_q8(self, kernel_mode="auto"):
         """Quantized twin of the `_paged_decode_jit` program: the
         incoming token's KV quantizes on write (`paged_decode_write_q`)
-        and the attention dequantizes in its gather (dense path — the
-        Pallas kernel has no dequant fusion yet)."""
+        and the attention dequantizes the int8 pool per routing mode —
+        fused inside the Pallas kernel's VMEM gather on the pallas
+        route, or in the dense reference's XLA gather when
+        ``kernel_mode`` forces dense (or auto resolves there)."""
         rebind = self._param_rebind()
         cfg = self.config
         hq = cfg.num_heads
@@ -816,12 +834,14 @@ class Llama(nn.Layer):
                         out = paged_decode_attention_tp(
                             q._data[:, 0], kp, vp, tables,
                             jnp.where(active, lens + 1, lens), mesh,
-                            k_scale=ksc, v_scale=vsc)
+                            k_scale=ksc, v_scale=vsc,
+                            kernel_mode=kernel_mode)
                     else:
                         out = paged_decode_attention(
                             q._data[:, 0], kp, vp, tables,
                             jnp.where(active, lens + 1, lens),
-                            k_scale=ksc, v_scale=vsc)
+                            k_scale=ksc, v_scale=vsc,
+                            kernel_mode=kernel_mode)
                     x = x + attn.o_proj(
                         Tensor(out.reshape(b, 1, hq * hd)))
                     x = x + blk.mlp(blk.post_attention_layernorm(x))
@@ -843,8 +863,9 @@ class Llama(nn.Layer):
                                      temperature=1.0, key=key),
                 lambda: jnp.argmax(last, axis=-1).astype(jnp.int32))
             return nxt, new_k, new_v, new_ks, new_vs
-        return _aot_wrap(jax.jit(fn),
-                         self._aot_tag("llama.paged_decode.q8"))
+        tag = "llama.paged_decode.q8" + (
+            "" if kernel_mode == "auto" else f".k-{kernel_mode}")
+        return _aot_wrap(jax.jit(fn), self._aot_tag(tag))
 
     # -- self-speculative decode (docs/SERVING.md "Decode speed tiers") --
 

@@ -392,20 +392,39 @@ def _quantize_weight(w, channel_axis):
 
 class ConvertedInt8Linear(nn.Layer):
     """Deployment form: per-out-channel int8 weight + fp scales; optional
-    static activation scale from the PTQ observer."""
+    static activation scale from the PTQ observer.
+
+    The matmul routes per ``FLAGS_paged_kernel`` (resolved ONCE at
+    conversion, the serving convention): on the pallas/interpret route
+    the weight stays int8 into `kernels.pallas.quant_matmul` and
+    dequantizes in-register; on the dense route (and the default
+    ``auto`` on CPU) it keeps the original XLA dequant-then-matmul
+    byte-for-byte."""
 
     def __init__(self, src, act_scale=None):
         super().__init__()
+        from ..inference.paged import kernel_route, resolve_paged_kernel
         w = src.weight._data  # [in, out]
         q, scales = _quantize_weight(w, channel_axis=1)
         self.register_buffer("w_int8", Tensor(q))
         self.register_buffer("w_scales", Tensor(scales))
         self.bias = src.bias
         self.act_scale = act_scale
+        self._kernel_route = kernel_route(resolve_paged_kernel(None))
 
     def forward(self, x):
         if self.act_scale is not None:  # simulate static input quant
             x = _act_fake_quant(x, self.act_scale)
+        if self._kernel_route != "dense":
+            from ..kernels.pallas.quant_matmul import quant_matmul
+            interp = self._kernel_route == "interpret"
+
+            def qmm(xx, ww, ss):
+                return quant_matmul(xx, ww, ss, interpret=interp)
+
+            out = apply(qmm, x, self.w_int8, self.w_scales,
+                        name="quant_matmul")
+            return out + self.bias if self.bias is not None else out
         w = Tensor(self.w_int8._data.astype(jnp.float32) *
                    self.w_scales._data[None, :])
         return nn.functional.linear(x, w, self.bias)

@@ -83,6 +83,12 @@ _c_handoffs = _metrics.counter("serving.disagg.handoffs")
 _c_transfer_bytes = _metrics.counter("serving.disagg.transfer_bytes")
 _c_transfer_us = _metrics.counter("serving.disagg.transfer_us")
 _c_fallbacks = _metrics.counter("serving.disagg.fallbacks")
+# degenerate topology: prefill and decode candidates are the SAME single
+# replica — a two-stage attempt would export/import a prefix into the
+# pool it came from and then "fall back" on the guaranteed self-handoff
+# refusal. Counted here (not in fallbacks: nothing failed) and served
+# co-located directly.
+_c_colocated = _metrics.counter("serving.disagg.colocated")
 
 
 class LocalTransport:
@@ -204,6 +210,20 @@ class DisaggPipeline:
             raise NoReplicaAvailable(
                 "disagg: prefill stage starved", reasons=reasons,
                 retry_after_s=retry_after)
+        # co-located short-circuit: when the prefill and decode stages
+        # resolve to the SAME single replica (one mixed-role replica —
+        # common in shakedown topologies), a two-stage attempt can only
+        # self-handoff and land in the fallback path. Serve it directly
+        # instead: not a failure, so it counts colocated, not fallbacks.
+        dprobe = self.router.stage_candidates("decode")
+        if dprobe and \
+                {r.replica_id for r in cands} == \
+                {r.replica_id for r in dprobe} and len(
+                    {r.replica_id for r in cands}) == 1:
+            _c_colocated.inc()
+            return self.router.submit(
+                prompt_ids, max_new_tokens, deadline=deadline,
+                priority=priority, on_token=on_token)
         prefill_rep = None
         phandle = None
         for rep in cands:

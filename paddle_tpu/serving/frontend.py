@@ -203,7 +203,8 @@ class ServingEngine:
                  bucket_cap=None, prefix_cache=None, accounting=None,
                  admission=None, brownout=None, kv_cache_dtype=None,
                  spec=None, spec_tokens=None, mesh=None,
-                 background=True, ready=True, role=None):
+                 background=True, ready=True, role=None,
+                 paged_kernel=None):
         self._state = Lifecycle.WARMING
         # disaggregation role (serving/disagg.py): advertised through
         # the fleet registry and the stage-aware router; "mixed" is
@@ -222,7 +223,8 @@ class ServingEngine:
             prefix_cache=prefix_cache, accounting=accounting,
             admission=admission, brownout=brownout,
             kv_cache_dtype=kv_cache_dtype, spec=spec,
-            spec_tokens=spec_tokens, mesh=mesh)
+            spec_tokens=spec_tokens, mesh=mesh,
+            paged_kernel=paged_kernel)
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._background = background
@@ -458,7 +460,9 @@ class ServingEngine:
                         sched.model.paged_decode_step(
                             cache, np.zeros((cache.max_batch,),
                                             np.int64), active,
-                            temperature=sched.temperature)
+                            temperature=sched.temperature,
+                            kernel_mode=getattr(sched, "kernel_mode",
+                                                None))
                         n += 1
                         if sched.spec:
                             sk = sched.spec_tokens
@@ -493,7 +497,10 @@ class ServingEngine:
                             sched.model.paged_decode_step(
                                 cache, np.zeros((cache.max_batch,),
                                                 np.int64), active,
-                                temperature=sched.temperature)
+                                temperature=sched.temperature,
+                                kernel_mode=getattr(sched,
+                                                    "kernel_mode",
+                                                    None))
                             decoded = True
                             n += 1
                             if sched.spec:

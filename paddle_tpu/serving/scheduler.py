@@ -63,7 +63,8 @@ import numpy as np
 from ..core import flags as flags_mod
 from ..core import resilience
 from ..inference.paged import (CapacityError, PagedKVCache,
-                               quant_block_ratio, resolve_kv_dtype,
+                               kernel_route, quant_block_ratio,
+                               resolve_kv_dtype, resolve_paged_kernel,
                                sized_num_blocks, validate_request)
 from ..profiler import accounting as _accounting
 from ..profiler import alerts as _alerts
@@ -246,7 +247,8 @@ class Scheduler:
                  prefill_token_budget=None, max_queue=None,
                  bucket_cap=None, prefix_cache=None, accounting=None,
                  admission=None, brownout=None, kv_cache_dtype=None,
-                 spec=None, spec_tokens=None, mesh=None):
+                 spec=None, spec_tokens=None, mesh=None,
+                 paged_kernel=None):
         import jax.numpy as jnp
 
         cfg = model.config
@@ -272,6 +274,13 @@ class Scheduler:
         kv_dtype = resolve_kv_dtype(
             flags_mod.flag("FLAGS_kv_cache_dtype")
             if kv_cache_dtype is None else kv_cache_dtype)
+        # paged-attention kernel routing (FLAGS_paged_kernel, read ONCE
+        # at construction like kv_cache_dtype): the resolved mode rides
+        # into every decode dispatch so the traced programs bake the
+        # route; `kernel_route` names where it lands (pallas / interpret
+        # / dense) for spans and gates
+        self.kernel_mode = resolve_paged_kernel(paged_kernel)
+        self.kernel_route = kernel_route(self.kernel_mode)
         hd = cfg.hidden_size // cfg.num_heads
         compute_dt = dtype if dtype is not None else jnp.bfloat16
         num_blocks = sized_num_blocks(
@@ -802,7 +811,8 @@ class Scheduler:
         toks, dec_us = self._timed_decode_dispatch(
             lambda: np.asarray(self.model.paged_decode_step(
                 self.cache, np.asarray(self._last_tok), active,
-                temperature=self.temperature)))
+                temperature=self.temperature,
+                kernel_mode=self.kernel_mode)))
         out = []
         for slot, req in list(self.running.items()):
             t = int(toks[slot])
@@ -812,7 +822,8 @@ class Scheduler:
             # request's trace gets a slice of that step's wall time
             _tracing.record_span("serving.decode_step", req.span,
                                  dec_us, token=len(req.generated),
-                                 batch=len(self.running))
+                                 batch=len(self.running),
+                                 route=self.kernel_route)
             self.accounting.note_decode(req)
             self._emit(req, t)
             out.append((req.rid, t))
@@ -918,6 +929,7 @@ class Scheduler:
             _tracing.record_span("serving.decode_step", req.span,
                                  dec_us, token=len(req.generated),
                                  batch=len(self.running),
+                                 route=self.kernel_route,
                                  spec_proposed=proposed,
                                  spec_accepted=m)
             if proposed:

@@ -352,6 +352,30 @@ def test_no_decode_replica_falls_back_colocated():
 
 
 @pytest.mark.usefixtures("disagg_flags")
+def test_single_mixed_replica_served_colocated_not_fallback():
+    """One mixed-role replica resolves both stages to itself: the
+    pipeline skips the two-stage attempt entirely (a self-handoff could
+    only fail) and counts colocated — NOT fallbacks, since nothing
+    failed."""
+    eng = tiny_engine(_same_weights_model(), prefix_cache=True)
+    r = Router()
+    r.add_replica("solo", engine=eng)
+    pipe = DisaggPipeline(r)
+    before = _disagg_counters()
+    cbefore = metrics.snapshot().get("serving.disagg.colocated", 0)
+    h = pipe.submit(PROMPT, max_new_tokens=8)
+    pipe.run_until_idle()
+    assert h.result(timeout=30) == _reference(PROMPT, 8)
+    after = _disagg_counters()
+    assert after["serving.disagg.fallbacks"] == \
+        before["serving.disagg.fallbacks"]
+    assert after["serving.disagg.handoffs"] == \
+        before["serving.disagg.handoffs"]
+    assert metrics.snapshot().get("serving.disagg.colocated", 0) == \
+        cbefore + 1
+
+
+@pytest.mark.usefixtures("disagg_flags")
 def test_prefill_stage_starved_reports_stage_reason():
     dec = tiny_engine(_same_weights_model(), prefix_cache=True,
                       role="decode")

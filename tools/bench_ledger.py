@@ -35,7 +35,12 @@ prefix_hit_rate, max ttft_p95_us — every number read through
 scenario-scoped profiler.metrics Windows, never a registry reset),
 ``disagg`` (tools/disagg_gate.py disaggregated serving: handoff and
 fallback counts, transfer bytes/us, bit-equivalence / zero-reprefill
-/ fail-open / disarmed check bits).
+/ fail-open / disarmed check bits), ``kernel_gate``
+(tools/kernel_gate.py Pallas serving-kernel tier: equivalence /
+counter-routing / warmup-zero-recompile / forced-off check bits),
+``quant_kernels`` (bench.py quantized-kernel rung: dense vs Pallas
+int8 decode attention and XLA vs Pallas int8 matmul step times plus
+their ratios — CPU interpret-mode proxies, see the rung's note).
 The ledger itself is schema-free — any kind/metrics pair appends.
 
 CLI::

@@ -80,6 +80,14 @@ def direction_and_tol(name):
         # pass/fail sentinels (scenario_ok, gate_ok — kind fleet_load):
         # any drop below an all-1.0 median is a failure, zero tolerance
         return ("down", 0.0)
+    if name in ("quant_decode_pallas_over_dense",
+                "quant_matmul_pallas_over_xla"):
+        # kernel-tier ratios (kind quant_kernels): Pallas step time
+        # over its dense/XLA reference. HONEST CPU caveat: tier-1 runs
+        # the kernels in interpret mode, so the ratio is an overhead
+        # proxy (interpret >> XLA), not the TPU speedup — the gate only
+        # guards against the kernel path getting structurally slower
+        return ("up", TIME_TOL)
     if "transfer_bytes" in name:
         # disaggregated handoff payload size (kind disagg): GROWTH is
         # the regression — a fatter frame per handoff means scale rows

@@ -64,10 +64,12 @@ _MAP = [
                                "tests/framework/test_prefix_cache.py",
                                "tests/framework/test_spec_decode.py",
                                "tests/framework/test_quantization.py",
-                               "tests/framework/test_mesh_serving.py"]),
+                               "tests/framework/test_mesh_serving.py",
+                               "tests/framework/test_pallas_kernels.py"]),
     ("paddle_tpu/quantization/",
      ["tests/framework/test_quantization.py",
-      "tests/framework/test_spec_decode.py"]),
+      "tests/framework/test_spec_decode.py",
+      "tests/framework/test_pallas_kernels.py"]),
     ("paddle_tpu/models/llama.py",
      ["tests/framework/test_paged_decode.py",
       "tests/framework/test_prefix_cache.py",
@@ -75,7 +77,8 @@ _MAP = [
       "tests/framework/test_fleet_observatory.py",
       "tests/framework/test_router.py",
       "tests/framework/test_spec_decode.py",
-      "tests/framework/test_mesh_serving.py"]),
+      "tests/framework/test_mesh_serving.py",
+      "tests/framework/test_pallas_kernels.py"]),
     ("paddle_tpu/models/generation.py",
      ["tests/framework/test_serving.py",
       "tests/framework/test_paged_decode.py",
@@ -96,7 +99,8 @@ _MAP = [
      ["tests/framework/test_mesh_serving.py", "tests/distributed"]),
     ("paddle_tpu/distributed/", ["tests/distributed"]),
     ("paddle_tpu/fleet/", ["tests/distributed"]),
-    ("paddle_tpu/kernels/", ["tests/kernels"]),
+    ("paddle_tpu/kernels/", ["tests/kernels",
+                             "tests/framework/test_pallas_kernels.py"]),
     ("paddle_tpu/optimizer/", ["tests/optimizer"]),
     ("paddle_tpu/vision/", ["tests/vision"]),
     ("paddle_tpu/amp/", ["tests/amp", "tests/test_amp.py"]),
@@ -143,6 +147,8 @@ _MAP = [
     ("tools/overload_gate.py", ["tests/framework/test_overload.py"]),
     ("tools/spec_gate.py", ["tests/framework/test_spec_decode.py",
                             "tests/framework/test_quantization.py"]),
+    ("tools/kernel_gate.py",
+     ["tests/framework/test_pallas_kernels.py", "tests/kernels"]),
     ("tools/mesh_gate.py", ["tests/framework/test_mesh_serving.py"]),
     ("tools/fleet_load_gate.py",
      ["tests/framework/test_loadgen.py",
