@@ -46,8 +46,8 @@ from . import flags as flags_mod
 from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
 
-__all__ = ["RetryPolicy", "Deadline", "CircuitBreaker", "policy",
-           "retry", "retry_call", "attempts", "degrade"]
+__all__ = ["RetryPolicy", "Deadline", "Lease", "CircuitBreaker",
+           "policy", "retry", "retry_call", "attempts", "degrade"]
 
 # monkeypatch seam for tests (and the chaos gate) — backoff sleeps go
 # through here so a scenario can run wall-clock-free
@@ -266,6 +266,55 @@ class Deadline:
 
     def __repr__(self):  # pragma: no cover - debug aid
         return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+class Lease:
+    """TTL'd ownership grant for work delegated across a process
+    boundary (serving/disagg.py remote handoffs).
+
+    A :class:`Deadline` answers "is this request out of time"; a lease
+    answers "does the other side still own this work". Both sides of a
+    delegation hold one under the same TTL: the delegator renews its
+    copy on every proof of remote liveness (a successful token pull, a
+    fresh fleet heartbeat on the remote's member payload), the remote
+    renews its copy on every sign the delegator still wants the result
+    (a pull/renew rpc landing). Expiry before a terminal status means
+    the peer is presumed dead: the delegator reclaims ownership (fails
+    open locally), the remote cancels the orphan and sweeps its
+    imported blocks. Monotonic clock, like :class:`Deadline` — never
+    compare across processes; each side measures its OWN silence.
+    """
+
+    __slots__ = ("name", "ttl_s", "granted_at", "renewed_at",
+                 "renewals")
+
+    def __init__(self, name, ttl_s):
+        self.name = str(name)
+        self.ttl_s = float(ttl_s)
+        self.granted_at = time.monotonic()
+        self.renewed_at = self.granted_at
+        self.renewals = 0
+
+    def renew(self):
+        """Fresh evidence of peer liveness: restart the TTL window."""
+        self.renewed_at = time.monotonic()
+        self.renewals += 1
+
+    def expired(self):
+        return time.monotonic() - self.renewed_at >= self.ttl_s
+
+    def remaining(self):
+        return max(0.0, self.ttl_s
+                   - (time.monotonic() - self.renewed_at))
+
+    def age(self):
+        """Seconds since the grant (not the last renewal)."""
+        return time.monotonic() - self.granted_at
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return (f"Lease({self.name!r}, ttl={self.ttl_s:.3f}s, "
+                f"remaining={self.remaining():.3f}s, "
+                f"renewals={self.renewals})")
 
 
 # -- circuit breaker -------------------------------------------------------

@@ -169,8 +169,20 @@ class _Agent:
             with self._open_channel(info, timeout) as sock:
                 if timeout and timeout > 0:
                     sock.settimeout(timeout)
-                self._send_frame(sock, frame)
-                resp = self._recv_frame(sock)
+                sent = False
+                try:
+                    self._send_frame(sock, frame)
+                    sent = True
+                    resp = self._recv_frame(sock)
+                except (OSError, EOFError) as e:
+                    # classify the ambiguity for callers: once the call
+                    # frame is on the wire, a timeout/reset/EOF no
+                    # longer proves the remote fn did NOT run — retrying
+                    # is only safe if the fn is idempotent. Dial
+                    # failures (frame never sent) escape from
+                    # _open_channel without this attribute.
+                    e.frame_sent = sent
+                    raise
         if resp["ok"]:
             return resp.get("result")
         raise resp["error"]
@@ -361,15 +373,19 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
         agent.call, to, fn, args, kwargs, timeout))
 
 
-def shutdown():
+def shutdown(graceful=True):
     """Barrier with all workers, then stop the agent (reference
-    rpc.py:276)."""
+    rpc.py:276). ``graceful=False`` skips the barrier — the teardown
+    for a survivor whose peer DIED (killed decode host, crashed
+    worker): barriering with a corpse would hang until the rendezvous
+    deadline, and the survivor has nothing left to synchronize."""
     global _agent
     if _agent is None:
         return
-    _barrier(_agent.store, _agent.rank, _agent.world_size, "shutdown",
-             getattr(_agent, "epoch", 0))
-    if _agent.rank == 0:
+    if graceful:
+        _barrier(_agent.store, _agent.rank, _agent.world_size,
+                 "shutdown", getattr(_agent, "epoch", 0))
+    if graceful and _agent.rank == 0:
         try:  # mark a clean end of life (enables elastic world resize)
             _agent.store.set("rpc/last_shutdown",
                              str(getattr(_agent, "epoch", 0)))

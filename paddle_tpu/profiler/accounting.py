@@ -90,7 +90,7 @@ class CostReport:
     __slots__ = ("rid", "status", "queue_us", "prefill_us",
                  "reprefill_us", "decode_us", "compile_us",
                  "aot_saved_us", "ttft_us", "transfer_us",
-                 "transfer_bytes",
+                 "transfer_bytes", "relay_us",
                  "tokens_prefilled", "tokens_decoded", "tokens_emitted",
                  "covered_tokens", "spec_proposed", "spec_accepted",
                  "preempts", "steps", "deadline_met")
@@ -111,6 +111,12 @@ class CostReport:
         #                             (informational, like aot_saved_us:
         #                             fabric time, not device-step time)
         self.transfer_bytes = 0     # KV bytes moved for the handoff
+        self.relay_us = 0.0         # cross-process token-relay serve time
+        #                             (remote handoffs, serving/disagg.py:
+        #                             decode-side pull handling — another
+        #                             informational fabric axis, NEVER in
+        #                             attributed_us; transfer_us semantics
+        #                             are unchanged by it)
         self.tokens_prefilled = 0   # computed (padded) prefill tokens
         self.tokens_decoded = 0     # batched decode steps participated in
         self.tokens_emitted = 0     # tokens streamed (prefill + decode)
@@ -349,6 +355,15 @@ class Accountant:
         if c is not None:
             c.transfer_us += float(transfer_us)
             c.transfer_bytes += int(transfer_bytes)
+
+    def note_relay(self, req, relay_us):
+        """``req`` is being served to a REMOTE caller over the token
+        relay (disagg ``_rpc_pull``): bill this pull's decode-side
+        handling time. Informational like ``transfer_us`` — wire
+        bookkeeping, not device time, outside the step-closure sum."""
+        c = req.cost
+        if c is not None:
+            c.relay_us += float(relay_us)
 
     def note_decode_compile(self, compile_us):
         """XLA compile observed around the batched decode dispatch
@@ -605,6 +620,9 @@ class _NullAccountant(Accountant):
         pass
 
     def note_transfer(self, req, transfer_us, transfer_bytes):
+        pass
+
+    def note_relay(self, req, relay_us):
         pass
 
     def note_decode_compile(self, compile_us):

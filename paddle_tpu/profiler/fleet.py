@@ -135,7 +135,7 @@ class Registrar:
     TTL."""
 
     def __init__(self, store, url, replica_id=None, ttl_s=None,
-                 status_fn=None, role=None):
+                 status_fn=None, role=None, extra_fn=None):
         ident = _metrics.replica_identity()
         self.store = store
         self.url = url
@@ -149,11 +149,27 @@ class Registrar:
         self.ttl_s = float(flags_mod.flag("FLAGS_fleet_ttl_s")
                            if ttl_s is None else ttl_s)
         self._status_fn = status_fn
+        # extra_fn: zero-arg callable whose dict merges into every
+        # heartbeat payload (reserved keys win) — how the remote
+        # handoff plane publishes lease state
+        # (disagg.register_rpc_engine sets it post-construction)
+        self.extra_fn = extra_fn
         self._ident = ident
         self._slot = None
         self._stop = threading.Event()
         self._thread = None
         self._adopted_identity = False
+        self._beat_hooks = []
+
+    def add_beat_hook(self, fn):
+        """Run ``fn()`` once per heartbeat (best-effort, after the
+        payload write) — periodic maintenance that should ride the
+        replica's existing liveness cadence instead of owning a
+        thread: serving/disagg.py renews/sweeps remote-handoff leases
+        here, so orphan reclamation happens even with zero relay
+        traffic. Failures degrade; the beat never stops."""
+        self._beat_hooks.append(fn)
+        return fn
 
     def _payload(self):
         p = {"replica_id": self.replica_id, "host": self._ident["host"],
@@ -163,6 +179,13 @@ class Registrar:
              "ttl_s": self.ttl_s, "slot": self._slot,
              "role": self.role,
              "heartbeat_ts": time.time()}
+        if self.extra_fn is not None:
+            try:
+                extra = dict(self.extra_fn())
+            except Exception:  # noqa: BLE001 — optional payload axes
+                extra = {}     # must never stop beats
+            for k, v in extra.items():
+                p.setdefault(k, v)
         if self._status_fn is not None:
             try:
                 p["state"] = self._status_fn()
@@ -219,6 +242,12 @@ class Registrar:
             except Exception as e:  # noqa: BLE001 — keep beating through store flaps
                 _c_hb_errors.inc()
                 resilience.degrade("fleet.heartbeat", exc=e)
+            for hook in list(self._beat_hooks):
+                try:
+                    hook()
+                except Exception as e:  # noqa: BLE001 — maintenance
+                    # riding the beat must never stop the beat
+                    resilience.degrade("fleet.beat_hook", exc=e)
 
     def deregister(self):
         """Stop the heartbeat and delete the registry entry

@@ -29,6 +29,32 @@ two stages over role-specialized replicas —
    serving — greedy outputs are bit-identical (tools/disagg_gate.py
    pins it, fp32 and int8 pools).
 
+**Cross-host decode** — the decode stage can live in ANOTHER process.
+A decode host registers its engine with :func:`register_rpc_engine`;
+an engine-less router replica (registry- or url-discovered) then
+qualifies as a decode candidate when the transport can admit remotely
+(:class:`RpcTransport`), and the whole stage rides rpc: ``_rpc_admit``
+imports the frame AND admits the request in one idempotent call (keyed
+on ``(request_id, frame digest)`` — a retried admission after an
+ambiguous timeout dedups instead of double-allocating, counted
+``serving.disagg.dup_admits``), and a pull-based token relay
+(``_rpc_pull``) streams tokens back against a MONOTONE CURSOR: the
+caller's :class:`RemoteHandoffHandle` pulls from ``len(delivered)``,
+so every position reaches the caller's sinks exactly once no matter
+how the channel flaps (the PR 12 ``RoutedHandle`` discipline applied
+cross-host). Ownership is explicit: each remote handoff holds a TTL'd
+:class:`~paddle_tpu.core.resilience.Lease` on BOTH sides — the caller
+renews on successful pulls and on a fresh decode fleet heartbeat; the
+decode host renews on every pull that lands. Expiry before a terminal
+status means the peer is presumed dead: the caller reclaims ownership
+and fails open to co-located decode replaying from the cursor
+(``serving.disagg.lease_expired`` + ``reclaims``); the decode host
+cancels the orphan and sweeps its imported refcount-0 blocks back to
+the free list (``serving.disagg.orphan_blocks``). A decode host that
+RESTARTS mid-lease has no admission record and refuses the stale
+cursor loudly (:class:`~.kv_transfer.RelayError`,
+``serving.disagg.stale_cursors``) — reclaim, never resync.
+
 **Fail-open ladder** — a broken fabric must never lose a request. Any
 failure past the prefill stage (export refused, transfer fault, import
 rejected, decode-side admission refused, or simply no decode-stage
@@ -41,7 +67,9 @@ ALSO refuses does :class:`~.router.NoReplicaAvailable` propagate —
 carrying stage-keyed reasons (``no-prefill-replica`` /
 ``no-decode-replica`` / ``transfer-failed``) next to the per-replica
 ones, with the smallest ``retry_after_s`` any structured rejection
-suggested.
+suggested. Post-admission remote death is the reclaim rung above —
+counted ``serving.disagg.reclaims``, NOT ``fallbacks`` (the handoff
+happened; arrivals == handoffs + fallbacks + colocated still holds).
 
 **Tracing** — the prefill request's ``serving.request`` root trace is
 the request's ONE trace: the transfer records a ``serving.transfer``
@@ -63,6 +91,9 @@ silence).
 
 from __future__ import annotations
 
+import hashlib
+import pickle
+import threading
 import time
 
 from ..core import flags as flags_mod
@@ -71,13 +102,14 @@ from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
 from ..testing import faults as _faults
 from . import kv_transfer
-from .kv_transfer import TransferError
+from .kv_transfer import RelayError, TransferError, TransferTimeout
 from .router import NoReplicaAvailable
-from .scheduler import HandoffError, QueueFullError
+from .scheduler import HandoffError, QueueFullError, RequestStatus
 from .frontend import NotReadyError
 
 __all__ = ["DisaggPipeline", "LocalTransport", "RpcTransport",
-           "register_rpc_engine"]
+           "RemoteHandoffHandle", "register_rpc_engine",
+           "sweep_remote"]
 
 _c_handoffs = _metrics.counter("serving.disagg.handoffs")
 _c_transfer_bytes = _metrics.counter("serving.disagg.transfer_bytes")
@@ -89,6 +121,27 @@ _c_fallbacks = _metrics.counter("serving.disagg.fallbacks")
 # refusal. Counted here (not in fallbacks: nothing failed) and served
 # co-located directly.
 _c_colocated = _metrics.counter("serving.disagg.colocated")
+# -- remote (cross-process) handoff plane (module docstring) -------------
+_c_remote = _metrics.counter("serving.disagg.remote_handoffs")
+# a frame re-shipped after an AMBIGUOUS timeout (TransferTimeout: sent,
+# delivery unknown). Safe — import dedups, admission is idempotent —
+# but never silently merged: up-is-worse (tools/regression_gate.py)
+_c_dup_frames = _metrics.counter("serving.disagg.dup_frames")
+# a retried _rpc_admit that found its (request_id, digest) record
+_c_dup_admits = _metrics.counter("serving.disagg.dup_admits")
+_c_pulls = _metrics.counter("serving.disagg.relay_pulls")
+# leases that ran out before a terminal status (either side counts its
+# own view); up-is-worse — a healthy fleet renews faster than it expires
+_c_lease_expired = _metrics.counter("serving.disagg.lease_expired")
+# caller-side ownership reclaims (post-admission fail-open): every one
+# completed co-located, replayed from the cursor
+_c_reclaims = _metrics.counter("serving.disagg.reclaims")
+# decode-side imported blocks swept back to the free list after their
+# lease died (orphan reclamation)
+_c_orphan_blocks = _metrics.counter("serving.disagg.orphan_blocks")
+# pulls refused loudly: no admission record (restart/reclaim) or a
+# cursor past the buffer — the caller must reclaim, never resync
+_c_stale_cursors = _metrics.counter("serving.disagg.stale_cursors")
 
 
 class LocalTransport:
@@ -108,20 +161,112 @@ class LocalTransport:
 # rpc-visible import targets: an engine must be registered here (by the
 # process that owns it) before an RpcTransport can land frames into it
 _RPC_ENGINES = {}
+# decode-side admission ledger: (engine name, request_id) -> record.
+# The idempotency table AND the relay buffer AND the lease registry —
+# one record per remote handoff, swept by sweep_remote()
+_ADMISSIONS = {}
+_ADMIT_LOCK = threading.Lock()
 
 
-def register_rpc_engine(name, engine):
-    """Expose ``engine``'s pool as an rpc import target under ``name``
-    (conventionally its replica_id). The decode-side process calls this
-    once; ``_rpc_import`` resolves the name inside the rpc handler."""
+class _RemoteAdmission:
+    """Decode-side state for one remote handoff: the dedup key, the
+    live engine handle, the append-only token buffer the relay reads
+    from, the decode-side lease (prefill liveness), and the import
+    result (the exact blocks orphan reclamation may sweep)."""
+
+    __slots__ = ("key", "frame_digest", "engine", "handle", "tokens",
+                 "lease", "imported", "orphaned")
+
+    def __init__(self, key, frame_digest, engine, lease, imported):
+        self.key = key
+        self.frame_digest = frame_digest
+        self.engine = engine
+        self.handle = None
+        self.tokens = []
+        self.lease = lease
+        self.imported = imported
+        self.orphaned = False
+
+
+def register_rpc_engine(name, engine, registrar=None):
+    """Expose ``engine`` as an rpc target under ``name``
+    (conventionally its replica_id): frame imports (``_rpc_import``),
+    remote admission (``_rpc_admit``), and the token relay
+    (``_rpc_pull``) all resolve the name inside the rpc handler. The
+    decode-side process calls this once. ``registrar`` (the replica's
+    ``profiler.fleet.Registrar``) opts the handoff plane into the
+    fleet heartbeat: lease state rides every member payload and
+    :func:`sweep_remote` runs once per beat, so orphan reclamation
+    does not depend on relay traffic arriving."""
     _RPC_ENGINES[str(name)] = engine
+    if registrar is not None:
+        registrar.extra_fn = lambda: lease_payload(name)
+        registrar.add_beat_hook(lambda: sweep_remote(name))
     return engine
 
 
+def lease_payload(name):
+    """Lease state for ``name``'s member payload (fleet heartbeat):
+    how many remote handoffs this decode host is serving and the
+    tightest remaining TTL — the aggregator-visible half of the
+    ownership protocol."""
+    with _ADMIT_LOCK:
+        recs = [r for (n, _), r in _ADMISSIONS.items() if n == name]
+    live = [r for r in recs if not r.lease.expired()]
+    p = {"leases": len(live)}
+    if live:
+        p["lease_min_remaining_s"] = round(
+            min(r.lease.remaining() for r in live), 3)
+    return p
+
+
+def sweep_remote(name=None):
+    """Decode-side orphan reclamation: for every admission whose lease
+    EXPIRED — cancel it if still running (the prefill side went silent
+    mid-stream: it has either died or already reclaimed ownership),
+    and once terminal, sweep the blocks its import freshly allocated
+    back to the truly-free list (``kv_transfer.release_import``) and
+    drop the record. A record that finished normally (lease simply
+    aged out after the caller pulled the terminal status) is dropped
+    WITHOUT releasing blocks — they are legitimate parked prefix-cache
+    entries. Runs on every rpc touch of the handoff plane plus once
+    per fleet heartbeat (:func:`register_rpc_engine`); returns the
+    number of blocks reclaimed."""
+    reclaimed = 0
+    with _ADMIT_LOCK:
+        items = list(_ADMISSIONS.items())
+    for key, rec in items:
+        if name is not None and key[0] != str(name):
+            continue
+        if not rec.lease.expired():
+            continue
+        status = rec.handle.status
+        if status not in RequestStatus.TERMINAL:
+            if not rec.orphaned:
+                rec.orphaned = True
+                _c_lease_expired.inc()
+                resilience.degrade(
+                    "disagg.lease",
+                    detail=f"rid={key[1]} status={status} "
+                           f"age={rec.lease.age():.3f}s")
+                rec.handle.cancel()
+            # blocks free at the next step boundary; a later sweep
+            # (next beat / next rpc) finishes the reclaim
+            continue
+        if rec.orphaned:
+            n = kv_transfer.release_import(rec.engine.cache,
+                                           rec.imported)
+            _c_orphan_blocks.inc(n)
+            reclaimed += n
+        with _ADMIT_LOCK:
+            _ADMISSIONS.pop(key, None)
+    return reclaimed
+
+
 def _rpc_import(name, frame):
-    """Remote half of :class:`RpcTransport` — runs on the decode host
-    via ``distributed.rpc``. Loud KeyError on an unregistered target
-    (the caller's retry/fallback ladder handles it)."""
+    """Remote half of :meth:`RpcTransport.send` — runs on the decode
+    host via ``distributed.rpc``. Loud on an unregistered target (the
+    caller's retry/fallback ladder handles it)."""
     eng = _RPC_ENGINES.get(str(name))
     if eng is None:
         raise TransferError(
@@ -130,25 +275,182 @@ def _rpc_import(name, frame):
     return kv_transfer.import_prefix(eng.cache, frame)
 
 
+def _rpc_admit(name, request_id, frame_digest, frame, prompt_ids,
+               first_token, max_new_tokens=32, priority=None,
+               deadline_s=None, trace_parent=None, transfer_us=0.0,
+               transfer_bytes=0, lease_ttl_s=10.0):
+    """Remote decode-stage admission — import + ``submit_handoff`` +
+    lease grant in ONE rpc, idempotent on ``(request_id, frame
+    digest)``: a retried call after an ambiguous timeout finds the
+    record, renews the lease, and acks (``serving.disagg.dup_admits``)
+    instead of double-allocating a slot; the SAME request_id under a
+    DIFFERENT digest is refused loudly (two prefills claiming one
+    identity is a bug, not a retry). If admission fails after the
+    import landed, the freshly imported blocks are released before the
+    error propagates — a refused handoff must not leave parked blocks
+    behind (the co-located pipeline applies the same discipline)."""
+    eng = _RPC_ENGINES.get(str(name))
+    if eng is None:
+        raise TransferError(
+            f"rpc admit: no engine registered as {name!r} "
+            f"(call disagg.register_rpc_engine on the decode host)")
+    sweep_remote(name)
+    key = (str(name), str(request_id))
+    with _ADMIT_LOCK:
+        rec = _ADMISSIONS.get(key)
+        if rec is not None:
+            if rec.frame_digest != frame_digest:
+                raise TransferError(
+                    f"rpc admit: request {request_id!r} already "
+                    f"admitted under a different frame digest "
+                    f"(have {rec.frame_digest[:8]}…, "
+                    f"got {str(frame_digest)[:8]}…) — refusing")
+            _c_dup_admits.inc()
+            rec.lease.renew()
+            _faults.site("disagg.admit.ack")
+            return {"ok": True, "dedup": True}
+        imported = kv_transfer.import_prefix(eng.cache, frame)
+        rec = _RemoteAdmission(
+            key, frame_digest, eng,
+            lease=resilience.Lease(f"disagg/{request_id}",
+                                   lease_ttl_s),
+            imported=imported)
+        try:
+            rec.handle = eng.submit_handoff(
+                prompt_ids, first_token, max_new_tokens,
+                deadline_s=deadline_s, priority=priority,
+                on_token=rec.tokens.append, trace_parent=trace_parent,
+                transfer_us=transfer_us, transfer_bytes=transfer_bytes,
+                handoff_id=str(request_id))
+        except BaseException:
+            kv_transfer.release_import(eng.cache, imported)
+            raise
+        _ADMISSIONS[key] = rec
+    # the admitted-but-ack-lost window: an injection here simulates a
+    # response that died on the wire AFTER the slot was allocated —
+    # exactly what the idempotent retry above must absorb
+    _faults.site("disagg.admit.ack")
+    return {"ok": True, "dedup": False}
+
+
+def _rpc_pull(name, request_id, cursor):
+    """One relay round, decode side: renew the lease (the pull IS the
+    prefill side's liveness signal), read status BEFORE tokens (a
+    terminal status therefore implies the token list is complete), and
+    return everything past the caller's monotone ``cursor``. A missing
+    record (this host restarted mid-lease, or swept the admission as
+    orphaned) or a cursor past the buffer refuses LOUDLY with
+    :class:`~.kv_transfer.RelayError` — the caller must reclaim
+    ownership, never quietly resync. Terminal responses carry the
+    request's CostReport when it pickles."""
+    sweep_remote(name)
+    key = (str(name), str(request_id))
+    with _ADMIT_LOCK:
+        rec = _ADMISSIONS.get(key)
+    if rec is None:
+        _c_stale_cursors.inc()
+        raise RelayError(
+            f"relay: no admission record for {request_id!r} on "
+            f"{name!r} — decode host restarted mid-lease or the lease "
+            f"was reclaimed; stale cursor {cursor} refused")
+    t0 = time.perf_counter_ns()
+    rec.lease.renew()
+    status = rec.handle.status
+    toks = list(rec.tokens)
+    cursor = int(cursor)
+    if cursor > len(toks):
+        _c_stale_cursors.inc()
+        raise RelayError(
+            f"relay: cursor {cursor} past the {len(toks)}-token "
+            f"buffer for {request_id!r} — refusing")
+    _c_pulls.inc()
+    resp = {"tokens": toks[cursor:], "cursor": len(toks),
+            "status": status}
+    rec.engine.scheduler.accounting.note_relay(
+        rec.handle._req, (time.perf_counter_ns() - t0) / 1000.0)
+    if status in RequestStatus.TERMINAL:
+        cost = rec.handle.cost()
+        try:
+            pickle.dumps(cost)
+            resp["cost"] = cost
+        except Exception:  # noqa: BLE001 — cost is advisory; the relay
+            pass           # must deliver the terminal status regardless
+    return resp
+
+
+def _rpc_cancel(name, request_id):
+    """Best-effort remote cancel: the caller walked away (explicit
+    cancel, or ownership reclaim before fail-open). Expires the lease
+    immediately and marks the record orphaned so the next sweep
+    reclaims the imported blocks without waiting out the TTL. True iff
+    a record existed."""
+    key = (str(name), str(request_id))
+    with _ADMIT_LOCK:
+        rec = _ADMISSIONS.get(key)
+    if rec is None:
+        return False
+    rec.handle.cancel()
+    rec.lease.ttl_s = 0.0
+    rec.orphaned = True
+    sweep_remote(name)
+    return True
+
+
 class RpcTransport:
-    """Cross-host fabric: the frame ships over the distributed/rpc.py
-    channel (PR 4/6 — length-prefixed, crc-checked, trace-stitched) to
-    ``_rpc_import`` on the worker that owns the decode replica.
+    """Cross-host fabric: frames AND admission AND the token relay
+    ride the distributed/rpc.py channel (length-prefixed, crc-checked,
+    trace-stitched) to the worker that owns the decode replica.
     ``worker_of`` maps a replica_id to its rpc worker name (default:
-    the replica_id IS the worker name). Admission itself still needs an
-    engine-bound replica record (cross-host submit rides the rpc layer
-    — ROADMAP); this transport is the block-streaming half."""
+    the replica_id IS the worker name).
+
+    Every call classifies channel death: a failure AFTER the call
+    frame went out (``frame_sent`` — distributed/rpc.py annotates it)
+    re-raises as :class:`~.kv_transfer.TransferTimeout`, the AMBIGUOUS
+    case where the remote may have executed the call and only the ack
+    died. The pipeline retries those (import dedups, admission is
+    idempotent) but counts the re-shipped frame
+    ``serving.disagg.dup_frames``. A refused dial stays a plain
+    ``ConnectionError`` — nothing was sent, retry is free."""
 
     def __init__(self, worker_of=None, timeout=60.0):
         self._worker_of = worker_of or (lambda rid: rid)
         self.timeout = float(timeout)
 
-    def send(self, replica, frame):
+    def _call(self, replica_id, fn, args=(), kwargs=None,
+              timeout=None):
         from ..distributed import rpc as _rpc
-        return _rpc.rpc_sync(
-            self._worker_of(replica.replica_id), _rpc_import,
-            args=(replica.replica_id, bytes(frame)),
-            timeout=self.timeout)
+        try:
+            return _rpc.rpc_sync(
+                self._worker_of(replica_id), fn, args=tuple(args),
+                kwargs=kwargs or {},
+                timeout=self.timeout if timeout is None
+                else float(timeout))
+        except (TimeoutError, OSError, EOFError) as e:
+            if getattr(e, "frame_sent", False):
+                raise TransferTimeout(
+                    f"rpc {getattr(fn, '__name__', fn)} to "
+                    f"{replica_id}: channel died after the frame was "
+                    f"sent — delivery unknown ({type(e).__name__})"
+                ) from e
+            raise
+
+    def send(self, replica, frame):
+        return self._call(replica.replica_id, _rpc_import,
+                          args=(replica.replica_id, bytes(frame)))
+
+    def admit(self, replica, request):
+        """Remote admission (``_rpc_admit`` kwargs ride verbatim)."""
+        return self._call(replica.replica_id, _rpc_admit,
+                          args=(replica.replica_id,), kwargs=request)
+
+    def pull(self, replica, request_id, cursor, timeout=None):
+        return self._call(replica.replica_id, _rpc_pull,
+                          args=(replica.replica_id, str(request_id),
+                                int(cursor)), timeout=timeout)
+
+    def cancel(self, replica, request_id):
+        return self._call(replica.replica_id, _rpc_cancel,
+                          args=(replica.replica_id, str(request_id)))
 
 
 class DisaggPipeline:
@@ -157,14 +459,23 @@ class DisaggPipeline:
     (``add_replica(..., role=...)`` or the fleet registry ``role``
     field). ``transport`` defaults to :class:`LocalTransport`;
     ``prefill_timeout_s`` bounds the wait for the prefill stage's
-    first token."""
+    first token. ``lease_ttl_s`` is the remote-handoff ownership TTL
+    (both sides; module docstring) and ``relay_poll_s`` the idle-pull
+    pause of the token relay — both only matter when the transport can
+    admit remotely (:class:`RpcTransport`)."""
 
-    def __init__(self, router, transport=None, prefill_timeout_s=120.0):
+    def __init__(self, router, transport=None, prefill_timeout_s=120.0,
+                 lease_ttl_s=10.0, relay_poll_s=0.01):
         self._armed = bool(flags_mod.flag("FLAGS_serving_disagg"))
         self.router = router
         self.transport = transport if transport is not None \
             else LocalTransport()
         self.prefill_timeout_s = float(prefill_timeout_s)
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.relay_poll_s = float(relay_poll_s)
+        # remote admission needs a transport that carries it; the
+        # in-process LocalTransport never routes to engine-less replicas
+        self._remote_ok = hasattr(self.transport, "admit")
 
     # -- stepping (foreground engines: tests/gates) ---------------------
 
@@ -268,7 +579,7 @@ class DisaggPipeline:
             dec_reasons = {}
             dcands = self.router.stage_candidates(
                 "decode", exclude={prefill_rep.replica_id},
-                reasons=dec_reasons)
+                reasons=dec_reasons, allow_remote=self._remote_ok)
             if not dcands:
                 reasons.update(dec_reasons)
                 reasons["no-decode-replica"] = \
@@ -280,17 +591,50 @@ class DisaggPipeline:
                                               TimeoutError))
             for rep in dcands:
                 try:
-                    def _send(rep=rep):
-                        _faults.site("disagg.transfer")
-                        return self.transport.send(rep, frame)
-                    imported = resilience.retry_call(_send, policy=pol)
-                    handle = rep.engine.submit_handoff(
-                        prompt_ids, first_token, max_new_tokens,
-                        deadline=deadline, priority=priority,
-                        on_token=on_token, trace_parent=ctx,
-                        transfer_us=(time.perf_counter_ns() - t0)
-                        / 1000.0,
-                        transfer_bytes=exported.nbytes)
+                    if rep.engine is None:
+                        # engine-less candidate: the decode stage lives
+                        # in ANOTHER process — admission + token relay
+                        # ride the rpc transport (module docstring)
+                        handle = self._remote_handoff(
+                            rep, prefill_rep, preq, ctx, prompt_ids,
+                            first_token, max_new_tokens, deadline,
+                            priority, on_token, frame, exported, t0)
+                    else:
+                        state = {"maybe_sent": False}
+
+                        def _send(rep=rep, state=state):
+                            _faults.site("disagg.transfer")
+                            if state["maybe_sent"]:
+                                # re-shipping after an AMBIGUOUS
+                                # timeout: the remote may already hold
+                                # the frame — import dedups, but the
+                                # duplicate send is never silent
+                                _c_dup_frames.inc()
+                            try:
+                                return self.transport.send(rep, frame)
+                            except TransferTimeout:
+                                state["maybe_sent"] = True
+                                raise
+                        imported = resilience.retry_call(_send,
+                                                         policy=pol)
+                        try:
+                            handle = rep.engine.submit_handoff(
+                                prompt_ids, first_token,
+                                max_new_tokens, deadline=deadline,
+                                priority=priority, on_token=on_token,
+                                trace_parent=ctx,
+                                transfer_us=(time.perf_counter_ns()
+                                             - t0) / 1000.0,
+                                transfer_bytes=exported.nbytes)
+                        except BaseException:
+                            # admission refused AFTER the import
+                            # landed: eagerly sweep the freshly
+                            # imported refcount-0 blocks back to the
+                            # free list — a failed handoff must not
+                            # park blocks until LRU pressure
+                            kv_transfer.release_import(
+                                rep.engine.cache, imported)
+                            raise
                 except (TransferError, HandoffError, NotReadyError,
                         QueueFullError, ConnectionError, TimeoutError,
                         RuntimeError) as e:
@@ -299,6 +643,8 @@ class DisaggPipeline:
                     continue
                 dur_us = (time.perf_counter_ns() - t0) / 1000.0
                 _c_handoffs.inc()
+                if rep.engine is None:
+                    _c_remote.inc()
                 _c_transfer_bytes.inc(exported.nbytes)
                 _c_transfer_us.inc(dur_us)
                 _tracing.record_span(
@@ -339,3 +685,304 @@ class DisaggPipeline:
                     "disagg: transfer failed and co-located fallback "
                     "refused", reasons=reasons,
                     retry_after_s=retry_after) from fe
+
+    # -- remote (cross-process) decode stage ----------------------------
+
+    def _remote_handoff(self, rep, prefill_rep, preq, ctx, prompt_ids,
+                        first_token, max_new_tokens, deadline,
+                        priority, on_token, frame, exported, t0):
+        """Admit the decode stage on a remote host and return the
+        relay-backed handle. The request_id derives from the prefill
+        identity + frame digest, so every retry of THIS submit reuses
+        one identity and the remote admission dedups; the admit rpc
+        itself retries only the AMBIGUOUS/refused-dial channel
+        failures (``disagg.admit`` policy) — a structured remote
+        refusal (HandoffError, geometry mismatch…) propagates to the
+        candidate sweep / fail-open ladder unchanged."""
+        digest = hashlib.blake2b(bytes(frame),
+                                 digest_size=16).hexdigest()
+        request_id = f"{prefill_rep.replica_id}.{preq.rid}." \
+                     f"{digest[:8]}"
+        req_kw = {
+            "request_id": request_id, "frame_digest": digest,
+            "frame": bytes(frame), "prompt_ids": prompt_ids,
+            "first_token": int(first_token),
+            "max_new_tokens": int(max_new_tokens),
+            "priority": priority,
+            "deadline_s": (deadline.remaining()
+                           if deadline is not None else None),
+            "trace_parent": ctx,
+            "transfer_us": (time.perf_counter_ns() - t0) / 1000.0,
+            "transfer_bytes": exported.nbytes,
+            "lease_ttl_s": self.lease_ttl_s,
+        }
+        state = {"maybe_sent": False}
+
+        def _admit():
+            _faults.site("disagg.admit")
+            if state["maybe_sent"]:
+                _c_dup_frames.inc()  # admission re-ships the frame
+            try:
+                return self.transport.admit(rep, req_kw)
+            except TransferTimeout:
+                state["maybe_sent"] = True
+                raise
+        resilience.retry_call(
+            _admit, policy=resilience.policy(
+                "disagg.admit", max_attempts=3,
+                retry_on=(TransferTimeout, ConnectionError)))
+        lease = resilience.Lease(f"disagg/{request_id}",
+                                 self.lease_ttl_s)
+        return RemoteHandoffHandle(
+            self, rep, prefill_rep, preq, prompt_ids, max_new_tokens,
+            deadline, priority, on_token, request_id, lease)
+
+
+class RemoteHandoffHandle:
+    """Caller-side view of a remote (cross-process) decode stage.
+
+    Mirrors the routed-handle surface (``status``/``rid``/``tokens``/
+    ``cost``/``result``/``stream``/``cancel``) over a PULL relay. The
+    exactly-once mechanism is the MONOTONE CURSOR, not the transport:
+    every ``_advance`` asks ``_rpc_pull`` for tokens past
+    ``len(delivered)`` and appends only what comes back, so a retried
+    or duplicated pull can never re-deliver a position to the caller's
+    sinks. Liveness is the lease: successful pulls renew it, and when
+    the relay flaps, a fresh fleet heartbeat on the decode replica's
+    member payload renews it too (both rungs behind the
+    ``disagg.lease`` fault site). Expiry before terminal — or a LOUD
+    stale-cursor refusal (the decode host restarted or swept us) —
+    reclaims ownership: fail open to co-located decode on the prefill
+    replica, suppressing the first ``len(delivered)`` tokens of the
+    replay (greedy-determinism contract, the ``RoutedHandle`` failover
+    discipline applied cross-host)."""
+
+    def __init__(self, pipeline, replica, prefill_rep, preq,
+                 prompt_ids, max_new_tokens, deadline, priority,
+                 on_token, request_id, lease):
+        self._pipeline = pipeline
+        self._replica = replica
+        self._prefill_rep = prefill_rep
+        self._preq = preq
+        self._prompt = prompt_ids
+        self._mnt = int(max_new_tokens)
+        self._deadline = deadline
+        self._priority = priority
+        self._on_token = on_token
+        self.request_id = str(request_id)
+        self.lease = lease
+        self._toks = []
+        self._status = RequestStatus.RUNNING
+        self._terminal = False
+        self._error = None
+        self._cost = None
+        self._cancel_requested = False
+        self._fb = None          # co-located handle after reclaim
+        self.reclaimed = False
+        self._lock = threading.RLock()
+
+    # -- routed-handle surface -----------------------------------------
+
+    @property
+    def replica_id(self):
+        return (self._prefill_rep.replica_id if self._fb is not None
+                else self._replica.replica_id)
+
+    @property
+    def status(self):
+        return self._status
+
+    @property
+    def rid(self):
+        return self.request_id
+
+    @property
+    def trace_id(self):
+        return getattr(self._preq, "trace_id", None)
+
+    def tokens(self):
+        with self._lock:
+            return list(self._toks)
+
+    def cost(self):
+        with self._lock:
+            return self._fb.cost() if self._fb is not None \
+                else self._cost
+
+    def cancel(self):
+        with self._lock:
+            self._cancel_requested = True
+            if self._fb is not None:
+                self._fb.cancel()
+                return
+        try:
+            self._pipeline.transport.cancel(self._replica,
+                                            self.request_id)
+        except Exception:  # noqa: BLE001 — the relay surfaces
+            pass           # CANCELLED, or the lease reclaims
+
+    def result(self, timeout=None):
+        deadline = None if timeout is None \
+            else time.monotonic() + float(timeout)
+        while True:
+            with self._lock:
+                if self._terminal:
+                    if self._error is not None:
+                        raise self._error
+                    return list(self._toks)
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    raise TimeoutError(
+                        f"remote handoff {self.request_id} not "
+                        f"finished within {timeout}s")
+                self._advance(left)
+
+    def stream(self, timeout=None):
+        """Yield tokens as the relay delivers them; ends at a terminal
+        status (exactly-once across reclaim — see class docstring)."""
+        i = 0
+        while True:
+            with self._lock:
+                toks = list(self._toks)
+                terminal, err = self._terminal, self._error
+            while i < len(toks):
+                yield toks[i]
+                i += 1
+            if terminal:
+                if err is not None:
+                    raise err
+                return
+            with self._lock:
+                if not self._terminal:
+                    self._advance(timeout)
+
+    # -- relay internals (caller holds self._lock) ---------------------
+
+    def _emit(self, tok):
+        self._toks.append(tok)
+        if self._on_token is not None:
+            self._on_token(tok)
+
+    def _finish(self, status):
+        self._status = status
+        self._terminal = True
+
+    def _sleep_poll(self, left):
+        d = self._pipeline.relay_poll_s
+        if left is not None:
+            d = min(d, max(float(left), 0.0))
+        if d > 0:
+            time.sleep(d)
+
+    def _advance(self, left=None):
+        """One relay round: pull from the cursor, process, renew the
+        lease on evidence, reclaim on expiry or stale cursor."""
+        if self._terminal:
+            return
+        pull_timeout = max(0.2, self.lease.remaining())
+        if left is not None:
+            pull_timeout = min(pull_timeout, max(0.05, float(left)))
+        try:
+            _faults.site("disagg.relay")
+            resp = self._pipeline.transport.pull(
+                self._replica, self.request_id, len(self._toks),
+                timeout=pull_timeout)
+        except RelayError as e:
+            # loud stale-cursor refusal: the decode host restarted
+            # mid-lease or already swept us — never resync, reclaim
+            self._reclaim(e)
+            return
+        except Exception as e:  # noqa: BLE001 — channel failure: any
+            # flavor (refused dial, ambiguous timeout, remote error)
+            # is survivable while the lease lasts
+            self._renew_from_heartbeat()
+            if self.lease.expired():
+                _c_lease_expired.inc()
+                self._reclaim(e)
+            else:
+                self._sleep_poll(left)
+            return
+        for t in resp.get("tokens", ()):
+            self._emit(int(t))
+        try:
+            _faults.site("disagg.lease")
+            self.lease.renew()
+        except Exception:  # noqa: BLE001 — renewal plane severed
+            # (injected or real): keep serving while the TTL lasts;
+            # the expiry check above reclaims when it runs out
+            pass
+        st = resp.get("status")
+        if st in RequestStatus.TERMINAL:
+            self._cost = resp.get("cost")
+            self._finish(st)
+        elif not resp.get("tokens"):
+            self._sleep_poll(left)
+
+    def _renew_from_heartbeat(self):
+        """The decode replica's fleet heartbeat is INDIRECT liveness:
+        a fresh member payload renews the lease even while the relay
+        channel itself flaps (same ``disagg.lease`` site — a chaos
+        scenario severs both renewal rungs at once)."""
+        try:
+            self._pipeline.router.refresh()
+        except Exception:  # noqa: BLE001 — registry flap ≠ peer death
+            pass
+        m = self._replica.member
+        if not m or "heartbeat_ts" not in m:
+            return
+        age = time.time() - float(m["heartbeat_ts"])
+        if age < min(self.lease.ttl_s,
+                     float(m.get("ttl_s", self.lease.ttl_s))):
+            try:
+                _faults.site("disagg.lease")
+                self.lease.renew()
+            except Exception:  # noqa: BLE001 — severed renewal rung
+                pass
+
+    def _reclaim(self, exc):
+        """Lease-driven ownership reclaim: the decode side is presumed
+        dead (or has forgotten us). Fail open to co-located decode on
+        the prefill replica — its prefix cache still covers the prompt
+        — replaying from the cursor: the first ``len(delivered)``
+        tokens of the replay are suppressed, so the caller's sinks see
+        each position exactly once. Counted ``serving.disagg.
+        reclaims`` (NOT ``fallbacks``: the handoff happened)."""
+        self.reclaimed = True
+        _c_reclaims.inc()
+        resilience.degrade(
+            "disagg.reclaim",
+            detail=f"remote={self._replica.replica_id} "
+                   f"rid={self.request_id} cursor={len(self._toks)}",
+            exc=exc)
+        try:  # a live-but-forgotten decode host must stop emitting
+            self._pipeline.transport.cancel(self._replica,
+                                            self.request_id)
+        except Exception:  # noqa: BLE001 — it is presumed dead anyway
+            pass
+        if self._cancel_requested:
+            self._finish(RequestStatus.CANCELLED)
+            return
+        if len(self._toks) >= self._mnt:
+            # every token already streamed; only the terminal ack died
+            self._finish(RequestStatus.DONE)
+            return
+        eng = self._prefill_rep.engine
+        try:
+            fb = eng.submit(self._prompt, self._mnt,
+                            deadline=self._deadline,
+                            priority=self._priority)
+            if not eng._background:
+                eng.run_until_idle()
+            toks = fb.result(
+                timeout=self._pipeline.prefill_timeout_s)
+        except Exception as fe:  # noqa: BLE001 — reclaim exhausted:
+            # the caller sees the fallback's error, terminally
+            self._error = fe
+            self._finish(RequestStatus.ERROR)
+            return
+        skip = len(self._toks)
+        for t in toks[skip:]:
+            self._emit(int(t))
+        self._fb = fb
+        self._finish(fb.status)

@@ -306,17 +306,21 @@ class ServingEngine:
                        max_new_tokens=32, *, deadline_s=None,
                        deadline=None, priority=None, on_token=None,
                        trace_parent=None, transfer_us=0.0,
-                       transfer_bytes=0):
+                       transfer_bytes=0, handoff_id=None):
         """Disaggregated decode-stage admission (serving/disagg.py):
         the prompt's KV blocks were imported into this engine's pool
         (``kv_transfer.import_prefix``) and ``first_token`` came from
-        the prefill replica — admit straight into the batched decode
-        step, zero prefill compute here. Same lifecycle gate as
-        :meth:`submit`; the handle streams the FULL sequence (the
-        first token re-emits through it). Raises
-        :class:`~.scheduler.HandoffError` when the imported prefix
-        does not cover the prompt or no slot/blocks are free — the
-        pipeline falls back to co-located serving."""
+        the prefill replica — possibly in ANOTHER process entirely
+        (the rpc-served ``disagg._rpc_admit`` endpoint lands here) —
+        admit straight into the batched decode step, zero prefill
+        compute here. Same lifecycle gate as :meth:`submit`; the
+        handle streams the FULL sequence (the first token re-emits
+        through it). ``handoff_id`` (remote handoffs) is the
+        pipeline-assigned cross-process identity, recorded on the
+        admission span so the lease/relay records join the trace.
+        Raises :class:`~.scheduler.HandoffError` when the imported
+        prefix does not cover the prompt or no slot/blocks are free —
+        the pipeline falls back to co-located serving."""
         handle = RequestHandle(self)
 
         def _sink_token(req, tok):
@@ -349,7 +353,7 @@ class ServingEngine:
                 deadline=deadline, priority=priority,
                 on_token=_sink_token, on_finish=_sink_finish,
                 trace_parent=trace_parent, transfer_us=transfer_us,
-                transfer_bytes=transfer_bytes)
+                transfer_bytes=transfer_bytes, handoff_id=handoff_id)
             self._ensure_driver()
             self._cond.notify_all()
         return handle

@@ -61,8 +61,9 @@ import numpy as np
 
 from ..inference.paged import _partial_key, chunk_digests
 
-__all__ = ["TransferError", "ExportedPrefix", "ImportResult",
-           "export_prefix", "import_prefix", "pack_frame",
+__all__ = ["TransferError", "TransferTimeout", "RelayError",
+           "ExportedPrefix", "ImportResult", "export_prefix",
+           "import_prefix", "release_import", "pack_frame",
            "unpack_frame", "MAGIC"]
 
 MAGIC = b"PTPUKVT1"
@@ -75,6 +76,25 @@ class TransferError(RuntimeError):
     digest mismatch, non-resident source prefix, or a destination pool
     without room. Always raised BEFORE any destination-pool mutation —
     the caller (serving/disagg.py) fails open to co-located serving."""
+
+
+class TransferTimeout(TransferError):
+    """The fabric timed out AFTER the frame left this host: delivery
+    is UNKNOWN — the remote may have imported (or admitted) it and the
+    ack was lost. Distinct from a refused dial (plain
+    ``ConnectionRefusedError``: nothing was sent, retry is free).
+    Retrying after THIS is safe only because both remote operations
+    are idempotent — import dedups resident digests, admission dedups
+    on (request_id, frame digest) — but it re-ships the frame, counted
+    ``serving.disagg.dup_frames`` rather than silently merged."""
+
+
+class RelayError(RuntimeError):
+    """The token relay refused a cursor: the decode host has no record
+    of the request (it restarted mid-lease, or swept the lease as
+    orphaned) or the cursor runs past its buffer. Deliberately LOUD and
+    non-retryable — a stale cursor must trigger reclaim/fail-open, not
+    a quiet resync that could double- or skip-emit tokens."""
 
 
 class ExportedPrefix:
@@ -94,17 +114,21 @@ class ExportedPrefix:
 
 
 class ImportResult:
-    """What an import did to the destination pool."""
+    """What an import did to the destination pool. ``blocks`` lists
+    the block ids the import freshly allocated (dedups excluded) — the
+    exact set :func:`release_import` can sweep back if the handed-off
+    request never admits or its lease dies."""
 
     __slots__ = ("num_tokens", "blocks_imported", "blocks_deduped",
-                 "nbytes")
+                 "nbytes", "blocks")
 
     def __init__(self, num_tokens, blocks_imported, blocks_deduped,
-                 nbytes):
+                 nbytes, blocks=()):
         self.num_tokens = num_tokens
         self.blocks_imported = blocks_imported
         self.blocks_deduped = blocks_deduped
         self.nbytes = nbytes
+        self.blocks = list(blocks)
 
 
 # -- framing (the serving/aot_cache.py checkpoint-v2 discipline) -----------
@@ -310,4 +334,31 @@ def import_prefix(cache, frame):
         cache._block_keys.setdefault(b, []).append((kind, key))
         cache._deref_block(b)
     return ImportResult(int(ids.size), len(taken), deduped,
-                        len(bytes(frame)))
+                        len(bytes(frame)), blocks=taken)
+
+
+def release_import(cache, result):
+    """Sweep a fresh import's blocks back to the TRULY-free list.
+
+    The undo for an import whose request never made it: admission
+    refused after the frame landed (serving/disagg.py fails open
+    elsewhere), or the remote handoff's lease died with the blocks
+    parked (orphan reclamation). Without this the refcount-0 imports
+    linger in the reclaimable LRU until capacity pressure evicts them —
+    correct but occupying, and invisible to "did we leak" accounting.
+
+    Only blocks still in the EXACT state the import left them (parked
+    refcount-0 in ``_cached_free``) are touched; a block another
+    request admitted against, or the LRU already evicted, is skipped —
+    it is no longer this import's to reclaim. Eviction goes through
+    ``_drop_cached`` so ``serving.prefix.evictions`` moves and the
+    digest registrations drop with the block. Returns the number of
+    blocks released. Safe to call twice (second call finds nothing).
+    """
+    released = 0
+    for b in getattr(result, "blocks", ()):
+        if b in cache._cached_free and int(cache._refcount[b]) == 0:
+            cache._drop_cached(b)
+            cache._free.append(b)
+            released += 1
+    return released

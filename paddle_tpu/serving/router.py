@@ -13,8 +13,11 @@ and turns those signals into placement decisions:
   to the engine with the same ``replica_id`` and contributes its
   heartbeat age to the weight, so a replica whose heartbeat died
   routes toward zero BEFORE it formally ages out. Registry entries
-  with no bound engine are visible in :meth:`view` but not
-  submittable (cross-host submit rides the rpc layer — ROADMAP);
+  with no bound engine are visible in :meth:`view` and not directly
+  submittable — but they DO qualify as remote decode-stage candidates
+  (``stage_candidates(..., allow_remote=True)``): serving/disagg.py
+  admits the handed-off request on them over rpc, leased + cursor-
+  relayed, so the decode stage can live in another process;
 - **readiness** — a replica that is not READY on the drain lifecycle
   (``/readyz`` semantics: WARMING, DRAINING, CLOSED, or dead) is
   refused outright: a drain REDISTRIBUTES, the draining replica
@@ -403,7 +406,8 @@ class Router:
 
     # -- placement ------------------------------------------------------
 
-    def _candidates(self, exclude=(), reasons=None, stage=None):
+    def _candidates(self, exclude=(), reasons=None, stage=None,
+                    allow_remote=False):
         """READY, engine-bound replicas ranked health-over-load.
         ``reasons`` (a dict, mutated) collects why every OTHER known
         replica was refused — the per-replica diagnosis
@@ -411,7 +415,12 @@ class Router:
         / ``"decode"``, disaggregated serving) filters by role: a
         stage accepts same-role and ``mixed`` replicas, never the
         opposite specialist — a prefill-only replica must not take
-        decode traffic and vice versa."""
+        decode traffic and vice versa. ``allow_remote`` additionally
+        admits ENGINE-LESS replicas that answer :meth:`RouterReplica.
+        ready` (registry heartbeat state / a live ``/readyz``) — the
+        cross-process decode candidates serving/disagg.py admits over
+        rpc; plain submits never set it (an engine-less replica cannot
+        take a local submit)."""
         self.refresh()
         with self._lock:
             reps = [self._replicas[rid] for rid in self._order
@@ -422,8 +431,12 @@ class Router:
                 if reasons is not None:
                     reasons[r.replica_id] = f"WrongRole({r.role})"
             elif r.engine is None:
-                if reasons is not None:
-                    reasons[r.replica_id] = "NoEngine"
+                if allow_remote and r.ready():
+                    cands.append(r)
+                elif reasons is not None:
+                    reasons[r.replica_id] = (
+                        "NotReady(remote)" if allow_remote
+                        else "NoEngine")
             elif not r.ready():
                 if reasons is not None:
                     reasons[r.replica_id] = (
@@ -438,14 +451,19 @@ class Router:
         cands.sort(key=lambda r: -(r.health() / (1.0 + r.inflight())))
         return cands
 
-    def stage_candidates(self, stage, exclude=(), reasons=None):
+    def stage_candidates(self, stage, exclude=(), reasons=None,
+                         allow_remote=False):
         """Ranked candidates for one disaggregation stage
         (``"prefill"`` / ``"decode"``): the :meth:`_candidates` sweep
         with role filtering. serving/disagg.py's two-stage pipeline
         calls this once per stage and carries the refusal reasons into
-        its stage-keyed :class:`NoReplicaAvailable`."""
+        its stage-keyed :class:`NoReplicaAvailable`; it sets
+        ``allow_remote`` for the decode stage when its transport can
+        admit cross-process (engine-less registry/url replicas then
+        qualify — see :meth:`_candidates`)."""
         return self._candidates(exclude=exclude, reasons=reasons,
-                                stage=str(stage))
+                                stage=str(stage),
+                                allow_remote=bool(allow_remote))
 
     def _breaker(self, replica_id):
         b = self._breakers.get(replica_id)

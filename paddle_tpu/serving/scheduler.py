@@ -428,7 +428,8 @@ class Scheduler:
     def admit_handoff(self, prompt_ids, first_token, max_new_tokens=32,
                       *, deadline=None, priority=None, on_token=None,
                       on_finish=None, trace_parent=None,
-                      transfer_us=0.0, transfer_bytes=0):
+                      transfer_us=0.0, transfer_bytes=0,
+                      handoff_id=None):
         """Disaggregated decode-stage admission (serving/disagg.py):
         the prompt's KV blocks were just imported (``serving/
         kv_transfer.import_prefix``) and ``first_token`` was sampled by
@@ -446,8 +447,13 @@ class Scheduler:
 
         ``trace_parent`` (a span ``context()`` dict off the prefill
         replica's ``serving.request`` root) stitches this stage's spans
-        into the SAME cross-replica trace; ``transfer_us``/``transfer_
-        bytes`` bill the fabric hop to this request's CostReport."""
+        into the SAME cross-replica trace — including across a PROCESS
+        boundary: a remote handoff (disagg._rpc_admit) ships the
+        context in its admission rpc, so ``serving.decode_stage``
+        genuinely spans hosts. ``transfer_us``/``transfer_bytes`` bill
+        the fabric hop to this request's CostReport; ``handoff_id``
+        (remote handoffs) rides the ``serving.handoff_admit`` span so
+        the trace joins the lease/relay records."""
         prompt = validate_request(prompt_ids, max_new_tokens,
                                   self.max_seq_len, self.cache,
                                   who="serving.admit_handoff")
@@ -498,7 +504,9 @@ class Scheduler:
         self._remaining[slot] = int(max_new_tokens) - 1
         _tracing.record_span("serving.handoff_admit", req.span, 0.0,
                              hit_blocks=plan.hit_blocks,
-                             transfer_bytes=int(transfer_bytes))
+                             transfer_bytes=int(transfer_bytes),
+                             **({"handoff_id": str(handoff_id)}
+                                if handoff_id is not None else {}))
         self._emit(req, int(first_token))
         self._maybe_finish(slot)
         self._update_gauges()

@@ -1,0 +1,411 @@
+"""Cross-host disaggregation that survives process death
+(serving/disagg.py remote handoff plane, over a real loopback rpc).
+
+What must hold:
+
+- remote admission over :class:`RpcTransport` (engine-less decode
+  replica, ``register_rpc_engine`` on the decode side) is bit-identical
+  to co-located serving with ZERO prefill compute on the decode engine;
+- admission is IDEMPOTENT on ``(request_id, frame digest)``: a retried
+  admit after an ambiguous timeout dedups (one slot, one record,
+  ``serving.disagg.dup_admits`` + ``dup_frames`` move) — and the SAME
+  request_id under a DIFFERENT digest is refused loudly;
+- the crash matrix (``disagg.admit`` / ``disagg.relay`` /
+  ``disagg.lease`` via testing/faults) never loses a request and never
+  double-delivers a token: every outcome is a clean terminal with the
+  caller's sinks seeing each position EXACTLY once, and no imported
+  block leaks on either side;
+- lease expiry before terminal reclaims ownership (fail open to
+  co-located decode replaying from the cursor, counted ``reclaims``
+  NOT ``fallbacks``) and the decode side sweeps its orphaned imports
+  back to the truly-free list (``orphan_blocks``);
+- a decode host that forgot the admission (restart mid-lease) refuses
+  the stale cursor LOUDLY (``RelayError``, ``stale_cursors``) and the
+  caller reclaims — never resyncs;
+- a failed LOCAL handoff releases the blocks its import freshly parked
+  (``serving.prefix.evictions`` moves, cached-block count returns to
+  baseline) instead of leaking them until LRU pressure.
+"""
+
+import socket
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import metrics
+from paddle_tpu.serving import disagg
+from paddle_tpu.serving.disagg import (DisaggPipeline, RemoteHandoffHandle,
+                                       RpcTransport, register_rpc_engine,
+                                       sweep_remote)
+from paddle_tpu.serving.frontend import Lifecycle
+from paddle_tpu.serving.kv_transfer import (RelayError, TransferError,
+                                            TransferTimeout)
+from paddle_tpu.serving.router import Router
+from paddle_tpu.serving.scheduler import HandoffError
+from paddle_tpu.testing import faults
+
+# tiny_llama fixture + the pinned engine config come from conftest.py
+from conftest import tiny_engine  # noqa: E402
+
+PROMPT = list(range(1, 13))  # 12 tokens: one full 8-block + 4 partial
+MAX_NEW = 8
+
+_COUNTERS = (
+    "serving.disagg.handoffs", "serving.disagg.fallbacks",
+    "serving.disagg.colocated", "serving.disagg.remote_handoffs",
+    "serving.disagg.dup_frames", "serving.disagg.dup_admits",
+    "serving.disagg.relay_pulls", "serving.disagg.lease_expired",
+    "serving.disagg.reclaims", "serving.disagg.orphan_blocks",
+    "serving.disagg.stale_cursors", "serving.prefix.evictions",
+)
+
+
+def _snap():
+    s = metrics.snapshot()
+    return {k: s.get(k, 0) for k in _COUNTERS}
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def rpc_loop():
+    """One loopback rpc world for the module: worker ``w0`` serves its
+    own calls — the remote admission/relay plane runs over the REAL
+    channel (framing, pickling, exception transport), in one process."""
+    from paddle_tpu.distributed import rpc
+    rpc.init_rpc("w0", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{_free_port()}")
+    yield
+    rpc.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _clean_remote_tables():
+    yield
+    disagg._ADMISSIONS.clear()
+    disagg._RPC_ENGINES.clear()
+    faults.clear()
+
+
+@pytest.fixture()
+def disagg_flags():
+    saved = paddle.get_flags(["FLAGS_serving_router",
+                              "FLAGS_serving_disagg"])
+    paddle.set_flags({"FLAGS_serving_router": True,
+                      "FLAGS_serving_disagg": True})
+    yield
+    paddle.set_flags(saved)
+
+
+def _same_weights_model():
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _reference(prompt, max_new, **kw):
+    ref = tiny_engine(_same_weights_model(), prefix_cache=True, **kw)
+    h = ref.submit(prompt, max_new_tokens=max_new)
+    ref.run_until_idle()
+    return h.result(timeout=30)
+
+
+def _remote_pipeline(transport=None, **pipe_kw):
+    """A prefill replica in-router + a decode engine reachable ONLY
+    through rpc: the decode replica is engine-less (registry-style)
+    and the engine registers under its replica_id on 'this host'."""
+    pre = tiny_engine(_same_weights_model(), prefix_cache=True,
+                      role="prefill")
+    dec = tiny_engine(_same_weights_model(), prefix_cache=True,
+                      role="decode")
+    register_rpc_engine("rdec", dec)
+    r = Router()
+    r.add_replica("pre", engine=pre)
+    rep = r.add_replica("rdec", role="decode")
+    rep.member = {"state": Lifecycle.READY}
+    if transport is None:
+        transport = RpcTransport(worker_of=lambda rid: "w0")
+    pipe = DisaggPipeline(r, transport=transport, **pipe_kw)
+    return pipe, pre, dec
+
+
+def _rdec_records():
+    return [rec for (n, _), rec in disagg._ADMISSIONS.items()
+            if n == "rdec"]
+
+
+# -- happy path: the decode stage rides rpc --------------------------------
+
+@pytest.mark.usefixtures("rpc_loop", "disagg_flags")
+def test_remote_handoff_bit_identical_zero_prefill():
+    pipe, _, dec = _remote_pipeline()
+    before = _snap()
+    sink = []
+    h = pipe.submit(PROMPT, max_new_tokens=MAX_NEW,
+                    on_token=sink.append)
+    assert isinstance(h, RemoteHandoffHandle)
+    assert h.replica_id == "rdec"
+    dec.run_until_idle()
+    toks = h.result(timeout=30)
+    assert toks == _reference(PROMPT, MAX_NEW)
+    assert sink == toks              # exactly once, in order
+    assert h.status == "DONE" and not h.reclaimed
+    after = _snap()
+    assert after["serving.disagg.handoffs"] == \
+        before["serving.disagg.handoffs"] + 1
+    assert after["serving.disagg.remote_handoffs"] == \
+        before["serving.disagg.remote_handoffs"] + 1
+    assert after["serving.disagg.relay_pulls"] > \
+        before["serving.disagg.relay_pulls"]
+    assert after["serving.disagg.fallbacks"] == \
+        before["serving.disagg.fallbacks"]
+    # the terminal pull shipped the decode-side CostReport: the decode
+    # engine ran ZERO prefill compute and the fabric axes rode along
+    c = h.cost()
+    assert c is not None
+    assert c.tokens_prefilled == 0
+    assert c.transfer_bytes > 0
+    assert c.relay_us >= 0.0
+
+
+@pytest.mark.usefixtures("rpc_loop", "disagg_flags")
+def test_remote_stream_is_exactly_once():
+    pipe, _, dec = _remote_pipeline()
+    h = pipe.submit(PROMPT, max_new_tokens=MAX_NEW)
+    dec.run_until_idle()
+    assert list(h.stream(timeout=30)) == _reference(PROMPT, MAX_NEW)
+
+
+# -- idempotent admission ---------------------------------------------------
+
+class _AmbiguousAckTransport(RpcTransport):
+    """The admit rpc DELIVERS but its ack 'dies on the wire': the first
+    attempt executes remotely, then surfaces the ambiguous
+    TransferTimeout — exactly what a killed channel after send looks
+    like to the caller."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.admit_calls = 0
+
+    def admit(self, replica, request):
+        resp = super().admit(replica, request)
+        self.admit_calls += 1
+        if self.admit_calls == 1:
+            raise TransferTimeout("simulated: ack lost after delivery")
+        return resp
+
+
+@pytest.mark.usefixtures("rpc_loop", "disagg_flags")
+def test_ambiguous_admit_retry_dedups():
+    t = _AmbiguousAckTransport(worker_of=lambda rid: "w0")
+    pipe, _, dec = _remote_pipeline(transport=t)
+    before = _snap()
+    sink = []
+    h = pipe.submit(PROMPT, max_new_tokens=MAX_NEW,
+                    on_token=sink.append)
+    assert t.admit_calls == 2        # first admit + the retried one
+    assert len(_rdec_records()) == 1  # ONE record, ONE slot
+    dec.run_until_idle()
+    toks = h.result(timeout=30)
+    assert toks == _reference(PROMPT, MAX_NEW) and sink == toks
+    after = _snap()
+    assert after["serving.disagg.dup_admits"] == \
+        before["serving.disagg.dup_admits"] + 1
+    # the re-shipped frame is safe but never silent
+    assert after["serving.disagg.dup_frames"] == \
+        before["serving.disagg.dup_frames"] + 1
+    assert after["serving.disagg.remote_handoffs"] == \
+        before["serving.disagg.remote_handoffs"] + 1
+
+
+@pytest.mark.usefixtures("rpc_loop", "disagg_flags")
+def test_same_request_id_different_digest_refused():
+    pipe, _, dec = _remote_pipeline()
+    h = pipe.submit(PROMPT, max_new_tokens=MAX_NEW)
+    rec = _rdec_records()[0]
+    import paddle_tpu.serving.kv_transfer as kvt
+    frame, _ = kvt.export_prefix(
+        pipe.router._replicas["pre"].engine.cache, PROMPT)
+    with pytest.raises(TransferError, match="different frame digest"):
+        disagg._rpc_admit("rdec", rec.key[1], "deadbeef" * 4,
+                          bytes(frame), PROMPT, 1,
+                          max_new_tokens=MAX_NEW)
+    dec.run_until_idle()
+    assert h.result(timeout=30) == _reference(PROMPT, MAX_NEW)
+
+
+# -- crash matrix: every site, no lost request, no double token ------------
+
+@pytest.mark.usefixtures("rpc_loop", "disagg_flags")
+def test_crash_admit_fails_open_colocated():
+    pipe, _, dec = _remote_pipeline()
+    before = _snap()
+    sink = []
+    with faults.inject("disagg.admit", nth=1, count=1):
+        h = pipe.submit(PROMPT, max_new_tokens=MAX_NEW,
+                        on_token=sink.append)
+    pipe.run_until_idle()
+    toks = h.result(timeout=30)
+    assert toks == _reference(PROMPT, MAX_NEW) and sink == toks
+    after = _snap()
+    assert after["serving.disagg.fallbacks"] == \
+        before["serving.disagg.fallbacks"] + 1
+    assert after["serving.disagg.remote_handoffs"] == \
+        before["serving.disagg.remote_handoffs"]
+    # the fault struck BEFORE the frame left: decode side untouched
+    assert not _rdec_records()
+    assert dec.cache.num_cached_blocks() == 0
+
+
+@pytest.mark.usefixtures("rpc_loop", "disagg_flags")
+def test_crash_relay_lease_expiry_reclaims_exactly_once():
+    pipe, _, dec = _remote_pipeline(lease_ttl_s=0.4, relay_poll_s=0.005)
+    dec_free0 = dec.cache.num_free_blocks()
+    before = _snap()
+    sink = []
+    h = pipe.submit(PROMPT, max_new_tokens=MAX_NEW,
+                    on_token=sink.append)
+    # first pull lands (the admission-emitted first token crosses, the
+    # cursor moves to 1), then the relay channel goes dark for good:
+    # the lease must expire and ownership reclaim to the prefill
+    # replica, REPLAYING FROM THE CURSOR — the sink sees position 0
+    # once, never twice
+    with faults.inject("disagg.relay", nth=2, count=100000):
+        toks = h.result(timeout=30)
+    assert h.reclaimed and h.status == "DONE"
+    assert toks == _reference(PROMPT, MAX_NEW)
+    assert sink == toks              # exactly once across the reclaim
+    after = _snap()
+    assert after["serving.disagg.reclaims"] == \
+        before["serving.disagg.reclaims"] + 1
+    assert after["serving.disagg.lease_expired"] > \
+        before["serving.disagg.lease_expired"]
+    # reclaim is NOT a fallback: the handoff happened
+    assert after["serving.disagg.fallbacks"] == \
+        before["serving.disagg.fallbacks"]
+    # decode side: the reclaim's best-effort cancel orphaned the
+    # record; once the cancelled request reaches terminal, the sweep
+    # returns its imported blocks to the truly-free list
+    dec.run_until_idle()
+    swept = sweep_remote("rdec")
+    assert swept > 0
+    assert not _rdec_records()
+    assert dec.cache.num_cached_blocks() == 0
+    assert dec.cache.num_free_blocks() == dec_free0
+    end = _snap()
+    assert end["serving.disagg.orphan_blocks"] == \
+        before["serving.disagg.orphan_blocks"] + swept
+
+
+@pytest.mark.usefixtures("rpc_loop", "disagg_flags")
+def test_crash_lease_renewal_severed_still_completes():
+    """Severing ONLY the renewal plane must not fail a healthy relay:
+    a terminal response finishes the request even if every renew
+    failed along the way."""
+    pipe, _, dec = _remote_pipeline()
+    before = _snap()
+    with faults.inject("disagg.lease", nth=1, count=100000):
+        h = pipe.submit(PROMPT, max_new_tokens=MAX_NEW)
+        dec.run_until_idle()
+        toks = h.result(timeout=30)
+    assert toks == _reference(PROMPT, MAX_NEW)
+    assert h.status == "DONE" and not h.reclaimed
+    after = _snap()
+    assert after["serving.disagg.reclaims"] == \
+        before["serving.disagg.reclaims"]
+
+
+@pytest.mark.usefixtures("rpc_loop", "disagg_flags")
+def test_decode_restart_refuses_stale_cursor_loudly():
+    pipe, _, dec = _remote_pipeline()
+    before = _snap()
+    sink = []
+    h = pipe.submit(PROMPT, max_new_tokens=MAX_NEW,
+                    on_token=sink.append)
+    # the decode host 'restarts': its admission table is gone while
+    # the caller still holds a live lease and a cursor
+    disagg._ADMISSIONS.clear()
+    toks = h.result(timeout=30)
+    assert h.reclaimed and h.status == "DONE"
+    assert toks == _reference(PROMPT, MAX_NEW) and sink == toks
+    after = _snap()
+    assert after["serving.disagg.stale_cursors"] > \
+        before["serving.disagg.stale_cursors"]
+    assert after["serving.disagg.reclaims"] == \
+        before["serving.disagg.reclaims"] + 1
+
+
+@pytest.mark.usefixtures("rpc_loop", "disagg_flags")
+def test_orphan_sweep_without_any_relay_traffic():
+    """Reclamation must not depend on pulls arriving: an admission
+    whose caller silently died is cancelled at the first post-expiry
+    sweep (the fleet-heartbeat rung) and its imports freed at the
+    next."""
+    pipe, _, dec = _remote_pipeline(lease_ttl_s=0.0)
+    dec_free0 = dec.cache.num_free_blocks()
+    h = pipe.submit(PROMPT, max_new_tokens=MAX_NEW)
+    rec = _rdec_records()[0]
+    assert not rec.orphaned
+    sweep_remote("rdec")             # ttl 0: instantly expired
+    assert rec.orphaned              # cancelled, counted lease_expired
+    dec.run_until_idle()             # cancel lands at a step boundary
+    swept = sweep_remote("rdec")
+    assert swept > 0 and not _rdec_records()
+    assert dec.cache.num_cached_blocks() == 0
+    assert dec.cache.num_free_blocks() == dec_free0
+    # the caller-side handle reclaims on its own lease independently
+    assert h.result(timeout=30) == _reference(PROMPT, MAX_NEW)
+
+
+@pytest.mark.usefixtures("rpc_loop", "disagg_flags")
+def test_lease_payload_rides_member_payload():
+    pipe, _, dec = _remote_pipeline(lease_ttl_s=30.0)
+    assert disagg.lease_payload("rdec") == {"leases": 0}
+    pipe.submit(PROMPT, max_new_tokens=MAX_NEW)
+    p = disagg.lease_payload("rdec")
+    assert p["leases"] == 1
+    assert 0 < p["lease_min_remaining_s"] <= 30.0
+
+
+# -- satellite: failed LOCAL handoff must not park imported blocks ---------
+
+@pytest.mark.usefixtures("disagg_flags")
+def test_local_handoff_failure_releases_imported_blocks():
+    pre = tiny_engine(_same_weights_model(), prefix_cache=True,
+                      role="prefill")
+    dec = tiny_engine(_same_weights_model(), prefix_cache=True,
+                      role="decode")
+    r = Router()
+    r.add_replica("pre", engine=pre)
+    r.add_replica("dec", engine=dec)
+    pipe = DisaggPipeline(r)
+
+    def _refuse(*a, **kw):
+        raise HandoffError("forced refusal AFTER the import landed")
+    dec.submit_handoff = _refuse
+    free0 = dec.cache.num_free_blocks()
+    assert dec.cache.num_cached_blocks() == 0
+    before = _snap()
+    h = pipe.submit(PROMPT, max_new_tokens=MAX_NEW)
+    pipe.run_until_idle()
+    assert h.result(timeout=30) == _reference(PROMPT, MAX_NEW)
+    after = _snap()
+    assert after["serving.disagg.fallbacks"] == \
+        before["serving.disagg.fallbacks"] + 1
+    # the eager release unregistered every freshly-imported block —
+    # visible as prefix evictions, a restored free count, and ZERO
+    # parked cached blocks (the leak this test pins closed)
+    assert after["serving.prefix.evictions"] > \
+        before["serving.prefix.evictions"]
+    assert dec.cache.num_cached_blocks() == 0
+    assert dec.cache.num_free_blocks() == free0

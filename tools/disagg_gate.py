@@ -19,13 +19,25 @@ through four pass/fail checks, in order of importance:
      broken fabric must never lose a request;
   4. disarmed — ``FLAGS_serving_disagg=0`` is a byte-for-byte
      ``Router.submit`` pass-through with ``serving.disagg.*`` counter
-     silence.
+     silence;
+  5. two-process — the decode stage lives in a REAL separate process
+     (``--decode-worker`` child hosting fp32 + int8 engines behind
+     distributed/rpc.py, ``disagg.register_rpc_engine``): remote
+     admission + the pull relay produce bit-identical greedy outputs
+     with zero decode-side prefill dispatches and zero billed prefill
+     tokens, the decode pool's occupancy closes after the burst, and
+     ``kill -9`` of the decode host MID-STREAM fails open — the
+     caller's lease expires, ownership reclaims to the prefill
+     replica, and the request completes with every token delivered
+     EXACTLY once (cursor replay, no duplicates, no loss) and the
+     prefill pool's occupancy closed.
 
 Exit 0 on pass, 1 on fail; one line per check. Runs under
 JAX_PLATFORMS=cpu (tier-1, like tests/framework/test_disagg.py);
 wired into tools/suite_gate.py beside the serving gates, and appends
-a ``disagg`` entry (handoffs, transfer bytes/us, fallbacks, check
-bits) to the continuous-bench ledger (tools/bench_ledger.py).
+a ``disagg`` entry (handoffs, transfer bytes/us, fallbacks, remote
+relay counters, check bits) to the continuous-bench ledger
+(tools/bench_ledger.py).
 """
 
 import os
@@ -199,6 +211,167 @@ def check_fail_open():
     return ok
 
 
+# -- two-process: the decode stage in another PROCESS ----------------------
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# decode-worker process state: name -> {"engine", "model"}. The stats /
+# drain functions below execute THERE (both processes run this file as
+# __main__, so pickled function refs resolve on either side).
+_WORKER = {}
+
+
+def _worker_drain(name):
+    """Step the (foreground) decode engine until idle — the
+    orchestrator drives decode progress deterministically over rpc."""
+    _WORKER[name]["engine"].run_until_idle()
+    return True
+
+
+def _worker_stats(name):
+    w = _WORKER[name]
+    occ = w["engine"].cache.occupancy()
+    return {
+        "prefill_calls": w["model"].prefill_calls,
+        "inflight": w["engine"].scheduler.inflight(),
+        "active": occ["active"],
+        "occupancy_ok": (occ["active"] + occ["cached_free"]
+                         + occ["free"] == occ["usable"]),
+    }
+
+
+def _decode_worker(port):
+    """Child main: host fp32 + int8 decode engines behind rpc and park
+    until killed (the gate ALWAYS kills this process — the final check
+    is precisely that its death mid-stream loses nothing)."""
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.serving import disagg
+
+    paddle.set_flags({"FLAGS_serving_router": True,
+                      "FLAGS_serving_disagg": True})
+    rpc.init_rpc("dec-host", rank=1, world_size=2,
+                 master_endpoint=f"127.0.0.1:{port}")
+    for name, kw in (("rdec32", {}),
+                     ("rdec8", {"kv_cache_dtype": "int8"})):
+        model = _CountingModel(_model())
+        eng = _engine(model, role="decode", **kw)
+        disagg.register_rpc_engine(name, eng)
+        _WORKER[name] = {"engine": eng, "model": model}
+    while True:  # reaped by SIGKILL; bail if the orchestrator vanished
+        if os.getppid() == 1:
+            return 0
+        time.sleep(0.2)
+
+
+def check_two_process():
+    import subprocess
+
+    from paddle_tpu.distributed import rpc
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import Router
+    from paddle_tpu.serving.disagg import (DisaggPipeline,
+                                           RpcTransport)
+    from paddle_tpu.serving.frontend import Lifecycle
+
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--decode-worker", str(port)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    prompts = _prompts()
+    snap0 = metrics.snapshot()
+    checks = {}
+    try:
+        rpc.init_rpc("front", rank=0, world_size=2,
+                     master_endpoint=f"127.0.0.1:{port}")
+        transport = RpcTransport(worker_of=lambda rid: "dec-host")
+        routers = {}
+        for label, name, kw in (
+                ("fp32", "rdec32", {}),
+                ("int8", "rdec8", {"kv_cache_dtype": "int8"})):
+            pre = _engine(_model(), role="prefill", **kw)
+            r = Router()
+            r.add_replica(f"pre-{label}", engine=pre)
+            rep = r.add_replica(name, role="decode")
+            rep.member = {"state": Lifecycle.READY}
+            routers[label] = (r, pre, name, kw)
+            pipe = DisaggPipeline(r, transport=transport)
+            want = _reference(prompts, **kw)
+            outs, costs = [], []
+            for p in prompts:
+                h = pipe.submit(p, max_new_tokens=MAX_NEW)
+                rpc.rpc_sync("dec-host", _worker_drain, args=(name,))
+                outs.append(h.result(timeout=60))
+                costs.append(h.cost())
+            stats = rpc.rpc_sync("dec-host", _worker_stats,
+                                 args=(name,))
+            checks[f"bit_{label}"] = outs == want
+            checks[f"zero_reprefill_{label}"] = (
+                stats["prefill_calls"] == 0
+                and sum(c.tokens_prefilled for c in costs if c) == 0
+                and sum(c.transfer_bytes for c in costs if c) > 0)
+            checks[f"decode_closure_{label}"] = (
+                stats["inflight"] == 0 and stats["active"] == 0
+                and stats["occupancy_ok"])
+
+        # -- kill -9 the decode host MID-STREAM ------------------------
+        r32, pre32, name32, _ = routers["fp32"]
+        pipe_kill = DisaggPipeline(r32, transport=transport,
+                                   lease_ttl_s=1.5, relay_poll_s=0.01)
+        want0 = _reference([prompts[0]])[0]
+        sink = []
+        h = pipe_kill.submit(prompts[0], max_new_tokens=MAX_NEW,
+                             on_token=sink.append)
+        it = h.stream(timeout=90)
+        first = next(it)  # one relay pull landed: the cursor is live
+        proc.kill()       # SIGKILL — no goodbye, no flushed buffers
+        proc.wait(timeout=30)
+        rest = list(it)   # lease expiry -> reclaim -> co-located replay
+        toks = [first] + rest
+        occ = pre32.cache.occupancy()
+        checks["kill_recovered"] = (
+            h.status == "DONE" and h.reclaimed and toks == want0
+            and sink == toks  # exactly once, across the process death
+            and occ["active"] == 0
+            and occ["active"] + occ["cached_free"] + occ["free"]
+            == occ["usable"])
+    except Exception as e:  # noqa: BLE001 — a wedged rendezvous or a
+        # dead child is a FAIL with a reason, not a traceback
+        checks["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        try:
+            proc.wait(timeout=30)
+        except Exception:  # noqa: BLE001
+            pass
+        rpc.shutdown(graceful=False)  # the peer is a corpse: no barrier
+    snap1 = metrics.snapshot()
+    remote = snap1.get("serving.disagg.remote_handoffs", 0) \
+        - snap0.get("serving.disagg.remote_handoffs", 0)
+    reclaims = snap1.get("serving.disagg.reclaims", 0) \
+        - snap0.get("serving.disagg.reclaims", 0)
+    checks["remote_counts"] = (remote == 2 * len(prompts) + 1
+                               and reclaims == 1)
+    ok = all(v is True for v in checks.values())
+    detail = " ".join(f"{k}={v}" for k, v in sorted(checks.items()))
+    print(f"[disagg-gate] two-process: {detail} "
+          f"(remote_handoffs={remote}, reclaims={reclaims}) "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok
+
+
 def check_disarmed():
     import paddle_tpu as paddle
     from paddle_tpu.profiler import metrics
@@ -242,7 +415,8 @@ def main():
     ok2 = check_zero_reprefill()
     ok3 = check_fail_open()
     ok4 = check_disarmed()
-    ok = ok1 and ok2 and ok3 and ok4
+    ok5 = check_two_process()
+    ok = ok1 and ok2 and ok3 and ok4 and ok5
     snap = metrics.snapshot()
     try:
         import bench_ledger
@@ -254,10 +428,19 @@ def main():
                 snap.get("serving.disagg.transfer_us", 0.0)),
             "fallbacks": float(
                 snap.get("serving.disagg.fallbacks", 0)),
+            "remote_handoffs": float(
+                snap.get("serving.disagg.remote_handoffs", 0)),
+            "dup_frames": float(
+                snap.get("serving.disagg.dup_frames", 0)),
+            "lease_expired": float(
+                snap.get("serving.disagg.lease_expired", 0)),
+            "reclaims": float(
+                snap.get("serving.disagg.reclaims", 0)),
             "bit_equivalence_ok": 1.0 if ok1 else 0.0,
             "zero_reprefill_ok": 1.0 if ok2 else 0.0,
             "fail_open_ok": 1.0 if ok3 else 0.0,
-            "disarmed_ok": 1.0 if ok4 else 0.0})
+            "disarmed_ok": 1.0 if ok4 else 0.0,
+            "two_process_ok": 1.0 if ok5 else 0.0})
         print("[disagg-gate] ledger: appended disagg "
               f"(handoffs={snap.get('serving.disagg.handoffs', 0)}, "
               f"fallbacks={snap.get('serving.disagg.fallbacks', 0)})")
@@ -269,4 +452,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--decode-worker":
+        sys.exit(_decode_worker(int(sys.argv[2])))
     sys.exit(main())

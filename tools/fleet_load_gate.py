@@ -27,11 +27,14 @@ metric Windows. Six pass/fail checks:
                      byte-identically twice (the loadgen purity
                      contract the whole harness rests on);
   6. disagg        — a prefill/decode role pair behind the ISSUE 17
-                     two-stage pipeline takes a shared-prefix burst:
-                     every request reaches a clean terminal, real
-                     handoffs happen, and anything the fabric could
-                     not hand off fell OPEN to co-located serving
-                     (handoffs + fallbacks == arrivals).
+                     two-stage pipeline takes a shared-prefix burst,
+                     and the decode replica is KILLED mid-burst (the
+                     harness's injected-replica-death idiom): every
+                     request — handed off before the kill or arriving
+                     after it — still reaches a clean terminal, real
+                     handoffs happen, and everything the dead fabric
+                     could not hand off fell OPEN to co-located
+                     serving (handoffs + fallbacks == arrivals).
 
 Every number is read through a per-phase ``metrics.Window`` — the
 global registry is never reset. Appends a ``fleet_load`` entry
@@ -165,14 +168,15 @@ def check_locality(card):
 
 
 def check_disagg():
-    """Disaggregated serving under a shared-prefix burst (ISSUE 17):
-    a prefill-role + decode-role pair behind the two-stage pipeline
-    takes a loadgen burst; every accepted request must reach a clean
-    terminal with at least one real handoff, and every request the
-    fabric could not hand off (decode slots exhausted mid-burst) must
-    fail OPEN to co-located serving — handoffs + fallbacks == n.
-    Counters read through a scoped ``metrics.Window``, the scenario
-    discipline."""
+    """Disaggregated serving under a shared-prefix burst with the
+    decode replica KILLED mid-burst (ISSUE 17 + the remote-handoff
+    robustness contract): the first half of the burst hands off
+    normally; then the decode replica dies (the FleetHarness injected-
+    death idiom — next step raises, readiness reflects the error) and
+    every later arrival must fail OPEN to co-located serving on the
+    prefill replica. No request is lost either way — handoffs +
+    fallbacks == n, all terminals clean. Counters read through a
+    scoped ``metrics.Window``, the scenario discipline."""
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
@@ -205,24 +209,40 @@ def check_disagg():
         router.add_replica("dg-dec", engine=dec)
         pipe = DisaggPipeline(router)
         win = metrics.Window("serving.disagg.")
+        kill_at = len(records) // 2
         handles = [pipe.submit(loadgen.prompt_ids(r),
                                max_new_tokens=r.max_new_tokens)
-                   for r in records]
+                   for r in records[:kill_at]]
+        pipe.run_until_idle()
+        # kill-decode-mid-handoff: the harness's injected-death idiom
+        # (scorecard.FleetHarness.kill) — the next scheduler step
+        # raises and readiness reflects the error, so the decode stage
+        # vanishes from under the rest of the burst
+        dec._error = RuntimeError("injected replica death: dg-dec")
+        dec._sched.step = lambda: (_ for _ in ()).throw(
+            RuntimeError("injected replica death: dg-dec"))
+        handles += [pipe.submit(loadgen.prompt_ids(r),
+                                max_new_tokens=r.max_new_tokens)
+                    for r in records[kill_at:]]
         pipe.run_until_idle()
         statuses = [h.result(timeout=60) and h.status for h in handles]
         win.freeze()
         pre.close()
-        dec.close()
+        try:
+            dec.close()
+        except RuntimeError:
+            pass  # the killed replica's driver is expected to be dead
     finally:
         paddle.set_flags(saved)
     handoffs = win.value("serving.disagg.handoffs")
     fallbacks = win.value("serving.disagg.fallbacks")
     clean = all(s == "DONE" for s in statuses)
-    ok = (clean and handoffs > 0
+    ok = (clean and handoffs > 0 and fallbacks >= len(records) - kill_at
           and handoffs + fallbacks == len(records))
     print(f"[fleet-load-gate] disagg: handoffs={handoffs} "
           f"fallbacks={fallbacks} (want handoffs+fallbacks="
-          f"{len(records)}, handoffs > 0) all-DONE={clean} "
+          f"{len(records)}, handoffs > 0, decode killed after "
+          f"{kill_at}) all-DONE={clean} "
           f"transfer-bytes={win.value('serving.disagg.transfer_bytes')}"
           f" {'PASS' if ok else 'FAIL'}")
     return ok, {"disagg_handoffs": float(handoffs),

@@ -88,6 +88,17 @@ def direction_and_tol(name):
         # proxy (interpret >> XLA), not the TPU speedup — the gate only
         # guards against the kernel path getting structurally slower
         return ("up", TIME_TOL)
+    if "dup_frames" in name:
+        # re-shipped frames after ambiguous rpc timeouts (kind disagg):
+        # each one is safe (import dedups, admission is idempotent) but
+        # GROWTH means the channel is flaking more — larger is worse
+        return ("up", RATE_TOL)
+    if "lease_expired" in name:
+        # remote-handoff leases that ran out before a terminal status
+        # (kind disagg): every one is a presumed-dead peer and a
+        # cursor-replayed reclaim — a healthy fleet renews faster than
+        # it expires, so GROWTH is the regression
+        return ("up", RATE_TOL)
     if "transfer_bytes" in name:
         # disaggregated handoff payload size (kind disagg): GROWTH is
         # the regression — a fatter frame per handoff means scale rows

@@ -48,17 +48,21 @@ _MAP = [
     ("paddle_tpu/serving/loadgen.py",
      ["tests/framework/test_loadgen.py"]),
     ("paddle_tpu/serving/kv_transfer.py",
-     ["tests/framework/test_disagg.py"]),
+     ["tests/framework/test_disagg.py",
+      "tests/framework/test_disagg_remote.py"]),
     ("paddle_tpu/serving/disagg.py",
-     ["tests/framework/test_disagg.py"]),
-    ("tools/disagg_gate.py", ["tests/framework/test_disagg.py"]),
+     ["tests/framework/test_disagg.py",
+      "tests/framework/test_disagg_remote.py"]),
+    ("tools/disagg_gate.py", ["tests/framework/test_disagg.py",
+                              "tests/framework/test_disagg_remote.py"]),
     ("paddle_tpu/serving/", ["tests/framework/test_serving.py",
                              "tests/framework/test_prefix_cache.py",
                              "tests/framework/test_fleet_observatory.py",
                              "tests/framework/test_router.py",
                              "tests/framework/test_overload.py",
                              "tests/framework/test_mesh_serving.py",
-                             "tests/framework/test_disagg.py"]),
+                             "tests/framework/test_disagg.py",
+                             "tests/framework/test_disagg_remote.py"]),
     ("paddle_tpu/inference/", ["tests/framework/test_paged_decode.py",
                                "tests/framework/test_serving.py",
                                "tests/framework/test_prefix_cache.py",
@@ -97,6 +101,8 @@ _MAP = [
     ("paddle_tpu/nn/", ["tests/nn", "tests/test_oracle_sweep_api.py"]),
     ("paddle_tpu/distributed/mesh.py",
      ["tests/framework/test_mesh_serving.py", "tests/distributed"]),
+    ("paddle_tpu/distributed/rpc.py",
+     ["tests/distributed", "tests/framework/test_disagg_remote.py"]),
     ("paddle_tpu/distributed/", ["tests/distributed"]),
     ("paddle_tpu/fleet/", ["tests/distributed"]),
     ("paddle_tpu/kernels/", ["tests/kernels",
