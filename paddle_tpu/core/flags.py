@@ -453,3 +453,74 @@ define_flag("FLAGS_fleet_skew_ratio", 2.5,
             "exceeds this multiple of the fleet median p95 (both from "
             "merged scrape buckets, with a min-sample floor) is flagged "
             "as the slow outlier a router should de-weight")
+define_flag("FLAGS_fleet_cache", False,
+            "fleet cache plane (serving/fleet_cache.py): each replica "
+            "advertises a capped hot slice of its registered chunk "
+            "digests through the fleet-registry heartbeat payload, the "
+            "Router scales its health/(1+inflight) rank by predicted "
+            "leading prefix coverage, and a chosen replica that covers "
+            "LESS than the best advertising peer pulls the registered "
+            "blocks over the serving/kv_transfer.py frame plane before "
+            "admission instead of re-prefilling — with any scoring or "
+            "pull failure failing open to plain health-ranked local "
+            "prefill, bit-identical (digests only gate placement; "
+            "tools/fleet_cache_gate.py pins it); 0 (default) reverts "
+            "byte-for-byte with serving.fleet_cache.* counter silence "
+            "(read at Router AND ServingEngine construction, the "
+            "FLAGS_serving_prefix_cache convention)")
+define_flag("FLAGS_fleet_cache_digests", 64,
+            "fleet cache advertisement cap: how many hot registered "
+            "full-chunk digests a replica's DigestPublisher folds into "
+            "each heartbeat payload, hottest first (live-referenced "
+            "blocks newest-registration-first, then the reclaimable "
+            "LRU newest-first) — bounds heartbeat payload growth; a "
+            "truncated advertisement only shortens the predictable "
+            "leading coverage, never corrupts it")
+define_flag("FLAGS_fleet_cache_weight", 2.0,
+            "fleet cache coverage weight: the Router multiplies a "
+            "candidate's health/(1+inflight) rank by (1 + weight * "
+            "covered_fraction) — at the default a fully-covered idle "
+            "replica outranks an uncovered idle one 3:1, and a loaded "
+            "covered replica stops absorbing traffic once its inflight "
+            "damping exceeds the boost (which is what spreads a "
+            "shared-prefix storm onto peers, who then pull)")
+define_flag("FLAGS_fleet_cache_publish_s", 1.0,
+            "fleet cache in-process publication cadence, seconds: how "
+            "often the router-side plane snapshots engine-bound "
+            "replicas' advertisements on the submit path (store-less "
+            "fleets — tests, gates, single-process demos); store-"
+            "discovered replicas ride their registry heartbeat instead "
+            "and ignore this")
+define_flag("FLAGS_fleet_autoscale", False,
+            "predictive fleet autoscaler (serving/autoscaler.py): a "
+            "hysteresis controller (the serving/overload.py brownout "
+            "school — edge-triggered, flight-recorded) over merged "
+            "fleet pressure (per-replica overload pressure, queue "
+            "fraction, brownout stage, and the fleet shed-rate delta) "
+            "that spawns ONE warm replica through the caller's spawn "
+            "callback after FLAGS_autoscale_enter_steps sustained "
+            "over-pressure ticks and retires the least-loaded replica "
+            "it spawned through the zero-drop drain contract after "
+            "FLAGS_autoscale_exit_steps sustained calm ticks; 0 "
+            "(default) makes update() a counter-silent no-op — "
+            "serving.autoscale.* never moves, the fleet is never "
+            "mutated (read at FleetAutoscaler construction, the "
+            "FLAGS_serving_prefix_cache convention)")
+define_flag("FLAGS_autoscale_enter_steps", 3,
+            "autoscaler scale-up hysteresis: consecutive update() "
+            "ticks at pressure >= 1.0 before ONE replica spawns (the "
+            "BrownoutController enter_steps discipline; an in-band "
+            "tick resets the count)")
+define_flag("FLAGS_autoscale_exit_steps", 6,
+            "autoscaler scale-down hysteresis: consecutive update() "
+            "ticks at pressure <= FLAGS_autoscale_low before ONE "
+            "spawned replica drains and retires — deliberately slower "
+            "than scale-up (capacity is cheap, queue time is not)")
+define_flag("FLAGS_autoscale_low", 0.3,
+            "autoscaler calm watermark: fleet pressure at or below "
+            "this reads as surplus capacity; between this and 1.0 is "
+            "the hold band where both hysteresis accumulators reset")
+define_flag("FLAGS_autoscale_max_replicas", 8,
+            "autoscaler fleet-size ceiling: scale-up edges past this "
+            "live engine-bound size are held (counted "
+            "serving.autoscale.holds), never spawned")

@@ -150,16 +150,31 @@ class Registrar:
                            if ttl_s is None else ttl_s)
         self._status_fn = status_fn
         # extra_fn: zero-arg callable whose dict merges into every
-        # heartbeat payload (reserved keys win) — how the remote
-        # handoff plane publishes lease state
-        # (disagg.register_rpc_engine sets it post-construction)
+        # heartbeat payload (reserved keys win). Post-construction
+        # contributors COMPOSE via add_extra — the remote handoff
+        # plane (lease state), pool geometry, and the fleet cache
+        # digest advertisement all ride the same beat
         self.extra_fn = extra_fn
+        self._extra_fns = []
         self._ident = ident
         self._slot = None
         self._stop = threading.Event()
         self._thread = None
         self._adopted_identity = False
         self._beat_hooks = []
+
+    def add_extra(self, fn):
+        """Register another payload contributor: ``fn()``'s dict merges
+        into every heartbeat after ``extra_fn`` (reserved keys and
+        earlier contributors win — ``setdefault`` semantics, so
+        contributors cannot clobber each other). Failures are dropped
+        per-contributor and never stop beats. This is how several
+        planes share one registrar: lease state
+        (serving/disagg.register_rpc_engine), pool geometry
+        (serving/fleet_cache.geometry_payload), digest advertisements
+        (DigestPublisher.payload)."""
+        self._extra_fns.append(fn)
+        return fn
 
     def add_beat_hook(self, fn):
         """Run ``fn()`` once per heartbeat (best-effort, after the
@@ -179,9 +194,11 @@ class Registrar:
              "ttl_s": self.ttl_s, "slot": self._slot,
              "role": self.role,
              "heartbeat_ts": time.time()}
-        if self.extra_fn is not None:
+        fns = ([self.extra_fn] if self.extra_fn is not None else []) \
+            + list(self._extra_fns)
+        for fn in fns:
             try:
-                extra = dict(self.extra_fn())
+                extra = dict(fn())
             except Exception:  # noqa: BLE001 — optional payload axes
                 extra = {}     # must never stop beats
             for k, v in extra.items():

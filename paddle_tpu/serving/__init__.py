@@ -30,17 +30,21 @@ contract, and the metric catalog.
 """
 
 from . import aot_cache  # noqa: F401
+from . import autoscaler  # noqa: F401
 from . import disagg  # noqa: F401
+from . import fleet_cache  # noqa: F401
 from . import kv_transfer  # noqa: F401
 from . import mesh  # noqa: F401
 from . import overload  # noqa: F401
 from . import spec  # noqa: F401
+from .autoscaler import FleetAutoscaler  # noqa: F401
 from .bucketing import bucket_length, bucket_lengths  # noqa: F401
 from .disagg import DisaggPipeline  # noqa: F401
+from .fleet_cache import FleetCachePlane  # noqa: F401
 from .frontend import (AdmissionRejected, HandoffError,  # noqa: F401
                        Lifecycle, NotReadyError, QueueFullError,
                        RequestHandle, RequestStatus, ServingEngine)
-from .kv_transfer import TransferError  # noqa: F401
+from .kv_transfer import GeometryMismatch, TransferError  # noqa: F401
 from .router import (NoReplicaAvailable, RoutedHandle,  # noqa: F401
                      Router, RouterReplica)
 from .scheduler import Scheduler, ServingRequest  # noqa: F401
@@ -50,5 +54,7 @@ __all__ = ["ServingEngine", "RequestHandle", "RequestStatus",
            "NotReadyError", "HandoffError", "Scheduler",
            "ServingRequest", "Router", "RouterReplica", "RoutedHandle",
            "NoReplicaAvailable", "DisaggPipeline", "TransferError",
-           "aot_cache", "disagg", "kv_transfer", "overload", "mesh",
+           "GeometryMismatch", "FleetCachePlane", "FleetAutoscaler",
+           "aot_cache", "autoscaler", "disagg", "fleet_cache",
+           "kv_transfer", "overload", "mesh",
            "bucket_length", "bucket_lengths"]

@@ -1,0 +1,239 @@
+"""Predictive fleet autoscaler: hysteresis over merged pressure.
+
+The elasticity half of the fleet cache plane (serving/fleet_cache.py):
+with routing cache-aware and KV pullable between pools, replicas are
+finally fungible enough to add and remove mechanically. This module
+closes that loop with a deliberately boring controller — the
+``BrownoutController`` school (serving/overload.py): edge-triggered,
+hysteresis on BOTH edges, every transition flight-recorded — over a
+MERGED fleet pressure signal:
+
+- per READY replica, the max of its overload pressure
+  (``OverloadController.pressure``: queue watermark, binding-slice KV
+  headroom, predicted wait), its raw queue fraction (so the signal
+  exists even with the overload plane disarmed), and its brownout
+  stage (stage s > 0 reads as ``1 + s/4`` — a browned-out replica IS
+  over pressure by definition);
+- fleet pressure = mean over READY replicas, floored to >= 1.0 when
+  ANY requests were shed since the last tick (``serving.shed`` delta —
+  shed traffic is the one signal that must never average away).
+
+``update()`` is one evaluation tick (callers own the cadence: a
+registrar beat hook, a gate loop, an operator cron). Sustained
+pressure >= 1.0 for ``FLAGS_autoscale_enter_steps`` ticks spawns ONE
+warm replica through the caller's ``spawn`` callback (an AOT-store
+boot is zero-compile — serving/aot_cache.py), ``warmup()``s it if
+still WARMING, and adds it to the router; sustained pressure <=
+``FLAGS_autoscale_low`` for ``FLAGS_autoscale_exit_steps`` ticks
+retires the least-loaded replica THIS controller spawned — never the
+seed fleet — through the zero-drop drain contract
+(``Router.drain`` -> ``remove_replica`` -> ``close``). In-band ticks
+count ``holds``; both edges reset both accumulators, so a flapping
+signal scales at most once per sustained excursion.
+
+Counters: ``serving.autoscale.{scale_ups,scale_downs,holds}``.
+``FLAGS_fleet_autoscale=0`` (default; read at construction, the
+``FLAGS_serving_prefix_cache`` convention) makes ``update()`` a no-op
+returning the current stage — zero counter movement, zero fleet
+mutation (tools/fleet_cache_gate.py pins the silence).
+"""
+
+from __future__ import annotations
+
+from ..core import flags as flags_mod
+from ..core import resilience
+from ..profiler import metrics as _metrics
+from .frontend import Lifecycle
+
+__all__ = ["FleetAutoscaler", "fleet_pressure"]
+
+_c_scale_ups = _metrics.counter("serving.autoscale.scale_ups")
+_c_scale_downs = _metrics.counter("serving.autoscale.scale_downs")
+_c_holds = _metrics.counter("serving.autoscale.holds")
+_g_size = _metrics.gauge("serving.autoscale.size")
+
+_SHED = _metrics.counter("serving.shed")
+
+
+def _replica_pressure(engine):
+    sched = engine.scheduler
+    p = 0.0
+    ov = getattr(sched, "overload", None)
+    if ov is not None:
+        try:
+            p = float(ov.pressure(sched))
+        except Exception:  # noqa: BLE001 — a broken signal reads as calm;
+            p = 0.0        # the queue fraction below still sees backlog
+        bo = getattr(ov, "brownout", None)
+        stage = getattr(bo, "stage", 0) if bo is not None else 0
+        if stage:
+            p = max(p, 1.0 + stage / 4.0)
+    if sched.max_queue:
+        p = max(p, len(sched.queue) / float(sched.max_queue))
+    return p
+
+
+def fleet_pressure(router):
+    """Merged fleet pressure (module docstring): mean per-READY-replica
+    pressure, >= 1.0 whenever the fleet shed since the last call site's
+    tick handles the shed delta (see :meth:`FleetAutoscaler.update`)."""
+    vals = []
+    for rid in list(router._order):
+        rep = router._replicas.get(rid)
+        eng = rep.engine if rep is not None else None
+        if eng is None or eng._error is not None \
+                or eng.lifecycle != Lifecycle.READY:
+            continue
+        vals.append(_replica_pressure(eng))
+    return sum(vals) / len(vals) if vals else 0.0
+
+
+class FleetAutoscaler:
+    """See module docstring. ``router`` is the fleet front door;
+    ``spawn`` a zero-arg callable returning a fresh ``ServingEngine``
+    (conventionally an AOT-store warm boot). ``pressure_fn`` overrides
+    the merged signal (tests/gates inject deterministic pressure the
+    way ``shed_tune`` pins watermarks); knob defaults read the
+    ``FLAGS_autoscale_*`` family at construction."""
+
+    def __init__(self, router, spawn, *, min_replicas=1,
+                 max_replicas=None, enter_steps=None, exit_steps=None,
+                 low_pressure=None, pressure_fn=None,
+                 drain_timeout_s=60.0, rid_prefix="auto"):
+        self._armed = bool(flags_mod.flag("FLAGS_fleet_autoscale"))
+        self.router = router
+        self._spawn = spawn
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(
+            flags_mod.flag("FLAGS_autoscale_max_replicas")
+            if max_replicas is None else max_replicas)
+        self.enter_steps = int(
+            flags_mod.flag("FLAGS_autoscale_enter_steps")
+            if enter_steps is None else enter_steps)
+        self.exit_steps = int(
+            flags_mod.flag("FLAGS_autoscale_exit_steps")
+            if exit_steps is None else exit_steps)
+        self.low_pressure = float(
+            flags_mod.flag("FLAGS_autoscale_low")
+            if low_pressure is None else low_pressure)
+        self._pressure_fn = pressure_fn
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.rid_prefix = str(rid_prefix)
+        self._spawned = {}  # replica_id -> engine (retirement set)
+        self._seq = 0
+        self._over = 0
+        self._under = 0
+        self._last_shed = _SHED.value
+
+    # -- signals --------------------------------------------------------
+
+    def pressure(self):
+        """The signal one tick acts on: ``pressure_fn`` if injected,
+        else :func:`fleet_pressure`, floored to 1.0 when requests were
+        shed since the previous tick."""
+        p = float(self._pressure_fn() if self._pressure_fn is not None
+                  else fleet_pressure(self.router))
+        shed = _SHED.value
+        if shed > self._last_shed:
+            p = max(p, 1.0)
+        self._last_shed = shed
+        return p
+
+    def size(self):
+        """Live engine-bound fleet size (what min/max bound)."""
+        with self.router._lock:
+            reps = list(self.router._replicas.values())
+        return sum(1 for r in reps if r.engine is not None
+                   and r.engine._error is None
+                   and r.engine.lifecycle == Lifecycle.READY)
+
+    # -- the control loop -----------------------------------------------
+
+    def update(self):
+        """One evaluation tick; returns ``"up"``, ``"down"``, or None
+        (held). Disarmed: always None, no counters, no mutation."""
+        if not self._armed:
+            return None
+        p = self.pressure()
+        action = None
+        if p >= 1.0:
+            self._under = 0
+            self._over += 1
+            if self._over >= self.enter_steps:
+                self._over = 0
+                if self._scale_up(p):
+                    action = "up"
+        elif p <= self.low_pressure:
+            self._over = 0
+            self._under += 1
+            if self._under >= self.exit_steps:
+                self._under = 0
+                if self._scale_down(p):
+                    action = "down"
+        else:
+            # in-band: both accumulators reset — excursions must be
+            # SUSTAINED, a dip through the band starts the count over
+            self._over = 0
+            self._under = 0
+        if action is None:
+            _c_holds.inc()
+        _g_size.set(self.size())
+        return action
+
+    def _record(self, name, status, **meta):
+        try:
+            from ..distributed import watchdog
+            watchdog.record_event(name, meta=meta, status=status)
+        except Exception:  # noqa: BLE001 — flight recording is advisory
+            pass
+
+    def _scale_up(self, pressure):
+        if self.size() >= self.max_replicas:
+            return False  # at ceiling: the tick counts as a hold
+        try:
+            eng = self._spawn()
+            if eng.lifecycle == Lifecycle.WARMING:
+                eng.warmup()
+            self._seq += 1
+            rid = f"{self.rid_prefix}{self._seq}"
+            self.router.add_replica(rid, engine=eng)
+            self._spawned[rid] = eng
+        except Exception as e:  # noqa: BLE001 — a failed spawn must not
+            # kill the control loop; pressure will re-trigger the edge
+            resilience.degrade("autoscale.spawn", exc=e)
+            return False
+        _c_scale_ups.inc()
+        self._record("autoscale.scale_up", "degraded",
+                     replica=rid, pressure=round(pressure, 4),
+                     size=self.size())
+        return True
+
+    def _scale_down(self, pressure):
+        victim = None
+        for rid, eng in self._spawned.items():
+            if eng._error is not None \
+                    or eng.lifecycle != Lifecycle.READY:
+                continue
+            load = eng.scheduler.inflight()
+            if victim is None or load < victim[1]:
+                victim = (rid, load)
+        if victim is None or self.size() <= self.min_replicas:
+            return False  # nothing retirable: hold
+        rid = victim[0]
+        eng = self._spawned.pop(rid)
+        try:
+            # the PR 11 zero-drop contract: drain finishes in-flight
+            # work while _candidates() already refuses the replica
+            self.router.drain(rid, timeout=self.drain_timeout_s)
+        except Exception as e:  # noqa: BLE001 — a wedged drain still
+            # retires the replica from routing; close() below drains
+            # again best-effort
+            resilience.degrade("autoscale.drain", detail=f"replica={rid}",
+                               exc=e)
+        self.router.remove_replica(rid)
+        eng.close()
+        _c_scale_downs.inc()
+        self._record("autoscale.scale_down", "recovered",
+                     replica=rid, pressure=round(pressure, 4),
+                     size=self.size())
+        return True

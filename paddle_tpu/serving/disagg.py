@@ -200,7 +200,15 @@ def register_rpc_engine(name, engine, registrar=None):
     does not depend on relay traffic arriving."""
     _RPC_ENGINES[str(name)] = engine
     if registrar is not None:
-        registrar.extra_fn = lambda: lease_payload(name)
+        # COMPOSE with the registrar's other payload contributors
+        # (Registrar.add_extra) — clobbering extra_fn would silently
+        # drop the geometry / digest advertisements sharing the beat
+        registrar.add_extra(lambda: lease_payload(name))
+        # decode hosts pre-register pool geometry so remote admission
+        # and peer pulls refuse a mismatch BEFORE a frame ships
+        # (kv_transfer.check_geometry against this payload)
+        registrar.add_extra(
+            lambda: {"kv_geom": kv_transfer.geometry(engine.cache)})
         registrar.add_beat_hook(lambda: sweep_remote(name))
     return engine
 
@@ -594,7 +602,16 @@ class DisaggPipeline:
                     if rep.engine is None:
                         # engine-less candidate: the decode stage lives
                         # in ANOTHER process — admission + token relay
-                        # ride the rpc transport (module docstring)
+                        # ride the rpc transport (module docstring).
+                        # Refuse an advertised pool-geometry mismatch
+                        # BEFORE the frame ships (GeometryMismatch is a
+                        # TransferError: the sweep records the reason
+                        # and moves to the next candidate)
+                        kv_transfer.check_geometry(
+                            kv_transfer.geometry(
+                                prefill_rep.engine.cache),
+                            (rep.member or {}).get("kv_geom"),
+                            who=f"disagg.decode.{rep.replica_id}")
                         handle = self._remote_handoff(
                             rep, prefill_rep, preq, ctx, prompt_ids,
                             first_token, max_new_tokens, deadline,

@@ -1,0 +1,442 @@
+"""Fleet cache plane: digest publication, cache-aware routing, peer
+KV pulls.
+
+PR 17/19 gave each replica a content-addressed prefix cache and a
+crc-framed way to move registered KV blocks between pools — but the
+fleet still behaves like N independent caches behind a cache-BLIND
+router: a shared-system-prompt workload recomputes the same prefix on
+every replica it lands on. This module makes the N caches act like
+one, in three planes:
+
+1. **Digest publication** — each replica folds a compact summary of
+   its hot registered chunk digests into its fleet-registry heartbeat
+   payload (:class:`DigestPublisher` via ``Registrar.add_extra``):
+   newest-registration-first out of the pool's live index, capped at
+   ``FLAGS_fleet_cache_digests`` entries, hex-encoded, with a ``seq``
+   that only moves when the summary changes (delta-friendly: an
+   unchanged advertisement is recognizable without set comparison).
+   Store-less in-process fleets (every test/gate topology) get the
+   same cadence from the router-side plane's rate-limited snapshot
+   (:meth:`FleetCachePlane.publish`).
+2. **Cache-aware routing** — the :class:`~.router.Router` computes the
+   submitted prompt's ``chunk_digests`` ONCE per sweep and scales each
+   candidate's existing ``health/(1+inflight)`` rank by
+   ``1 + FLAGS_fleet_cache_weight * predicted_coverage`` where
+   predicted coverage is the LEADING run of prompt digests present in
+   the candidate's advertisement (digests chain, so a leading run is
+   exactly a usable prefix). A misprediction can never produce a wrong
+   result — digests only gate *placement* — and any scoring failure
+   fails open to the pure health rank
+   (``resilience.degrade('fleet_cache.score')``).
+3. **Peer fill** — when the chosen replica's own pool covers LESS of
+   the prompt than the best advertising peer, the router pulls the
+   advertised blocks over the existing ``kv_transfer`` frame plane
+   (``export_prefix`` on the peer — in-process directly, cross-process
+   via ``disagg.RpcTransport`` + :func:`_rpc_export` — then the
+   all-or-nothing deduping ``import_prefix`` into the chosen pool)
+   BEFORE submitting, so ordinary admission sees the prefix resident
+   and extends instead of re-prefilling. A stale advertisement (the
+   peer evicted between heartbeat and pull) surfaces as
+   ``export_prefix``'s non-resident :class:`~.kv_transfer.
+   TransferError`; that — and every other pull failure — degrades to
+   plain local prefill (``serving.fleet_cache.pull_fallbacks``,
+   ``resilience.degrade('fleet_cache.pull')``), outputs bit-identical
+   either way. Pull geometry is refused BEFORE a frame ships
+   (:func:`~.kv_transfer.check_geometry` against the advertised
+   ``kv_geom``). Pull time/bytes bill on the request like a disagg
+   transfer (``Accountant.note_transfer``) and record a
+   ``serving.fleet_pull`` span on its trace.
+
+Counters: ``serving.fleet_cache.{published,coverage_hits,peer_pulls,
+pull_bytes,pull_fallbacks}``. Fault sites: ``fleet_cache.publish``,
+``fleet_cache.pull`` (docs/ROBUSTNESS.md). ``FLAGS_fleet_cache=0``
+(default; read at Router AND ServingEngine construction, the
+``FLAGS_serving_prefix_cache`` convention) builds neither publisher
+nor plane: placement, payloads, and counters stay byte-for-byte
+pre-fleet-cache (tools/fleet_cache_gate.py pins the silence).
+
+The elasticity half of the fleet plane — the predictive autoscaler
+that spawns/drains replicas off merged fleet pressure — lives in
+``serving/autoscaler.py``.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..core import flags as flags_mod
+from ..core import resilience
+from ..inference.paged import chunk_digests
+from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
+from ..testing import faults as _faults
+from . import kv_transfer
+from .kv_transfer import GeometryMismatch, TransferError, check_geometry
+
+__all__ = ["DigestPublisher", "FleetCachePlane", "geometry_payload",
+           "GeometryMismatch", "check_geometry"]
+
+_c_published = _metrics.counter("serving.fleet_cache.published")
+_c_coverage_hits = _metrics.counter("serving.fleet_cache.coverage_hits")
+_c_peer_pulls = _metrics.counter("serving.fleet_cache.peer_pulls")
+_c_pull_bytes = _metrics.counter("serving.fleet_cache.pull_bytes")
+_c_pull_fallbacks = _metrics.counter(
+    "serving.fleet_cache.pull_fallbacks")
+
+
+def geometry_payload(engine):
+    """The pool-geometry half of a replica's registry payload
+    (``kv_geom``): block size, kv dtype, head layout. Published
+    UNCONDITIONALLY (pure mechanism, no flag) so remote admission
+    (serving/disagg.py) and peer pulls can refuse a geometry mismatch
+    BEFORE a frame ships — the PR 19 leftover."""
+    return {"kv_geom": kv_transfer.geometry(engine.scheduler.cache)}
+
+
+class DigestPublisher:
+    """One replica's advertisement builder: the hot slice of its
+    registered full-chunk digest set, hottest first — blocks a live
+    request still references (most recently registered first), then
+    the parked reclaimable LRU newest-first (the next-evicted digest
+    is the LAST a peer should bet a pull on). Partial-tail keys are
+    never advertised: a pull lands whole blocks or nothing.
+
+    ``payload()`` is what rides the registry heartbeat
+    (``Registrar.add_extra``) and what the router-side plane snapshots
+    for store-less fleets; it walks live pool maps WITHOUT the engine
+    lock (heartbeats must not wait out a device step), so a racing
+    mutation can raise — callers treat any failure as "advertisement
+    unchanged this beat" (``fleet_cache.publish`` discipline)."""
+
+    __slots__ = ("engine", "cap", "_seq", "_last")
+
+    def __init__(self, engine, cap=None):
+        self.engine = engine
+        self.cap = int(flags_mod.flag("FLAGS_fleet_cache_digests")
+                       if cap is None else cap)
+        self._seq = 0
+        self._last = None
+
+    def digests(self):
+        """Hot-first capped list of registered full-chunk digests
+        (raw bytes)."""
+        cache = self.engine.scheduler.cache
+        parked = list(cache._cached_free)
+        parked_set = set(parked)
+        # active = registered blocks NOT parked (a live request holds
+        # them); _block_keys is insertion-ordered, newest registration
+        # last — reverse for recency
+        keyed = list(cache._block_keys.items())
+        out, seen = [], set()
+
+        def _add(block_ids):
+            for b in block_ids:
+                for kind, key in cache._block_keys.get(b, ()):
+                    if kind != "full" or key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(key)
+                    if len(out) >= self.cap:
+                        return True
+            return False
+
+        if not _add(b for b, _ in reversed(keyed)
+                    if b not in parked_set):
+            _add(reversed(parked))
+        return out
+
+    def payload(self):
+        """The heartbeat/advertisement dict:
+        ``{"kv_digests": [hex...], "kv_digest_seq": n}`` — ``seq``
+        moves only when the digest list changed, so consumers can skip
+        unchanged advertisements without comparing sets. Counted
+        ``serving.fleet_cache.published`` per build; fault site
+        ``fleet_cache.publish``."""
+        _faults.site("fleet_cache.publish")
+        digs = tuple(d.hex() for d in self.digests())
+        if digs != self._last:
+            self._last = digs
+            self._seq += 1
+        _c_published.inc()
+        return {"kv_digests": list(digs), "kv_digest_seq": self._seq}
+
+
+class _Advert:
+    """One replica's last-known advertisement, however it arrived
+    (registry member payload or in-process snapshot)."""
+
+    __slots__ = ("digests", "geom", "seq")
+
+    def __init__(self, digests, geom=None, seq=0):
+        self.digests = frozenset(digests)
+        self.geom = geom
+        self.seq = seq
+
+
+class _RouteView:
+    """One submit sweep's digest work, computed once: the prompt, its
+    chunk-digest hexes, and every advertiser's predicted LEADING
+    coverage in blocks."""
+
+    __slots__ = ("ids", "hexes", "coverage", "block_size")
+
+    def __init__(self, ids, hexes, coverage, block_size):
+        self.ids = ids
+        self.hexes = hexes
+        self.coverage = coverage  # {replica_id: leading blocks}
+        self.block_size = block_size
+
+
+class _PullInfo:
+    """What one successful peer fill did (the billing/span record)."""
+
+    __slots__ = ("src", "us", "nbytes", "result")
+
+    def __init__(self, src, us, nbytes, result):
+        self.src = src
+        self.us = us
+        self.nbytes = nbytes
+        self.result = result
+
+
+class FleetCachePlane:
+    """The router-side half: advertisement intake, coverage scoring,
+    and the peer-fill ladder. Constructed by :class:`~.router.Router`
+    when ``FLAGS_fleet_cache`` is set at construction; a disarmed
+    router has NO plane and routes byte-for-byte health-rank.
+
+    Advertisements come from two places, registry payload winning:
+    a replica discovered via the fleet store carries ``kv_digests`` in
+    its member payload (heartbeat cadence); in-process engine-bound
+    replicas are snapshotted by :meth:`publish`, rate-limited to
+    ``FLAGS_fleet_cache_publish_s`` on the submit path — tests and
+    gates call ``publish(force=True)`` as their deterministic
+    heartbeat tick. Either way an advertisement is a point-in-time
+    claim that can go stale; the pull ladder treats staleness as an
+    ordinary fallback, never an error the caller sees."""
+
+    def __init__(self, router, publish_s=None):
+        self.router = router
+        self.weight = float(flags_mod.flag("FLAGS_fleet_cache_weight"))
+        self.publish_s = float(
+            flags_mod.flag("FLAGS_fleet_cache_publish_s")
+            if publish_s is None else publish_s)
+        self._ads = {}
+        self._last_publish = None
+        self._transport = None  # lazy disagg.RpcTransport (remote pulls)
+
+    # -- advertisement intake -------------------------------------------
+
+    def publish(self, force=False):
+        """Snapshot every engine-bound replica's advertisement (the
+        in-process heartbeat tick). Rate-limited unless ``force``; a
+        replica whose publisher fails keeps its previous advertisement
+        (heartbeat semantics: the old payload stands until
+        overwritten)."""
+        now = time.monotonic()
+        if not force and self._last_publish is not None \
+                and now - self._last_publish < self.publish_s:
+            return
+        self._last_publish = now
+        for rep in self._known():
+            pub = getattr(rep.engine, "_fleet_pub", None) \
+                if rep.engine is not None else None
+            if pub is None:
+                continue
+            try:
+                p = pub.payload()
+                self._ads[rep.replica_id] = _Advert(
+                    p["kv_digests"],
+                    geom=kv_transfer.geometry(rep.engine.scheduler.cache),
+                    seq=p["kv_digest_seq"])
+            except Exception as e:  # noqa: BLE001 — a failed snapshot
+                # must not stop routing; the stale ad stands (the pull
+                # ladder absorbs staleness)
+                resilience.degrade(
+                    "fleet_cache.publish",
+                    detail=f"replica={rep.replica_id}", exc=e)
+
+    def _known(self):
+        with self.router._lock:
+            return [self.router._replicas[rid]
+                    for rid in self.router._order]
+
+    def _ad_for(self, rep):
+        m = rep.member
+        if m is not None and m.get("kv_digests") is not None:
+            return _Advert(m["kv_digests"], geom=m.get("kv_geom"),
+                           seq=m.get("kv_digest_seq", 0))
+        return self._ads.get(rep.replica_id)
+
+    # -- coverage scoring -----------------------------------------------
+
+    def rank(self, cands, prompt_ids):
+        """Re-rank one sweep's candidates by coverage-scaled health;
+        returns ``(cands, view)`` where ``view`` carries the per-
+        advertiser coverage the peer-fill step reuses. Any failure
+        fails open to the incoming health order (``view=None``) — a
+        scoring bug must never cost a placement."""
+        try:
+            self.publish()
+            ids = np.ascontiguousarray(
+                np.asarray(prompt_ids).reshape(-1), dtype=np.int64)
+            bs = self._block_size()
+            if not bs or ids.size < bs:
+                return cands, None
+            hexes = [d.hex() for d in chunk_digests(ids, bs)]
+            if not hexes:
+                return cands, None
+            cov = {}
+            for rep in self._known():
+                ad = self._ad_for(rep)
+                if ad is None or not ad.digests:
+                    continue
+                if ad.geom is not None \
+                        and ad.geom.get("block_size") != bs:
+                    continue  # incomparable digests: different chunking
+                n = 0
+                for hx in hexes:
+                    if hx not in ad.digests:
+                        break
+                    n += 1
+                if n:
+                    cov[rep.replica_id] = n
+            view = _RouteView(ids, hexes, cov, bs)
+            if cov:
+                total = float(len(hexes))
+                w = self.weight
+                cands = sorted(
+                    cands,
+                    key=lambda r: -(
+                        (r.health() / (1.0 + r.inflight()))
+                        * (1.0 + w * cov.get(r.replica_id, 0) / total)))
+            return cands, view
+        except Exception as e:  # noqa: BLE001 — placement must survive
+            # any scoring failure; health rank is always a right answer
+            resilience.degrade("fleet_cache.score", exc=e)
+            return cands, None
+
+    def _block_size(self):
+        for rep in self._known():
+            if rep.engine is not None:
+                return rep.engine.scheduler.cache.block_size
+        return None
+
+    # -- peer fill ------------------------------------------------------
+
+    def peer_fill(self, rep, view):
+        """Pull the best advertising peer's covered prefix into
+        ``rep``'s pool before submit, when it beats what ``rep``
+        already holds. Returns a :class:`_PullInfo` on success, None
+        when no pull applies, and None — counted
+        ``serving.fleet_cache.pull_fallbacks``, degraded
+        ``fleet_cache.pull`` — on ANY failure: the request then
+        prefills locally, bit-identical (coverage only changes where
+        compute happens, never what it produces)."""
+        peers = [(n, rid) for rid, n in view.coverage.items()
+                 if rid != rep.replica_id]
+        if not peers:
+            return None
+        best_n, best_rid = max(peers)
+        try:
+            local = rep.engine.scheduler.cache.plan_prefix(view.ids)
+            if best_n <= local.matched_full:
+                return None  # resident already beats the best ad
+            _faults.site("fleet_cache.pull")
+            t0 = time.perf_counter_ns()
+            src = self.router._replicas.get(best_rid)
+            if src is None:
+                raise TransferError(
+                    f"fleet_cache: advertiser {best_rid!r} left the "
+                    f"fleet")
+            pull_ids = view.ids[:best_n * view.block_size]
+            frame = self._fetch(src, rep, pull_ids)
+            result = kv_transfer.import_prefix(
+                rep.engine.scheduler.cache, frame)
+            us = (time.perf_counter_ns() - t0) / 1000.0
+            _c_peer_pulls.inc()
+            _c_pull_bytes.inc(result.nbytes)
+            return _PullInfo(best_rid, us, result.nbytes, result)
+        except Exception as e:  # noqa: BLE001 — the whole ladder fails
+            # open: stale advertisement (export refuses non-resident),
+            # geometry refusal, dead peer, exhausted destination pool —
+            # all end in an ordinary local prefill
+            _c_pull_fallbacks.inc()
+            resilience.degrade(
+                "fleet_cache.pull",
+                detail=f"src={best_rid} dst={rep.replica_id} "
+                       f"blocks={best_n}", exc=e)
+            return None
+
+    def _fetch(self, src, dst, pull_ids):
+        """One peer's frame, geometry refused BEFORE it ships. In-
+        process peers export directly (readiness irrelevant — a
+        DRAINING peer's pool is still a fine read); engine-less
+        advertisers answer over the disagg rpc fabric
+        (:func:`_rpc_export`), retried once on a refused dial (nothing
+        was sent; a re-fetch is free and the import dedups)."""
+        local_geom = kv_transfer.geometry(dst.engine.scheduler.cache)
+        if src.engine is not None:
+            check_geometry(
+                local_geom,
+                kv_transfer.geometry(src.engine.scheduler.cache),
+                who=f"fleet_cache.pull.{src.replica_id}")
+            frame, _ = kv_transfer.export_prefix(
+                src.engine.scheduler.cache, pull_ids)
+            return frame
+        check_geometry(local_geom, (src.member or {}).get("kv_geom"),
+                       who=f"fleet_cache.pull.{src.replica_id}")
+        if self._transport is None:
+            from .disagg import RpcTransport
+            self._transport = RpcTransport()
+        return resilience.retry_call(
+            self._transport._call, src.replica_id, _rpc_export,
+            args=(src.replica_id, np.asarray(pull_ids).tolist()),
+            policy=resilience.policy(
+                "fleet_cache.pull", max_attempts=2,
+                retry_on=(ConnectionError, TimeoutError)))
+
+    # -- post-placement accounting --------------------------------------
+
+    def note_routed(self, rep, handle, view, pull):
+        """After a successful routed submit: count a coverage-informed
+        placement, bill a pull's time/bytes on the request (the
+        ``note_transfer`` discipline — informational, outside the
+        step-closure sum), and put the pull on the request's trace."""
+        try:
+            if pull is not None or view.coverage.get(rep.replica_id):
+                _c_coverage_hits.inc()
+            req = getattr(handle, "_req", None)
+            if pull is None or req is None:
+                return
+            rep.engine.scheduler.accounting.note_transfer(
+                req, pull.us, pull.nbytes)
+            _tracing.record_span(
+                "serving.fleet_pull", req.span, pull.us,
+                src=pull.src, dst=rep.replica_id, nbytes=pull.nbytes,
+                blocks=pull.result.blocks_imported,
+                deduped=pull.result.blocks_deduped)
+        except Exception as e:  # noqa: BLE001 — bookkeeping must never
+            # fail a request that already routed
+            resilience.degrade("fleet_cache.score", exc=e)
+
+
+def _rpc_export(name, token_ids):
+    """Remote half of a cross-process peer pull — runs on the
+    advertising host via ``distributed.rpc`` (the ``_rpc_import``
+    mirror): export the registered prefix covering ``token_ids`` from
+    the engine registered as ``name`` (``disagg.register_rpc_engine``
+    — the same table every rpc-visible engine already sits in). Loud
+    on an unregistered name or a non-resident prefix; the caller's
+    pull ladder fails open."""
+    from .disagg import _RPC_ENGINES
+    eng = _RPC_ENGINES.get(str(name))
+    if eng is None:
+        raise TransferError(
+            f"rpc export: no engine registered as {name!r} "
+            f"(call disagg.register_rpc_engine on the peer host)")
+    frame, _ = kv_transfer.export_prefix(eng.scheduler.cache,
+                                         token_ids)
+    return frame

@@ -49,6 +49,7 @@ import time
 
 import numpy as np
 
+from ..core import flags as flags_mod
 from ..core import resilience
 from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
@@ -233,6 +234,14 @@ class ServingEngine:
         self._error = None
         self._metrics_server = None
         self._registrar = None
+        # fleet cache digest publication (serving/fleet_cache.py;
+        # FLAGS_fleet_cache read here, the FLAGS_serving_prefix_cache
+        # convention): disarmed = no publisher object, registry
+        # payloads byte-for-byte pre-fleet-cache
+        self._fleet_pub = None
+        if bool(flags_mod.flag("FLAGS_fleet_cache")):
+            from . import fleet_cache as _fleet_cache
+            self._fleet_pub = _fleet_cache.DigestPublisher(self)
         # ready=False holds the engine in WARMING: submit() raises
         # NotReadyError until warmup() (or mark_ready()) flips READY;
         # routers see WARMING as not-routable on /readyz
@@ -690,6 +699,16 @@ class ServingEngine:
                 reg = _fleet.Registrar(
                     store, srv.url(""), replica_id=replica_id,
                     status_fn=lambda: self._state, role=self.role)
+                # pool geometry rides every payload UNCONDITIONALLY
+                # (serving/fleet_cache.geometry_payload): peers refuse
+                # a frame-exchange mismatch BEFORE anything ships
+                from . import fleet_cache as _fleet_cache
+                reg.add_extra(
+                    lambda: _fleet_cache.geometry_payload(self))
+                if self._fleet_pub is not None:
+                    # the digest advertisement (FLAGS_fleet_cache,
+                    # read at construction) joins the same beat
+                    reg.add_extra(self._fleet_pub.payload)
                 reg.start()
                 with self._lock:
                     if self._registrar is None:

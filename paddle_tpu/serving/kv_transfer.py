@@ -62,9 +62,10 @@ import numpy as np
 from ..inference.paged import _partial_key, chunk_digests
 
 __all__ = ["TransferError", "TransferTimeout", "RelayError",
-           "ExportedPrefix", "ImportResult", "export_prefix",
-           "import_prefix", "release_import", "pack_frame",
-           "unpack_frame", "MAGIC"]
+           "GeometryMismatch", "ExportedPrefix", "ImportResult",
+           "export_prefix", "import_prefix", "release_import",
+           "pack_frame", "unpack_frame", "geometry",
+           "check_geometry", "MAGIC"]
 
 MAGIC = b"PTPUKVT1"
 _HEADER = struct.Struct(">4sQ")  # crc32 (raw big-endian) + payload len
@@ -87,6 +88,42 @@ class TransferTimeout(TransferError):
     are idempotent — import dedups resident digests, admission dedups
     on (request_id, frame digest) — but it re-ships the frame, counted
     ``serving.disagg.dup_frames`` rather than silently merged."""
+
+
+class GeometryMismatch(TransferError):
+    """Two pools cannot exchange frames: block size, kv dtype, or head
+    layout differ. Structured — ``who`` names the refusing site (e.g.
+    ``disagg.decode.<rid>``, ``fleet_cache.pull.<rid>``, ``import``)
+    and ``mismatch`` maps each differing field to ``(theirs, ours)`` —
+    so the refusal is diagnosable from the exception alone. Raised
+    BEFORE a frame ships whenever the counterpart pre-registered its
+    geometry (``kv_geom`` in the fleet-registry payload —
+    serving/fleet_cache.geometry_payload); :func:`import_prefix`'s
+    frame-time validation raises it too, as the backstop for peers
+    that never advertised."""
+
+    def __init__(self, who, mismatch):
+        self.who = str(who)
+        self.mismatch = dict(mismatch)
+        super().__init__(
+            f"{self.who}: pool geometry mismatch — " + "; ".join(
+                f"{k}: theirs={t!r} ours={o!r}"
+                for k, (t, o) in sorted(self.mismatch.items())))
+
+
+def check_geometry(local_geom, advertised, who="kv"):
+    """Refuse a transfer BEFORE any frame ships: compare a
+    counterpart's ADVERTISED registry geometry against this pool's.
+    A missing/empty advertisement passes — a peer predating geometry
+    pre-registration still gets frame-time validation — but an
+    advertisement that disagrees on ANY field raises
+    :class:`GeometryMismatch` naming every differing field."""
+    if not advertised:
+        return
+    diff = {k: (advertised.get(k), local_geom[k]) for k in local_geom
+            if advertised.get(k) != local_geom[k]}
+    if diff:
+        raise GeometryMismatch(who, diff)
 
 
 class RelayError(RuntimeError):
@@ -164,7 +201,11 @@ def unpack_frame(frame):
     return payload
 
 
-def _geometry(cache):
+def geometry(cache):
+    """A pool's exchange-relevant shape: what frames embed, what
+    replicas pre-register in their fleet payload (``kv_geom``), and
+    what :func:`check_geometry` compares. Plain JSON-serializable
+    scalars — it rides heartbeat payloads verbatim."""
     return {"num_layers": cache.num_layers,
             "num_kv_heads": cache.num_kv_heads,
             "head_dim": cache.head_dim,
@@ -172,6 +213,9 @@ def _geometry(cache):
             "kv_dtype": cache.kv_dtype,
             "dtype": np.dtype(cache.dtype).name
             if not cache.quantized else "int8"}
+
+
+_geometry = geometry  # pre-PR-20 internal name
 
 
 # -- export ----------------------------------------------------------------
@@ -230,11 +274,11 @@ def _validate(obj, cache):
     if obj.get("version") != _VERSION:
         raise TransferError(
             f"import: unsupported frame version {obj.get('version')!r}")
-    want, got = _geometry(cache), obj.get("geom") or {}
+    want, got = geometry(cache), obj.get("geom") or {}
     if got != want:
         diff = {k: (got.get(k), want[k]) for k in want
                 if got.get(k) != want[k]}
-        raise TransferError(f"import: geometry mismatch {diff}")
+        raise GeometryMismatch("import", diff)
     ids = np.ascontiguousarray(np.asarray(obj["ids"]).reshape(-1),
                                dtype=np.int64)
     digests = chunk_digests(ids, cache.block_size)

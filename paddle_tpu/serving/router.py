@@ -319,6 +319,15 @@ class Router:
         self._breaker_armed = self._armed and bool(
             flags_mod.flag("FLAGS_router_breaker"))
         self._breakers = {}
+        # fleet cache plane (serving/fleet_cache.py; FLAGS_fleet_cache
+        # read here like FLAGS_serving_router itself): digest-aware
+        # candidate ranking + peer KV pulls. Disarmed = no plane object
+        # at all — placement stays byte-for-byte health-rank and
+        # serving.fleet_cache.* never moves
+        self.fleet_cache = None
+        if self._armed and bool(flags_mod.flag("FLAGS_fleet_cache")):
+            from . import fleet_cache as _fleet_cache
+            self.fleet_cache = _fleet_cache.FleetCachePlane(self)
         self._lock = threading.Lock()
         self._replicas = {}
         self._order = []  # insertion order: the disarmed primary
@@ -479,6 +488,12 @@ class Router:
         t0 = time.perf_counter_ns()
         reasons = {}
         cands = self._candidates(exclude, reasons)
+        view = None
+        if self.fleet_cache is not None and cands:
+            # digest-aware re-rank (fails open to the health order);
+            # the view carries the per-advertiser coverage the
+            # peer-fill step below reuses — digests computed ONCE
+            cands, view = self.fleet_cache.rank(cands, prompt)
         retry_after = None
         for i, rep in enumerate(cands):
             br = self._breaker(rep.replica_id) \
@@ -496,6 +511,14 @@ class Router:
                 if not allowed:
                     reasons[rep.replica_id] = "breaker-open"
                     continue
+            pull = None
+            if view is not None:
+                # peer fill BEFORE submit: a failed submit strands at
+                # worst a parked refcount-0 import in this replica's
+                # reclaimable LRU (evictable, admissible by anyone);
+                # submitting first would race the background driver's
+                # admission past the pull
+                pull = self.fleet_cache.peer_fill(rep, view)
             try:
                 _faults.site("router.submit")
                 _faults.site(f"router.submit.{rep.replica_id}")
@@ -548,6 +571,9 @@ class Router:
                     (time.perf_counter_ns() - t0) / 1000.0,
                     replica=rep.replica_id, attempt=i + 1,
                     candidates=len(cands))
+            if view is not None:
+                # coverage-hit counting + pull billing/span
+                self.fleet_cache.note_routed(rep, h, view, pull)
             return rep, h
         raise NoReplicaAvailable(
             f"router: no READY replica accepted the request "

@@ -1,0 +1,460 @@
+"""Fleet cache plane (ISSUE 20): digest publication, cache-aware
+routing, peer KV pulls (serving/fleet_cache.py) + the predictive
+autoscaler (serving/autoscaler.py).
+
+Acceptance pins: a replica's heartbeat payload advertises its hot
+registered chunk digests and pool geometry (Registrar contributors
+COMPOSE — disagg lease state no longer clobbers them); the router
+prefers an advertising replica and, when load spills a shared-prefix
+request onto an uncovered peer, that peer pulls the advertised blocks
+instead of re-prefilling; a STALE advertisement (the peer evicted
+between heartbeat and pull) and an injected ``fleet_cache.pull`` /
+``fleet_cache.publish`` fault all fail open to plain local prefill
+with bit-identical outputs; geometry mismatches are refused
+structurally BEFORE any frame ships (remote admission and pulls); the
+autoscaler's hysteresis edges fire exactly once per sustained
+excursion and scale-down retires a spawned replica through the
+zero-drop drain contract; ``FLAGS_fleet_cache=0`` /
+``FLAGS_fleet_autoscale=0`` revert byte-for-byte with
+``serving.fleet_cache.*`` / ``serving.autoscale.*`` counter silence.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import fleet as fleet_mod
+from paddle_tpu.profiler import metrics
+from paddle_tpu.serving import (FleetAutoscaler, GeometryMismatch,
+                                Lifecycle, Router, disagg,
+                                fleet_cache, kv_transfer)
+from paddle_tpu.testing import faults
+
+# tiny_llama fixture + the pinned engine config come from conftest.py
+from conftest import tiny_engine  # noqa: E402
+
+# 24 tokens = 3 full blocks at the pinned block_size=8: the shared
+# prefix every locality prompt leads with
+PREFIX = [int(x) for x in (np.arange(1, 25) % 50 + 1)]
+PROMPT = PREFIX + [7, 9]
+MAX_NEW = 4
+
+_FC = ("serving.fleet_cache.published",
+       "serving.fleet_cache.coverage_hits",
+       "serving.fleet_cache.peer_pulls",
+       "serving.fleet_cache.pull_bytes",
+       "serving.fleet_cache.pull_fallbacks")
+_AS = ("serving.autoscale.scale_ups", "serving.autoscale.scale_downs",
+       "serving.autoscale.holds")
+
+
+def _snap(names=_FC):
+    s = metrics.snapshot()
+    return {k: s.get(k, 0) for k in names}
+
+
+@pytest.fixture(autouse=True)
+def _no_trace_pollution():
+    saved = paddle.get_flags(["FLAGS_trace_enable"])
+    paddle.set_flags({"FLAGS_trace_enable": False})
+    yield
+    paddle.set_flags(saved)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def fc_flags():
+    saved = paddle.get_flags(["FLAGS_fleet_cache"])
+    paddle.set_flags({"FLAGS_fleet_cache": True})
+    yield
+    paddle.set_flags(saved)
+
+
+@pytest.fixture
+def as_flags():
+    saved = paddle.get_flags(["FLAGS_fleet_autoscale"])
+    paddle.set_flags({"FLAGS_fleet_autoscale": True})
+    yield
+    paddle.set_flags(saved)
+
+
+def _fleet(model, n, **kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_queue", 32)
+    engines = [tiny_engine(model, prefix_cache=True, **kw)
+               for _ in range(n)]
+    router = Router()
+    for i, eng in enumerate(engines):
+        router.add_replica(chr(ord("A") + i), engine=eng)
+    return router, engines
+
+
+def _settle(engines, handles, timeout=30):
+    for eng in engines:
+        eng.run_until_idle()
+    return [h.result(timeout=timeout) for h in handles]
+
+
+def _reference(model, prompt=PROMPT, max_new=MAX_NEW):
+    eng = tiny_engine(model, prefix_cache=True)
+    h = eng.submit(prompt, max_new_tokens=max_new)
+    eng.run_until_idle()
+    return h.result(timeout=30)
+
+
+# -- digest publication ----------------------------------------------------
+
+def test_publisher_advertises_hot_digests(tiny_llama, fc_flags):
+    router, (eng,) = _fleet(tiny_llama, 1)
+    assert eng._fleet_pub is not None
+    before = _snap()
+    h = router.submit(PROMPT, max_new_tokens=MAX_NEW)
+    _settle([eng], [h])
+    p = eng._fleet_pub.payload()
+    # three full chunks registered by commit_prefix -> three hex
+    # digests, matching what plan_prefix derives from the prompt
+    want = [d.hex() for d in fleet_cache.chunk_digests(
+        np.asarray(PROMPT, np.int64), 8)]
+    assert p["kv_digests"][:len(want)] == want \
+        or set(want) <= set(p["kv_digests"])
+    seq = p["kv_digest_seq"]
+    # unchanged pool -> unchanged seq (delta-friendly)
+    assert eng._fleet_pub.payload()["kv_digest_seq"] == seq
+    after = _snap()
+    assert after["serving.fleet_cache.published"] > \
+        before["serving.fleet_cache.published"]
+
+
+def test_publisher_cap_bounds_advertisement(tiny_llama, fc_flags):
+    router, (eng,) = _fleet(tiny_llama, 1)
+    h = router.submit(PROMPT, max_new_tokens=MAX_NEW)
+    _settle([eng], [h])
+    eng._fleet_pub.cap = 1
+    assert len(eng._fleet_pub.payload()["kv_digests"]) == 1
+
+
+def test_registrar_contributors_compose(tiny_llama, fc_flags):
+    """Geometry + digest advertisement + disagg lease state all ride
+    ONE registrar payload — register_rpc_engine composes via
+    add_extra instead of clobbering extra_fn (the PR 19 leftover)."""
+    eng = tiny_engine(tiny_llama, prefix_cache=True)
+    reg = fleet_mod.Registrar(store=None, url="http://x",
+                              replica_id="r0")
+    reg.add_extra(lambda: fleet_cache.geometry_payload(eng))
+    reg.add_extra(eng._fleet_pub.payload)
+    disagg.register_rpc_engine("r0", eng, registrar=reg)
+    try:
+        p = reg._payload()
+        assert p["kv_geom"] == kv_transfer.geometry(eng.scheduler.cache)
+        assert "kv_digests" in p and "kv_digest_seq" in p
+        assert p["leases"] == 0  # the disagg contributor still merged
+        assert reg.extra_fn is None  # composed, not clobbered
+    finally:
+        disagg._RPC_ENGINES.clear()
+
+
+# -- geometry refusal (satellite: pre-registered pool geometry) ------------
+
+def test_check_geometry_structured():
+    local = {"num_layers": 2, "num_kv_heads": 2, "head_dim": 8,
+             "block_size": 8, "kv_dtype": "auto", "dtype": "float32"}
+    kv_transfer.check_geometry(local, None)          # no advertisement
+    kv_transfer.check_geometry(local, dict(local))   # exact match
+    theirs = dict(local, block_size=16, dtype="int8")
+    with pytest.raises(GeometryMismatch) as ei:
+        kv_transfer.check_geometry(local, theirs, who="disagg.decode.d0")
+    e = ei.value
+    assert isinstance(e, kv_transfer.TransferError)
+    assert e.who == "disagg.decode.d0"
+    assert e.mismatch == {"block_size": (16, 8),
+                          "dtype": ("int8", "float32")}
+    assert "geometry mismatch" in str(e)
+
+
+def test_remote_admission_refuses_geometry_before_ship(tiny_llama):
+    """A decode host advertising a mismatched pool geometry is refused
+    BEFORE any frame ships: the transport is never touched and the
+    pipeline fails open to co-located serving, bit-identical."""
+    saved = paddle.get_flags(["FLAGS_serving_router",
+                              "FLAGS_serving_disagg"])
+    paddle.set_flags({"FLAGS_serving_router": True,
+                      "FLAGS_serving_disagg": True})
+    calls = []
+
+    class _NeverTransport:
+        def send(self, replica, frame):
+            calls.append(("send", replica.replica_id))
+            raise AssertionError("frame shipped past geometry refusal")
+
+        def admit(self, replica, request):
+            calls.append(("admit", replica.replica_id))
+            raise AssertionError("admission shipped past refusal")
+
+        def pull(self, replica, request_id, cursor, timeout=None):
+            raise AssertionError("relay reached")
+
+        def cancel(self, replica, request_id):
+            return True
+
+    try:
+        pre = tiny_engine(tiny_llama, prefix_cache=True, role="prefill")
+        router = Router()
+        router.add_replica("pre", engine=pre)
+        rep = router.add_replica("rdec", role="decode")
+        wrong = kv_transfer.geometry(pre.scheduler.cache)
+        wrong = dict(wrong, block_size=wrong["block_size"] * 2)
+        rep.member = {"state": Lifecycle.READY, "kv_geom": wrong}
+        pipe = disagg.DisaggPipeline(router,
+                                     transport=_NeverTransport())
+        before = metrics.snapshot().get("serving.disagg.fallbacks", 0)
+        h = pipe.submit(PROMPT, max_new_tokens=MAX_NEW)
+        pre.run_until_idle()
+        assert h.result(timeout=30) == _reference(tiny_llama)
+        assert calls == []  # nothing shipped
+        assert metrics.snapshot()["serving.disagg.fallbacks"] == \
+            before + 1
+    finally:
+        paddle.set_flags(saved)
+
+
+# -- cache-aware routing + peer fill ---------------------------------------
+
+def test_routing_prefers_advertiser(tiny_llama, fc_flags):
+    router, engines = _fleet(tiny_llama, 2)
+    h1 = router.submit(PROMPT, max_new_tokens=MAX_NEW)
+    ref = _settle(engines, [h1])[0]
+    first = h1.replica_id
+    router.fleet_cache.publish(force=True)
+    before = _snap()
+    h2 = router.submit(PROMPT, max_new_tokens=MAX_NEW)
+    # both replicas idle: coverage breaks the health tie toward the
+    # replica that computed the prefix — no pull needed
+    assert h2.replica_id == first
+    assert _settle(engines, [h2])[0] == ref
+    after = _snap()
+    assert after["serving.fleet_cache.coverage_hits"] == \
+        before["serving.fleet_cache.coverage_hits"] + 1
+    assert after["serving.fleet_cache.peer_pulls"] == \
+        before["serving.fleet_cache.peer_pulls"]
+
+
+def test_spill_pulls_from_peer(tiny_llama, fc_flags):
+    """Load past the coverage boost spills onto an uncovered replica,
+    which pulls the advertised blocks instead of re-prefilling — and
+    bills the pull like a disagg transfer."""
+    router, engines = _fleet(tiny_llama, 3)
+    h1 = router.submit(PROMPT, max_new_tokens=MAX_NEW)
+    ref = _settle(engines, [h1])[0]
+    first = h1.replica_id
+    router.fleet_cache.publish(force=True)
+    before = _snap()
+    burst = [router.submit(PROMPT, max_new_tokens=MAX_NEW)
+             for _ in range(6)]
+    outs = _settle(engines, burst)
+    assert all(o == ref for o in outs)
+    spilled = [h for h in burst if h.replica_id != first]
+    assert spilled, "burst never spilled past the coverage boost"
+    after = _snap()
+    pulls = after["serving.fleet_cache.peer_pulls"] - \
+        before["serving.fleet_cache.peer_pulls"]
+    assert pulls >= 1
+    assert after["serving.fleet_cache.pull_bytes"] > \
+        before["serving.fleet_cache.pull_bytes"]
+    assert after["serving.fleet_cache.pull_fallbacks"] == \
+        before["serving.fleet_cache.pull_fallbacks"]
+    # the pulled admission billed the fabric axes, not re-prefill
+    c = spilled[0].cost()
+    assert c is not None and c.transfer_bytes > 0
+
+
+def test_stale_advertisement_falls_back_bit_identical(tiny_llama,
+                                                      fc_flags):
+    """The peer evicted the advertised blocks between heartbeat and
+    pull: the pull fails on the export side (non-resident), counted
+    ``pull_fallbacks``, and the request prefills locally with
+    bit-identical output."""
+    router, engines = _fleet(tiny_llama, 2)
+    h1 = router.submit(PROMPT, max_new_tokens=MAX_NEW)
+    ref = _settle(engines, [h1])[0]
+    donor = router._replicas[h1.replica_id].engine
+    router.fleet_cache.publish(force=True)  # advertise, THEN evict
+    cache = donor.scheduler.cache
+    for b in list(cache._cached_free):
+        cache._drop_cached(b)
+        cache._free.append(b)
+    before = _snap()
+    burst = [router.submit(PROMPT, max_new_tokens=MAX_NEW)
+             for _ in range(4)]
+    outs = _settle(engines, burst)
+    assert all(o == ref for o in outs)
+    assert {h.replica_id for h in burst} - {h1.replica_id}, \
+        "burst never spilled"
+    after = _snap()
+    assert after["serving.fleet_cache.pull_fallbacks"] > \
+        before["serving.fleet_cache.pull_fallbacks"]
+    assert after["serving.fleet_cache.peer_pulls"] == \
+        before["serving.fleet_cache.peer_pulls"]
+
+
+def test_pull_fault_site_fails_open(tiny_llama, fc_flags):
+    router, engines = _fleet(tiny_llama, 2)
+    h1 = router.submit(PROMPT, max_new_tokens=MAX_NEW)
+    ref = _settle(engines, [h1])[0]
+    router.fleet_cache.publish(force=True)
+    before = _snap()
+    with faults.inject("fleet_cache.pull", nth=1, count=100):
+        burst = [router.submit(PROMPT, max_new_tokens=MAX_NEW)
+                 for _ in range(4)]
+        outs = _settle(engines, burst)
+    assert all(o == ref for o in outs)
+    after = _snap()
+    assert after["serving.fleet_cache.pull_fallbacks"] > \
+        before["serving.fleet_cache.pull_fallbacks"]
+    assert after["serving.fleet_cache.peer_pulls"] == \
+        before["serving.fleet_cache.peer_pulls"]
+
+
+def test_publish_fault_site_keeps_routing(tiny_llama, fc_flags):
+    router, engines = _fleet(tiny_llama, 2)
+    with faults.inject("fleet_cache.publish", nth=1, count=100):
+        router.fleet_cache.publish(force=True)
+        h = router.submit(PROMPT, max_new_tokens=MAX_NEW)
+        out = _settle(engines, [h])[0]
+    assert out == _reference(tiny_llama)
+    assert router.fleet_cache._ads == {}  # nothing advertised
+
+
+def test_pull_geometry_refused_before_frame_ships(tiny_llama,
+                                                  fc_flags):
+    """A peer advertising a mismatched pool geometry is refused
+    structurally (GeometryMismatch) BEFORE any transport dial — and
+    the routing-layer ladder absorbs it as an ordinary fallback."""
+    router, (eng,) = _fleet(tiny_llama, 1)
+    dst = router._replicas["A"]
+    src = router.add_replica("remote-peer")  # engine-less advertiser
+    good = kv_transfer.geometry(eng.scheduler.cache)
+    src.member = {"state": Lifecycle.READY,
+                  "kv_geom": dict(good, kv_dtype="int8")}
+    plane = router.fleet_cache
+    with pytest.raises(GeometryMismatch) as ei:
+        plane._fetch(src, dst, np.asarray(PREFIX, np.int64))
+    assert ei.value.who == "fleet_cache.pull.remote-peer"
+    assert ei.value.mismatch == {"kv_dtype": ("int8",
+                                              good["kv_dtype"])}
+    assert plane._transport is None  # refused before any dial
+
+    # same mismatch through the full ladder: counted fallback, local
+    # prefill, bit-identical
+    src.member["kv_digests"] = [
+        d.hex() for d in fleet_cache.chunk_digests(
+            np.asarray(PROMPT, np.int64), 8)]
+    before = _snap()
+    h = router.submit(PROMPT, max_new_tokens=MAX_NEW)
+    assert _settle([eng], [h])[0] == _reference(tiny_llama)
+    after = _snap()
+    assert after["serving.fleet_cache.pull_fallbacks"] == \
+        before["serving.fleet_cache.pull_fallbacks"] + 1
+    assert after["serving.fleet_cache.peer_pulls"] == \
+        before["serving.fleet_cache.peer_pulls"]
+    assert plane._transport is None
+
+
+# -- flag-off silence ------------------------------------------------------
+
+def test_flags_off_byte_for_byte_silence(tiny_llama):
+    router, engines = _fleet(tiny_llama, 2)
+    assert router.fleet_cache is None
+    assert engines[0]._fleet_pub is None
+    before = _snap(_FC + _AS)
+    h = router.submit(PROMPT, max_new_tokens=MAX_NEW)
+    out = _settle(engines, [h])[0]
+    assert out == _reference(tiny_llama)
+    assert _snap(_FC + _AS) == before
+
+
+# -- autoscaler ------------------------------------------------------------
+
+def test_autoscaler_edges_and_zero_drop(tiny_llama, as_flags):
+    router, engines = _fleet(tiny_llama, 1)
+    pressure = {"v": 2.0}
+    spawned = []
+
+    def _spawn():
+        eng = tiny_engine(tiny_llama, prefix_cache=True, max_batch=2,
+                          max_queue=32)
+        spawned.append(eng)
+        return eng
+
+    auto = FleetAutoscaler(router, _spawn, min_replicas=1,
+                           enter_steps=2, exit_steps=3,
+                           pressure_fn=lambda: pressure["v"])
+    before = _snap(_AS)
+    assert auto.update() is None          # 1st over-pressure tick
+    assert auto.update() == "up"          # edge at enter_steps
+    assert auto.size() == 2
+    # sustained pressure re-accumulates from zero: no immediate re-spawn
+    assert auto.update() is None
+    # traffic lands on the spawned replica too, then drains zero-drop
+    rid = next(r for r in router._order if r.startswith("auto"))
+    burst = [router.submit(PROMPT, max_new_tokens=MAX_NEW)
+             for _ in range(4)]
+    placed = {h.replica_id for h in burst}
+    assert rid in placed  # the spawned replica really took traffic
+    pressure["v"] = 0.1
+    acts = [auto.update() for _ in range(3)]
+    assert acts == [None, None, "down"]   # edge at exit_steps
+    assert auto.size() == 1
+    assert spawned[0].lifecycle == Lifecycle.CLOSED
+    engines[0].run_until_idle()
+    outs = [h.result(timeout=30) for h in burst]
+    assert len({tuple(o) for o in outs}) == 1  # zero dropped, identical
+    assert all(h.status == "DONE" for h in burst)
+    after = _snap(_AS)
+    assert after["serving.autoscale.scale_ups"] == \
+        before["serving.autoscale.scale_ups"] + 1
+    assert after["serving.autoscale.scale_downs"] == \
+        before["serving.autoscale.scale_downs"] + 1
+    assert after["serving.autoscale.holds"] > \
+        before["serving.autoscale.holds"]
+
+
+def test_autoscaler_hold_band_resets_accumulators(tiny_llama, as_flags):
+    router, _ = _fleet(tiny_llama, 1)
+    seq = iter([2.0, 0.6, 2.0, 2.0])  # dip through the band resets
+    auto = FleetAutoscaler(router, lambda: None, enter_steps=2,
+                           exit_steps=2, pressure_fn=lambda: next(seq))
+    assert auto.update() is None
+    assert auto.update() is None   # in-band: accumulators reset
+    assert auto.update() is None   # over again: count restarts at 1
+    assert auto.update() == "up" or auto.size() == 1
+    # (spawn returns None -> scale_up degrades and holds; either way
+    # the edge logic demanded TWO consecutive over-pressure ticks)
+
+
+def test_autoscaler_ceiling_holds(tiny_llama, as_flags):
+    router, _ = _fleet(tiny_llama, 1)
+    auto = FleetAutoscaler(router, lambda: None, max_replicas=1,
+                           enter_steps=1, pressure_fn=lambda: 5.0)
+    before = _snap(_AS)
+    assert auto.update() is None  # at ceiling: held, never spawned
+    after = _snap(_AS)
+    assert after["serving.autoscale.scale_ups"] == \
+        before["serving.autoscale.scale_ups"]
+    assert after["serving.autoscale.holds"] == \
+        before["serving.autoscale.holds"] + 1
+
+
+def test_autoscaler_disarmed_silence(tiny_llama):
+    router, _ = _fleet(tiny_llama, 1)
+    before = _snap(_AS)
+    auto = FleetAutoscaler(router, lambda: None,
+                           pressure_fn=lambda: 5.0)
+    assert all(auto.update() is None for _ in range(5))
+    assert auto.size() == 1
+    assert _snap(_AS) == before

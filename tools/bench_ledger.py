@@ -40,7 +40,11 @@ fallback counts, transfer bytes/us, bit-equivalence / zero-reprefill
 counter-routing / warmup-zero-recompile / forced-off check bits),
 ``quant_kernels`` (bench.py quantized-kernel rung: dense vs Pallas
 int8 decode attention and XLA vs Pallas int8 matmul step times plus
-their ratios — CPU interpret-mode proxies, see the rung's note).
+their ratios — CPU interpret-mode proxies, see the rung's note),
+``fleet_cache`` (tools/fleet_cache_gate.py fleet cache plane:
+blind-vs-aware full-prefill token A/B and its ~1/N ratio, peer-pull
+and fallback counts, autoscale edge counts, zero-reprefill /
+fail-open / flags-off check bits).
 The ledger itself is schema-free — any kind/metrics pair appends.
 
 CLI::

@@ -1,0 +1,451 @@
+"""Fleet-cache gate: the fleet cache plane (ISSUE 20) A/B'd end to
+end — digest-aware routing + cross-replica KV pulls
+(paddle_tpu/serving/fleet_cache.py) and the predictive autoscaler
+(paddle_tpu/serving/autoscaler.py) against the cache-blind baseline.
+Five pass/fail checks:
+
+  1. ab-prefill   — the headline A/B: the SAME shared-prefix storm on
+                    a 3-replica fleet, cache-blind vs cache-aware.
+                    Blind, every replica the storm touches runs one
+                    FULL prefill of the shared prefix (N per fleet);
+                    aware, the prefix is computed ONCE fleet-wide and
+                    every other replica pulls it (counting-model
+                    wrapper on ``Llama.paged_prefill`` — the
+                    coverage-0 dispatch — plus >= 1
+                    ``serving.fleet_cache.peer_pulls``). Wants
+                    aware full-prefill tokens <= ~1/N of blind, and
+                    bit-identical outputs both ways;
+  2. zero-reprefill — a peer-filled admission bills like a handoff,
+                    not a prefill: the pulling replica runs ZERO full
+                    ``paged_prefill`` dispatches for the pulled
+                    prompt, its CostReport covers the whole prefix
+                    (``covered_tokens``) and computes at most the
+                    bucketed tail, and the pull's fabric time/bytes
+                    ride ``transfer_us``/``transfer_bytes``;
+  3. fail-open    — an injected ``fleet_cache.pull`` fault AND a
+                    stale advertisement (the peer evicted between
+                    heartbeat and pull) both degrade to plain local
+                    prefill: counted ``pull_fallbacks``, zero
+                    ``peer_pulls``, outputs bit-identical to the
+                    reference either way;
+  4. autoscale    — the hysteresis controller under injected
+                    pressure: sustained over-pressure spawns exactly
+                    one replica at the enter edge (edge-triggered: the
+                    next tick holds), the spawned replica takes
+                    traffic, and sustained low pressure retires it
+                    through the zero-drop drain contract — every
+                    in-flight request reaches DONE, outputs identical;
+  5. flags-off    — ``FLAGS_fleet_cache=0`` + ``FLAGS_fleet_autoscale
+                    =0`` (the defaults): no plane on the router, no
+                    publisher on the engine, routed outputs
+                    byte-for-byte the armed run's, and the
+                    ``serving.fleet_cache.*`` / ``serving.autoscale.*``
+                    counter families bit-silent through a scoped
+                    ``metrics.Window``.
+
+Every number is read through ``metrics.Window`` — the global registry
+is never reset. Appends a ``fleet_cache`` entry (full-prefill token
+A/B, pull/fallback counts, scale event counts, check bits) to the
+continuous-bench ledger (tools/bench_ledger.py). Exit 0 on pass, 1 on
+fail; runs under JAX_PLATFORMS=cpu (tier-1); wired into
+tools/suite_gate.py beside the fleet-load gate.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_REPLICAS = int(os.environ.get("FLEET_CACHE_REPLICAS", "3"))
+STORM = int(os.environ.get("FLEET_CACHE_STORM", "6"))
+PREFIX_LEN = 24   # 3 full KV blocks at the pinned block_size=8
+MAX_NEW = 4
+
+
+def _model():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Llama, LlamaConfig
+
+    paddle.seed(0)
+    m = Llama(LlamaConfig.tiny())
+    m.eval()
+    return m
+
+
+def _prompt():
+    import numpy as np
+
+    prefix = [int(x) for x in (np.arange(1, PREFIX_LEN + 1) % 50 + 1)]
+    return prefix + [7, 9]
+
+
+class PrefillCounter:
+    """Counting-model discipline (tools/disagg_gate.py school): wrap
+    ``Llama.paged_prefill`` — the coverage-0 FULL-prefill dispatch;
+    covered admissions go through ``paged_prefill_extend`` instead —
+    and tally dispatches + unpadded prompt tokens, per KV pool."""
+
+    def __init__(self, model):
+        self.model = model
+        self.calls = []  # (cache id, token count)
+        self._orig = model.paged_prefill
+
+    def __enter__(self):
+        counter = self
+
+        def counted(cache, slot, prompt_ids, **kw):
+            counter.calls.append((id(cache), len(prompt_ids)))
+            return counter._orig(cache, slot, prompt_ids, **kw)
+
+        self.model.paged_prefill = counted
+        return self
+
+    def __exit__(self, *exc):
+        self.model.paged_prefill = self._orig
+        return False
+
+    def dispatches(self, cache=None):
+        return sum(1 for c, _ in self.calls
+                   if cache is None or c == id(cache))
+
+    def tokens(self):
+        return sum(n for _, n in self.calls)
+
+
+def _fleet(model, n=N_REPLICAS):
+    import jax.numpy as jnp
+
+    from paddle_tpu.serving import Router, ServingEngine
+
+    engines = [ServingEngine(model, temperature=0.0, background=False,
+                             dtype=jnp.float32, max_batch=2,
+                             block_size=8, max_seq_len=64,
+                             bucket_cap=32, max_queue=32,
+                             prefix_cache=True) for _ in range(n)]
+    router = Router()
+    for i, eng in enumerate(engines):
+        router.add_replica(f"fc{i}", engine=eng)
+    return router, engines
+
+
+def _storm(router, engines, prompt, n=STORM, prime=True):
+    """One shared-prefix storm: prime one replica, advertise, then
+    burst without stepping so load spills past the coverage boost."""
+    handles = []
+    if prime:
+        h = router.submit(prompt, max_new_tokens=MAX_NEW)
+        for eng in engines:
+            eng.run_until_idle()
+        h.result(timeout=60)
+        handles.append(h)
+        if router.fleet_cache is not None:
+            router.fleet_cache.publish(force=True)
+    handles += [router.submit(prompt, max_new_tokens=MAX_NEW)
+                for _ in range(n)]
+    for eng in engines:
+        eng.run_until_idle()
+    return handles, [h.result(timeout=60) for h in handles]
+
+
+def check_ab_prefill():
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+
+    prompt = _prompt()
+    saved = paddle.get_flags(["FLAGS_fleet_cache"])
+    results = {}
+    try:
+        for mode, armed in (("blind", False), ("aware", True)):
+            paddle.set_flags({"FLAGS_fleet_cache": armed})
+            model = _model()
+            router, engines = _fleet(model)
+            win = metrics.Window("serving.fleet_cache.")
+            with PrefillCounter(model) as pc:
+                _, outs = _storm(router, engines, prompt)
+            win.freeze()
+            results[mode] = {
+                "full_dispatches": pc.dispatches(),
+                "full_tokens": pc.tokens(),
+                "pulls": win.value("serving.fleet_cache.peer_pulls"),
+                "fallbacks": win.value(
+                    "serving.fleet_cache.pull_fallbacks"),
+                "outs": outs,
+            }
+            for eng in engines:
+                eng.close()
+    finally:
+        paddle.set_flags(saved)
+    blind, aware = results["blind"], results["aware"]
+    identical = (len({tuple(o) for o in blind["outs"]}) == 1
+                 and blind["outs"][0] == aware["outs"][0]
+                 and len({tuple(o) for o in aware["outs"]}) == 1)
+    # the headline: blind computes the prefix once PER REPLICA the
+    # storm touches; aware computes it once PER FLEET
+    ratio = (aware["full_tokens"] / blind["full_tokens"]
+             if blind["full_tokens"] else 1.0)
+    ok = (blind["full_dispatches"] >= N_REPLICAS
+          and aware["full_dispatches"] == 1
+          and ratio <= 1.0 / N_REPLICAS + 0.05
+          and aware["pulls"] >= 1 and aware["fallbacks"] == 0
+          and blind["pulls"] == 0 and identical)
+    print(f"[fleet-cache-gate] ab-prefill: full-prefills "
+          f"blind={blind['full_dispatches']} "
+          f"aware={aware['full_dispatches']} tokens "
+          f"{blind['full_tokens']}->{aware['full_tokens']} "
+          f"(ratio {ratio:.3f}, want <= ~1/{N_REPLICAS}) "
+          f"pulls={aware['pulls']} bit-identical={identical} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok, {"blind_full_prefill_tokens": float(blind["full_tokens"]),
+                "aware_full_prefill_tokens": float(aware["full_tokens"]),
+                "full_prefill_ratio": float(ratio),
+                "peer_pulls": float(aware["pulls"]),
+                "ab_ok": 1.0 if ok else 0.0}
+
+
+def check_zero_reprefill():
+    import paddle_tpu as paddle
+    from paddle_tpu.serving.bucketing import bucket_length
+
+    prompt = _prompt()
+    saved = paddle.get_flags(["FLAGS_fleet_cache"])
+    try:
+        paddle.set_flags({"FLAGS_fleet_cache": True})
+        model = _model()
+        router, engines = _fleet(model)
+        with PrefillCounter(model) as pc:
+            handles, _ = _storm(router, engines, prompt)
+        donor = router._replicas[handles[0].replica_id].engine
+        pulled = [h for h in handles[1:]
+                  if h.replica_id != handles[0].replica_id]
+        tail_cap = bucket_length(len(prompt) - PREFIX_LEN, 8, 32,
+                                 max_len=64)
+        puller_dispatches = sum(
+            pc.dispatches(eng.scheduler.cache) for eng in engines
+            if eng is not donor)
+        # every spilled admission rides the covered-extend path; the
+        # FIRST one per spilled replica additionally bills the pull's
+        # fabric bytes (later ones hit the now-resident prefix free)
+        costs = [h.cost() for h in pulled]
+        covered_ok = all(
+            c is not None and c.covered_tokens >= PREFIX_LEN
+            and c.tokens_prefilled <= tail_cap for c in costs)
+        seen, firsts = set(), []
+        for h in pulled:
+            if h.replica_id not in seen:
+                seen.add(h.replica_id)
+                firsts.append(h)
+        billed_ok = covered_ok and all(
+            h.cost().transfer_bytes > 0 for h in firsts)
+        ok = bool(pulled) and puller_dispatches == 0 and billed_ok
+        print(f"[fleet-cache-gate] zero-reprefill: pulled-admissions="
+              f"{len(pulled)} puller-full-prefills={puller_dispatches} "
+              f"(want 0) billed-covered>= {PREFIX_LEN} "
+              f"computed<=tail({tail_cap}) transfer-billed={billed_ok} "
+              f"{'PASS' if ok else 'FAIL'}")
+        for eng in engines:
+            eng.close()
+    finally:
+        paddle.set_flags(saved)
+    return ok, {"zero_reprefill_ok": 1.0 if ok else 0.0}
+
+
+def check_fail_open():
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.testing import faults
+
+    prompt = _prompt()
+    saved = paddle.get_flags(["FLAGS_fleet_cache"])
+    try:
+        paddle.set_flags({"FLAGS_fleet_cache": True})
+        model = _model()
+        ref_router, ref_engines = _fleet(model, n=1)
+        _, ref_outs = _storm(ref_router, ref_engines, prompt, n=1)
+        ref = ref_outs[0]
+        for eng in ref_engines:
+            eng.close()
+
+        # (a) injected pull fault
+        router, engines = _fleet(model)
+        win = metrics.Window("serving.fleet_cache.")
+        with faults.inject("fleet_cache.pull", nth=1, count=100):
+            _, outs_fault = _storm(router, engines, prompt)
+        win.freeze()
+        fault_fb = win.value("serving.fleet_cache.pull_fallbacks")
+        fault_pulls = win.value("serving.fleet_cache.peer_pulls")
+        for eng in engines:
+            eng.close()
+
+        # (b) stale advertisement: evict after the heartbeat
+        router, engines = _fleet(model)
+        h = router.submit(prompt, max_new_tokens=MAX_NEW)
+        for eng in engines:
+            eng.run_until_idle()
+        h.result(timeout=60)
+        donor = router._replicas[h.replica_id].engine
+        router.fleet_cache.publish(force=True)
+        cache = donor.scheduler.cache
+        for b in list(cache._cached_free):
+            cache._drop_cached(b)
+            cache._free.append(b)
+        win = metrics.Window("serving.fleet_cache.")
+        _, outs_stale = _storm(router, engines, prompt, prime=False)
+        win.freeze()
+        stale_fb = win.value("serving.fleet_cache.pull_fallbacks")
+        stale_pulls = win.value("serving.fleet_cache.peer_pulls")
+        for eng in engines:
+            eng.close()
+    finally:
+        paddle.set_flags(saved)
+    identical = all(o == ref for o in outs_fault) \
+        and all(o == ref for o in outs_stale)
+    ok = (fault_fb >= 1 and fault_pulls == 0
+          and stale_fb >= 1 and stale_pulls == 0 and identical)
+    print(f"[fleet-cache-gate] fail-open: injected-fault fallbacks="
+          f"{fault_fb} pulls={fault_pulls} | stale-ad fallbacks="
+          f"{stale_fb} pulls={stale_pulls} (want fallbacks >= 1, "
+          f"pulls == 0) bit-identical={identical} "
+          f"{'PASS' if ok else 'FAIL'}")
+    return ok, {"fault_fallbacks": float(fault_fb),
+                "stale_fallbacks": float(stale_fb),
+                "fail_open_ok": 1.0 if ok else 0.0}
+
+
+def check_autoscale():
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import FleetAutoscaler, Lifecycle
+
+    prompt = _prompt()
+    saved = paddle.get_flags(["FLAGS_fleet_autoscale"])
+    try:
+        paddle.set_flags({"FLAGS_fleet_autoscale": True})
+        import jax.numpy as jnp
+
+        from paddle_tpu.serving import ServingEngine
+
+        model = _model()
+        router, engines = _fleet(model, n=1)
+        pressure = {"v": 2.0}
+        spawned = []
+
+        def _spawn():
+            eng = ServingEngine(model, temperature=0.0,
+                                background=False, dtype=jnp.float32,
+                                max_batch=2, block_size=8,
+                                max_seq_len=64, bucket_cap=32,
+                                max_queue=32, prefix_cache=True)
+            spawned.append(eng)
+            return eng
+
+        auto = FleetAutoscaler(router, _spawn, min_replicas=1,
+                               enter_steps=2, exit_steps=3,
+                               pressure_fn=lambda: pressure["v"])
+        win = metrics.Window("serving.autoscale.")
+        acts_up = [auto.update(), auto.update(), auto.update()]
+        sized_up = auto.size() == 2
+        burst = [router.submit(prompt, max_new_tokens=MAX_NEW)
+                 for _ in range(4)]
+        spawned_took = any(h.replica_id.startswith("auto")
+                           for h in burst)
+        pressure["v"] = 0.1
+        acts_down = [auto.update() for _ in range(3)]
+        engines[0].run_until_idle()
+        outs = [h.result(timeout=60) for h in burst]
+        statuses = [h.status for h in burst]
+        win.freeze()
+        final_size = auto.size()
+        closed = spawned and spawned[0].lifecycle == Lifecycle.CLOSED
+        for eng in engines:
+            eng.close()
+    finally:
+        paddle.set_flags(saved)
+    ups = win.value("serving.autoscale.scale_ups")
+    downs = win.value("serving.autoscale.scale_downs")
+    zero_drop = (all(s == "DONE" for s in statuses)
+                 and len({tuple(o) for o in outs}) == 1)
+    ok = (acts_up == [None, "up", None] and sized_up and spawned_took
+          and acts_down == [None, None, "down"] and final_size == 1
+          and bool(closed) and zero_drop and ups == 1 and downs == 1)
+    print(f"[fleet-cache-gate] autoscale: up-edge={acts_up} "
+          f"down-edge={acts_down} spawned-took-traffic={spawned_took} "
+          f"scale_ups={ups} scale_downs={downs} zero-drop={zero_drop} "
+          f"retired-closed={bool(closed)} {'PASS' if ok else 'FAIL'}")
+    return ok, {"scale_ups": float(ups), "scale_downs": float(downs),
+                "autoscale_ok": 1.0 if ok else 0.0}
+
+
+def check_flags_off():
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+
+    prompt = _prompt()
+    # the defaults ARE off — assert, don't set (a drifted default is
+    # exactly what this check exists to catch)
+    flags = paddle.get_flags(["FLAGS_fleet_cache",
+                              "FLAGS_fleet_autoscale"])
+    defaults_off = not flags["FLAGS_fleet_cache"] \
+        and not flags["FLAGS_fleet_autoscale"]
+    model = _model()
+    saved = paddle.get_flags(["FLAGS_fleet_cache"])
+    try:
+        paddle.set_flags({"FLAGS_fleet_cache": True})
+        router, engines = _fleet(model)
+        _, armed_outs = _storm(router, engines, prompt)
+        for eng in engines:
+            eng.close()
+    finally:
+        paddle.set_flags(saved)
+    router, engines = _fleet(model)
+    disarmed = router.fleet_cache is None \
+        and all(eng._fleet_pub is None for eng in engines)
+    before = dict(metrics.snapshot("serving.fleet_cache."))
+    before.update(metrics.snapshot("serving.autoscale."))
+    _, off_outs = _storm(router, engines, prompt)
+    after = dict(metrics.snapshot("serving.fleet_cache."))
+    after.update(metrics.snapshot("serving.autoscale."))
+    silent = before == after
+    for eng in engines:
+        eng.close()
+    identical = off_outs[0] == armed_outs[0] \
+        and len({tuple(o) for o in off_outs}) == 1
+    ok = defaults_off and disarmed and silent and identical
+    print(f"[fleet-cache-gate] flags-off: defaults-off={defaults_off} "
+          f"plane/publisher-absent={disarmed} counter-silent={silent} "
+          f"byte-for-byte={identical} {'PASS' if ok else 'FAIL'}")
+    return ok, {"flags_off_ok": 1.0 if ok else 0.0}
+
+
+def main():
+    ok1, m1 = check_ab_prefill()
+    ok2, m2 = check_zero_reprefill()
+    ok3, m3 = check_fail_open()
+    ok4, m4 = check_autoscale()
+    ok5, m5 = check_flags_off()
+    ok = ok1 and ok2 and ok3 and ok4 and ok5
+
+    try:
+        import bench_ledger
+        m = {}
+        for d in (m1, m2, m3, m4, m5):
+            m.update(d)
+        m["gate_ok"] = 1.0 if ok else 0.0
+        bench_ledger.append_entry(
+            "fleet_cache", m,
+            meta={"replicas": N_REPLICAS, "storm": STORM})
+        print(f"[fleet-cache-gate] ledger: appended fleet_cache "
+              f"({len(m)} metrics)")
+    except Exception as e:  # noqa: BLE001 — ledger trouble is advisory
+        print(f"[fleet-cache-gate] ledger append skipped "
+              f"({type(e).__name__}: {e})")
+
+    print(f"[fleet-cache-gate] {'PASS' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,7 +3,7 @@ a composed loadgen scenario (burst storm under shed + replica kill
 mid-storm + drain mid-storm + shared-prefix locality) driven against a
 3-replica in-process fleet (Router + overload plane, the PR 11-13
 stack), graded by profiler/scorecard.py through scenario-scoped
-metric Windows. Six pass/fail checks:
+metric Windows. Seven pass/fail checks:
 
   1. storm-shed    — the burst storm actually sheds (``serving.shed``
                      > 0 inside the storm's Window) while the HIGH
@@ -34,7 +34,16 @@ metric Windows. Six pass/fail checks:
                      after it — still reaches a clean terminal, real
                      handoffs happen, and everything the dead fabric
                      could not hand off fell OPEN to co-located
-                     serving (handoffs + fallbacks == arrivals).
+                     serving (handoffs + fallbacks == arrivals);
+  7. fleet-cache   — the ISSUE 20 fleet cache plane A/B: the same
+                     shared-prefix storm cache-blind vs cache-aware on
+                     a 3-replica fleet — aware holds a fleet-wide
+                     prefix block hit-rate >= ``FLEET_CACHE_HIT_RATE``
+                     (default 0.55; partial tail blocks cap the
+                     achievable rate) with a real gap over blind, >= 1
+                     cross-replica KV pull lands
+                     (``serving.fleet_cache.peer_pulls``), and both
+                     runs emit bit-identical tokens.
 
 Every number is read through a per-phase ``metrics.Window`` — the
 global registry is never reset. Appends a ``fleet_load`` entry
@@ -252,6 +261,97 @@ def check_disagg():
                 "disagg_ok": 1.0 if ok else 0.0}
 
 
+def check_fleet_cache():
+    """Fleet-cache phase (ISSUE 20): the SAME shared-prefix storm —
+    a loadgen locality workload, every prompt opening with ONE common
+    24-token prefix (3 full KV blocks) — replayed cache-BLIND
+    (``FLAGS_fleet_cache=0``) and cache-AWARE on a fresh 3-replica
+    fleet each way. Blind, every replica the storm touches recomputes
+    the prefix (fleet-wide block hit-rate ~0.5 at 2 requests per
+    replica); aware, digest routing concentrates the prefix and load
+    spills PULL it over the kv_transfer plane instead of re-prefilling.
+    Wants: aware hit-rate >= ``FLEET_CACHE_HIT_RATE`` (default 0.55),
+    a real A/B gap over blind, >= 1 ``serving.fleet_cache.peer_pulls``
+    with zero ``pull_fallbacks``, and bit-identical per-record outputs
+    across the two runs. Counters read through scoped
+    ``metrics.Window``s, the scenario discipline."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.profiler import metrics
+    from paddle_tpu.serving import Router, ServingEngine, loadgen
+
+    floor = float(os.environ.get("FLEET_CACHE_HIT_RATE", "0.55"))
+    spec = loadgen.WorkloadSpec(
+        prompt_len=(26, 30), max_new_tokens=(2, 3), locality=1.0,
+        num_prefixes=1, prefix_len=24, priority_mix={1: 1.0})
+    phase = loadgen.Phase("cache_storm", 6, arrival="burst",
+                          duration_s=0.02, workload=spec)
+    records = loadgen.Scenario("fleet_cache", [phase]).schedule(SEED)
+
+    saved = paddle.get_flags(["FLAGS_fleet_cache"])
+    runs = {}
+    try:
+        for mode, armed in (("blind", False), ("aware", True)):
+            paddle.set_flags({"FLAGS_fleet_cache": armed})
+            engines = [ServingEngine(_model(), temperature=0.0,
+                                     background=False,
+                                     dtype=jnp.float32, max_batch=2,
+                                     block_size=8, max_seq_len=64,
+                                     bucket_cap=32, max_queue=32,
+                                     prefix_cache=True)
+                       for _ in range(3)]
+            router = Router()
+            for i, eng in enumerate(engines):
+                router.add_replica(f"fc{i}", engine=eng)
+            win = metrics.Window("serving.")
+            # the first record is the fleet's heartbeat prime: it
+            # lands, completes, and (aware) advertises its digests
+            # before the rest of the storm bursts in
+            handles = [router.submit(loadgen.prompt_ids(records[0]),
+                                     max_new_tokens=records[0]
+                                     .max_new_tokens)]
+            for eng in engines:
+                eng.run_until_idle()
+            handles[0].result(timeout=60)
+            if router.fleet_cache is not None:
+                router.fleet_cache.publish(force=True)
+            handles += [router.submit(loadgen.prompt_ids(r),
+                                      max_new_tokens=r.max_new_tokens)
+                        for r in records[1:]]
+            for eng in engines:
+                eng.run_until_idle()
+            outs = [h.result(timeout=60) for h in handles]
+            win.freeze()
+            hits = win.value("serving.prefix.hit_blocks")
+            misses = win.value("serving.prefix.miss_blocks")
+            runs[mode] = {
+                "rate": hits / (hits + misses) if hits + misses else 0.0,
+                "pulls": win.value("serving.fleet_cache.peer_pulls"),
+                "fallbacks": win.value(
+                    "serving.fleet_cache.pull_fallbacks"),
+                "outs": outs,
+            }
+            for eng in engines:
+                eng.close()
+    finally:
+        paddle.set_flags(saved)
+    blind, aware = runs["blind"], runs["aware"]
+    identical = blind["outs"] == aware["outs"]
+    ok = (aware["rate"] >= floor and aware["rate"] > blind["rate"]
+          and aware["pulls"] >= 1 and aware["fallbacks"] == 0
+          and blind["pulls"] == 0 and identical)
+    print(f"[fleet-load-gate] fleet-cache: hit-rate "
+          f"blind={blind['rate']:.3f} aware={aware['rate']:.3f} "
+          f"(want >= {floor} and an A/B gap) "
+          f"pulls={aware['pulls']} fallbacks={aware['fallbacks']} "
+          f"bit-identical={identical} {'PASS' if ok else 'FAIL'}")
+    return ok, {"cache_blind_hit_rate": float(blind["rate"]),
+                "cache_aware_hit_rate": float(aware["rate"]),
+                "cache_peer_pulls": float(aware["pulls"]),
+                "fleet_cache_ok": 1.0 if ok else 0.0}
+
+
 def main():
     from paddle_tpu.profiler import scorecard
 
@@ -273,12 +373,14 @@ def main():
     ok4 = check_locality(card)
     harness.close()
     ok5, disagg_metrics = check_disagg()
-    ok = ok1 and ok2 and ok3 and ok4 and ok5 and ok_det
+    ok6, cache_metrics = check_fleet_cache()
+    ok = ok1 and ok2 and ok3 and ok4 and ok5 and ok6 and ok_det
 
     try:
         import bench_ledger
         m = scorecard.fleet_load_metrics(card)
         m.update(disagg_metrics)
+        m.update(cache_metrics)
         m["gate_ok"] = 1.0 if ok else 0.0
         bench_ledger.append_entry("fleet_load", m,
                                   meta={"scenario": card["scenario"],

@@ -93,6 +93,23 @@ def direction_and_tol(name):
         # each one is safe (import dedups, admission is idempotent) but
         # GROWTH means the channel is flaking more — larger is worse
         return ("up", RATE_TOL)
+    if name == "full_prefill_ratio":
+        # the fleet-cache headline (kind fleet_cache): aware-over-blind
+        # full-prefill tokens, ~1/N when cross-replica pulls land —
+        # a RATIO where larger is worse, like eager_over_jit_ratio
+        return ("up", RATE_TOL)
+    if "pull_fallbacks" in name or "fallbacks" in name:
+        # fleet-cache peer-pull fallbacks (kind fleet_cache) and disagg
+        # fallbacks (kind fleet_load): every one is a request that
+        # degraded to local/co-located serving — correct but slower, so
+        # GROWTH means the fabric or the advertisements got less honest
+        return ("up", RATE_TOL)
+    if "peer_pulls" in name or "coverage_hits" in name:
+        # fleet-cache plane effectiveness (kind fleet_cache /
+        # fleet_load): a DROP means the digest routing stopped finding
+        # (or stopped using) cross-replica prefixes — the plane quietly
+        # reverting to cache-blind without failing its gate
+        return ("down", RATE_TOL)
     if "lease_expired" in name:
         # remote-handoff leases that ran out before a terminal status
         # (kind disagg): every one is a presumed-dead peer and a

@@ -52,9 +52,18 @@ _MAP = [
       "tests/framework/test_disagg_remote.py"]),
     ("paddle_tpu/serving/disagg.py",
      ["tests/framework/test_disagg.py",
-      "tests/framework/test_disagg_remote.py"]),
+      "tests/framework/test_disagg_remote.py",
+      "tests/framework/test_fleet_cache.py"]),
     ("tools/disagg_gate.py", ["tests/framework/test_disagg.py",
                               "tests/framework/test_disagg_remote.py"]),
+    ("paddle_tpu/serving/fleet_cache.py",
+     ["tests/framework/test_fleet_cache.py",
+      "tests/framework/test_router.py"]),
+    ("paddle_tpu/serving/autoscaler.py",
+     ["tests/framework/test_fleet_cache.py",
+      "tests/framework/test_router.py"]),
+    ("tools/fleet_cache_gate.py",
+     ["tests/framework/test_fleet_cache.py"]),
     ("paddle_tpu/serving/", ["tests/framework/test_serving.py",
                              "tests/framework/test_prefix_cache.py",
                              "tests/framework/test_fleet_observatory.py",
@@ -62,7 +71,8 @@ _MAP = [
                              "tests/framework/test_overload.py",
                              "tests/framework/test_mesh_serving.py",
                              "tests/framework/test_disagg.py",
-                             "tests/framework/test_disagg_remote.py"]),
+                             "tests/framework/test_disagg_remote.py",
+                             "tests/framework/test_fleet_cache.py"]),
     ("paddle_tpu/inference/", ["tests/framework/test_paged_decode.py",
                                "tests/framework/test_serving.py",
                                "tests/framework/test_prefix_cache.py",
